@@ -1,0 +1,16 @@
+"""slate-tpu: TPU-native distributed dense linear algebra.
+
+A from-scratch re-design of the capabilities of SLATE (the ECP-era
+ScaLAPACK successor; reference include/slate/slate.hh) for TPU:
+tiled/distributed matrices as sharded jax.Arrays over an ICI mesh,
+per-tile BLAS on the MXU via XLA/Pallas, and the reference's MPI
+2D-block-cyclic communication expressed as XLA collectives.
+
+Public API mirrors the reference's routine vocabulary (gemm, potrf, gesv,
+geqrf, heev, svd, ...) plus the simplified verbs (multiply, chol_solve,
+...; include/slate/simplified_api.hh).
+"""
+
+from .core import *  # noqa: F401,F403
+from . import matgen
+from .linalg.norms import norm, col_norms

@@ -1,0 +1,12 @@
+from .types import (Uplo, Op, Diag, Side, Norm, NormScope, Direction, Layout,
+                    GridOrder, MatrixKind, MethodGemm, MethodTrsm, MethodHemm,
+                    MethodLU, MethodGels, MethodEig, MethodSVD, Options,
+                    DEFAULT_OPTIONS)
+from .exceptions import SlateError, slate_error_if, slate_assert
+from .grid import (ProcessGrid, num_tiles, tile_dim, tile_rank_2d,
+                   cyclic_permutation, inverse_permutation, gridinfo,
+                   ROW_AXIS, COL_AXIS)
+from .tiled_matrix import (TiledMatrix, from_dense, zeros, empty_like,
+                           triangular, symmetric, hermitian, band,
+                           hermitian_band, triangular_band, pad_mask,
+                           pad_diag_identity)

@@ -1,0 +1,23 @@
+"""Error handling for slate-tpu.
+
+Reference: include/slate/Exception.hh (slate::Exception, slate_error,
+slate_assert, MPI/LAPACK error translation). On TPU there is no MPI error
+class; numerical "info" codes from factorizations are returned as values
+(jit-compatible), and host-side argument validation raises SlateError.
+"""
+
+from __future__ import annotations
+
+
+class SlateError(RuntimeError):
+    """Analog of slate::Exception (include/slate/Exception.hh:1-126)."""
+
+
+def slate_error_if(cond: bool, msg: str) -> None:
+    if cond:
+        raise SlateError(msg)
+
+
+def slate_assert(cond: bool, msg: str = "assertion failed") -> None:
+    if not cond:
+        raise SlateError(msg)

@@ -1,0 +1,1 @@
+from .norms import norm, col_norms

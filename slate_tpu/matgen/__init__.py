@@ -1,0 +1,2 @@
+from .generate import generate_matrix, random_spd
+from . import random

@@ -1,0 +1,156 @@
+"""Test-matrix generation (reference: matgen/ library, slate_matgen).
+
+Reference entry point: generate_matrix(MatrixParams, A) with ~40 kinds
+(matgen/generate_matrix_utils.cc:64-136; type builders
+generate_type_{rand,svd,heev,geev}.hh; spectra in generate_sigma.hh).
+
+Here: ``generate_matrix(kind, m, n, ...)`` returns a dense jax array (wrap
+with core.from_dense to distribute). Determinism/distribution-independence
+comes from slate_tpu.matgen.random (counter-based, logical-shape keyed).
+
+Supported kind grammar (subset mirroring the reference):
+  zeros | ones | identity | jordan | minij | hilb | gcdmat | toeppen
+  rand | rands | randn | randb                    (+ _dominant suffix)
+  diag^{spectrum} | svd_{spectrum} | heev_{spectrum} | poev_{spectrum}
+with spectrum ∈ {logrand, arith, geo, cluster0, cluster1, rarith, rgeo,
+rcluster0, rcluster1, specified} and condition number ``cond``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError
+from . import random as rnd
+
+
+def _spectrum(kind: str, n: int, cond: float, dtype, seed: int) -> jax.Array:
+    """Singular/eigen-value profiles Σ (generate_sigma.hh analog).
+
+    All profiles have σ₁ = 1, σₙ = 1/cond (before random sign for 'r'
+    variants). Random profiles are keyed on the caller's seed, like the
+    reference matgen (matgen/random.cc keys everything on params.seed)."""
+    real = jnp.finfo(dtype).dtype
+    i = jnp.arange(n, dtype=real)
+    inv = jnp.asarray(1.0 / cond, real)
+    if kind in ("logrand",):
+        # log-uniform in [1/cond, 1]
+        u = jax.random.uniform(jax.random.fold_in(jax.random.key(seed), 1),
+                               (n,), real)
+        sig = jnp.exp(u * jnp.log(inv))
+    elif kind in ("arith",):
+        sig = 1.0 - i / max(n - 1, 1) * (1.0 - inv)
+    elif kind in ("geo",):
+        sig = inv ** (i / max(n - 1, 1))
+    elif kind in ("cluster0",):  # {1, 1/cond, ..., 1/cond}
+        sig = jnp.where(i == 0, 1.0, inv)
+    elif kind in ("cluster1",):  # {1, ..., 1, 1/cond}
+        sig = jnp.where(i == n - 1, inv, 1.0)
+    elif kind.startswith("r") and kind[1:] in ("logrand", "arith", "geo",
+                                               "cluster0", "cluster1"):
+        sig = _spectrum(kind[1:], n, cond, dtype, seed)
+        sign = jnp.where(
+            jax.random.bernoulli(jax.random.fold_in(jax.random.key(seed), 2),
+                                 0.5, (n,)), 1.0, -1.0
+        ).astype(real)
+        sig = sig * sign
+    else:
+        raise SlateError(f"unknown spectrum '{kind}'")
+    return sig.astype(real)
+
+
+def _random_orthogonal(seed: int, n: int, dtype) -> jax.Array:
+    """Haar-ish orthogonal/unitary via QR of a Gaussian (the reference
+    applies random Householder reflectors, generate_type_svd.hh — QR of a
+    Gaussian is the standard equivalent)."""
+    g = rnd.normal(seed, n, n, dtype)
+    q, r = jnp.linalg.qr(g)
+    # fix signs for determinism
+    d = jnp.diagonal(r)
+    ph = jnp.where(d == 0, jnp.ones((), d.dtype), d / jnp.abs(d))
+    return q * jnp.conj(ph)[None, :]
+
+
+def generate_matrix(kind: str, m: int, n: Optional[int] = None,
+                    dtype=jnp.float32, seed: int = 42,
+                    cond: Optional[float] = None) -> jax.Array:
+    """Dense (m × n) test matrix of the given kind."""
+    n = n if n is not None else m
+    k = min(m, n)
+    if cond is None:
+        cond = 1.0e4
+    base, _, spec = kind.partition("_")
+
+    if kind == "zeros" or kind == "zero":
+        return jnp.zeros((m, n), dtype)
+    if kind == "ones" or kind == "one":
+        return jnp.ones((m, n), dtype)
+    if kind == "identity":
+        return jnp.eye(m, n, dtype=dtype)
+    if kind == "jordan":
+        return jnp.eye(m, n, dtype=dtype) + jnp.eye(m, n, k=1, dtype=dtype)
+    if kind == "minij":
+        i = jnp.arange(1, m + 1)[:, None]
+        j = jnp.arange(1, n + 1)[None, :]
+        return jnp.minimum(i, j).astype(dtype)
+    if kind == "hilb":
+        i = jnp.arange(m)[:, None]
+        j = jnp.arange(n)[None, :]
+        return (1.0 / (i + j + 1)).astype(dtype)
+    if kind == "gcdmat":
+        i = jnp.arange(1, m + 1)[:, None]
+        j = jnp.arange(1, n + 1)[None, :]
+        return jnp.gcd(i, j).astype(dtype)
+    if kind == "toeppen":
+        # pentadiagonal Toeplitz [1, -10, 0, 10, 1]
+        a = jnp.zeros((m, n), dtype)
+        for off, v in ((-2, 1.0), (-1, -10.0), (1, 10.0), (2, 1.0)):
+            a = a + v * jnp.eye(m, n, k=off, dtype=dtype)
+        return a
+
+    dominant = kind.endswith("_dominant")
+    rkind = base
+    if rkind in ("rand", "rands", "randn", "randb"):
+        gen = {"rand": rnd.uniform, "rands": rnd.uniform_signed,
+               "randn": rnd.normal, "randb": rnd.binary}[rkind]
+        a = gen(seed, m, n, dtype)
+        if dominant:
+            a = a + k * jnp.eye(m, n, dtype=dtype)
+        return a
+
+    if base == "diag":
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        return jnp.zeros((m, n), dtype).at[jnp.arange(k), jnp.arange(k)].set(
+            sig.astype(dtype))
+
+    if base == "svd":
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        u = _random_orthogonal(seed, m, dtype)[:, :k]
+        v = _random_orthogonal(seed + 1, n, dtype)[:, :k]
+        return (u * sig[None, :].astype(dtype)) @ jnp.conj(v).T
+
+    if base in ("heev", "syev"):
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        q = _random_orthogonal(seed, n, dtype)
+        a = (q * sig[None, :].astype(dtype)) @ jnp.conj(q).T
+        return 0.5 * (a + jnp.conj(a).T)
+
+    if base == "poev":
+        sig = jnp.abs(_spectrum(spec or "logrand", k, cond, dtype, seed))
+        q = _random_orthogonal(seed, n, dtype)
+        a = (q * sig[None, :].astype(dtype)) @ jnp.conj(q).T
+        return 0.5 * (a + jnp.conj(a).T)
+
+    raise SlateError(f"unknown matrix kind '{kind}'")
+
+
+def random_spd(m: int, nb_unused: int = 0, dtype=jnp.float32, seed: int = 0,
+               ) -> jax.Array:
+    """Well-conditioned SPD/HPD matrix: A = G·Gᴴ/m + I (the standard posv
+    tester input; reference test/matrix_params)."""
+    g = rnd.normal(seed, m, m, dtype)
+    a = g @ jnp.conj(g).T / m + jnp.eye(m, dtype=dtype)
+    return 0.5 * (a + jnp.conj(a).T)

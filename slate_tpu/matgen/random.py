@@ -1,0 +1,55 @@
+"""Deterministic, distribution-independent random matrix entries.
+
+Reference: matgen/random.cc:43-72 — a counter-based Philox-2x64 RNG keyed
+on (seed, global entry index) so generated matrices are identical under
+any process distribution (CHANGELOG.md:77-79).
+
+TPU-native equivalent: jax.random *is* a counter-based (threefry) RNG.
+We generate at the *logical* (m, n) shape from key(seed) — never at the
+padded/sharded shape — so the values depend only on (seed, m, n, kind),
+not on tile size nb, process grid, or sharding. Padding and sharding are
+applied after generation; under jit+GSPMD the generation itself is
+partitioned across the mesh by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def uniform(seed: int, m: int, n: int, dtype, minval=0.0, maxval=1.0):
+    """Entries ~ U[minval, maxval) ('rand' kind, matgen Dist::Uniform)."""
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        real_dtype = jnp.finfo(dtype).dtype
+        k1, k2 = jax.random.split(_key(seed))
+        re = jax.random.uniform(k1, (m, n), real_dtype, minval, maxval)
+        im = jax.random.uniform(k2, (m, n), real_dtype, minval, maxval)
+        return (re + 1j * im).astype(dtype)
+    return jax.random.uniform(_key(seed), (m, n), dtype, minval, maxval)
+
+
+def uniform_signed(seed: int, m: int, n: int, dtype):
+    """'rands' kind: U[-1, 1)."""
+    return uniform(seed, m, n, dtype, -1.0, 1.0)
+
+
+def normal(seed: int, m: int, n: int, dtype):
+    """'randn' kind: N(0, 1)."""
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        real_dtype = jnp.finfo(dtype).dtype
+        k1, k2 = jax.random.split(_key(seed))
+        re = jax.random.normal(k1, (m, n), real_dtype)
+        im = jax.random.normal(k2, (m, n), real_dtype)
+        return (re + 1j * im).astype(dtype)
+    return jax.random.normal(_key(seed), (m, n), dtype)
+
+
+def binary(seed: int, m: int, n: int, dtype):
+    """'randb' kind: entries in {0, 1}."""
+    bits = jax.random.bernoulli(_key(seed), 0.5, (m, n))
+    return bits.astype(dtype)

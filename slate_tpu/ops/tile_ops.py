@@ -1,0 +1,170 @@
+"""Per-tile compute kernels.
+
+TPU-native replacement of the reference's L2 tile layer:
+- ``tile::gemm/trsm/herk/...`` forwarding to BLAS++
+  (include/slate/Tile_blas.hh:30,273,523,682) → jnp/lax ops that XLA maps
+  onto the MXU. Batching over many tiles (the analog of
+  ``blas::batch::gemm`` + device_regions_build,
+  src/internal/internal_batch.hh:197-391) is jax.vmap / einsum over a
+  leading batch axis — XLA emits one fused batched matmul.
+- ``tile::potrf/geqrf/getrf`` panel kernels (src/internal/Tile_lapack.hh:268,
+  Tile_getrf.hh, Tile_geqrf.hh) → lax.linalg factorizations on one tile.
+- aux tile ops ``tile::gecopy/geadd/geset/gescale`` and the device kernels
+  src/cuda/device_ge*.cu → trivial jnp expressions (XLA fuses them into
+  neighbors, which is exactly what the hand-written CUDA kernels exist to
+  approximate).
+
+All kernels are shape-polymorphic pure functions; "tiles" are any 2-D
+blocks (typically the padded nb×nb blocks of a TiledMatrix).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.types import Diag, Side, Uplo
+
+
+# -- BLAS-3 on tiles --------------------------------------------------------
+
+def gemm(alpha, a, b, beta, c):
+    """c ← α·a·b + β·c (tile::gemm, Tile_blas.hh:30)."""
+    return alpha * (a @ b) + beta * c
+
+
+def syrk(alpha, a, beta, c, uplo: Uplo = Uplo.Lower):
+    out = alpha * (a @ a.T) + beta * c
+    return _keep_triangle(out, c, uplo)
+
+
+def herk(alpha, a, beta, c, uplo: Uplo = Uplo.Lower):
+    out = alpha * (a @ jnp.conj(a).T) + beta * c
+    return _keep_triangle(out, c, uplo)
+
+
+def syr2k(alpha, a, b, beta, c, uplo: Uplo = Uplo.Lower):
+    out = alpha * (a @ b.T) + alpha * (b @ a.T) + beta * c
+    return _keep_triangle(out, c, uplo)
+
+
+def her2k(alpha, a, b, beta, c, uplo: Uplo = Uplo.Lower):
+    out = alpha * (a @ jnp.conj(b).T) + jnp.conj(alpha) * (b @ jnp.conj(a).T) + beta * c
+    return _keep_triangle(out, c, uplo)
+
+
+def _keep_triangle(out, orig, uplo: Uplo):
+    """syrk/herk only update one triangle; keep the other from orig."""
+    if uplo is Uplo.Lower:
+        return jnp.tril(out) + jnp.triu(orig, 1)
+    return jnp.triu(out) + jnp.tril(orig, -1)
+
+
+def trsm(side: Side, uplo: Uplo, alpha, a, b, diag: Diag = Diag.NonUnit,
+         conj_a: bool = False):
+    """Solve op(A)·X = α·B (Left) or X·op(A) = α·B (Right) for X with A
+    triangular (tile::trsm, Tile_blas.hh:682)."""
+    if conj_a:
+        a = jnp.conj(a)
+    x = lax.linalg.triangular_solve(
+        a, alpha * b,
+        left_side=(side is Side.Left),
+        lower=(uplo is Uplo.Lower),
+        unit_diagonal=(diag is Diag.Unit))
+    return x
+
+
+def trmm(side: Side, uplo: Uplo, alpha, a, b, diag: Diag = Diag.NonUnit):
+    """B ← α·op(A)·B with A triangular (tile::trmm, Tile_blas.hh:523)."""
+    tri = jnp.tril(a) if uplo is Uplo.Lower else jnp.triu(a)
+    if diag is Diag.Unit:
+        eye = jnp.eye(a.shape[0], dtype=a.dtype)
+        tri = tri - jnp.diag(jnp.diagonal(tri)) + eye
+    return alpha * (tri @ b) if side is Side.Left else alpha * (b @ tri)
+
+
+# -- LAPACK-style tile factorizations --------------------------------------
+
+def potrf(a, uplo: Uplo = Uplo.Lower):
+    """Cholesky of one tile (tile::potrf → lapack::potrf,
+    src/internal/Tile_lapack.hh:268). lax.linalg.cholesky lowers to a
+    blocked TPU implementation; upper is handled by conjugate transposition."""
+    if uplo is Uplo.Lower:
+        return lax.linalg.cholesky(a)
+    return jnp.conj(lax.linalg.cholesky(jnp.conj(a).T)).T
+
+
+def getrf(a):
+    """Partial-pivot LU of one tile → (lu, pivots, permutation).
+
+    Reference: the multi-threaded panel kernel src/internal/Tile_getrf.hh;
+    on TPU one tile factors with lax.linalg.lu (no cross-shard comms)."""
+    return lax.linalg.lu(a)
+
+
+def geqrf(a):
+    """Householder QR of one panel → packed (a_factored, taus)
+    (Tile_geqrf.hh analog)."""
+    return lax.linalg.geqrf(a)
+
+
+def qr_explicit(a):
+    """Economy QR returning explicit (Q, R) — building block for the
+    tall-skinny tree QR (internal_ttqrt analog)."""
+    q, r = jnp.linalg.qr(a, mode="reduced")
+    return q, r
+
+
+def trtri(a, uplo: Uplo = Uplo.Lower, diag: Diag = Diag.NonUnit):
+    """Invert one triangular tile via triangular solve against I."""
+    eye = jnp.eye(a.shape[0], dtype=a.dtype)
+    return lax.linalg.triangular_solve(
+        a, eye, left_side=True, lower=(uplo is Uplo.Lower),
+        unit_diagonal=(diag is Diag.Unit))
+
+
+# -- aux tile ops (device_ge*.cu analogs) ----------------------------------
+
+def geadd(alpha, a, beta, b):
+    """b ← α·a + β·b (internal_geadd / device_geadd.cu)."""
+    return alpha * a + beta * b
+
+
+def gecopy(a, dtype=None):
+    """copy with optional precision conversion (device_gecopy.cu does
+    mixed-precision copies; here it's astype)."""
+    return a.astype(dtype) if dtype is not None else a
+
+
+def gescale(numer, denom, a):
+    return a * (numer / denom)
+
+
+def gescale_row_col(r, c, a):
+    """a[i,j] *= r[i]·c[j] (internal_gescale_row_col)."""
+    return a * r[:, None] * c[None, :]
+
+
+def geset(offdiag, diag_, shape, dtype):
+    """Set off-diagonal entries to offdiag, diagonal to diag_
+    (device_geset.cu)."""
+    a = jnp.full(shape, offdiag, dtype)
+    k = min(shape)
+    return a.at[jnp.arange(k), jnp.arange(k)].set(jnp.asarray(diag_, dtype))
+
+
+def tzset(offdiag, diag_, shape, dtype, uplo: Uplo):
+    a = geset(offdiag, diag_, shape, dtype)
+    z = jnp.zeros((), dtype)
+    if uplo is Uplo.Lower:
+        return jnp.tril(a)
+    if uplo is Uplo.Upper:
+        return jnp.triu(a)
+    return a
+
+
+def transpose_tile(a, conj=False):
+    """device_transpose.cu analog — XLA handles layout; kept for parity."""
+    at = a.T
+    return jnp.conj(at) if conj else at

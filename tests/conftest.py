@@ -1,0 +1,52 @@
+"""Test configuration: 8 virtual CPU devices.
+
+The reference tests multi-node behavior by actually running
+``mpirun -np N`` (SURVEY §4). Our analog: an 8-device virtual CPU mesh via
+--xla_force_host_platform_device_count, so every collective/sharding path
+runs in CI without a TPU pod — the same trick the driver's
+dryrun_multichip uses. Single-device degeneracy is tested with 1×1 grids.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize (TPU tunnel) forces jax_platforms="axon,cpu" via
+# jax.config at interpreter start; override back to cpu before any backend
+# is initialized so tests get the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) >= 8, f"expected 8 virtual devices, got {len(d)}"
+    return d
+
+
+@pytest.fixture(scope="session")
+def grid2x2():
+    from slate_tpu.core.grid import ProcessGrid
+    return ProcessGrid.create(2, 2)
+
+
+@pytest.fixture(scope="session")
+def grid2x4():
+    from slate_tpu.core.grid import ProcessGrid
+    return ProcessGrid.create(2, 4)
+
+
+@pytest.fixture(scope="session")
+def grid1x1():
+    from slate_tpu.core.grid import ProcessGrid
+    return ProcessGrid.create(1, 1)

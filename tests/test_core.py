@@ -1,0 +1,147 @@
+"""Core data-model tests.
+
+Mirrors the reference's unit_test/test_Matrix.cc (constructors, views,
+sub, slice, transpose) and test_func.cc (distribution index maps).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.grid import (cyclic_permutation, inverse_permutation,
+                                 num_tiles, tile_dim, tile_rank_2d)
+from slate_tpu.core.types import Diag, MatrixKind, Op, Uplo
+
+
+def test_num_tiles_and_dim():
+    assert num_tiles(100, 32) == 4
+    assert num_tiles(96, 32) == 3
+    assert tile_dim(3, 100, 32) == 4
+    assert tile_dim(0, 100, 32) == 32
+    assert tile_dim(2, 96, 32) == 32
+
+
+def test_tile_rank_2d():
+    # 2D block-cyclic: tile (i, j) -> (i mod p, j mod q) (func.hh:100)
+    p, q = 2, 3
+    ranks = {(i, j): tile_rank_2d(i, j, p, q) for i in range(4) for j in range(6)}
+    assert ranks[(0, 0)] == ranks[(2, 0)] == ranks[(0, 3)]
+    assert len(set(ranks.values())) == p * q
+
+
+def test_cyclic_permutation_roundtrip():
+    for nt, p in [(7, 2), (8, 4), (5, 3), (1, 4)]:
+        perm = cyclic_permutation(nt, p)
+        inv = inverse_permutation(perm)
+        for i in range(nt):
+            assert perm[inv[i]] == i
+        per = -(-nt // p)
+        for pi in range(p):
+            chunk = perm[pi * per:(pi + 1) * per]
+            owned = [t for t in chunk if t >= 0]
+            assert all(t % p == pi for t in owned)
+
+
+def test_from_dense_roundtrip():
+    a = np.arange(30.0).reshape(5, 6)
+    A = st.from_dense(a, nb=4)
+    assert A.data.shape == (8, 8)  # padded
+    assert A.shape == (5, 6)
+    assert A.mt == 2 and A.nt == 2
+    np.testing.assert_array_equal(A.to_numpy(), a)
+
+
+def test_transpose_views():
+    a = np.arange(12.0).reshape(3, 4)
+    A = st.from_dense(a, nb=2)
+    At = A.T
+    assert At.shape == (4, 3)
+    np.testing.assert_array_equal(At.to_numpy(), a.T)
+    np.testing.assert_array_equal(At.T.to_numpy(), a)
+    # conj transpose on complex
+    c = (a + 1j * a).astype(np.complex64)
+    C = st.from_dense(c, nb=2)
+    np.testing.assert_array_equal(C.H.to_numpy(), c.conj().T)
+    np.testing.assert_array_equal(C.H.H.to_numpy(), c)
+    np.testing.assert_array_equal(C.T.H.to_numpy(), c.conj())
+
+
+def test_tile_access():
+    a = np.arange(64.0).reshape(8, 8)
+    A = st.from_dense(a, nb=4)
+    np.testing.assert_array_equal(np.asarray(A.tile(1, 0)), a[4:8, 0:4])
+    B = A.with_tile(0, 1, jnp.zeros((4, 4)))
+    out = B.to_numpy()
+    assert (out[0:4, 4:8] == 0).all()
+    assert (out[4:8, 0:4] == a[4:8, 0:4]).all()
+
+
+def test_sub_and_slice():
+    a = np.arange(81.0).reshape(9, 9)
+    A = st.from_dense(a, nb=3)
+    S = A.sub(1, 2, 0, 1)
+    np.testing.assert_array_equal(S.to_numpy(), a[3:9, 0:6])
+    Z = A.slice(2, 6, 1, 7)
+    np.testing.assert_array_equal(Z.to_numpy(), a[2:7, 1:8])
+
+
+def test_full_dense_symmetric_hermitian():
+    a = np.triu(np.arange(16.0).reshape(4, 4)) + 4 * np.eye(4)
+    A = st.symmetric(a, nb=2, uplo=Uplo.Upper)
+    f = np.asarray(A.full_dense())
+    np.testing.assert_array_equal(f, np.triu(a) + np.triu(a, 1).T)
+
+    c = (np.tril(np.arange(16.0).reshape(4, 4)) + 1j * np.tril(np.ones((4, 4)), -1))
+    c = c.astype(np.complex128)
+    H = st.hermitian(c, nb=2, uplo=Uplo.Lower)
+    f = np.asarray(H.full_dense())
+    np.testing.assert_allclose(f, np.tril(c) + np.tril(c, -1).conj().T)
+    assert np.allclose(np.imag(np.diagonal(f)), 0)
+
+
+def test_full_dense_triangular_unit():
+    a = np.arange(16.0).reshape(4, 4) + 1
+    T = st.triangular(a, nb=2, uplo=Uplo.Lower, diag=Diag.Unit)
+    f = np.asarray(T.full_dense())
+    expect = np.tril(a, -1) + np.eye(4)
+    np.testing.assert_array_equal(f, expect)
+
+
+def test_band_mask():
+    a = np.ones((6, 6))
+    B = st.band(a, nb=2, kl=1, ku=2)
+    f = np.asarray(B.full_dense())[:6, :6]
+    r, c = np.indices((6, 6))
+    expect = ((c - r <= 2) & (r - c <= 1)).astype(float)
+    np.testing.assert_array_equal(f, expect)
+
+
+def test_shard_2x2(grid2x2):
+    a = np.arange(64.0).reshape(8, 8)
+    A = st.from_dense(a, nb=2, grid=grid2x2)
+    assert len(A.data.sharding.device_set) == 4
+    np.testing.assert_array_equal(A.to_numpy(), a)
+
+
+def test_pytree_jit_roundtrip():
+    a = np.arange(16.0).reshape(4, 4)
+    A = st.from_dense(a, nb=2)
+
+    @jax.jit
+    def f(M: st.TiledMatrix):
+        return M.with_data(M.data * 2.0)
+
+    B = f(A)
+    np.testing.assert_array_equal(B.to_numpy(), 2 * a)
+    assert B.nb == 2 and B.shape == (4, 4)
+
+
+def test_pad_diag_identity():
+    a = np.eye(5) * 3.0
+    A = st.from_dense(a, nb=4)  # padded to 8x8
+    P = st.pad_diag_identity(A)
+    d = np.asarray(P.data)
+    assert (np.diagonal(d)[5:] == 1.0).all()
+    np.testing.assert_array_equal(P.to_numpy(), a)

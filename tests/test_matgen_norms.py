@@ -1,0 +1,87 @@
+"""matgen determinism + norm correctness.
+
+Reference analogs: unit_test/test_norm.cc and the matgen
+distribution-independence property (matgen/random.cc, CHANGELOG.md:77-79).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import Norm, NormScope, Uplo
+from slate_tpu.matgen import generate_matrix, random_spd
+
+
+def test_matgen_deterministic_and_distribution_independent(grid2x2):
+    a1 = np.asarray(generate_matrix("randn", 12, 12, jnp.float64, seed=7))
+    a2 = np.asarray(generate_matrix("randn", 12, 12, jnp.float64, seed=7))
+    np.testing.assert_array_equal(a1, a2)
+    # same values regardless of nb and grid (counter-based keyed on logical
+    # shape — matgen/random.cc property)
+    A_nb4 = st.from_dense(a1, nb=4)
+    A_nb5 = st.from_dense(a1, nb=5, grid=grid2x2)
+    np.testing.assert_array_equal(A_nb4.to_numpy(), A_nb5.to_numpy())
+
+
+def test_matgen_kinds_shapes():
+    for kind in ["zeros", "ones", "identity", "minij", "hilb", "gcdmat",
+                 "rand", "rands", "randn", "randb", "rand_dominant",
+                 "svd_arith", "svd_geo", "svd_cluster0", "heev_arith",
+                 "poev_logrand", "diag_arith"]:
+        a = generate_matrix(kind, 8, 8, jnp.float64)
+        assert a.shape == (8, 8), kind
+        assert np.isfinite(np.asarray(a)).all(), kind
+
+
+def test_matgen_spectra():
+    cond = 100.0
+    a = generate_matrix("svd_geo", 16, 16, jnp.float64, cond=cond)
+    s = np.linalg.svd(np.asarray(a), compute_uv=False)
+    assert abs(s[0] - 1.0) < 1e-8
+    assert abs(s[-1] - 1.0 / cond) < 1e-8
+    h = generate_matrix("heev_arith", 16, 16, jnp.float64, cond=cond)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h).T, atol=1e-12)
+
+
+def test_norms_general():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((7, 9))
+    A = st.from_dense(a, nb=4)  # padding must not affect norms
+    assert np.isclose(float(st.norm(A, Norm.Max)), np.abs(a).max())
+    assert np.isclose(float(st.norm(A, Norm.One)), np.abs(a).sum(0).max())
+    assert np.isclose(float(st.norm(A, Norm.Inf)), np.abs(a).sum(1).max())
+    assert np.isclose(float(st.norm(A, Norm.Fro)), np.linalg.norm(a, "fro"))
+
+
+def test_norms_structured():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((6, 6))
+    S = st.symmetric(np.tril(a), nb=4, uplo=Uplo.Lower)
+    full = np.tril(a) + np.tril(a, -1).T
+    assert np.isclose(float(st.norm(S, Norm.One)), np.abs(full).sum(0).max())
+    T = st.triangular(a, nb=4, uplo=Uplo.Upper)
+    assert np.isclose(float(st.norm(T, Norm.Fro)),
+                      np.linalg.norm(np.triu(a), "fro"))
+
+
+def test_norm_nan_propagates():
+    a = np.ones((4, 4))
+    a[2, 1] = np.nan
+    A = st.from_dense(a, nb=2)
+    assert np.isnan(float(st.norm(A, Norm.Max)))
+    assert np.isnan(float(st.norm(A, Norm.One)))
+
+
+def test_col_norms():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((5, 7))
+    A = st.from_dense(a, nb=3)
+    np.testing.assert_allclose(np.asarray(st.col_norms(A, Norm.Max)),
+                               np.abs(a).max(0), rtol=1e-12)
+
+
+def test_random_spd_is_spd():
+    a = np.asarray(random_spd(16, dtype=jnp.float64))
+    w = np.linalg.eigvalsh(a)
+    assert w.min() > 0
