@@ -13,4 +13,21 @@ geqrf, heev, svd, ...) plus the simplified verbs (multiply, chol_solve,
 
 from .core import *  # noqa: F401,F403
 from . import matgen
-from .linalg.norms import norm, col_norms
+from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
+                     her2k, trmm, trsm, gbmm, hbmm, tbsm, add, copy, scale,
+                     scale_row_col, set_matrix, set_lambda, redistribute,
+                     potrf, potrs, posv, trtri, trtrm, potri, posv_mixed,
+                     getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv,
+                     gesv_nopiv, gesv_rbt, gesv_mixed, getri, gerbt,
+                     QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
+                     gels, qr_multiply_explicit,
+                     gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
+                     gecondest, pocondest, trcondest, hesv, hetrf, hetrs)
+from . import api
+from . import utils
+from .api import (multiply, rank_k_update, rank_2k_update,
+                  triangular_multiply, triangular_solve, lu_factor, lu_solve,
+                  lu_solve_using_factor, lu_inverse_using_factor,
+                  chol_factor, chol_solve, chol_solve_using_factor,
+                  chol_inverse_using_factor, band_solve, indefinite_solve,
+                  least_squares_solve)

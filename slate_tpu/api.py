@@ -1,0 +1,133 @@
+"""Simplified API — overloaded linear-algebra verbs.
+
+Reference: include/slate/simplified_api.hh (848 LoC): multiply,
+rank_k_update, rank_2k_update, triangular_multiply, triangular_solve,
+band_solve, lu_solve, lu_factor, lu_solve_using_factor, chol_solve,
+chol_factor, chol_solve_using_factor, indefinite_solve,
+least_squares_solve, plus eig/svd entries. Dispatch keys off matrix
+kinds, mirroring the reference's overload sets.
+"""
+
+from __future__ import annotations
+
+from .core.exceptions import SlateError
+from .core.tiled_matrix import TiledMatrix
+from .core.types import MatrixKind, Options, Side, DEFAULT_OPTIONS
+from .linalg import (blas3, band as band_mod, cholesky, indefinite, lu as
+                     lu_mod, qr as qr_mod)
+
+
+def multiply(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
+             opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """C = α·A·B + β·C, dispatching on A/B kind (simplified_api.hh
+    multiply → gemm/hemm/symm/gbmm/hbmm)."""
+    if A.kind is MatrixKind.Hermitian:
+        return blas3.hemm(Side.Left, alpha, A, B, beta, C, opts)
+    if B.kind is MatrixKind.Hermitian:
+        return blas3.hemm(Side.Right, alpha, B, A, beta, C, opts)
+    if A.kind is MatrixKind.Symmetric:
+        return blas3.symm(Side.Left, alpha, A, B, beta, C, opts)
+    if B.kind is MatrixKind.Symmetric:
+        return blas3.symm(Side.Right, alpha, B, A, beta, C, opts)
+    if A.kind is MatrixKind.Band:
+        return blas3.gbmm(alpha, A, B, beta, C, opts)
+    if A.kind is MatrixKind.HermitianBand:
+        return blas3.hbmm(Side.Left, alpha, A, B, beta, C, opts)
+    return blas3.gemm(alpha, A, B, beta, C, opts)
+
+
+def rank_k_update(alpha, A: TiledMatrix, beta, C: TiledMatrix,
+                  opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if C.kind is MatrixKind.Hermitian:
+        return blas3.herk(alpha, A, beta, C, opts)
+    return blas3.syrk(alpha, A, beta, C, opts)
+
+
+def rank_2k_update(alpha, A: TiledMatrix, B: TiledMatrix, beta,
+                   C: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+                   ) -> TiledMatrix:
+    if C.kind is MatrixKind.Hermitian:
+        return blas3.her2k(alpha, A, B, beta, C, opts)
+    return blas3.syr2k(alpha, A, B, beta, C, opts)
+
+
+def triangular_multiply(alpha, A: TiledMatrix, B: TiledMatrix,
+                        side: Side = Side.Left,
+                        opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    return blas3.trmm(side, alpha, A, B, opts)
+
+
+def triangular_solve(alpha, A: TiledMatrix, B: TiledMatrix,
+                     side: Side = Side.Left,
+                     opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if A.kind is MatrixKind.TriangularBand:
+        return blas3.tbsm(side, alpha, A, B, opts)
+    return blas3.trsm(side, alpha, A, B, opts)
+
+
+def lu_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    return lu_mod.getrf(A, opts)
+
+
+def lu_solve(A: TiledMatrix, B: TiledMatrix,
+             opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if A.kind is MatrixKind.Band:
+        X, info = band_mod.gbsv(A, B, opts)
+        return X
+    X, info = lu_mod.gesv(A, B, opts)
+    return X
+
+
+def lu_solve_using_factor(LU, perm, B: TiledMatrix,
+                          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    return lu_mod.getrs(LU, perm, B, opts)
+
+
+def lu_inverse_using_factor(LU, perm, opts: Options = DEFAULT_OPTIONS):
+    return lu_mod.getri(LU, perm, opts)
+
+
+def chol_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    if A.kind is MatrixKind.HermitianBand:
+        return band_mod.pbtrf(A, opts)
+    return cholesky.potrf(A, opts)
+
+
+def chol_solve(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if A.kind is MatrixKind.HermitianBand:
+        X, info = band_mod.pbsv(A, B, opts)
+        return X
+    X, info = cholesky.posv(A, B, opts)
+    return X
+
+
+def chol_solve_using_factor(L, B: TiledMatrix,
+                            opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    return cholesky.potrs(L, B, opts)
+
+
+def chol_inverse_using_factor(L, opts: Options = DEFAULT_OPTIONS):
+    return cholesky.potri(L, opts)
+
+
+def band_solve(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if A.kind is MatrixKind.HermitianBand:
+        X, _ = band_mod.pbsv(A, B, opts)
+        return X
+    if A.kind is MatrixKind.Band:
+        X, _ = band_mod.gbsv(A, B, opts)
+        return X
+    raise SlateError("band_solve: A must be a band matrix")
+
+
+def indefinite_solve(A: TiledMatrix, B: TiledMatrix,
+                     opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    X, info = indefinite.hesv(A, B, opts)
+    return X
+
+
+def least_squares_solve(A: TiledMatrix, B: TiledMatrix,
+                        opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    return qr_mod.gels(A, B, opts)

@@ -139,6 +139,32 @@ class TiledMatrix:
             return self.data.T
         return jnp.conj(self.data).T
 
+    def dense_canonical(self) -> jax.Array:
+        """Padded dense of the view at the *canonical* size (mt·nb, nt·nb),
+        cropping or zero-padding any extra grid-rounding padding (see
+        shard()). Drivers use this so operand shapes always line up."""
+        a = self.dense()
+        rows, cols = self.mt * self.nb, self.nt * self.nb
+        if a.shape == (rows, cols):
+            return a
+        a = a[:rows, :cols]
+        if a.shape != (rows, cols):
+            a = jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
+        return a
+
+    def full_dense_canonical(self) -> jax.Array:
+        """full_dense() cropped/padded to the canonical (mt·nb, nt·nb)
+        size — the form drivers must use so operand shapes line up
+        regardless of grid-rounding padding (see shard())."""
+        a = self.full_dense()
+        rows, cols = self.mt * self.nb, self.nt * self.nb
+        if a.shape == (rows, cols):
+            return a
+        a = a[:rows, :cols]
+        if a.shape != (rows, cols):
+            a = jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
+        return a
+
     def to_numpy(self) -> np.ndarray:
         """Crop padding and return the logical (view-shaped) matrix."""
         mm, nn = self.shape
@@ -305,6 +331,13 @@ def from_dense(a, nb: int, grid: Optional[ProcessGrid] = None,
         raise SlateError("from_dense expects a 2-D array")
     m, n = logical_shape if logical_shape is not None else a.shape
     a = _pad_to_tiles(a, nb)
+    if logical_shape is not None and (m < a.shape[0] or n < a.shape[1]):
+        # invariant: storage beyond the logical shape is zero (drivers
+        # rely on it — e.g. trsm's unit-padded diagonal, solves with
+        # zero-padded rhs)
+        r = jnp.arange(a.shape[0])[:, None] < m
+        c = jnp.arange(a.shape[1])[None, :] < n
+        a = jnp.where(r & c, a, jnp.zeros((), a.dtype))
     t = TiledMatrix(a, m, n, nb, kind=kind, uplo=uplo, diag=diag, kl=kl, ku=ku,
                     grid=grid)
     if grid is not None:
@@ -372,15 +405,19 @@ def pad_mask(t: TiledMatrix) -> jax.Array:
     return r & c
 
 
+def unit_pad_diag(a: jax.Array, m_log: int, n_log: int) -> jax.Array:
+    """Set 1 on the diagonal of the padding region (rows/cols beyond the
+    logical (m_log, n_log)). The single shared helper behind every
+    factorization's 'padded system is block-diag [[A,0],[0,I]]' trick
+    (SURVEY §7 risk (v))."""
+    idx = jnp.arange(min(a.shape))
+    d = jnp.diagonal(a)[: idx.size]
+    on_pad = (idx >= m_log) | (idx >= n_log)
+    return a.at[idx, idx].set(jnp.where(on_pad, jnp.ones((), a.dtype), d))
+
+
 def pad_diag_identity(t: TiledMatrix) -> TiledMatrix:
     """Put 1 on the padded part of the diagonal so factorizations of the
     padded storage stay well-defined (SURVEY §7 risk (v)). The padding is
     cropped away by to_dense(), and zero rhs padding keeps solves exact."""
-    a = t.data
-    k = min(a.shape)
-    idx = jnp.arange(k)
-    on_pad = (idx >= t.m) | (idx >= t.n)
-    d = jnp.diagonal(a)[:k]
-    newd = jnp.where(on_pad, jnp.ones((), a.dtype), d)
-    a = a.at[idx, idx].set(newd)
-    return t.with_data(a)
+    return t.with_data(unit_pad_diag(t.data, t.m, t.n))

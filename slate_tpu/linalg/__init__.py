@@ -1,1 +1,15 @@
 from .norms import norm, col_norms
+from .blas3 import (gemm, symm, hemm, syrk, herk, syr2k, her2k, trmm, trsm,
+                    gbmm, hbmm, tbsm)
+from .elementwise import (add, copy, scale, scale_row_col, set_matrix,
+                          set_lambda, redistribute)
+from .cholesky import (potrf, potrs, posv, trtri, trtrm, potri, posv_mixed)
+from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
+                 gesv_rbt, gesv_mixed, getri, gerbt)
+from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
+                 qr_multiply_explicit)
+from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
+from .condest import gecondest, pocondest, trcondest
+from .indefinite import hesv, hetrf, hetrs
+from . import blas3, band, cholesky, condest, elementwise, indefinite, lu, qr
+

@@ -1,0 +1,98 @@
+"""Band linear solvers: gbsv/gbtrf/gbtrs (band LU), pbsv/pbtrf/pbtrs
+(band Cholesky).
+
+Reference: src/gbsv.cc, src/gbtrf.cc, src/gbtrs.cc, src/pbsv.cc,
+src/pbtrf.cc, src/pbtrs.cc — band variants of the dense drivers operating
+on BandMatrix/HermitianBandMatrix tile storage (only tiles within the
+band exist; partial pivoting in gbtrf fills the band out to kl+ku).
+
+Round-1 TPU design: band structure lives in the (kl, ku) mask of
+TiledMatrix (full_dense applies it); the factorizations reuse the dense
+blocked kernels, which on TPU is usually the *right* trade — the MXU
+prefers one dense matmul over many skinny band updates, and XLA cannot
+exploit the zero blocks anyway without a packed layout. A packed band
+layout (storing only the O(n·(kl+ku)) band) is the flagged follow-up for
+memory-bound cases.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import MatrixKind, Options, Uplo, DEFAULT_OPTIONS
+from . import cholesky as chol
+from . import lu as lu_mod
+
+Array = jax.Array
+
+
+def gbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> Tuple[TiledMatrix, Array, Array]:
+    """Band LU with partial pivoting (slate::gbtrf, src/gbtrf.cc).
+
+    Pivoting fills the upper band out to kl+ku (same as the reference,
+    which allocates the extra super-diagonal tiles)."""
+    if A.kind is not MatrixKind.Band:
+        raise SlateError("gbtrf: A must be a band matrix")
+    dense = TiledMatrix(A.full_dense_canonical(), A.shape[0], A.shape[1], A.nb,
+                        grid=A.grid)
+    LU, perm, info = lu_mod.getrf(dense, opts)
+    # record the filled band: L keeps kl, U fills to kl+ku
+    out = from_dense(LU.dense_canonical(), A.nb, grid=A.grid,
+                     kind=MatrixKind.Band, kl=A.kl, ku=A.kl + A.ku,
+                     logical_shape=A.shape)
+    return out, perm, info
+
+
+def gbtrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Solve from gbtrf factors (slate::gbtrs — tbsm sweeps)."""
+    dense = TiledMatrix(LU.data, LU.shape[0], LU.shape[1], LU.nb,
+                        grid=LU.grid)
+    return lu_mod.getrs(dense, perm, B, opts)
+
+
+def gbsv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> Tuple[TiledMatrix, Array]:
+    """slate::gbsv = gbtrf + gbtrs (src/gbsv.cc)."""
+    LU, perm, info = gbtrf(A, opts)
+    X = gbtrs(LU, perm, B, opts)
+    return X, info
+
+
+def pbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> Tuple[TiledMatrix, Array]:
+    """Band Cholesky (slate::pbtrf, src/pbtrf.cc). The factor keeps the
+    band: L has bandwidth kd (no fill outside the band)."""
+    if A.kind is not MatrixKind.HermitianBand:
+        raise SlateError("pbtrf: A must be Hermitian band")
+    kd = A.kl or A.ku
+    herm = TiledMatrix(A.full_dense_canonical(), A.shape[0], A.shape[1], A.nb,
+                       kind=MatrixKind.Hermitian, uplo=Uplo.Lower,
+                       grid=A.grid)
+    L, info = chol.potrf(herm, opts)
+    out = from_dense(L.dense_canonical(), A.nb, grid=A.grid,
+                     kind=MatrixKind.TriangularBand, uplo=Uplo.Lower,
+                     kl=kd, ku=0, logical_shape=A.shape)
+    return out, info
+
+
+def pbtrs(L: TiledMatrix, B: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Solve from pbtrf factors (slate::pbtrs — two tbsm sweeps)."""
+    tri = TiledMatrix(L.full_dense_canonical(), L.shape[0], L.shape[1], L.nb,
+                      kind=MatrixKind.Triangular, uplo=L.uplo, grid=L.grid)
+    return chol.potrs(tri, B, opts)
+
+
+def pbsv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> Tuple[TiledMatrix, Array]:
+    """slate::pbsv = pbtrf + pbtrs (src/pbsv.cc)."""
+    L, info = pbtrf(A, opts)
+    X = pbtrs(L, B, opts)
+    return X, info

@@ -1,0 +1,238 @@
+"""Cholesky family: potrf, potrs, posv, trtri, trtrm, potri, posv_mixed.
+
+Reference: src/potrf.cc (driver + task DAG, SURVEY §3.1), src/potrs.cc,
+src/posv.cc, src/trtri.cc, src/trtrm.cc, src/potri.cc,
+src/posv_mixed.cc, with internals internal_potrf/internal_trsm/
+internal_herk and the per-tile lapack::potrf on device
+(src/internal/internal_potrf.cc:58-75).
+
+TPU-native design (SURVEY §7.4): the reference's OpenMP task DAG with
+panel/lookahead/trailing tasks and hypercube tile broadcasts
+(src/potrf.cc:84-195) becomes a statically-unrolled blocked right-looking
+loop inside one jit:
+
+    for k in 0..nt-1:
+        L[k,k]   = chol(A[k,k])                  (internal::potrf analog)
+        L[k+1:,k]= A[k+1:,k] · L[k,k]^-H         (internal::trsm, batched)
+        A[k+1:,k+1:] -= L[k+1:,k] · L[k+1:,k]ᴴ   (internal::herk trailing)
+
+Each step's trailing update is ONE large MXU matmul; under GSPMD the
+panel is all-gathered along the mesh axes (the analog of
+tileBcast/listBcastMT at src/potrf.cc:109-132) and the update runs on
+all devices. Lookahead (Option::Lookahead, P3) has no explicit analog:
+XLA's async scheduler overlaps the collectives of step k+1 with the
+tail of step k where the dependence allows.
+
+Unlike LAPACK's in-place convention the factor is returned as a new
+lower-TriangularMatrix (functional semantics); ``info`` follows the
+reference's reduce_info convention (src/potrf.cc:208): 0 = success,
+k > 0 = leading minor k not positive definite.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
+from ..core.types import (Diag, MatrixKind, Norm, Options, Side, Uplo,
+                          DEFAULT_OPTIONS)
+from ..ops import tile_ops
+from . import blas3
+from . import elementwise as ew
+from .elementwise import copy as copy_matrix
+from .norms import norm
+
+
+def _chol_info_scan(a: jax.Array) -> jax.Array:
+    """Exact LAPACK-style failing index for one non-SPD tile.
+
+    lax.linalg.cholesky NaN-poisons the entire tile on failure, so the
+    1-based index of the first non-positive leading minor (LAPACK potrf
+    info) is recovered by an unblocked fori_loop recurrence. Only invoked
+    (under lax.cond) when a tile actually failed — the fast path never
+    pays for it."""
+    nbb = a.shape[0]
+    rdtype = jnp.real(a).dtype
+
+    def body(i, carry):
+        mat, info = carry
+        d = jnp.real(mat[i, i])
+        bad = jnp.isnan(d) | (d <= 0)
+        info = jnp.where((info == 0) & bad, i + 1, info)
+        dsafe = jnp.where(bad, jnp.ones((), rdtype), d)
+        col = mat[:, i] / jnp.sqrt(dsafe).astype(mat.dtype)
+        idx = jnp.arange(nbb)
+        live = (idx[:, None] > i) & (idx[None, :] > i)
+        mat = mat - jnp.where(live, jnp.outer(col, jnp.conj(col)), 0)
+        return (mat, info)
+
+    _, info = jax.lax.fori_loop(0, nbb, body, (a, jnp.zeros((), jnp.int32)))
+    return info
+
+
+def _potrf_blocked(a: jax.Array, nb: int, nt: int):
+    """Right-looking blocked Cholesky on padded dense (lower).
+
+    Returns (tril factor, info). Unlike LAPACK we do not stop at the
+    first failure (data-dependent early exit is not jit-able); NaNs
+    propagate through later steps and ``info`` reports the first failing
+    1-based global index, matching the reference's reduce_info semantics."""
+    info = jnp.zeros((), jnp.int32)
+    for k in range(nt):
+        k0, k1 = k * nb, (k + 1) * nb
+        akk = a[k0:k1, k0:k1]
+        lkk = tile_ops.potrf(akk, Uplo.Lower)
+        tile_failed = jnp.any(jnp.isnan(jnp.diagonal(lkk)))
+        tile_info = jax.lax.cond(
+            tile_failed, lambda t=akk: _chol_info_scan(t),
+            lambda: jnp.zeros((), jnp.int32))
+        info = jnp.where((info == 0) & (tile_info > 0), k0 + tile_info, info)
+        a = a.at[k0:k1, k0:k1].set(lkk)
+        if k1 < a.shape[0]:
+            panel = a[k1:, k0:k1]
+            # panel ← panel · L[k,k]^-H  (Right/Lower/ConjTrans trsm)
+            panel = jax.lax.linalg.triangular_solve(
+                jnp.conj(lkk), panel, left_side=False, lower=True,
+                unit_diagonal=False, transpose_a=True)
+            a = a.at[k1:, k0:k1].set(panel)
+            # trailing Hermitian update (one MXU matmul)
+            trail = a[k1:, k1:] - panel @ jnp.conj(panel).T
+            a = a.at[k1:, k1:].set(trail)
+    return jnp.tril(a), info
+
+
+def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> Tuple[TiledMatrix, jax.Array]:
+    """Cholesky factorization A = L·Lᴴ (Lower) or UᴴU (Upper).
+
+    Returns (L_or_U as TriangularMatrix, info)."""
+    if A.kind not in (MatrixKind.Hermitian, MatrixKind.Symmetric):
+        raise SlateError("potrf: A must be Hermitian/Symmetric (use "
+                         "slate_tpu.hermitian/symmetric)")
+    if A.shape[0] != A.shape[1]:
+        raise SlateError("potrf: A must be square")
+    n = A.shape[0]
+    nb = A.nb
+    a = A.full_dense_canonical()
+    a = unit_pad_diag(a, n, n)
+    nt = A.mt
+    lower, info = _potrf_blocked(a, nb, nt)
+    if A.uplo is Uplo.Upper:
+        out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
+                         kind=MatrixKind.Triangular, uplo=Uplo.Upper,
+                         logical_shape=(n, n))
+    else:
+        out = from_dense(lower, nb, grid=A.grid, kind=MatrixKind.Triangular,
+                         uplo=Uplo.Lower, logical_shape=(n, n))
+    return out, info
+
+
+def potrs(L: TiledMatrix, B: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Solve A·X = B given the Cholesky factor (slate::potrs,
+    src/potrs.cc: two work::trsm sweeps)."""
+    if L.kind is not MatrixKind.Triangular:
+        raise SlateError("potrs: L must be the factor from potrf")
+    if L.uplo is Uplo.Lower:
+        y = blas3.trsm(Side.Left, 1.0, L, B, opts)
+        x = blas3.trsm(Side.Left, 1.0, L.H, y, opts)
+    else:
+        y = blas3.trsm(Side.Left, 1.0, L.H, B, opts)
+        x = blas3.trsm(Side.Left, 1.0, L, y, opts)
+    return x
+
+
+def posv(A: TiledMatrix, B: TiledMatrix,
+         opts: Options = DEFAULT_OPTIONS) -> Tuple[TiledMatrix, jax.Array]:
+    """Solve A·X = B for Hermitian positive definite A (slate::posv)."""
+    L, info = potrf(A, opts)
+    X = potrs(L, B, opts)
+    return X, info
+
+
+def trtri(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Triangular inverse (slate::trtri, src/trtri.cc). One XLA
+    triangular_solve against I — blocked internally."""
+    if A.kind not in (MatrixKind.Triangular, MatrixKind.TriangularBand):
+        raise SlateError("trtri: A must be triangular")
+    a = A.full_dense_canonical()
+    n = A.shape[0]
+    a = unit_pad_diag(a, n, n)
+    eye = jnp.eye(a.shape[0], dtype=a.dtype)
+    inv = jax.lax.linalg.triangular_solve(
+        a, eye, left_side=True, lower=(A.uplo is Uplo.Lower),
+        unit_diagonal=(A.diag is Diag.Unit))
+    return from_dense(inv, A.nb, grid=A.grid, kind=MatrixKind.Triangular,
+                      uplo=A.uplo, diag=A.diag, logical_shape=A.shape)
+
+
+def trtrm(L: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Lᴴ·L (or U·Uᴴ) triangular-triangular multiply (slate::trtrm,
+    src/trtrm.cc — the second half of potri)."""
+    a = L.full_dense_canonical()
+    if L.uplo is Uplo.Lower:
+        out = jnp.conj(a).T @ a
+    else:
+        out = a @ jnp.conj(a).T
+    return from_dense(out, L.nb, grid=L.grid, kind=MatrixKind.Hermitian,
+                      uplo=L.uplo, logical_shape=L.shape)
+
+
+def potri(A_factor: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> TiledMatrix:
+    """A⁻¹ from the Cholesky factor: inv = L⁻ᴴ·L⁻¹ (slate::potri,
+    src/potri.cc = trtri + trtrm)."""
+    linv = trtri(A_factor, opts)
+    return trtrm(linv, opts)
+
+
+def posv_mixed(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS,
+               factor_dtype=jnp.float32
+               ) -> Tuple[TiledMatrix, jax.Array, int]:
+    """Mixed-precision posv with iterative refinement.
+
+    Reference: src/posv_mixed.cc:23-77 — factor in single, iterate the
+    residual in double, fall back to full precision if IR stagnates. On
+    TPU this is the *natural* mode: factor in f32 (or bf16), refine in the
+    working precision. Returns (X, info, iters); iters < 0 means the
+    fallback full-precision solve was used (reference convention)."""
+    work_dtype = A.dtype
+    if A.dtype == factor_dtype:
+        X, info = posv(A, B, opts)
+        return X, info, 0
+
+    A_lo = copy_matrix(A, dtype=factor_dtype)
+    L_lo, info = potrf(A_lo, opts)
+
+    anorm = norm(A, Norm.Inf)
+    eps = jnp.finfo(work_dtype).eps
+    n = A.shape[0]
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), anorm.dtype))
+
+    X = copy_matrix(potrs(L_lo, copy_matrix(B, dtype=factor_dtype), opts),
+                    dtype=work_dtype)
+    converged = False
+    iters = 0
+    for it in range(opts.max_iterations):
+        iters = it + 1
+        # R = B - A·X in working precision
+        R = blas3.hemm(Side.Left, -1.0, A, X, 1.0, B, opts) \
+            if A.kind is MatrixKind.Hermitian else \
+            blas3.symm(Side.Left, -1.0, A, X, 1.0, B, opts)
+        rnorm = norm(R, Norm.Inf)
+        xnorm = norm(X, Norm.Inf)
+        if bool(rnorm <= xnorm * cte):
+            converged = True
+            break
+        D = copy_matrix(potrs(L_lo, copy_matrix(R, dtype=factor_dtype), opts),
+                        dtype=work_dtype)
+        X = ew.add(1.0, D, 1.0, X, opts)
+    if not converged and opts.use_fallback_solver:
+        X, info = posv(A, B, opts)
+        return X, info, -iters
+    return X, info, iters

@@ -1,0 +1,112 @@
+"""Elementwise / auxiliary drivers.
+
+Reference: src/add.cc, src/copy.cc, src/scale.cc, src/scale_row_col.cc,
+src/set.cc, src/redistribute.cc and their internals (internal_geadd,
+internal_gecopy incl. precision conversion, internal_gescale,
+internal_gescale_row_col, internal_geset, internal_tz* variants, plus the
+CUDA kernels src/cuda/device_ge*.cu). On TPU each is a single fused XLA
+elementwise expression over the padded storage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.exceptions import SlateError
+from ..core.grid import ProcessGrid
+from ..core.tiled_matrix import TiledMatrix, from_dense, pad_mask
+from ..core.types import MatrixKind, Options, Uplo, DEFAULT_OPTIONS
+
+
+def add(alpha, A: TiledMatrix, beta, B: TiledMatrix,
+        opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """B ← α·A + β·B (slate::add, src/add.cc; tz variant for trapezoid)."""
+    if A.shape != B.shape:
+        raise SlateError("add: shape mismatch")
+    out = alpha * A.dense_canonical() + beta * B.dense_canonical()
+    return B.with_data(out) if B.data.shape == out.shape and B.op.value == "n" \
+        else from_dense(out, B.nb, grid=B.grid, kind=B.kind, uplo=B.uplo,
+                        diag=B.diag, kl=B.kl, ku=B.ku, logical_shape=B.shape)
+
+
+def copy(A: TiledMatrix, dtype=None, kind: MatrixKind = None) -> TiledMatrix:
+    """Copy with optional precision conversion (slate::copy, src/copy.cc;
+    the reference's device_gecopy.cu also converts precision)."""
+    data = A.dense_canonical()
+    if dtype is not None:
+        data = data.astype(dtype)
+    return from_dense(data, A.nb, grid=A.grid, kind=kind or A.kind,
+                      uplo=A.uplo, diag=A.diag, kl=A.kl, ku=A.ku,
+                      logical_shape=A.shape)
+
+
+def scale(numer, denom, A: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """A ← (numer/denom)·A (slate::scale, src/scale.cc)."""
+    return A.with_data(A.data * (numer / denom)) if A.op.value == "n" else \
+        from_dense(A.dense_canonical() * (numer / denom), A.nb, grid=A.grid,
+                   kind=A.kind, uplo=A.uplo, logical_shape=A.shape)
+
+
+def scale_row_col(R, C, A: TiledMatrix,
+                  opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """A[i,j] ← r[i]·c[j]·A[i,j] (slate::scale_row_col,
+    src/scale_row_col.cc — used for equilibration)."""
+    a = A.dense_canonical()
+    r = jnp.ones(a.shape[0], a.dtype).at[: R.shape[0]].set(R.astype(a.dtype))
+    c = jnp.ones(a.shape[1], a.dtype).at[: C.shape[0]].set(C.astype(a.dtype))
+    return from_dense(a * r[:, None] * c[None, :], A.nb, grid=A.grid,
+                      kind=A.kind, uplo=A.uplo, logical_shape=A.shape)
+
+
+def _canonical_mask(A: TiledMatrix, shape):
+    """Logical-entry mask at the canonical padded size (pad_mask is
+    storage-sized and may include grid-rounding padding)."""
+    mm, nn = A.shape
+    r = jnp.arange(shape[0])[:, None] < mm
+    c = jnp.arange(shape[1])[None, :] < nn
+    return r & c
+
+
+def set_matrix(offdiag, diag_, A: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """A ← offdiag everywhere, diag_ on the diagonal (slate::set,
+    src/set.cc / internal_geset). Padding stays zero."""
+    a = A.dense_canonical()
+    mask = _canonical_mask(A, a.shape)
+    out = jnp.where(mask, jnp.asarray(offdiag, a.dtype), jnp.zeros((), a.dtype))
+    k = min(A.shape)
+    idx = jnp.arange(min(a.shape))
+    on_diag = idx < k
+    d = jnp.where(on_diag, jnp.asarray(diag_, a.dtype),
+                  out[idx, idx] if min(a.shape) else 0)
+    out = out.at[idx, idx].set(d)
+    return from_dense(out, A.nb, grid=A.grid, kind=A.kind, uplo=A.uplo,
+                      logical_shape=A.shape)
+
+
+def set_lambda(fn, A: TiledMatrix) -> TiledMatrix:
+    """A[i,j] ← fn(i, j) vectorized (slate::set with lambdas,
+    src/set_lambdas — reference takes per-entry functions)."""
+    a = A.dense_canonical()
+    i = jnp.arange(a.shape[0])
+    j = jnp.arange(a.shape[1])
+    vals = fn(i[:, None], j[None, :])
+    mask = _canonical_mask(A, a.shape)
+    out = jnp.where(mask, vals.astype(a.dtype), jnp.zeros((), a.dtype))
+    return from_dense(out, A.nb, grid=A.grid, kind=A.kind, uplo=A.uplo,
+                      logical_shape=A.shape)
+
+
+def redistribute(A: TiledMatrix, grid: ProcessGrid,
+                 spec: P = None) -> TiledMatrix:
+    """Re-shard A onto a different grid/partition spec.
+
+    Reference: slate::redistribute (src/redistribute.cc:40-125) does
+    per-tile blocking MPI send/recv between old and new owners; on TPU a
+    single device_put resharding — XLA routes it over ICI optimally."""
+    return A.shard(grid, spec)

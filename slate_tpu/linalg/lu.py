@@ -1,0 +1,435 @@
+"""LU family: gesv, getrf (partial-pivot / no-pivot / tournament / RBT),
+getrs, getri, mixed-precision iterative refinement.
+
+Reference: src/gesv.cc, src/getrf.cc (driver DAG, SURVEY §3.2),
+src/getrf_nopiv.cc, src/getrf_tntpiv.cc (CALU), src/gesv_rbt.cc +
+src/gerbt.cc (random butterfly), src/gesv_mixed.cc, src/getrs.cc,
+src/getri.cc, with internals internal_getrf.cc (multi-threaded panel +
+MPI_Allreduce MAXLOC pivot search, internal_getrf.cc:64-119,
+Tile_getrf.hh:209-270) and internal_swap.cc (batched device row swaps +
+MPI_Sendrecv remote rows).
+
+TPU-native design (SURVEY §7.5): the reference's latency-bound panel
+factorization with cross-rank MAXLOC pivot search becomes
+``lax.linalg.lu`` on the whole (m−k)×nb panel — XLA keeps the pivot
+search on-device; the fine-grained row swaps (the hard part on
+distributed memory, internal_swap.cc:503-560 batches them on GPUs)
+become one gather of the row block, which GSPMD turns into the
+collective-permute traffic the reference hand-codes with MPI_Sendrecv.
+Pivots are carried as a full row-permutation vector (the analog of the
+reference's Pivots list): ``a_factored = A[perm] = L·U``.
+
+Padding note: padded rows/cols carry an identity diagonal
+(pad_diag_identity), so the padded system is block-diagonal
+[[A,0],[0,I]]; pivoting can never select a padded row for a logical
+column (padded rows are zero there), and solves with zero-padded rhs
+stay exact.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
+from ..core.types import (Diag, MatrixKind, MethodLU, Norm, Options, Side,
+                          Uplo, DEFAULT_OPTIONS)
+from . import blas3
+from . import elementwise as ew
+from .norms import norm
+
+Array = jax.Array
+
+
+def _canonical(A: TiledMatrix) -> Array:
+    return A.dense_canonical()
+
+
+# single shared implementation in core (review: was quadruplicated)
+_pad_identity_diag = unit_pad_diag
+
+
+# ---------------------------------------------------------------------------
+# partial-pivot LU
+# ---------------------------------------------------------------------------
+
+def _getrf_blocked(a: Array, nb: int, nt: int):
+    """Blocked right-looking partial-pivot LU on padded dense.
+
+    Returns (lu, perm, info): lu holds unit-L below / U on-and-above the
+    diagonal; perm is the accumulated row permutation (A[perm] = L·U)."""
+    m = a.shape[0]
+    perm = jnp.arange(m, dtype=jnp.int32)
+    info = jnp.zeros((), jnp.int32)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, a.shape[1])
+        panel = a[k0:, k0:k1]
+        # panel factorization (internal::getrf_panel analog): LU with
+        # partial pivot on the tall panel, pivot search fused on device
+        lu_p, _, p_perm = jax.lax.linalg.lu(panel)
+        # apply the panel's row permutation to the whole trailing row
+        # block, including the L-panels to the left (LAPACK laswp)
+        a = a.at[k0:, :].set(a[k0:, :][p_perm])
+        perm = perm.at[k0:].set(perm[k0:][p_perm])
+        a = a.at[k0:, k0:k1].set(lu_p)
+        # first failing pivot in this panel (reduce_info analog)
+        dpan = jnp.abs(jnp.diagonal(lu_p))
+        bad = jnp.isnan(dpan) | (dpan == 0)
+        pinfo = jnp.where(jnp.any(bad),
+                          jnp.argmax(bad).astype(jnp.int32) + 1, 0)
+        info = jnp.where((info == 0) & (pinfo > 0), k0 + pinfo, info)
+        if k1 < a.shape[1]:
+            lkk = a[k0:k1, k0:k1]
+            # U row block: L_kk^{-1} · A[k, k+1:]
+            urow = jax.lax.linalg.triangular_solve(
+                lkk, a[k0:k1, k1:], left_side=True, lower=True,
+                unit_diagonal=True)
+            a = a.at[k0:k1, k1:].set(urow)
+            # trailing update — ONE MXU matmul per step
+            trail = a[k1:, k1:] - a[k1:, k0:k1] @ urow
+            a = a.at[k1:, k1:].set(trail)
+    return a, perm, info
+
+
+def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> Tuple[TiledMatrix, Array, Array]:
+    """Partial-pivot LU: A[perm] = L·U (slate::getrf, src/getrf.cc).
+
+    Returns (LU packed in one matrix, perm, info)."""
+    method = opts.method_lu
+    if method is MethodLU.NoPiv:
+        LU, info = getrf_nopiv(A, opts)
+        nrows = LU.mt * LU.nb  # canonical rows, not grid-padded storage
+        return LU, jnp.arange(nrows, dtype=jnp.int32), info
+    if method is MethodLU.CALU:
+        return getrf_tntpiv(A, opts)
+    m, n = A.shape
+    a = _canonical(A)
+    a = _pad_identity_diag(a, m, n)
+    lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt))
+    out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
+    return out, perm, info
+
+
+def getrf_nopiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+                ) -> Tuple[TiledMatrix, Array]:
+    """LU without pivoting (slate::getrf_nopiv, src/getrf_nopiv.cc) —
+    for diagonally-dominant or RBT-preconditioned systems."""
+    m, n = A.shape
+    a = _canonical(A)
+    a = _pad_identity_diag(a, m, n)
+    lu, info = _lu_nopiv_recursive(a)
+    out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
+    return out, info
+
+
+def _lu_nopiv_recursive(a: Array, base: int = 64):
+    """Recursive blocked no-pivot LU; base case is an unblocked
+    fori_loop recurrence (maps the reference's Tile_getrf_nopiv.hh panel
+    kernel to a compiler-friendly static recursion)."""
+    n = min(a.shape)
+    if n <= base:
+        return _lu_nopiv_unblocked(a)
+    half = (n // 2 + 7) & ~7 if n > 16 else n // 2  # 8-aligned split
+    half = max(8, min(half, n - 1))
+    a11, info1 = _lu_nopiv_recursive(a[:half, :half], base)
+    l11 = a11
+    a12 = jax.lax.linalg.triangular_solve(
+        l11, a[:half, half:], left_side=True, lower=True, unit_diagonal=True)
+    a21 = jax.lax.linalg.triangular_solve(
+        l11, a[half:, :half], left_side=False, lower=False,
+        unit_diagonal=False)
+    a22 = a[half:, half:] - a21 @ a12
+    a22, info2 = _lu_nopiv_recursive(a22, base)
+    out = jnp.block([[a11, a12], [a21, a22]])
+    info = jnp.where(info1 > 0, info1,
+                     jnp.where(info2 > 0, info2 + half, 0)).astype(jnp.int32)
+    return out, info
+
+
+def _lu_nopiv_unblocked(a: Array):
+    n = min(a.shape)
+    rows = jnp.arange(a.shape[0])
+    cols = jnp.arange(a.shape[1])
+
+    def body(i, carry):
+        mat, info = carry
+        d = mat[i, i]
+        bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
+        info = jnp.where((info == 0) & bad, i + 1, info)
+        dsafe = jnp.where(bad, jnp.ones((), mat.dtype), d)
+        col = jnp.where(rows > i, mat[:, i] / dsafe, 0)
+        mat = mat.at[:, i].set(jnp.where(rows > i, col, mat[:, i]))
+        urow = jnp.where(cols > i, mat[i, :], 0)
+        mat = mat - jnp.outer(col, urow)
+        # the outer product zeroed nothing at/above row i (col is 0 there)
+        return (mat, info)
+
+    mat, info = jax.lax.fori_loop(0, n, body, (a, jnp.zeros((), jnp.int32)))
+    return mat, info
+
+
+def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+                 ) -> Tuple[TiledMatrix, Array, Array]:
+    """Tournament (CALU) pivoting LU (slate::getrf_tntpiv,
+    src/getrf_tntpiv.cc:110-175).
+
+    The reference factors each rank's local tile stack, then plays a
+    binary tournament over ranks exchanging candidate row blocks via
+    tileSend/Recv. Here: vmap-batched LU over nb-row chunks selects each
+    chunk's candidate rows, then a log₂ tree of pairwise stacked LUs
+    picks the panel's winners — all on device, no host round-trips."""
+    m, n = A.shape
+    nb = A.nb
+    a = _canonical(A)
+    a = _pad_identity_diag(a, m, n)
+    mpad = a.shape[0]
+    perm = jnp.arange(mpad, dtype=jnp.int32)
+    info = jnp.zeros((), jnp.int32)
+    nt = min(A.mt, A.nt)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, a.shape[1])
+        w = k1 - k0
+        prows = mpad - k0
+        panel = a[k0:, k0:k1]
+        # --- tournament: find nb winner rows ---------------------------
+        nchunks = -(-prows // nb)
+        pad_rows = nchunks * nb - prows
+        stacked = jnp.pad(panel, ((0, pad_rows), (0, 0)))
+        chunks = stacked.reshape(nchunks, nb, w)
+        cand_idx = (jnp.arange(nchunks * nb, dtype=jnp.int32)
+                    .reshape(nchunks, nb))
+        # round 0: local LU per chunk picks each chunk's top-w rows
+        while chunks.shape[0] > 1:
+            _, _, perms_c = jax.vmap(jax.lax.linalg.lu)(chunks)
+            top = jax.vmap(lambda c, p: c[p][:w])(chunks, perms_c)
+            topi = jax.vmap(lambda ci, p: ci[p][:w])(cand_idx, perms_c)
+            # pair up winners for the next round
+            nc = top.shape[0]
+            if nc % 2 == 1:
+                top = jnp.concatenate(
+                    [top, jnp.zeros((1,) + top.shape[1:], top.dtype)])
+                topi = jnp.concatenate(
+                    [topi, jnp.full((1, w), mpad, jnp.int32)])
+                nc += 1
+            chunks = top.reshape(nc // 2, 2 * w, w)
+            cand_idx = topi.reshape(nc // 2, 2 * w)
+        _, _, pfin = jax.lax.linalg.lu(chunks[0])
+        winners = cand_idx[0][pfin][:w]  # panel-relative row indices
+        winners = jnp.minimum(winners, prows - 1)
+        # --- swap winners to the top, then no-pivot elimination --------
+        others_mask = jnp.ones(prows, bool).at[winners].set(False)
+        rest = jnp.nonzero(others_mask, size=prows - w, fill_value=0)[0]
+        p_perm = jnp.concatenate([winners, rest.astype(jnp.int32)])
+        a = a.at[k0:, :].set(a[k0:, :][p_perm])
+        perm = perm.at[k0:].set(perm[k0:][p_perm])
+        # eliminate panel without further pivoting
+        lu_pan, pinfo = _lu_nopiv_recursive(a[k0:k1, k0:k1])
+        a = a.at[k0:k1, k0:k1].set(lu_pan)
+        info = jnp.where((info == 0) & (pinfo > 0), k0 + pinfo, info)
+        lkk = lu_pan
+        below = jax.lax.linalg.triangular_solve(
+            lkk, a[k1:, k0:k1], left_side=False, lower=False,
+            unit_diagonal=False)
+        a = a.at[k1:, k0:k1].set(below)
+        if k1 < a.shape[1]:
+            urow = jax.lax.linalg.triangular_solve(
+                lkk, a[k0:k1, k1:], left_side=True, lower=True,
+                unit_diagonal=True)
+            a = a.at[k0:k1, k1:].set(urow)
+            a = a.at[k1:, k1:].set(a[k1:, k1:] - below @ urow)
+    out = from_dense(a, nb, grid=A.grid, logical_shape=(m, n))
+    return out, perm, info
+
+
+def getrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS, trans: bool = False
+          ) -> TiledMatrix:
+    """Solve A·X = B (or Aᵀ·X = B) from getrf factors (slate::getrs,
+    src/getrs.cc: permuteRows → trsm(L) → trsm(U))."""
+    lu = LU.dense_canonical()
+    # storage beyond the logical shape is zero by invariant; restore the
+    # unit diagonal there so the padded triangular solves stay exact
+    lu = _pad_identity_diag(lu, *LU.shape)
+    b = B.dense_canonical()
+    if b.shape[0] != lu.shape[0]:
+        pad = lu.shape[0] - b.shape[0]
+        if pad < 0:
+            raise SlateError("getrs: rhs taller than factor")
+        b = jnp.pad(b, ((0, pad), (0, 0)))
+    if not trans:
+        pb = b[perm]
+        y = jax.lax.linalg.triangular_solve(
+            lu, pb, left_side=True, lower=True, unit_diagonal=True)
+        x = jax.lax.linalg.triangular_solve(
+            lu, y, left_side=True, lower=False, unit_diagonal=False)
+    else:
+        z = jax.lax.linalg.triangular_solve(
+            lu, b, left_side=True, lower=False, unit_diagonal=False,
+            transpose_a=True)
+        w = jax.lax.linalg.triangular_solve(
+            lu, z, left_side=True, lower=True, unit_diagonal=True,
+            transpose_a=True)
+        x = jnp.zeros_like(w).at[perm].set(w)
+    x = x[: B.dense_canonical().shape[0]]
+    return from_dense(x, B.nb, grid=B.grid, logical_shape=B.shape)
+
+
+def gesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> Tuple[TiledMatrix, Array]:
+    """Solve A·X = B (slate::gesv = getrf + getrs; MethodLU dispatch at
+    src/getrf.cc:324-353)."""
+    if opts.method_lu is MethodLU.RBT:
+        return gesv_rbt(A, B, opts)
+    LU, perm, info = getrf(A, opts)
+    X = getrs(LU, perm, B, opts)
+    return X, info
+
+
+def gesv_nopiv(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS) -> Tuple[TiledMatrix, Array]:
+    LU, info = getrf_nopiv(A, opts)
+    X = getrs(LU, jnp.arange(LU.mt * LU.nb, dtype=jnp.int32), B, opts)
+    return X, info
+
+
+def getri(LU: TiledMatrix, perm: Array, opts: Options = DEFAULT_OPTIONS
+          ) -> TiledMatrix:
+    """Matrix inverse from getrf factors (slate::getri, src/getri.cc)."""
+    n = LU.shape[0]
+    eye = jnp.eye(LU.dense_canonical().shape[0], dtype=LU.dtype)
+    I = from_dense(eye, LU.nb, grid=LU.grid,
+                   logical_shape=(n, n))
+    return getrs(LU, perm, I, opts)
+
+
+# ---------------------------------------------------------------------------
+# Random Butterfly Transform (RBT)
+# ---------------------------------------------------------------------------
+
+def _butterfly_vectors(n2: int, depth: int, seed: int, dtype) -> Array:
+    """Random diagonal entries for the butterflies: exp(r/10)/sqrt(2) with
+    r ~ U[-1,1] (the classic Parker/PRBT scaling used by the reference's
+    internal_rbt_generate.cc)."""
+    key = jax.random.key(seed)
+    r = jax.random.uniform(key, (2 * depth, n2), jnp.float32,
+                           minval=-1.0, maxval=1.0)
+    return (jnp.exp(r / 10.0) / jnp.sqrt(2.0)).astype(dtype)
+
+
+def _apply_butterfly(x: Array, d: Array, transpose: bool) -> Array:
+    """y = Bᵀ·x (transpose=True) or B·x, where B = [[D1, D2],[D1, -D2]]
+    acting on the leading axis (one recursion level)."""
+    h = x.shape[0] // 2
+    x1, x2 = x[:h], x[h:]
+    d1 = d[:h, None]
+    d2 = d[h: 2 * h, None]
+    if transpose:
+        return jnp.concatenate([d1 * (x1 + x2), d2 * (x1 - x2)])
+    return jnp.concatenate([d1 * x1 + d2 * x2, d1 * x1 - d2 * x2])
+
+
+def _rbt_rows(x: Array, diags: Array, depth: int, transpose: bool) -> Array:
+    """Apply the depth-d recursive butterfly W (or Wᵀ) to the rows of x."""
+    n = x.shape[0]
+    levels = range(depth - 1, -1, -1) if not transpose else range(depth)
+    for lev in levels:
+        nblk = 2 ** lev
+        blk = n // nblk
+        xr = x.reshape(nblk, blk, -1)
+        d = diags[lev][: nblk * blk].reshape(nblk, blk)
+        xr = jax.vmap(lambda xb, db: _apply_butterfly(xb, db, transpose)
+                      )(xr, d)
+        x = xr.reshape(n, -1)
+    return x
+
+
+def gerbt(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS, seed: int = 0):
+    """Two-sided random butterfly transform Ã = Uᵀ·A·V (slate::gerbt,
+    src/gerbt.cc). Returns (Ã, u_diags, v_diags)."""
+    depth = opts.depth
+    a = A.dense_canonical()
+    a = _pad_identity_diag(a, *A.shape)
+    n = a.shape[0]
+    # butterfly needs n divisible by 2^depth; padded nb grids usually are
+    while n % (2 ** depth):
+        depth -= 1
+    u = _butterfly_vectors(n, depth, seed * 2 + 1, a.dtype).reshape(-1, n)
+    v = _butterfly_vectors(n, depth, seed * 2 + 2, a.dtype).reshape(-1, n)
+    at = _rbt_rows(a, u, depth, transpose=True)           # Uᵀ·A
+    at = _rbt_rows(at.T, v, depth, transpose=True).T      # (Vᵀ·(UᵀA)ᵀ)ᵀ = UᵀAV
+    At = from_dense(at, A.nb, grid=A.grid, logical_shape=A.shape)
+    return At, (u, depth), (v, depth)
+
+
+def gesv_rbt(A: TiledMatrix, B: TiledMatrix,
+             opts: Options = DEFAULT_OPTIONS) -> Tuple[TiledMatrix, Array]:
+    """Solve via RBT + no-pivot LU + one step of IR (slate::gesv_rbt,
+    src/gesv_rbt.cc): A = U·Ã·Vᵀ ⇒ X = V·Ã⁻¹·Uᵀ·B."""
+    At, (u, du), (v, dv) = gerbt(A, opts)
+    LU, info = getrf_nopiv(At, opts)
+    b = B.dense_canonical()
+    npad = LU.dense_canonical().shape[0]
+    if b.shape[0] < npad:
+        b = jnp.pad(b, ((0, npad - b.shape[0]), (0, 0)))
+    ub = _rbt_rows(b, u, du, transpose=True)
+    Bt = from_dense(ub, B.nb, logical_shape=(npad, B.shape[1]))
+    Y = getrs(LU, jnp.arange(npad, dtype=jnp.int32), Bt, opts)
+    y = Y.dense_canonical()[:npad]
+    x = _rbt_rows(y, v, dv, transpose=False)
+    X = from_dense(x[: B.shape[0]], B.nb, grid=B.grid, logical_shape=B.shape)
+    # one IR pass in working precision guards RBT's stability loss
+    R = blas3.gemm(-1.0, A, X, 1.0, B, opts)
+    rb = _rbt_rows(jnp.pad(R.dense_canonical(),
+                           ((0, npad - R.dense_canonical().shape[0]), (0, 0))
+                           ) if R.dense_canonical().shape[0] < npad
+                   else R.dense_canonical(), u, du, transpose=True)
+    Rt = from_dense(rb, B.nb, logical_shape=(npad, B.shape[1]))
+    D = getrs(LU, jnp.arange(npad, dtype=jnp.int32), Rt, opts)
+    d = _rbt_rows(D.dense_canonical()[:npad], v, dv, transpose=False)
+    X = X.with_data(X.dense_canonical() + d[: X.dense_canonical().shape[0]])
+    return X, info
+
+
+# ---------------------------------------------------------------------------
+# mixed precision
+# ---------------------------------------------------------------------------
+
+def gesv_mixed(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS, factor_dtype=jnp.float32
+               ) -> Tuple[TiledMatrix, Array, int]:
+    """Factor in low precision, refine in working precision
+    (slate::gesv_mixed, src/gesv_mixed.cc:23-77). Returns (X, info,
+    iters); iters < 0 ⇒ fell back to full-precision solve."""
+    if A.dtype == factor_dtype:
+        X, info = gesv(A, B, opts)
+        return X, info, 0
+    work_dtype = A.dtype
+    A_lo = ew.copy(A, dtype=factor_dtype)
+    LU, perm, info = getrf(A_lo, opts)
+
+    anorm = norm(A, Norm.Inf)
+    eps = jnp.finfo(work_dtype).eps
+    n = A.shape[0]
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), anorm.dtype))
+
+    X = ew.copy(getrs(LU, perm, ew.copy(B, dtype=factor_dtype), opts),
+                dtype=work_dtype)
+    converged = False
+    iters = 0
+    for it in range(opts.max_iterations):
+        iters = it + 1
+        R = blas3.gemm(-1.0, A, X, 1.0, B, opts)
+        if bool(norm(R, Norm.Inf) <= norm(X, Norm.Inf) * cte):
+            converged = True
+            break
+        D = ew.copy(getrs(LU, perm, ew.copy(R, dtype=factor_dtype), opts),
+                    dtype=work_dtype)
+        X = ew.add(1.0, D, 1.0, X, opts)
+    if not converged and opts.use_fallback_solver:
+        X, info = gesv(A, B, opts)
+        return X, info, -iters
+    return X, info, iters

@@ -1,0 +1,324 @@
+"""QR/LQ/least-squares family: geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
+gels.
+
+Reference: src/geqrf.cc (driver with local panel + cross-rank ttqrt tree,
+SURVEY §3.3), src/gelqf.cc, src/unmqr.cc, src/unmlq.cc, src/cholqr.cc,
+src/gels.cc / gels_qr.cc / gels_cholqr.cc, with internals
+internal_geqrf.cc (device panel gather + lapack::geqrf on GPU,
+internal_geqrf.cc:235-254), internal_ttqrt/ttmqr (binary tree of tpqrt
+combines, internal_ttqrt.cc:91-127), Tile_tpqrt.hh, internal_unmqr.cc.
+
+TPU-native design (SURVEY §7.6):
+- Panel factorization: ``lax.linalg.geqrf`` on the whole (m−k)×nb panel —
+  the analog of the reference's "gather panel to one contiguous device
+  buffer and run lapack::geqrf on the GPU" trick.
+- Compact-WY T factor: larft recurrence with a single VᴴV Gram matmul +
+  an nb-step fori_loop (the reference gets T from tile::larft inside
+  internal_geqrf).
+- Trailing update: C −= V·Tᴴ·(Vᴴ·C) — two big MXU matmuls per panel;
+  batching over tiles (internal::unmqr's batched gemm) is implicit.
+- The reference's cross-rank reduction tree (ttqrt/ttmqr, parallelism P7)
+  appears here as ``tsqr``: a log₂ tree of stacked-R QR combines done
+  with vmap over row chunks — the communication the reference does with
+  tileSend/tileRecv pairs becomes data movement inside one XLA program.
+
+Factors are returned as a QRFactors pytree (functional analog of the
+reference's in-place V/R storage plus TriangularFactors T pair).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import (TiledMatrix, from_dense, triangular,
+                                 unit_pad_diag)
+from ..core.types import (Diag, MatrixKind, MethodGels, Norm, Options, Side,
+                          Uplo, DEFAULT_OPTIONS)
+from . import blas3
+from .cholesky import potrf
+from .norms import norm
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class QRFactors:
+    """Packed blocked-Householder factors.
+
+    ``vr``: (mpad, npad) — V (unit lower trapezoid, by panel) below the
+    diagonal, R on/above. ``t``: (npanels, nb, nb) upper-triangular T
+    factors, one per panel. Analog of the reference's pair
+    T = {Tlocal, Treduce} (src/geqrf.cc:26)."""
+
+    vr: Array
+    t: Array
+    m: int
+    n: int
+    nb: int
+
+    def tree_flatten(self):
+        return (self.vr, self.t), (self.m, self.n, self.nb)
+
+    @classmethod
+    def tree_unflatten(cls, meta, children):
+        vr, t = children
+        m, n, nb = meta
+        return cls(vr, t, m, n, nb)
+
+    @property
+    def r_matrix(self) -> TiledMatrix:
+        """R as an upper TriangularMatrix (logical n×n for m≥n)."""
+        k = min(self.m, self.n)
+        r = jnp.triu(self.vr)[: self.vr.shape[1], :]
+        return from_dense(r, self.nb, kind=MatrixKind.Triangular,
+                          uplo=Uplo.Upper, logical_shape=(k, self.n))
+
+
+def _larft(v: Array, taus: Array) -> Array:
+    """Forward (columnwise) T from reflectors: the lapack larft recurrence
+    T[:i,i] = −τᵢ·T[:i,:i]·(Vᴴvᵢ), T[i,i] = τᵢ. One Gram matmul + an
+    nb-step fori_loop."""
+    nbb = taus.shape[0]
+    w = jnp.conj(v).T @ v  # (nb, nb) Gram; rows<i of col i give Vᴴ·vᵢ
+    idx = jnp.arange(nbb)
+
+    def body(i, t):
+        wi = jnp.where(idx < i, w[:, i], 0)
+        col = -taus[i] * (t @ wi)
+        col = jnp.where(idx < i, col, 0)
+        col = col.at[i].set(taus[i].astype(col.dtype))
+        return t.at[:, i].set(col)
+
+    t0 = jnp.zeros((nbb, nbb), v.dtype)
+    return jax.lax.fori_loop(0, nbb, body, t0)
+
+
+def _apply_block_reflector_H(v: Array, t: Array, c: Array) -> Array:
+    """C ← (I − V·T·Vᴴ)ᴴ·C = C − V·Tᴴ·(Vᴴ·C)  (Qᴴ·C, larfb analog)."""
+    return c - v @ (jnp.conj(t).T @ (jnp.conj(v).T @ c))
+
+
+def _apply_block_reflector(v: Array, t: Array, c: Array) -> Array:
+    """C ← (I − V·T·Vᴴ)·C = C − V·T·(Vᴴ·C)  (Q·C)."""
+    return c - v @ (t @ (jnp.conj(v).T @ c))
+
+
+# single shared implementation in core (review: was quadruplicated)
+_pad_identity_diag = unit_pad_diag
+
+
+def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
+    """Blocked Householder QR: A = Q·R (slate::geqrf, src/geqrf.cc)."""
+    m, n = A.shape
+    nb = A.nb
+    a = A.dense_canonical()
+    a = _pad_identity_diag(a, m, n)
+    mpad, npad = a.shape
+    kt = -(-min(m, n) // nb)  # panels covering the logical diagonal
+    ts = []
+    for k in range(kt):
+        k0, k1 = k * nb, min((k + 1) * nb, npad)
+        w = k1 - k0
+        panel = a[k0:, k0:k1]
+        # packed Householder (LAPACK geqrf layout); mode="raw" returns the
+        # transposed packed factor
+        h_t, taus = jnp.linalg.qr(panel, mode="raw")
+        qr_packed = h_t.T
+        v = jnp.tril(qr_packed, -1)
+        v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+        t = _larft(v, taus)
+        if w < nb:  # ragged final panel: embed into (nb, nb)
+            t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
+        ts.append(t)
+        # store R rows + V below diagonal
+        a = a.at[k0:, k0:k1].set(jnp.triu(qr_packed) + v -
+                                 jnp.eye(panel.shape[0], w, dtype=a.dtype))
+        if k1 < npad:
+            a = a.at[k0:, k1:].set(
+                _apply_block_reflector_H(v, t[:w, :w], a[k0:, k1:]))
+    t_all = jnp.stack(ts) if ts else jnp.zeros((0, nb, nb), a.dtype)
+    return QRFactors(a, t_all, m, n, nb)
+
+
+def unmqr(side: Side, QR: QRFactors, C: TiledMatrix, trans: bool = False,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Multiply by Q from geqrf (slate::unmqr, src/unmqr.cc).
+
+    side=Left: C ← Q·C (trans=False) or Qᴴ·C (trans=True).
+    side=Right: C ← C·Q or C·Qᴴ."""
+    nb = QR.nb
+    mpad = QR.vr.shape[0]
+    kt = QR.t.shape[0]
+    c = C.dense_canonical()
+    if side is Side.Left:
+        if c.shape[0] < mpad:
+            c = jnp.pad(c, ((0, mpad - c.shape[0]), (0, 0)))
+    else:
+        if c.shape[1] < mpad:
+            c = jnp.pad(c, ((0, 0), (0, mpad - c.shape[1])))
+    # Q = H_0·H_1·…·H_{kt−1} (block reflectors). Qᴴ·C applies forward,
+    # Q·C applies backward.
+    order = range(kt) if trans else range(kt - 1, -1, -1)
+    for k in order:
+        k0 = k * nb
+        k1 = min(k0 + nb, QR.vr.shape[1])
+        w = k1 - k0
+        v = jnp.tril(QR.vr[k0:, k0:k1], -1)
+        v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+        t = QR.t[k][:w, :w]
+        if side is Side.Left:
+            blk = c[k0:, :]
+            blk = _apply_block_reflector_H(v, t, blk) if trans \
+                else _apply_block_reflector(v, t, blk)
+            c = c.at[k0:, :].set(blk)
+        else:
+            # C·Q = (Qᴴ·Cᴴ)ᴴ
+            blk = c[:, k0:]
+            if trans:  # C·Qᴴ = (Q·Cᴴ)ᴴ
+                blk = jnp.conj(_apply_block_reflector(
+                    v, t, jnp.conj(blk).T)).T
+            else:
+                blk = jnp.conj(_apply_block_reflector_H(
+                    v, t, jnp.conj(blk).T)).T
+            c = c.at[:, k0:].set(blk)
+    out_shape = C.shape
+    c = c[: -(-out_shape[0] // nb) * nb, : -(-out_shape[1] // nb) * nb]
+    return from_dense(c, nb, grid=C.grid, logical_shape=out_shape)
+
+
+def qr_multiply_explicit(QR: QRFactors) -> TiledMatrix:
+    """Materialize the thin Q (helper for checks; ungqr/orgqr analog)."""
+    m, n = QR.m, QR.n
+    k = min(m, n)
+    eye = jnp.eye(QR.vr.shape[0], -(-k // QR.nb) * QR.nb, dtype=QR.vr.dtype)
+    I = from_dense(eye, QR.nb, logical_shape=(m, k))
+    return unmqr(Side.Left, QR, I, trans=False)
+
+
+# -- LQ --------------------------------------------------------------------
+
+def gelqf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
+    """LQ factorization A = L·Q via QR of Aᴴ (slate::gelqf,
+    src/gelqf.cc; the reference mirrors geqrf with ttlqt trees)."""
+    return geqrf(A.H, opts)
+
+
+def unmlq(side: Side, LQ: QRFactors, C: TiledMatrix, trans: bool = False,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Multiply by Q from gelqf: A = L·Qlq with Qlq = Qᴴ of the
+    underlying QR of Aᴴ. side=Left applies Qlq (trans=False) or Qlqᴴ."""
+    # Qlq·C = (QR-Q)ᴴ·C, so flip the trans flag of unmqr
+    return unmqr(side, LQ, C, trans=not trans, opts=opts)
+
+
+# -- CholQR / TSQR ---------------------------------------------------------
+
+def cholqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+           ) -> Tuple[TiledMatrix, TiledMatrix]:
+    """Cholesky QR: R = chol(AᴴA)ᵀ-ish, Q = A·R⁻¹ (slate::cholqr,
+    src/cholqr.cc — herk + potrf + trsm). Returns (Q, R)."""
+    m, n = A.shape
+    if m < n:
+        raise SlateError("cholqr needs m >= n")
+    from ..core.tiled_matrix import hermitian as herm_ctor, zeros
+    C = zeros(n, n, A.nb, A.dtype)
+    C = TiledMatrix(C.data, n, n, A.nb, kind=MatrixKind.Hermitian,
+                    uplo=Uplo.Upper, grid=A.grid)
+    G = blas3.herk(1.0, A.H, 0.0, C, opts) if jnp.iscomplexobj(A.data) else \
+        blas3.syrk(1.0, A.H, 0.0,
+                   TiledMatrix(C.data, n, n, A.nb,
+                               kind=MatrixKind.Symmetric, uplo=Uplo.Upper,
+                               grid=A.grid), opts)
+    Gh = TiledMatrix(G.data, n, n, A.nb, kind=MatrixKind.Hermitian,
+                     uplo=Uplo.Upper, grid=A.grid)
+    R, info = potrf(Gh, opts)
+    Q = blas3.trsm(Side.Right, 1.0, R, A, opts)
+    return Q, R
+
+
+def tsqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> Tuple[TiledMatrix, TiledMatrix]:
+    """Communication-avoiding tall-skinny QR (the reference's
+    internal_ttqrt binary tree, parallelism P7, as a vmap/log-tree).
+
+    Row chunks are QR'd independently (vmap — the analog of each rank's
+    local geqrf), then R factors combine pairwise up a binary tree (the
+    analog of the ttqrt tileSend/tileRecv rounds). Q is recovered as
+    A·R⁻¹ with one reorthogonalization pass (CholeskyQR2-style) to
+    restore orthogonality to working precision. Returns (Q, R)."""
+    m, n = A.shape
+    if m < n:
+        raise SlateError("tsqr needs m >= n")
+    a = A.dense_canonical()
+    a = _pad_identity_diag(a, m, n)
+    mpad, npad = a.shape
+    chunk = max(npad, A.nb)
+    nchunks = -(-mpad // chunk)
+    a_p = jnp.pad(a, ((0, nchunks * chunk - mpad), (0, 0)))
+    blocks = a_p.reshape(nchunks, chunk, npad)
+    rs = jax.vmap(lambda b: jnp.linalg.qr(b, mode="r"))(blocks)
+    while rs.shape[0] > 1:
+        nc = rs.shape[0]
+        if nc % 2 == 1:
+            rs = jnp.concatenate([rs, jnp.zeros((1, npad, npad), rs.dtype)])
+            nc += 1
+        stacked = rs.reshape(nc // 2, 2 * npad, npad)
+        rs = jax.vmap(lambda b: jnp.linalg.qr(b, mode="r"))(stacked)
+    r = rs[0]
+    # fix signs: make diagonal non-negative for determinism
+    sgn = jnp.where(jnp.real(jnp.diagonal(r)) < 0, -1.0, 1.0).astype(r.dtype)
+    r = r * sgn[:, None]
+    Rm = from_dense(r, A.nb, kind=MatrixKind.Triangular, uplo=Uplo.Upper,
+                    logical_shape=(n, n))
+    Q1 = blas3.trsm(Side.Right, 1.0, Rm, A, opts)
+    # CholeskyQR2-style second pass restores orthogonality
+    Q2, R2 = cholqr(Q1, opts)
+    r_final = (R2.dense_canonical() @ r)[:npad, :npad]
+    Rf = from_dense(r_final, A.nb, kind=MatrixKind.Triangular,
+                    uplo=Uplo.Upper, logical_shape=(n, n))
+    return Q2, Rf
+
+
+# -- least squares ---------------------------------------------------------
+
+def gels(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> TiledMatrix:
+    """Minimum-norm least squares solve min‖AX − B‖ (slate::gels,
+    src/gels.cc; MethodGels {QR, CholQR} dispatch)."""
+    m, n = A.shape
+    method = opts.method_gels
+    if method is MethodGels.Auto:
+        method = MethodGels.QR
+    if m >= n:
+        if method is MethodGels.CholQR:
+            Q, R = cholqr(A, opts)
+            # X = R⁻¹·(Qᴴ·B)
+            qtb = jnp.conj(Q.dense_canonical()).T @ B.dense_canonical()
+            QtB = from_dense(qtb[: -(-n // A.nb) * A.nb], A.nb,
+                             logical_shape=(n, B.shape[1]))
+            return blas3.trsm(Side.Left, 1.0, R, QtB, opts)
+        QR = geqrf(A, opts)
+        QtB = unmqr(Side.Left, QR, B, trans=True, opts=opts)
+        # top n rows: R X = (QᴴB)[:n]
+        qtb = QtB.dense_canonical()[: -(-n // A.nb) * A.nb]
+        QtB_top = from_dense(qtb, A.nb, logical_shape=(n, B.shape[1]))
+        return blas3.trsm(Side.Left, 1.0, QR.r_matrix, QtB_top, opts)
+    # underdetermined: minimum-norm via LQ: A = L·Q, X = Qᴴ·L⁻¹·B
+    LQ = gelqf(A, opts)
+    # L is R(of AᴴQR)ᴴ: lower (n? m×m)
+    r = LQ.r_matrix  # upper, from QR of Aᴴ; L = rᴴ
+    L = r.H
+    Y = blas3.trsm(Side.Left, 1.0, L, B, opts)
+    # embed Y (m rows) into n rows then apply Qᴴ of the LQ
+    ypad = Y.dense_canonical()
+    rows = -(-n // A.nb) * A.nb
+    y_full = jnp.zeros((rows, ypad.shape[1]), ypad.dtype)
+    y_full = y_full.at[: ypad.shape[0]].set(ypad)
+    Yf = from_dense(y_full, A.nb, logical_shape=(n, B.shape[1]))
+    return unmlq(Side.Left, LQ, Yf, trans=True, opts=opts)

@@ -1,0 +1,2 @@
+from . import trace
+from .printing import print_matrix, debug_dump

@@ -1,0 +1,66 @@
+"""Distributed matrix printing + debug dumps.
+
+Reference: src/print.cc + include/slate/print.hh (distributed matrix
+printing with PrintVerbose/PrintEdgeItems/PrintWidth/PrintPrecision
+options, enums.hh:477-487) and src/auxiliary/Debug.cc (tile-map /
+MOSI-state / memory dumps).
+
+TPU-native: values are fetched once (to_numpy gathers the sharded array);
+the debug dump shows the sharding layout — the analog of Debug's
+tile-owner maps.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..core.tiled_matrix import TiledMatrix
+from ..core.types import Options, DEFAULT_OPTIONS
+
+
+def print_matrix(label: str, A: TiledMatrix,
+                 opts: Options = DEFAULT_OPTIONS, out=None) -> str:
+    """Render like the reference's print (verbose levels: 0 none, 1 meta,
+    2 full, 3 edgeitems, 4 full-if-small-else-edgeitems)."""
+    out = out or sys.stdout
+    v = opts.print_verbose
+    w, p = opts.print_width, opts.print_precision
+    edge = opts.print_edgeitems
+    m, n = A.shape
+    header = (f"% {label}: {type(A).__name__} {m}x{n}, nb={A.nb}, "
+              f"kind={A.kind.name}, uplo={A.uplo.name}, op={A.op.name}"
+              + (f", grid={A.grid.p}x{A.grid.q}" if A.grid else ""))
+    lines = [header]
+    if v >= 2:
+        a = A.to_numpy()
+        small = v == 2 or (v == 4 and m <= 2 * edge and n <= 2 * edge)
+        with np.printoptions(linewidth=10**9, threshold=10**9 if small
+                             else 0, edgeitems=edge,
+                             formatter={"float_kind":
+                                        lambda x: f"%{w}.{p}f" % x}):
+            lines.append(f"{label} = [")
+            lines.append(str(a).replace("[", " ").replace("]", " "))
+            lines.append("];")
+    text = "\n".join(lines)
+    print(text, file=out)
+    return text
+
+
+def debug_dump(A: TiledMatrix, out=None) -> str:
+    """Sharding/layout dump (Debug::printTiles analog): which device owns
+    which tile block."""
+    out = out or sys.stderr
+    lines = [f"TiledMatrix {A.shape} nb={A.nb} mt={A.mt} nt={A.nt} "
+             f"dtype={A.dtype} storage={A.data.shape}"]
+    sh = A.data.sharding
+    lines.append(f"sharding: {sh}")
+    try:
+        for d, idx in sh.devices_indices_map(A.data.shape).items():
+            lines.append(f"  {d}: rows {idx[0]}, cols {idx[1]}")
+    except Exception:
+        pass
+    text = "\n".join(lines)
+    print(text, file=out)
+    return text

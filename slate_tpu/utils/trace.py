@@ -1,0 +1,147 @@
+"""Tracing / profiling: phase timers + SVG timeline.
+
+Reference: include/slate/internal/Trace.hh (trace::Block RAII records
+Event{name, start, stop, thread} per thread) and src/auxiliary/Trace.cc:
+330-446 (Trace::finish gathers events over MPI and writes an SVG timeline
+colored by kernel name). Coarse per-phase timers: the global
+std::map<std::string,double> timers filled by drivers (src/heev.cc:
+128-207), printed by the tester at --timer-level 2.
+
+TPU-native: events are host-side phases (jit dispatch + block) recorded
+by the ``Block`` context manager; for intra-device timelines point users
+at jax.profiler (perfetto) — the SVG here is the cross-phase overview the
+reference ships. No MPI gather is needed (single host process per slice).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+_COLORS = ["#4878CF", "#6ACC65", "#D65F5F", "#B47CC7", "#C4AD66", "#77BEDB",
+           "#E17A2D", "#8C613C", "#937860", "#DA8BC3"]
+
+
+class Event:
+    __slots__ = ("name", "start", "stop", "lane")
+
+    def __init__(self, name, start, stop, lane=0):
+        self.name = name
+        self.start = start
+        self.stop = stop
+        self.lane = lane
+
+
+class Trace:
+    """Global trace registry (reference: static members of trace::Trace)."""
+
+    enabled: bool = False
+    _events: List[Event] = []
+    _t0: Optional[float] = None
+
+    @classmethod
+    def on(cls):
+        cls.enabled = True
+        if cls._t0 is None:
+            cls._t0 = time.perf_counter()
+
+    @classmethod
+    def off(cls):
+        cls.enabled = False
+
+    @classmethod
+    def clear(cls):
+        cls._events = []
+        cls._t0 = time.perf_counter()
+
+    @classmethod
+    def record(cls, name: str, start: float, stop: float, lane: int = 0):
+        cls._events.append(Event(name, start, stop, lane))
+
+    @classmethod
+    def finish(cls, path: str = None) -> Optional[str]:
+        """Write the SVG timeline (Trace::finish analog,
+        src/auxiliary/Trace.cc:330-446). Returns the path."""
+        if not cls._events:
+            return None
+        if path is None:
+            path = f"trace_{int(time.time())}.svg"
+        t0 = min(e.start for e in cls._events)
+        t1 = max(e.stop for e in cls._events)
+        span = max(t1 - t0, 1e-9)
+        lanes = sorted({e.lane for e in cls._events})
+        names = sorted({e.name for e in cls._events})
+        color = {n: _COLORS[i % len(_COLORS)] for i, n in enumerate(names)}
+        W, row_h, pad = 1000.0, 24.0, 4.0
+        H = len(lanes) * (row_h + pad) + 60
+        parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+                 f'height="{H + 20 * len(names)}">']
+        for e in cls._events:
+            x = (e.start - t0) / span * W
+            w = max((e.stop - e.start) / span * W, 0.5)
+            y = lanes.index(e.lane) * (row_h + pad)
+            parts.append(
+                f'<rect x="{x:.2f}" y="{y:.2f}" width="{w:.2f}" '
+                f'height="{row_h}" fill="{color[e.name]}">'
+                f'<title>{e.name}: {(e.stop - e.start)*1e3:.3f} ms</title>'
+                f'</rect>')
+        # legend + time axis ticks
+        ly = len(lanes) * (row_h + pad) + 20
+        for i, n in enumerate(names):
+            parts.append(f'<rect x="4" y="{ly + 20*i}" width="14" height="14"'
+                         f' fill="{color[n]}"/>')
+            parts.append(f'<text x="24" y="{ly + 20*i + 12}" '
+                         f'font-size="12">{n}</text>')
+        for frac in (0.0, 0.25, 0.5, 0.75, 1.0):
+            parts.append(f'<text x="{frac*W*0.98:.0f}" y="{ly - 6}" '
+                         f'font-size="10">{span*frac*1e3:.1f} ms</text>')
+        parts.append("</svg>")
+        with open(path, "w") as f:
+            f.write("\n".join(parts))
+        return path
+
+
+class Block:
+    """RAII trace block (trace::Block, Trace.hh:24-98). Usage:
+    ``with trace.Block("potrf"): ...``"""
+
+    def __init__(self, name: str, lane: int = 0):
+        self.name = name
+        self.lane = lane
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if Trace.enabled:
+            Trace.record(self.name, self.start, time.perf_counter(),
+                         self.lane)
+        return False
+
+
+# coarse per-phase timers (reference: global `timers` map, src/heev.cc)
+timers: Dict[str, float] = collections.defaultdict(float)
+
+
+class timer:
+    """``with timer("heev_stage1"): ...`` accumulates into timers[name]."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        timers[self.name] += time.perf_counter() - self.start
+        return False
+
+
+def print_timers(level: int = 2, out=None):
+    import sys
+    out = out or sys.stderr
+    for k, v in sorted(timers.items()):
+        print(f"  {k:<30s} {v:10.6f} s", file=out)

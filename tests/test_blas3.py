@@ -1,0 +1,209 @@
+"""BLAS-3 driver tests vs numpy references.
+
+Mirrors the reference's test/test_gemm.cc family and
+unit_test/test_internal_blas.cc (internal kernels vs serial BLAS).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import (Diag, MethodGemm, Norm, Options, Side, Uplo)
+
+RNG = np.random.default_rng(7)
+
+
+def _mk(m, n, nb=16, grid=None):
+    a = RNG.standard_normal((m, n))
+    return a, st.from_dense(a, nb=nb, grid=grid)
+
+
+@pytest.mark.parametrize("opa", ["n", "t"])
+@pytest.mark.parametrize("opb", ["n", "t"])
+def test_gemm_ops(opa, opb):
+    m, n, k = 37, 25, 41
+    a, A = _mk(*((m, k) if opa == "n" else (k, m)))
+    b, B = _mk(*((k, n) if opb == "n" else (n, k)))
+    c, C = _mk(m, n)
+    Av = A if opa == "n" else A.T
+    Bv = B if opb == "n" else B.T
+    out = st.gemm(2.0, Av, Bv, -0.5, C)
+    ref = 2.0 * (a if opa == "n" else a.T) @ (b if opb == "n" else b.T) - 0.5 * c
+    np.testing.assert_allclose(out.to_numpy(), ref, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("method", [MethodGemm.A, MethodGemm.C])
+def test_gemm_methods_on_grid(grid2x2, method):
+    m, n, k = 64, 48, 80
+    a, A = _mk(m, k, nb=16, grid=grid2x2)
+    b, B = _mk(k, n, nb=16, grid=grid2x2)
+    c, C = _mk(m, n, nb=16, grid=grid2x2)
+    out = st.gemm(1.0, A, B, 1.0, C, Options(method_gemm=method))
+    # distributed reductions reorder sums; allow a bit more slack
+    np.testing.assert_allclose(out.to_numpy(), a @ b + c, rtol=1e-9,
+                               atol=1e-10)
+
+
+def test_gemm_probabilistic_residual_check():
+    # the reference's self-check: ||(C - (alpha A B + beta C0)) X|| small
+    # for random X (test/test_gemm.cc:135-279)
+    m, n, k = 50, 40, 30
+    a, A = _mk(m, k)
+    b, B = _mk(k, n)
+    c0, C0 = _mk(m, n)
+    alpha, beta = 0.7, -1.3
+    C = st.gemm(alpha, A, B, beta, C0)
+    x = RNG.standard_normal((n, 2))
+    lhs = C.to_numpy() @ x
+    rhs = alpha * (a @ (b @ x)) + beta * (c0 @ x)
+    err = np.linalg.norm(lhs - rhs) / np.linalg.norm(rhs)
+    assert err < 3 * np.finfo(np.float64).eps * max(m, n, k)
+
+
+def test_symm_hemm():
+    n, m = 33, 21
+    s = RNG.standard_normal((n, n))
+    S = st.symmetric(np.tril(s), nb=8, uplo=Uplo.Lower)
+    full = np.tril(s) + np.tril(s, -1).T
+    b, B = _mk(n, m, nb=8)
+    c, C = _mk(n, m, nb=8)
+    out = st.symm(Side.Left, 1.5, S, B, 0.5, C)
+    np.testing.assert_allclose(out.to_numpy(), 1.5 * full @ b + 0.5 * c,
+                               rtol=1e-12)
+    # right side
+    b2, B2 = _mk(m, n, nb=8)
+    c2, C2 = _mk(m, n, nb=8)
+    out2 = st.symm(Side.Right, 2.0, S, B2, 1.0, C2)
+    np.testing.assert_allclose(out2.to_numpy(), 2.0 * b2 @ full + c2,
+                               rtol=1e-12)
+
+    h = (RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n)))
+    hfull = np.tril(h) + np.tril(h, -1).conj().T
+    np.fill_diagonal(hfull, np.real(np.diagonal(hfull)))
+    H = st.hermitian(np.tril(h), nb=8, uplo=Uplo.Lower)
+    bc = RNG.standard_normal((n, m)) + 1j * RNG.standard_normal((n, m))
+    Bc = st.from_dense(bc, nb=8)
+    Cc = st.from_dense(np.zeros_like(bc), nb=8)
+    outc = st.hemm(Side.Left, 1.0, H, Bc, 0.0, Cc)
+    np.testing.assert_allclose(outc.to_numpy(), hfull @ bc, rtol=1e-12)
+
+
+def test_syrk_herk():
+    n, k = 29, 17
+    a, A = _mk(n, k, nb=8)
+    c = RNG.standard_normal((n, n))
+    C = st.symmetric(c, nb=8, uplo=Uplo.Lower)
+    out = st.syrk(1.0, A, 2.0, C)
+    ref = np.tril(a @ a.T + 2.0 * c)
+    np.testing.assert_allclose(np.tril(out.to_numpy()), ref, rtol=1e-12)
+    # upper
+    Cu = st.symmetric(c, nb=8, uplo=Uplo.Upper)
+    outu = st.syrk(1.0, A, 0.0, Cu)
+    np.testing.assert_allclose(np.triu(outu.to_numpy()), np.triu(a @ a.T),
+                               rtol=1e-12)
+    # herk complex
+    ac = a + 1j * RNG.standard_normal((n, k))
+    Ac = st.from_dense(ac, nb=8)
+    Cc = st.hermitian(np.zeros((n, n), complex), nb=8, uplo=Uplo.Lower)
+    outc = st.herk(1.0, Ac, 0.0, Cc)
+    np.testing.assert_allclose(np.tril(outc.to_numpy()),
+                               np.tril(ac @ ac.conj().T), rtol=1e-12)
+
+
+def test_syr2k_her2k():
+    n, k = 19, 11
+    a, A = _mk(n, k, nb=8)
+    b, B = _mk(n, k, nb=8)
+    C = st.symmetric(np.zeros((n, n)), nb=8, uplo=Uplo.Lower)
+    out = st.syr2k(1.0, A, B, 0.0, C)
+    ref = a @ b.T + b @ a.T
+    np.testing.assert_allclose(np.tril(out.to_numpy()), np.tril(ref),
+                               rtol=1e-12, atol=1e-12)
+    Ch = st.hermitian(np.zeros((n, n), complex), nb=8, uplo=Uplo.Lower)
+    ac = a + 1j * b
+    bc = b - 2j * a
+    Ac, Bc = st.from_dense(ac, nb=8), st.from_dense(bc, nb=8)
+    outh = st.her2k(1.0 + 0.5j, Ac, Bc, 0.0, Ch)
+    alpha = 1.0 + 0.5j
+    refh = alpha * ac @ bc.conj().T + np.conj(alpha) * bc @ ac.conj().T
+    np.testing.assert_allclose(np.tril(outh.to_numpy()), np.tril(refh),
+                               rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_trsm_trmm(side, uplo):
+    n, m = 24, 13
+    t = RNG.standard_normal((n, n)) + 3 * np.eye(n)
+    tri = np.tril(t) if uplo is Uplo.Lower else np.triu(t)
+    T = st.triangular(t, nb=8, uplo=uplo)
+    shape = (n, m) if side is Side.Left else (m, n)
+    b, B = _mk(*shape, nb=8)
+    X = st.trsm(side, 2.0, T, B)
+    if side is Side.Left:
+        ref = np.linalg.solve(tri, 2.0 * b)
+    else:
+        ref = (2.0 * b) @ np.linalg.inv(tri)
+    np.testing.assert_allclose(X.to_numpy(), ref, rtol=1e-9)
+    Bm = st.trmm(side, 1.0, T, st.from_dense(ref, nb=8))
+    np.testing.assert_allclose(Bm.to_numpy(), 2.0 * b, rtol=1e-9)
+
+
+def test_trsm_transposed_view():
+    n, m = 16, 5
+    t = np.tril(RNG.standard_normal((n, n))) + 3 * np.eye(n)
+    T = st.triangular(t, nb=8, uplo=Uplo.Lower)
+    b, B = _mk(n, m, nb=8)
+    X = st.trsm(Side.Left, 1.0, T.T, B)  # solve Lᵀ X = B
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(t.T, b),
+                               rtol=1e-9)
+
+
+def test_band_gbmm_tbsm():
+    n = 20
+    a = RNG.standard_normal((n, n))
+    Ab = st.band(a, nb=8, kl=2, ku=1)
+    r, c = np.indices((n, n))
+    banded = np.where((c - r <= 1) & (r - c <= 2), a, 0.0)
+    b, B = _mk(n, 7, nb=8)
+    C = st.from_dense(np.zeros((n, 7)), nb=8)
+    out = st.gbmm(1.0, Ab, B, 0.0, C)
+    np.testing.assert_allclose(out.to_numpy(), banded @ b, rtol=1e-12,
+                               atol=1e-12)
+    # triangular band solve
+    tb = np.tril(a, 0) + 5 * np.eye(n)
+    Tb = st.triangular_band(tb, nb=8, kd=2, uplo=Uplo.Lower)
+    tb_masked = np.where((r - c <= 2) & (r - c >= 0), tb, 0.0)
+    Xb = st.tbsm(Side.Left, 1.0, Tb, B)
+    np.testing.assert_allclose(Xb.to_numpy(), np.linalg.solve(tb_masked, b),
+                               rtol=1e-9)
+
+
+def test_elementwise():
+    a, A = _mk(10, 12, nb=4)
+    b, B = _mk(10, 12, nb=4)
+    out = st.add(2.0, A, -1.0, B)
+    np.testing.assert_allclose(out.to_numpy(), 2 * a - b, rtol=1e-12)
+    C = st.copy(A, dtype=jnp.float32)
+    assert C.dtype == jnp.float32
+    S = st.scale(3.0, 2.0, A)
+    np.testing.assert_allclose(S.to_numpy(), 1.5 * a, rtol=1e-12)
+    r = np.arange(1.0, 11.0)
+    c = np.arange(1.0, 13.0)
+    RC = st.scale_row_col(jnp.asarray(r), jnp.asarray(c), A)
+    np.testing.assert_allclose(RC.to_numpy(), a * r[:, None] * c[None, :],
+                               rtol=1e-12)
+    Z = st.set_matrix(1.0, 5.0, A)
+    zn = Z.to_numpy()
+    assert (np.diagonal(zn) == 5.0).all()
+    assert zn[0, 1] == 1.0
+    L = st.set_lambda(lambda i, j: i * 100 + j, A)
+    assert L.to_numpy()[3, 4] == 304
+
+
+def test_redistribute(grid2x2, grid2x4):
+    a, A = _mk(32, 32, nb=8, grid=grid2x2)
+    B = st.redistribute(A, grid2x4)
+    assert len(B.data.sharding.device_set) == 8
+    np.testing.assert_array_equal(B.to_numpy(), a)

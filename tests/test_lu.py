@@ -1,0 +1,155 @@
+"""LU family tests — residuals per the reference's test/test_gesv.cc:
+‖PA − LU‖ and backward error of solves.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import MethodLU, Options
+from slate_tpu.linalg import lu as lu_mod
+from slate_tpu.matgen import generate_matrix
+
+RNG = np.random.default_rng(23)
+
+
+def _solve_residual(a, b, x):
+    return (np.linalg.norm(b - a @ x, 1)
+            / (np.linalg.norm(a, 1) * np.linalg.norm(x, 1)
+               * a.shape[0] * np.finfo(float).eps))
+
+
+@pytest.mark.parametrize("n,nb", [(48, 16), (50, 16), (33, 8)])
+def test_getrf_residual(n, nb):
+    a = RNG.standard_normal((n, n))
+    A = st.from_dense(a, nb=nb)
+    LU, perm, info = lu_mod.getrf(A)
+    assert int(info) == 0
+    lu = LU.to_numpy()
+    l = np.tril(lu, -1) + np.eye(lu.shape[0])
+    u = np.triu(lu)
+    pa = np.pad(a, ((0, len(perm) - n), (0, len(perm) - n)))
+    pa = lu_mod._pad_identity_diag(jnp.asarray(pa), n, n)
+    pa = np.asarray(pa)[np.asarray(perm)][:n, :n]
+    lfull = np.tril(np.asarray(LU.dense_canonical()), -1) + np.eye(len(perm))
+    ufull = np.triu(np.asarray(LU.dense_canonical()))
+    err = np.linalg.norm(pa - (lfull @ ufull)[:n, :n], 1) / (
+        np.linalg.norm(a, 1) * n * np.finfo(float).eps)
+    assert err < 10.0
+
+
+@pytest.mark.parametrize("n,nb,nrhs", [(64, 16, 4), (37, 8, 3)])
+def test_gesv(n, nb, nrhs):
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    X, info = st.gesv(st.from_dense(a, nb=nb), st.from_dense(b, nb=nb))
+    assert int(info) == 0
+    assert _solve_residual(a, b, X.to_numpy()) < 10.0
+
+
+def test_gesv_trans():
+    n, nrhs = 32, 2
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    LU, perm, info = lu_mod.getrf(st.from_dense(a, nb=8))
+    X = lu_mod.getrs(LU, perm, st.from_dense(b, nb=8), trans=True)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a.T, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_gesv_on_grid(grid2x2):
+    n, nrhs = 64, 8
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    A = st.from_dense(a, nb=16, grid=grid2x2)
+    B = st.from_dense(b, nb=16, grid=grid2x2)
+    X, info = st.gesv(A, B)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_gesv_jit():
+    n = 24
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, 2))
+
+    @jax.jit
+    def f(A, B):
+        return st.gesv(A, B)
+
+    X, info = f(st.from_dense(a, nb=8), st.from_dense(b, nb=8))
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_getrf_nopiv_dominant():
+    n = 40
+    a = np.asarray(generate_matrix("rand_dominant", n, n, jnp.float64, seed=4))
+    b = RNG.standard_normal((n, 3))
+    X, info = lu_mod.gesv_nopiv(st.from_dense(a, nb=16), st.from_dense(b, nb=16))
+    assert int(info) == 0
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_getrf_info_singular():
+    n = 16
+    a = RNG.standard_normal((n, n))
+    a[:, 3] = 0.0  # exactly singular
+    LU, perm, info = lu_mod.getrf(st.from_dense(a, nb=8))
+    assert int(info) > 0
+
+
+def test_getrf_tntpiv():
+    n, nb = 64, 16
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, 4))
+    A = st.from_dense(a, nb=nb)
+    LU, perm, info = lu_mod.getrf_tntpiv(A)
+    assert int(info) == 0
+    X = lu_mod.getrs(LU, perm, st.from_dense(b, nb=nb))
+    assert _solve_residual(a, b, X.to_numpy()) < 50.0
+
+
+def test_gesv_method_dispatch():
+    n = 32
+    a = np.asarray(generate_matrix("rand_dominant", n, n, jnp.float64, seed=6))
+    b = RNG.standard_normal((n, 2))
+    for m in [MethodLU.PartialPiv, MethodLU.CALU, MethodLU.NoPiv, MethodLU.RBT]:
+        X, info = st.gesv(st.from_dense(a, nb=8), st.from_dense(b, nb=8),
+                          Options(method_lu=m))
+        np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                                   rtol=1e-6, atol=1e-8, err_msg=str(m))
+
+
+def test_gesv_rbt():
+    n = 64
+    a = RNG.standard_normal((n, n))  # general, needs pivoting normally
+    b = RNG.standard_normal((n, 2))
+    X, info = lu_mod.gesv_rbt(st.from_dense(a, nb=16), st.from_dense(b, nb=16))
+    res = _solve_residual(a, b, X.to_numpy())
+    assert res < 1e4  # RBT trades stability for speed; IR recovers most
+
+
+def test_getri():
+    n = 30
+    a = RNG.standard_normal((n, n)) + 5 * np.eye(n)
+    LU, perm, info = lu_mod.getrf(st.from_dense(a, nb=8))
+    Ainv = lu_mod.getri(LU, perm)
+    np.testing.assert_allclose(Ainv.to_numpy(), np.linalg.inv(a),
+                               rtol=1e-7, atol=1e-9)
+
+
+def test_gesv_mixed():
+    n = 48
+    a = RNG.standard_normal((n, n)) + 8 * np.eye(n)
+    b = RNG.standard_normal((n, 2))
+    A = st.from_dense(a, nb=16)
+    B = st.from_dense(b, nb=16)
+    X, info, iters = lu_mod.gesv_mixed(A, B, factor_dtype=jnp.float32)
+    assert int(info) == 0 and iters != 0
+    res = np.linalg.norm(b - a @ X.to_numpy(), np.inf) / (
+        np.linalg.norm(a, np.inf) * np.linalg.norm(X.to_numpy(), np.inf))
+    assert res < 1e-13

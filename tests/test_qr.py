@@ -1,0 +1,133 @@
+"""QR/LQ/least-squares tests — ‖A − QR‖ and ‖QᴴQ − I‖ residuals like the
+reference's test/test_geqrf.cc and test/test_gels.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import MethodGels, Options, Side
+
+RNG = np.random.default_rng(31)
+EPS = np.finfo(float).eps
+
+
+def _check_qr(a, Q, R, tol=50.0):
+    m, n = a.shape
+    q = Q.to_numpy()
+    r = np.triu(R.to_numpy())
+    assert np.linalg.norm(a - q @ r, 1) / (np.linalg.norm(a, 1) * m * EPS) < tol
+    assert np.linalg.norm(q.T.conj() @ q - np.eye(q.shape[1]), 1) / (m * EPS) < tol
+
+
+@pytest.mark.parametrize("m,n,nb", [(48, 48, 16), (50, 30, 16), (40, 24, 8)])
+def test_geqrf_unmqr(m, n, nb):
+    a = RNG.standard_normal((m, n))
+    A = st.from_dense(a, nb=nb)
+    QR = st.geqrf(A)
+    Q = st.qr_multiply_explicit(QR)
+    _check_qr(a, Q, QR.r_matrix)
+
+
+def test_geqrf_complex():
+    m, n = 32, 20
+    a = RNG.standard_normal((m, n)) + 1j * RNG.standard_normal((m, n))
+    A = st.from_dense(a, nb=8)
+    QR = st.geqrf(A)
+    Q = st.qr_multiply_explicit(QR)
+    q = Q.to_numpy()
+    r = np.triu(QR.r_matrix.to_numpy())
+    assert np.linalg.norm(a - q @ r) / np.linalg.norm(a) < 1e-13
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-13
+
+
+def test_unmqr_right_and_roundtrip():
+    m, n = 36, 24
+    a = RNG.standard_normal((m, n))
+    QR = st.geqrf(st.from_dense(a, nb=8))
+    c = RNG.standard_normal((m, 5))
+    C = st.from_dense(c, nb=8)
+    QtC = st.unmqr(Side.Left, QR, C, trans=True)
+    back = st.unmqr(Side.Left, QR, QtC, trans=False)
+    np.testing.assert_allclose(back.to_numpy(), c, rtol=1e-10, atol=1e-12)
+    # right-side: D·Q then (D·Q)·Qᴴ roundtrip
+    d = RNG.standard_normal((5, m))
+    D = st.from_dense(d, nb=8)
+    DQ = st.unmqr(Side.Right, QR, D, trans=False)
+    back2 = st.unmqr(Side.Right, QR, DQ, trans=True)
+    np.testing.assert_allclose(back2.to_numpy(), d, rtol=1e-10, atol=1e-12)
+
+
+def test_gelqf_unmlq():
+    m, n = 20, 44
+    a = RNG.standard_normal((m, n))
+    LQ = st.gelqf(st.from_dense(a, nb=8))
+    # L = Rᴴ of the QR of Aᴴ
+    l = np.tril(LQ.r_matrix.H.to_numpy())
+    # reconstruct: A = L·Qlq where Qlq rows orthonormal
+    eye_rows = -(-n // 8) * 8
+    I = st.from_dense(np.eye(eye_rows, m), nb=8,
+                      logical_shape=(n, m))
+    Qlq_H = st.unmlq(Side.Left, LQ, I, trans=True)  # Qlqᴴ·I = Qlqᴴ (n×m)
+    qlq = Qlq_H.to_numpy().T.conj()  # (m × n)
+    assert np.linalg.norm(a - l @ qlq, 1) / (np.linalg.norm(a, 1) * n * EPS) < 100
+
+
+def test_cholqr():
+    m, n = 60, 12
+    a = RNG.standard_normal((m, n))
+    Q, R = st.cholqr(st.from_dense(a, nb=12))
+    _check_qr(a, Q, R)
+
+
+def test_tsqr():
+    m, n = 128, 8
+    a = RNG.standard_normal((m, n))
+    Q, R = st.tsqr(st.from_dense(a, nb=8))
+    _check_qr(a, Q, R)
+
+
+def test_tsqr_matches_reference_r():
+    # |R| from tsqr must match |R| from numpy QR (up to sign)
+    m, n = 64, 8
+    a = RNG.standard_normal((m, n))
+    _, R = st.tsqr(st.from_dense(a, nb=8))
+    r_ref = np.linalg.qr(a, mode="r")
+    np.testing.assert_allclose(np.abs(np.triu(R.to_numpy())), np.abs(r_ref),
+                               rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.parametrize("method", [MethodGels.QR, MethodGels.CholQR])
+def test_gels_overdetermined(method):
+    m, n, nrhs = 50, 20, 3
+    a = RNG.standard_normal((m, n))
+    b = RNG.standard_normal((m, nrhs))
+    X = st.gels(st.from_dense(a, nb=8), st.from_dense(b, nb=8),
+                Options(method_gels=method))
+    x_ref, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(X.to_numpy()[:n], x_ref, rtol=1e-8, atol=1e-9)
+
+
+def test_gels_underdetermined():
+    m, n, nrhs = 18, 40, 2
+    a = RNG.standard_normal((m, n))
+    b = RNG.standard_normal((m, nrhs))
+    X = st.gels(st.from_dense(a, nb=8), st.from_dense(b, nb=8))
+    x_ref, *_ = np.linalg.lstsq(a, b, rcond=None)  # minimum-norm solution
+    np.testing.assert_allclose(X.to_numpy()[:n], x_ref, rtol=1e-8, atol=1e-9)
+
+
+def test_geqrf_jit_and_grid(grid2x2):
+    m, n = 64, 32
+    a = RNG.standard_normal((m, n))
+    A = st.from_dense(a, nb=16, grid=grid2x2)
+
+    @jax.jit
+    def f(A):
+        return st.geqrf(A)
+
+    QR = f(A)
+    Q = st.qr_multiply_explicit(QR)
+    _check_qr(a, Q, QR.r_matrix)

@@ -1,0 +1,151 @@
+"""Band solvers, condition estimation, indefinite solvers, simplified API,
+trace/printing utilities.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import Norm, Options, Side, Uplo
+from slate_tpu.matgen import random_spd
+from slate_tpu.utils import trace
+
+RNG = np.random.default_rng(41)
+
+
+def test_gbsv():
+    n, kl, ku, nrhs = 40, 3, 2, 2
+    a = RNG.standard_normal((n, n)) + 6 * np.eye(n)
+    r, c = np.indices((n, n))
+    ab = np.where((c - r <= ku) & (r - c <= kl), a, 0.0)
+    A = st.band(ab, nb=8, kl=kl, ku=ku)
+    b = RNG.standard_normal((n, nrhs))
+    X, info = st.gbsv(A, st.from_dense(b, nb=8))
+    assert int(info) == 0
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(ab, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_pbsv():
+    n, kd, nrhs = 36, 4, 3
+    g = RNG.standard_normal((n, n))
+    spd = g @ g.T / n + 4 * np.eye(n)
+    r, c = np.indices((n, n))
+    ab = np.where(np.abs(r - c) <= kd, spd, 0.0)
+    # make the banded matrix SPD again (diag dominant)
+    ab = ab + 2 * np.eye(n) * np.abs(ab).sum(1).max() / n
+    A = st.hermitian_band(np.tril(ab), nb=8, kd=kd, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, nrhs))
+    X, info = st.pbsv(A, st.from_dense(b, nb=8))
+    assert int(info) == 0
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(ab, b),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_gecondest():
+    n = 32
+    a = RNG.standard_normal((n, n)) + 5 * np.eye(n)
+    A = st.from_dense(a, nb=8)
+    LU, perm, info = st.getrf(A)
+    anorm = float(st.norm(A, Norm.One))
+    rcond = st.gecondest(LU, perm, anorm)
+    true_rcond = 1.0 / (np.linalg.norm(a, 1)
+                        * np.linalg.norm(np.linalg.inv(a), 1))
+    # estimator must be within ~10x of truth and never above 1
+    assert 0 < rcond <= 1.01
+    assert true_rcond / 15 < rcond < true_rcond * 15
+
+
+def test_pocondest_trcondest():
+    n = 32
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=8))
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    L, _ = st.potrf(A)
+    anorm = float(st.norm(A, Norm.One))
+    rcond = st.pocondest(L, anorm)
+    true_rcond = 1.0 / (np.linalg.norm(a, 1)
+                        * np.linalg.norm(np.linalg.inv(a), 1))
+    assert true_rcond / 15 < rcond < true_rcond * 15
+    t = np.tril(RNG.standard_normal((n, n))) + 4 * np.eye(n)
+    T = st.triangular(t, nb=8, uplo=Uplo.Lower)
+    rc = st.trcondest(T)
+    assert 0 < rc <= 1.01
+
+
+def test_hesv():
+    n, nrhs = 48, 3
+    g = RNG.standard_normal((n, n))
+    a = (g + g.T) / 2  # indefinite symmetric
+    A = st.symmetric(np.tril(a), nb=16, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, nrhs))
+    X, info = st.hesv(A, st.from_dense(b, nb=16))
+    res = np.linalg.norm(b - a @ X.to_numpy(), 1) / (
+        np.linalg.norm(a, 1) * np.linalg.norm(X.to_numpy(), 1))
+    assert res < 1e-10
+
+
+def test_hetrf_hetrs_spd_case():
+    n = 32
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=12))
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    LD, info = st.hetrf(A)
+    assert int(info) == 0
+    b = RNG.standard_normal((n, 2))
+    X = st.hetrs(LD, st.from_dense(b, nb=8))
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8, atol=1e-9)
+
+
+def test_simplified_api():
+    n = 24
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=3))
+    b = RNG.standard_normal((n, 2))
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    B = st.from_dense(b, nb=8)
+    X = st.chol_solve(A, B)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8)
+    g = RNG.standard_normal((n, n)) + 4 * np.eye(n)
+    X2 = st.lu_solve(st.from_dense(g, nb=8), B)
+    np.testing.assert_allclose(X2.to_numpy(), np.linalg.solve(g, b),
+                               rtol=1e-8)
+    C = st.from_dense(np.zeros((n, 2)), nb=8)
+    Y = st.multiply(1.0, A, B, 0.0, C)
+    full = np.tril(a) + np.tril(a, -1).T
+    np.testing.assert_allclose(Y.to_numpy(), full @ b, rtol=1e-10)
+    m = 40
+    aa = RNG.standard_normal((m, n))
+    bb = RNG.standard_normal((m, 2))
+    Xl = st.least_squares_solve(st.from_dense(aa, nb=8),
+                                st.from_dense(bb, nb=8))
+    ref, *_ = np.linalg.lstsq(aa, bb, rcond=None)
+    np.testing.assert_allclose(Xl.to_numpy()[:n], ref, rtol=1e-7, atol=1e-9)
+
+
+def test_trace_svg(tmp_path):
+    trace.Trace.clear()
+    trace.Trace.on()
+    with trace.Block("gemm"):
+        pass
+    with trace.Block("potrf", lane=1):
+        pass
+    trace.Trace.off()
+    p = trace.Trace.finish(str(tmp_path / "trace.svg"))
+    assert p and os.path.exists(p)
+    svg = open(p).read()
+    assert "gemm" in svg and "potrf" in svg and "<svg" in svg
+    with trace.timer("phase1"):
+        pass
+    assert "phase1" in trace.timers
+
+
+def test_print_and_debug(capsys):
+    a = RNG.standard_normal((5, 4))
+    A = st.from_dense(a, nb=2)
+    out = st.utils.print_matrix("A", A, Options(print_verbose=2))
+    assert "5x4" in out
+    dbg = st.utils.debug_dump(A)
+    assert "nb=2" in dbg
