@@ -22,7 +22,9 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
-                     gecondest, pocondest, trcondest, hesv, hetrf, hetrs)
+                     gecondest, pocondest, trcondest, hesv, hetrf, hetrs,
+                     heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf,
+                     svd, ge2tb, bdsqr)
 from . import api
 from . import utils
 from .api import (multiply, rank_k_update, rank_2k_update,

@@ -1,0 +1,32 @@
+"""Matmul-precision policy for numerically sensitive drivers.
+
+On TPU, jax's *default* matmul precision runs f32 contractions as fast
+bfloat16-pass products (~2⁻¹⁴/pass effective mantissa, 3 passes). That is
+the right trade for the gemm/symm BLAS-3 drivers (users control their own
+precision there), but it destroys the backward stability budget of
+factorizations — e.g. blocked-Householder Q orthogonality degrades from
+1e-5 to 0.19 at n=512/f32 (measured on v5e). The reference never faces
+this choice because cuBLAS runs true FP64.
+
+``accurate_matmuls`` pins jax.default_matmul_precision("highest") (full
+f32 accumulate on TPU; no-op on CPU f64) around a driver body. Applied to
+every factorization/reflector path: potrf, getrf, geqrf/unmqr, he2hb,
+ge2tb, heev, svd, hetrf.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def accurate_matmuls(fn):
+    """Decorator: run fn under full-precision matmuls."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+
+    return wrapped

@@ -9,7 +9,13 @@ from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
 from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
                  qr_multiply_explicit)
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
+from .eig import heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf
+from .svd import svd, ge2tb, bdsqr
 from .condest import gecondest, pocondest, trcondest
 from .indefinite import hesv, hetrf, hetrs
-from . import blas3, band, cholesky, condest, elementwise, indefinite, lu, qr
+# The driver function `svd` shadows the submodule attribute of the same
+# name (so `import slate_tpu.linalg.svd as m` would bind the *function*).
+# Expose an explicit module handle for internals like ge2tb back-ends:
+import sys as _sys
+svd_module = _sys.modules[__name__ + ".svd"]
 

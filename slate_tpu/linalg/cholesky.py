@@ -40,6 +40,7 @@ from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, Norm, Options, Side, Uplo,
                           DEFAULT_OPTIONS)
+from ..core.precision import accurate_matmuls
 from ..ops import tile_ops
 from . import blas3
 from . import elementwise as ew
@@ -105,6 +106,7 @@ def _potrf_blocked(a: jax.Array, nb: int, nt: int):
     return jnp.tril(a), info
 
 
+@accurate_matmuls
 def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
           ) -> Tuple[TiledMatrix, jax.Array]:
     """Cholesky factorization A = L·Lᴴ (Lower) or UᴴU (Upper).
@@ -154,6 +156,7 @@ def posv(A: TiledMatrix, B: TiledMatrix,
     return X, info
 
 
+@accurate_matmuls
 def trtri(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Triangular inverse (slate::trtri, src/trtri.cc). One XLA
     triangular_solve against I — blocked internally."""
@@ -170,6 +173,7 @@ def trtri(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
                       uplo=A.uplo, diag=A.diag, logical_shape=A.shape)
 
 
+@accurate_matmuls
 def trtrm(L: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Lᴴ·L (or U·Uᴴ) triangular-triangular multiply (slate::trtrm,
     src/trtrm.cc — the second half of potri)."""

@@ -1,0 +1,295 @@
+"""Hermitian eigensolvers: heev, hegv, hegst, he2hb, unmtr_he2hb,
+steqr, sterf.
+
+Reference: src/heev.cc (driver, SURVEY §3.4), src/hegv.cc, src/hegst.cc,
+src/he2hb.cc (full→band stage 1, 729 LoC), src/hb2st.cc (band→tridiag
+bulge chasing), src/steqr*.cc / src/sterf.cc / src/stedc*.cc (tridiagonal
+eigensolvers), src/unmtr_he2hb.cc, src/unmtr_hb2st.cc (back-transforms).
+
+TPU-native design (SURVEY §7.7):
+- Stage 1 (he2hb): blocked two-sided band reduction — per panel one tall
+  QR plus the Hermitian rank-2b update A₂₂ ← A₂₂ − V·Wᴴ − W·Vᴴ with
+  W = Y − ½·V·(Tᴴ·(Vᴴ·Y)), Y = A₂₂·V·T. All FLOPs are large MXU matmuls;
+  under GSPMD the update is partitioned over the mesh exactly where the
+  reference runs he2hb_hemm/he2hb_her2k_offdiag_ranks batched kernels.
+- Stage 2+3: the band (O(n·nb) data) is gathered to one device and
+  diagonalized there — the same strategy as the reference, which gathers
+  the band to MPI rank 0 for hb2st (src/heev.cc:131-135) and then calls
+  LAPACK's steqr for the tridiagonal stage. Our single-device kernel is
+  XLA's eigh (QDWH-based on TPU — itself a matmul-rich algorithm); a
+  native bulge-chasing hb2st is the flagged follow-up.
+- Back-transform (unmtr_he2hb): apply the stage-1 block reflectors to the
+  band eigenvectors — one pair of matmuls per panel (the reference's
+  unmqr-like internal_unmtr_hb2st/unmtr_he2hb).
+- steqr: an own-implementation implicit-shift QR iteration on (d, e)
+  with eigenvector accumulation, host-side like the reference's direct
+  lapack::steqr calls (src/steqr_impl.cc runs Givens on the host per
+  rank). sterf (values only) wraps eigh_tridiagonal.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
+from ..core.types import (MatrixKind, Norm, Options, Side, Uplo,
+                          DEFAULT_OPTIONS)
+from ..core.precision import accurate_matmuls
+from .norms import norm
+from .qr import _apply_block_reflector, _apply_block_reflector_H, _larft
+from . import blas3
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# stage 1: full → band
+# ---------------------------------------------------------------------------
+
+@accurate_matmuls
+def he2hb(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    """Reduce Hermitian A to band form (bandwidth nb): A = Q·B·Qᴴ.
+
+    Returns (B_band as HermitianBand TiledMatrix, vs, ts) where (vs, ts)
+    are per-panel block reflectors of Q (reference stores T = {Tlocal,
+    Treduce}, src/he2hb.cc:160-260)."""
+    if A.kind not in (MatrixKind.Hermitian, MatrixKind.Symmetric):
+        raise SlateError("he2hb: A must be Hermitian/Symmetric")
+    n = A.shape[0]
+    nb = A.nb
+    a = A.full_dense_canonical()
+    a = unit_pad_diag(a, n, n)
+    npad = a.shape[0]
+    nt = npad // nb
+    vs: List[Array] = []
+    ts: List[Array] = []
+    for k in range(nt - 1):
+        k0, k1 = k * nb, (k + 1) * nb
+        panel = a[k1:, k0:k1]
+        h_t, taus = jnp.linalg.qr(panel, mode="raw")
+        packed = h_t.T
+        w = packed.shape[1]
+        v = jnp.tril(packed, -1)
+        v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+        t = _larft(v, taus)
+        vs.append(v)
+        ts.append(t)
+        # band column: R (upper triangular) in the first block row
+        a = a.at[k1:, k0:k1].set(
+            jnp.zeros_like(panel).at[:w, :w].set(jnp.triu(packed[:w])))
+        a = a.at[k0:k1, k1:].set(
+            jnp.conj(jnp.zeros_like(panel).at[:w, :w].set(
+                jnp.triu(packed[:w]))).T)
+        # two-sided Hermitian update of the trailing block
+        a22 = a[k1:, k1:]
+        y = a22 @ (v @ t)
+        wmat = y - 0.5 * (v @ (jnp.conj(t).T @ (jnp.conj(v).T @ y)))
+        a22 = a22 - v @ jnp.conj(wmat).T - wmat @ jnp.conj(v).T
+        # re-Hermitianize against roundoff drift
+        a22 = 0.5 * (a22 + jnp.conj(a22).T)
+        a = a.at[k1:, k1:].set(a22)
+    band = from_dense(a, nb, grid=A.grid, kind=MatrixKind.HermitianBand,
+                      uplo=Uplo.Lower, kl=nb, ku=nb, logical_shape=(n, n))
+    return band, vs, ts
+
+
+def unmtr_he2hb(vs: List[Array], ts: List[Array], C: Array, nb: int,
+                trans: bool = False) -> Array:
+    """Apply the stage-1 Q (or Qᴴ) to the rows of C
+    (slate::unmtr_he2hb, src/unmtr_he2hb.cc). Q = H₀·H₁·…, where Hₖ acts
+    on rows (k+1)·nb and below."""
+    kt = len(vs)
+    order = range(kt) if trans else range(kt - 1, -1, -1)
+    for k in order:
+        k1 = (k + 1) * nb
+        v, t = vs[k], ts[k]
+        blk = C[k1:, :]
+        blk = _apply_block_reflector_H(v, t, blk) if trans \
+            else _apply_block_reflector(v, t, blk)
+        C = C.at[k1:, :].set(blk)
+    return C
+
+
+# ---------------------------------------------------------------------------
+# tridiagonal eigensolvers
+# ---------------------------------------------------------------------------
+
+def sterf(d: Array, e: Array) -> Array:
+    """Eigenvalues of a real symmetric tridiagonal matrix, ascending
+    (slate::sterf wraps LAPACK sterf; here: eigh_tridiagonal)."""
+    return jax.scipy.linalg.eigh_tridiagonal(d, e, eigvals_only=True)
+
+
+def steqr(d, e, compute_z: bool = True,
+          max_sweeps: int = 60) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Implicit-shift QR iteration on a symmetric tridiagonal matrix with
+    optional eigenvector accumulation.
+
+    Own implementation of the lapack::steqr role (the reference computes
+    Givens rotations redundantly on every rank and applies them to its
+    local rows of Z, src/steqr_impl.cc:253-262). Host-side numpy — the
+    tridiagonal stage is O(n²)-per-sweep scalar recurrences, which belong
+    on the host exactly as the reference leaves them in LAPACK. Returns
+    ascending (w, z)."""
+    d = np.asarray(d, dtype=np.float64).copy()
+    e = np.asarray(e, dtype=np.float64).copy()
+    n = d.size
+    z = np.eye(n) if compute_z else None
+    if n == 1:
+        return d, z
+
+    def givens(f, g):
+        if g == 0:
+            return 1.0, 0.0, f
+        if f == 0:
+            return 0.0, 1.0, g
+        r = np.hypot(f, g)
+        return f / r, g / r, r
+
+    lo = 0
+    converged = False
+    for _ in range(max_sweeps * n):
+        # deflate
+        for i in range(n - 1):
+            tol = 1e-16 * (abs(d[i]) + abs(d[i + 1]))
+            if abs(e[i]) <= tol:
+                e[i] = 0.0
+        # find an undeflated block [lo, hi]
+        hi = n - 1
+        while hi > 0 and e[hi - 1] == 0.0:
+            hi -= 1
+        if hi == 0:
+            converged = True
+            break
+        lo = hi - 1
+        while lo > 0 and e[lo - 1] != 0.0:
+            lo -= 1
+        # Wilkinson shift from the trailing 2x2 of the block
+        a11, a22 = d[hi - 1], d[hi]
+        ab = e[hi - 1]
+        delta = (a11 - a22) / 2.0
+        denom = delta + np.sign(delta if delta != 0 else 1.0) * np.hypot(
+            delta, ab)
+        mu = a22 - (ab * ab) / denom if denom != 0 else a22 - ab
+        # implicit QR sweep with bulge chasing over [lo, hi]
+        f, g = d[lo] - mu, e[lo]
+        for i in range(lo, hi):
+            c, s, r = givens(f, g)
+            if i > lo:
+                e[i - 1] = r
+            m11, m12, m22 = d[i], e[i], d[i + 1]
+            d[i] = c * c * m11 + 2 * c * s * m12 + s * s * m22
+            d[i + 1] = s * s * m11 - 2 * c * s * m12 + c * c * m22
+            e[i] = (c * c - s * s) * m12 + c * s * (m22 - m11)
+            if i < hi - 1:
+                bulge = s * e[i + 1]
+                e[i + 1] = c * e[i + 1]
+                f, g = e[i], bulge
+            if compute_z:
+                zi = z[:, i].copy()
+                z[:, i] = c * zi + s * z[:, i + 1]
+                z[:, i + 1] = -s * zi + c * z[:, i + 1]
+    if not converged and np.any(e != 0.0):
+        # LAPACK steqr reports info > 0 here; we fail loudly instead of
+        # returning partially-converged values that look like a result
+        raise SlateError("steqr: QR iteration did not converge within "
+                         f"{max_sweeps}*n sweeps")
+    order = np.argsort(d)
+    d = d[order]
+    if compute_z:
+        z = z[:, order]
+    return d, z
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+@accurate_matmuls
+def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+         want_vectors: bool = True
+         ) -> Tuple[Array, Optional[TiledMatrix]]:
+    """Hermitian eigensolver (slate::heev, src/heev.cc:67).
+
+    Pipeline: scale → he2hb (distributed stage 1) → single-device
+    diagonalization of the gathered band (stage 2+3, see module
+    docstring) → unmtr_he2hb back-transform → rescale.
+    Returns (Lambda ascending, Z or None)."""
+    n = A.shape[0]
+    nb = A.nb
+    if n == 0:
+        return jnp.zeros((0,), jnp.float32), None
+    # scale to safe range (reference heev.cc:104-122)
+    anorm = norm(A, Norm.Max)
+    sfmin = jnp.finfo(A.dtype).tiny ** 0.5
+    sfmax = jnp.finfo(A.dtype).max ** 0.5
+    do_scale = (anorm > 0) & ((anorm < sfmin) | (anorm > sfmax))
+    sigma = jnp.where(do_scale, jnp.where(anorm < sfmin, sfmin / anorm,
+                                          sfmax / anorm), 1.0)
+    # scaling by a real scalar is valid under any op view; never skip it
+    # (w is divided by sigma unconditionally below)
+    A = A.with_data(A.data * sigma.astype(A.dtype)) if A.op.value == "n" \
+        else from_dense(A.dense_canonical() * sigma.astype(A.dtype), nb,
+                        grid=A.grid, kind=A.kind, uplo=A.uplo,
+                        logical_shape=A.shape)
+    band, vs, ts = he2hb(A, opts)
+    bfull = band.full_dense_canonical()
+    npad = bfull.shape[0]
+    if npad != n:
+        # the padding block is exactly decoupled (block-diag); shift its
+        # diagonal past the Gershgorin bound of the band so its
+        # eigenvalues sort strictly last and w[:n]/z[:, :n] are the
+        # logical eigenpairs
+        big = (2 * nb + 1) * jnp.max(jnp.abs(bfull)) + 1.0
+        idx = jnp.arange(npad)
+        dpad = jnp.where(idx >= n, big.astype(jnp.real(bfull).dtype),
+                         jnp.real(jnp.diagonal(bfull)))
+        bfull = bfull.at[idx, idx].set(dpad.astype(bfull.dtype))
+    # stage 2+3 on one device (gathered band, O(n*nb) information)
+    w, zb = jnp.linalg.eigh(bfull)
+    w = w[:n]
+    if not want_vectors:
+        return w / sigma, None
+    z = unmtr_he2hb(vs, ts, zb[:, :n], nb, trans=False)
+    Z = from_dense(z, nb, grid=A.grid, logical_shape=(n, n))
+    return w / sigma, Z
+
+
+@accurate_matmuls
+def hegst(A: TiledMatrix, L: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Reduce generalized A·x = λ·B·x to standard form: A ← L⁻¹·A·L⁻ᴴ
+    (itype 1; slate::hegst, src/hegst.cc)."""
+    a = A.full_dense_canonical()
+    n = A.shape[0]
+    lmat = L.full_dense_canonical()
+    lmat = unit_pad_diag(lmat, n, n)
+    x = jax.lax.linalg.triangular_solve(lmat, a, left_side=True, lower=True,
+                                        unit_diagonal=False)
+    y = jax.lax.linalg.triangular_solve(
+        jnp.conj(lmat), x, left_side=False, lower=True,
+        unit_diagonal=False, transpose_a=True)
+    y = 0.5 * (y + jnp.conj(y).T)
+    return from_dense(y, A.nb, grid=A.grid, kind=A.kind, uplo=Uplo.Lower,
+                      logical_shape=(n, n))
+
+
+def hegv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+         want_vectors: bool = True
+         ) -> Tuple[Array, Optional[TiledMatrix]]:
+    """Generalized Hermitian-definite eigensolver (slate::hegv = potrf(B)
+    + hegst + heev + trsm back-transform)."""
+    from .cholesky import potrf
+    Lb, info = potrf(B, opts)
+    As = hegst(A, Lb, opts)
+    w, Z = heev(As, opts, want_vectors=want_vectors)
+    if not want_vectors:
+        return w, None
+    # x = L⁻ᴴ·z
+    X = blas3.trsm(Side.Left, 1.0, Lb.H, Z, opts)
+    return w, X

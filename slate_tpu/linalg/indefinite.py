@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense
 from ..core.types import MatrixKind, Options, Side, Uplo, DEFAULT_OPTIONS
+from ..core.precision import accurate_matmuls
 from . import blas3
 from .lu import _butterfly_vectors, _rbt_rows
 
@@ -53,6 +54,7 @@ def _ldl_unblocked(a: Array):
     return jax.lax.fori_loop(0, n, body, (a, jnp.zeros((), jnp.int32)))
 
 
+@accurate_matmuls
 def hetrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
           ) -> Tuple[TiledMatrix, Array]:
     """Block LDLᴴ: A = L·D·Lᴴ with unit-lower L and real diagonal D
@@ -120,6 +122,7 @@ def hetrs(LD: TiledMatrix, B: TiledMatrix,
                       logical_shape=(nlog, B.shape[1]))
 
 
+@accurate_matmuls
 def hesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> Tuple[TiledMatrix, Array]:
     """Solve Hermitian-indefinite A·X = B (slate::hesv, src/hesv.cc).

@@ -37,6 +37,7 @@ from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, MethodLU, Norm, Options, Side,
                           Uplo, DEFAULT_OPTIONS)
+from ..core.precision import accurate_matmuls
 from . import blas3
 from . import elementwise as ew
 from .norms import norm
@@ -94,6 +95,7 @@ def _getrf_blocked(a: Array, nb: int, nt: int):
     return a, perm, info
 
 
+@accurate_matmuls
 def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
           ) -> Tuple[TiledMatrix, Array, Array]:
     """Partial-pivot LU: A[perm] = L·U (slate::getrf, src/getrf.cc).
@@ -114,6 +116,7 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     return out, perm, info
 
 
+@accurate_matmuls
 def getrf_nopiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
                 ) -> Tuple[TiledMatrix, Array]:
     """LU without pivoting (slate::getrf_nopiv, src/getrf_nopiv.cc) —
@@ -172,6 +175,7 @@ def _lu_nopiv_unblocked(a: Array):
     return mat, info
 
 
+@accurate_matmuls
 def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
                  ) -> Tuple[TiledMatrix, Array, Array]:
     """Tournament (CALU) pivoting LU (slate::getrf_tntpiv,
@@ -245,6 +249,7 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     return out, perm, info
 
 
+@accurate_matmuls
 def getrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS, trans: bool = False
           ) -> TiledMatrix:

@@ -39,6 +39,7 @@ from ..core.tiled_matrix import (TiledMatrix, from_dense, triangular,
                                  unit_pad_diag)
 from ..core.types import (Diag, MatrixKind, MethodGels, Norm, Options, Side,
                           Uplo, DEFAULT_OPTIONS)
+from ..core.precision import accurate_matmuls
 from . import blas3
 from .cholesky import potrf
 from .norms import norm
@@ -113,6 +114,7 @@ def _apply_block_reflector(v: Array, t: Array, c: Array) -> Array:
 _pad_identity_diag = unit_pad_diag
 
 
+@accurate_matmuls
 def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     """Blocked Householder QR: A = Q·R (slate::geqrf, src/geqrf.cc)."""
     m, n = A.shape
@@ -146,6 +148,7 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     return QRFactors(a, t_all, m, n, nb)
 
 
+@accurate_matmuls
 def unmqr(side: Side, QR: QRFactors, C: TiledMatrix, trans: bool = False,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Multiply by Q from geqrf (slate::unmqr, src/unmqr.cc).
@@ -219,6 +222,7 @@ def unmlq(side: Side, LQ: QRFactors, C: TiledMatrix, trans: bool = False,
 
 # -- CholQR / TSQR ---------------------------------------------------------
 
+@accurate_matmuls
 def cholqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
            ) -> Tuple[TiledMatrix, TiledMatrix]:
     """Cholesky QR: R = chol(AᴴA)ᵀ-ish, Q = A·R⁻¹ (slate::cholqr,
@@ -242,6 +246,7 @@ def cholqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     return Q, R
 
 
+@accurate_matmuls
 def tsqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> Tuple[TiledMatrix, TiledMatrix]:
     """Communication-avoiding tall-skinny QR (the reference's
@@ -287,6 +292,7 @@ def tsqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
 
 # -- least squares ---------------------------------------------------------
 
+@accurate_matmuls
 def gels(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> TiledMatrix:
     """Minimum-norm least squares solve min‖AX − B‖ (slate::gels,
