@@ -1,0 +1,43 @@
+"""Example 3: eigensolvers and SVD.
+
+Reference analog: examples/ex10_svd.cc, ex11_hermitian_eig.cc,
+ex12_generalized_hermitian_eig.cc.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu.core.types import Uplo
+from slate_tpu.matgen import generate_matrix, random_spd
+
+
+def main():
+    n = 256
+    a = np.asarray(generate_matrix("heev_arith", n, n, jnp.float32,
+                                   cond=100.0))
+    A = st.hermitian(np.tril(a), nb=64, uplo=Uplo.Lower)
+    w, Z = st.heev(A)
+    z = Z.to_numpy()
+    print("heev residual:",
+          float(np.abs(a @ z - z * np.asarray(w)[None, :]).max()))
+
+    # generalized: A x = lambda B x
+    b = np.asarray(random_spd(n, dtype=jnp.float32, seed=2))
+    Bm = st.hermitian(np.tril(b), nb=64, uplo=Uplo.Lower)
+    wg, Xg, info_g = st.hegv(A, Bm)
+    xg = Xg.to_numpy()
+    print("hegv residual:",
+          float(np.abs(a @ xg - (b @ xg) * np.asarray(wg)[None, :]).max()))
+
+    # SVD with vectors
+    m = 384
+    g = np.asarray(generate_matrix("svd_geo", m, n, jnp.float32, cond=50.0))
+    s, U, V = st.svd(st.from_dense(g, nb=64), want_vectors=True)
+    recon = (U.to_numpy() * np.asarray(s)[None, :]) @ V.to_numpy().T
+    print("svd recon rel err:",
+          float(np.linalg.norm(g - recon) / np.linalg.norm(g)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Smoke-run all examples (reference: examples/run_tests.py — doubles as
+an API regression test)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+
+def main():
+    here = pathlib.Path(__file__).parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(here.parent) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    fails = 0
+    for ex in sorted(here.glob("ex*.py")):
+        print(f"=== {ex.name} ===")
+        r = subprocess.run([sys.executable, str(ex)], cwd=here.parent,
+                           env=env)
+        if r.returncode != 0:
+            fails += 1
+            print(f"!!! {ex.name} FAILED")
+    return fails
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+/* slate-tpu C API — native host-runtime entry points.
+ *
+ * Reference analog: include/slate/c_api/slate.h (the generated C API,
+ * tools/c_api/*.py) and the scalapack_api/ interchange layer.
+ *
+ * The TPU compute path lives in the Python/JAX runtime; this header
+ * covers the native host runtime (layout/staging kernels in
+ * native/libslate_tpu_host.so) that C and Fortran callers use to move
+ * data between their layouts and slate-tpu's. Link with
+ * -lslate_tpu_host (built by native/Makefile).
+ *
+ * All matrices are double precision. Error convention: 0 = success,
+ * negative = argument error (LAPACK-style).
+ */
+
+#ifndef SLATE_TPU_H
+#define SLATE_TPU_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Pack a row-major global (m x n, leading dim ldg) matrix into the 2D
+ * block-cyclic local buffer of process (pi, qi) on a p x q grid with
+ * tile size nb. `local` must hold ceil(mt/p)*ceil(nt/q)*nb*nb doubles. */
+int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
+                   int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
+                   double* local);
+
+/* Inverse: scatter a local block-cyclic buffer into the global matrix. */
+int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
+                     int64_t nb, int64_t p, int64_t q, int64_t pi,
+                     int64_t qi, double* global);
+
+/* Row-major global <-> tile-major (mt, nt, nb, nb) padded layout. */
+int64_t st_tile_pack(const double* global, int64_t m, int64_t n,
+                     int64_t ldg, int64_t nb, double* tiles);
+int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
+                       int64_t ldg, int64_t nb, double* global);
+
+/* Column-major (LAPACK) <-> row-major conversion, OpenMP blocked. */
+int64_t st_colmajor_to_rowmajor(const double* cm, int64_t m, int64_t n,
+                                int64_t ldcm, double* rm, int64_t ldrm);
+int64_t st_rowmajor_to_colmajor(const double* rm, int64_t m, int64_t n,
+                                int64_t ldrm, double* cm, int64_t ldcm);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SLATE_TPU_H */

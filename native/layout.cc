@@ -1,0 +1,190 @@
+// slate-tpu native host runtime: layout conversion kernels.
+//
+// TPU-native analog of the reference's data-interchange machinery:
+//  - Matrix::fromScaLAPACK zero-copy wrapping of 2D block-cyclic buffers
+//    (reference include/slate/Matrix.hh:73 and the scalapack_api/ layer,
+//    e.g. scalapack_api/scalapack_potrf.cc:94-110 reading BLACS grids);
+//  - the tile layout conversions (BaseMatrix.hh:551-603 col<->row major,
+//    src/cuda/device_transpose.cu batched tile transpose).
+//
+// On TPU the device side needs none of this (XLA owns device layout), but
+// the HOST side does: users arriving from ScaLAPACK hold per-process 2D
+// block-cyclic local arrays, and staging those into the global row-major
+// buffers jax.device_put expects is a memory-bound strided copy that
+// belongs in native code. These kernels are exposed through ctypes
+// (slate_tpu/interop/scalapack.py) and parallelized with OpenMP, matching
+// the reference's use of OpenMP for host-side data motion.
+//
+// Layout conventions:
+//  - global: row-major (m x n), leading dimension ldg >= n.
+//  - block-cyclic local: the (p, q) process at coords (pi, qi) owns tiles
+//    (i, j) with i % p == pi, j % q == qi (ScaLAPACK block-cyclic,
+//    2D grid); its local buffer is column-of-tiles major, i.e. local tile
+//    (il, jl) starts at offset ((jl * mt_loc) + il) * nb * nb and is
+//    stored row-major nb x nb, zero-padded at the ragged edges.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Number of local tile-rows for grid coordinate pi of p over mt tiles.
+static inline int64_t local_tiles(int64_t mt, int64_t p, int64_t pi) {
+    return (mt - pi + p - 1) / p;
+}
+
+// Pack a row-major global (m x n) matrix into one process's 2D
+// block-cyclic local buffer. Returns 0 on success.
+int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
+                   int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
+                   double* local) {
+    if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
+    if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
+    const int64_t mt = (m + nb - 1) / nb;
+    const int64_t nt = (n + nb - 1) / nb;
+    const int64_t mtl = local_tiles(mt, p, pi);
+    const int64_t ntl = local_tiles(nt, q, qi);
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t jl = 0; jl < ntl; ++jl) {
+        for (int64_t il = 0; il < mtl; ++il) {
+            const int64_t gi = pi + il * p;   // global tile row
+            const int64_t gj = qi + jl * q;   // global tile col
+            const int64_t r0 = gi * nb, c0 = gj * nb;
+            const int64_t rows = std::min(nb, m - r0);
+            const int64_t cols = std::min(nb, n - c0);
+            double* t = local + ((jl * mtl) + il) * nb * nb;
+            for (int64_t r = 0; r < rows; ++r) {
+                const double* src = global + (r0 + r) * ldg + c0;
+                double* dst = t + r * nb;
+                std::memcpy(dst, src, size_t(cols) * sizeof(double));
+                if (cols < nb)
+                    std::memset(dst + cols, 0,
+                                size_t(nb - cols) * sizeof(double));
+            }
+            for (int64_t r = rows; r < nb; ++r)
+                std::memset(t + r * nb, 0, size_t(nb) * sizeof(double));
+        }
+    }
+    return 0;
+}
+
+// Inverse of st_bc_pack: scatter one process's local block-cyclic buffer
+// back into the row-major global matrix (only this process's tiles are
+// written).
+int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
+                     int64_t nb, int64_t p, int64_t q, int64_t pi,
+                     int64_t qi, double* global) {
+    if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
+    if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
+    const int64_t mt = (m + nb - 1) / nb;
+    const int64_t nt = (n + nb - 1) / nb;
+    const int64_t mtl = local_tiles(mt, p, pi);
+    const int64_t ntl = local_tiles(nt, q, qi);
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t jl = 0; jl < ntl; ++jl) {
+        for (int64_t il = 0; il < mtl; ++il) {
+            const int64_t gi = pi + il * p;
+            const int64_t gj = qi + jl * q;
+            const int64_t r0 = gi * nb, c0 = gj * nb;
+            const int64_t rows = std::min(nb, m - r0);
+            const int64_t cols = std::min(nb, n - c0);
+            const double* t = local + ((jl * mtl) + il) * nb * nb;
+            for (int64_t r = 0; r < rows; ++r)
+                std::memcpy(global + (r0 + r) * ldg + c0, t + r * nb,
+                            size_t(cols) * sizeof(double));
+        }
+    }
+    return 0;
+}
+
+// Pack a row-major global matrix into tile-major (mt, nt, nb, nb) order
+// (padded). The host-side analog of the reference's tile layout
+// (Tile.hh + MatrixStorage tile map) used for fast staging.
+int64_t st_tile_pack(const double* global, int64_t m, int64_t n,
+                     int64_t ldg, int64_t nb, double* tiles) {
+    if (!global || !tiles || nb <= 0) return -1;
+    const int64_t mt = (m + nb - 1) / nb;
+    const int64_t nt = (n + nb - 1) / nb;
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t i = 0; i < mt; ++i) {
+        for (int64_t j = 0; j < nt; ++j) {
+            const int64_t r0 = i * nb, c0 = j * nb;
+            const int64_t rows = std::min(nb, m - r0);
+            const int64_t cols = std::min(nb, n - c0);
+            double* t = tiles + ((i * nt) + j) * nb * nb;
+            for (int64_t r = 0; r < rows; ++r) {
+                std::memcpy(t + r * nb, global + (r0 + r) * ldg + c0,
+                            size_t(cols) * sizeof(double));
+                if (cols < nb)
+                    std::memset(t + r * nb + cols, 0,
+                                size_t(nb - cols) * sizeof(double));
+            }
+            for (int64_t r = rows; r < nb; ++r)
+                std::memset(t + r * nb, 0, size_t(nb) * sizeof(double));
+        }
+    }
+    return 0;
+}
+
+int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
+                       int64_t ldg, int64_t nb, double* global) {
+    if (!global || !tiles || nb <= 0) return -1;
+    const int64_t mt = (m + nb - 1) / nb;
+    const int64_t nt = (n + nb - 1) / nb;
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t i = 0; i < mt; ++i) {
+        for (int64_t j = 0; j < nt; ++j) {
+            const int64_t r0 = i * nb, c0 = j * nb;
+            const int64_t rows = std::min(nb, m - r0);
+            const int64_t cols = std::min(nb, n - c0);
+            const double* t = tiles + ((i * nt) + j) * nb * nb;
+            for (int64_t r = 0; r < rows; ++r)
+                std::memcpy(global + (r0 + r) * ldg + c0, t + r * nb,
+                            size_t(cols) * sizeof(double));
+        }
+    }
+    return 0;
+}
+
+// Column-major (LAPACK/ScaLAPACK) <-> row-major conversion with OpenMP
+// blocking (the host analog of device_transpose.cu).
+int64_t st_colmajor_to_rowmajor(const double* cm, int64_t m, int64_t n,
+                                int64_t ldcm, double* rm, int64_t ldrm) {
+    if (!cm || !rm) return -1;
+    const int64_t B = 64;
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t ib = 0; ib < m; ib += B) {
+        for (int64_t jb = 0; jb < n; jb += B) {
+            const int64_t ie = std::min(ib + B, m);
+            const int64_t je = std::min(jb + B, n);
+            for (int64_t j = jb; j < je; ++j)
+                for (int64_t i = ib; i < ie; ++i)
+                    rm[i * ldrm + j] = cm[j * ldcm + i];
+        }
+    }
+    return 0;
+}
+
+int64_t st_rowmajor_to_colmajor(const double* rm, int64_t m, int64_t n,
+                                int64_t ldrm, double* cm, int64_t ldcm) {
+    if (!rm || !cm) return -1;
+    const int64_t B = 64;
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t ib = 0; ib < m; ib += B) {
+        for (int64_t jb = 0; jb < n; jb += B) {
+            const int64_t ie = std::min(ib + B, m);
+            const int64_t je = std::min(jb + B, n);
+            for (int64_t i = ib; i < ie; ++i)
+                for (int64_t j = jb; j < je; ++j)
+                    cm[j * ldcm + i] = rm[i * ldrm + j];
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

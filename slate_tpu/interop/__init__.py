@@ -1,0 +1,2 @@
+from .scalapack import from_lapack, from_scalapack, to_scalapack
+from .native import have_native, tile_pack, tile_unpack, bc_pack, bc_unpack

@@ -1,0 +1,182 @@
+"""ctypes bindings for the native host runtime (native/layout.cc).
+
+Reference analog: the scalapack_api/ + lapack_api/ interchange layers and
+BaseMatrix's layout-conversion machinery. The shared library is built on
+first use with the repo's Makefile (g++ -fopenmp); if no compiler is
+available, every entry point falls back to an equivalent numpy path so
+the framework stays importable (reference behavior: the APIs are optional
+CMake components, CMakeLists.txt:56).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_NATIVE_DIR, "libslate_tpu_host.so")
+
+_I64 = ctypes.c_int64
+_PD = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        for name, argtypes in [
+            ("st_bc_pack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
+                            _I64, _PD]),
+            ("st_bc_unpack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
+                              _I64, _PD]),
+            ("st_tile_pack", [_PD, _I64, _I64, _I64, _I64, _PD]),
+            ("st_tile_unpack", [_PD, _I64, _I64, _I64, _I64, _PD]),
+            ("st_colmajor_to_rowmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
+            ("st_rowmajor_to_colmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = _I64
+        _LIB = lib
+        return _LIB
+
+
+def have_native() -> bool:
+    return get_lib() is not None
+
+
+# -- numpy fallbacks (same layout contracts as layout.cc) -------------------
+
+def _local_tiles(mt: int, p: int, pi: int) -> int:
+    return (mt - pi + p - 1) // p
+
+
+def bc_pack(global_rm: np.ndarray, nb: int, p: int, q: int, pi: int,
+            qi: int) -> np.ndarray:
+    """Global row-major (m, n) → this process's 2D block-cyclic local
+    buffer of shape (ntl*mtl, nb, nb) in column-of-tiles-major order."""
+    a = np.ascontiguousarray(global_rm, dtype=np.float64)
+    m, n = a.shape
+    mt, nt = -(-m // nb), -(-n // nb)
+    mtl, ntl = _local_tiles(mt, p, pi), _local_tiles(nt, q, qi)
+    out = np.zeros((ntl * mtl, nb, nb), np.float64)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.st_bc_pack(a, m, n, a.strides[0] // 8, nb, p, q, pi, qi,
+                            out.reshape(-1))
+        if rc == 0:
+            return out
+    for jl in range(ntl):
+        for il in range(mtl):
+            gi, gj = pi + il * p, qi + jl * q
+            r0, c0 = gi * nb, gj * nb
+            rows, cols = min(nb, m - r0), min(nb, n - c0)
+            out[jl * mtl + il, :rows, :cols] = a[r0:r0 + rows, c0:c0 + cols]
+    return out
+
+
+def bc_unpack(local: np.ndarray, m: int, n: int, nb: int, p: int, q: int,
+              pi: int, qi: int, out: Optional[np.ndarray] = None
+              ) -> np.ndarray:
+    """Scatter a local block-cyclic buffer into the global row-major
+    matrix (writes only this process's tiles)."""
+    if out is None:
+        out = np.zeros((m, n), np.float64)
+    loc = np.ascontiguousarray(local, dtype=np.float64)
+    mt, nt = -(-m // nb), -(-n // nb)
+    mtl, ntl = _local_tiles(mt, p, pi), _local_tiles(nt, q, qi)
+    lib = get_lib()
+    if lib is not None and out.flags.c_contiguous:
+        rc = lib.st_bc_unpack(loc.reshape(-1), m, n, out.strides[0] // 8,
+                              nb, p, q, pi, qi, out)
+        if rc == 0:
+            return out
+    loc3 = loc.reshape(ntl * mtl, nb, nb)
+    for jl in range(ntl):
+        for il in range(mtl):
+            gi, gj = pi + il * p, qi + jl * q
+            r0, c0 = gi * nb, gj * nb
+            rows, cols = min(nb, m - r0), min(nb, n - c0)
+            out[r0:r0 + rows, c0:c0 + cols] = loc3[jl * mtl + il,
+                                                   :rows, :cols]
+    return out
+
+
+def tile_pack(global_rm: np.ndarray, nb: int) -> np.ndarray:
+    a = np.ascontiguousarray(global_rm, dtype=np.float64)
+    m, n = a.shape
+    mt, nt = -(-m // nb), -(-n // nb)
+    out = np.zeros((mt, nt, nb, nb), np.float64)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.st_tile_pack(a, m, n, a.strides[0] // 8, nb,
+                              out.reshape(-1))
+        if rc == 0:
+            return out
+    for i in range(mt):
+        for j in range(nt):
+            r0, c0 = i * nb, j * nb
+            rows, cols = min(nb, m - r0), min(nb, n - c0)
+            out[i, j, :rows, :cols] = a[r0:r0 + rows, c0:c0 + cols]
+    return out
+
+
+def tile_unpack(tiles: np.ndarray, m: int, n: int) -> np.ndarray:
+    t = np.ascontiguousarray(tiles, dtype=np.float64)
+    mt, nt, nb, _ = t.shape
+    out = np.zeros((m, n), np.float64)
+    lib = get_lib()
+    if lib is not None:
+        rc = lib.st_tile_unpack(t.reshape(-1), m, n, out.strides[0] // 8,
+                                nb, out)
+        if rc == 0:
+            return out
+    for i in range(mt):
+        for j in range(nt):
+            r0, c0 = i * nb, j * nb
+            rows, cols = min(nb, m - r0), min(nb, n - c0)
+            out[r0:r0 + rows, c0:c0 + cols] = t[i, j, :rows, :cols]
+    return out
+
+
+def colmajor_to_rowmajor(cm: np.ndarray) -> np.ndarray:
+    a = np.asfortranarray(cm, dtype=np.float64)
+    m, n = a.shape
+    out = np.empty((m, n), np.float64)
+    lib = get_lib()
+    if lib is not None:
+        # fortran array: strides[1]//8 is the column stride (ldcm)
+        rc = lib.st_colmajor_to_rowmajor(
+            np.ascontiguousarray(a.T.reshape(-1)).reshape(n * m), m, n, m,
+            out, n)
+        if rc == 0:
+            return out
+    return np.ascontiguousarray(cm)

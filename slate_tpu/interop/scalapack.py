@@ -1,0 +1,70 @@
+"""ScaLAPACK / LAPACK data interchange.
+
+Reference: Matrix::fromLAPACK (include/slate/Matrix.hh:58),
+Matrix::fromScaLAPACK (Matrix.hh:73) and the scalapack_api/ layer that
+wraps existing 2D block-cyclic buffers zero-copy
+(scalapack_api/scalapack_potrf.cc:94-110).
+
+On TPU zero-copy wrapping is impossible (data must be staged into HBM),
+so these are explicit converters: per-process block-cyclic local buffers
+(ScaLAPACK layout) ⇄ TiledMatrix. The strided host-side repacking runs in
+the native C++ library (native/layout.cc, OpenMP) with a numpy fallback.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.grid import ProcessGrid
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import GridOrder, MatrixKind, Uplo
+from . import native
+
+
+def from_lapack(a_colmajor: np.ndarray, nb: int, grid: Optional[ProcessGrid]
+                = None, **kw) -> TiledMatrix:
+    """Wrap a column-major (LAPACK) matrix (Matrix::fromLAPACK analog).
+
+    The lapack_api layer of the reference (lapack_api/lapack_slate.hh)
+    does exactly this conversion before dispatching to drivers."""
+    a = np.ascontiguousarray(np.asarray(a_colmajor).T).T  # row-major copy
+    return from_dense(np.ascontiguousarray(a), nb, grid=grid, **kw)
+
+
+def from_scalapack(locals_: List[np.ndarray], m: int, n: int, nb: int,
+                   p: int, q: int, grid: Optional[ProcessGrid] = None,
+                   order: GridOrder = GridOrder.Col, **kw) -> TiledMatrix:
+    """Assemble a TiledMatrix from per-process 2D block-cyclic local
+    buffers.
+
+    ``locals_[rank]`` is process rank's buffer as produced by
+    to_scalapack / ScaLAPACK (column-of-tiles-major, see
+    native/layout.cc); ranks are ordered column-major over the (p, q)
+    grid (BLACS default) unless order says otherwise."""
+    if len(locals_) != p * q:
+        raise ValueError(f"expected {p*q} local buffers, got {len(locals_)}")
+    out = np.zeros((m, n), np.float64)
+    for rank, loc in enumerate(locals_):
+        if order is GridOrder.Col:
+            pi, qi = rank % p, rank // p
+        else:
+            pi, qi = rank // q, rank % q
+        native.bc_unpack(loc, m, n, nb, p, q, pi, qi, out=out)
+    return from_dense(out, nb, grid=grid, **kw)
+
+
+def to_scalapack(A: TiledMatrix, p: int, q: int,
+                 order: GridOrder = GridOrder.Col) -> List[np.ndarray]:
+    """Split a TiledMatrix into per-process 2D block-cyclic local buffers
+    (the export direction of the scalapack_api)."""
+    a = A.to_numpy().astype(np.float64)
+    out = []
+    for rank in range(p * q):
+        if order is GridOrder.Col:
+            pi, qi = rank % p, rank // p
+        else:
+            pi, qi = rank // q, rank % q
+        out.append(native.bc_pack(a, A.nb, p, q, pi, qi))
+    return out

@@ -13,9 +13,12 @@ from .eig import heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf
 from .svd import svd, ge2tb, bdsqr
 from .condest import gecondest, pocondest, trcondest
 from .indefinite import hesv, hetrf, hetrs
+# Explicit submodule attributes (not just import side effects):
+from . import (band, blas3, cholesky, condest, eig, elementwise,
+               indefinite, lu, qr)
 # The driver function `svd` shadows the submodule attribute of the same
 # name (so `import slate_tpu.linalg.svd as m` would bind the *function*).
-# Expose an explicit module handle for internals like ge2tb back-ends:
+# Use this explicit module handle for internals like ge2tb back-ends:
 import sys as _sys
 svd_module = _sys.modules[__name__ + ".svd"]
 

@@ -251,10 +251,11 @@ def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
                          jnp.real(jnp.diagonal(bfull)))
         bfull = bfull.at[idx, idx].set(dpad.astype(bfull.dtype))
     # stage 2+3 on one device (gathered band, O(n*nb) information)
+    if not want_vectors:
+        w = jnp.linalg.eigvalsh(bfull)[:n]
+        return w / sigma, None
     w, zb = jnp.linalg.eigh(bfull)
     w = w[:n]
-    if not want_vectors:
-        return w / sigma, None
     z = unmtr_he2hb(vs, ts, zb[:, :n], nb, trans=False)
     Z = from_dense(z, nb, grid=A.grid, logical_shape=(n, n))
     return w / sigma, Z
@@ -263,17 +264,28 @@ def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
 @accurate_matmuls
 def hegst(A: TiledMatrix, L: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    """Reduce generalized A·x = λ·B·x to standard form: A ← L⁻¹·A·L⁻ᴴ
-    (itype 1; slate::hegst, src/hegst.cc)."""
+    """Reduce generalized A·x = λ·B·x to standard form (itype 1;
+    slate::hegst, src/hegst.cc): A ← L⁻¹·A·L⁻ᴴ for a Lower factor
+    (B = L·Lᴴ) or A ← U⁻ᴴ·A·U⁻¹ for an Upper factor (B = UᴴU)."""
     a = A.full_dense_canonical()
     n = A.shape[0]
     lmat = L.full_dense_canonical()
     lmat = unit_pad_diag(lmat, n, n)
-    x = jax.lax.linalg.triangular_solve(lmat, a, left_side=True, lower=True,
-                                        unit_diagonal=False)
-    y = jax.lax.linalg.triangular_solve(
-        jnp.conj(lmat), x, left_side=False, lower=True,
-        unit_diagonal=False, transpose_a=True)
+    lower = L.uplo is Uplo.Lower
+    if lower:
+        x = jax.lax.linalg.triangular_solve(
+            lmat, a, left_side=True, lower=True, unit_diagonal=False)
+        y = jax.lax.linalg.triangular_solve(
+            jnp.conj(lmat), x, left_side=False, lower=True,
+            unit_diagonal=False, transpose_a=True)
+    else:
+        # U⁻ᴴ·A: solve Uᴴ·X = A (upper factor, conj-transposed solve)
+        x = jax.lax.linalg.triangular_solve(
+            jnp.conj(lmat), a, left_side=True, lower=False,
+            unit_diagonal=False, transpose_a=True)
+        # (U⁻ᴴA)·U⁻¹: solve Y·U = X
+        y = jax.lax.linalg.triangular_solve(
+            lmat, x, left_side=False, lower=False, unit_diagonal=False)
     y = 0.5 * (y + jnp.conj(y).T)
     return from_dense(y, A.nb, grid=A.grid, kind=A.kind, uplo=Uplo.Lower,
                       logical_shape=(n, n))
@@ -281,15 +293,19 @@ def hegst(A: TiledMatrix, L: TiledMatrix,
 
 def hegv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
          want_vectors: bool = True
-         ) -> Tuple[Array, Optional[TiledMatrix]]:
+         ) -> Tuple[Array, Optional[TiledMatrix], Array]:
     """Generalized Hermitian-definite eigensolver (slate::hegv = potrf(B)
-    + hegst + heev + trsm back-transform)."""
+    + hegst + heev + trsm back-transform).
+
+    Returns (Lambda, X or None, info); info > 0 ⇔ B was not positive
+    definite (potrf's code, propagated like the reference)."""
     from .cholesky import potrf
     Lb, info = potrf(B, opts)
     As = hegst(A, Lb, opts)
     w, Z = heev(As, opts, want_vectors=want_vectors)
     if not want_vectors:
-        return w, None
-    # x = L⁻ᴴ·z
-    X = blas3.trsm(Side.Left, 1.0, Lb.H, Z, opts)
-    return w, X
+        return w, None, info
+    # x = L⁻ᴴ·z (Lower factor) or U⁻¹·z (Upper factor)
+    back = Lb.H if Lb.uplo is Uplo.Lower else Lb
+    X = blas3.trsm(Side.Left, 1.0, back, Z, opts)
+    return w, X, info

@@ -372,30 +372,43 @@ def gerbt(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS, seed: int = 0):
 
 def gesv_rbt(A: TiledMatrix, B: TiledMatrix,
              opts: Options = DEFAULT_OPTIONS) -> Tuple[TiledMatrix, Array]:
-    """Solve via RBT + no-pivot LU + one step of IR (slate::gesv_rbt,
-    src/gesv_rbt.cc): A = U·Ã·Vᵀ ⇒ X = V·Ã⁻¹·Uᵀ·B."""
+    """Solve via RBT + no-pivot LU + iterative refinement
+    (slate::gesv_rbt, src/gesv_rbt.cc: butterfly transform, no-pivot
+    factor, then refinement with fallback): A = U·Ã·Vᵀ ⇒
+    X = V·Ã⁻¹·Uᵀ·B."""
     At, (u, du), (v, dv) = gerbt(A, opts)
     LU, info = getrf_nopiv(At, opts)
-    b = B.dense_canonical()
     npad = LU.dense_canonical().shape[0]
-    if b.shape[0] < npad:
-        b = jnp.pad(b, ((0, npad - b.shape[0]), (0, 0)))
-    ub = _rbt_rows(b, u, du, transpose=True)
-    Bt = from_dense(ub, B.nb, logical_shape=(npad, B.shape[1]))
-    Y = getrs(LU, jnp.arange(npad, dtype=jnp.int32), Bt, opts)
-    y = Y.dense_canonical()[:npad]
-    x = _rbt_rows(y, v, dv, transpose=False)
-    X = from_dense(x[: B.shape[0]], B.nb, grid=B.grid, logical_shape=B.shape)
-    # one IR pass in working precision guards RBT's stability loss
-    R = blas3.gemm(-1.0, A, X, 1.0, B, opts)
-    rb = _rbt_rows(jnp.pad(R.dense_canonical(),
-                           ((0, npad - R.dense_canonical().shape[0]), (0, 0))
-                           ) if R.dense_canonical().shape[0] < npad
-                   else R.dense_canonical(), u, du, transpose=True)
-    Rt = from_dense(rb, B.nb, logical_shape=(npad, B.shape[1]))
-    D = getrs(LU, jnp.arange(npad, dtype=jnp.int32), Rt, opts)
-    d = _rbt_rows(D.dense_canonical()[:npad], v, dv, transpose=False)
-    X = X.with_data(X.dense_canonical() + d[: X.dense_canonical().shape[0]])
+    iota = jnp.arange(npad, dtype=jnp.int32)
+
+    def rbt_solve(rhs_mat: TiledMatrix) -> TiledMatrix:
+        rb = rhs_mat.dense_canonical()
+        if rb.shape[0] < npad:
+            rb = jnp.pad(rb, ((0, npad - rb.shape[0]), (0, 0)))
+        tb = _rbt_rows(rb, u, du, transpose=True)
+        Tb = from_dense(tb, B.nb, logical_shape=(npad, rhs_mat.shape[1]))
+        Y = getrs(LU, iota, Tb, opts)
+        x = _rbt_rows(Y.dense_canonical()[:npad], v, dv, transpose=False)
+        return from_dense(x[: B.shape[0]], B.nb, grid=B.grid,
+                          logical_shape=B.shape)
+
+    X = rbt_solve(B)
+    # iterative refinement in working precision guards the RBT/no-pivot
+    # stability loss (reference refines and falls back the same way)
+    anorm = norm(A, Norm.Inf)
+    eps = jnp.finfo(jnp.real(A.data).dtype).eps
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(A.shape[0]), anorm.dtype))
+    converged = False
+    for _ in range(opts.max_iterations + 1):
+        R = blas3.gemm(-1.0, A, X, 1.0, B, opts)
+        if bool(norm(R, Norm.Inf) <= norm(X, Norm.Inf) * cte):
+            converged = True
+            break
+        X = ew.add(1.0, rbt_solve(R), 1.0, X, opts)
+    if not converged and opts.use_fallback_solver:
+        # partial-pivot rescue (MethodLU.PartialPiv), reference fallback
+        LU2, perm2, info2 = getrf(A, opts.replace(method_lu=MethodLU.PartialPiv))
+        return getrs(LU2, perm2, B, opts), info2
     return X, info
 
 

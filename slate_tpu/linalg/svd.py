@@ -24,12 +24,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.exceptions import SlateError
-from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
-from ..core.types import MatrixKind, Options, Side, Uplo, DEFAULT_OPTIONS
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import Options, Side, DEFAULT_OPTIONS
 from ..core.precision import accurate_matmuls
 from .qr import (_apply_block_reflector, _apply_block_reflector_H, _larft,
-                 geqrf, qr_multiply_explicit, unmqr)
+                 geqrf, unmqr)
 
 Array = jax.Array
 

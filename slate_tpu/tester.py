@@ -1,0 +1,237 @@
+"""Parameter-sweep tester / benchmark driver.
+
+Reference: the `tester` binary built from test/ on TestSweeper
+(test/test.cc:116-260 registers ~90 routines; each test_xxx.cc declares
+sweep params, runs the call bracketed by barrier'd wall time, and reports
+time + model GFLOP/s + a residual self-check — SURVEY §4). The
+self-checks need no ScaLAPACK reference: probabilistic residual bounds
+(test/test_gemm.cc:135-279) — the property that lets our tester run
+anywhere a chip is.
+
+Usage:
+    python -m slate_tpu.tester --routine gemm,posv --n 512,1024 \
+        --nb 128 --p 1 --q 1 --dtype f32 [--iters 2] [--trace out.svg]
+
+Prints one table row per (routine, size) combination:
+routine, dims, nb, grid, seconds, GFLOP/s, error, status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _flops(routine: str, m, n, k):
+    if routine == "gemm":
+        return 2.0 * m * n * k
+    if routine in ("potrf", "posv"):
+        return n ** 3 / 3.0
+    if routine in ("getrf", "gesv", "hesv"):
+        return 2.0 * n ** 3 / 3.0
+    if routine in ("geqrf", "gels"):
+        return 2.0 * m * n * n - 2.0 * n ** 3 / 3.0
+    if routine == "heev":
+        return 4.0 * n ** 3 / 3.0
+    if routine == "svd":
+        return 8.0 * m * n * n / 3.0
+    return 0.0
+
+
+def run_one(routine: str, m: int, n: int, nb: int, grid, dtype, seed: int,
+            iters: int):
+    """Returns (seconds, gflops, error, ok)."""
+    import jax
+    import jax.numpy as jnp
+    import slate_tpu as st
+    from slate_tpu.core.types import Norm, Uplo
+    from slate_tpu.matgen import generate_matrix, random_spd
+
+    eps = float(jnp.finfo(dtype).eps)
+    k = n
+    nrhs = 8
+
+    def timed(fn):
+        out = fn()
+        jax.block_until_ready(out)
+        # force real completion (remote tunnels make block_until_ready
+        # unreliable): fetch one scalar
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = fn()
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            np.asarray(leaf).ravel()[:1]
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+
+    if routine == "gemm":
+        a = generate_matrix("randn", m, k, dtype, seed)
+        b = generate_matrix("randn", k, n, dtype, seed + 1)
+        A, B = st.from_dense(a, nb=nb, grid=grid), st.from_dense(b, nb=nb, grid=grid)
+        C = st.zeros(m, n, nb, dtype, grid=grid)
+        f = jax.jit(lambda: st.gemm(1.0, A, B, 0.0, C))
+        out, secs = timed(f)
+        x = np.asarray(generate_matrix("rands", n, nrhs, dtype, seed + 2))
+        lhs = out.to_numpy() @ x
+        rhs = np.asarray(a) @ (np.asarray(b) @ x)
+        err = np.linalg.norm(lhs - rhs) / max(np.linalg.norm(rhs), 1e-30)
+        ok = err < 3 * eps * max(m, n, k)
+    elif routine in ("potrf", "posv"):
+        a = random_spd(n, dtype=dtype, seed=seed)
+        A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid)
+        if routine == "potrf":
+            f = jax.jit(lambda: st.potrf(A)[0])
+            L, secs = timed(f)
+            l = np.tril(L.to_numpy())
+            err = np.linalg.norm(np.asarray(a) - l @ l.conj().T, 1) / (
+                np.linalg.norm(np.asarray(a), 1) * n * eps)
+        else:
+            b = generate_matrix("randn", n, nrhs, dtype, seed + 1)
+            B = st.from_dense(b, nb=nb, grid=grid)
+            f = jax.jit(lambda: st.posv(A, B)[0])
+            X, secs = timed(f)
+            x = X.to_numpy()
+            err = np.linalg.norm(np.asarray(b) - np.asarray(a) @ x, 1) / (
+                np.linalg.norm(np.asarray(a), 1) * np.linalg.norm(x, 1)
+                * n * eps)
+        ok = err < 10
+    elif routine in ("getrf", "gesv"):
+        a = generate_matrix("randn", n, n, dtype, seed)
+        A = st.from_dense(a, nb=nb, grid=grid)
+        b = generate_matrix("randn", n, nrhs, dtype, seed + 1)
+        B = st.from_dense(b, nb=nb, grid=grid)
+        f = jax.jit(lambda: st.gesv(A, B)[0])
+        X, secs = timed(f)
+        x = X.to_numpy()
+        err = np.linalg.norm(np.asarray(b) - np.asarray(a) @ x, 1) / (
+            np.linalg.norm(np.asarray(a), 1) * np.linalg.norm(x, 1) * n * eps)
+        ok = err < 60
+    elif routine in ("geqrf", "gels"):
+        a = generate_matrix("randn", m, n, dtype, seed)
+        A = st.from_dense(a, nb=nb, grid=grid)
+        if routine == "geqrf":
+            f = jax.jit(lambda: st.geqrf(A).vr)
+            _, secs = timed(f)
+            QR = st.geqrf(A)
+            Q = st.qr_multiply_explicit(QR)
+            q = Q.to_numpy()
+            r = np.triu(QR.r_matrix.to_numpy())
+            err = np.linalg.norm(np.asarray(a) - q @ r, 1) / (
+                np.linalg.norm(np.asarray(a), 1) * m * eps)
+        else:
+            b = generate_matrix("randn", m, nrhs, dtype, seed + 1)
+            B = st.from_dense(b, nb=nb, grid=grid)
+            f = jax.jit(lambda: st.gels(A, B).data)
+            _, secs = timed(f)
+            X = st.gels(A, B)
+            x = X.to_numpy()[:n]
+            # normal-equations residual: Aᵀ(AX − B) ≈ 0
+            rr = np.asarray(a).T @ (np.asarray(a) @ x - np.asarray(b))
+            err = np.linalg.norm(rr, 1) / (
+                np.linalg.norm(np.asarray(a), 1) ** 2
+                * max(np.linalg.norm(x, 1), 1e-30) * m * eps)
+        ok = err < 100
+    elif routine == "heev":
+        a = generate_matrix("heev_arith", n, n, dtype, seed, cond=100.0)
+        A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid)
+        f = jax.jit(lambda: st.heev(A)[0])
+        w, secs = timed(f)
+        w_ref = np.linalg.eigvalsh(np.asarray(a, np.float64))
+        err = np.abs(np.asarray(w) - w_ref).max() / (
+            max(abs(w_ref).max(), 1e-30) * n * eps)
+        ok = err < 200
+    elif routine == "svd":
+        a = generate_matrix("svd_geo", m, n, dtype, seed, cond=100.0)
+        A = st.from_dense(a, nb=nb, grid=grid)
+        f = jax.jit(lambda: st.svd(A)[0])
+        s, secs = timed(f)
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        err = np.abs(np.asarray(s) - s_ref).max() / (
+            s_ref[0] * max(m, n) * eps)
+        ok = err < 200
+    elif routine == "hesv":
+        a = generate_matrix("randn", n, n, dtype, seed)
+        a = (a + a.T) / 2
+        A = st.symmetric(jnp.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid)
+        b = generate_matrix("randn", n, nrhs, dtype, seed + 1)
+        B = st.from_dense(b, nb=nb, grid=grid)
+        f = jax.jit(lambda: st.hesv(A, B)[0])
+        X, secs = timed(f)
+        x = X.to_numpy()
+        err = np.linalg.norm(np.asarray(b) - np.asarray(a) @ x, 1) / (
+            np.linalg.norm(np.asarray(a), 1) * np.linalg.norm(x, 1) * n * eps)
+        ok = err < 1000
+    else:
+        raise ValueError(f"unknown routine {routine}")
+    gflops = _flops(routine, m, n, k) / secs / 1e9
+    return secs, gflops, float(err), bool(ok)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--routine", default="gemm,posv,gesv,gels")
+    ap.add_argument("--n", default="256,512")
+    ap.add_argument("--m", default=None, help="defaults to n")
+    ap.add_argument("--nb", type=int, default=64)
+    ap.add_argument("--p", type=int, default=1)
+    ap.add_argument("--q", type=int, default=1)
+    ap.add_argument("--dtype", default="f32",
+                    choices=["f32", "f64", "bf16"])
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--iters", type=int, default=1)
+    ap.add_argument("--trace", default=None, help="write SVG timeline")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+    from slate_tpu.core.grid import ProcessGrid
+    from slate_tpu.utils import trace as trace_mod
+
+    dtype = {"f32": jnp.float32, "f64": jnp.float64,
+             "bf16": jnp.bfloat16}[args.dtype]
+    grid = None
+    if args.p * args.q > 1:
+        grid = ProcessGrid.create(args.p, args.q)
+    if args.trace:
+        trace_mod.Trace.clear()
+        trace_mod.Trace.on()
+
+    routines = args.routine.split(",")
+    sizes = [int(s) for s in args.n.split(",")]
+    ms = [int(s) for s in args.m.split(",")] if args.m else sizes
+    hdr = (f"{'routine':<8} {'m':>6} {'n':>6} {'nb':>5} {'grid':>5} "
+           f"{'time(s)':>10} {'GFLOP/s':>10} {'error':>10} status")
+    print(hdr)
+    print("-" * len(hdr))
+    failures = 0
+    for routine in routines:
+        for m, n in zip(ms, sizes):
+            with trace_mod.Block(routine):
+                try:
+                    secs, gf, err, ok = run_one(
+                        routine, m, n, args.nb, grid, dtype, args.seed,
+                        args.iters)
+                except Exception as e:  # surface per-row, keep sweeping
+                    print(f"{routine:<8} {m:>6} {n:>6} {args.nb:>5} "
+                          f"{args.p}x{args.q:>3} {'-':>10} {'-':>10} "
+                          f"{'-':>10} ERROR: {e}")
+                    failures += 1
+                    continue
+            status = "pass" if ok else "FAILED"
+            failures += 0 if ok else 1
+            print(f"{routine:<8} {m:>6} {n:>6} {args.nb:>5} "
+                  f"{args.p}x{args.q:>3} {secs:>10.4f} {gf:>10.1f} "
+                  f"{err:>10.2e} {status}")
+    if args.trace:
+        trace_mod.Trace.off()
+        path = trace_mod.Trace.finish(args.trace)
+        print(f"# trace written to {path}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

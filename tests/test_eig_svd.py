@@ -92,8 +92,8 @@ def test_hegv():
     b = g @ g.T / n + np.eye(n)
     A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
     B = st.hermitian(np.tril(b), nb=8, uplo=Uplo.Lower)
-    w, X = st.hegv(A, B)
-    import scipy.linalg  # available via numpy? fall back to manual check
+    w, X, info = st.hegv(A, B)
+    assert int(info) == 0
     x = X.to_numpy()
     # generalized residual: A·x = λ·B·x
     res = np.linalg.norm(a @ x - (b @ x) * np.asarray(w)[None, :], 1)
@@ -175,3 +175,29 @@ def test_bdsqr():
     np.testing.assert_allclose(np.asarray(s),
                                np.linalg.svd(b, compute_uv=False),
                                rtol=1e-10, atol=1e-10)
+
+
+def test_hegv_upper_factor():
+    # B stored Upper -> potrf returns U; hegst/back-transform must handle it
+    n = 24
+    a = _herm(n, seed=15)
+    g = np.random.default_rng(16).standard_normal((n, n))
+    b = g @ g.T / n + np.eye(n)
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    B = st.hermitian(np.triu(b), nb=8, uplo=Uplo.Upper)
+    w, X, info = st.hegv(A, B)
+    assert int(info) == 0
+    x = X.to_numpy()
+    res = np.linalg.norm(a @ x - (b @ x) * np.asarray(w)[None, :], 1)
+    assert res / (np.linalg.norm(a, 1) * n) < 1e-10
+
+
+def test_hegv_not_pd_info():
+    n = 16
+    a = _herm(n, seed=17)
+    bad = np.eye(n)
+    bad[4, 4] = -2.0  # indefinite B
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    B = st.hermitian(np.tril(bad), nb=8, uplo=Uplo.Lower)
+    w, X, info = st.hegv(A, B)
+    assert int(info) == 5
