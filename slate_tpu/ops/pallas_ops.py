@@ -1,0 +1,98 @@
+"""Pallas TPU kernels for structure-aware hot ops.
+
+Reference analog: the hand-written batched device kernels of
+src/cuda/*.cu and the batched blas::batch::herk/syrk calls
+(src/internal/internal_herk.cc:351) — the reference avoids computing the
+upper triangle of Hermitian rank-k updates by batching only the
+lower-triangle tiles (device_regions_build). XLA has no triangular
+matmul, so a plain jnp herk computes the FULL product and masks — 2× the
+FLOPs of the update that dominates potrf/hetrf/he2hb.
+
+``herk_lower_update`` restores the saving: a scalar-prefetch Pallas grid
+enumerates only the nt·(nt+1)/2 lower tile pairs (i ≥ j) and computes
+C[i,j] −= A[i]·A[j]ᴴ per block on the MXU at full f32 precision;
+untouched (upper) blocks alias through from the input. Used by
+cholesky._potrf_blocked and blas3.herk when shapes/dtype/backend allow;
+callers fall back to the jnp path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MIN_BLOCK = 128  # MXU-friendly tile edge; also the lane dimension
+
+
+def herk_eligible(n: int, k: int, dtype, block: int) -> bool:
+    """Can the Pallas path run? TPU backend, real f32/bf16, divisible
+    shapes, at least 2 tile rows (otherwise there is nothing to save)."""
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    if backend != "tpu":
+        return False
+    if dtype not in (jnp.float32.dtype, jnp.bfloat16.dtype,
+                     np.dtype("float32"), np.dtype("bfloat16")):
+        return False
+    return (n >= 2 * block and n % block == 0 and k % _MIN_BLOCK == 0
+            and block % _MIN_BLOCK == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _herk_lower_call(c, a, ii, jj, block: int):
+    n = c.shape[0]
+    k = a.shape[1]
+    npairs = ii.shape[0]
+
+    def kernel(ii_ref, jj_ref, ai_ref, aj_ref, cin_ref, out_ref):
+        prod = jax.lax.dot_general(
+            ai_ref[:], aj_ref[:], (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        out_ref[:] = cin_ref[:] - prod.astype(out_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(npairs,),
+        in_specs=[
+            pl.BlockSpec((block, k), lambda t, ii, jj: (ii[t], 0)),
+            pl.BlockSpec((block, k), lambda t, ii, jj: (jj[t], 0)),
+            pl.BlockSpec((block, block), lambda t, ii, jj: (ii[t], jj[t])),
+        ],
+        out_specs=pl.BlockSpec((block, block),
+                               lambda t, ii, jj: (ii[t], jj[t])),
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, n), c.dtype),
+        input_output_aliases={4: 0},  # C aliases (indices count scalars)
+    )
+    return fn(ii, jj, a, a, c)
+
+
+def herk_lower_update(c: jax.Array, a: jax.Array,
+                      block: int = None) -> jax.Array:
+    """C ← C − A·Aᵀ on the lower tile triangle only (real dtypes).
+
+    Strictly-upper blocks of C pass through unchanged; entries above the
+    diagonal *within* diagonal blocks ARE updated (harmless for callers
+    that only read the lower triangle, as potrf does)."""
+    n = c.shape[0]
+    k = a.shape[1]
+    block = block or max(_MIN_BLOCK, min(512, k))
+    if not herk_eligible(n, k, c.dtype, block):
+        return c - jax.lax.dot_general(
+            a, a, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)
+    nt = n // block
+    pairs = [(i, j) for i in range(nt) for j in range(i + 1)]
+    ii = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    jj = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    return _herk_lower_call(c, a, ii, jj, block)
