@@ -1,0 +1,69 @@
+"""Perf probes: gemm rate per precision; builtin cholesky; potrf variants."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+import bench
+
+n = 4096 if len(sys.argv) < 2 else int(sys.argv[1])
+nb = 512
+
+
+def probe_gemm(prec):
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.float32) / n**0.5
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.float32)
+
+    def step(c, cs):
+        (a,) = cs
+        with jax.default_matmul_precision(prec):
+            return a @ c
+
+    t = bench._per_iter_seconds(step, b, (a,))
+    return 2 * n**3 / 1e9 / t, t
+
+
+def probe_chol_builtin():
+    from slate_tpu.matgen import random_spd
+    a = random_spd(n, dtype=jnp.float32, seed=3)
+
+    def step(x, cs):
+        (a,) = cs
+        l = jnp.linalg.cholesky(a + 0e0 * x)
+        return a + 1e-30 * l
+
+    t = bench._per_iter_seconds(step, a, (a,), k1=2, k2=6)
+    return (n**3 / 3) / 1e9 / t, t
+
+
+def probe_potrf(prec):
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+    from slate_tpu.matgen import random_spd
+    a = random_spd(n, dtype=jnp.float32, seed=3)
+    A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower)
+    from slate_tpu.linalg.cholesky import _potrf_blocked
+
+    def step(a_data, cs):
+        with jax.default_matmul_precision(prec):
+            l, info = _potrf_blocked(a_data, nb, n // nb)
+        return a_data + 1e-30 * l
+
+    t = bench._per_iter_seconds(step, A.data, (), k1=2, k2=6)
+    return (n**3 / 3) / 1e9 / t, t
+
+
+which = sys.argv[2] if len(sys.argv) > 2 else "all"
+if which in ("all", "gemm"):
+    for prec in ("default", "high", "highest"):
+        g, t = probe_gemm(prec)
+        print(f"gemm    n={n} prec={prec:8s}: {g:10.1f} GFLOP/s ({t*1e3:.2f} ms)")
+if which in ("all", "chol"):
+    g, t = probe_chol_builtin()
+    print(f"chol-builtin n={n}:            {g:10.1f} GFLOP/s ({t*1e3:.2f} ms)")
+if which in ("all", "potrf"):
+    for prec in ("default", "high", "highest"):
+        g, t = probe_potrf(prec)
+        print(f"potrf   n={n} prec={prec:8s}: {g:10.1f} GFLOP/s ({t*1e3:.2f} ms)")

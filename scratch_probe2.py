@@ -1,0 +1,67 @@
+"""Probe: triangular_solve rate, panel qr/lu rates on TPU."""
+import sys
+import jax
+import jax.numpy as jnp
+import bench
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+nb = 512
+
+
+def probe_trsm(prec, c=None):
+    c = c or n
+    l = jnp.tril(jax.random.normal(jax.random.key(0), (c, c), jnp.float32)) \
+        + 10.0 * jnp.eye(c, dtype=jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (n, c), jnp.float32)
+
+    def step(x, cs):
+        l, b = cs
+        with jax.default_matmul_precision(prec):
+            y = jax.lax.linalg.triangular_solve(
+                jnp.conj(l), b + 1e-20 * x, left_side=False, lower=True,
+                transpose_a=True)
+        return y
+
+    t = bench._per_iter_seconds(step, b, (l, b), k1=2, k2=6)
+    return n * c * c / 1e9 / t, t
+
+
+def probe_qr_panel(h):
+    a = jax.random.normal(jax.random.key(0), (h, nb), jnp.float32)
+
+    def step(x, cs):
+        (a,) = cs
+        with jax.default_matmul_precision("highest"):
+            ht, taus = jnp.linalg.qr(a + 1e-20 * x, mode="raw")
+        return a + 1e-30 * ht.T
+
+    t = bench._per_iter_seconds(step, a, (a,), k1=2, k2=4)
+    return t
+
+
+def probe_lu_panel(h):
+    a = jax.random.normal(jax.random.key(0), (h, nb), jnp.float32)
+
+    def step(x, cs):
+        (a,) = cs
+        with jax.default_matmul_precision("highest"):
+            lu, piv, perm = jax.lax.linalg.lu(a + 1e-20 * x)
+        return a + 1e-30 * lu
+
+    t = bench._per_iter_seconds(step, a, (a,), k1=2, k2=4)
+    return t
+
+
+which = sys.argv[2] if len(sys.argv) > 2 else "all"
+if which in ("all", "trsm"):
+    for prec in ("high", "highest"):
+        g, t = probe_trsm(prec)
+        print(f"trsm n={n} c={n} prec={prec}: {g:9.1f} GFLOP/s ({t*1e3:.2f} ms)")
+    g, t = probe_trsm("highest", c=nb)
+    print(f"trsm n={n} c={nb} (panel): {g:9.1f} GFLOP/s ({t*1e3:.3f} ms)")
+if which in ("all", "panels"):
+    for h in (4096, 16384):
+        t = probe_qr_panel(h)
+        print(f"qr raw panel ({h}x{nb}): {t*1e3:.2f} ms")
+        t = probe_lu_panel(h)
+        print(f"lu panel     ({h}x{nb}): {t*1e3:.2f} ms")

@@ -1,0 +1,69 @@
+"""Probe new factorization drivers on TPU: potrf/getrf/geqrf rates."""
+import sys
+import time
+import jax
+import jax.numpy as jnp
+import bench
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+nb = 512
+which = sys.argv[2] if len(sys.argv) > 2 else "all"
+
+
+def probe_potrf():
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+    from slate_tpu.matgen import random_spd
+    from slate_tpu.linalg.cholesky import _potrf_blocked
+    a = random_spd(n, dtype=jnp.float32, seed=3)
+
+    def step(a_data, cs):
+        with jax.default_matmul_precision("highest"):
+            l, info = _potrf_blocked(a_data, nb, n // nb, prec="high")
+        return a_data + 1e-30 * l
+
+    t0 = time.perf_counter()
+    t = bench._per_iter_seconds(step, a, (), k1=2, k2=6)
+    print(f"potrf  n={n}: {(n**3/3)/1e9/t:9.1f} GFLOP/s ({t*1e3:.2f} ms) "
+          f"[probe wall {time.perf_counter()-t0:.0f}s]")
+
+
+def probe_getrf():
+    from slate_tpu.linalg.lu import _getrf_blocked
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.float32) \
+        + n * jnp.eye(n, dtype=jnp.float32) * 0  # general matrix
+
+    def step(a_data, cs):
+        with jax.default_matmul_precision("highest"):
+            lu, perm, info = _getrf_blocked(a_data, nb, n // nb, prec="high")
+        return a_data + 1e-30 * lu
+
+    t0 = time.perf_counter()
+    t = bench._per_iter_seconds(step, a, (), k1=2, k2=6)
+    print(f"getrf  n={n}: {(2*n**3/3)/1e9/t:9.1f} GFLOP/s ({t*1e3:.2f} ms) "
+          f"[probe wall {time.perf_counter()-t0:.0f}s]")
+
+
+def probe_geqrf():
+    import slate_tpu as st
+
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.float32)
+    A = st.from_dense(a, nb=nb)
+
+    def step(a_data, cs):
+        (A,) = cs
+        qr = st.geqrf(A.with_data(a_data))
+        return a_data + 1e-30 * qr.vr
+
+    t0 = time.perf_counter()
+    t = bench._per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
+    print(f"geqrf  n={n}: {(4*n**3/3)/1e9/t:9.1f} GFLOP/s ({t*1e3:.2f} ms) "
+          f"[probe wall {time.perf_counter()-t0:.0f}s]")
+
+
+if which in ("all", "potrf"):
+    probe_potrf()
+if which in ("all", "getrf"):
+    probe_getrf()
+if which in ("all", "geqrf"):
+    probe_geqrf()

@@ -204,6 +204,13 @@ class Options:
     use_fallback_solver: bool = True
     pivot_threshold: float = 1.0
     depth: int = 2  # RBT butterfly depth
+    # Matmul precision for the large trailing-update gemms of the
+    # factorization drivers. On TPU "high" = bf16x3 passes (≈ f32-accurate,
+    # 2× the "highest" rate, measured 60.7 vs 30.7 TFLOP/s on v5e); panel
+    # and reflector math always runs at "highest" (core/precision.py).
+    # No analog in the reference (cuBLAS runs native fp64); closest is
+    # the gemm-autotuning Target/Method machinery.
+    update_precision: str = "high"
     # Method selection (P10):
     method_gemm: MethodGemm = MethodGemm.Auto
     method_trsm: MethodTrsm = MethodTrsm.Auto

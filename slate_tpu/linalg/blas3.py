@@ -42,7 +42,7 @@ from ..core.tiled_matrix import (TiledMatrix, from_dense,
                                  unit_pad_diag)
 from ..core.types import (Diag, MatrixKind, MethodGemm, Options, Side, Uplo,
                           DEFAULT_OPTIONS)
-from ..ops import tile_ops
+from ..ops import blocked, tile_ops
 
 
 def _wrap_like(c: TiledMatrix, data: jax.Array) -> TiledMatrix:
@@ -186,10 +186,12 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     """Solve op(A)·X = α·B (Left) or X·op(A) = α·B for X, A triangular.
 
     Reference: slate::trsm (src/trsm.cc, work::trsm src/work/work_trsm.cc:
-    96-140 — block-column loop with panel bcasts and lookahead). Here one
-    XLA triangular_solve over the padded storage: XLA lowers it to a
-    blocked, MXU-friendly algorithm, and under GSPMD partitions the update
-    gemms. The padded diagonal is set to 1 so padding solves to zero."""
+    96-140 — block-column loop with panel bcasts and lookahead). Here a
+    gemm-based block recursion (ops/blocked.trsm_rec — XLA's own
+    triangular_solve is latency-bound and ~5× below the gemm rate on TPU;
+    the inverted-diagonal-block scheme matches what cuBLAS does for the
+    reference). The padded diagonal is set to 1 so padding solves to
+    zero."""
     if A.kind not in (MatrixKind.Triangular, MatrixKind.TriangularBand):
         raise SlateError("trsm: A must be triangular")
     uplo = A.uplo
@@ -199,11 +201,13 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     # unit-pad the diagonal so the padded system is nonsingular
     a = unit_pad_diag(a, A.shape[0], A.shape[1])
     b = B.dense_canonical()
-    x = jax.lax.linalg.triangular_solve(
+    x = blocked.trsm_rec(
         a, alpha * b,
-        left_side=(side is Side.Left),
+        left=(side is Side.Left),
         lower=(uplo is Uplo.Lower),
-        unit_diagonal=(A.diag is Diag.Unit))
+        unit=(A.diag is Diag.Unit),
+        prec=opts.update_precision,
+        base=min(A.nb, a.shape[0]))
     return _wrap_like(B, x)
 
 

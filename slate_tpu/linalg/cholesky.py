@@ -41,7 +41,7 @@ from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, Norm, Options, Side, Uplo,
                           DEFAULT_OPTIONS)
 from ..core.precision import accurate_matmuls
-from ..ops import tile_ops
+from ..ops import blocked, tile_ops
 from . import blas3
 from . import elementwise as ew
 from .elementwise import copy as copy_matrix
@@ -75,35 +75,55 @@ def _chol_info_scan(a: jax.Array) -> jax.Array:
     return info
 
 
-def _potrf_blocked(a: jax.Array, nb: int, nt: int):
-    """Right-looking blocked Cholesky on padded dense (lower).
+@jax.jit
+def _tile_chol(akk: jax.Array):
+    """Factor one diagonal tile + its LAPACK info (jit-cached: one
+    compilation per tile shape, many call sites). Uses the ib-blocked
+    tile Cholesky (blocked.chol_tile_blocked) — ~5× less sequential
+    latency than lax.linalg.cholesky's column recurrence."""
+    lkk = blocked.chol_tile_blocked(akk)
+    tile_failed = jnp.any(jnp.isnan(jnp.diagonal(lkk)))
+    tile_info = jax.lax.cond(
+        tile_failed, lambda: _chol_info_scan(akk),
+        lambda: jnp.zeros((), jnp.int32))
+    return lkk, tile_info
 
-    Returns (tril factor, info). Unlike LAPACK we do not stop at the
-    first failure (data-dependent early exit is not jit-able); NaNs
-    propagate through later steps and ``info`` reports the first failing
-    1-based global index, matching the reference's reduce_info semantics."""
-    info = jnp.zeros((), jnp.int32)
-    for k in range(nt):
-        k0, k1 = k * nb, (k + 1) * nb
-        akk = a[k0:k1, k0:k1]
-        lkk = tile_ops.potrf(akk, Uplo.Lower)
-        tile_failed = jnp.any(jnp.isnan(jnp.diagonal(lkk)))
-        tile_info = jax.lax.cond(
-            tile_failed, lambda t=akk: _chol_info_scan(t),
-            lambda: jnp.zeros((), jnp.int32))
-        info = jnp.where((info == 0) & (tile_info > 0), k0 + tile_info, info)
-        a = a.at[k0:k1, k0:k1].set(lkk)
-        if k1 < a.shape[0]:
-            panel = a[k1:, k0:k1]
-            # panel ← panel · L[k,k]^-H  (Right/Lower/ConjTrans trsm)
-            panel = jax.lax.linalg.triangular_solve(
-                jnp.conj(lkk), panel, left_side=False, lower=True,
-                unit_diagonal=False, transpose_a=True)
-            a = a.at[k1:, k0:k1].set(panel)
-            # trailing Hermitian update (one MXU matmul)
-            trail = a[k1:, k1:] - panel @ jnp.conj(panel).T
-            a = a.at[k1:, k1:].set(trail)
-    return jnp.tril(a), info
+
+def _potrf_rec(a: jax.Array, nb: int, prec):
+    """Recursive blocked Cholesky on padded dense (lower).
+
+    TPU redesign of the reference's panel/trailing task DAG
+    (src/potrf.cc:84-195): a 2×2 static-shape recursion whose flops live
+    in large MXU matmuls — gemm-based trsm (blocked.trsm_rec: XLA's
+    triangular_solve is 5× slower, see ops/blocked.py) and a
+    triangle-aware rank-k update (blocked.herk_lower_rec — the analog of
+    internal::herk's halved flops, src/internal/internal_herk.cc:351).
+    Trailing gemms run at ``prec``; panel/tile math at the caller's
+    HIGHEST context. Returns (factor with garbage above diag, info);
+    unlike LAPACK there is no early exit (not jit-able) — NaNs propagate
+    and info reports the first failing 1-based index (reduce_info
+    semantics, src/potrf.cc:208)."""
+    s = a.shape[0]
+    if s <= nb:
+        return _tile_chol(a)
+    h = blocked._half(s, nb)
+    l11, i1 = _potrf_rec(a[:h, :h], nb, prec)
+    l21 = blocked.trsm_rec(l11, a[h:, :h], left=False, lower=True,
+                           conj_a=True, trans_a=True, prec=prec, base=nb)
+    a22 = blocked.herk_lower_rec(a[h:, h:], l21, prec=prec)
+    l22, i2 = _potrf_rec(a22, nb, prec)
+    out = jnp.concatenate([
+        jnp.concatenate([l11, a[:h, h:]], axis=1),
+        jnp.concatenate([l21, l22], axis=1)], axis=0)
+    info = jnp.where(i1 > 0, i1,
+                     jnp.where(i2 > 0, i2 + h, 0)).astype(jnp.int32)
+    return out, info
+
+
+def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high"):
+    """Blocked Cholesky on padded dense (lower) → (tril factor, info)."""
+    out, info = _potrf_rec(a, nb, prec=prec)
+    return jnp.tril(out), info
 
 
 @accurate_matmuls
@@ -122,7 +142,7 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     a = A.full_dense_canonical()
     a = unit_pad_diag(a, n, n)
     nt = A.mt
-    lower, info = _potrf_blocked(a, nb, nt)
+    lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision)
     if A.uplo is Uplo.Upper:
         out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
                          kind=MatrixKind.Triangular, uplo=Uplo.Upper,

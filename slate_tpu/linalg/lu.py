@@ -38,6 +38,7 @@ from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, MethodLU, Norm, Options, Side,
                           Uplo, DEFAULT_OPTIONS)
 from ..core.precision import accurate_matmuls
+from ..ops import blocked
 from . import blas3
 from . import elementwise as ew
 from .norms import norm
@@ -57,42 +58,61 @@ _pad_identity_diag = unit_pad_diag
 # partial-pivot LU
 # ---------------------------------------------------------------------------
 
-def _getrf_blocked(a: Array, nb: int, nt: int):
-    """Blocked right-looking partial-pivot LU on padded dense.
+def _getrf_rec(a: Array, nb: int, prec):
+    """Recursive blocked partial-pivot LU on an (M × W) column block,
+    W ≤ M, recursing on width down to nb-wide panels.
 
-    Returns (lu, perm, info): lu holds unit-L below / U on-and-above the
-    diagonal; perm is the accumulated row permutation (A[perm] = L·U)."""
-    m = a.shape[0]
-    perm = jnp.arange(m, dtype=jnp.int32)
-    info = jnp.zeros((), jnp.int32)
-    for k in range(nt):
-        k0, k1 = k * nb, min((k + 1) * nb, a.shape[1])
-        panel = a[k0:, k0:k1]
-        # panel factorization (internal::getrf_panel analog): LU with
-        # partial pivot on the tall panel, pivot search fused on device
-        lu_p, _, p_perm = jax.lax.linalg.lu(panel)
-        # apply the panel's row permutation to the whole trailing row
-        # block, including the L-panels to the left (LAPACK laswp)
-        a = a.at[k0:, :].set(a[k0:, :][p_perm])
-        perm = perm.at[k0:].set(perm[k0:][p_perm])
-        a = a.at[k0:, k0:k1].set(lu_p)
-        # first failing pivot in this panel (reduce_info analog)
-        dpan = jnp.abs(jnp.diagonal(lu_p))
-        bad = jnp.isnan(dpan) | (dpan == 0)
-        pinfo = jnp.where(jnp.any(bad),
-                          jnp.argmax(bad).astype(jnp.int32) + 1, 0)
-        info = jnp.where((info == 0) & (pinfo > 0), k0 + pinfo, info)
-        if k1 < a.shape[1]:
-            lkk = a[k0:k1, k0:k1]
-            # U row block: L_kk^{-1} · A[k, k+1:]
-            urow = jax.lax.linalg.triangular_solve(
-                lkk, a[k0:k1, k1:], left_side=True, lower=True,
-                unit_diagonal=True)
-            a = a.at[k0:k1, k1:].set(urow)
-            # trailing update — ONE MXU matmul per step
-            trail = a[k1:, k1:] - a[k1:, k0:k1] @ urow
-            a = a.at[k1:, k1:].set(trail)
-    return a, perm, info
+    TPU redesign of the reference's panel + lookahead + trailing task DAG
+    (src/getrf.cc:81-160): the multi-threaded panel with MPI MAXLOC pivot
+    search (internal_getrf.cc:64-119) becomes blocked.panel_getrf — a
+    width-recursion whose base is an ib-column fori_loop, heights
+    bucketed to powers of two so only O(log nt) panel shapes compile
+    (lax.linalg.lu is both latency-bound and fails VMEM on tall v5e
+    panels, see ops/blocked.py). The fine-grained row swaps
+    (internal_swap.cc:503-560 batches them on GPUs) become bounded
+    gather/scatter of the ≤2·width displaced rows
+    (blocked.permute_rows_limited).
+
+    Returns (lu, perm, info) with gather semantics a[perm] = L·U;
+    perm length M, info 1-based first zero pivot."""
+    m, w = a.shape
+    if w <= nb:
+        hb = blocked.bucket_pow2(m, nb)
+        ap = jnp.pad(a, ((0, hb - m), (0, 0))) if hb > m else a
+        lu, perm, info = blocked.panel_getrf_jit(ap)
+        return lu[:m], perm[:m], info
+    h = blocked._half(w, nb)
+    lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec)
+    right = blocked.permute_rows_limited(a[:, h:], p1, 2 * h)
+    # U12 = L11⁻¹ · A12 (unit-lower block solve, gemm-based)
+    u_top = blocked.trsm_rec(lu1[:h, :h], right[:h], left=True, lower=True,
+                             unit=True, prec=prec, base=min(nb, h))
+    schur = right[h:] - blocked.mm(lu1[h:, :h], u_top, prec)
+    lu2, p2, i2 = _getrf_rec(schur, nb, prec)
+    low_left = blocked.permute_rows_limited(lu1[h:, :h], p2, 2 * (w - h))
+    lu = jnp.concatenate([
+        jnp.concatenate([lu1[:h], u_top], axis=1),
+        jnp.concatenate([low_left, lu2], axis=1)], axis=0)
+    perm = blocked._compose_tail(p1, p2, h)
+    info = jnp.where(i1 > 0, i1,
+                     jnp.where(i2 > 0, i2 + h, 0)).astype(jnp.int32)
+    return lu, perm, info
+
+
+def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high"):
+    """Blocked partial-pivot LU on padded dense (possibly rectangular).
+
+    Factors the leading min(m,n) columns recursively; for wide matrices
+    the remaining U columns get one block solve + no further pivoting."""
+    m, n = a.shape
+    k = min(m, n)
+    lu, perm, info = _getrf_rec(a[:, :k], nb, prec)
+    if n > k:
+        rest = blocked.permute_rows_limited(a[:, k:], perm, 2 * k)
+        u_rest = blocked.trsm_rec(lu[:, :k], rest, left=True, lower=True,
+                                  unit=True, prec=prec, base=nb)
+        lu = jnp.concatenate([lu, u_rest], axis=1)
+    return lu, perm, info
 
 
 @accurate_matmuls
@@ -111,7 +131,8 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     m, n = A.shape
     a = _canonical(A)
     a = _pad_identity_diag(a, m, n)
-    lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt))
+    lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
+                                    prec=opts.update_precision)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 
@@ -265,19 +286,18 @@ def getrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
         if pad < 0:
             raise SlateError("getrs: rhs taller than factor")
         b = jnp.pad(b, ((0, pad), (0, 0)))
+    prec = opts.update_precision
     if not trans:
         pb = b[perm]
-        y = jax.lax.linalg.triangular_solve(
-            lu, pb, left_side=True, lower=True, unit_diagonal=True)
-        x = jax.lax.linalg.triangular_solve(
-            lu, y, left_side=True, lower=False, unit_diagonal=False)
+        y = blocked.trsm_rec(lu, pb, left=True, lower=True, unit=True,
+                             prec=prec, base=LU.nb)
+        x = blocked.trsm_rec(lu, y, left=True, lower=False, unit=False,
+                             prec=prec, base=LU.nb)
     else:
-        z = jax.lax.linalg.triangular_solve(
-            lu, b, left_side=True, lower=False, unit_diagonal=False,
-            transpose_a=True)
-        w = jax.lax.linalg.triangular_solve(
-            lu, z, left_side=True, lower=True, unit_diagonal=True,
-            transpose_a=True)
+        z = blocked.trsm_rec(lu, b, left=True, lower=False, unit=False,
+                             trans_a=True, prec=prec, base=LU.nb)
+        w = blocked.trsm_rec(lu, z, left=True, lower=True, unit=True,
+                             trans_a=True, prec=prec, base=LU.nb)
         x = jnp.zeros_like(w).at[perm].set(w)
     x = x[: B.dense_canonical().shape[0]]
     return from_dense(x, B.nb, grid=B.grid, logical_shape=B.shape)

@@ -40,6 +40,7 @@ from ..core.tiled_matrix import (TiledMatrix, from_dense, triangular,
 from ..core.types import (Diag, MatrixKind, MethodGels, Norm, Options, Side,
                           Uplo, DEFAULT_OPTIONS)
 from ..core.precision import accurate_matmuls
+from ..ops import blocked
 from . import blas3
 from .cholesky import potrf
 from .norms import norm
@@ -81,33 +82,21 @@ class QRFactors:
                           uplo=Uplo.Upper, logical_shape=(k, self.n))
 
 
-def _larft(v: Array, taus: Array) -> Array:
-    """Forward (columnwise) T from reflectors: the lapack larft recurrence
-    T[:i,i] = −τᵢ·T[:i,:i]·(Vᴴvᵢ), T[i,i] = τᵢ. One Gram matmul + an
-    nb-step fori_loop."""
-    nbb = taus.shape[0]
-    w = jnp.conj(v).T @ v  # (nb, nb) Gram; rows<i of col i give Vᴴ·vᵢ
-    idx = jnp.arange(nbb)
-
-    def body(i, t):
-        wi = jnp.where(idx < i, w[:, i], 0)
-        col = -taus[i] * (t @ wi)
-        col = jnp.where(idx < i, col, 0)
-        col = col.at[i].set(taus[i].astype(col.dtype))
-        return t.at[:, i].set(col)
-
-    t0 = jnp.zeros((nbb, nbb), v.dtype)
-    return jax.lax.fori_loop(0, nbb, body, t0)
+_larft = blocked.larft
 
 
-def _apply_block_reflector_H(v: Array, t: Array, c: Array) -> Array:
+def _apply_block_reflector_H(v: Array, t: Array, c: Array,
+                             prec=None) -> Array:
     """C ← (I − V·T·Vᴴ)ᴴ·C = C − V·Tᴴ·(Vᴴ·C)  (Qᴴ·C, larfb analog)."""
-    return c - v @ (jnp.conj(t).T @ (jnp.conj(v).T @ c))
+    mm = blocked.mm
+    return c - mm(v, mm(jnp.conj(t).T, mm(jnp.conj(v).T, c, prec)), prec)
 
 
-def _apply_block_reflector(v: Array, t: Array, c: Array) -> Array:
+def _apply_block_reflector(v: Array, t: Array, c: Array,
+                           prec=None) -> Array:
     """C ← (I − V·T·Vᴴ)·C = C − V·T·(Vᴴ·C)  (Q·C)."""
-    return c - v @ (t @ (jnp.conj(v).T @ c))
+    mm = blocked.mm
+    return c - mm(v, mm(t, mm(jnp.conj(v).T, c, prec)), prec)
 
 
 # single shared implementation in core (review: was quadruplicated)
@@ -116,9 +105,18 @@ _pad_identity_diag = unit_pad_diag
 
 @accurate_matmuls
 def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
-    """Blocked Householder QR: A = Q·R (slate::geqrf, src/geqrf.cc)."""
+    """Blocked Householder QR: A = Q·R (slate::geqrf, src/geqrf.cc).
+
+    Panels are factored by blocked.panel_geqrf_with_t (the TPU analog of
+    the reference's gather-panel-to-device + lapack::geqrf trick,
+    internal_geqrf.cc:235-254; XLA's own QR expansion costs ~25 ms per
+    panel). Panel heights are bucketed to powers of two — zero rows below
+    a panel are inert for Householder QR — so only O(log nt) panel
+    shapes compile. Trailing updates are two large MXU gemms per panel
+    at opts.update_precision."""
     m, n = A.shape
     nb = A.nb
+    prec = opts.update_precision
     a = A.dense_canonical()
     a = _pad_identity_diag(a, m, n)
     mpad, npad = a.shape
@@ -127,23 +125,24 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     for k in range(kt):
         k0, k1 = k * nb, min((k + 1) * nb, npad)
         w = k1 - k0
+        rows = mpad - k0
+        hb = blocked.bucket_pow2(rows, nb)
         panel = a[k0:, k0:k1]
-        # packed Householder (LAPACK geqrf layout); mode="raw" returns the
-        # transposed packed factor
-        h_t, taus = jnp.linalg.qr(panel, mode="raw")
-        qr_packed = h_t.T
-        v = jnp.tril(qr_packed, -1)
+        if hb > rows:
+            panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
+        vr, taus, t = blocked.panel_geqrf_with_t(panel)
+        vr = vr[:rows]
+        v = jnp.tril(vr, -1)
         v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
-        t = _larft(v, taus)
         if w < nb:  # ragged final panel: embed into (nb, nb)
             t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
         ts.append(t)
         # store R rows + V below diagonal
-        a = a.at[k0:, k0:k1].set(jnp.triu(qr_packed) + v -
-                                 jnp.eye(panel.shape[0], w, dtype=a.dtype))
+        a = a.at[k0:, k0:k1].set(jnp.triu(vr) + v -
+                                 jnp.eye(rows, w, dtype=a.dtype))
         if k1 < npad:
             a = a.at[k0:, k1:].set(
-                _apply_block_reflector_H(v, t[:w, :w], a[k0:, k1:]))
+                _apply_block_reflector_H(v, t[:w, :w], a[k0:, k1:], prec))
     t_all = jnp.stack(ts) if ts else jnp.zeros((0, nb, nb), a.dtype)
     return QRFactors(a, t_all, m, n, nb)
 
@@ -167,6 +166,7 @@ def unmqr(side: Side, QR: QRFactors, C: TiledMatrix, trans: bool = False,
             c = jnp.pad(c, ((0, 0), (0, mpad - c.shape[1])))
     # Q = H_0·H_1·…·H_{kt−1} (block reflectors). Qᴴ·C applies forward,
     # Q·C applies backward.
+    prec = opts.update_precision
     order = range(kt) if trans else range(kt - 1, -1, -1)
     for k in order:
         k0 = k * nb
@@ -177,18 +177,18 @@ def unmqr(side: Side, QR: QRFactors, C: TiledMatrix, trans: bool = False,
         t = QR.t[k][:w, :w]
         if side is Side.Left:
             blk = c[k0:, :]
-            blk = _apply_block_reflector_H(v, t, blk) if trans \
-                else _apply_block_reflector(v, t, blk)
+            blk = _apply_block_reflector_H(v, t, blk, prec) if trans \
+                else _apply_block_reflector(v, t, blk, prec)
             c = c.at[k0:, :].set(blk)
         else:
             # C·Q = (Qᴴ·Cᴴ)ᴴ
             blk = c[:, k0:]
             if trans:  # C·Qᴴ = (Q·Cᴴ)ᴴ
                 blk = jnp.conj(_apply_block_reflector(
-                    v, t, jnp.conj(blk).T)).T
+                    v, t, jnp.conj(blk).T, prec)).T
             else:
                 blk = jnp.conj(_apply_block_reflector_H(
-                    v, t, jnp.conj(blk).T)).T
+                    v, t, jnp.conj(blk).T, prec)).T
             c = c.at[:, k0:].set(blk)
     out_shape = C.shape
     c = c[: -(-out_shape[0] // nb) * nb, : -(-out_shape[1] // nb) * nb]
