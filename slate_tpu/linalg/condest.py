@@ -26,13 +26,22 @@ from .lu import getrs
 from .norms import norm
 
 
-def _norm1est(solve: Callable, solve_t: Callable, n: int, dtype,
+def _conj_solve(solve_t: Callable) -> Callable:
+    """Turn a transpose solve x ↦ A⁻ᵀx into the conjugate-transpose solve
+    x ↦ A⁻ᴴx that Higham/gecon requires for complex matrices:
+    A⁻ᴴx = conj(A⁻ᵀ·conj(x)). For real dtypes conj is the identity."""
+    return lambda x: jnp.conj(solve_t(jnp.conj(x)))
+
+
+def _norm1est(solve: Callable, solve_h: Callable, n: int, dtype,
               max_iter: int = 5) -> float:
     """Estimate ‖A⁻¹‖₁ given x ↦ A⁻¹x and x ↦ A⁻ᴴx (internal_norm1est).
 
     Complex-safe (Higham's complex variant): the 'sign' vector is
     y/|y| and iterates stay complex — casting to float64 would zero
-    purely-imaginary solves and report a singular matrix."""
+    purely-imaginary solves and report a singular matrix. ``solve_h``
+    must be the CONJUGATE-transpose solve (wrap a transpose solve with
+    _conj_solve), per LAPACK gecon/Higham."""
     cplx = np.issubdtype(np.dtype(jnp.zeros((), dtype).dtype), np.complexfloating)
     work = np.complex128 if cplx else np.float64
     x = np.full((n, 1), 1.0 / n, dtype=work)
@@ -46,9 +55,9 @@ def _norm1est(solve: Callable, solve_t: Callable, n: int, dtype,
         if (np.abs(sign - prev_sign) < 1e-12).all():
             break
         prev_sign = sign
-        z = np.asarray(solve_t(jnp.asarray(sign, dtype))).astype(work)[:n]
+        z = np.asarray(solve_h(jnp.asarray(sign, dtype))).astype(work)[:n]
         j = int(np.argmax(np.abs(z)))
-        if np.abs(z[j]) <= float(np.abs(np.conj(z).T @ x)):
+        if np.abs(z[j]).item() <= np.abs(np.conj(z).T @ x).item():
             break
         x = np.zeros((n, 1), dtype=work)
         x[j] = 1.0
@@ -71,8 +80,8 @@ def gecondest(LU: TiledMatrix, perm, anorm: float,
     n = LU.shape[0]
     inv_norm = _norm1est(
         lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts).to_dense(),
-        lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts,
-                        trans=True).to_dense(),
+        _conj_solve(lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts,
+                                    trans=True).to_dense()),
         n, LU.dtype)
     if anorm == 0 or inv_norm == 0:
         return 0.0
@@ -97,7 +106,7 @@ def trcondest(T: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> float:
     inv_norm = _norm1est(
         lambda x: blas3.trsm(Side.Left, 1.0, T, _rhs(n, T.nb, x),
                              opts).to_dense(),
-        lambda x: blas3.trsm(Side.Left, 1.0, T.T, _rhs(n, T.nb, x),
+        lambda x: blas3.trsm(Side.Left, 1.0, T.H, _rhs(n, T.nb, x),
                              opts).to_dense(),
         n, T.dtype)
     if anorm == 0 or inv_norm == 0:

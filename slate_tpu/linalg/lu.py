@@ -244,7 +244,19 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
             cand_idx = topi.reshape(nc // 2, 2 * w)
         _, _, pfin = jax.lax.linalg.lu(chunks[0])
         winners = cand_idx[0][pfin][:w]  # panel-relative row indices
-        winners = jnp.minimum(winners, prows - 1)
+        # A winner may be a padding sentinel (index ≥ prows: zero-padded
+        # rows of the last chunk, or the mpad filler of an odd pairing) —
+        # possible when a panel column is entirely zero. Clamping would
+        # duplicate a real row and corrupt the permutation; instead give
+        # each sentinel slot a distinct unused row, so p_perm stays a
+        # valid permutation and singularity surfaces only via info.
+        valid = winners < prows
+        used = (jnp.zeros(prows + 1, bool)
+                .at[jnp.where(valid, winners, prows)].set(True))[:prows]
+        unused = jnp.nonzero(~used, size=prows,
+                             fill_value=prows - 1)[0].astype(jnp.int32)
+        slot = jnp.cumsum(~valid) - (~valid)  # per-slot sentinel ordinal
+        winners = jnp.where(valid, winners, unused[slot])
         # --- swap winners to the top, then no-pivot elimination --------
         others_mask = jnp.ones(prows, bool).at[winners].set(False)
         rest = jnp.nonzero(others_mask, size=prows - w, fill_value=0)[0]
