@@ -22,17 +22,24 @@
 extern "C" {
 #endif
 
-/* Pack a row-major global (m x n, leading dim ldg) matrix into the 2D
- * block-cyclic local buffer of process (pi, qi) on a p x q grid with
- * tile size nb. `local` must hold ceil(mt/p)*ceil(nt/q)*nb*nb doubles. */
+/* ScaLAPACK numroc (source process 0): local row/col count of grid
+ * coordinate pi of p for m rows with block size nb. */
+int64_t st_numroc(int64_t m, int64_t nb, int64_t pi, int64_t p);
+
+/* Pack a row-major global (m x n, leading dim ldg) matrix into the TRUE
+ * ScaLAPACK local buffer of process (pi, qi) on a p x q grid with block
+ * size nb: a column-major (lld x numroc(n, nb, qi, q)) array with
+ * lld >= numroc(m, nb, pi, p) — byte-compatible with BLACS/ScaLAPACK
+ * local arrays (descriptor's LLD_). */
 int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
                    int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
-                   double* local);
+                   double* local, int64_t lld);
 
-/* Inverse: scatter a local block-cyclic buffer into the global matrix. */
+/* Inverse: scatter a ScaLAPACK column-major local buffer into the global
+ * matrix (only this process's entries are written). */
 int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
                      int64_t nb, int64_t p, int64_t q, int64_t pi,
-                     int64_t qi, double* global);
+                     int64_t qi, double* global, int64_t lld);
 
 /* Row-major global <-> tile-major (mt, nt, nb, nb) padded layout. */
 int64_t st_tile_pack(const double* global, int64_t m, int64_t n,

@@ -17,11 +17,16 @@
 //
 // Layout conventions:
 //  - global: row-major (m x n), leading dimension ldg >= n.
-//  - block-cyclic local: the (p, q) process at coords (pi, qi) owns tiles
-//    (i, j) with i % p == pi, j % q == qi (ScaLAPACK block-cyclic,
-//    2D grid); its local buffer is column-of-tiles major, i.e. local tile
-//    (il, jl) starts at offset ((jl * mt_loc) + il) * nb * nb and is
-//    stored row-major nb x nb, zero-padded at the ragged edges.
+//  - block-cyclic local: TRUE ScaLAPACK layout. The (p, q) process at
+//    coords (pi, qi) owns tiles (i, j) with i % p == pi, j % q == qi
+//    (block-cyclic with source process 0, the BLACS default); its local
+//    buffer is a COLUMN-MAJOR (lld x nloc) array with lld >= mloc =
+//    numroc(m, nb, pi, p), exactly what Cpdgemr2d / pdpotrf_ expect and
+//    what the reference wraps zero-copy in Matrix::fromScaLAPACK
+//    (include/slate/Matrix.hh:347). Local row li maps to global row
+//    (li/nb * p + pi) * nb + li%nb; ragged final blocks are NOT padded
+//    (matching numroc), so buffers from real ScaLAPACK/BLACS programs
+//    are byte-compatible.
 
 #include <cstdint>
 #include <cstring>
@@ -38,13 +43,26 @@ static inline int64_t local_tiles(int64_t mt, int64_t p, int64_t pi) {
     return (mt - pi + p - 1) / p;
 }
 
-// Pack a row-major global (m x n) matrix into one process's 2D
-// block-cyclic local buffer. Returns 0 on success.
+// ScaLAPACK numroc (TOOLS/numroc.f) with source process 0: how many of
+// the m rows land on grid coordinate pi of p with block size nb.
+int64_t st_numroc(int64_t m, int64_t nb, int64_t pi, int64_t p) {
+    const int64_t nblocks = m / nb;
+    int64_t loc = (nblocks / p) * nb;
+    const int64_t extra = nblocks % p;
+    if (pi < extra) loc += nb;
+    else if (pi == extra) loc += m % nb;
+    return loc;
+}
+
+// Pack a row-major global (m x n) matrix into one process's TRUE
+// ScaLAPACK local buffer: column-major (lld x nloc), lld >= mloc =
+// numroc(m, nb, pi, p). Returns 0 on success.
 int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
                    int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
-                   double* local) {
+                   double* local, int64_t lld) {
     if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
     if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
+    if (lld < st_numroc(m, nb, pi, p)) return -3;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
     const int64_t mtl = local_tiles(mt, p, pi);
@@ -57,30 +75,26 @@ int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
             const int64_t r0 = gi * nb, c0 = gj * nb;
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
-            double* t = local + ((jl * mtl) + il) * nb * nb;
-            for (int64_t r = 0; r < rows; ++r) {
-                const double* src = global + (r0 + r) * ldg + c0;
-                double* dst = t + r * nb;
-                std::memcpy(dst, src, size_t(cols) * sizeof(double));
-                if (cols < nb)
-                    std::memset(dst + cols, 0,
-                                size_t(nb - cols) * sizeof(double));
+            for (int64_t c = 0; c < cols; ++c) {
+                double* dst = local + (jl * nb + c) * lld + il * nb;
+                const double* src = global + r0 * ldg + (c0 + c);
+                for (int64_t r = 0; r < rows; ++r)
+                    dst[r] = src[r * ldg];
             }
-            for (int64_t r = rows; r < nb; ++r)
-                std::memset(t + r * nb, 0, size_t(nb) * sizeof(double));
         }
     }
     return 0;
 }
 
-// Inverse of st_bc_pack: scatter one process's local block-cyclic buffer
-// back into the row-major global matrix (only this process's tiles are
-// written).
+// Inverse of st_bc_pack: scatter one process's ScaLAPACK column-major
+// local buffer back into the row-major global matrix (only this
+// process's entries are written).
 int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
                      int64_t nb, int64_t p, int64_t q, int64_t pi,
-                     int64_t qi, double* global) {
+                     int64_t qi, double* global, int64_t lld) {
     if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
     if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
+    if (lld < st_numroc(m, nb, pi, p)) return -3;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
     const int64_t mtl = local_tiles(mt, p, pi);
@@ -93,10 +107,12 @@ int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
             const int64_t r0 = gi * nb, c0 = gj * nb;
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
-            const double* t = local + ((jl * mtl) + il) * nb * nb;
-            for (int64_t r = 0; r < rows; ++r)
-                std::memcpy(global + (r0 + r) * ldg + c0, t + r * nb,
-                            size_t(cols) * sizeof(double));
+            for (int64_t c = 0; c < cols; ++c) {
+                const double* src = local + (jl * nb + c) * lld + il * nb;
+                double* dst = global + r0 * ldg + (c0 + c);
+                for (int64_t r = 0; r < rows; ++r)
+                    dst[r * ldg] = src[r];
+            }
         }
     }
     return 0;

@@ -1,2 +1,3 @@
 from .scalapack import from_lapack, from_scalapack, to_scalapack
-from .native import have_native, tile_pack, tile_unpack, bc_pack, bc_unpack
+from .native import (have_native, numroc, tile_pack, tile_unpack, bc_pack,
+                     bc_unpack)

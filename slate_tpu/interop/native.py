@@ -53,10 +53,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         for name, argtypes in [
+            ("st_numroc", [_I64, _I64, _I64, _I64]),
             ("st_bc_pack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
-                            _I64, _PD]),
+                            _I64, _PD, _I64]),
             ("st_bc_unpack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
-                              _I64, _PD]),
+                              _I64, _PD, _I64]),
             ("st_tile_pack", [_PD, _I64, _I64, _I64, _I64, _PD]),
             ("st_tile_unpack", [_PD, _I64, _I64, _I64, _I64, _PD]),
             ("st_colmajor_to_rowmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
@@ -75,58 +76,78 @@ def have_native() -> bool:
 
 # -- numpy fallbacks (same layout contracts as layout.cc) -------------------
 
-def _local_tiles(mt: int, p: int, pi: int) -> int:
-    return (mt - pi + p - 1) // p
+def numroc(m: int, nb: int, pi: int, p: int) -> int:
+    """ScaLAPACK numroc (source process 0): rows of grid coord pi of p."""
+    nblocks = m // nb
+    loc = (nblocks // p) * nb
+    extra = nblocks % p
+    if pi < extra:
+        loc += nb
+    elif pi == extra:
+        loc += m % nb
+    return loc
+
+
+def _cyclic_indices(m: int, nb: int, pi: int, p: int) -> np.ndarray:
+    """Global row indices owned by grid coord pi, in local-row order."""
+    mt = -(-m // nb)
+    blocks = np.arange(pi, mt, p, dtype=np.int64)
+    idx = (blocks[:, None] * nb + np.arange(nb, dtype=np.int64)).ravel()
+    return idx[idx < m]
 
 
 def bc_pack(global_rm: np.ndarray, nb: int, p: int, q: int, pi: int,
             qi: int) -> np.ndarray:
-    """Global row-major (m, n) → this process's 2D block-cyclic local
-    buffer of shape (ntl*mtl, nb, nb) in column-of-tiles-major order."""
+    """Global row-major (m, n) → this process's TRUE ScaLAPACK local
+    array: column-major (mloc, nloc) with mloc = numroc(m, nb, pi, p),
+    byte-compatible with BLACS/ScaLAPACK local buffers (lld = mloc)."""
     a = np.ascontiguousarray(global_rm, dtype=np.float64)
     m, n = a.shape
-    mt, nt = -(-m // nb), -(-n // nb)
-    mtl, ntl = _local_tiles(mt, p, pi), _local_tiles(nt, q, qi)
-    out = np.zeros((ntl * mtl, nb, nb), np.float64)
+    mloc, nloc = numroc(m, nb, pi, p), numroc(n, nb, qi, q)
     lib = get_lib()
     if lib is not None:
+        flat = np.zeros(mloc * nloc, np.float64)
         rc = lib.st_bc_pack(a, m, n, a.strides[0] // 8, nb, p, q, pi, qi,
-                            out.reshape(-1))
+                            flat, mloc)
         if rc == 0:
-            return out
-    for jl in range(ntl):
-        for il in range(mtl):
-            gi, gj = pi + il * p, qi + jl * q
-            r0, c0 = gi * nb, gj * nb
-            rows, cols = min(nb, m - r0), min(nb, n - c0)
-            out[jl * mtl + il, :rows, :cols] = a[r0:r0 + rows, c0:c0 + cols]
-    return out
+            return flat.reshape((mloc, nloc), order="F")
+    gr = _cyclic_indices(m, nb, pi, p)
+    gc = _cyclic_indices(n, nb, qi, q)
+    return np.asfortranarray(a[np.ix_(gr, gc)])
 
 
 def bc_unpack(local: np.ndarray, m: int, n: int, nb: int, p: int, q: int,
-              pi: int, qi: int, out: Optional[np.ndarray] = None
-              ) -> np.ndarray:
-    """Scatter a local block-cyclic buffer into the global row-major
-    matrix (writes only this process's tiles)."""
+              pi: int, qi: int, out: Optional[np.ndarray] = None,
+              lld: Optional[int] = None) -> np.ndarray:
+    """Scatter a ScaLAPACK column-major local array into the global
+    row-major matrix (writes only this process's entries).
+
+    ``local`` may be a (lld, nloc) 2-D array (any memory order; rows
+    beyond mloc are the unused lld slack) or a flat column-major buffer
+    with ``lld`` given."""
     if out is None:
         out = np.zeros((m, n), np.float64)
-    loc = np.ascontiguousarray(local, dtype=np.float64)
-    mt, nt = -(-m // nb), -(-n // nb)
-    mtl, ntl = _local_tiles(mt, p, pi), _local_tiles(nt, q, qi)
+    mloc, nloc = numroc(m, nb, pi, p), numroc(n, nb, qi, q)
+    loc = np.asarray(local, dtype=np.float64)
+    if loc.ndim == 1:
+        ld = lld if lld is not None else mloc
+        loc = loc.reshape((ld, nloc), order="F")
+    loc = loc[:mloc, :nloc]
+    if loc.shape != (mloc, nloc):
+        raise ValueError(
+            f"bc_unpack: local buffer {np.asarray(local).shape} too small "
+            f"for numroc sizes ({mloc}, {nloc})")
     lib = get_lib()
     if lib is not None and out.flags.c_contiguous:
-        rc = lib.st_bc_unpack(loc.reshape(-1), m, n, out.strides[0] // 8,
-                              nb, p, q, pi, qi, out)
+        locf = np.asfortranarray(loc)
+        rc = lib.st_bc_unpack(locf.ravel(order="F"), m, n,
+                              out.strides[0] // 8, nb, p, q, pi, qi, out,
+                              mloc)
         if rc == 0:
             return out
-    loc3 = loc.reshape(ntl * mtl, nb, nb)
-    for jl in range(ntl):
-        for il in range(mtl):
-            gi, gj = pi + il * p, qi + jl * q
-            r0, c0 = gi * nb, gj * nb
-            rows, cols = min(nb, m - r0), min(nb, n - c0)
-            out[r0:r0 + rows, c0:c0 + cols] = loc3[jl * mtl + il,
-                                                   :rows, :cols]
+    gr = _cyclic_indices(m, nb, pi, p)
+    gc = _cyclic_indices(n, nb, qi, q)
+    out[np.ix_(gr, gc)] = loc
     return out
 
 

@@ -36,13 +36,14 @@ def from_lapack(a_colmajor: np.ndarray, nb: int, grid: Optional[ProcessGrid]
 def from_scalapack(locals_: List[np.ndarray], m: int, n: int, nb: int,
                    p: int, q: int, grid: Optional[ProcessGrid] = None,
                    order: GridOrder = GridOrder.Col, **kw) -> TiledMatrix:
-    """Assemble a TiledMatrix from per-process 2D block-cyclic local
-    buffers.
+    """Assemble a TiledMatrix from per-process ScaLAPACK local arrays.
 
-    ``locals_[rank]`` is process rank's buffer as produced by
-    to_scalapack / ScaLAPACK (column-of-tiles-major, see
-    native/layout.cc); ranks are ordered column-major over the (p, q)
-    grid (BLACS default) unless order says otherwise."""
+    ``locals_[rank]`` is process rank's local array in the TRUE ScaLAPACK
+    layout — column-major (lld × nloc) with lld ≥ numroc(m, nb, pi, p),
+    exactly the buffer a BLACS program passes to pdpotrf_ and what the
+    reference wraps in Matrix::fromScaLAPACK (include/slate/
+    Matrix.hh:347). Ranks are ordered column-major over the (p, q) grid
+    (BLACS default) unless order says otherwise."""
     if len(locals_) != p * q:
         raise ValueError(f"expected {p*q} local buffers, got {len(locals_)}")
     out = np.zeros((m, n), np.float64)
@@ -57,8 +58,9 @@ def from_scalapack(locals_: List[np.ndarray], m: int, n: int, nb: int,
 
 def to_scalapack(A: TiledMatrix, p: int, q: int,
                  order: GridOrder = GridOrder.Col) -> List[np.ndarray]:
-    """Split a TiledMatrix into per-process 2D block-cyclic local buffers
-    (the export direction of the scalapack_api)."""
+    """Split a TiledMatrix into per-process ScaLAPACK local arrays —
+    column-major (mloc × nloc) with lld = mloc (the export direction of
+    the scalapack_api)."""
     a = A.to_numpy().astype(np.float64)
     out = []
     for rank in range(p * q):

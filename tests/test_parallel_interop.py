@@ -124,6 +124,44 @@ def test_bc_pack_unpack_all_ranks():
     np.testing.assert_array_equal(out, a)
 
 
+def test_bc_pack_matches_scalapack_definition():
+    """bc_pack must produce byte-compatible ScaLAPACK local arrays:
+    column-major (numroc × numroc) with the INDXG2P/INDXG2L index maps
+    (ScaLAPACK TOOLS; reference wraps such buffers zero-copy in
+    Matrix::fromScaLAPACK, include/slate/Matrix.hh:347)."""
+    from slate_tpu.interop import numroc
+    m, n, nb, p, q = 45, 61, 8, 3, 2
+    a = RNG.standard_normal((m, n))
+    for pi in range(p):
+        for qi in range(q):
+            loc = bc_pack(a, nb, p, q, pi, qi)
+            assert loc.shape == (numroc(m, nb, pi, p), numroc(n, nb, qi, q))
+            assert loc.flags.f_contiguous or 1 in loc.shape
+            for gi in range(m):
+                for gj in range(n):
+                    if (gi // nb) % p == pi and (gj // nb) % q == qi:
+                        li = (gi // nb // p) * nb + gi % nb  # INDXG2L − 1
+                        lj = (gj // nb // q) * nb + gj % nb
+                        assert loc[li, lj] == a[gi, gj]
+
+
+def test_bc_unpack_flat_with_lld_slack():
+    """A flat BLACS buffer with lld > mloc (descriptor LLD_ slack) must
+    unpack identically to the exact-size array."""
+    from slate_tpu.interop import numroc
+    m, n, nb, p, q, pi, qi = 40, 24, 8, 2, 2, 1, 0
+    a = RNG.standard_normal((m, n))
+    loc = bc_pack(a, nb, p, q, pi, qi)
+    mloc, nloc = loc.shape
+    lld = mloc + 5
+    padded = np.zeros((lld, nloc))
+    padded[:mloc] = loc
+    out = bc_unpack(padded.ravel(order="F"), m, n, nb, p, q, pi, qi,
+                    lld=lld)
+    ref = bc_unpack(loc, m, n, nb, p, q, pi, qi)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_tile_pack_unpack():
     m, n, nb = 37, 29, 8
     a = RNG.standard_normal((m, n))
