@@ -18,7 +18,8 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      scale_row_col, set_matrix, set_lambda, redistribute,
                      potrf, potrs, posv, trtri, trtrm, potri, posv_mixed,
                      getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv,
-                     gesv_nopiv, gesv_rbt, gesv_mixed, getri, gerbt,
+                     gesv_nopiv, gesv_rbt, gesv_mixed, gesv_mixed_gmres,
+                     posv_mixed_gmres, getri, gerbt,
                      QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,

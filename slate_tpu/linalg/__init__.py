@@ -12,10 +12,11 @@ from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
 from .eig import heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf
 from .svd import svd, ge2tb, bdsqr
 from .condest import gecondest, pocondest, trcondest
+from .gmres import gesv_mixed_gmres, posv_mixed_gmres
 from .indefinite import hesv, hetrf, hetrs
 # Explicit submodule attributes (not just import side effects):
 from . import (band, blas3, cholesky, condest, eig, elementwise,
-               indefinite, lu, qr)
+               gmres, indefinite, lu, qr)
 # The driver function `svd` shadows the submodule attribute of the same
 # name (so `import slate_tpu.linalg.svd as m` would bind the *function*).
 # Use this explicit module handle for internals like ge2tb back-ends:

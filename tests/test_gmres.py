@@ -1,0 +1,148 @@
+"""GMRES-IR mixed-precision solver tests.
+
+Reference semantics: src/gesv_mixed_gmres.cc, src/posv_mixed_gmres.cc.
+The key acceptance test (VERDICT round 1, item 7): an ill-conditioned
+system that plain iterative refinement CANNOT solve from an f32 factor
+must converge under FGMRES-IR to working-precision accuracy.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+RNG = np.random.default_rng(42)
+
+
+def _cond_matrix(n, cond, rng=RNG, spd=False, complex_=False):
+    """Matrix with prescribed 2-norm condition number via SVD synthesis."""
+    if complex_:
+        u, _ = np.linalg.qr(rng.standard_normal((n, n))
+                            + 1j * rng.standard_normal((n, n)))
+        v, _ = np.linalg.qr(rng.standard_normal((n, n))
+                            + 1j * rng.standard_normal((n, n)))
+    else:
+        u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -np.log10(cond), n)
+    if spd:
+        a = (u * s) @ np.conj(u).T
+        return 0.5 * (a + np.conj(a).T)
+    return (u * s) @ np.conj(v).T
+
+
+def test_gesv_mixed_gmres_well_conditioned():
+    n, nb = 64, 16
+    a = _cond_matrix(n, 1e3)
+    x_true = RNG.standard_normal((n, 1))
+    b = a @ x_true
+    X, info, iters = st.gesv_mixed_gmres(
+        st.from_dense(a, nb=nb), st.from_dense(b, nb=nb))
+    assert int(info) == 0 and iters >= 0
+    np.testing.assert_allclose(X.to_numpy(), x_true, rtol=1e-9, atol=1e-9)
+
+
+def test_gesv_mixed_gmres_beats_plain_ir():
+    """cond ≈ 1e9: plain IR from an f32 factor diverges (the correction
+    equation amplifies the error); FGMRES-IR must converge to the
+    attainable forward accuracy ~cond·ε (the reason the routine exists —
+    src/gesv_mixed_gmres.cc:29-33). nb = 32 so the reference's
+    restart = min(30, itermax, nb−1) rule gives the full restart of 30."""
+    n, nb = 96, 32
+    rng = np.random.default_rng(0)  # premise verified for this seed
+    a = _cond_matrix(n, 1e9, rng=rng)
+    x_true = rng.standard_normal((n, 1))
+    b = a @ x_true
+    A = st.from_dense(a, nb=nb)
+    B = st.from_dense(b, nb=nb)
+    opts = st.Options(use_fallback_solver=False, max_iterations=90)
+
+    X1, _, it_plain = st.gesv_mixed(A, B, opts, factor_dtype=jnp.float32)
+    err_plain = np.linalg.norm(X1.to_numpy() - x_true) / np.linalg.norm(
+        x_true)
+    X, info, iters = st.gesv_mixed_gmres(A, B, opts,
+                                         factor_dtype=jnp.float32)
+    err = np.linalg.norm(X.to_numpy() - x_true) / np.linalg.norm(x_true)
+    assert int(info) == 0
+    assert iters >= 0, "FGMRES-IR failed to converge"
+    assert err < 1e-5, f"FGMRES-IR err {err}"
+    # plain IR must have actually failed — guards the test's premise
+    assert not (err_plain < 1e-5), f"plain IR unexpectedly fine: {err_plain}"
+
+
+def test_gesv_mixed_gmres_multiple_rhs():
+    n, nb, nrhs = 64, 16, 3
+    a = _cond_matrix(n, 1e6)
+    x_true = RNG.standard_normal((n, nrhs))
+    b = a @ x_true
+    X, info, iters = st.gesv_mixed_gmres(
+        st.from_dense(a, nb=nb), st.from_dense(b, nb=nb))
+    assert int(info) == 0 and iters >= 0
+    np.testing.assert_allclose(X.to_numpy(), x_true, rtol=1e-6, atol=1e-8)
+
+
+def test_gesv_mixed_gmres_complex():
+    n, nb = 64, 16
+    a = _cond_matrix(n, 1e6, complex_=True)
+    x_true = RNG.standard_normal((n, 1)) + 1j * RNG.standard_normal((n, 1))
+    b = a @ x_true
+    X, info, iters = st.gesv_mixed_gmres(
+        st.from_dense(a, nb=nb), st.from_dense(b, nb=nb),
+        factor_dtype=jnp.complex64)
+    assert int(info) == 0 and iters >= 0
+    np.testing.assert_allclose(X.to_numpy(), x_true, rtol=1e-6, atol=1e-8)
+
+
+def test_gesv_mixed_gmres_singular_low_factor():
+    """Exactly singular matrix: iter = −3 (reference code, .cc:77) and the
+    fallback reports the singularity when disabled."""
+    n = 8
+    A = st.from_dense(np.zeros((n, n)), nb=8)
+    B = st.from_dense(np.ones((n, 1)), nb=8)
+    _, info, iters = st.gesv_mixed_gmres(
+        A, B, st.Options(use_fallback_solver=False))
+    assert iters == -3 and int(info) > 0
+
+
+def test_posv_mixed_gmres_ill_conditioned():
+    n, nb = 96, 16
+    a = _cond_matrix(n, 1e8, spd=True)
+    x_true = RNG.standard_normal((n, 2))
+    b = a @ x_true
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    B = st.from_dense(b, nb=nb)
+    X, info, iters = st.posv_mixed_gmres(
+        A, B, st.Options(use_fallback_solver=False),
+        factor_dtype=jnp.float32)
+    err = np.linalg.norm(X.to_numpy() - x_true) / np.linalg.norm(x_true)
+    assert int(info) == 0 and iters >= 0
+    assert err < 1e-7, f"posv FGMRES-IR err {err}"
+
+
+def test_posv_mixed_gmres_same_dtype_short_circuits():
+    n, nb = 32, 8
+    a = _cond_matrix(n, 10, spd=True).astype(np.float32)
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    b = RNG.standard_normal((n, 1)).astype(np.float32)
+    X, info, iters = st.posv_mixed_gmres(A, st.from_dense(b, nb=nb),
+                                         factor_dtype=jnp.float32)
+    assert iters == 0 and int(info) == 0
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-4)
+
+
+def test_gesv_mixed_gmres_fallback():
+    """With the fallback enabled a hopeless low-precision factor still
+    produces a correct solution (iter < 0 reports the failure)."""
+    n, nb = 64, 16
+    a = _cond_matrix(n, 1e15)  # beyond f32: GMRES-IR itself fails
+    x_true = RNG.standard_normal((n, 1))
+    b = a @ x_true
+    X, info, iters = st.gesv_mixed_gmres(
+        st.from_dense(a, nb=nb), st.from_dense(b, nb=nb),
+        st.Options(use_fallback_solver=True))
+    assert iters < 0
+    # fallback = full-precision partial-pivot solve; backward error check
+    r = np.linalg.norm(a @ X.to_numpy() - b) / (
+        np.linalg.norm(a) * np.linalg.norm(X.to_numpy()))
+    assert r < 1e-12
