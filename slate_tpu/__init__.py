@@ -24,7 +24,8 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
                      gecondest, pocondest, trcondest, hesv, hetrf, hetrs,
-                     heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf,
+                     heev, hegv, hegst, he2hb, he2td, unmtr_he2hb,
+                     unmtr_he2td, steqr, sterf,
                      svd, ge2tb, bdsqr)
 from . import api
 from . import utils

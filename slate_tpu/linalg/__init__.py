@@ -9,7 +9,8 @@ from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
 from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
                  qr_multiply_explicit)
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
-from .eig import heev, hegv, hegst, he2hb, unmtr_he2hb, steqr, sterf
+from .eig import (heev, hegv, hegst, he2hb, he2td, unmtr_he2hb,
+                  unmtr_he2td, steqr, sterf)
 from .svd import svd, ge2tb, bdsqr
 from .condest import gecondest, pocondest, trcondest
 from .gmres import gesv_mixed_gmres, posv_mixed_gmres

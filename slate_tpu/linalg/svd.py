@@ -18,19 +18,25 @@ the transpose, exactly like the reference (svd.cc:214-232).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense
-from ..core.types import Options, Side, DEFAULT_OPTIONS
+from ..core.types import MethodSVD, Options, Side, DEFAULT_OPTIONS
 from ..core.precision import accurate_matmuls
+from ..ops import blocked
 from .qr import (_apply_block_reflector, _apply_block_reflector_H, _larft,
                  geqrf, unmqr)
 
 Array = jax.Array
+
+_DC_MIN_N = 2048   # MethodSVD.Auto engages the DC path above this order
+_BD_PANEL = 32     # labrd panel width for the device bidiagonalization
 
 
 def _panel_reflector(panel: Array):
@@ -114,17 +120,195 @@ def _apply_v(v_refl, C: Array, nb: int, trans: bool) -> Array:
     return C
 
 
+# ---------------------------------------------------------------------------
+# direct blocked bidiagonalization (device) — real dtypes
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("b",))
+def _ge2bd_jit(a: Array, b: int = _BD_PANEL):
+    """Blocked Householder bidiagonalization A = Q_l·B·Q_rᵀ on device
+    (real dtypes; the gebrd/labrd recurrences).
+
+    The direct TPU replacement for the reference's ge2tb + tb2bd chase
+    (src/ge2tb.cc, src/tb2bd.cc) — same reasoning as eig._he2td_jit: the
+    per-column work is two full matvecs (HBM-bound either way) and all
+    O(mn·b) block updates are large gemms, while a bulge chase would
+    serialize ~n²/b tiny updates.
+
+    Returns (d, e, Vl, TauL, Ur, TauR): B = bidiag(d, e) upper;
+    Q_l = ∏ⱼ(I − τₗⱼ vⱼvⱼᵀ) (pivot row j), Q_r = ∏ⱼ(I − τᵣⱼ uⱼuⱼᵀ)
+    (pivot col j+1); Vl/Ur are per-panel matrices.
+    """
+    mpad, npad = a.shape
+    kt = min(mpad, npad)
+    rows = jnp.arange(mpad)
+    cols = jnp.arange(npad)
+    n_panels = max(1, -(-kt // b))
+
+    def col_step(j, carry):
+        a_c, Vl, Y, X, Ur, tl, tr, j0 = carry
+        jj = j0 + j
+
+        def do(carry):
+            a_c, Vl, Y, X, Ur, tl, tr, j0 = carry
+            # update column jj:  A_upd = A − Vl·Yᴴ − X·Urᴴ
+            acol = jax.lax.dynamic_slice(a_c, (0, jj), (mpad, 1))[:, 0]
+            yrow = jax.lax.dynamic_slice(Y, (jj, 0), (1, b))[0]
+            urow = jax.lax.dynamic_slice(Ur, (jj, 0), (1, b))[0]
+            col = acol - Vl @ jnp.conj(yrow) - X @ jnp.conj(urow)
+            # left reflector, pivot row jj
+            alpha = jax.lax.dynamic_slice(col, (jj,), (1,))[0]
+            tail = jnp.where(rows > jj, col, 0)
+            beta_l, tau_l, scale_l = blocked._larfg(alpha, tail)
+            v = jnp.where(rows > jj, col * scale_l, 0)
+            v = v.at[jj].set(jnp.ones((), a_c.dtype))
+            # y = τ_l·(A_updᴴ v)
+            y = tau_l * (jnp.conj(a_c).T @ v
+                         - Y @ (jnp.conj(Vl).T @ v)
+                         - Ur @ (jnp.conj(X).T @ v))
+            # row jj after the left reflector: row = A_upd[jj,:] − yᴴ
+            arow = jax.lax.dynamic_slice(a_c, (jj, 0), (1, npad))[0]
+            vlrow = jax.lax.dynamic_slice(Vl, (jj, 0), (1, b))[0]
+            xrow = jax.lax.dynamic_slice(X, (jj, 0), (1, b))[0]
+            row = arow - jnp.conj(Y @ jnp.conj(vlrow)) \
+                - jnp.conj(Ur @ jnp.conj(xrow)) - jnp.conj(y)
+            # right reflector, pivot col jj+1 (none on the last column)
+            alpha_r = jax.lax.dynamic_slice(
+                jnp.pad(row, (0, 1)), (jj + 1,), (1,))[0]
+            tail_r = jnp.where(cols > jj + 1, row, 0)
+            beta_r, tau_r, scale_r = blocked._larfg(
+                jnp.conj(alpha_r), jnp.conj(tail_r))
+            u = jnp.where(cols > jj + 1, jnp.conj(row) * scale_r, 0)
+            # out-of-bounds scatter (jj+1 == npad, last column) is
+            # dropped under jit, and the where() below zeroes u anyway
+            u = u.at[jj + 1].set(jnp.ones((), a_c.dtype))
+            u = jnp.where(jj + 1 >= npad, jnp.zeros_like(u), u)
+            tau_r = jnp.where(jj + 1 >= npad, jnp.zeros_like(tau_r), tau_r)
+            # x = τ_r·(A_upd3 u), A_upd3 = A_upd − v·yᴴ
+            x = tau_r * (a_c @ u - Vl @ (jnp.conj(Y).T @ u)
+                         - X @ (jnp.conj(Ur).T @ u)
+                         - v * (jnp.conj(y) @ u))
+            Vl = jax.lax.dynamic_update_slice(Vl, v[:, None], (0, j))
+            Y = jax.lax.dynamic_update_slice(Y, y[:, None], (0, j))
+            X = jax.lax.dynamic_update_slice(X, x[:, None], (0, j))
+            Ur = jax.lax.dynamic_update_slice(Ur, u[:, None], (0, j))
+            return (a_c, Vl, Y, X, Ur, tl.at[j].set(tau_l),
+                    tr.at[j].set(tau_r), j0)
+
+        return jax.lax.cond(jj < kt, do, lambda c: c, carry)
+
+    def panel_step(k, carry):
+        a_c, Vls, TauLs, Urs, TauRs = carry
+        j0 = k * b
+        Vl0 = jnp.zeros((mpad, b), a_c.dtype)
+        Y0 = jnp.zeros((npad, b), a_c.dtype)
+        X0 = jnp.zeros((mpad, b), a_c.dtype)
+        Ur0 = jnp.zeros((npad, b), a_c.dtype)
+        tl0 = jnp.zeros((b,), a_c.dtype)
+        tr0 = jnp.zeros((b,), a_c.dtype)
+        a_c, Vl, Y, X, Ur, tl, tr, _ = jax.lax.fori_loop(
+            0, b, col_step, (a_c, Vl0, Y0, X0, Ur0, tl0, tr0, j0))
+        a_c = a_c - Vl @ jnp.conj(Y).T - X @ jnp.conj(Ur).T
+        Vls = jax.lax.dynamic_update_slice(Vls, Vl[None], (k, 0, 0))
+        TauLs = jax.lax.dynamic_update_slice(TauLs, tl[None], (k, 0))
+        Urs = jax.lax.dynamic_update_slice(Urs, Ur[None], (k, 0, 0))
+        TauRs = jax.lax.dynamic_update_slice(TauRs, tr[None], (k, 0))
+        return (a_c, Vls, TauLs, Urs, TauRs)
+
+    Vls0 = jnp.zeros((n_panels, mpad, b), a.dtype)
+    TauLs0 = jnp.zeros((n_panels, b), a.dtype)
+    Urs0 = jnp.zeros((n_panels, npad, b), a.dtype)
+    TauRs0 = jnp.zeros((n_panels, b), a.dtype)
+    a, Vls, TauLs, Urs, TauRs = jax.lax.fori_loop(
+        0, n_panels, panel_step, (a, Vls0, TauLs0, Urs0, TauRs0))
+    d = jnp.real(jnp.diagonal(a))[:kt]
+    e = jnp.real(jnp.diagonal(a, offset=1))[: kt - 1]
+    Tl = jax.vmap(blocked.larft)(Vls, TauLs)
+    Tr = jax.vmap(blocked.larft)(Urs, TauRs)
+    return d, e, Vls, Tl, Urs, Tr
+
+
+def ge2bd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    """Bidiagonalize real A (m ≥ n): returns (d, e, (Vl, Tl), (Ur, Tr))
+    stacked block reflectors with Q_lᵀ·A·Q_r = bidiag(d, e) on the
+    padded size."""
+    a = A.dense_canonical()
+    d, e, Vls, Tl, Urs, Tr = _ge2bd_jit(a)
+    return d, e, (Vls, Tl), (Urs, Tr)
+
+
+# unmbr-style back-transform: shared stacked-reflector application
+_apply_q_panels = blocked.apply_block_reflectors_stacked
+
+
 def bdsqr(d, e, compute_uv: bool = False):
-    """Singular values (and optionally vectors) of an upper bidiagonal
-    matrix (slate::bdsqr wraps lapack::bdsqr, src/bdsqr.cc; here the
-    small dense bidiagonal goes through one-device SVD)."""
-    n = np.asarray(d).shape[0]
-    b = jnp.diag(jnp.asarray(d)) + jnp.diag(jnp.asarray(e), 1) \
-        if n > 1 else jnp.asarray(d).reshape(1, 1)
-    if compute_uv:
-        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
-        return s, u, vt
-    return jnp.linalg.svd(b, compute_uv=False)
+    """Singular values (and optionally vectors) of a real upper
+    bidiagonal matrix (slate::bdsqr, src/bdsqr.cc).
+
+    TPU-native redesign: the bidiagonal B maps to the Golub-Kahan
+    permuted tridiagonal — the 2k×2k symmetric tridiagonal with zero
+    diagonal and off-diagonals (d₁, e₁, d₂, e₂, …, d_k) — whose
+    eigenpairs are ±σᵢ with shuffled (v, u) vectors. That feeds stedc
+    (divide & conquer, matmul-rich) instead of densifying B into a k×k
+    matrix as round 1 did. Returns σ descending (+ U, Vᵀ of B when
+    compute_uv)."""
+    from .stedc import stedc as stedc_fn
+
+    d = np.asarray(d, np.float64)
+    e = np.asarray(e, np.float64)
+    k = d.shape[0]
+    if k == 0:
+        z = np.zeros((0, 0))
+        return (jnp.zeros(0), jnp.asarray(z), jnp.asarray(z)) \
+            if compute_uv else jnp.zeros(0)
+    off = np.empty(2 * k - 1)
+    off[0::2] = d
+    off[1::2] = e
+    tzero = np.zeros(2 * k)
+    if not compute_uv:
+        w, _ = stedc_fn(tzero, off, compute_z=False)
+        return jnp.asarray(np.sort(w[k:])[::-1].copy())
+    w, q = stedc_fn(tzero, off)
+    sig = w[k:]              # ascending positive half
+    Q = q[:, k:]
+    v = np.sqrt(2.0) * Q[0::2, :]
+    u = np.sqrt(2.0) * Q[1::2, :]
+    # tiny/zero σ: the ±σ eigenpair is near-degenerate and its vector
+    # may split unevenly between the u and v halves — renormalize each
+    # column (residual perturbation is O(σ·imbalance), negligible there)
+    un = np.linalg.norm(u, axis=0)
+    vn = np.linalg.norm(v, axis=0)
+    u = u / np.where(un == 0, 1.0, un)
+    v = v / np.where(vn == 0, 1.0, vn)
+    order = np.argsort(sig)[::-1]
+    return (jnp.asarray(sig[order].copy()), jnp.asarray(u[:, order].copy()),
+            jnp.asarray(v[:, order].T.copy()))
+
+
+def _svd_dc(A: TiledMatrix, opts: Options, want_vectors: bool):
+    """DC path (real dtypes): ge2bd device bidiagonalization + the
+    Golub-Kahan/stedc bdsqr + gemm back-transforms (MethodSVD.DC)."""
+    m, n = A.shape
+    k = min(m, n)
+    d, e, ql, qr = ge2bd(A, opts)
+    dn = np.asarray(d, np.float64)
+    en = np.asarray(e, np.float64)
+    if not want_vectors:
+        s = bdsqr(dn, en, compute_uv=False)
+        return jnp.asarray(s, jnp.finfo(A.dtype).dtype)[:k], None, None
+    s, ub, vbt = bdsqr(dn, en, compute_uv=True)
+    kt = dn.shape[0]
+    mpad = ql[0].shape[1]
+    npad = qr[0].shape[1]
+    ub = jnp.asarray(np.asarray(ub), A.dtype)[:, :k]
+    vb = jnp.asarray(np.asarray(vbt).T, A.dtype)[:, :k]
+    u_pad = jnp.zeros((mpad, k), A.dtype).at[:kt].set(ub)
+    v_pad = jnp.zeros((npad, k), A.dtype).at[:kt].set(vb)
+    U = _apply_q_panels(ql[0], ql[1], u_pad)
+    V = _apply_q_panels(qr[0], qr[1], v_pad)
+    s = jnp.asarray(s, jnp.finfo(A.dtype).dtype)[:k]
+    return (s, from_dense(U, A.nb, grid=A.grid, logical_shape=(m, k)),
+            from_dense(V, A.nb, grid=A.grid, logical_shape=(n, k)))
 
 
 @accurate_matmuls
@@ -132,6 +316,13 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
         want_vectors: bool = False
         ) -> Tuple[Array, Optional[TiledMatrix], Optional[TiledMatrix]]:
     """Singular value decomposition (slate::svd, src/svd.cc).
+
+    MethodSVD dispatch: DC (and Auto at n ≥ _DC_MIN_N, real dtypes) =
+    ge2bd device bidiagonalization + Golub-Kahan/stedc divide & conquer;
+    otherwise ge2tb band reduction + one-device band SVD (small-n/
+    complex fallback). Tall (m ≥ 2n) inputs take a pre-QR shortcut and
+    wide inputs go through the transpose, like the reference
+    (svd.cc:214-232).
 
     Returns (Sigma descending, U or None, V or None) with A = U·Σ·Vᴴ
     (thin U (m×k), V (n×k), k = min(m, n))."""
@@ -142,6 +333,21 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
         # transpose route is the TPU-functional equivalent)
         s, V, U = svd(A.H, opts, want_vectors=want_vectors)
         return s, U, V
+    method = opts.method_svd
+    if method is MethodSVD.DC and jnp.iscomplexobj(A.data):
+        raise SlateError(
+            "svd: MethodSVD.DC supports real dtypes only (the ge2bd "
+            "bidiagonalization is real; complex inputs take the "
+            "MethodSVD.Auto band path)")
+    if method is MethodSVD.Auto and min(m, n) >= _DC_MIN_N \
+            and not jnp.iscomplexobj(A.data) \
+            and jax.default_backend() == "cpu":
+        # same runtime-aware heuristic as heev (see eig.py): DC by
+        # default on CPU meshes, dense band path on attached
+        # accelerators, MethodSVD.DC to force the scalable pipeline
+        method = MethodSVD.DC
+    if method is MethodSVD.DC and m < 2 * n:
+        return _svd_dc(A, opts, want_vectors)
     if m >= 2 * n:
         # tall case: pre-QR then SVD of R (svd.cc:214-232 "qr_iteration
         # on the small square factor")

@@ -525,6 +525,23 @@ def panel_geqrf(a: Array, ib: int = PANEL_IB,
             jnp.concatenate([taus1, taus2]))
 
 
+@jax.jit
+def apply_block_reflectors_stacked(Vs: Array, Ts: Array, C: Array) -> Array:
+    """C ← Q·C for Q = ∏ₖ(I − VₖTₖVₖᴴ) given stacked per-panel block
+    reflectors Vs (k, n, b) / Ts (k, b, b) — the shared back-transform
+    of the two-sided reductions (unmtr_he2td, unmbr ge2bd). Last panel
+    applies first; all MXU gemms inside one jit."""
+    n_panels = Vs.shape[0]
+
+    def step(i, C):
+        k = n_panels - 1 - i
+        V = Vs[k]
+        T = Ts[k]
+        return C - V @ (T @ (jnp.conj(V).T @ C))
+
+    return lax.fori_loop(0, n_panels, step, C)
+
+
 @functools.partial(jax.jit, static_argnames=("ib",))
 def panel_geqrf_with_t(a: Array, ib: int = PANEL_IB):
     """jit entry: bucketed panel QR + its T factor, compiled per bucket.

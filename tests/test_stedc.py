@@ -1,0 +1,186 @@
+"""stedc divide & conquer + he2td tridiagonalization + heev DC path.
+
+Reference: src/stedc*.cc (distributed D&C), src/he2hb.cc + src/hb2st.cc
+(the reduction the TPU build performs as one direct blocked
+tridiagonalization — see eig._he2td_jit docstring).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.types import MethodEig
+from slate_tpu.linalg.stedc import stedc
+
+RNG = np.random.default_rng(7)
+
+
+def _tridiag(d, e):
+    t = np.diag(d)
+    if len(e):
+        t = t + np.diag(e, 1) + np.diag(e, -1)
+    return t
+
+
+@pytest.mark.parametrize("case", [
+    "random", "gk_zero_diag", "glued_wilkinson", "ties", "decoupled",
+])
+def test_stedc_accuracy(case):
+    n = 180
+    if case == "random":
+        d, e = RNG.standard_normal(n), RNG.standard_normal(n - 1)
+    elif case == "gk_zero_diag":
+        d, e = np.zeros(n), np.ones(n - 1)
+    elif case == "glued_wilkinson":
+        m = 21
+        wd = np.abs(np.arange(m) - (m - 1) / 2.0)
+        d = np.concatenate([wd] * 8)
+        e = np.ones(d.size - 1)
+        e[m - 1::m] = 1e-9
+    elif case == "ties":
+        d, e = np.ones(n), 1e-12 * np.ones(n - 1)
+    else:
+        d, e = np.arange(n) * 1.0, np.zeros(n - 1)
+    w, z = stedc(d, e)
+    t = _tridiag(d, e)
+    nn = d.size
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(t),
+                               rtol=1e-12, atol=1e-12 * max(1, np.abs(
+                                   np.linalg.eigvalsh(t)).max()))
+    assert np.abs(z.T @ z - np.eye(nn)).max() < nn * 1e-14
+    assert np.abs(t @ z - z * w).max() < nn * 1e-13 * max(1.0, np.abs(w).max())
+
+
+def test_stedc_values_only():
+    n = 100
+    d, e = RNG.standard_normal(n), RNG.standard_normal(n - 1)
+    w, z = stedc(d, e, compute_z=False)
+    assert z is None
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(_tridiag(d, e)),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_stedc_tiny():
+    w, z = stedc(np.array([3.0]), np.array([]))
+    assert w.shape == (1,) and z.shape == (1, 1)
+
+
+def test_he2td_reduction_invariants():
+    """Qᴴ·A·Q must equal tridiag(d, e) and Q must be unitary."""
+    from slate_tpu.linalg.eig import he2td, unmtr_he2td
+    n, nb = 112, 16  # ragged tiles
+    a = RNG.standard_normal((n, n))
+    a = (a + a.T) / 2
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    d, e, Vs, Ts = he2td(A)
+    npad = Vs.shape[1]
+    Q = np.asarray(unmtr_he2td(Vs, Ts, jnp.eye(npad, dtype=A.dtype)))
+    assert np.abs(Q.conj().T @ Q - np.eye(npad)).max() < n * 1e-13
+    apad = np.pad(a, ((0, npad - n), (0, npad - n)))
+    apad[range(n, npad), range(n, npad)] = 1.0
+    t = Q.conj().T @ apad @ Q
+    ref = _tridiag(np.asarray(d)[:n], np.asarray(e)[:n - 1])
+    assert np.abs(t[:n, :n] - ref).max() < n * 1e-13
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_heev_dc_matches_dense(dtype):
+    n, nb = 160, 32
+    a = RNG.standard_normal((n, n)).astype(dtype)
+    if np.iscomplexobj(a):
+        a = a + 1j * RNG.standard_normal((n, n))
+    a = (a + a.conj().T) / 2
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    w, Z = st.heev(A, st.Options(method_eig=MethodEig.DC))
+    wref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.asarray(w), wref, rtol=1e-10,
+                               atol=1e-10 * np.abs(wref).max())
+    z = Z.to_numpy()
+    res = np.abs(a @ z - z * np.asarray(w)).max()
+    orth = np.abs(z.conj().T @ z - np.eye(n)).max()
+    assert res < n * 1e-12 * max(1.0, np.abs(wref).max())
+    assert orth < n * 1e-13
+
+
+def test_heev_qr_method():
+    n, nb = 48, 16
+    a = RNG.standard_normal((n, n))
+    a = (a + a.T) / 2
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    w, Z = st.heev(A, st.Options(method_eig=MethodEig.QR))
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(a),
+                               rtol=1e-9, atol=1e-9)
+    z = Z.to_numpy()
+    assert np.abs(a @ z - z * np.asarray(w)).max() < 1e-9
+
+
+def test_heev_dc_values_only():
+    n, nb = 96, 16
+    a = RNG.standard_normal((n, n))
+    a = (a + a.T) / 2
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    w, Z = st.heev(A, st.Options(method_eig=MethodEig.DC),
+                   want_vectors=False)
+    assert Z is None
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(a),
+                               rtol=1e-11, atol=1e-11)
+
+
+@pytest.mark.parametrize("shape", [(128, 128), (150, 110), (110, 150)])
+def test_svd_dc_matches_dense(shape):
+    from slate_tpu.core.types import MethodSVD
+    m, n = shape
+    a = RNG.standard_normal((m, n))
+    A = st.from_dense(a, nb=32)
+    s, U, V = st.svd(A, st.Options(method_svd=MethodSVD.DC),
+                     want_vectors=True)
+    k = min(m, n)
+    sref = np.linalg.svd(a, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(s), sref, rtol=1e-11,
+                               atol=1e-11 * sref.max())
+    u, v = U.to_numpy(), V.to_numpy()
+    assert np.abs(u @ np.diag(np.asarray(s)) @ v.T - a).max() \
+        < k * 1e-12 * sref.max()
+    assert np.abs(u.T @ u - np.eye(k)).max() < k * 1e-13
+    assert np.abs(v.T @ v - np.eye(k)).max() < k * 1e-13
+
+
+def test_svd_dc_values_only():
+    from slate_tpu.core.types import MethodSVD
+    a = RNG.standard_normal((90, 90))
+    s, U, V = st.svd(st.from_dense(a, nb=16),
+                     st.Options(method_svd=MethodSVD.DC))
+    assert U is None and V is None
+    np.testing.assert_allclose(np.asarray(s),
+                               np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_bdsqr_no_densify_agrees():
+    """bdsqr via the Golub-Kahan permuted tridiagonal must reproduce
+    the singular values/vectors of the bidiagonal."""
+    from slate_tpu.linalg.svd import bdsqr
+    n = 60
+    d = RNG.standard_normal(n)
+    e = RNG.standard_normal(n - 1)
+    B = np.diag(d) + np.diag(e, 1)
+    s, u, vt = bdsqr(d, e, compute_uv=True)
+    sref = np.linalg.svd(B, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(s), sref, rtol=1e-12, atol=1e-12)
+    un, vtn = np.asarray(u), np.asarray(vt)
+    assert np.abs(B @ vtn.T - un * np.asarray(s)).max() < 1e-11
+    assert np.abs(un.T @ un - np.eye(n)).max() < 1e-11
+
+
+def test_hegv_with_dc():
+    n, nb = 96, 16
+    a = RNG.standard_normal((n, n)); a = (a + a.T) / 2
+    b = RNG.standard_normal((n, n)); b = b @ b.T + n * np.eye(n)
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    B = st.hermitian(np.tril(b), nb=nb, uplo=st.Uplo.Lower)
+    w, X, info = st.hegv(A, B, st.Options(method_eig=MethodEig.DC))
+    assert int(info) == 0
+    x = X.to_numpy()
+    res = np.abs(a @ x - (b @ x) * np.asarray(w)).max()
+    assert res < n * 1e-11 * max(1.0, np.abs(np.asarray(w)).max())
