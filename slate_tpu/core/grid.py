@@ -101,6 +101,14 @@ def inverse_permutation(perm: np.ndarray) -> np.ndarray:
     return inv
 
 
+def tile_perm_row_indices(perm: np.ndarray, nb: int) -> np.ndarray:
+    """Expand a tile permutation into element row indices: output row
+    t·nb + r reads input row perm[t]·nb + r. Shared by the cyclic
+    pack (shard(cyclic=True)) and unpack (_storage_logical) paths."""
+    return (np.asarray(perm)[:, None] * nb
+            + np.arange(nb, dtype=np.int64)[None, :]).ravel()
+
+
 @dataclasses.dataclass(frozen=True)
 class ProcessGrid:
     """A p×q grid of devices = jax Mesh with axes ("p", "q").

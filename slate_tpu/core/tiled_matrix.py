@@ -61,18 +61,27 @@ class TiledMatrix:
     kl: int = 0
     ku: int = 0
     grid: Optional[ProcessGrid] = None
+    # storage is 2D BLOCK-CYCLIC over the grid: storage tile-row s holds
+    # logical tile-row cyclic_permutation(mt, p)[s] (ditto columns over
+    # q). The ScaLAPACK-model layout (reference func::process_2d_grid,
+    # include/slate/func.hh:100-120): contiguous GSPMD shards of the
+    # permuted storage are exactly the cyclic tile sets, so each device
+    # owns tiles {i : i mod p == pi}. dense() unpermutes to logical
+    # order (one gather = collective-permute over ICI).
+    cyclic: bool = False
 
     # -- pytree ----------------------------------------------------------
     def tree_flatten(self):
         meta = (self.m, self.n, self.nb, self.kind, self.uplo, self.op,
-                self.diag, self.kl, self.ku, self.grid)
+                self.diag, self.kl, self.ku, self.grid, self.cyclic)
         return (self.data,), meta
 
     @classmethod
     def tree_unflatten(cls, meta, children):
         (data,) = children
-        m, n, nb, kind, uplo, op, diag, kl, ku, grid = meta
-        return cls(data, m, n, nb, kind, uplo, op, diag, kl, ku, grid)
+        m, n, nb, kind, uplo, op, diag, kl, ku, grid, cyclic = meta
+        return cls(data, m, n, nb, kind, uplo, op, diag, kl, ku, grid,
+                   cyclic)
 
     # -- shape / tiles (op-adjusted, like BaseMatrix::m()/n()/mt()/nt()) --
     @property
@@ -130,14 +139,33 @@ class TiledMatrix:
         return self.conj_transpose()
 
     # -- materialization -------------------------------------------------
+    def _storage_logical(self) -> jax.Array:
+        """Storage in logical (NoTrans) tile order — unpermutes cyclic
+        packing when present."""
+        if not self.cyclic:
+            return self.data
+        from .grid import (cyclic_permutation, inverse_permutation,
+                           tile_perm_row_indices)
+        p = self.grid.p if self.grid is not None else 1
+        q = self.grid.q if self.grid is not None else 1
+        nb = self.nb
+        mtp = self.data.shape[0] // nb
+        ntp = self.data.shape[1] // nb
+        ridx = tile_perm_row_indices(
+            inverse_permutation(cyclic_permutation(mtp, p)), nb)
+        cidx = tile_perm_row_indices(
+            inverse_permutation(cyclic_permutation(ntp, q)), nb)
+        return self.data[jnp.asarray(ridx)][:, jnp.asarray(cidx)]
+
     def dense(self) -> jax.Array:
         """Padded dense array with op applied (shape mt·nb × nt·nb of the
         view). The workhorse used by drivers; XLA fuses the transpose."""
+        base = self._storage_logical()
         if self.op is Op.NoTrans:
-            return self.data
+            return base
         if self.op is Op.Trans:
-            return self.data.T
-        return jnp.conj(self.data).T
+            return base.T
+        return jnp.conj(base).T
 
     def dense_canonical(self) -> jax.Array:
         """Padded dense of the view at the *canonical* size (mt·nb, nt·nb),
@@ -178,8 +206,12 @@ class TiledMatrix:
         """Materialize implicit structure: mirror the stored triangle for
         Symmetric/Hermitian kinds, apply unit diagonal / zero the strict
         opposite triangle for Triangular, band-mask Band kinds. Used by
-        checks, norms, and drivers that need an explicit operand."""
-        a = self.dense()
+        checks, norms, and drivers that need an explicit operand.
+
+        Operates at the CANONICAL (mt·nb, nt·nb) size: grid-rounding
+        padding can make raw storage non-square, and mirroring a
+        non-square array would be ill-formed."""
+        a = self.dense_canonical()
         npad = a.shape
         if self.kind in (MatrixKind.Symmetric, MatrixKind.Hermitian):
             tri_l = jnp.tril(a)
@@ -233,6 +265,9 @@ class TiledMatrix:
     def with_tile(self, i: int, j: int, val: jax.Array) -> "TiledMatrix":
         if self.op is not Op.NoTrans:
             raise SlateError("with_tile requires a NoTrans view")
+        if self.cyclic:
+            raise SlateError("with_tile requires contiguous (non-cyclic) "
+                             "storage; use shard(grid) first")
         data = jax.lax.dynamic_update_slice(self.data, val.astype(self.dtype),
                                             (i * self.nb, j * self.nb))
         return dataclasses.replace(self, data=data)
@@ -273,23 +308,36 @@ class TiledMatrix:
         return from_dense(a, self.nb, grid=self.grid, logical_shape=(sub_m, sub_n))
 
     # -- sharding --------------------------------------------------------
-    def shard(self, grid: ProcessGrid, spec: Optional[P] = None) -> "TiledMatrix":
+    def shard(self, grid: ProcessGrid, spec: Optional[P] = None,
+              cyclic: bool = False) -> "TiledMatrix":
         """Place storage on the grid with rows over 'p', cols over 'q'.
 
         The analog of constructing a matrix with process_2d_grid tileRank
         lambdas (func.hh:100-120). GSPMD requires even shards, so storage
         is padded up to tile counts divisible by (p, q) — the moral
-        equivalent of ScaLAPACK's padded local arrays."""
+        equivalent of ScaLAPACK's padded local arrays.
+
+        cyclic=True packs tiles 2D block-cyclically before sharding
+        (see the ``cyclic`` field): device (pi, qi) then owns exactly
+        the ScaLAPACK tile set {(i, j) : i mod p = pi, j mod q = qi}."""
+        from .grid import cyclic_permutation, tile_perm_row_indices
         spec = spec if spec is not None else grid.spec_2d()
         nb = self.nb
-        rows = -(-self.data.shape[0] // (grid.p * nb)) * grid.p * nb
-        cols = -(-self.data.shape[1] // (grid.q * nb)) * grid.q * nb
-        data = self.data
+        data = self._storage_logical()
+        rows = -(-data.shape[0] // (grid.p * nb)) * grid.p * nb
+        cols = -(-data.shape[1] // (grid.q * nb)) * grid.q * nb
         if (rows, cols) != data.shape:
             data = jnp.pad(data, ((0, rows - data.shape[0]),
                                   (0, cols - data.shape[1])))
+        if cyclic:
+            ridx = tile_perm_row_indices(
+                cyclic_permutation(rows // nb, grid.p), nb)
+            cidx = tile_perm_row_indices(
+                cyclic_permutation(cols // nb, grid.q), nb)
+            data = data[jnp.asarray(ridx)][:, jnp.asarray(cidx)]
         data = jax.device_put(data, NamedSharding(grid.mesh, spec))
-        return dataclasses.replace(self, data=data, grid=grid)
+        return dataclasses.replace(self, data=data, grid=grid,
+                                   cyclic=cyclic)
 
     def constrain(self, spec: P) -> "TiledMatrix":
         """with_sharding_constraint under jit (needs self.grid)."""
@@ -397,11 +445,11 @@ def triangular_band(a, nb: int, kd: int, uplo: Uplo, diag: Diag = Diag.NonUnit,
 
 
 def pad_mask(t: TiledMatrix) -> jax.Array:
-    """Boolean mask of logical (non-padding) entries of the padded view."""
+    """Boolean mask of logical (non-padding) entries at the canonical
+    padded size (matches full_dense())."""
     mm, nn = t.shape
-    a = t.dense()
-    r = jnp.arange(a.shape[0])[:, None] < mm
-    c = jnp.arange(a.shape[1])[None, :] < nn
+    r = jnp.arange(t.mt * t.nb)[:, None] < mm
+    c = jnp.arange(t.nt * t.nb)[None, :] < nn
     return r & c
 
 
@@ -420,4 +468,6 @@ def pad_diag_identity(t: TiledMatrix) -> TiledMatrix:
     """Put 1 on the padded part of the diagonal so factorizations of the
     padded storage stay well-defined (SURVEY §7 risk (v)). The padding is
     cropped away by to_dense(), and zero rhs padding keeps solves exact."""
+    if t.cyclic:
+        raise SlateError("pad_diag_identity requires contiguous storage")
     return t.with_data(unit_pad_diag(t.data, t.m, t.n))

@@ -125,6 +125,9 @@ class MethodGemm(enum.Enum):
     Auto = "auto"
     A = "A"  # stationary-A: partial products where A lives, then reduce
     C = "C"  # stationary-C: broadcast A column / B row panels (SUMMA)
+    # explicit hand-scheduled SUMMA via shard_map + ring broadcasts
+    # (parallel/summa.gemm_summa) instead of GSPMD constraint inference
+    SUMMA = "summa"
 
 
 class MethodTrsm(enum.Enum):

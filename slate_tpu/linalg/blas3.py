@@ -59,6 +59,30 @@ def _check_dims(am, an, bm, bn, cm, cn):
                          f" -> ({cm}x{cn})")
 
 
+def _grid_of(*mats):
+    for m in mats:
+        if m.grid is not None and m.grid.size > 1:
+            return m.grid
+    return None
+
+
+def _constrain_product(left, right, grid):
+    """Stationary-C constraint recipe for one product left·right: the
+    contraction panels are gathered (the reference's listBcast sets,
+    src/gemmC.cc) while the result stays 2D-sharded."""
+    mesh = grid.mesh
+    left = jax.lax.with_sharding_constraint(
+        left, NamedSharding(mesh, P(ROW_AXIS, None)))
+    right = jax.lax.with_sharding_constraint(
+        right, NamedSharding(mesh, P(None, COL_AXIS)))
+    return left, right
+
+
+def _constrain_out(out, grid):
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(grid.mesh, grid.spec_2d()))
+
+
 def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """C ← α·op(A)·op(B) + β·C  (slate::gemm, src/gemm.cc)."""
@@ -71,20 +95,23 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
     if method is MethodGemm.Auto:
         # reference: gemmA iff C is narrow (B.nt() < 2), src/gemm.cc:12-23
         method = MethodGemm.A if B.nt < 2 else MethodGemm.C
+    if method is MethodGemm.SUMMA:
+        # explicit collective schedule (shard_map ring broadcasts) —
+        # the hand-written analog of the reference's gemmC bcast loop
+        from ..parallel.summa import gemm_summa
+        out = gemm_summa(alpha, A, B, beta, C)
+        return out
 
     a = A.dense_canonical()
     b = B.dense_canonical()
     c = C.dense_canonical()
 
-    grid = C.grid or A.grid or B.grid
-    if grid is not None and grid.size > 1:
+    grid = _grid_of(C, A, B)
+    if grid is not None:
         mesh = grid.mesh
         if method is MethodGemm.C:
             # stationary-C SUMMA: gather k-panels, keep C 2D-sharded
-            a = jax.lax.with_sharding_constraint(
-                a, NamedSharding(mesh, P(ROW_AXIS, None)))
-            b = jax.lax.with_sharding_constraint(
-                b, NamedSharding(mesh, P(None, COL_AXIS)))
+            a, b = _constrain_product(a, b, grid)
         else:
             # stationary-A: A keeps 2D shards; contraction dim sharded on
             # 'q' => XLA reduces partial products into C (listReduce analog)
@@ -93,9 +120,8 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
             b = jax.lax.with_sharding_constraint(
                 b, NamedSharding(mesh, P(COL_AXIS, None)))
     out = tile_ops.gemm(alpha, a, b, beta, c)
-    if grid is not None and grid.size > 1:
-        out = jax.lax.with_sharding_constraint(
-            out, NamedSharding(grid.mesh, grid.spec_2d()))
+    if grid is not None:
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -110,10 +136,17 @@ def symm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
     a = A.full_dense_canonical()
     b = B.dense_canonical()
     c = C.dense_canonical()
+    grid = _grid_of(C, A, B)
     if side is Side.Left:
+        if grid is not None:
+            a, b = _constrain_product(a, b, grid)
         out = alpha * (a @ b) + beta * c
     else:
+        if grid is not None:
+            b, a = _constrain_product(b, a, grid)
         out = alpha * (b @ a) + beta * c
+    if grid is not None:
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -125,8 +158,16 @@ def hemm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
     a = A.full_dense_canonical()
     b = B.dense_canonical()
     c = C.dense_canonical()
+    grid = _grid_of(C, A, B)
+    if grid is not None:
+        if side is Side.Left:
+            a, b = _constrain_product(a, b, grid)
+        else:
+            b, a = _constrain_product(b, a, grid)
     out = alpha * (a @ b) + beta * c if side is Side.Left \
         else alpha * (b @ a) + beta * c
+    if grid is not None:
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -177,7 +218,15 @@ def trmm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
         raise SlateError("trmm: A must be triangular")
     a = A.full_dense_canonical()
     b = B.dense_canonical()
+    grid = _grid_of(B, A)
+    if grid is not None:
+        if side is Side.Left:
+            a, b = _constrain_product(a, b, grid)
+        else:
+            b, a = _constrain_product(b, a, grid)
     out = alpha * (a @ b) if side is Side.Left else alpha * (b @ a)
+    if grid is not None:
+        out = _constrain_out(out, grid)
     return _wrap_like(B, out)
 
 
@@ -208,6 +257,9 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
         unit=(A.diag is Diag.Unit),
         prec=opts.update_precision,
         base=min(A.nb, a.shape[0]))
+    grid = _grid_of(B, A)
+    if grid is not None:
+        x = _constrain_out(x, grid)
     return _wrap_like(B, x)
 
 

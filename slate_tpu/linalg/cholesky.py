@@ -108,9 +108,11 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
         return _tile_chol(a)
     h = blocked._half(s, nb)
     l11, i1 = _potrf_rec(a[:h, :h], nb, prec)
-    l21 = blocked.trsm_rec(l11, a[h:, :h], left=False, lower=True,
-                           conj_a=True, trans_a=True, prec=prec, base=nb)
-    a22 = blocked.herk_lower_rec(a[h:, h:], l21, prec=prec)
+    l21 = blocked.rebalance(
+        blocked.trsm_rec(l11, a[h:, :h], left=False, lower=True,
+                         conj_a=True, trans_a=True, prec=prec, base=nb))
+    a22 = blocked.rebalance(
+        blocked.herk_lower_rec(a[h:, h:], l21, prec=prec))
     l22, i2 = _potrf_rec(a22, nb, prec)
     out = jnp.concatenate([
         jnp.concatenate([l11, a[:h, h:]], axis=1),
@@ -142,7 +144,8 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     a = A.full_dense_canonical()
     a = unit_pad_diag(a, n, n)
     nt = A.mt
-    lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision)
+    with blocked.distribute_on(A.grid):
+        lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision)
     if A.uplo is Uplo.Upper:
         out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
                          kind=MatrixKind.Triangular, uplo=Uplo.Upper,

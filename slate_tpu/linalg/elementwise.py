@@ -28,7 +28,10 @@ def add(alpha, A: TiledMatrix, beta, B: TiledMatrix,
     if A.shape != B.shape:
         raise SlateError("add: shape mismatch")
     out = alpha * A.dense_canonical() + beta * B.dense_canonical()
-    return B.with_data(out) if B.data.shape == out.shape and B.op.value == "n" \
+    # out is in logical order — with_data is only valid for contiguous
+    # NoTrans storage of the same shape
+    return B.with_data(out) if (B.data.shape == out.shape
+                                and B.op.value == "n" and not B.cyclic) \
         else from_dense(out, B.nb, grid=B.grid, kind=B.kind, uplo=B.uplo,
                         diag=B.diag, kl=B.kl, ku=B.ku, logical_shape=B.shape)
 

@@ -87,7 +87,8 @@ def _getrf_rec(a: Array, nb: int, prec):
     # U12 = L11⁻¹ · A12 (unit-lower block solve, gemm-based)
     u_top = blocked.trsm_rec(lu1[:h, :h], right[:h], left=True, lower=True,
                              unit=True, prec=prec, base=min(nb, h))
-    schur = right[h:] - blocked.mm(lu1[h:, :h], u_top, prec)
+    schur = blocked.rebalance(
+        right[h:] - blocked.mm(lu1[h:, :h], u_top, prec))
     lu2, p2, i2 = _getrf_rec(schur, nb, prec)
     low_left = blocked.permute_rows_limited(lu1[h:, :h], p2, 2 * (w - h))
     lu = jnp.concatenate([
@@ -131,8 +132,9 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     m, n = A.shape
     a = _canonical(A)
     a = _pad_identity_diag(a, m, n)
-    lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
-                                    prec=opts.update_precision)
+    with blocked.distribute_on(A.grid):
+        lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
+                                        prec=opts.update_precision)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 

@@ -122,27 +122,29 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     mpad, npad = a.shape
     kt = -(-min(m, n) // nb)  # panels covering the logical diagonal
     ts = []
-    for k in range(kt):
-        k0, k1 = k * nb, min((k + 1) * nb, npad)
-        w = k1 - k0
-        rows = mpad - k0
-        hb = blocked.bucket_pow2(rows, nb)
-        panel = a[k0:, k0:k1]
-        if hb > rows:
-            panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
-        vr, taus, t = blocked.panel_geqrf_with_t(panel)
-        vr = vr[:rows]
-        v = jnp.tril(vr, -1)
-        v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
-        if w < nb:  # ragged final panel: embed into (nb, nb)
-            t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
-        ts.append(t)
-        # store R rows + V below diagonal
-        a = a.at[k0:, k0:k1].set(jnp.triu(vr) + v -
-                                 jnp.eye(rows, w, dtype=a.dtype))
-        if k1 < npad:
-            a = a.at[k0:, k1:].set(
-                _apply_block_reflector_H(v, t[:w, :w], a[k0:, k1:], prec))
+    with blocked.distribute_on(A.grid):
+        for k in range(kt):
+            k0, k1 = k * nb, min((k + 1) * nb, npad)
+            w = k1 - k0
+            rows = mpad - k0
+            hb = blocked.bucket_pow2(rows, nb)
+            panel = a[k0:, k0:k1]
+            if hb > rows:
+                panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
+            vr, taus, t = blocked.panel_geqrf_with_t(panel)
+            vr = vr[:rows]
+            v = jnp.tril(vr, -1)
+            v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+            if w < nb:  # ragged final panel: embed into (nb, nb)
+                t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
+            ts.append(t)
+            # store R rows + V below diagonal
+            a = a.at[k0:, k0:k1].set(jnp.triu(vr) + v -
+                                     jnp.eye(rows, w, dtype=a.dtype))
+            if k1 < npad:
+                a = a.at[k0:, k1:].set(blocked.rebalance(
+                    _apply_block_reflector_H(v, t[:w, :w],
+                                             a[k0:, k1:], prec)))
     t_all = jnp.stack(ts) if ts else jnp.zeros((0, nb, nb), a.dtype)
     return QRFactors(a, t_all, m, n, nb)
 

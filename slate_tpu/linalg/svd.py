@@ -37,6 +37,7 @@ Array = jax.Array
 
 _DC_MIN_N = 2048   # MethodSVD.Auto engages the DC path above this order
 _BD_PANEL = 32     # labrd panel width for the device bidiagonalization
+_BD_EPS = float(np.finfo(np.float64).eps)
 
 
 def _panel_reflector(panel: Array):
@@ -254,6 +255,13 @@ def bdsqr(d, e, compute_uv: bool = False):
     compute_uv)."""
     from .stedc import stedc as stedc_fn
 
+    if np.iscomplexobj(d) or np.iscomplexobj(e):
+        # same contract as LAPACK zbdsqr: the bidiagonal of a proper
+        # gebrd/ge2tb is REAL even for complex A (phases are absorbed
+        # into Q/P); a complex (d, e) indicates a caller bug
+        raise SlateError("bdsqr: d and e must be real (complex matrices "
+                         "carry a real bidiagonal; absorb phases into "
+                         "the left/right transforms)")
     d = np.asarray(d, np.float64)
     e = np.asarray(e, np.float64)
     k = d.shape[0]
@@ -281,8 +289,23 @@ def bdsqr(d, e, compute_uv: bool = False):
     u = u / np.where(un == 0, 1.0, un)
     v = v / np.where(vn == 0, 1.0, vn)
     order = np.argsort(sig)[::-1]
-    return (jnp.asarray(sig[order].copy()), jnp.asarray(u[:, order].copy()),
-            jnp.asarray(v[:, order].T.copy()))
+    sig = sig[order].copy()
+    u = u[:, order]
+    v = v[:, order]
+    # rank deficiency: the ±0 eigenspace of the GK matrix mixes u/v
+    # pairs arbitrarily, so the σ≈0 columns are not orthonormal.
+    # Rebuild them as an orthonormal completion of the σ>tol columns —
+    # span(v_good)⊥ = null(B) and span(u_good)⊥ = null(Bᴴ), so the
+    # completed columns are genuine null-space singular vectors.
+    tol = max(sig[0] if k else 0.0, 0.0) * 8 * k * _BD_EPS
+    g = int((sig > tol).sum())
+    if g < k:
+        for mat in (u, v):
+            qc, _ = np.linalg.qr(
+                np.concatenate([mat[:, :g], np.eye(k)], axis=1))
+            mat[:, g:] = qc[:, g:k]
+    return (jnp.asarray(sig), jnp.asarray(u.copy()),
+            jnp.asarray(v.T.copy()))
 
 
 def _svd_dc(A: TiledMatrix, opts: Options, want_vectors: bool):

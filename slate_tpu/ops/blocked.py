@@ -41,6 +41,8 @@ core/precision.py.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 from typing import Optional, Tuple
 
@@ -49,6 +51,44 @@ import jax.numpy as jnp
 from jax import lax
 
 Array = jax.Array
+
+# Distribution context for the factorization recursions: when a driver
+# runs on a multi-device grid it installs the grid here, and rebalance()
+# pins intermediates (trailing submatrices, panels) to the full 2D mesh.
+# This is the TPU-native replacement for the reference's static 2D
+# block-cyclic layout (include/slate/func.hh:179): instead of fixing a
+# cyclic tile→rank map up front (an MPI-world necessity — redistribution
+# is expensive there), every recursion level re-shards its shrinking
+# trailing submatrix evenly over ALL devices, so no device goes idle as
+# the factorization proceeds. XLA turns each constraint into
+# collective-permute/all-gather traffic over ICI.
+_GRID_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "slate_tpu_factor_grid", default=None)
+
+
+@contextlib.contextmanager
+def distribute_on(grid):
+    """Install ``grid`` as the factorization distribution context (used
+    by drivers; None or a single-device grid disables rebalancing)."""
+    use = grid if (grid is not None and grid.size > 1) else None
+    tok = _GRID_CTX.set(use)
+    try:
+        yield
+    finally:
+        _GRID_CTX.reset(tok)
+
+
+def rebalance(x: Array) -> Array:
+    """Constrain a 2-D intermediate to the active grid's (p, q) spec —
+    the per-level load-balancing resharding (see _GRID_CTX). No-op
+    without an active multi-device grid."""
+    g = _GRID_CTX.get()
+    if g is None or x.ndim != 2:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..core.grid import COL_AXIS, ROW_AXIS
+    return lax.with_sharding_constraint(
+        x, NamedSharding(g.mesh, P(ROW_AXIS, COL_AXIS)))
 
 # base sizes, chosen for TPU: ib such that the fori-loop bases touch
 # O(m·nb·ib) bytes total; bases for recursion chosen so leaf ops stay

@@ -1,0 +1,197 @@
+"""Distributed layout + collective-insertion tests (8-device CPU mesh).
+
+VERDICT round-1 items 5/6: the block-cyclic storage mode must be real
+(device tile ownership matching the ScaLAPACK map), factorizations must
+keep outputs sharded (not silently replicated) and agree with the 1×1
+grid bit-for-bit at the logical level, and collective ops must actually
+appear in the compiled HLO (no "GSPMD silently replicates" regression).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.grid import cyclic_permutation
+from slate_tpu.core.types import MethodGemm
+
+RNG = np.random.default_rng(11)
+
+
+def _spd(n, dtype=np.float64):
+    a = RNG.standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+# -- block-cyclic storage ---------------------------------------------------
+
+def test_cyclic_shard_roundtrip(grid2x4):
+    m, n, nb = 144, 208, 16
+    a = RNG.standard_normal((m, n))
+    A = st.from_dense(a, nb=nb).shard(grid2x4, cyclic=True)
+    assert A.cyclic
+    np.testing.assert_array_equal(A.to_numpy(), a)
+    # re-shard back to contiguous
+    B = A.shard(grid2x4)
+    assert not B.cyclic
+    np.testing.assert_array_equal(B.to_numpy(), a)
+
+
+def test_cyclic_device_ownership(grid2x4):
+    """Device (pi, qi) must hold exactly the ScaLAPACK cyclic tile set
+    {(i, j) : i mod p == pi, j mod q == qi}."""
+    n, nb = 128, 16
+    p, q = grid2x4.p, grid2x4.q
+    a = np.arange(n * n, dtype=np.float64).reshape(n, n)
+    A = st.from_dense(a, nb=nb).shard(grid2x4, cyclic=True)
+    mtp = A.data.shape[0] // nb
+    ntp = A.data.shape[1] // nb
+    perm_r = cyclic_permutation(mtp, p)
+    perm_c = cyclic_permutation(ntp, q)
+    for shard in A.data.addressable_shards:
+        r0, c0 = shard.index[0].start or 0, shard.index[1].start or 0
+        local = np.asarray(shard.data)
+        # every tile in this shard must be a cyclic-owned logical tile
+        for it in range(local.shape[0] // nb):
+            for jt in range(local.shape[1] // nb):
+                gi = perm_r[r0 // nb + it]
+                gj = perm_c[c0 // nb + jt]
+                np.testing.assert_array_equal(
+                    local[it * nb:(it + 1) * nb, jt * nb:(jt + 1) * nb],
+                    a[gi * nb:(gi + 1) * nb, gj * nb:(gj + 1) * nb])
+                # and ownership must follow the ScaLAPACK map
+                dev_row = (r0 // nb) // (mtp // p)
+                dev_col = (c0 // nb) // (ntp // q)
+                assert gi % p == dev_row and gj % q == dev_col
+
+
+def test_factorizations_accept_cyclic_input(grid2x4):
+    n, nb = 192, 16
+    a = _spd(n)
+    rhs = RNG.standard_normal((n, 3))
+    A1 = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+    Ac = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower).shard(
+        grid2x4, cyclic=True)
+    X1, i1 = st.posv(A1, st.from_dense(rhs, nb=nb))
+    Xc, ic = st.posv(Ac, st.from_dense(rhs, nb=nb, grid=grid2x4))
+    assert int(i1) == int(ic) == 0
+    np.testing.assert_allclose(Xc.to_numpy(), X1.to_numpy(), rtol=1e-12,
+                               atol=1e-12)
+
+
+# -- sharded outputs + 1x1-grid agreement ----------------------------------
+
+@pytest.mark.parametrize("routine", ["potrf", "getrf", "geqrf"])
+def test_factorization_outputs_stay_sharded(grid2x4, routine):
+    n, nb = 256, 32
+    if routine == "potrf":
+        a = _spd(n)
+        A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower,
+                         grid=grid2x4)
+        out, _ = st.potrf(A)
+        data = out.data
+    elif routine == "getrf":
+        a = RNG.standard_normal((n, n))
+        A = st.from_dense(a, nb=nb, grid=grid2x4)
+        out, _, _ = st.getrf(A)
+        data = out.data
+    else:
+        a = RNG.standard_normal((n + 64, n))
+        A = st.from_dense(a, nb=nb, grid=grid2x4)
+        qr = st.geqrf(A)
+        data = qr.vr
+    assert len(data.sharding.device_set) == 8, \
+        f"{routine}: output collapsed to {data.sharding.device_set}"
+    assert not data.sharding.is_fully_replicated, \
+        f"{routine}: output silently replicated"
+
+
+@pytest.mark.parametrize("routine", ["potrf", "getrf", "geqrf"])
+def test_grid_matches_single_device(grid2x4, routine):
+    n, nb = 256, 32
+    if routine == "potrf":
+        a = _spd(n)
+        M1 = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+        Mg = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower,
+                          grid=grid2x4)
+        r1 = st.potrf(M1)[0].to_numpy()
+        rg = st.potrf(Mg)[0].to_numpy()
+    elif routine == "getrf":
+        a = RNG.standard_normal((n, n))
+        r1 = st.getrf(st.from_dense(a, nb=nb))[0].to_numpy()
+        rg = st.getrf(st.from_dense(a, nb=nb, grid=grid2x4))[0].to_numpy()
+    else:
+        a = RNG.standard_normal((n + 64, n))
+        r1 = st.geqrf(st.from_dense(a, nb=nb)).vr
+        rg = st.geqrf(st.from_dense(a, nb=nb, grid=grid2x4)).vr
+        r1, rg = np.asarray(r1), np.asarray(rg)
+    np.testing.assert_allclose(rg, r1, rtol=1e-13, atol=1e-13)
+
+
+# -- collective insertion asserted on compiled HLO --------------------------
+
+_COLLECTIVES = ("all-gather", "all-reduce", "collective-permute",
+                "reduce-scatter", "all-to-all")
+
+
+def _collective_count(fn, *args):
+    txt = jax.jit(fn).lower(*args).compile().as_text()
+    return sum(txt.count(c) for c in _COLLECTIVES)
+
+
+def test_hlo_gemm_has_collectives(grid2x4):
+    n, nb = 128, 16
+    A = st.from_dense(RNG.standard_normal((n, n)), nb=nb, grid=grid2x4)
+    B = st.from_dense(RNG.standard_normal((n, n)), nb=nb, grid=grid2x4)
+    C = st.from_dense(np.zeros((n, n)), nb=nb, grid=grid2x4)
+
+    def f(A, B, C):
+        return st.gemm(1.0, A, B, 0.0, C).data
+
+    assert _collective_count(f, A, B, C) > 0
+
+
+def test_hlo_potrf_has_collectives(grid2x4):
+    n, nb = 256, 32
+    a = _spd(n)
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower, grid=grid2x4)
+
+    def f(A):
+        return st.potrf(A)[0].data
+
+    assert _collective_count(f, A) > 0, \
+        "potrf compiled without any collective: GSPMD replicated the work"
+
+
+def test_hlo_hemm_trsm_have_collectives(grid2x4):
+    n, nb = 128, 16
+    a = _spd(n)
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower, grid=grid2x4)
+    L = st.triangular(np.tril(a), nb=nb, uplo=st.Uplo.Lower, grid=grid2x4)
+    B = st.from_dense(RNG.standard_normal((n, n)), nb=nb, grid=grid2x4)
+
+    def f_hemm(A, B):
+        return st.hemm(st.Side.Left, 1.0, A, B, 0.0, B).data
+
+    def f_trsm(L, B):
+        return st.trsm(st.Side.Left, 1.0, L, B).data
+
+    assert _collective_count(f_hemm, A, B) > 0
+    assert _collective_count(f_trsm, L, B) > 0
+
+
+# -- explicit SUMMA routing -------------------------------------------------
+
+def test_method_gemm_summa_routing(grid2x4):
+    n, nb = 128, 16
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, n))
+    c = RNG.standard_normal((n, n))
+    A = st.from_dense(a, nb=nb, grid=grid2x4)
+    B = st.from_dense(b, nb=nb, grid=grid2x4)
+    C = st.from_dense(c, nb=nb, grid=grid2x4)
+    out = st.gemm(2.0, A, B, -1.0, C,
+                  st.Options(method_gemm=MethodGemm.SUMMA))
+    np.testing.assert_allclose(out.to_numpy(), 2.0 * a @ b - c,
+                               rtol=1e-10, atol=1e-10)

@@ -173,6 +173,30 @@ def test_bdsqr_no_densify_agrees():
     assert np.abs(un.T @ un - np.eye(n)).max() < 1e-11
 
 
+def test_svd_dc_rank_deficient_orthonormal():
+    """σ ≈ 0 columns must still form orthonormal null-space bases (the
+    GK ±0 eigenspace mixes u/v pairs; bdsqr rebuilds the deficient
+    columns by orthonormal completion)."""
+    from slate_tpu.core.types import MethodSVD
+    m, n, r = 90, 90, 5
+    a = (RNG.standard_normal((m, r)) @ RNG.standard_normal((r, n)))
+    s, U, V = st.svd(st.from_dense(a, nb=16),
+                     st.Options(method_svd=MethodSVD.DC),
+                     want_vectors=True)
+    u, v, sn = U.to_numpy(), V.to_numpy(), np.asarray(s)
+    assert np.abs(u.T @ u - np.eye(n)).max() < n * 1e-12
+    assert np.abs(v.T @ v - np.eye(n)).max() < n * 1e-12
+    assert np.abs(u @ np.diag(sn) @ v.T - a).max() < n * 1e-11 * sn.max()
+    assert (sn[r:] < sn.max() * 1e-10).all()
+
+
+def test_bdsqr_complex_raises():
+    import pytest as _pytest
+    from slate_tpu.linalg.svd import bdsqr
+    with _pytest.raises(Exception, match="real"):
+        bdsqr(np.ones(4) + 1j, np.ones(3))
+
+
 def test_hegv_with_dc():
     n, nb = 96, 16
     a = RNG.standard_normal((n, n)); a = (a + a.T) / 2
