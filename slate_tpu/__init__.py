@@ -23,6 +23,7 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
+                     PackedBand, BandLU, pb_pack, gb_pack, tbsm_packed,
                      gecondest, pocondest, trcondest, hesv, hetrf, hetrs,
                      heev, hegv, hegst, he2hb, he2td, unmtr_he2hb,
                      unmtr_he2td, steqr, sterf,

@@ -15,6 +15,7 @@ from .core.tiled_matrix import TiledMatrix
 from .core.types import MatrixKind, Options, Side, DEFAULT_OPTIONS
 from .linalg import (blas3, band as band_mod, cholesky, indefinite, lu as
                      lu_mod, qr as qr_mod)
+from .linalg.band_packed import PackedBand
 
 
 def multiply(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
@@ -71,6 +72,9 @@ def lu_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
 
 def lu_solve(A: TiledMatrix, B: TiledMatrix,
              opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if isinstance(A, PackedBand):
+        X, info = band_mod.gbsv(A, B, opts)
+        return X
     if A.kind is MatrixKind.Band:
         X, info = band_mod.gbsv(A, B, opts)
         return X
@@ -88,6 +92,8 @@ def lu_inverse_using_factor(LU, perm, opts: Options = DEFAULT_OPTIONS):
 
 
 def chol_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    if isinstance(A, PackedBand):
+        return band_mod.pbtrf(A, opts)
     if A.kind is MatrixKind.HermitianBand:
         return band_mod.pbtrf(A, opts)
     return cholesky.potrf(A, opts)
@@ -95,6 +101,9 @@ def chol_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
 
 def chol_solve(A: TiledMatrix, B: TiledMatrix,
                opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if isinstance(A, PackedBand):
+        X, _ = band_mod.pbsv(A, B, opts)
+        return X
     if A.kind is MatrixKind.HermitianBand:
         X, info = band_mod.pbsv(A, B, opts)
         return X
@@ -113,6 +122,12 @@ def chol_inverse_using_factor(L, opts: Options = DEFAULT_OPTIONS):
 
 def band_solve(A: TiledMatrix, B: TiledMatrix,
                opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if isinstance(A, PackedBand):
+        if A.hermitian:
+            X, _ = band_mod.pbsv(A, B, opts)
+        else:
+            X, _ = band_mod.gbsv(A, B, opts)
+        return X
     if A.kind is MatrixKind.HermitianBand:
         X, _ = band_mod.pbsv(A, B, opts)
         return X

@@ -9,6 +9,8 @@ from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
 from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
                  qr_multiply_explicit)
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
+from .band_packed import PackedBand, BandLU, pb_pack, gb_pack
+from .band_packed import tbsm as tbsm_packed
 from .eig import (heev, hegv, hegst, he2hb, he2td, unmtr_he2hb,
                   unmtr_he2td, steqr, sterf)
 from .svd import svd, ge2tb, bdsqr

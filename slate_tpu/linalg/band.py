@@ -6,13 +6,13 @@ src/pbtrf.cc, src/pbtrs.cc — band variants of the dense drivers operating
 on BandMatrix/HermitianBandMatrix tile storage (only tiles within the
 band exist; partial pivoting in gbtrf fills the band out to kl+ku).
 
-Round-1 TPU design: band structure lives in the (kl, ku) mask of
-TiledMatrix (full_dense applies it); the factorizations reuse the dense
-blocked kernels, which on TPU is usually the *right* trade — the MXU
-prefers one dense matmul over many skinny band updates, and XLA cannot
-exploit the zero blocks anyway without a packed layout. A packed band
-layout (storing only the O(n·(kl+ku)) band) is the flagged follow-up for
-memory-bound cases.
+Two storage paths, dispatched on the input type:
+- ``PackedBand`` (linalg/band_packed.py): TRUE packed band storage —
+  O(n·(kl+ku)) memory, band-exploiting scan kernels. The path for large
+  n (pbsv at n=65536, kd=512 fits where dense would need 17 GB).
+- ``TiledMatrix`` band kinds: the (kl, ku)-masked dense representation;
+  factorizations reuse the dense blocked kernels. Fine at small/medium
+  n where one dense MXU matmul beats many skinny band updates.
 """
 
 from __future__ import annotations
@@ -27,8 +27,15 @@ from ..core.tiled_matrix import TiledMatrix, from_dense
 from ..core.types import MatrixKind, Options, Uplo, DEFAULT_OPTIONS
 from . import cholesky as chol
 from . import lu as lu_mod
+from . import band_packed as _packed
+from .band_packed import PackedBand, pb_pack, gb_pack, BandLU
 
 Array = jax.Array
+
+
+def _rhs_dense(B):
+    """Accept TiledMatrix or plain-array right-hand sides."""
+    return B.to_dense() if isinstance(B, TiledMatrix) else B
 
 
 def gbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
@@ -37,6 +44,9 @@ def gbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
 
     Pivoting fills the upper band out to kl+ku (same as the reference,
     which allocates the extra super-diagonal tiles)."""
+    if isinstance(A, PackedBand):
+        F, info = _packed.gbtrf(A)
+        return F, F.pivots, info  # same arity as the dense path
     if A.kind is not MatrixKind.Band:
         raise SlateError("gbtrf: A must be a band matrix")
     dense = TiledMatrix(A.full_dense_canonical(), A.shape[0], A.shape[1], A.nb,
@@ -52,6 +62,10 @@ def gbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
 def gbtrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Solve from gbtrf factors (slate::gbtrs — tbsm sweeps)."""
+    if isinstance(LU, BandLU):
+        # perm is carried inside BandLU (in-band offsets); the explicit
+        # argument is accepted for signature parity and ignored
+        return _packed.gbtrs(LU, _rhs_dense(B))
     dense = TiledMatrix(LU.data, LU.shape[0], LU.shape[1], LU.nb,
                         grid=LU.grid)
     return lu_mod.getrs(dense, perm, B, opts)
@@ -60,6 +74,8 @@ def gbtrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
 def gbsv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> Tuple[TiledMatrix, Array]:
     """slate::gbsv = gbtrf + gbtrs (src/gbsv.cc)."""
+    if isinstance(A, PackedBand):
+        return _packed.gbsv(A, _rhs_dense(B))
     LU, perm, info = gbtrf(A, opts)
     X = gbtrs(LU, perm, B, opts)
     return X, info
@@ -69,6 +85,8 @@ def pbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
           ) -> Tuple[TiledMatrix, Array]:
     """Band Cholesky (slate::pbtrf, src/pbtrf.cc). The factor keeps the
     band: L has bandwidth kd (no fill outside the band)."""
+    if isinstance(A, PackedBand):
+        return _packed.pbtrf(A, nb=opts.block_size)
     if A.kind is not MatrixKind.HermitianBand:
         raise SlateError("pbtrf: A must be Hermitian band")
     kd = A.kl or A.ku
@@ -85,6 +103,8 @@ def pbtrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
 def pbtrs(L: TiledMatrix, B: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Solve from pbtrf factors (slate::pbtrs — two tbsm sweeps)."""
+    if isinstance(L, PackedBand):
+        return _packed.pbtrs(L, _rhs_dense(B), nb=opts.block_size)
     tri = TiledMatrix(L.full_dense_canonical(), L.shape[0], L.shape[1], L.nb,
                       kind=MatrixKind.Triangular, uplo=L.uplo, grid=L.grid)
     return chol.potrs(tri, B, opts)
@@ -93,6 +113,8 @@ def pbtrs(L: TiledMatrix, B: TiledMatrix,
 def pbsv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> Tuple[TiledMatrix, Array]:
     """slate::pbsv = pbtrf + pbtrs (src/pbsv.cc)."""
+    if isinstance(A, PackedBand):
+        return _packed.pbsv(A, _rhs_dense(B), nb=opts.block_size)
     L, info = pbtrf(A, opts)
     X = pbtrs(L, B, opts)
     return X, info
