@@ -85,7 +85,7 @@ def bench_gemm(n=8192, nb=512, dtype=jnp.float32):
     return 2.0 * n * n * n / 1e9 / t, t
 
 
-def bench_potrf(n=8192, nb=512, dtype=jnp.float32):
+def bench_potrf(n=8192, nb=1024, dtype=jnp.float32):
     import slate_tpu as st
     from slate_tpu.core.types import Uplo
     from slate_tpu.matgen import random_spd
@@ -104,23 +104,64 @@ def bench_potrf(n=8192, nb=512, dtype=jnp.float32):
     return (n ** 3 / 3.0) / 1e9 / t, t
 
 
+def bench_getrf(n=8192, nb=1024, dtype=jnp.float32):
+    import slate_tpu as st
+    from slate_tpu.matgen import generate_matrix
+
+    a = generate_matrix("randn", n, n, dtype, seed=4)
+    # diagonal dominance keeps the iterated factor chain stable
+    a = a + n * jnp.eye(n, dtype=dtype)
+    A = st.from_dense(a, nb=nb)
+
+    def step(a_data, cs):
+        (A,) = cs
+        LU, perm, _ = st.getrf(A.with_data(a_data))
+        return a_data + 1e-30 * LU.data
+
+    t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
+    return (2.0 * n ** 3 / 3.0) / 1e9 / t, t
+
+
+def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32):
+    import slate_tpu as st
+    from slate_tpu.matgen import generate_matrix
+
+    a = generate_matrix("randn", n, n, dtype, seed=5)
+    A = st.from_dense(a, nb=nb)
+
+    def step(a_data, cs):
+        (A,) = cs
+        qr = st.geqrf(A.with_data(a_data))
+        return a_data + 1e-30 * qr.vr
+
+    t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
+    return (4.0 * n ** 3 / 3.0) / 1e9 / t, t
+
+
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     gemm_gflops, gemm_t = bench_gemm(n=n)
     print(f"# gemm   n={n} fp32: {gemm_gflops:9.1f} GFLOP/s  ({gemm_t*1e3:.1f} ms/iter)",
           file=sys.stderr)
-    try:
-        po_gflops, po_t = bench_potrf(n=n)
-        print(f"# potrf  n={n} fp32: {po_gflops:9.1f} GFLOP/s  ({po_t*1e3:.1f} ms/iter)",
-              file=sys.stderr)
-    except Exception as e:  # keep headline metric alive regardless
-        print(f"# potrf bench skipped: {e}", file=sys.stderr)
+    extra = {}
+    for name, fn in (("potrf", bench_potrf), ("getrf", bench_getrf),
+                     ("geqrf", bench_geqrf)):
+        try:
+            gflops, t = fn(n=n)
+            extra[f"{name}_gflops"] = round(gflops, 1)
+            extra[f"{name}_pct_of_gemm"] = round(100 * gflops / gemm_gflops, 1)
+            print(f"# {name}  n={n} fp32: {gflops:9.1f} GFLOP/s  "
+                  f"({t*1e3:.1f} ms/iter, {100*gflops/gemm_gflops:.0f}% of "
+                  f"gemm rate)", file=sys.stderr)
+        except Exception as e:  # keep headline metric alive regardless
+            print(f"# {name} bench skipped: {e}", file=sys.stderr)
 
     print(json.dumps({
         "metric": f"gemm_gflops_per_chip_fp32_n{n}",
         "value": round(gemm_gflops, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(gemm_gflops / BASELINE_GFLOPS_PER_CHIP, 2),
+        **extra,
     }))
 
 

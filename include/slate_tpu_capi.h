@@ -1,0 +1,30 @@
+/* slate-tpu routine-level C API (see native/capi.c).
+ *
+ * Reference analog: include/slate/c_api/slate.h (generated C API).
+ * Column-major double buffers, LAPACK conventions; returns info
+ * (0 success, >0 numerical, <0 argument/runtime failure).
+ * Link: -lslate_tpu_capi -lpython3.x  (the library embeds Python). */
+
+#ifndef SLATE_TPU_CAPI_H
+#define SLATE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int64_t slate_tpu_dgesv(int64_t n, int64_t nrhs, double* a, int64_t lda,
+                        int64_t* ipiv, double* b, int64_t ldb);
+int64_t slate_tpu_dpotrf(const char* uplo, int64_t n, double* a,
+                         int64_t lda);
+int64_t slate_tpu_dposv(const char* uplo, int64_t n, int64_t nrhs,
+                        double* a, int64_t lda, double* b, int64_t ldb);
+int64_t slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double* a,
+                        int64_t lda, double* b, int64_t ldb);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SLATE_TPU_CAPI_H */

@@ -1,0 +1,129 @@
+/* slate-tpu routine-level C API.
+ *
+ * Reference analog: the generated C API (tools/c_api/generate_*.py +
+ * src/c_api/wrappers.cc) that exposes each driver as a C symbol.
+ *
+ * The TPU compute path lives in the Python/JAX runtime, so these
+ * symbols embed the CPython interpreter (once, lazily) and dispatch to
+ * slate_tpu.compat.lapack_api. Matrices are COLUMN-MAJOR double
+ * buffers with leading dimension, LAPACK conventions; info is the
+ * return value (0 = success, <0 = argument/runtime error).
+ *
+ * Build: native/Makefile target libslate_tpu_capi.so (links
+ * libpython). C callers:
+ *
+ *     #include "slate_tpu_capi.h"
+ *     info = slate_tpu_dgesv(n, nrhs, a, lda, ipiv, b, ldb);
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+static int ensure_python(void) {
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+    }
+    return Py_IsInitialized() ? 0 : -100;
+}
+
+/* Run a compat call: fn_name(args...) where buffers are passed through
+ * memoryviews; results are copied back into the caller's buffers by
+ * the Python helper (slate_tpu.compat.c_glue). */
+static int call_glue(const char* fn, PyObject* args) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    int rc = -101;
+    PyObject *mod = NULL, *f = NULL, *res = NULL;
+    mod = PyImport_ImportModule("slate_tpu.compat.c_glue");
+    if (!mod) goto done;
+    f = PyObject_GetAttrString(mod, fn);
+    if (!f) goto done;
+    res = PyObject_CallObject(f, args);
+    if (!res) goto done;
+    rc = (int)PyLong_AsLong(res);
+done:
+    if (PyErr_Occurred()) {
+        PyErr_Print();
+        if (rc >= 0) rc = -102;
+    }
+    Py_XDECREF(res);
+    Py_XDECREF(f);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return rc;
+}
+
+static PyObject* mv(double* p, int64_t count) {
+    return PyMemoryView_FromMemory((char*)p, count * (int64_t)sizeof(double),
+                                   PyBUF_WRITE);
+}
+
+static PyObject* mvi(int64_t* p, int64_t count) {
+    return PyMemoryView_FromMemory((char*)p, count * (int64_t)sizeof(int64_t),
+                                   PyBUF_WRITE);
+}
+
+int64_t slate_tpu_dgesv(int64_t n, int64_t nrhs, double* a, int64_t lda,
+                        int64_t* ipiv, double* b, int64_t ldb) {
+    if (ensure_python()) return -100;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(LLNLNNL)", (long long)n, (long long)nrhs, mv(a, lda * n),
+        (long long)lda, mvi(ipiv, n), mv(b, ldb * nrhs), (long long)ldb);
+    PyGILState_Release(g);
+    if (!args) return -103;
+    int rc = call_glue("c_dgesv", args);
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    Py_DECREF(args);
+    PyGILState_Release(g2);
+    return rc;
+}
+
+int64_t slate_tpu_dpotrf(const char* uplo, int64_t n, double* a,
+                         int64_t lda) {
+    if (ensure_python()) return -100;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue("(sLNL)", uplo, (long long)n,
+                                   mv(a, lda * n), (long long)lda);
+    PyGILState_Release(g);
+    if (!args) return -103;
+    int rc = call_glue("c_dpotrf", args);
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    Py_DECREF(args);
+    PyGILState_Release(g2);
+    return rc;
+}
+
+int64_t slate_tpu_dposv(const char* uplo, int64_t n, int64_t nrhs,
+                        double* a, int64_t lda, double* b, int64_t ldb) {
+    if (ensure_python()) return -100;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(sLLNLNL)", uplo, (long long)n, (long long)nrhs, mv(a, lda * n),
+        (long long)lda, mv(b, ldb * nrhs), (long long)ldb);
+    PyGILState_Release(g);
+    if (!args) return -103;
+    int rc = call_glue("c_dposv", args);
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    Py_DECREF(args);
+    PyGILState_Release(g2);
+    return rc;
+}
+
+int64_t slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double* a,
+                        int64_t lda, double* b, int64_t ldb) {
+    if (ensure_python()) return -100;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(LLLNLNL)", (long long)m, (long long)n, (long long)nrhs,
+        mv(a, lda * n), (long long)lda, mv(b, ldb * nrhs), (long long)ldb);
+    PyGILState_Release(g);
+    if (!args) return -103;
+    int rc = call_glue("c_dgels", args);
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    Py_DECREF(args);
+    PyGILState_Release(g2);
+    return rc;
+}

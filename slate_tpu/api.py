@@ -67,6 +67,8 @@ def triangular_solve(alpha, A: TiledMatrix, B: TiledMatrix,
 
 
 def lu_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    if isinstance(A, PackedBand):
+        return band_mod.gbtrf(A, opts)
     return lu_mod.getrf(A, opts)
 
 
@@ -84,6 +86,9 @@ def lu_solve(A: TiledMatrix, B: TiledMatrix,
 
 def lu_solve_using_factor(LU, perm, B: TiledMatrix,
                           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    from .linalg.band_packed import BandLU
+    if isinstance(LU, BandLU):
+        return band_mod.gbtrs(LU, perm, B, opts)
     return lu_mod.getrs(LU, perm, B, opts)
 
 
@@ -113,6 +118,8 @@ def chol_solve(A: TiledMatrix, B: TiledMatrix,
 
 def chol_solve_using_factor(L, B: TiledMatrix,
                             opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    if isinstance(L, PackedBand):
+        return band_mod.pbtrs(L, B, opts)
     return cholesky.potrs(L, B, opts)
 
 

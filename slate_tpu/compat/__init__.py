@@ -1,0 +1,4 @@
+"""Compatibility API surfaces (reference L6/L7: c_api, lapack_api,
+scalapack_api)."""
+
+from . import lapack_api, scalapack_api  # noqa: F401

@@ -1,0 +1,60 @@
+"""Glue between the embedded-interpreter C API (native/capi.c) and the
+Python drivers: unpack C memoryviews (column-major, LAPACK layout),
+call the compat lapack_api, copy results back into the caller's
+buffers, and return info.
+
+Reference analog: src/c_api/wrappers.cc (the hand-written core of the
+generated C API).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_cm(buf, rows, ld, cols, dtype=np.float64):
+    """View a C memoryview as a column-major (rows, cols) array slice."""
+    flat = np.frombuffer(buf, dtype=dtype)
+    full = flat[: ld * cols].reshape((cols, ld)).T  # (ld, cols) col-major
+    return full[:rows, :]
+
+
+def c_dgesv(n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    b = _as_cm(b_buf, n, ldb, nrhs)
+    lu, ipiv, x, info = lp.dgesv(n, nrhs, np.array(a), lda and n, b, n)
+    a[:, :] = lu
+    b[:, :] = x
+    np.frombuffer(ipiv_buf, dtype=np.int64)[:n] = ipiv
+    return int(info)
+
+
+def c_dpotrf(uplo, n, a_buf, lda) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    f, info = lp.dpotrf(uplo, n, np.array(a), n)
+    if uplo.lower().startswith("l"):
+        a[:, :] = np.tril(f) + np.triu(np.array(a), 1)
+    else:
+        a[:, :] = np.triu(f) + np.tril(np.array(a), -1)
+    return int(info)
+
+
+def c_dposv(uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    b = _as_cm(b_buf, n, ldb, nrhs)
+    x, info = lp.dposv(uplo, n, nrhs, np.array(a), n, np.array(b), n)
+    b[:, :] = x
+    return int(info)
+
+
+def c_dgels(m, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, m, lda, n)
+    b = _as_cm(b_buf, max(m, n), ldb, nrhs)
+    x, info = lp.dgels("n", m, n, nrhs, np.array(a), m,
+                       np.array(b[:m]), m)
+    b[:n, :] = x
+    return int(info)

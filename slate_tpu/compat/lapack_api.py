@@ -1,0 +1,227 @@
+"""Drop-in LAPACK-style API.
+
+Reference: lapack_api/ (29 files) — a library exporting `dgesv_`-style
+symbols that converts LAPACK column-major arguments and dispatches to
+the reference's drivers (lapack_api/lapack_slate.hh:34-92, with env
+knobs SLATE_LAPACK_TARGET/_NB/...).
+
+Here the same surface is a Python module: functions named exactly like
+the LAPACK entry points (sgesv/dgesv/cgesv/zgesv, ?potrf, ?geqrf,
+?gesvd, ?syev/?heev, ...), taking column-major numpy arrays and
+following LAPACK in/out conventions (factors overwrite A conceptually —
+returned as the first output, since jax arrays are immutable; info is
+the last return). Block size comes from the SLATE_LAPACK_NB env var
+(default 256), mirroring the reference's env-based config.
+
+The C-callable version of this surface is native/capi.c
+(slate_tpu_dgesv etc.), which embeds the interpreter and calls these.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def _nb(n: int) -> int:
+    nb = int(os.environ.get("SLATE_LAPACK_NB", "256"))
+    return max(8, min(nb, max(8, n)))
+
+
+def _st():
+    import slate_tpu as st
+    return st
+
+
+_DTYPES = {"s": np.float32, "d": np.float64,
+           "c": np.complex64, "z": np.complex128}
+
+
+def _colmajor_in(a, dtype):
+    """LAPACK passes column-major; our storage is row-major logical."""
+    return np.ascontiguousarray(np.asarray(a, dtype=dtype).T).T
+
+
+def _make_gesv(prefix, dtype):
+    def gesv(n: int, nrhs: int, a, lda: int, b, ldb: int
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """?gesv: solve A·X=B by LU with partial pivoting.
+        Returns (lu, ipiv (1-based, LAPACK-style), x, info)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        A = st.from_dense(an, nb=_nb(n))
+        B = st.from_dense(bn, nb=_nb(n))
+        LU, perm, info = st.getrf(A)
+        X = st.getrs(LU, perm, B)
+        lu = LU.to_numpy()[:n, :n]
+        # gather-perm → LAPACK-style successive-swap ipiv (1-based)
+        p = np.asarray(perm)[:n]
+        ipiv = _perm_to_ipiv(p, n)
+        return lu, ipiv, X.to_numpy()[:n], int(info)
+
+    gesv.__name__ = prefix + "gesv"
+    return gesv
+
+
+def _perm_to_ipiv(perm: np.ndarray, n: int) -> np.ndarray:
+    """Convert a gather permutation (row i of PA is row perm[i] of A)
+    into LAPACK ipiv (at step i, rows i and ipiv[i]−1 were swapped)."""
+    work = list(perm[:n])
+    pos = {r: i for i, r in enumerate(work)}
+    ipiv = np.zeros(n, np.int32)
+    cur = list(range(n))  # cur[i] = original row currently in slot i
+    where = {r: i for i, r in enumerate(cur)}
+    for i in range(n):
+        want = perm[i]
+        j = where[want]
+        ipiv[i] = j + 1
+        cur[i], cur[j] = cur[j], cur[i]
+        where[cur[i]] = i
+        where[cur[j]] = j
+    return ipiv
+
+
+def _make_potrf(prefix, dtype):
+    def potrf(uplo: str, n: int, a, lda: int):
+        """?potrf: Cholesky. Returns (factor, info)."""
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        A = st.hermitian(tri, nb=_nb(n), uplo=u)
+        L, info = st.potrf(A)
+        f = np.asarray(L.full_dense_canonical())[:n, :n]
+        return f, int(info)
+
+    potrf.__name__ = prefix + "potrf"
+    return potrf
+
+
+def _make_posv(prefix, dtype):
+    def posv(uplo: str, n: int, nrhs: int, a, lda: int, b, ldb: int):
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        A = st.hermitian(tri, nb=_nb(n), uplo=u)
+        X, info = st.posv(A, st.from_dense(bn, nb=_nb(n)))
+        return X.to_numpy()[:n], int(info)
+
+    posv.__name__ = prefix + "posv"
+    return posv
+
+
+def _make_geqrf(prefix, dtype):
+    def geqrf(m: int, n: int, a, lda: int):
+        """?geqrf. Returns (packed V\\R, tau-equivalent T stack, info)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        QR = st.geqrf(st.from_dense(an, nb=_nb(min(m, n))))
+        return np.asarray(QR.vr)[:m, :n], np.asarray(QR.t), 0
+
+    geqrf.__name__ = prefix + "geqrf"
+    return geqrf
+
+
+def _make_gels(prefix, dtype):
+    def gels(trans: str, m: int, n: int, nrhs: int, a, lda: int, b, ldb: int):
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        A = st.from_dense(an, nb=_nb(min(m, n)))
+        if trans.lower() in ("t", "c"):
+            A = A.H if trans.lower() == "c" else A.T
+            rows = n
+        else:
+            rows = m
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:rows], dtype)
+        X = st.gels(A, st.from_dense(bn, nb=_nb(min(m, n))))
+        k = A.shape[1]
+        return X.to_numpy()[:k], 0
+
+    gels.__name__ = prefix + "gels"
+    return gels
+
+
+def _make_gesvd(prefix, dtype):
+    def gesvd(jobu: str, jobvt: str, m: int, n: int, a, lda: int):
+        """?gesvd. Returns (s, u or None, vt or None, info)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        A = st.from_dense(an, nb=_nb(min(m, n)))
+        want = jobu.lower() != "n" or jobvt.lower() != "n"
+        s, U, V = st.svd(A, want_vectors=want)
+        u = U.to_numpy() if U is not None else None
+        vt = V.to_numpy().conj().T if V is not None else None
+        return np.asarray(s), u, vt, 0
+
+    gesvd.__name__ = prefix + "gesvd"
+    return gesvd
+
+
+def _make_heev(prefix, dtype, name):
+    def heev(jobz: str, uplo: str, n: int, a, lda: int):
+        """?syev/?heev. Returns (w, z or None, info)."""
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        A = st.hermitian(tri, nb=_nb(n), uplo=u)
+        want = jobz.lower().startswith("v")
+        w, Z = st.heev(A, want_vectors=want)
+        return (np.asarray(w), Z.to_numpy() if Z is not None else None, 0)
+
+    heev.__name__ = name
+    return heev
+
+
+def _make_getrs(prefix, dtype):
+    def getrs(trans: str, n: int, nrhs: int, lu, lda: int, ipiv, b,
+              ldb: int):
+        """?getrs from ?gesv factors (takes our gather perm OR LAPACK
+        ipiv — detected by monotone content)."""
+        st = _st()
+        import jax.numpy as jnp
+        lun = _colmajor_in(np.asarray(lu)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        ip = np.asarray(ipiv)
+        if ip.min() >= 1:  # LAPACK 1-based swap list → gather perm
+            perm = np.arange(n)
+            for i, p in enumerate(ip[:n]):
+                j = int(p) - 1
+                perm[i], perm[j] = perm[j], perm[i]
+        else:
+            perm = ip
+        LU = st.from_dense(lun, nb=_nb(n))
+        pfull = np.arange(LU.data.shape[0])
+        pfull[:n] = perm
+        X = st.getrs(LU, jnp.asarray(pfull), st.from_dense(bn, nb=_nb(n)),
+                     trans=trans.lower() in ("t", "c"))
+        return X.to_numpy()[:n], 0
+
+    getrs.__name__ = prefix + "getrs"
+    return getrs
+
+
+# materialize the drop-in surface: s/d/c/z × routine
+for _p, _dt in _DTYPES.items():
+    globals()[_p + "gesv"] = _make_gesv(_p, _dt)
+    globals()[_p + "getrs"] = _make_getrs(_p, _dt)
+    globals()[_p + "potrf"] = _make_potrf(_p, _dt)
+    globals()[_p + "posv"] = _make_posv(_p, _dt)
+    globals()[_p + "geqrf"] = _make_geqrf(_p, _dt)
+    globals()[_p + "gels"] = _make_gels(_p, _dt)
+    globals()[_p + "gesvd"] = _make_gesvd(_p, _dt)
+for _p in ("s", "d"):
+    globals()[_p + "syev"] = _make_heev(_p, _DTYPES[_p], _p + "syev")
+for _p in ("c", "z"):
+    globals()[_p + "heev"] = _make_heev(_p, _DTYPES[_p], _p + "heev")
+
+__all__ = sorted(k for k in globals()
+                 if k[:1] in "sdcz" and not k.startswith("_"))

@@ -1,0 +1,128 @@
+"""Drop-in ScaLAPACK-style API over the packed local-array converters.
+
+Reference: scalapack_api/ (30 files) — exports each routine under the
+`pdpotrf/pdpotrf_` spellings, reads the BLACS grid out of the
+descriptor (`Cblacs_gridinfo(desc_CTXT(desca), ...)`,
+scalapack_api/scalapack_potrf.cc:44-110) and wraps the caller's 2D
+block-cyclic local array zero-copy.
+
+TPU execution model difference: ScaLAPACK is SPMD — every MPI rank
+calls `pdpotrf_` on its own local array. This runtime is single-process
+multi-device, so the shim is called ONCE with the list of ALL ranks'
+local arrays (column-major (lld × nloc), byte-compatible with BLACS
+buffers — see interop/scalapack.py) and updates them in place. The
+descriptor follows ScaLAPACK's DESC_ layout:
+
+    desc = (dtype_=1, ctxt, m, n, mb, nb, rsrc=0, csrc=0, lld)
+
+with mb == nb (square blocks, like the reference's fromScaLAPACK).
+``ctxt`` is interpreted as the (p, q) grid shape tuple, since there is
+no BLACS context object in-process.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..core.exceptions import SlateError
+from ..interop import bc_unpack, from_scalapack, to_scalapack
+
+
+def make_desc(m: int, n: int, nb: int, p: int, q: int,
+              lld: int = 0) -> tuple:
+    """Build a descriptor tuple (DESC_ layout; ctxt = (p, q))."""
+    return (1, (p, q), m, n, nb, nb, 0, 0, lld)
+
+
+def _parse_desc(desc) -> Tuple[int, int, int, int, int]:
+    if len(desc) < 9:
+        raise SlateError("descriptor must have 9 entries (DESC_ layout)")
+    _, ctxt, m, n, mb, nb, rsrc, csrc, _ = desc[:9]
+    if mb != nb:
+        raise SlateError("shim supports square blocks (mb == nb)")
+    if rsrc or csrc:
+        raise SlateError("shim supports rsrc = csrc = 0")
+    p, q = ctxt
+    return int(m), int(n), int(nb), int(p), int(q)
+
+
+def _gather(locals_, desc, hermitian_uplo=None):
+    m, n, nb, p, q = _parse_desc(desc)
+    A = from_scalapack([np.asarray(l) for l in locals_], m, n, nb, p, q)
+    return A, (m, n, nb, p, q)
+
+
+def _scatter_back(locals_, a_global: np.ndarray, desc) -> None:
+    m, n, nb, p, q = _parse_desc(desc)
+    for rank, loc in enumerate(locals_):
+        pi, qi = rank % p, rank // p
+        out = np.zeros((m, n), np.float64)
+        bc_unpack(np.asarray(loc), m, n, nb, p, q, pi, qi, out=out)
+        # overwrite the local array in place with the new global content
+        from ..interop.native import bc_pack
+        new = bc_pack(a_global, nb, p, q, pi, qi)
+        l = np.asarray(loc)
+        l[: new.shape[0], : new.shape[1]] = new
+
+
+def pdpotrf(uplo: str, n: int, locals_: Sequence[np.ndarray], desc
+            ) -> int:
+    """Cholesky of a block-cyclic-distributed matrix (scalapack pdpotrf;
+    scalapack_api/scalapack_potrf.cc:44-110). Updates the local arrays
+    in place; returns info."""
+    import jax.numpy as jnp
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+
+    A, (m, _, nb, p, q) = _gather(locals_, desc)
+    u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+    a = np.asarray(A.to_numpy(), np.float64)
+    tri = np.tril(a) if u is Uplo.Lower else np.triu(a)
+    H = st.hermitian(jnp.asarray(tri), nb=nb, uplo=u)
+    L, info = st.potrf(H)
+    f = np.asarray(L.full_dense_canonical(), np.float64)[:n, :n]
+    out = np.tril(f) if u is Uplo.Lower else np.triu(f)
+    # keep the untouched triangle as the caller left it (LAPACK style)
+    keep = np.triu(a, 1) if u is Uplo.Lower else np.tril(a, -1)
+    _scatter_back(locals_, out + keep, desc)
+    return int(info)
+
+
+def pdgesv(n: int, nrhs: int, a_locals: Sequence[np.ndarray], desca,
+           b_locals: Sequence[np.ndarray], descb) -> int:
+    """Solve A·X=B distributed (scalapack pdgesv). B's locals receive X."""
+    import slate_tpu as st
+
+    A, _ = _gather(a_locals, desca)
+    B, _ = _gather(b_locals, descb)
+    X, info = st.gesv(A, B)
+    _scatter_back(b_locals, np.asarray(X.to_numpy(), np.float64), descb)
+    return int(info)
+
+
+def pdgemm(transa: str, transb: str, m: int, n: int, k: int, alpha: float,
+           a_locals, desca, b_locals, descb, beta: float,
+           c_locals, descc) -> None:
+    """pdgemm: C ← α·op(A)·op(B) + β·C on distributed operands."""
+    import slate_tpu as st
+
+    A, _ = _gather(a_locals, desca)
+    B, _ = _gather(b_locals, descb)
+    C, _ = _gather(c_locals, descc)
+    if transa.lower() in ("t", "c"):
+        A = A.H if transa.lower() == "c" else A.T
+    if transb.lower() in ("t", "c"):
+        B = B.H if transb.lower() == "c" else B.T
+    out = st.gemm(alpha, A, B, beta, C)
+    _scatter_back(c_locals, np.asarray(out.to_numpy(), np.float64), descc)
+
+
+# underscore spellings, like the reference's triple exports
+pdpotrf_ = pdpotrf
+pdgesv_ = pdgesv
+pdgemm_ = pdgemm
+PDPOTRF = pdpotrf
+PDGESV = pdgesv
+PDGEMM = pdgemm

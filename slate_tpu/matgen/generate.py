@@ -76,8 +76,28 @@ def _random_orthogonal(seed: int, n: int, dtype) -> jax.Array:
 
 def generate_matrix(kind: str, m: int, n: Optional[int] = None,
                     dtype=jnp.float32, seed: int = 42,
-                    cond: Optional[float] = None) -> jax.Array:
-    """Dense (m × n) test matrix of the given kind."""
+                    cond: Optional[float] = None,
+                    condD: Optional[float] = None) -> jax.Array:
+    """Dense (m × n) test matrix of the given kind.
+
+    ``condD``: two-sided diagonal scaling A ← D·A·D with D log-spaced
+    over [condD^-½, condD^½] — the reference's condD knob
+    (matgen/generate_matrix_utils.cc:64-136), which grades row/column
+    norms to stress scaling-sensitive paths (equilibration, pivoting).
+    """
+    a = _generate_unscaled(kind, m, n, dtype, seed, cond)
+    if condD is not None and condD != 1.0:
+        nn = a.shape
+        real = jnp.finfo(dtype).dtype
+        h = 0.5 * jnp.log(jnp.asarray(condD, real))
+        dr = jnp.exp(jnp.linspace(-h, h, nn[0])).astype(dtype)
+        dc = jnp.exp(jnp.linspace(-h, h, nn[1])).astype(dtype)
+        a = dr[:, None] * a * dc[None, :]
+    return a
+
+
+def _generate_unscaled(kind: str, m: int, n: Optional[int],
+                       dtype, seed: int, cond: Optional[float]) -> jax.Array:
     n = n if n is not None else m
     k = min(m, n)
     if cond is None:
@@ -143,6 +163,18 @@ def generate_matrix(kind: str, m: int, n: Optional[int] = None,
         q = _random_orthogonal(seed, n, dtype)
         a = (q * sig[None, :].astype(dtype)) @ jnp.conj(q).T
         return 0.5 * (a + jnp.conj(a).T)
+
+    if base == "geev":
+        # nonsymmetric with prescribed eigenvalues (reference
+        # generate_type_geev.hh): A = V·Λ·V⁻¹ with a well-conditioned
+        # nonorthogonal V = I + ½·strict_lower(G)/√n
+        lam = _spectrum(spec or "logrand", n, cond, dtype, seed)
+        g = rnd.normal(seed + 3, n, n, dtype)
+        v = jnp.eye(n, dtype=dtype) + 0.5 * jnp.tril(g, -1) / jnp.sqrt(
+            jnp.asarray(float(n), jnp.finfo(dtype).dtype)).astype(dtype)
+        # A = V Λ V⁻¹  via  solve(Vᵀ, (V Λ)ᵀ)ᵀ
+        vl = v * lam[None, :].astype(dtype)
+        return jnp.linalg.solve(v.T, vl.T).T
 
     raise SlateError(f"unknown matrix kind '{kind}'")
 

@@ -1,0 +1,215 @@
+"""Compat API surfaces: LAPACK-style, ScaLAPACK-style, and the
+C-callable embedded API.
+
+Reference: lapack_api/ (drop-in dgesv_ etc.), scalapack_api/ (pdpotrf_
+reading BLACS descriptors), tools/c_api + src/c_api/wrappers.cc.
+"""
+
+import os
+import subprocess
+import sysconfig
+import textwrap
+
+import numpy as np
+import pytest
+
+from slate_tpu.compat import lapack_api as lp
+from slate_tpu.compat import scalapack_api as sc
+from slate_tpu.interop import to_scalapack
+import slate_tpu as st
+
+RNG = np.random.default_rng(9)
+
+
+def _spd(n, dtype=np.float64):
+    a = RNG.standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+# -- LAPACK-style Python surface -------------------------------------------
+
+def test_lapack_dgesv_roundtrip():
+    n, nrhs = 48, 3
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    lu, ipiv, x, info = lp.dgesv(n, nrhs, a, n, b, n)
+    assert info == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-10)
+    # LAPACK ipiv semantics: applying the swaps reproduces P·A = L·U
+    l = np.tril(lu, -1) + np.eye(n)
+    u = np.triu(lu)
+    pa = a.copy()
+    for i, p in enumerate(ipiv):
+        j = int(p) - 1
+        pa[[i, j]] = pa[[j, i]]
+    np.testing.assert_allclose(pa, l @ u, atol=1e-10)
+
+
+def test_lapack_getrs_from_factors():
+    n = 40
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, 2))
+    lu, ipiv, _, info = lp.dgesv(n, 1, a, n, b[:, :1], n)
+    x, info2 = lp.dgetrs("n", n, 2, lu, n, ipiv, b, n)
+    assert info2 == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+
+
+def test_lapack_dpotrf_dposv():
+    n = 40
+    a = _spd(n)
+    f, info = lp.dpotrf("L", n, a, n)
+    assert info == 0
+    np.testing.assert_allclose(np.tril(f) @ np.tril(f).T, a, atol=1e-9)
+    b = RNG.standard_normal((n, 2))
+    x, info = lp.dposv("L", n, 2, a, n, b, n)
+    assert info == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+
+
+def test_lapack_sgesv_f32():
+    n = 32
+    a = RNG.standard_normal((n, n)).astype(np.float32)
+    b = RNG.standard_normal((n, 1)).astype(np.float32)
+    lu, ipiv, x, info = lp.sgesv(n, 1, a, n, b, n)
+    assert info == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-3)
+
+
+def test_lapack_zheev_dsyev():
+    n = 36
+    a = _spd(n)
+    w, z, info = lp.dsyev("V", "L", n, a, n)
+    wref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(w, wref, rtol=1e-9, atol=1e-9)
+    assert np.abs(a @ z - z * w).max() < 1e-8
+    c = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    c = 0.5 * (c + c.conj().T)
+    w2, z2, info2 = lp.zheev("N", "L", n, c, n)
+    np.testing.assert_allclose(w2, np.linalg.eigvalsh(c), rtol=1e-9,
+                               atol=1e-9)
+    assert z2 is None
+
+
+def test_lapack_dgesvd_dgels():
+    m, n = 50, 30
+    a = RNG.standard_normal((m, n))
+    s, u, vt, info = lp.dgesvd("S", "S", m, n, a, m)
+    sref = np.linalg.svd(a, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(s)[:n], sref, rtol=1e-9,
+                               atol=1e-9)
+    b = RNG.standard_normal((m, 2))
+    x, info = lp.dgels("n", m, n, 2, a, m, b, m)
+    xref, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, xref, atol=1e-8)
+
+
+# -- ScaLAPACK-style surface ------------------------------------------------
+
+def test_scalapack_pdpotrf_in_place():
+    n, nb, p, q = 48, 8, 2, 2
+    a = _spd(n)
+    A = st.from_dense(a, nb=nb)
+    locals_ = [np.array(l) for l in to_scalapack(A, p, q)]
+    desc = sc.make_desc(n, n, nb, p, q)
+    info = sc.pdpotrf("L", n, locals_, desc)
+    assert info == 0
+    from slate_tpu.interop import from_scalapack
+    F = from_scalapack(locals_, n, n, nb, p, q).to_numpy()
+    np.testing.assert_allclose(np.tril(F) @ np.tril(F).T, a, atol=1e-9)
+    # untouched triangle preserved (LAPACK in-place convention)
+    np.testing.assert_allclose(np.triu(F, 1), np.triu(a, 1), atol=1e-12)
+
+
+def test_scalapack_pdgesv_and_pdgemm():
+    n, nrhs, nb, p, q = 40, 2, 8, 2, 2
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    al = [np.array(l) for l in to_scalapack(st.from_dense(a, nb=nb), p, q)]
+    bl = [np.array(l) for l in to_scalapack(st.from_dense(b, nb=nb), p, q)]
+    da = sc.make_desc(n, n, nb, p, q)
+    db = sc.make_desc(n, nrhs, nb, p, q)
+    info = sc.pdgesv_(n, nrhs, al, da, bl, db)
+    assert info == 0
+    from slate_tpu.interop import from_scalapack
+    X = from_scalapack(bl, n, nrhs, nb, p, q).to_numpy()
+    np.testing.assert_allclose(a @ X, b, atol=1e-9)
+
+    cl = [np.array(l) for l in to_scalapack(
+        st.from_dense(np.zeros((n, n)), nb=nb), p, q)]
+    dc = sc.make_desc(n, n, nb, p, q)
+    sc.pdgemm("n", "t", n, n, n, 1.0, al, da, al, da, 0.0, cl, dc)
+    C = from_scalapack(cl, n, n, nb, p, q).to_numpy()
+    np.testing.assert_allclose(C, a @ a.T, atol=1e-9)
+
+
+# -- C API (embedded interpreter) ------------------------------------------
+
+C_TEST = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "slate_tpu_capi.h"
+
+int main(void) {
+    const int n = 24, nrhs = 2;
+    double *a = malloc(n * n * sizeof(double));
+    double *acopy = malloc(n * n * sizeof(double));
+    double *b = malloc(n * nrhs * sizeof(double));
+    double *bcopy = malloc(n * nrhs * sizeof(double));
+    int64_t *ipiv = malloc(n * sizeof(int64_t));
+    unsigned s = 12345;
+    for (int i = 0; i < n * n; ++i) {
+        s = s * 1103515245u + 12345u;
+        a[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+    }
+    for (int j = 0; j < n; ++j) a[j * n + j] += n;  /* dominant */
+    for (int i = 0; i < n * nrhs; ++i) {
+        s = s * 1103515245u + 12345u;
+        b[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+    }
+    for (int i = 0; i < n * n; ++i) acopy[i] = a[i];
+    for (int i = 0; i < n * nrhs; ++i) bcopy[i] = b[i];
+    int64_t info = slate_tpu_dgesv(n, nrhs, a, n, ipiv, b, n);
+    if (info != 0) { printf("info=%lld\n", (long long)info); return 2; }
+    /* residual: column-major A (acopy) times X (b) vs bcopy */
+    double maxerr = 0.0;
+    for (int c = 0; c < nrhs; ++c)
+        for (int i = 0; i < n; ++i) {
+            double acc = 0.0;
+            for (int k = 0; k < n; ++k)
+                acc += acopy[k * n + i] * b[c * n + k];
+            double e = acc - bcopy[c * n + i];
+            if (e < 0) e = -e;
+            if (e > maxerr) maxerr = e;
+        }
+    printf("maxerr=%g\n", maxerr);
+    return maxerr < 1e-8 ? 0 : 3;
+}
+"""
+
+
+@pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
+                    reason="C toolchain test disabled")
+def test_c_api_from_real_c_program(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    so = os.path.join(native, "libslate_tpu_capi.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", native], check=True,
+                       capture_output=True)
+    csrc = tmp_path / "t.c"
+    csrc.write_text(C_TEST)
+    exe = tmp_path / "t"
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(repo, "include"),
+         "-L", native, "-lslate_tpu_capi", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = f"{native}:{libdir}:" + env.get(
+        "LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "maxerr=" in r.stdout
