@@ -25,6 +25,10 @@
 static int ensure_python(void) {
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
+        /* release the GIL acquired by initialization so other threads
+         * can enter via PyGILState_Ensure (we never need the init
+         * thread state again — every entry point brackets itself) */
+        PyEval_SaveThread();
     }
     return Py_IsInitialized() ? 0 : -100;
 }

@@ -69,8 +69,6 @@ def _make_gesv(prefix, dtype):
 def _perm_to_ipiv(perm: np.ndarray, n: int) -> np.ndarray:
     """Convert a gather permutation (row i of PA is row perm[i] of A)
     into LAPACK ipiv (at step i, rows i and ipiv[i]−1 were swapped)."""
-    work = list(perm[:n])
-    pos = {r: i for i, r in enumerate(work)}
     ipiv = np.zeros(n, np.int32)
     cur = list(range(n))  # cur[i] = original row currently in slot i
     where = {r: i for i, r in enumerate(cur)}

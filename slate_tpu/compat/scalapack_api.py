@@ -55,13 +55,10 @@ def _gather(locals_, desc, hermitian_uplo=None):
 
 
 def _scatter_back(locals_, a_global: np.ndarray, desc) -> None:
+    from ..interop.native import bc_pack
     m, n, nb, p, q = _parse_desc(desc)
     for rank, loc in enumerate(locals_):
         pi, qi = rank % p, rank // p
-        out = np.zeros((m, n), np.float64)
-        bc_unpack(np.asarray(loc), m, n, nb, p, q, pi, qi, out=out)
-        # overwrite the local array in place with the new global content
-        from ..interop.native import bc_pack
         new = bc_pack(a_global, nb, p, q, pi, qi)
         l = np.asarray(loc)
         l[: new.shape[0], : new.shape[1]] = new
@@ -95,8 +92,11 @@ def pdgesv(n: int, nrhs: int, a_locals: Sequence[np.ndarray], desca,
     """Solve A·X=B distributed (scalapack pdgesv). B's locals receive X."""
     import slate_tpu as st
 
-    A, _ = _gather(a_locals, desca)
-    B, _ = _gather(b_locals, descb)
+    A, (ma, na, *_rest) = _gather(a_locals, desca)
+    B, (mb, nb_, *_) = _gather(b_locals, descb)
+    if n != ma or n != na or nrhs != nb_:
+        raise SlateError("pdgesv: n/nrhs must match the descriptors "
+                         "(submatrix views are not supported)")
     X, info = st.gesv(A, B)
     _scatter_back(b_locals, np.asarray(X.to_numpy(), np.float64), descb)
     return int(info)
@@ -108,9 +108,14 @@ def pdgemm(transa: str, transb: str, m: int, n: int, k: int, alpha: float,
     """pdgemm: C ← α·op(A)·op(B) + β·C on distributed operands."""
     import slate_tpu as st
 
-    A, _ = _gather(a_locals, desca)
-    B, _ = _gather(b_locals, descb)
-    C, _ = _gather(c_locals, descc)
+    A, (ma, na, *_) = _gather(a_locals, desca)
+    B, (mb, nb_, *_) = _gather(b_locals, descb)
+    C, (mc, nc, *_) = _gather(c_locals, descc)
+    opa = (na, ma) if transa.lower() in ("t", "c") else (ma, na)
+    opb = (nb_, mb) if transb.lower() in ("t", "c") else (mb, nb_)
+    if (m, k) != opa or (k, n) != opb or (m, n) != (mc, nc):
+        raise SlateError("pdgemm: m/n/k must match the descriptors "
+                         "(submatrix views are not supported)")
     if transa.lower() in ("t", "c"):
         A = A.H if transa.lower() == "c" else A.T
     if transb.lower() in ("t", "c"):

@@ -152,18 +152,43 @@ def symm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
 
 def hemm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
          C: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    """slate::hemm (src/hemm.cc); A Hermitian."""
+    """slate::hemm (src/hemm.cc); A Hermitian.
+
+    MethodHemm dispatch (the reference's hemmA/hemmC split,
+    src/hemmA.cc vs src/hemmC.cc): C = stationary-C (gather the
+    contraction panels, the listBcast recipe); A = stationary-A (A keeps
+    its 2D shards, partial products reduce into C — the listReduce
+    recipe). Auto = A iff C is a single block column (reference
+    select_algo logic)."""
+    from ..core.types import MethodHemm
     if A.kind is not MatrixKind.Hermitian:
         raise SlateError("hemm: A must be Hermitian")
     a = A.full_dense_canonical()
     b = B.dense_canonical()
     c = C.dense_canonical()
+    method = opts.method_hemm
+    if method is MethodHemm.Auto:
+        method = MethodHemm.A if C.nt < 2 else MethodHemm.C
     grid = _grid_of(C, A, B)
     if grid is not None:
-        if side is Side.Left:
-            a, b = _constrain_product(a, b, grid)
+        mesh = grid.mesh
+        if method is MethodHemm.A:
+            # stationary-A: shard A both ways; the contraction dim of
+            # the other operand rides the matching axis so XLA reduces
+            # partial products into C's owners
+            a = jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)))
+            if side is Side.Left:
+                b = jax.lax.with_sharding_constraint(
+                    b, NamedSharding(mesh, P(COL_AXIS, None)))
+            else:
+                b = jax.lax.with_sharding_constraint(
+                    b, NamedSharding(mesh, P(None, ROW_AXIS)))
         else:
-            b, a = _constrain_product(b, a, grid)
+            if side is Side.Left:
+                a, b = _constrain_product(a, b, grid)
+            else:
+                b, a = _constrain_product(b, a, grid)
     out = alpha * (a @ b) + beta * c if side is Side.Left \
         else alpha * (b @ a) + beta * c
     if grid is not None:
@@ -241,6 +266,7 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     the inverted-diagonal-block scheme matches what cuBLAS does for the
     reference). The padded diagonal is set to 1 so padding solves to
     zero."""
+    from ..core.types import MethodTrsm
     if A.kind not in (MatrixKind.Triangular, MatrixKind.TriangularBand):
         raise SlateError("trsm: A must be triangular")
     uplo = A.uplo
@@ -250,13 +276,26 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     # unit-pad the diagonal so the padded system is nonsingular
     a = unit_pad_diag(a, A.shape[0], A.shape[1])
     b = B.dense_canonical()
-    x = blocked.trsm_rec(
-        a, alpha * b,
-        left=(side is Side.Left),
-        lower=(uplo is Uplo.Lower),
-        unit=(A.diag is Diag.Unit),
-        prec=opts.update_precision,
-        base=min(A.nb, a.shape[0]))
+    method = opts.method_trsm
+    if method is MethodTrsm.B:
+        # substitution-based solve (XLA's native triangular_solve) —
+        # the stationary-B style schedule. Auto/A use the gemm-based
+        # inverted-diagonal-block recursion, which is the fast path on
+        # TPU (see ops/blocked.py module docstring for measurements);
+        # B is kept for narrow rhs where substitution's lower flop
+        # count can win over the inversion recursion.
+        x = jax.lax.linalg.triangular_solve(
+            a, alpha * b, left_side=(side is Side.Left),
+            lower=(uplo is Uplo.Lower),
+            unit_diagonal=(A.diag is Diag.Unit))
+    else:
+        x = blocked.trsm_rec(
+            a, alpha * b,
+            left=(side is Side.Left),
+            lower=(uplo is Uplo.Lower),
+            unit=(A.diag is Diag.Unit),
+            prec=opts.update_precision,
+            base=min(A.nb, a.shape[0]))
     grid = _grid_of(B, A)
     if grid is not None:
         x = _constrain_out(x, grid)

@@ -475,7 +475,8 @@ def _t_getri(ctx):
 
 # -- QR / LS ----------------------------------------------------------------
 
-@register("geqrf", flops=lambda m, n: 2 * m * n * n - 2 * n ** 3 / 3.0)
+@register("geqrf", tol=30,  # orthogonality |QᴴQ−I|/(ε·m) sits ~5-10
+          flops=lambda m, n: 2 * m * n * n - 2 * n ** 3 / 3.0)
 def _t_geqrf(ctx):
     import slate_tpu as st
     import jax
@@ -494,7 +495,8 @@ def _t_geqrf(ctx):
     return secs, max(err_f, err_o)
 
 
-@register("gelqf", flops=lambda m, n: 2 * m * m * n - 2 * m ** 3 / 3.0)
+@register("gelqf", tol=30,
+          flops=lambda m, n: 2 * m * m * n - 2 * m ** 3 / 3.0)
 def _t_gelqf(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -510,7 +512,7 @@ def _t_gelqf(ctx):
     return secs, err
 
 
-@register("cholqr", flops=lambda m, n: 2 * m * n * n)
+@register("cholqr", tol=30, flops=lambda m, n: 2 * m * n * n)
 def _t_cholqr(ctx):
     import slate_tpu as st
     m = max(ctx.m, 2 * ctx.n)
