@@ -69,14 +69,35 @@ static PyObject* mvi(int64_t* p, int64_t count) {
                                    PyBUF_WRITE);
 }
 
+/* Build the args tuple from up to three pre-made memoryviews using the
+ * "O" format (Py_BuildValue takes its own reference), then drop ours —
+ * so a failure anywhere leaks nothing (each view is DECREFed exactly
+ * once here whether or not the tuple was built). Any pending error is
+ * printed while the GIL is still held. */
+static PyObject* finish_args(PyGILState_STATE g, PyObject* args,
+                             PyObject* v0, PyObject* v1, PyObject* v2) {
+    Py_XDECREF(v0);
+    Py_XDECREF(v1);
+    Py_XDECREF(v2);
+    if (!args && PyErr_Occurred()) PyErr_Print();
+    PyGILState_Release(g);
+    return args;
+}
+
 int64_t slate_tpu_dgesv(int64_t n, int64_t nrhs, double* a, int64_t lda,
                         int64_t* ipiv, double* b, int64_t ldb) {
     if (ensure_python()) return -100;
     PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* args = Py_BuildValue(
-        "(LLNLNNL)", (long long)n, (long long)nrhs, mv(a, lda * n),
-        (long long)lda, mvi(ipiv, n), mv(b, ldb * nrhs), (long long)ldb);
-    PyGILState_Release(g);
+    /* short-circuit after a NULL: calling further C-API constructors
+     * with an exception pending is undefined (asserts on debug builds) */
+    PyObject* mva = mv(a, lda * n);
+    PyObject* mvp = mva ? mvi(ipiv, n) : NULL;
+    PyObject* mvb = mvp ? mv(b, ldb * nrhs) : NULL;
+    PyObject* args = (mva && mvp && mvb)
+        ? Py_BuildValue("(LLOLOOL)", (long long)n, (long long)nrhs, mva,
+                        (long long)lda, mvp, mvb, (long long)ldb)
+        : NULL;
+    args = finish_args(g, args, mva, mvp, mvb);
     if (!args) return -103;
     int rc = call_glue("c_dgesv", args);
     PyGILState_STATE g2 = PyGILState_Ensure();
@@ -89,9 +110,11 @@ int64_t slate_tpu_dpotrf(const char* uplo, int64_t n, double* a,
                          int64_t lda) {
     if (ensure_python()) return -100;
     PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* args = Py_BuildValue("(sLNL)", uplo, (long long)n,
-                                   mv(a, lda * n), (long long)lda);
-    PyGILState_Release(g);
+    PyObject* mva = mv(a, lda * n);
+    PyObject* args = mva
+        ? Py_BuildValue("(sLOL)", uplo, (long long)n, mva, (long long)lda)
+        : NULL;
+    args = finish_args(g, args, mva, NULL, NULL);
     if (!args) return -103;
     int rc = call_glue("c_dpotrf", args);
     PyGILState_STATE g2 = PyGILState_Ensure();
@@ -104,10 +127,13 @@ int64_t slate_tpu_dposv(const char* uplo, int64_t n, int64_t nrhs,
                         double* a, int64_t lda, double* b, int64_t ldb) {
     if (ensure_python()) return -100;
     PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* args = Py_BuildValue(
-        "(sLLNLNL)", uplo, (long long)n, (long long)nrhs, mv(a, lda * n),
-        (long long)lda, mv(b, ldb * nrhs), (long long)ldb);
-    PyGILState_Release(g);
+    PyObject* mva = mv(a, lda * n);
+    PyObject* mvb = mva ? mv(b, ldb * nrhs) : NULL;
+    PyObject* args = (mva && mvb)
+        ? Py_BuildValue("(sLLOLOL)", uplo, (long long)n, (long long)nrhs,
+                        mva, (long long)lda, mvb, (long long)ldb)
+        : NULL;
+    args = finish_args(g, args, mva, mvb, NULL);
     if (!args) return -103;
     int rc = call_glue("c_dposv", args);
     PyGILState_STATE g2 = PyGILState_Ensure();
@@ -120,10 +146,14 @@ int64_t slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double* a,
                         int64_t lda, double* b, int64_t ldb) {
     if (ensure_python()) return -100;
     PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* args = Py_BuildValue(
-        "(LLLNLNL)", (long long)m, (long long)n, (long long)nrhs,
-        mv(a, lda * n), (long long)lda, mv(b, ldb * nrhs), (long long)ldb);
-    PyGILState_Release(g);
+    PyObject* mva = mv(a, lda * n);
+    PyObject* mvb = mva ? mv(b, ldb * nrhs) : NULL;
+    PyObject* args = (mva && mvb)
+        ? Py_BuildValue("(LLLOLOL)", (long long)m, (long long)n,
+                        (long long)nrhs, mva, (long long)lda, mvb,
+                        (long long)ldb)
+        : NULL;
+    args = finish_args(g, args, mva, mvb, NULL);
     if (!args) return -103;
     int rc = call_glue("c_dgels", args);
     PyGILState_STATE g2 = PyGILState_Ensure();

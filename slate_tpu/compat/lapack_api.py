@@ -117,11 +117,25 @@ def _make_posv(prefix, dtype):
 
 def _make_geqrf(prefix, dtype):
     def geqrf(m: int, n: int, a, lda: int):
-        """?geqrf. Returns (packed V\\R, tau-equivalent T stack, info)."""
+        """?geqrf. Returns (a_out, tau, info) with LAPACK semantics:
+        a_out is the packed V\\R (R on and above the diagonal, the
+        Householder vectors' tails below), tau[i] the scalar factor of
+        reflector i — recovered as the diagonal of each panel's larft T
+        factor, which stores exactly tau on its diagonal. Driver
+        failures map to info > 0 (LAPACK xerbla-style argument checks
+        are not replicated; bad shapes raise)."""
         st = _st()
         an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
-        QR = st.geqrf(st.from_dense(an, nb=_nb(min(m, n))))
-        return np.asarray(QR.vr)[:m, :n], np.asarray(QR.t), 0
+        A = st.from_dense(an, nb=_nb(min(m, n)))  # bad args raise here
+        try:
+            QR = st.geqrf(A)
+        except Exception:
+            return None, None, 1  # driver failure → info > 0
+        t = np.asarray(QR.t)
+        # T is stacked per panel (kpanels, nb, nb); diag(T_k) == tau of
+        # panel k (larft forward-columnwise convention)
+        tau = np.concatenate([np.diagonal(t[k]) for k in range(t.shape[0])])
+        return np.asarray(QR.vr)[:m, :n], tau[: min(m, n)], 0
 
     geqrf.__name__ = prefix + "geqrf"
     return geqrf
@@ -138,7 +152,11 @@ def _make_gels(prefix, dtype):
         else:
             rows = m
         bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:rows], dtype)
-        X = st.gels(A, st.from_dense(bn, nb=_nb(min(m, n))))
+        Bm = st.from_dense(bn, nb=_nb(min(m, n)))  # bad args raise here
+        try:
+            X = st.gels(A, Bm)
+        except Exception:
+            return None, 1  # driver failure → info > 0 (LAPACK-style)
         k = A.shape[1]
         return X.to_numpy()[:k], 0
 
@@ -153,7 +171,10 @@ def _make_gesvd(prefix, dtype):
         an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
         A = st.from_dense(an, nb=_nb(min(m, n)))
         want = jobu.lower() != "n" or jobvt.lower() != "n"
-        s, U, V = st.svd(A, want_vectors=want)
+        try:
+            s, U, V = st.svd(A, want_vectors=want)
+        except Exception:
+            return None, None, None, 1  # non-convergence → info > 0
         u = U.to_numpy() if U is not None else None
         vt = V.to_numpy().conj().T if V is not None else None
         return np.asarray(s), u, vt, 0

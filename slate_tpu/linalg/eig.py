@@ -232,6 +232,10 @@ def sterf(d: Array, e: Array) -> Array:
     return jax.scipy.linalg.eigh_tridiagonal(d, e, eigvals_only=True)
 
 
+_STEQR_MAX_N = 1024  # loud refusal above this (QR iteration is O(n²)
+                     # Python-level rotations; MethodEig.DC scales)
+
+
 def steqr(d, e, compute_z: bool = True,
           max_sweeps: int = 60) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Implicit-shift QR iteration on a symmetric tridiagonal matrix with
@@ -239,13 +243,25 @@ def steqr(d, e, compute_z: bool = True,
 
     Own implementation of the lapack::steqr role (the reference computes
     Givens rotations redundantly on every rank and applies them to its
-    local rows of Z, src/steqr_impl.cc:253-262). Host-side numpy — the
-    tridiagonal stage is O(n²)-per-sweep scalar recurrences, which belong
-    on the host exactly as the reference leaves them in LAPACK. Returns
-    ascending (w, z)."""
+    local rows of Z with lapack::lasr, src/steqr_impl.cc:253-262,
+    :389-398). Host-side numpy — the tridiagonal stage is O(n²)-per-sweep
+    scalar recurrences, which belong on the host exactly as the
+    reference leaves them in LAPACK; the Z update vectorizes each
+    rotation over all n rows (dlasr's inner loop). The total rotation
+    count is O(n²) Python-level steps, so sizes beyond _STEQR_MAX_N
+    refuse loudly instead of silently taking minutes — MethodEig.DC
+    (stedc divide & conquer) is the large-n tridiagonal method, exactly
+    as in the reference's heev dispatch. Returns ascending (w, z)."""
     d = np.asarray(d, dtype=np.float64).copy()
     e = np.asarray(e, dtype=np.float64).copy()
     n = d.size
+    if n > _STEQR_MAX_N:
+        raise SlateError(
+            f"steqr: n={n} exceeds the QR-iteration cutoff "
+            f"({_STEQR_MAX_N}); the implicit-shift sweep is an O(n²) "
+            "host-side rotation recurrence that does not scale — use "
+            "MethodEig.DC (stedc divide & conquer) for large "
+            "tridiagonals")
     z = np.eye(n) if compute_z else None
     if n == 1:
         return d, z
@@ -283,7 +299,12 @@ def steqr(d, e, compute_z: bool = True,
         denom = delta + np.sign(delta if delta != 0 else 1.0) * np.hypot(
             delta, ab)
         mu = a22 - (ab * ab) / denom if denom != 0 else a22 - ab
-        # implicit QR sweep with bulge chasing over [lo, hi]
+        # implicit QR sweep with bulge chasing over [lo, hi]. The Z
+        # update is dlasr's inner loop: one rotation hits a column PAIR,
+        # vectorized over all n rows by numpy (accumulating the sweep
+        # into a dense (m×m) factor and gemm-ing it onto Z was measured
+        # and rejected: the factor is upper Hessenberg-dense, so the
+        # gemm costs O(n·m²) against O(n·m) for direct application)
         f, g = d[lo] - mu, e[lo]
         for i in range(lo, hi):
             c, s, r = givens(f, g)
@@ -420,6 +441,12 @@ def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
     if method is MethodEig.DC:
         w, Z = _heev_td(A, opts, want_vectors, use_steqr=False)
     elif method is MethodEig.QR:
+        if n > _STEQR_MAX_N:
+            # decidable from n alone — refuse BEFORE paying the he2td
+            # device reduction (steqr itself also guards)
+            raise SlateError(
+                f"heev: MethodEig.QR is the small-n method (n ≤ "
+                f"{_STEQR_MAX_N}); use MethodEig.DC for n={n}")
         w, Z = _heev_td(A, opts, want_vectors, use_steqr=True)
     else:
         w, Z = _heev_band_dense(A, opts, want_vectors)

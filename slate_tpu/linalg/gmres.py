@@ -198,6 +198,17 @@ def _fgmres_cycle(a: Array, factor, perm, x: Array, b: Array,
     return x_new, steps, res, breakdown
 
 
+@jax.jit
+def _res_norms(a, xj, bj):
+    """(‖b − a·x‖_max, ‖x‖_max) as one fused device computation and ONE
+    host fetch per convergence check: through a tunneled device each
+    float() is a full round-trip, so the residual and solution norms
+    ride together (round-2 advisor item on per-cycle sync count).
+    Module-level so the compilation caches across solves."""
+    rj = bj - a @ xj
+    return jnp.stack([jnp.max(jnp.abs(rj)), jnp.max(jnp.abs(xj))])
+
+
 def _ir_gmres(A: TiledMatrix, B: TiledMatrix, opts: Options,
               factor, perm, kind: str) -> Tuple[TiledMatrix, int]:
     """Shared FGMRES-IR outer loop (host-side control, jitted cycles)."""
@@ -236,9 +247,7 @@ def _ir_gmres(A: TiledMatrix, B: TiledMatrix, opts: Options,
         iiter = 0
         col_conv = False
         while iiter < itermax:
-            rj = bj - a @ xj
-            rnorm = float(jnp.max(jnp.abs(rj)))
-            xnorm = float(jnp.max(jnp.abs(xj)))
+            rnorm, xnorm = map(float, np.asarray(_res_norms(a, xj, bj)))
             if rnorm <= cte * xnorm:
                 col_conv = True
                 break
@@ -255,9 +264,8 @@ def _ir_gmres(A: TiledMatrix, B: TiledMatrix, opts: Options,
         if not col_conv:
             # re-check after the last cycle (the loop may exit at itermax
             # with the final update unchecked)
-            rj = bj - a @ xj
-            if float(jnp.max(jnp.abs(rj))) <= cte * float(
-                    jnp.max(jnp.abs(xj))):
+            rnorm, xnorm = map(float, np.asarray(_res_norms(a, xj, bj)))
+            if rnorm <= cte * xnorm:
                 col_conv = True
         converged = converged and col_conv
         x = x.at[:, j:j + 1].set(xj)

@@ -242,7 +242,7 @@ def ge2bd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
 _apply_q_panels = blocked.apply_block_reflectors_stacked
 
 
-def bdsqr(d, e, compute_uv: bool = False):
+def bdsqr(d, e, compute_uv: bool = False, logical_k: Optional[int] = None):
     """Singular values (and optionally vectors) of a real upper
     bidiagonal matrix (slate::bdsqr, src/bdsqr.cc).
 
@@ -252,7 +252,13 @@ def bdsqr(d, e, compute_uv: bool = False):
     eigenpairs are ±σᵢ with shuffled (v, u) vectors. That feeds stedc
     (divide & conquer, matmul-rich) instead of densifying B into a k×k
     matrix as round 1 did. Returns σ descending (+ U, Vᵀ of B when
-    compute_uv)."""
+    compute_uv).
+
+    ``logical_k``: when (d, e) carry a zero-padded bidiagonal (ge2bd
+    pads with exact zeros), the caller's logical size — rank-deficient
+    null-space columns are then completed INSIDE the first logical_k
+    coordinates, so cropping to logical rows keeps them unit-norm
+    (padding coordinates never receive null-space support)."""
     from .stedc import stedc as stedc_fn
 
     if np.iscomplexobj(d) or np.iscomplexobj(e):
@@ -296,14 +302,21 @@ def bdsqr(d, e, compute_uv: bool = False):
     # pairs arbitrarily, so the σ≈0 columns are not orthonormal.
     # Rebuild them as an orthonormal completion of the σ>tol columns —
     # span(v_good)⊥ = null(B) and span(u_good)⊥ = null(Bᴴ), so the
-    # completed columns are genuine null-space singular vectors.
+    # completed columns are genuine null-space singular vectors. The
+    # completion basis is restricted to the first klog coordinates: for
+    # a zero-padded bidiagonal the σ>0 vectors already live there (the
+    # padded tail is exactly decoupled), and columns completed from
+    # e₀..e_{klog−1} stay inside the logical subspace — cropping to
+    # logical rows preserves their norm (round-2 advisor item).
+    klog = k if logical_k is None else min(logical_k, k)
     tol = max(sig[0] if k else 0.0, 0.0) * 8 * k * _BD_EPS
     g = int((sig > tol).sum())
-    if g < k:
+    if g < klog:
+        basis = np.eye(k)[:, :klog]
         for mat in (u, v):
             qc, _ = np.linalg.qr(
-                np.concatenate([mat[:, :g], np.eye(k)], axis=1))
-            mat[:, g:] = qc[:, g:k]
+                np.concatenate([mat[:, :g], basis], axis=1))
+            mat[:, g:klog] = qc[:, g:klog]
     return (jnp.asarray(sig), jnp.asarray(u.copy()),
             jnp.asarray(v.T.copy()))
 
@@ -319,7 +332,7 @@ def _svd_dc(A: TiledMatrix, opts: Options, want_vectors: bool):
     if not want_vectors:
         s = bdsqr(dn, en, compute_uv=False)
         return jnp.asarray(s, jnp.finfo(A.dtype).dtype)[:k], None, None
-    s, ub, vbt = bdsqr(dn, en, compute_uv=True)
+    s, ub, vbt = bdsqr(dn, en, compute_uv=True, logical_k=k)
     kt = dn.shape[0]
     mpad = ql[0].shape[1]
     npad = qr[0].shape[1]

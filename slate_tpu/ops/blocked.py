@@ -261,8 +261,21 @@ def herk_lower_rec(c: Array, a: Array, b: Optional[Array] = None,
     Recursive split: diagonal blocks recurse, the off-diagonal block is
     one big gemm — so the flops approach the true herk count (half of a
     full gemm), which is where the reference's internal::herk wins too
-    (src/internal/internal_herk.cc)."""
+    (src/internal/internal_herk.cc).
+
+    On a single-device TPU backend the pure herk case (b is a, real
+    dtype, block-divisible shapes) routes to the Pallas tile-triangle
+    kernel instead (ops/pallas_ops.herk_lower_update): same triangle
+    saving, but tiles are written in place (input/output aliasing) so
+    the recursion's concatenate copies — pure HBM traffic — disappear.
+    Multi-device grids keep the jnp recursion (GSPMD cannot partition a
+    pallas_call, and rebalance() constraints live here)."""
     if b is None:
+        from . import pallas_ops
+        blk = pallas_ops.default_block(a.shape[1])
+        if _GRID_CTX.get() is None and pallas_ops.herk_eligible(
+                c.shape[0], a.shape[1], c.dtype, blk):
+            return pallas_ops.herk_lower_update(c, a, blk)
         b = a
     s = c.shape[0]
     if s <= base:

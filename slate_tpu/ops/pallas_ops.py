@@ -11,14 +11,18 @@ FLOPs of the update that dominates potrf/hetrf/he2hb.
 ``herk_lower_update`` restores the saving: a scalar-prefetch Pallas grid
 enumerates only the nt·(nt+1)/2 lower tile pairs (i ≥ j) and computes
 C[i,j] −= A[i]·A[j]ᴴ per block on the MXU at full f32 precision;
-untouched (upper) blocks alias through from the input. Used by
-cholesky._potrf_blocked and blas3.herk when shapes/dtype/backend allow;
-callers fall back to the jnp path otherwise.
+untouched (upper) blocks alias through from the input. Call site:
+ops/blocked.herk_lower_rec routes its top-level herk case (b is A, real
+dtype, single-device) here when ``herk_eligible`` passes — i.e. the
+trailing updates of potrf/posv on a TPU backend; everything else takes
+the jnp recursion. ``SLATE_TPU_NO_PALLAS_HERK=1`` disables the route
+(used for A/B measurement; see PERF.md).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -29,9 +33,18 @@ from jax.experimental.pallas import tpu as pltpu
 _MIN_BLOCK = 128  # MXU-friendly tile edge; also the lane dimension
 
 
+def default_block(k: int) -> int:
+    """The kernel's default tile edge for a rank-k update — the single
+    source of truth for both the call-site eligibility gate
+    (blocked.herk_lower_rec) and the kernel itself."""
+    return max(_MIN_BLOCK, min(512, k))
+
+
 def herk_eligible(n: int, k: int, dtype, block: int) -> bool:
     """Can the Pallas path run? TPU backend, real f32/bf16, divisible
     shapes, at least 2 tile rows (otherwise there is nothing to save)."""
+    if os.environ.get("SLATE_TPU_NO_PALLAS_HERK"):
+        return False
     try:
         backend = jax.default_backend()
     except Exception:
@@ -45,8 +58,8 @@ def herk_eligible(n: int, k: int, dtype, block: int) -> bool:
             and block % _MIN_BLOCK == 0)
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def _herk_lower_call(c, a, ii, jj, block: int):
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _herk_lower_call(c, a, ii, jj, block: int, interpret: bool = False):
     n = c.shape[0]
     k = a.shape[1]
     npairs = ii.shape[0]
@@ -73,21 +86,27 @@ def _herk_lower_call(c, a, ii, jj, block: int):
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, n), c.dtype),
         input_output_aliases={4: 0},  # C aliases (indices count scalars)
+        interpret=interpret,
     )
     return fn(ii, jj, a, a, c)
 
 
 def herk_lower_update(c: jax.Array, a: jax.Array,
-                      block: int = None) -> jax.Array:
+                      block: int = None, *,
+                      interpret: bool = False,
+                      force: bool = False) -> jax.Array:
     """C ← C − A·Aᵀ on the lower tile triangle only (real dtypes).
 
     Strictly-upper blocks of C pass through unchanged; entries above the
     diagonal *within* diagonal blocks ARE updated (harmless for callers
-    that only read the lower triangle, as potrf does)."""
+    that only read the lower triangle, as potrf does).
+
+    ``interpret``/``force`` run the Pallas kernel in interpreter mode on
+    any backend (correctness tests on CPU meshes)."""
     n = c.shape[0]
     k = a.shape[1]
-    block = block or max(_MIN_BLOCK, min(512, k))
-    if not herk_eligible(n, k, c.dtype, block):
+    block = block or default_block(k)
+    if not force and not herk_eligible(n, k, c.dtype, block):
         return c - jax.lax.dot_general(
             a, a, (((1,), (1,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST)
@@ -95,4 +114,4 @@ def herk_lower_update(c: jax.Array, a: jax.Array,
     pairs = [(i, j) for i in range(nt) for j in range(i + 1)]
     ii = jnp.asarray([p[0] for p in pairs], jnp.int32)
     jj = jnp.asarray([p[1] for p in pairs], jnp.int32)
-    return _herk_lower_call(c, a, ii, jj, block)
+    return _herk_lower_call(c, a, ii, jj, block, interpret=interpret)

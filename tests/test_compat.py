@@ -143,6 +143,25 @@ def test_scalapack_pdgesv_and_pdgemm():
     np.testing.assert_allclose(C, a @ a.T, atol=1e-9)
 
 
+def test_lapack_dgeqrf_tau_parity():
+    # LAPACK semantics: a_out packs V\R, tau are the reflector scalars;
+    # rebuilding Q = H_0·H_1·… from (a_out, tau) must reproduce A = Q·R
+    m, n = 40, 24
+    a = RNG.standard_normal((m, n))
+    vr, tau, info = lp.dgeqrf(m, n, a, m)
+    assert info == 0
+    assert tau.shape == (n,)
+    q = np.eye(m)
+    for i in range(n):
+        v = np.zeros(m)
+        v[i] = 1.0
+        v[i + 1:] = vr[i + 1:, i]
+        q = q @ (np.eye(m) - tau[i] * np.outer(v, v))
+    r = np.triu(vr)[:n, :]
+    np.testing.assert_allclose(q[:, :n] @ r, a, atol=1e-9)
+    np.testing.assert_allclose(q.T @ q, np.eye(m), atol=1e-9)
+
+
 # -- C API (embedded interpreter) ------------------------------------------
 
 C_TEST = r"""

@@ -113,6 +113,44 @@ def test_steqr_own_implementation():
     assert np.linalg.norm(z.T @ z - np.eye(n)) < 1e-10
 
 
+def test_steqr_refuses_large_n():
+    # steqr is the small-n QR method; beyond the cutoff it must refuse
+    # loudly (MethodEig.DC is the scalable path), not silently crawl
+    from slate_tpu.core.exceptions import SlateError
+    from slate_tpu.linalg.eig import _STEQR_MAX_N
+    n = _STEQR_MAX_N + 1
+    with pytest.raises(SlateError, match="steqr"):
+        st.steqr(np.ones(n), np.ones(n - 1))
+
+
+def test_bdsqr_rank_deficient_logical_subspace():
+    # zero-padded bidiagonal with a rank-deficient logical part: the
+    # null-space completion must live inside the first logical_k
+    # coordinates (round-2 advisor item) so cropping keeps unit norm
+    klog, kt = 6, 8
+    d = np.zeros(kt)
+    e = np.zeros(kt - 1)
+    d[:4] = [3.0, 2.0, 1.5, 1.0]   # rank 4 of logical 6
+    e[:3] = 0.3
+    s, u, vt = st.bdsqr(d, e, compute_uv=True, logical_k=klog)
+    u = np.asarray(u)
+    v = np.asarray(vt).T
+    b = np.diag(d) + np.diag(e, 1)
+    for j in range(klog):
+        # unit columns with support only in the logical coordinates
+        assert abs(np.linalg.norm(u[:klog, j]) - 1.0) < 1e-10
+        assert abs(np.linalg.norm(v[:klog, j]) - 1.0) < 1e-10
+        assert np.linalg.norm(u[klog:, j]) < 1e-10
+        assert np.linalg.norm(v[klog:, j]) < 1e-10
+    # still a valid SVD of the logical block
+    recon = (u[:klog, :klog] * np.asarray(s)[None, :klog]) \
+        @ v[:klog, :klog].T
+    assert np.linalg.norm(b[:klog, :klog] - recon) < 1e-9
+    # orthonormal within the logical subspace
+    g = u[:klog, :klog]
+    assert np.linalg.norm(g.T @ g - np.eye(klog)) < 1e-9
+
+
 def test_sterf():
     n = 16
     rng = np.random.default_rng(4)
