@@ -1,0 +1,146 @@
+! slate-tpu Fortran API: iso_c_binding interfaces over the C API
+! (native/capi.c / include/slate_tpu_capi.h).
+!
+! Reference analog: the generated Fortran module of tools/fortran/ in
+! SLATE. Same conventions as the C API: column-major double-precision
+! arrays with leading dimensions, LAPACK argument order, info as the
+! function result (0 success, >0 numerical, <0 runtime failure).
+!
+! Build (needs a Fortran compiler; this image ships none, so the module
+! is compile-tested only where gfortran exists — tests/test_compat.py
+! skips otherwise):
+!
+!     gfortran -c slate_tpu.f90
+!     gfortran main.f90 slate_tpu.o -L../native -lslate_tpu_capi
+!
+! Usage:
+!
+!     use slate_tpu
+!     integer(c_int64_t) :: info
+!     info = slate_tpu_dgesv(n, nrhs, a, lda, ipiv, b, ldb)
+
+module slate_tpu
+   use iso_c_binding, only: c_int64_t, c_double, c_char
+   implicit none
+
+   interface
+      function slate_tpu_dgesv(n, nrhs, a, lda, ipiv, b, ldb) &
+            bind(c, name="slate_tpu_dgesv") result(info)
+         import :: c_int64_t, c_double
+         integer(c_int64_t), value :: n, nrhs, lda, ldb
+         real(c_double), intent(inout) :: a(lda, *), b(ldb, *)
+         integer(c_int64_t), intent(out) :: ipiv(*)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dpotrf(uplo, n, a, lda) &
+            bind(c, name="slate_tpu_dpotrf") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: uplo(*)
+         integer(c_int64_t), value :: n, lda
+         real(c_double), intent(inout) :: a(lda, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dposv(uplo, n, nrhs, a, lda, b, ldb) &
+            bind(c, name="slate_tpu_dposv") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: uplo(*)
+         integer(c_int64_t), value :: n, nrhs, lda, ldb
+         real(c_double), intent(inout) :: a(lda, *), b(ldb, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dgels(m, n, nrhs, a, lda, b, ldb) &
+            bind(c, name="slate_tpu_dgels") result(info)
+         import :: c_int64_t, c_double
+         integer(c_int64_t), value :: m, n, nrhs, lda, ldb
+         real(c_double), intent(inout) :: a(lda, *), b(ldb, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dgetrf(m, n, a, lda, ipiv) &
+            bind(c, name="slate_tpu_dgetrf") result(info)
+         import :: c_int64_t, c_double
+         integer(c_int64_t), value :: m, n, lda
+         real(c_double), intent(inout) :: a(lda, *)
+         integer(c_int64_t), intent(out) :: ipiv(*)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dgetrs(trans, n, nrhs, a, lda, ipiv, b, ldb) &
+            bind(c, name="slate_tpu_dgetrs") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: trans(*)
+         integer(c_int64_t), value :: n, nrhs, lda, ldb
+         real(c_double), intent(inout) :: a(lda, *), b(ldb, *)
+         integer(c_int64_t), intent(in) :: ipiv(*)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dpotrs(uplo, n, nrhs, a, lda, b, ldb) &
+            bind(c, name="slate_tpu_dpotrs") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: uplo(*)
+         integer(c_int64_t), value :: n, nrhs, lda, ldb
+         real(c_double), intent(inout) :: a(lda, *), b(ldb, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dsyev(jobz, uplo, n, a, lda, w) &
+            bind(c, name="slate_tpu_dsyev") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: jobz(*), uplo(*)
+         integer(c_int64_t), value :: n, lda
+         real(c_double), intent(inout) :: a(lda, *)
+         real(c_double), intent(out) :: w(*)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dgesvd(jobu, jobvt, m, n, a, lda, s, u, ldu, &
+                                vt, ldvt) &
+            bind(c, name="slate_tpu_dgesvd") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: jobu(*), jobvt(*)
+         integer(c_int64_t), value :: m, n, lda, ldu, ldvt
+         real(c_double), intent(inout) :: a(lda, *)
+         real(c_double), intent(out) :: s(*), u(ldu, *), vt(ldvt, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dgemm(transa, transb, m, n, k, alpha, a, lda, &
+                               b, ldb, beta, c, ldc) &
+            bind(c, name="slate_tpu_dgemm") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: transa(*), transb(*)
+         integer(c_int64_t), value :: m, n, k, lda, ldb, ldc
+         real(c_double), value :: alpha, beta
+         real(c_double), intent(in) :: a(lda, *), b(ldb, *)
+         real(c_double), intent(inout) :: c(ldc, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dtrsm(side, uplo, transa, diag, m, n, alpha, &
+                               a, lda, b, ldb) &
+            bind(c, name="slate_tpu_dtrsm") result(info)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: side(*), uplo(*)
+         character(kind=c_char), intent(in) :: transa(*), diag(*)
+         integer(c_int64_t), value :: m, n, lda, ldb
+         real(c_double), value :: alpha
+         real(c_double), intent(in) :: a(lda, *)
+         real(c_double), intent(inout) :: b(ldb, *)
+         integer(c_int64_t) :: info
+      end function
+
+      function slate_tpu_dlange(norm, m, n, a, lda) &
+            bind(c, name="slate_tpu_dlange") result(val)
+         import :: c_int64_t, c_double, c_char
+         character(kind=c_char), intent(in) :: norm(*)
+         integer(c_int64_t), value :: m, n, lda
+         real(c_double), intent(in) :: a(lda, *)
+         real(c_double) :: val
+      end function
+   end interface
+
+end module slate_tpu

@@ -22,6 +22,28 @@ int64_t slate_tpu_dposv(const char* uplo, int64_t n, int64_t nrhs,
                         double* a, int64_t lda, double* b, int64_t ldb);
 int64_t slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double* a,
                         int64_t lda, double* b, int64_t ldb);
+int64_t slate_tpu_dgetrf(int64_t m, int64_t n, double* a, int64_t lda,
+                         int64_t* ipiv);
+int64_t slate_tpu_dgetrs(const char* trans, int64_t n, int64_t nrhs,
+                         double* a, int64_t lda, int64_t* ipiv, double* b,
+                         int64_t ldb);
+int64_t slate_tpu_dpotrs(const char* uplo, int64_t n, int64_t nrhs,
+                         double* a, int64_t lda, double* b, int64_t ldb);
+int64_t slate_tpu_dsyev(const char* jobz, const char* uplo, int64_t n,
+                        double* a, int64_t lda, double* w);
+int64_t slate_tpu_dgesvd(const char* jobu, const char* jobvt, int64_t m,
+                         int64_t n, double* a, int64_t lda, double* s,
+                         double* u, int64_t ldu, double* vt, int64_t ldvt);
+int64_t slate_tpu_dgemm(const char* transa, const char* transb, int64_t m,
+                        int64_t n, int64_t k, double alpha, double* a,
+                        int64_t lda, double* b, int64_t ldb, double beta,
+                        double* c, int64_t ldc);
+int64_t slate_tpu_dtrsm(const char* side, const char* uplo,
+                        const char* transa, const char* diag, int64_t m,
+                        int64_t n, double alpha, double* a, int64_t lda,
+                        double* b, int64_t ldb);
+double slate_tpu_dlange(const char* norm, int64_t m, int64_t n, double* a,
+                        int64_t lda);
 
 #ifdef __cplusplus
 }

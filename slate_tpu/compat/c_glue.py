@@ -56,5 +56,99 @@ def c_dgels(m, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
     b = _as_cm(b_buf, max(m, n), ldb, nrhs)
     x, info = lp.dgels("n", m, n, nrhs, np.array(a), m,
                        np.array(b[:m]), m)
+    if info != 0:  # driver failure: report info, leave b untouched
+        return int(info)
     b[:n, :] = x
     return int(info)
+
+
+def c_dgetrf(m, n, a_buf, lda, ipiv_buf) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, m, lda, n)
+    lu, ipiv, info = lp.dgetrf(m, n, np.array(a), m)
+    a[:, :] = lu
+    k = min(m, n)
+    np.frombuffer(ipiv_buf, dtype=np.int64)[:k] = ipiv[:k]
+    return int(info)
+
+
+def c_dgetrs(trans, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    b = _as_cm(b_buf, n, ldb, nrhs)
+    ipiv = np.array(np.frombuffer(ipiv_buf, dtype=np.int64)[:n])
+    x, info = lp.dgetrs(trans, n, nrhs, np.array(a), n, ipiv,
+                        np.array(b), n)
+    b[:, :] = x
+    return int(info)
+
+
+def c_dpotrs(uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    b = _as_cm(b_buf, n, ldb, nrhs)
+    x, info = lp.dpotrs(uplo, n, nrhs, np.array(a), n, np.array(b), n)
+    b[:, :] = x
+    return int(info)
+
+
+def c_dsyev(jobz, uplo, n, a_buf, lda, w_buf) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, n, lda, n)
+    w, z, info = lp.dsyev(jobz, uplo, n, np.array(a), n)
+    np.frombuffer(w_buf, dtype=np.float64)[:n] = np.asarray(w)
+    if z is not None:
+        a[:, :] = z  # LAPACK: eigenvectors overwrite A when jobz='V'
+    return int(info)
+
+
+def c_dgesvd(jobu, jobvt, m, n, a_buf, lda, s_buf, u_buf, ldu, vt_buf,
+             ldvt) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, m, lda, n)
+    s, u, vt, info = lp.dgesvd(jobu, jobvt, m, n, np.array(a), m)
+    if info:
+        return int(info)
+    k = min(m, n)
+    np.frombuffer(s_buf, dtype=np.float64)[:k] = np.asarray(s)[:k]
+    if u is not None and u_buf is not None:
+        _as_cm(u_buf, m, ldu, k)[:, :] = np.asarray(u)[:m, :k]
+    if vt is not None and vt_buf is not None:
+        _as_cm(vt_buf, k, ldvt, n)[:, :] = np.asarray(vt)[:k, :n]
+    return 0
+
+
+def c_dgemm(transa, transb, m, n, k, alpha, a_buf, lda, b_buf, ldb, beta,
+            c_buf, ldc) -> int:
+    from . import lapack_api as lp
+    rows_a = m if transa.lower().startswith("n") else k
+    cols_a = k if transa.lower().startswith("n") else m
+    rows_b = k if transb.lower().startswith("n") else n
+    cols_b = n if transb.lower().startswith("n") else k
+    a = _as_cm(a_buf, rows_a, lda, cols_a)
+    b = _as_cm(b_buf, rows_b, ldb, cols_b)
+    c = _as_cm(c_buf, m, ldc, n)
+    out = lp.dgemm(transa, transb, m, n, k, alpha, np.array(a), rows_a,
+                   np.array(b), rows_b, beta, np.array(c), m)
+    c[:, :] = out
+    return 0
+
+
+def c_dtrsm(side, uplo, transa, diag, m, n, alpha, a_buf, lda, b_buf,
+            ldb) -> int:
+    from . import lapack_api as lp
+    ka = m if side.lower().startswith("l") else n
+    a = _as_cm(a_buf, ka, lda, ka)
+    b = _as_cm(b_buf, m, ldb, n)
+    out = lp.dtrsm(side, uplo, transa, diag, m, n, alpha, np.array(a), ka,
+                   np.array(b), m)
+    b[:, :] = out
+    return 0
+
+
+def c_dlange(norm, m, n, a_buf, lda, out_buf) -> int:
+    from . import lapack_api as lp
+    a = _as_cm(a_buf, m, lda, n)
+    np.frombuffer(out_buf, dtype=np.float64)[0] = lp.dlange(
+        norm, m, n, np.array(a), m)
+    return 0

@@ -228,19 +228,403 @@ def _make_getrs(prefix, dtype):
     return getrs
 
 
-# materialize the drop-in surface: s/d/c/z × routine
+def _ipiv_to_perm(ipiv, n: int) -> np.ndarray:
+    """LAPACK 1-based successive-swap list → gather permutation."""
+    perm = np.arange(n)
+    for i, p in enumerate(np.asarray(ipiv)[:n]):
+        j = int(p) - 1
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+def _make_getrf(prefix, dtype):
+    def getrf(m: int, n: int, a, lda: int):
+        """?getrf. Returns (lu, ipiv (1-based), info)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        A = st.from_dense(an, nb=_nb(min(m, n)))
+        LU, perm, info = st.getrf(A)
+        k = min(m, n)
+        ipiv = _perm_to_ipiv(np.asarray(perm)[:m], m)[:k]
+        return LU.to_numpy()[:m, :n], ipiv, int(info)
+
+    getrf.__name__ = prefix + "getrf"
+    return getrf
+
+
+def _make_getri(prefix, dtype):
+    def getri(n: int, lu, lda: int, ipiv):
+        """?getri: inverse from ?getrf factors. Returns (ainv, info)."""
+        st = _st()
+        import jax.numpy as jnp
+        lun = _colmajor_in(np.asarray(lu)[:lda, :n][:n], dtype)
+        LU = st.from_dense(lun, nb=_nb(n))
+        perm = _ipiv_to_perm(ipiv, n)
+        pfull = np.arange(LU.data.shape[0])
+        pfull[:n] = perm
+        inv = st.getri(LU, jnp.asarray(pfull))
+        return inv.to_numpy()[:n, :n], 0
+
+    getri.__name__ = prefix + "getri"
+    return getri
+
+
+def _make_potrs(prefix, dtype):
+    def potrs(uplo: str, n: int, nrhs: int, a, lda: int, b, ldb: int):
+        """?potrs from the ?potrf factor. Returns (x, info)."""
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        L = st.triangular(tri, nb=_nb(n), uplo=u)
+        X = st.potrs(L, st.from_dense(bn, nb=_nb(n)))
+        return X.to_numpy()[:n], 0
+
+    potrs.__name__ = prefix + "potrs"
+    return potrs
+
+
+def _make_potri(prefix, dtype):
+    def potri(uplo: str, n: int, a, lda: int):
+        """?potri: inverse from the ?potrf factor. Returns (ainv, info)."""
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        L = st.triangular(tri, nb=_nb(n), uplo=u)
+        inv = st.potri(L)
+        return np.asarray(inv.full_dense_canonical())[:n, :n], 0
+
+    potri.__name__ = prefix + "potri"
+    return potri
+
+
+def _make_heevd(prefix, dtype, name):
+    def heevd(jobz: str, uplo: str, n: int, a, lda: int):
+        """?syevd/?heevd: divide-and-conquer eigensolver (MethodEig.DC —
+        the stedc pipeline, like LAPACK's xsyevd)."""
+        st = _st()
+        from slate_tpu.core.types import MethodEig, Options, Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        A = st.hermitian(tri, nb=_nb(n), uplo=u)
+        want = jobz.lower().startswith("v")
+        opts = Options(method_eig=MethodEig.DC) if n >= 32 else Options()
+        w, Z = st.heev(A, opts, want_vectors=want)
+        return (np.asarray(w), Z.to_numpy() if Z is not None else None, 0)
+
+    heevd.__name__ = name
+    return heevd
+
+
+def _make_gesv_mixed(prefix, dtype, name):
+    def gesv_mixed(n: int, nrhs: int, a, lda: int, b, ldb: int):
+        """dsgesv/zcgesv: mixed-precision solve with iterative
+        refinement. Returns (x, iters, info); iters < 0 ⇒ fell back to
+        the full-precision solver (LAPACK convention)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        A = st.from_dense(an, nb=_nb(n))
+        B = st.from_dense(bn, nb=_nb(n))
+        X, info, iters = st.gesv_mixed(A, B)
+        return X.to_numpy()[:n], int(iters), int(info)
+
+    gesv_mixed.__name__ = name
+    return gesv_mixed
+
+
+# -- BLAS-3 drop-ins (lapack_api/lapack_gemm.cc etc.) ----------------------
+
+def _op_np(a, trans: str):
+    t = trans.lower()
+    if t.startswith("t"):
+        return a.T
+    if t.startswith("c"):
+        return np.conj(a).T
+    return a
+
+
+def _make_gemm(prefix, dtype):
+    def gemm(transa: str, transb: str, m: int, n: int, k: int, alpha,
+             a, lda: int, b, ldb: int, beta, c, ldc: int):
+        """?gemm (lapack_api/lapack_gemm.cc). Returns the updated C."""
+        st = _st()
+        rows_a = m if transa.lower().startswith("n") else k
+        cols_a = k if transa.lower().startswith("n") else m
+        rows_b = k if transb.lower().startswith("n") else n
+        cols_b = n if transb.lower().startswith("n") else k
+        an = _op_np(_colmajor_in(np.asarray(a)[:lda, :cols_a][:rows_a],
+                                 dtype), transa)
+        bn = _op_np(_colmajor_in(np.asarray(b)[:ldb, :cols_b][:rows_b],
+                                 dtype), transb)
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:m], dtype)
+        nb = _nb(min(m, n, k))
+        out = st.gemm(alpha, st.from_dense(np.ascontiguousarray(an), nb=nb),
+                      st.from_dense(np.ascontiguousarray(bn), nb=nb),
+                      beta, st.from_dense(cn, nb=nb))
+        return out.to_numpy()[:m, :n]
+
+    gemm.__name__ = prefix + "gemm"
+    return gemm
+
+
+def _make_symm_like(prefix, dtype, name, hermitian):
+    def symm(side: str, uplo: str, m: int, n: int, alpha, a, lda: int,
+             b, ldb: int, beta, c, ldc: int):
+        st = _st()
+        from slate_tpu.core.types import Side, Uplo
+        ka = m if side.lower().startswith("l") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :ka][:ka], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :n][:m], dtype)
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:m], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        nb = _nb(min(m, n))
+        A = st.hermitian(tri, nb=nb, uplo=u) if hermitian \
+            else st.symmetric(tri, nb=nb, uplo=u)
+        s = Side.Left if side.lower().startswith("l") else Side.Right
+        fn = st.hemm if hermitian else st.symm
+        out = fn(s, alpha, A, st.from_dense(bn, nb=nb), beta,
+                 st.from_dense(cn, nb=nb))
+        return out.to_numpy()[:m, :n]
+
+    symm.__name__ = name
+    return symm
+
+
+def _make_rank_k(prefix, dtype, name, hermitian):
+    def rank_k(uplo: str, trans: str, n: int, k: int, alpha, a, lda: int,
+               beta, c, ldc: int):
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        rows = n if trans.lower().startswith("n") else k
+        cols = k if trans.lower().startswith("n") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :cols][:rows], dtype)
+        if not trans.lower().startswith("n"):
+            an = np.conj(an).T if hermitian else an.T
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(cn) if u is Uplo.Lower else np.triu(cn)
+        nb = _nb(min(n, k))
+        C = st.hermitian(tri, nb=nb, uplo=u) if hermitian \
+            else st.symmetric(tri, nb=nb, uplo=u)
+        fn = st.herk if hermitian else st.syrk
+        out = fn(alpha, st.from_dense(np.ascontiguousarray(an), nb=nb),
+                 beta, C)
+        f = np.asarray(out.full_dense_canonical())[:n, :n]
+        keep = np.triu(cn, 1) if u is Uplo.Lower else np.tril(cn, -1)
+        return (np.tril(f) if u is Uplo.Lower else np.triu(f)) + keep
+
+    rank_k.__name__ = name
+    return rank_k
+
+
+def _make_rank_2k(prefix, dtype, name, hermitian):
+    def rank_2k(uplo: str, trans: str, n: int, k: int, alpha, a, lda: int,
+                b, ldb: int, beta, c, ldc: int):
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        rows = n if trans.lower().startswith("n") else k
+        cols = k if trans.lower().startswith("n") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :cols][:rows], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :cols][:rows], dtype)
+        if not trans.lower().startswith("n"):
+            an = np.conj(an).T if hermitian else an.T
+            bn = np.conj(bn).T if hermitian else bn.T
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(cn) if u is Uplo.Lower else np.triu(cn)
+        nb = _nb(min(n, k))
+        C = st.hermitian(tri, nb=nb, uplo=u) if hermitian \
+            else st.symmetric(tri, nb=nb, uplo=u)
+        fn = st.her2k if hermitian else st.syr2k
+        out = fn(alpha, st.from_dense(np.ascontiguousarray(an), nb=nb),
+                 st.from_dense(np.ascontiguousarray(bn), nb=nb), beta, C)
+        f = np.asarray(out.full_dense_canonical())[:n, :n]
+        keep = np.triu(cn, 1) if u is Uplo.Lower else np.tril(cn, -1)
+        return (np.tril(f) if u is Uplo.Lower else np.triu(f)) + keep
+
+    rank_2k.__name__ = name
+    return rank_2k
+
+
+def _make_trmm_trsm(prefix, dtype, name, solve):
+    def tr(side: str, uplo: str, transa: str, diag: str, m: int, n: int,
+           alpha, a, lda: int, b, ldb: int):
+        st = _st()
+        from slate_tpu.core.types import Diag, Side, Uplo
+        ka = m if side.lower().startswith("l") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :ka][:ka], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :n][:m], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        an = _op_np(an, transa)
+        if not transa.lower().startswith("n"):
+            u = Uplo.Upper if u is Uplo.Lower else Uplo.Lower
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
+        nb = _nb(min(m, n))
+        A = st.triangular(np.ascontiguousarray(tri), nb=nb, uplo=u, diag=d)
+        s = Side.Left if side.lower().startswith("l") else Side.Right
+        fn = st.trsm if solve else st.trmm
+        out = fn(s, alpha, A, st.from_dense(bn, nb=nb))
+        return out.to_numpy()[:m, :n]
+
+    tr.__name__ = name
+    return tr
+
+
+# -- norms + condition estimates (lapack_lange/lanhe/lansy/lantr,
+#    lapack_gecon/pocon/trcon) ---------------------------------------------
+
+def _norm_of(char):
+    from slate_tpu.core.types import Norm
+    c = char.lower()[0]
+    if c == "m":
+        return Norm.Max
+    if c in ("1", "o"):
+        return Norm.One
+    if c == "i":
+        return Norm.Inf
+    return Norm.Fro
+
+
+def _make_lange(prefix, dtype):
+    def lange(norm_c: str, m: int, n: int, a, lda: int) -> float:
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        return float(st.norm(st.from_dense(an, nb=_nb(min(m, n))),
+                             _norm_of(norm_c)))
+
+    lange.__name__ = prefix + "lange"
+    return lange
+
+
+def _make_lanhe(prefix, dtype, name, hermitian):
+    def lanhe(norm_c: str, uplo: str, n: int, a, lda: int) -> float:
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        A = st.hermitian(tri, nb=_nb(n), uplo=u) if hermitian \
+            else st.symmetric(tri, nb=_nb(n), uplo=u)
+        return float(st.norm(A, _norm_of(norm_c)))
+
+    lanhe.__name__ = name
+    return lanhe
+
+
+def _make_lantr(prefix, dtype):
+    def lantr(norm_c: str, uplo: str, diag: str, m: int, n: int, a,
+              lda: int) -> float:
+        st = _st()
+        from slate_tpu.core.types import Diag, Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        k = min(m, n)
+        tri = np.tril(an[:k, :k]) if u is Uplo.Lower else np.triu(an[:k, :k])
+        d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
+        A = st.triangular(tri, nb=_nb(k), uplo=u, diag=d)
+        return float(st.norm(A, _norm_of(norm_c)))
+
+    lantr.__name__ = prefix + "lantr"
+    return lantr
+
+
+def _make_gecon(prefix, dtype):
+    def gecon(norm_c: str, n: int, a, lda: int, anorm: float):
+        """?gecon on ?getrf output (LAPACK passes no ipiv: row permutes
+        do not change the estimated norms). Returns (rcond, info)."""
+        st = _st()
+        import jax.numpy as jnp
+        lun = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        LU = st.from_dense(lun, nb=_nb(n))
+        perm = jnp.arange(LU.data.shape[0])
+        return float(st.gecondest(LU, perm, float(anorm))), 0
+
+    gecon.__name__ = prefix + "gecon"
+    return gecon
+
+
+def _make_pocon(prefix, dtype):
+    def pocon(uplo: str, n: int, a, lda: int, anorm: float):
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        L = st.triangular(tri, nb=_nb(n), uplo=u)
+        return float(st.pocondest(L, float(anorm))), 0
+
+    pocon.__name__ = prefix + "pocon"
+    return pocon
+
+
+def _make_trcon(prefix, dtype):
+    def trcon(norm_c: str, uplo: str, diag: str, n: int, a, lda: int):
+        st = _st()
+        from slate_tpu.core.types import Diag, Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
+        T = st.triangular(tri, nb=_nb(n), uplo=u, diag=d)
+        return float(st.trcondest(T)), 0
+
+    trcon.__name__ = prefix + "trcon"
+    return trcon
+
+
+# materialize the drop-in surface: s/d/c/z × routine (mirrors the
+# reference's lapack_api/ file list: gecon gels gemm gesv gesv_mixed
+# gesvd getrf getri getrs heev heevd hemm her2k herk lange lanhe lansy
+# lantr pocon posv potrf potri potrs symm syr2k syrk trcon trmm trsm
+# + geqrf)
 for _p, _dt in _DTYPES.items():
     globals()[_p + "gesv"] = _make_gesv(_p, _dt)
+    globals()[_p + "getrf"] = _make_getrf(_p, _dt)
     globals()[_p + "getrs"] = _make_getrs(_p, _dt)
+    globals()[_p + "getri"] = _make_getri(_p, _dt)
     globals()[_p + "potrf"] = _make_potrf(_p, _dt)
+    globals()[_p + "potrs"] = _make_potrs(_p, _dt)
+    globals()[_p + "potri"] = _make_potri(_p, _dt)
     globals()[_p + "posv"] = _make_posv(_p, _dt)
     globals()[_p + "geqrf"] = _make_geqrf(_p, _dt)
     globals()[_p + "gels"] = _make_gels(_p, _dt)
     globals()[_p + "gesvd"] = _make_gesvd(_p, _dt)
+    globals()[_p + "gemm"] = _make_gemm(_p, _dt)
+    globals()[_p + "symm"] = _make_symm_like(_p, _dt, _p + "symm", False)
+    globals()[_p + "syrk"] = _make_rank_k(_p, _dt, _p + "syrk", False)
+    globals()[_p + "syr2k"] = _make_rank_2k(_p, _dt, _p + "syr2k", False)
+    globals()[_p + "trmm"] = _make_trmm_trsm(_p, _dt, _p + "trmm", False)
+    globals()[_p + "trsm"] = _make_trmm_trsm(_p, _dt, _p + "trsm", True)
+    globals()[_p + "lange"] = _make_lange(_p, _dt)
+    globals()[_p + "lantr"] = _make_lantr(_p, _dt)
+    globals()[_p + "lansy"] = _make_lanhe(_p, _dt, _p + "lansy", False)
+    globals()[_p + "gecon"] = _make_gecon(_p, _dt)
+    globals()[_p + "pocon"] = _make_pocon(_p, _dt)
+    globals()[_p + "trcon"] = _make_trcon(_p, _dt)
 for _p in ("s", "d"):
     globals()[_p + "syev"] = _make_heev(_p, _DTYPES[_p], _p + "syev")
+    globals()[_p + "syevd"] = _make_heevd(_p, _DTYPES[_p], _p + "syevd")
 for _p in ("c", "z"):
     globals()[_p + "heev"] = _make_heev(_p, _DTYPES[_p], _p + "heev")
+    globals()[_p + "heevd"] = _make_heevd(_p, _DTYPES[_p], _p + "heevd")
+    globals()[_p + "hemm"] = _make_symm_like(_p, _DTYPES[_p], _p + "hemm",
+                                             True)
+    globals()[_p + "herk"] = _make_rank_k(_p, _DTYPES[_p], _p + "herk",
+                                          True)
+    globals()[_p + "her2k"] = _make_rank_2k(_p, _DTYPES[_p], _p + "her2k",
+                                            True)
+    globals()[_p + "lanhe"] = _make_lanhe(_p, _DTYPES[_p], _p + "lanhe",
+                                          True)
+globals()["dsgesv"] = _make_gesv_mixed("d", np.float64, "dsgesv")
+globals()["zcgesv"] = _make_gesv_mixed("z", np.complex128, "zcgesv")
 
 __all__ = sorted(k for k in globals()
                  if k[:1] in "sdcz" and not k.startswith("_"))

@@ -124,6 +124,299 @@ def pdgemm(transa: str, transb: str, m: int, n: int, k: int, alpha: float,
     _scatter_back(c_locals, np.asarray(out.to_numpy(), np.float64), descc)
 
 
+# ---------------------------------------------------------------------------
+# Table-driven breadth: the remaining scalapack_api/ surface is built by
+# composing the SAME two primitives every reference wrapper uses —
+# fromScaLAPACK (here: _gather) and the LAPACK-convention driver (here:
+# compat.lapack_api, which already covers all four dtypes) — then
+# scattering results back into every rank's local buffer.
+# Reference: scalapack_api/scalapack_{gels,gesvd,getrf,getrs,heev,heevd,
+# hemm,lange,lansy,lantr,posv,potrs,potri,symm,syrk,syr2k,trmm,trsm,
+# gecon,pocon,trcon,getri}.cc
+# ---------------------------------------------------------------------------
+
+_PREFIX_DTYPE = {"s": np.float32, "d": np.float64,
+                 "c": np.complex64, "z": np.complex128}
+
+
+def _lp():
+    from . import lapack_api
+    return lapack_api
+
+
+def _global(locals_, desc, dtype):
+    A, (m, n, nb, p, q) = _gather(locals_, desc)
+    return np.array(A.to_numpy(), dtype), (m, n, nb, p, q)
+
+
+def _make_p_getrf(pfx, dtype):
+    def p_getrf(m: int, n: int, a_locals, desca, ipiv_out=None):
+        """p?getrf. Writes LU into the locals; returns (ipiv, info).
+        ipiv is the GLOBAL 1-based LAPACK swap list (deviation from
+        ScaLAPACK's per-process-row distributed ipiv, documented)."""
+        a, _ = _global(a_locals, desca, dtype)
+        lu, ipiv, info = getattr(_lp(), pfx + "getrf")(m, n, a, m)
+        _scatter_back(a_locals, lu, desca)
+        if ipiv_out is not None:
+            np.asarray(ipiv_out)[: len(ipiv)] = ipiv
+        return ipiv, int(info)
+
+    p_getrf.__name__ = "p" + pfx + "getrf"
+    return p_getrf
+
+
+def _make_p_getrs(pfx, dtype):
+    def p_getrs(trans: str, n: int, nrhs: int, a_locals, desca, ipiv,
+                b_locals, descb):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        x, info = getattr(_lp(), pfx + "getrs")(trans, n, nrhs, a, n,
+                                                ipiv, b, n)
+        _scatter_back(b_locals, x, descb)
+        return int(info)
+
+    p_getrs.__name__ = "p" + pfx + "getrs"
+    return p_getrs
+
+
+def _make_p_potrs(pfx, dtype):
+    def p_potrs(uplo: str, n: int, nrhs: int, a_locals, desca,
+                b_locals, descb):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        x, info = getattr(_lp(), pfx + "potrs")(uplo, n, nrhs, a, n, b, n)
+        _scatter_back(b_locals, x, descb)
+        return int(info)
+
+    p_potrs.__name__ = "p" + pfx + "potrs"
+    return p_potrs
+
+
+def _make_p_posv(pfx, dtype):
+    def p_posv(uplo: str, n: int, nrhs: int, a_locals, desca,
+               b_locals, descb):
+        # factor once + potrs (not the posv driver, which would factor a
+        # second time just to recover the factor for scatter-back)
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        lu, info = getattr(_lp(), pfx + "potrf")(uplo, n, a, n)
+        if info == 0:
+            tri = np.tril(lu) if uplo.lower().startswith("l") \
+                else np.triu(lu)
+            x, info = getattr(_lp(), pfx + "potrs")(uplo, n, nrhs, tri, n,
+                                                    b, n)
+        if info == 0:
+            keep = np.triu(a, 1) if uplo.lower().startswith("l") \
+                else np.tril(a, -1)
+            _scatter_back(a_locals, tri + keep, desca)
+            _scatter_back(b_locals, x, descb)
+        return int(info)
+
+    p_posv.__name__ = "p" + pfx + "posv"
+    return p_posv
+
+
+def _make_p_potri(pfx, dtype):
+    def p_potri(uplo: str, n: int, a_locals, desca):
+        a, _ = _global(a_locals, desca, dtype)
+        inv, info = getattr(_lp(), pfx + "potri")(uplo, n, a, n)
+        _scatter_back(a_locals, inv, desca)
+        return int(info)
+
+    p_potri.__name__ = "p" + pfx + "potri"
+    return p_potri
+
+
+def _make_p_getri(pfx, dtype):
+    def p_getri(n: int, a_locals, desca, ipiv):
+        a, _ = _global(a_locals, desca, dtype)
+        inv, info = getattr(_lp(), pfx + "getri")(n, a, n, ipiv)
+        _scatter_back(a_locals, inv, desca)
+        return int(info)
+
+    p_getri.__name__ = "p" + pfx + "getri"
+    return p_getri
+
+
+def _make_p_gels(pfx, dtype):
+    def p_gels(trans: str, m: int, n: int, nrhs: int, a_locals, desca,
+               b_locals, descb):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        x, info = getattr(_lp(), pfx + "gels")(trans, m, n, nrhs, a, m,
+                                               b, b.shape[0])
+        if info != 0:  # driver failure: leave the locals untouched
+            return int(info)
+        bg = np.array(b)
+        k = x.shape[0]
+        bg[:k, :nrhs] = x
+        _scatter_back(b_locals, bg, descb)
+        return int(info)
+
+    p_gels.__name__ = "p" + pfx + "gels"
+    return p_gels
+
+
+def _make_p_gesvd(pfx, dtype):
+    def p_gesvd(jobu: str, jobvt: str, m: int, n: int, a_locals, desca,
+                u_locals=None, descu=None, vt_locals=None, descvt=None):
+        """p?gesvd. Returns (s, info); U/Vᵀ scattered if locals given."""
+        a, _ = _global(a_locals, desca, dtype)
+        s, u, vt, info = getattr(_lp(), pfx + "gesvd")(jobu, jobvt, m, n,
+                                                       a, m)
+        if u is not None and u_locals is not None:
+            _scatter_back(u_locals, u, descu)
+        if vt is not None and vt_locals is not None:
+            _scatter_back(vt_locals, vt, descvt)
+        return s, int(info)
+
+    p_gesvd.__name__ = "p" + pfx + "gesvd"
+    return p_gesvd
+
+
+def _make_p_heev(pfx, dtype, name):
+    def p_heev(jobz: str, uplo: str, n: int, a_locals, desca,
+               z_locals=None, descz=None):
+        """p?syev/p?heev[d]. Returns (w, info); Z scattered if given.
+        The lapack_api name (syev vs syevd = QR-sized vs DC pipeline)
+        already encodes the method."""
+        lp_name = name[1:]  # strip the p
+        a, _ = _global(a_locals, desca, dtype)
+        w, z, info = getattr(_lp(), lp_name)(jobz, uplo, n, a, n)
+        if z is not None and z_locals is not None:
+            _scatter_back(z_locals, z, descz)
+        return np.asarray(w), int(info)
+
+    p_heev.__name__ = name
+    return p_heev
+
+
+def _make_p_blas3(pfx, dtype, base):
+    lpn = pfx + base
+
+    def p_trmm_trsm(side, uplo, transa, diag, m, n, alpha, a_locals,
+                    desca, b_locals, descb):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        out = getattr(_lp(), lpn)(side, uplo, transa, diag, m, n, alpha,
+                                  a, a.shape[0], b, b.shape[0])
+        _scatter_back(b_locals, out, descb)
+
+    def p_rank_k(uplo, trans, n, k, alpha, a_locals, desca, beta,
+                 c_locals, descc):
+        a, _ = _global(a_locals, desca, dtype)
+        c, _ = _global(c_locals, descc, dtype)
+        out = getattr(_lp(), lpn)(uplo, trans, n, k, alpha, a,
+                                  a.shape[0], beta, c, c.shape[0])
+        _scatter_back(c_locals, out, descc)
+
+    def p_rank_2k(uplo, trans, n, k, alpha, a_locals, desca, b_locals,
+                  descb, beta, c_locals, descc):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        c, _ = _global(c_locals, descc, dtype)
+        out = getattr(_lp(), lpn)(uplo, trans, n, k, alpha, a,
+                                  a.shape[0], b, b.shape[0], beta, c,
+                                  c.shape[0])
+        _scatter_back(c_locals, out, descc)
+
+    def p_symm_like(side, uplo, m, n, alpha, a_locals, desca, b_locals,
+                    descb, beta, c_locals, descc):
+        a, _ = _global(a_locals, desca, dtype)
+        b, _ = _global(b_locals, descb, dtype)
+        c, _ = _global(c_locals, descc, dtype)
+        out = getattr(_lp(), lpn)(side, uplo, m, n, alpha, a, a.shape[0],
+                                  b, b.shape[0], beta, c, c.shape[0])
+        _scatter_back(c_locals, out, descc)
+
+    fn = {"trmm": p_trmm_trsm, "trsm": p_trmm_trsm,
+          "syrk": p_rank_k, "herk": p_rank_k,
+          "syr2k": p_rank_2k, "her2k": p_rank_2k,
+          "symm": p_symm_like, "hemm": p_symm_like}[base]
+    fn.__name__ = "p" + lpn
+    return fn
+
+
+def _make_p_norm(pfx, dtype, base):
+    lpn = pfx + base
+
+    def p_lange(norm_c, m, n, a_locals, desca):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(norm_c, m, n, a, m)
+
+    def p_lanhe(norm_c, uplo, n, a_locals, desca):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(norm_c, uplo, n, a, n)
+
+    def p_lantr(norm_c, uplo, diag, m, n, a_locals, desca):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(norm_c, uplo, diag, m, n, a, m)
+
+    fn = {"lange": p_lange, "lansy": p_lanhe, "lanhe": p_lanhe,
+          "lantr": p_lantr}[base]
+    fn.__name__ = "p" + lpn
+    return fn
+
+
+def _make_p_con(pfx, dtype, base):
+    lpn = pfx + base
+
+    def p_gecon(norm_c, n, a_locals, desca, anorm):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(norm_c, n, a, n, anorm)
+
+    def p_pocon(uplo, n, a_locals, desca, anorm):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(uplo, n, a, n, anorm)
+
+    def p_trcon(norm_c, uplo, diag, n, a_locals, desca):
+        a, _ = _global(a_locals, desca, dtype)
+        return getattr(_lp(), lpn)(norm_c, uplo, diag, n, a, n)
+
+    fn = {"gecon": p_gecon, "pocon": p_pocon, "trcon": p_trcon}[base]
+    fn.__name__ = "p" + lpn
+    return fn
+
+
+def _export(name, fn):
+    """Register under the reference's triple spellings
+    (scalapack_api/scalapack_potrf.cc:44-90)."""
+    globals()[name] = fn
+    globals()[name + "_"] = fn
+    globals()[name.upper()] = fn
+
+
+for _pfx, _dt in _PREFIX_DTYPE.items():
+    _export("p" + _pfx + "getrf", _make_p_getrf(_pfx, _dt))
+    _export("p" + _pfx + "getrs", _make_p_getrs(_pfx, _dt))
+    _export("p" + _pfx + "getri", _make_p_getri(_pfx, _dt))
+    _export("p" + _pfx + "potrs", _make_p_potrs(_pfx, _dt))
+    _export("p" + _pfx + "posv", _make_p_posv(_pfx, _dt))
+    _export("p" + _pfx + "potri", _make_p_potri(_pfx, _dt))
+    _export("p" + _pfx + "gels", _make_p_gels(_pfx, _dt))
+    _export("p" + _pfx + "gesvd", _make_p_gesvd(_pfx, _dt))
+    for _b in ("trmm", "trsm", "syrk", "syr2k", "symm"):
+        _export("p" + _pfx + _b, _make_p_blas3(_pfx, _dt, _b))
+    for _b in ("lange", "lansy", "lantr"):
+        _export("p" + _pfx + _b, _make_p_norm(_pfx, _dt, _b))
+    for _b in ("gecon", "pocon", "trcon"):
+        _export("p" + _pfx + _b, _make_p_con(_pfx, _dt, _b))
+for _pfx in ("s", "d"):
+    _export("p" + _pfx + "syev",
+            _make_p_heev(_pfx, _PREFIX_DTYPE[_pfx], "p" + _pfx + "syev"))
+    _export("p" + _pfx + "syevd",
+            _make_p_heev(_pfx, _PREFIX_DTYPE[_pfx], "p" + _pfx + "syevd"))
+for _pfx in ("c", "z"):
+    _export("p" + _pfx + "heev",
+            _make_p_heev(_pfx, _PREFIX_DTYPE[_pfx], "p" + _pfx + "heev"))
+    _export("p" + _pfx + "heevd",
+            _make_p_heev(_pfx, _PREFIX_DTYPE[_pfx], "p" + _pfx + "heevd"))
+    for _b in ("hemm", "herk", "her2k"):
+        _export("p" + _pfx + _b, _make_p_blas3(_pfx, _PREFIX_DTYPE[_pfx],
+                                               _b))
+    _export("p" + _pfx + "lanhe", _make_p_norm(_pfx, _PREFIX_DTYPE[_pfx],
+                                               "lanhe"))
+
 # underscore spellings, like the reference's triple exports
 pdpotrf_ = pdpotrf
 pdgesv_ = pdgesv

