@@ -25,7 +25,8 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
                      PackedBand, BandLU, pb_pack, gb_pack, tbsm_packed,
                      gecondest, pocondest, trcondest, hesv, hetrf, hetrs,
-                     heev, hegv, hegst, he2hb, he2td, unmtr_he2hb,
+                     heev, hegv, hegst, he2hb, he2td, hb2td, unmtr_he2hb,
+                     unmtr_hb2td,
                      unmtr_he2td, steqr, sterf,
                      svd, ge2tb, bdsqr)
 from . import api

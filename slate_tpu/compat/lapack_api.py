@@ -211,10 +211,7 @@ def _make_getrs(prefix, dtype):
         bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
         ip = np.asarray(ipiv)
         if ip.min() >= 1:  # LAPACK 1-based swap list → gather perm
-            perm = np.arange(n)
-            for i, p in enumerate(ip[:n]):
-                j = int(p) - 1
-                perm[i], perm[j] = perm[j], perm[i]
+            perm = _ipiv_to_perm(ip, n)
         else:
             perm = ip
         LU = st.from_dense(lun, nb=_nb(n))
@@ -545,7 +542,9 @@ def _make_gecon(prefix, dtype):
         lun = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
         LU = st.from_dense(lun, nb=_nb(n))
         perm = jnp.arange(LU.data.shape[0])
-        return float(st.gecondest(LU, perm, float(anorm))), 0
+        inf = norm_c.lower().startswith("i")
+        return float(st.gecondest(LU, perm, float(anorm),
+                                  inf_norm=inf)), 0
 
     gecon.__name__ = prefix + "gecon"
     return gecon
@@ -574,7 +573,8 @@ def _make_trcon(prefix, dtype):
         tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
         d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
         T = st.triangular(tri, nb=_nb(n), uplo=u, diag=d)
-        return float(st.trcondest(T)), 0
+        inf = norm_c.lower().startswith("i")
+        return float(st.trcondest(T, inf_norm=inf)), 0
 
     trcon.__name__ = prefix + "trcon"
     return trcon

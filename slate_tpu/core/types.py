@@ -221,6 +221,13 @@ class Options:
     method_lu: MethodLU = MethodLU.Auto
     method_gels: MethodGels = MethodGels.Auto
     method_eig: MethodEig = MethodEig.Auto
+    # stage-1 reduction strategy for the DC eigensolver path:
+    # "he2td" = direct blocked tridiagonalization (one stage, half the
+    # flops in sequential full-matrix matvecs); "two_stage" = he2hb
+    # band reduction (all-gemm) + hb2td bulge chase on O(n·nb) data
+    # (the reference's he2hb+hb2st split, src/he2hb.cc + src/hb2st.cc);
+    # "auto" picks per backend/size (see eig._heev_td and PERF.md)
+    eig_stage1: str = "auto"
     method_svd: MethodSVD = MethodSVD.Auto
     # printing (reference enums.hh:477-487)
     print_verbose: int = 4

@@ -74,15 +74,21 @@ def _rhs(n: int, nb: int, x) -> TiledMatrix:
 
 
 def gecondest(LU: TiledMatrix, perm, anorm: float,
-              opts: Options = DEFAULT_OPTIONS) -> float:
-    """Reciprocal condition estimate 1/(‖A‖₁·‖A⁻¹‖₁) from getrf factors
-    (slate::gecondest)."""
+              opts: Options = DEFAULT_OPTIONS,
+              inf_norm: bool = False) -> float:
+    """Reciprocal condition estimate 1/(‖A‖·‖A⁻¹‖) from getrf factors
+    (slate::gecondest). ``inf_norm``: estimate in the ∞-norm instead of
+    the 1-norm — ‖A⁻¹‖_∞ = ‖A⁻ᴴ‖₁, i.e. the estimator runs with the
+    solve and conjugate-transpose-solve roles swapped (LAPACK
+    gecon('I'))."""
     n = LU.shape[0]
-    inv_norm = _norm1est(
-        lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts).to_dense(),
-        _conj_solve(lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts,
-                                    trans=True).to_dense()),
-        n, LU.dtype)
+    solve = lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts).to_dense()
+    solve_h = _conj_solve(
+        lambda x: getrs(LU, perm, _rhs(n, LU.nb, x), opts,
+                        trans=True).to_dense())
+    if inf_norm:
+        solve, solve_h = solve_h, solve
+    inv_norm = _norm1est(solve, solve_h, n, LU.dtype)
     if anorm == 0 or inv_norm == 0:
         return 0.0
     return 1.0 / (float(anorm) * inv_norm)
@@ -99,16 +105,20 @@ def pocondest(L: TiledMatrix, anorm: float,
     return 1.0 / (float(anorm) * inv_norm)
 
 
-def trcondest(T: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> float:
-    """Triangular condition estimate (slate::trcondest, used by gels)."""
+def trcondest(T: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+              inf_norm: bool = False) -> float:
+    """Triangular condition estimate (slate::trcondest, used by gels).
+    ``inf_norm``: ∞-norm variant (solve roles swapped, ‖T‖_∞ in the
+    numerator)."""
     n = T.shape[0]
-    anorm = float(norm(T, Norm.One))
-    inv_norm = _norm1est(
-        lambda x: blas3.trsm(Side.Left, 1.0, T, _rhs(n, T.nb, x),
-                             opts).to_dense(),
-        lambda x: blas3.trsm(Side.Left, 1.0, T.H, _rhs(n, T.nb, x),
-                             opts).to_dense(),
-        n, T.dtype)
+    anorm = float(norm(T, Norm.Inf if inf_norm else Norm.One))
+    solve = lambda x: blas3.trsm(Side.Left, 1.0, T, _rhs(n, T.nb, x),
+                                 opts).to_dense()
+    solve_h = lambda x: blas3.trsm(Side.Left, 1.0, T.H, _rhs(n, T.nb, x),
+                                   opts).to_dense()
+    if inf_norm:
+        solve, solve_h = solve_h, solve
+    inv_norm = _norm1est(solve, solve_h, n, T.dtype)
     if anorm == 0 or inv_norm == 0:
         return 0.0
     return 1.0 / (anorm * inv_norm)

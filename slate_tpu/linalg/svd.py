@@ -50,14 +50,103 @@ def _panel_reflector(panel: Array):
     return v, _larft(v, taus), jnp.triu(packed[:w])
 
 
+@functools.partial(jax.jit, static_argnames=("nb", "kp"))
+def _ge2tb_level(a: Array, nb: int, kp: int):
+    """One ge2tb level: reduce the first ``kp`` diagonal panels of the
+    (sm × sn) matrix to band upper form with fixed-shape full-matrix
+    updates — O(1) HLO per level (the he2hb treatment applied to the
+    two-sided QR/LQ reduction; see eig._he2hb_level). Panels whose LQ
+    step falls off the right edge degrade to no-ops via _larfg's
+    degenerate case. Returns (a, Vls, Tls, Vrs, Trs) stacked per panel;
+    left panel k pivots at row k·nb, right at column (k+1)·nb."""
+    sm, sn = a.shape
+    rows_m = jnp.arange(sm)
+    rows_n = jnp.arange(sn)
+    jcols = jnp.arange(nb)
+
+    def qr_col(j, carry):
+        P, V, taus, j0 = carry
+        s = P.shape[0]
+        rows = jnp.arange(s)
+        r = j0 + j
+        # pivots past the edge (the last panel's LQ in a square matrix)
+        # are no-ops: v = 0, τ = 0 keeps larft/back-transform exact
+        valid = r < s
+        col = jax.lax.dynamic_slice(P, (0, j), (s, 1))[:, 0]
+        alpha = jax.lax.dynamic_slice(col, (jnp.minimum(r, s - 1),),
+                                      (1,))[0]
+        tail = jnp.where(rows > r, col, 0)
+        beta, tau, scale = blocked._larfg(alpha, tail)
+        tau = jnp.where(valid, tau, 0)
+        v = jnp.where(rows > r, col * scale, 0) \
+            + jnp.where(rows == r, jnp.ones((), P.dtype), 0)
+        v = jnp.where(valid, v, 0)
+        wrow = jnp.conj(v) @ P
+        P = P - jnp.outer(jnp.conj(tau) * v, wrow)
+        V = jax.lax.dynamic_update_slice(V, v[:, None], (0, j))
+        return (P, V, taus.at[j].set(tau), j0)
+
+    def panel_body(k, carry):
+        a, Vls, Tls, Vrs, Trs = carry
+        k0 = k * nb
+        k1 = k0 + nb
+        # ---- left QR of the diagonal panel (pivot rows k0 + j) ----
+        P = jax.lax.dynamic_slice(a, (0, k0), (sm, nb))
+        P, Vl, tl, _ = jax.lax.fori_loop(
+            0, nb, qr_col, (P, jnp.zeros((sm, nb), a.dtype),
+                            jnp.zeros((nb,), a.dtype), k0))
+        Tl = blocked.larft(Vl, tl)
+        # apply Hᴴ to the trailing columns only
+        upd = Vl @ (jnp.conj(Tl).T @ (jnp.conj(Vl).T @ a))
+        a = a - jnp.where(rows_n[None, :] >= k1, upd, 0)
+        # write [R; 0] into the panel columns
+        keep_r = (rows_m[:, None] >= k0) & (rows_m[:, None] <= k0 + jcols)
+        newcols = jnp.where(rows_m[:, None] < k0, P,
+                            jnp.where(keep_r, P, 0))
+        a = jax.lax.dynamic_update_slice(a, newcols, (0, k0))
+        # ---- right LQ of the row block (pivot cols k1 + j) ----
+        G = jnp.conj(jax.lax.dynamic_slice(a, (k0, 0), (nb, sn))).T
+        G, Vr, tr, _ = jax.lax.fori_loop(
+            0, nb, qr_col, (G, jnp.zeros((sn, nb), a.dtype),
+                            jnp.zeros((nb,), a.dtype), k1))
+        Tr = blocked.larft(Vr, tr)
+        # a ← a·Gᴴ_refl: conjugate-transpose, apply, transpose back;
+        # restrict to rows ≥ k0 (earlier band rows untouched)
+        C = jnp.conj(a).T
+        updr = Vr @ (jnp.conj(Tr).T @ (jnp.conj(Vr).T @ C))
+        C = C - jnp.where(rows_m[None, :] >= k0, updr, 0)
+        a = jnp.conj(C).T
+        # write [Lᴴ; 0] into the row block (cols ≥ k1 only)
+        keep_rg = (rows_n[:, None] >= k1) & (rows_n[:, None] <= k1 + jcols)
+        newG = jnp.where(rows_n[:, None] < k1, G,
+                         jnp.where(keep_rg, G, 0))
+        oldrows = jax.lax.dynamic_slice(a, (k0, 0), (nb, sn))
+        newrows = jnp.where(rows_n[None, :] >= k1, jnp.conj(newG).T,
+                            oldrows)
+        a = jax.lax.dynamic_update_slice(a, newrows, (k0, 0))
+        Vls = jax.lax.dynamic_update_slice(Vls, Vl[None], (k, 0, 0))
+        Tls = jax.lax.dynamic_update_slice(Tls, Tl[None], (k, 0, 0))
+        Vrs = jax.lax.dynamic_update_slice(Vrs, Vr[None], (k, 0, 0))
+        Trs = jax.lax.dynamic_update_slice(Trs, Tr[None], (k, 0, 0))
+        return (a, Vls, Tls, Vrs, Trs)
+
+    Vls0 = jnp.zeros((kp, sm, nb), a.dtype)
+    Tls0 = jnp.zeros((kp, nb, nb), a.dtype)
+    Vrs0 = jnp.zeros((kp, sn, nb), a.dtype)
+    Trs0 = jnp.zeros((kp, nb, nb), a.dtype)
+    return jax.lax.fori_loop(0, kp, panel_body,
+                             (a, Vls0, Tls0, Vrs0, Trs0))
+
+
 @accurate_matmuls
 def ge2tb(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
     """Reduce general A (m ≥ n) to band upper-triangular form
     B = Uᴴ·A·V with bandwidth nb (slate::ge2tb, src/ge2tb.cc).
 
-    Returns (band array (mpad, npad), u_refl, v_refl) where u_refl /
-    v_refl are lists of (V, T) block reflectors of U (left) and V
-    (right)."""
+    Returns (band array (mpad, npad), u_refl, v_refl): level lists of
+    (offset, Vs, Ts) stacked block reflectors of U (left, panel k pivots
+    at global row offset + k·nb) and V (right, pivot col
+    offset + (k+1)·nb)."""
     m, n = A.shape
     nb = A.nb
     a = A.dense_canonical()
@@ -66,59 +155,38 @@ def ge2tb(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
     # zero padding contributes exact zero singular values that sort last
     mpad, npad = a.shape
     kt = npad // nb
-    u_refl: List[Tuple[Array, Array]] = []
-    v_refl: List[Tuple[Array, Array]] = []
-    for k in range(kt):
-        k0, k1 = k * nb, (k + 1) * nb
-        # left: QR of the panel zeroes below-diagonal in block column k
-        v, t, r = _panel_reflector(a[k0:, k0:k1])
-        u_refl.append((v, t))
-        a = a.at[k0:, k1:].set(
-            _apply_block_reflector_H(v, t, a[k0:, k1:]))
-        a = a.at[k0:, k0:k1].set(
-            jnp.zeros_like(a[k0:, k0:k1]).at[:r.shape[0]].set(r))
-        # right: LQ of the row block zeroes right of the first
-        # superdiagonal block
-        if k1 < npad:
-            row = a[k0:k1, k1:]
-            vr, tr, lr = _panel_reflector(jnp.conj(row).T)
-            v_refl.append((vr, tr))
-            # A ← A·(I − Vr·Tr·Vrᴴ)ᴴ  applied to columns k1:
-            blk = a[k0:, k1:]
-            blk = jnp.conj(_apply_block_reflector_H(
-                vr, tr, jnp.conj(blk).T)).T
-            a = a.at[k0:, k1:].set(blk)
-            a = a.at[k0:k1, k1:].set(
-                jnp.zeros_like(row).at[:, :lr.shape[0]].set(jnp.conj(lr).T))
+    u_refl: List[Tuple[int, Array, Array]] = []
+    v_refl: List[Tuple[int, Array, Array]] = []
+    off = 0
+    for kp in blocked.level_plan(kt):
+        sub = a[off:, off:]
+        sub, Vls, Tls, Vrs, Trs = _ge2tb_level(sub, nb=nb, kp=kp)
+        a = a.at[off:, off:].set(sub)
+        u_refl.append((off, Vls, Tls))
+        v_refl.append((off, Vrs, Trs))
+        off += kp * nb
     return a, u_refl, v_refl
 
 
 def _apply_u(u_refl, C: Array, nb: int, trans: bool) -> Array:
-    """C ← U·C (or Uᴴ·C); U = H₀·H₁·… with Hₖ acting on rows k·nb.."""
-    kt = len(u_refl)
-    order = range(kt) if trans else range(kt - 1, -1, -1)
-    for k in order:
-        k0 = k * nb
-        v, t = u_refl[k]
-        blk = C[k0:, :]
-        blk = _apply_block_reflector_H(v, t, blk) if trans \
-            else _apply_block_reflector(v, t, blk)
-        C = C.at[k0:, :].set(blk)
+    """C ← U·C (or Uᴴ·C); U = H₀·H₁·… in level order, each level one
+    stacked-reflector jit."""
+    if trans:
+        for off, Vs, Ts in u_refl:
+            C = C.at[off:, :].set(
+                blocked.apply_block_reflectors_stacked_H(Vs, Ts,
+                                                         C[off:, :]))
+        return C
+    for off, Vs, Ts in reversed(u_refl):
+        C = C.at[off:, :].set(
+            blocked.apply_block_reflectors_stacked(Vs, Ts, C[off:, :]))
     return C
 
 
 def _apply_v(v_refl, C: Array, nb: int, trans: bool) -> Array:
-    """C ← V·C (or Vᴴ·C); V = G₀·G₁·… with Gₖ acting on rows (k+1)·nb.."""
-    kt = len(v_refl)
-    order = range(kt) if trans else range(kt - 1, -1, -1)
-    for k in order:
-        k1 = (k + 1) * nb
-        v, t = v_refl[k]
-        blk = C[k1:, :]
-        blk = _apply_block_reflector_H(v, t, blk) if trans \
-            else _apply_block_reflector(v, t, blk)
-        C = C.at[k1:, :].set(blk)
-    return C
+    """C ← V·C (or Vᴴ·C); V = G₀·G₁·… in level order (same machinery;
+    Gₖ's support rows start one block lower, encoded in the V arrays)."""
+    return _apply_u(v_refl, C, nb, trans)
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +351,7 @@ def bdsqr(d, e, compute_uv: bool = False, logical_k: Optional[int] = None):
         w, _ = stedc_fn(tzero, off, compute_z=False)
         return jnp.asarray(np.sort(w[k:])[::-1].copy())
     w, q = stedc_fn(tzero, off)
+    q = np.asarray(q)  # device-resident merges return a jax.Array
     sig = w[k:]              # ascending positive half
     Q = q[:, k:]
     v = np.sqrt(2.0) * Q[0::2, :]
@@ -376,11 +445,10 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
             "bidiagonalization is real; complex inputs take the "
             "MethodSVD.Auto band path)")
     if method is MethodSVD.Auto and min(m, n) >= _DC_MIN_N \
-            and not jnp.iscomplexobj(A.data) \
-            and jax.default_backend() == "cpu":
-        # same runtime-aware heuristic as heev (see eig.py): DC by
-        # default on CPU meshes, dense band path on attached
-        # accelerators, MethodSVD.DC to force the scalable pipeline
+            and not jnp.iscomplexobj(A.data):
+        # DC is the large-n method on every backend (same reasoning as
+        # heev: stedc's device-resident merges removed the round-2
+        # CPU-only gate); MethodSVD.DC forces it at any size
         method = MethodSVD.DC
     if method is MethodSVD.DC and m < 2 * n:
         return _svd_dc(A, opts, want_vectors)

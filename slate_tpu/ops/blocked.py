@@ -596,6 +596,34 @@ def apply_block_reflectors_stacked(Vs: Array, Ts: Array, C: Array) -> Array:
     return lax.fori_loop(0, n_panels, step, C)
 
 
+def level_plan(rem: int, min_panels: int = 4):
+    """Panel counts per level for the halving two-sided reductions
+    (he2hb / ge2tb): halve the remaining panels until few are left,
+    then finish — O(log rem) jitted programs, ~1.7× flop overhead
+    versus perfectly-shrinking updates."""
+    plan = []
+    while rem > 0:
+        kp = rem if rem <= min_panels else rem // 2
+        plan.append(kp)
+        rem -= kp
+    return plan
+
+
+@jax.jit
+def apply_block_reflectors_stacked_H(Vs: Array, Ts: Array,
+                                     C: Array) -> Array:
+    """C ← Qᴴ·C for the same stacked Q as apply_block_reflectors_stacked
+    (first panel applies first; Hᴴ = I − V·Tᴴ·Vᴴ)."""
+    n_panels = Vs.shape[0]
+
+    def step(k, C):
+        V = Vs[k]
+        T = Ts[k]
+        return C - V @ (jnp.conj(T).T @ (jnp.conj(V).T @ C))
+
+    return lax.fori_loop(0, n_panels, step, C)
+
+
 @functools.partial(jax.jit, static_argnames=("ib",))
 def panel_geqrf_with_t(a: Array, ib: int = PANEL_IB):
     """jit entry: bucketed panel QR + its T factor, compiled per bucket.

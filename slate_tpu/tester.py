@@ -641,11 +641,14 @@ def _t_stedc(ctx):
     t0 = time.perf_counter()
     w, z = stedc(d, e)
     secs = time.perf_counter() - t0
+    z = np.asarray(z)  # device path returns a jax.Array basis
     t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
-    epsd = np.finfo(np.float64).eps
+    # eps of the basis dtype: the device merge path runs f32 bases on
+    # accelerators (f64 on CPU meshes with x64)
+    epsz = np.finfo(z.dtype).eps
     res = _rel(np.abs(t @ z - z * w).max(),
-               epsd * n * max(np.abs(w).max(), 1e-300))
-    orth = _rel(np.abs(z.T @ z - np.eye(n)).max(), epsd * n)
+               epsz * n * max(np.abs(w).max(), 1e-300))
+    orth = _rel(np.abs(z.T @ z - np.eye(n)).max(), epsz * n)
     return secs, max(res, orth)
 
 

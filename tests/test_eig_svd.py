@@ -76,7 +76,7 @@ def test_he2hb_preserves_spectrum():
     n, nb = 40, 8
     a = _herm(n, seed=3)
     A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower)
-    band, vs, ts = st.he2hb(A)
+    band, reflectors = st.he2hb(A)
     bf = np.asarray(band.full_dense_canonical())[:n, :n]
     # band structure: zero outside bandwidth nb
     r, c = np.indices((n, n))
