@@ -104,22 +104,32 @@ def bench_potrf(n=8192, nb=1024, dtype=jnp.float32):
     return (n ** 3 / 3.0) / 1e9 / t, t
 
 
-def bench_getrf(n=8192, nb=1024, dtype=jnp.float32):
+def bench_getrf(n=8192, nb=1024, dtype=jnp.float32, opts=None):
     import slate_tpu as st
+    from slate_tpu.core.types import Options
     from slate_tpu.matgen import generate_matrix
 
     a = generate_matrix("randn", n, n, dtype, seed=4)
     # diagonal dominance keeps the iterated factor chain stable
     a = a + n * jnp.eye(n, dtype=dtype)
     A = st.from_dense(a, nb=nb)
+    opts = opts or Options()
 
     def step(a_data, cs):
         (A,) = cs
-        LU, perm, _ = st.getrf(A.with_data(a_data))
+        LU, perm, _ = st.getrf(A.with_data(a_data), opts)
         return a_data + 1e-30 * LU.data
 
     t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
     return (2.0 * n ** 3 / 3.0) / 1e9 / t, t
+
+
+def bench_getrf_calu(n=8192, nb=1024, dtype=jnp.float32):
+    """MethodLU.CALU (tournament pivoting) — PERF.md's recommended LU
+    method at scale; benched alongside partial pivot per VERDICT r2."""
+    from slate_tpu.core.types import MethodLU, Options
+    return bench_getrf(n=n, nb=nb, dtype=dtype,
+                       opts=Options(method_lu=MethodLU.CALU))
 
 
 def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32):
@@ -145,6 +155,7 @@ def main():
           file=sys.stderr)
     extra = {}
     for name, fn in (("potrf", bench_potrf), ("getrf", bench_getrf),
+                     ("getrf_calu", bench_getrf_calu),
                      ("geqrf", bench_geqrf)):
         try:
             gflops, t = fn(n=n)

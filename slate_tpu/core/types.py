@@ -219,6 +219,11 @@ class Options:
     method_trsm: MethodTrsm = MethodTrsm.Auto
     method_hemm: MethodHemm = MethodHemm.Auto
     method_lu: MethodLU = MethodLU.Auto
+    # explicit shard_map panel factorization for getrf: per-column
+    # maxloc pivot collective + masked-psum row swaps over the grid row
+    # axis (parallel/panel.py — the hand-scheduled counterpart of the
+    # GSPMD-inferred panel; reference Tile_getrf.hh:209-270)
+    lu_dist_panel: bool = False
     method_gels: MethodGels = MethodGels.Auto
     method_eig: MethodEig = MethodEig.Auto
     # stage-1 reduction strategy for the DC eigensolver path:

@@ -58,7 +58,7 @@ _pad_identity_diag = unit_pad_diag
 # partial-pivot LU
 # ---------------------------------------------------------------------------
 
-def _getrf_rec(a: Array, nb: int, prec):
+def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
     """Recursive blocked partial-pivot LU on an (M × W) column block,
     W ≤ M, recursing on width down to nb-wide panels.
 
@@ -79,17 +79,22 @@ def _getrf_rec(a: Array, nb: int, prec):
     if w <= nb:
         hb = blocked.bucket_pow2(m, nb)
         ap = jnp.pad(a, ((0, hb - m), (0, 0))) if hb > m else a
-        lu, perm, info = blocked.panel_getrf_jit(ap)
+        g = blocked._GRID_CTX.get()
+        if dist_panel and g is not None and hb % g.p == 0:
+            from ..parallel.panel import dist_panel_getrf
+            lu, perm, info = dist_panel_getrf(ap, g)
+        else:
+            lu, perm, info = blocked.panel_getrf_jit(ap)
         return lu[:m], perm[:m], info
     h = blocked._half(w, nb)
-    lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec)
+    lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec, dist_panel)
     right = blocked.permute_rows_limited(a[:, h:], p1, 2 * h)
     # U12 = L11⁻¹ · A12 (unit-lower block solve, gemm-based)
     u_top = blocked.trsm_rec(lu1[:h, :h], right[:h], left=True, lower=True,
                              unit=True, prec=prec, base=min(nb, h))
     schur = blocked.rebalance(
         right[h:] - blocked.mm(lu1[h:, :h], u_top, prec))
-    lu2, p2, i2 = _getrf_rec(schur, nb, prec)
+    lu2, p2, i2 = _getrf_rec(schur, nb, prec, dist_panel)
     low_left = blocked.permute_rows_limited(lu1[h:, :h], p2, 2 * (w - h))
     lu = jnp.concatenate([
         jnp.concatenate([lu1[:h], u_top], axis=1),
@@ -100,14 +105,15 @@ def _getrf_rec(a: Array, nb: int, prec):
     return lu, perm, info
 
 
-def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high"):
+def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
+                   dist_panel: bool = False):
     """Blocked partial-pivot LU on padded dense (possibly rectangular).
 
     Factors the leading min(m,n) columns recursively; for wide matrices
     the remaining U columns get one block solve + no further pivoting."""
     m, n = a.shape
     k = min(m, n)
-    lu, perm, info = _getrf_rec(a[:, :k], nb, prec)
+    lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel)
     if n > k:
         rest = blocked.permute_rows_limited(a[:, k:], perm, 2 * k)
         u_rest = blocked.trsm_rec(lu[:, :k], rest, left=True, lower=True,
@@ -134,7 +140,8 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     a = _pad_identity_diag(a, m, n)
     with blocked.distribute_on(A.grid):
         lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
-                                        prec=opts.update_precision)
+                                        prec=opts.update_precision,
+                                        dist_panel=opts.lu_dist_panel)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 

@@ -33,11 +33,23 @@ from jax.experimental.pallas import tpu as pltpu
 _MIN_BLOCK = 128  # MXU-friendly tile edge; also the lane dimension
 
 
+_VMEM_BUDGET = 14 * 2 ** 20  # leave headroom under the 16 MiB scoped limit
+
+
 def default_block(k: int) -> int:
     """The kernel's default tile edge for a rank-k update — the single
     source of truth for both the call-site eligibility gate
-    (blocked.herk_lower_rec) and the kernel itself."""
-    return max(_MIN_BLOCK, min(512, k))
+    (blocked.herk_lower_rec) and the kernel itself.
+
+    Sized so the pipelined working set fits scoped VMEM: two (b × k)
+    input tiles + the (b × b) in/out pair, double-buffered —
+    (2·b·k + 2·b²)·4·2 bytes. At k=2048 an unconditional b=512 blew the
+    16 MiB limit (measured at n=16384 potrf)."""
+    # power-of-two candidates keep n % block == 0 for padded tile sizes
+    for b in (512, 256, _MIN_BLOCK):
+        if (2 * b * k + 2 * b * b) * 4 * 2 <= _VMEM_BUDGET:
+            return max(_MIN_BLOCK, min(b, k))
+    return _MIN_BLOCK
 
 
 def herk_eligible(n: int, k: int, dtype, block: int) -> bool:

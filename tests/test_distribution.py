@@ -220,6 +220,37 @@ def test_hlo_rank_k_family_has_collectives(grid2x4):
                                rtol=1e-12, atol=1e-12)
 
 
+def test_dist_panel_maxloc(grid2x4):
+    """VERDICT r3 #7: the explicit shard_map panel (per-column maxloc
+    pivot collective + masked-psum row swaps, parallel/panel.py) must
+    match the GSPMD panel and compile with collectives; getrf routes to
+    it under Options.lu_dist_panel."""
+    import jax.numpy as jnp
+    from slate_tpu.parallel.panel import dist_panel_getrf
+
+    rng = np.random.default_rng(21)
+    m, w = 512, 64
+    a = jnp.asarray(rng.standard_normal((m, w)))
+    lu, perm, info = dist_panel_getrf(a, grid2x4)
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    assert int(info) == 0
+    L = np.tril(lu, -1) + np.concatenate(
+        [np.eye(w), np.zeros((m - w, w))])
+    U = np.triu(lu[:w])
+    assert np.abs(np.asarray(a)[perm] - L @ U).max() < 1e-12
+
+    assert _collective_count(lambda x: dist_panel_getrf(x, grid2x4),
+                             a) > 0, \
+        "maxloc panel compiled without collectives"
+
+    # driver call site: getrf(lu_dist_panel=True) agrees with default
+    n, nb = 256, 32
+    A = st.from_dense(rng.standard_normal((n, n)), nb=nb, grid=grid2x4)
+    lu0 = st.getrf(A)[0].to_numpy()
+    lu1 = st.getrf(A, st.Options(lu_dist_panel=True))[0].to_numpy()
+    np.testing.assert_allclose(lu1, lu0, rtol=1e-10, atol=1e-10)
+
+
 # -- explicit SUMMA routing -------------------------------------------------
 
 def test_method_gemm_summa_routing(grid2x4):

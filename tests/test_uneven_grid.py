@@ -81,3 +81,65 @@ def test_gecondest_complex():
     LU, perm, info = st.getrf(A)
     rcond = st.gecondest(LU, perm, 1.0)
     assert 0.5 < rcond <= 1.01
+
+
+# -- DESIGN.md P2 edge cases: raggedness where padded-uniform could
+#    silently go wrong ------------------------------------------------------
+
+@pytest.mark.parametrize("n", [37, 53])  # primes: maximally ragged tiles
+def test_prime_sizes_all_drivers(grid2x2, n):
+    nb = 16
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=n))
+    b = RNG.standard_normal((n, 3))
+    A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid2x2)
+    B = st.from_dense(b, nb=nb, grid=grid2x2)
+    X, info = st.posv(A, B)
+    assert int(info) == 0
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-8, atol=1e-9)
+    g = RNG.standard_normal((n, n))
+    Xg, info = st.gesv(st.from_dense(g, nb=nb, grid=grid2x2),
+                       st.from_dense(b, nb=nb, grid=grid2x2))
+    assert int(info) == 0
+    np.testing.assert_allclose(Xg.to_numpy(), np.linalg.solve(g, b),
+                               rtol=1e-7, atol=1e-8)
+    w, Z = st.heev(A)
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(a),
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_nb_larger_than_n(grid2x2):
+    """nb > n: one padded tile holds the whole matrix."""
+    n, nb = 11, 32
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=2))
+    b = RNG.standard_normal((n, 2))
+    A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid2x2)
+    X, info = st.posv(A, st.from_dense(b, nb=nb, grid=grid2x2))
+    assert int(info) == 0
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-9, atol=1e-10)
+
+
+def test_padding_isolated_from_results(grid2x2):
+    """The same logical matrix under different padding amounts (nb
+    choices → different pad sizes and grid roundings) must produce the
+    same logical results: padding is owned by the constructors and
+    never leaks into logical entries. (Raw storage poisoning via
+    with_data is OUT of contract — with_data requires canonical
+    padding, which the constructors maintain.)"""
+    n = 40
+    a = np.asarray(random_spd(n, dtype=jnp.float64, seed=3))
+    b = RNG.standard_normal((n, 2))
+    results = []
+    norms = []
+    for nb in (8, 16, 32):  # pad 0/8/24 rows + grid rounding
+        A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower, grid=grid2x2)
+        B = st.from_dense(b, nb=nb, grid=grid2x2)
+        X, info = st.posv(A, B)
+        assert int(info) == 0
+        results.append(X.to_numpy())
+        norms.append(float(st.norm(A, st.Norm.One)))
+    for r in results[1:]:
+        np.testing.assert_allclose(r, results[0], rtol=1e-9, atol=1e-10)
+    for m in norms:
+        assert np.isclose(m, np.abs(a).sum(axis=0).max())
