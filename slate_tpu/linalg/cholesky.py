@@ -19,9 +19,13 @@ loop inside one jit:
 Each step's trailing update is ONE large MXU matmul; under GSPMD the
 panel is all-gathered along the mesh axes (the analog of
 tileBcast/listBcastMT at src/potrf.cc:109-132) and the update runs on
-all devices. Lookahead (Option::Lookahead, P3) has no explicit analog:
-XLA's async scheduler overlaps the collectives of step k+1 with the
-tail of step k where the dependence allows.
+all devices. Lookahead (Option::Lookahead, P3) has no explicit analog
+— and measurement (PERF.md "Lookahead / overlap") shows the functional
+recursion genuinely serializes panel and update on one chip; the
+panel-latency budget is attacked directly (bucketed leaves, ib
+blocking) and on a mesh the rebalanced updates keep all devices busy
+while the panel runs. A double-buffered true-lookahead scan is future
+work for communication-bound multi-host meshes.
 
 Unlike LAPACK's in-place convention the factor is returned as a new
 lower-TriangularMatrix (functional semantics); ``info`` follows the

@@ -6,25 +6,24 @@ src/he2hb.cc (full→band stage 1, 729 LoC), src/hb2st.cc (band→tridiag
 bulge chasing), src/steqr*.cc / src/sterf.cc / src/stedc*.cc (tridiagonal
 eigensolvers), src/unmtr_he2hb.cc, src/unmtr_hb2st.cc (back-transforms).
 
-TPU-native design (SURVEY §7.7):
-- Stage 1 (he2hb): blocked two-sided band reduction — per panel one tall
-  QR plus the Hermitian rank-2b update A₂₂ ← A₂₂ − V·Wᴴ − W·Vᴴ with
-  W = Y − ½·V·(Tᴴ·(Vᴴ·Y)), Y = A₂₂·V·T. All FLOPs are large MXU matmuls;
-  under GSPMD the update is partitioned over the mesh exactly where the
-  reference runs he2hb_hemm/he2hb_her2k_offdiag_ranks batched kernels.
-- Stage 2+3: the band (O(n·nb) data) is gathered to one device and
-  diagonalized there — the same strategy as the reference, which gathers
-  the band to MPI rank 0 for hb2st (src/heev.cc:131-135) and then calls
-  LAPACK's steqr for the tridiagonal stage. Our single-device kernel is
-  XLA's eigh (QDWH-based on TPU — itself a matmul-rich algorithm); a
-  native bulge-chasing hb2st is the flagged follow-up.
-- Back-transform (unmtr_he2hb): apply the stage-1 block reflectors to the
-  band eigenvectors — one pair of matmuls per panel (the reference's
-  unmqr-like internal_unmtr_hb2st/unmtr_he2hb).
-- steqr: an own-implementation implicit-shift QR iteration on (d, e)
-  with eigenvector accumulation, host-side like the reference's direct
-  lapack::steqr calls (src/steqr_impl.cc runs Givens on the host per
-  rank). sterf (values only) wraps eigh_tridiagonal.
+TPU-native design (SURVEY §7.7), round-3 state:
+- Stage 1, two strategies (Options.eig_stage1): ``he2td`` — direct
+  blocked tridiagonalization, O(1)-HLO fori_loops, back-transform is
+  pure stacked gemms (the single-chip default, measured in PERF.md);
+  ``two_stage`` — he2hb band reduction (all-gemm, O(log nt) fixed-shape
+  level programs) + hb2td bulge chase (O(n·nb) data touched per sweep,
+  the reference's he2hb + hb2st split, src/he2hb.cc + src/hb2st.cc).
+- Stage 2 (hb2td): Householder bulge chasing on 3b×3b dynamic-slice
+  windows with traced hop counts; one sweep's reflectors have disjoint
+  supports, so the back-transform applies a whole sweep as one batched
+  segment update (unmtr_hb2st analog, src/unmtr_hb2st.cc).
+- Stage 3: stedc divide & conquer with device-resident merge GEMMs
+  (linalg/stedc.py) — the default at n ≥ _DC_MIN_N on every backend;
+  steqr (own implicit-shift QR iteration, host-side like the
+  reference's lapack::steqr calls) for small n under MethodEig.QR;
+  sterf (values only) wraps eigh_tridiagonal.
+- Back-transforms (unmtr_he2hb / unmtr_he2td / unmtr_hb2td): stacked
+  block reflectors applied in one jit per level.
 """
 
 from __future__ import annotations

@@ -79,7 +79,7 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
     if w <= nb:
         hb = blocked.bucket_pow2(m, nb)
         ap = jnp.pad(a, ((0, hb - m), (0, 0))) if hb > m else a
-        g = blocked._GRID_CTX.get()
+        g = blocked.current_grid()
         if dist_panel and g is not None and hb % g.p == 0:
             from ..parallel.panel import dist_panel_getrf
             lu, perm, info = dist_panel_getrf(ap, g)

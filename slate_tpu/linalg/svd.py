@@ -27,7 +27,8 @@ import numpy as np
 
 from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense
-from ..core.types import MethodSVD, Options, Side, DEFAULT_OPTIONS
+from ..core.types import (MatrixKind, MethodSVD, Options, Side, Uplo,
+                          DEFAULT_OPTIONS)
 from ..core.precision import accurate_matmuls
 from ..ops import blocked
 from .qr import (_apply_block_reflector, _apply_block_reflector_H, _larft,
@@ -195,8 +196,10 @@ def _apply_v(v_refl, C: Array, nb: int, trans: bool) -> Array:
 
 @functools.partial(jax.jit, static_argnames=("b",))
 def _ge2bd_jit(a: Array, b: int = _BD_PANEL):
-    """Blocked Householder bidiagonalization A = Q_l·B·Q_rᵀ on device
-    (real dtypes; the gebrd/labrd recurrences).
+    """Blocked Householder bidiagonalization A = Q_l·B·Q_rᴴ on device
+    (the gebrd/labrd recurrences; complex inputs produce a REAL
+    bidiagonal because every larfg beta is real — the zgebrd property,
+    verified to roundoff in tests).
 
     The direct TPU replacement for the reference's ge2tb + tb2bd chase
     (src/ge2tb.cc, src/tb2bd.cc) — same reasoning as eig._he2td_jit: the
@@ -309,6 +312,72 @@ def ge2bd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
 # unmbr-style back-transform: shared stacked-reflector application
 _apply_q_panels = blocked.apply_block_reflectors_stacked
 
+_BAND_DC_MIN = 1024  # below this the one-shot dense band SVD wins
+
+
+def _svd_band_gk(A: TiledMatrix, band: Array, u_refl, v_refl, k: int,
+                 want_vectors: bool, opts: Options):
+    """SVD endgame for the ge2tb band: embed the upper BAND B in the
+    perfect-shuffled Hermitian [[0, Bᴴ],[B, 0]] — a Hermitian band of
+    bandwidth 2·nb — and run the heev stage-2 pipeline on it (hb2td
+    bulge chase + stedc). Eigenpairs come out as ±σ with interleaved
+    (v, u) vectors; the top-k positive half is the SVD — the
+    reference's gather-band + tb2bd + bdsqr shape, with no dense SVD
+    anywhere.
+
+    Memory note: the embedding is STORED dense (2npad)² (hb2td operates
+    on dense storage with O(b²) windows), so this path's win over the
+    dense-band SVD is in FLOPs/data *touched* (O(n²·nb) chase + matmul-
+    rich stedc vs an O(n³) dense Jacobi/QDWH svd), not in footprint;
+    moving hb2td onto packed band storage is the follow-up that would
+    shrink memory to O(n·nb)."""
+    from .eig import hb2td as _hb2td, unmtr_hb2td as _unmtr_hb2td
+    from .stedc import stedc as stedc_fn
+
+    mpad, npad = band.shape
+    nbw = A.nb
+    m, n = A.shape
+    bsq = band[:npad, :npad]
+    s2 = 2 * npad
+    C = jnp.zeros((s2, s2), bsq.dtype)
+    C = C.at[1::2, 0::2].set(bsq)
+    C = C.at[0::2, 1::2].set(jnp.conj(bsq).T)
+    w2 = 2 * nbw
+    CB = from_dense(C, nbw, kind=MatrixKind.HermitianBand,
+                    uplo=Uplo.Lower, kl=w2, ku=w2,
+                    logical_shape=(s2, s2))
+    d, e, Vh, Th, phase = _hb2td(CB)
+    dn = np.asarray(d, np.float64)[:s2]
+    en = np.asarray(e, np.float64)[: s2 - 1]
+    rdt = jnp.finfo(A.dtype).dtype if not jnp.iscomplexobj(A.data) \
+        else jnp.zeros((), A.dtype).real.dtype
+    if not want_vectors:
+        w, _ = stedc_fn(dn, en, compute_z=False)
+        sig = np.sort(w)[::-1][:k]
+        return jnp.asarray(sig.copy(), rdt), None, None
+    w, z = stedc_fn(dn, en, grid=A.grid)
+    z = jnp.asarray(z)
+    order = np.argsort(np.asarray(w))[::-1][:k].copy()
+    sig = np.asarray(w)[order]
+    spad = Vh.shape[0] + 2
+    zsel = jnp.asarray(z[:, jnp.asarray(order)], C.dtype)
+    zt = jnp.zeros((spad, k), C.dtype).at[:s2].set(zsel)
+    zb = _unmtr_hb2td(Vh, Th, zt, phase)[:s2]
+    v = zb[0::2, :] * jnp.sqrt(jnp.asarray(2.0, rdt))
+    u = zb[1::2, :] * jnp.sqrt(jnp.asarray(2.0, rdt))
+    # tiny/zero σ: the ±σ pair is near-degenerate and the vector may
+    # split unevenly between the halves — renormalize per column
+    un = jnp.linalg.norm(u, axis=0)
+    vn = jnp.linalg.norm(v, axis=0)
+    u = u / jnp.where(un == 0, 1.0, un)
+    v = v / jnp.where(vn == 0, 1.0, vn)
+    u_pad = jnp.zeros((mpad, k), C.dtype).at[:npad].set(u)
+    Uf = _apply_u(u_refl, u_pad, nbw, trans=False)
+    Vf = _apply_v(v_refl, v, nbw, trans=False)
+    U = from_dense(Uf, nbw, grid=A.grid, logical_shape=(m, k))
+    V = from_dense(Vf, nbw, grid=A.grid, logical_shape=(n, k))
+    return jnp.asarray(sig.copy(), rdt), U, V
+
 
 def bdsqr(d, e, compute_uv: bool = False, logical_k: Optional[int] = None):
     """Singular values (and optionally vectors) of a real upper
@@ -391,8 +460,9 @@ def bdsqr(d, e, compute_uv: bool = False, logical_k: Optional[int] = None):
 
 
 def _svd_dc(A: TiledMatrix, opts: Options, want_vectors: bool):
-    """DC path (real dtypes): ge2bd device bidiagonalization + the
-    Golub-Kahan/stedc bdsqr + gemm back-transforms (MethodSVD.DC)."""
+    """DC path (all dtypes — the bidiagonal is real even for complex A):
+    ge2bd device bidiagonalization + the Golub-Kahan/stedc bdsqr + gemm
+    back-transforms (MethodSVD.DC)."""
     m, n = A.shape
     k = min(m, n)
     d, e, ql, qr = ge2bd(A, opts)
@@ -439,16 +509,12 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
         s, V, U = svd(A.H, opts, want_vectors=want_vectors)
         return s, U, V
     method = opts.method_svd
-    if method is MethodSVD.DC and jnp.iscomplexobj(A.data):
-        raise SlateError(
-            "svd: MethodSVD.DC supports real dtypes only (the ge2bd "
-            "bidiagonalization is real; complex inputs take the "
-            "MethodSVD.Auto band path)")
-    if method is MethodSVD.Auto and min(m, n) >= _DC_MIN_N \
-            and not jnp.iscomplexobj(A.data):
-        # DC is the large-n method on every backend (same reasoning as
-        # heev: stedc's device-resident merges removed the round-2
-        # CPU-only gate); MethodSVD.DC forces it at any size
+    if method is MethodSVD.Auto and min(m, n) >= _DC_MIN_N:
+        # DC is the large-n method on every backend and dtype (same
+        # reasoning as heev: stedc's device-resident merges removed the
+        # round-2 CPU-only gate; complex inputs work because ge2bd's
+        # larfg betas are real, so the bidiagonal comes out real — the
+        # zgebrd property); MethodSVD.DC forces it at any size
         method = MethodSVD.DC
     if method is MethodSVD.DC and m < 2 * n:
         return _svd_dc(A, opts, want_vectors)
@@ -477,7 +543,14 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
     mpad, npad = band.shape
     k = min(m, n)
     bsq = band[:npad, :npad]
-    # one-device band SVD (the rank-0 tb2bd+bdsqr analog). Padding rows/
+    if npad >= _BAND_DC_MIN and npad >= 3 * nb:
+        # band endgame on O(n·nb) data: Golub-Kahan-embed the BAND and
+        # chase it with hb2td + stedc (the tb2bd+bdsqr pipeline,
+        # src/tb2bd.cc + src/bdsqr.cc, through the heev stage-2
+        # machinery) — no dense svd of the full padded square
+        return _svd_band_gk(A, band, u_refl, v_refl, k, want_vectors,
+                            opts)
+    # small-n fallback: one-device dense SVD of the band. Padding rows/
     # cols are exactly zero, so the (npad - k) padding singular values
     # are exactly 0 and sort last in the descending spectrum.
     if want_vectors:

@@ -78,6 +78,12 @@ def distribute_on(grid):
         _GRID_CTX.reset(tok)
 
 
+def current_grid():
+    """The grid installed by distribute_on (None outside a context) —
+    the public accessor; callers must not read _GRID_CTX directly."""
+    return _GRID_CTX.get()
+
+
 def rebalance(x: Array) -> Array:
     """Constrain a 2-D intermediate to the active grid's (p, q) spec —
     the per-level load-balancing resharding (see _GRID_CTX). No-op

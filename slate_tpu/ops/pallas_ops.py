@@ -33,7 +33,12 @@ from jax.experimental.pallas import tpu as pltpu
 _MIN_BLOCK = 128  # MXU-friendly tile edge; also the lane dimension
 
 
-_VMEM_BUDGET = 14 * 2 ** 20  # leave headroom under the 16 MiB scoped limit
+# Budget in (2·b·k + 2·b²)·8-byte units. Mosaic's actual scoped-VMEM
+# accounting runs ~1.6× this model (measured: b=512, k=1024 → model
+# 12.6 MiB, compiler 20.21 MiB against a 16 MiB limit), so the budget
+# is set to 8 MiB model-units ≈ 13 MiB compiler-units.
+_VMEM_BUDGET = 8 * 2 ** 20
+_K_CHUNK = 1024  # contraction split: k beyond this is applied in chunks
 
 
 def default_block(k: int) -> int:
@@ -44,7 +49,9 @@ def default_block(k: int) -> int:
     Sized so the pipelined working set fits scoped VMEM: two (b × k)
     input tiles + the (b × b) in/out pair, double-buffered —
     (2·b·k + 2·b²)·4·2 bytes. At k=2048 an unconditional b=512 blew the
-    16 MiB limit (measured at n=16384 potrf)."""
+    16 MiB limit (measured at n=16384 potrf); beyond _K_CHUNK the
+    caller splits the contraction, so k here is ≤ _K_CHUNK."""
+    k = min(k, _K_CHUNK)
     # power-of-two candidates keep n % block == 0 for padded tile sizes
     for b in (512, 256, _MIN_BLOCK):
         if (2 * b * k + 2 * b * b) * 4 * 2 <= _VMEM_BUDGET:
@@ -117,6 +124,15 @@ def herk_lower_update(c: jax.Array, a: jax.Array,
     any backend (correctness tests on CPU meshes)."""
     n = c.shape[0]
     k = a.shape[1]
+    if k > _K_CHUNK:
+        # split the contraction so each kernel call fits scoped VMEM
+        # (measured: one unchunked call at k=8192 needs 16.25 MiB);
+        # the ragged last chunk falls back per-chunk via herk_eligible
+        # if its width is not kernel-friendly
+        for c0 in range(0, k, _K_CHUNK):
+            c = herk_lower_update(c, a[:, c0:min(c0 + _K_CHUNK, k)],
+                                  block, interpret=interpret, force=force)
+        return c
     block = block or default_block(k)
     if not force and not herk_eligible(n, k, c.dtype, block):
         return c - jax.lax.dot_general(
