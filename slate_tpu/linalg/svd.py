@@ -316,7 +316,7 @@ _BAND_DC_MIN = 1024  # below this the one-shot dense band SVD wins
 
 
 def _svd_band_gk(A: TiledMatrix, band: Array, u_refl, v_refl, k: int,
-                 want_vectors: bool, opts: Options):
+                 want_vectors: bool):
     """SVD endgame for the ge2tb band: embed the upper BAND B in the
     perfect-shuffled Hermitian [[0, Bᴴ],[B, 0]] — a Hermitian band of
     bandwidth 2·nb — and run the heev stage-2 pipeline on it (hb2td
@@ -353,24 +353,39 @@ def _svd_band_gk(A: TiledMatrix, band: Array, u_refl, v_refl, k: int,
         else jnp.zeros((), A.dtype).real.dtype
     if not want_vectors:
         w, _ = stedc_fn(dn, en, compute_z=False)
-        sig = np.sort(w)[::-1][:k]
+        # roundoff can push an exact-zero ±σ pair slightly negative
+        sig = np.maximum(np.sort(w)[::-1][:k], 0.0)
         return jnp.asarray(sig.copy(), rdt), None, None
     w, z = stedc_fn(dn, en, grid=A.grid)
     z = jnp.asarray(z)
     order = np.argsort(np.asarray(w))[::-1][:k].copy()
-    sig = np.asarray(w)[order]
+    sig = np.maximum(np.asarray(w)[order], 0.0)
     spad = Vh.shape[0] + 2
     zsel = jnp.asarray(z[:, jnp.asarray(order)], C.dtype)
     zt = jnp.zeros((spad, k), C.dtype).at[:s2].set(zsel)
     zb = _unmtr_hb2td(Vh, Th, zt, phase)[:s2]
-    v = zb[0::2, :] * jnp.sqrt(jnp.asarray(2.0, rdt))
-    u = zb[1::2, :] * jnp.sqrt(jnp.asarray(2.0, rdt))
+    v = np.asarray(zb[0::2, :]) * np.sqrt(2.0)
+    u = np.asarray(zb[1::2, :]) * np.sqrt(2.0)
     # tiny/zero σ: the ±σ pair is near-degenerate and the vector may
     # split unevenly between the halves — renormalize per column
-    un = jnp.linalg.norm(u, axis=0)
-    vn = jnp.linalg.norm(v, axis=0)
-    u = u / jnp.where(un == 0, 1.0, un)
-    v = v / jnp.where(vn == 0, 1.0, vn)
+    un = np.linalg.norm(u, axis=0)
+    vn = np.linalg.norm(v, axis=0)
+    u = u / np.where(un == 0, 1.0, un)
+    v = v / np.where(vn == 0, 1.0, vn)
+    # rank deficiency: σ≈0 columns are not orthonormal (the ±0 space
+    # mixes halves arbitrarily); rebuild them as an orthonormal
+    # completion inside the first k coordinates — same treatment and
+    # rationale as bdsqr's logical_k completion below
+    tol = (sig[0] if k else 0.0) * 8 * s2 * _BD_EPS
+    g = int((sig > tol).sum())
+    if g < k:
+        basis = np.eye(npad, dtype=u.dtype)[:, :k]
+        for mat in (u, v):
+            qc, _ = np.linalg.qr(
+                np.concatenate([mat[:, :g], basis], axis=1))
+            mat[:, g:k] = qc[:, g:k]
+    u = jnp.asarray(u, C.dtype)
+    v = jnp.asarray(v, C.dtype)
     u_pad = jnp.zeros((mpad, k), C.dtype).at[:npad].set(u)
     Uf = _apply_u(u_refl, u_pad, nbw, trans=False)
     Vf = _apply_v(v_refl, v, nbw, trans=False)
@@ -492,12 +507,13 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
         ) -> Tuple[Array, Optional[TiledMatrix], Optional[TiledMatrix]]:
     """Singular value decomposition (slate::svd, src/svd.cc).
 
-    MethodSVD dispatch: DC (and Auto at n ≥ _DC_MIN_N, real dtypes) =
-    ge2bd device bidiagonalization + Golub-Kahan/stedc divide & conquer;
-    otherwise ge2tb band reduction + one-device band SVD (small-n/
-    complex fallback). Tall (m ≥ 2n) inputs take a pre-QR shortcut and
-    wide inputs go through the transpose, like the reference
-    (svd.cc:214-232).
+    MethodSVD dispatch (all dtypes — complex reduces to a REAL
+    bidiagonal/band): DC (and Auto at n ≥ _DC_MIN_N) = ge2bd device
+    bidiagonalization + Golub-Kahan/stedc divide & conquer; otherwise
+    the ge2tb band path — finished by the GK band embedding + hb2td
+    chase at npad ≥ _BAND_DC_MIN, or a one-device dense band SVD below
+    that. Tall (m ≥ 2n) inputs take a pre-QR shortcut and wide inputs
+    go through the transpose, like the reference (svd.cc:214-232).
 
     Returns (Sigma descending, U or None, V or None) with A = U·Σ·Vᴴ
     (thin U (m×k), V (n×k), k = min(m, n))."""
@@ -542,14 +558,13 @@ def svd(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
     band, u_refl, v_refl = ge2tb(A, opts)
     mpad, npad = band.shape
     k = min(m, n)
-    bsq = band[:npad, :npad]
     if npad >= _BAND_DC_MIN and npad >= 3 * nb:
-        # band endgame on O(n·nb) data: Golub-Kahan-embed the BAND and
-        # chase it with hb2td + stedc (the tb2bd+bdsqr pipeline,
-        # src/tb2bd.cc + src/bdsqr.cc, through the heev stage-2
-        # machinery) — no dense svd of the full padded square
-        return _svd_band_gk(A, band, u_refl, v_refl, k, want_vectors,
-                            opts)
+        # band endgame: Golub-Kahan-embed the BAND and chase it with
+        # hb2td + stedc (the tb2bd+bdsqr pipeline, src/tb2bd.cc +
+        # src/bdsqr.cc, through the heev stage-2 machinery) — no dense
+        # svd of the full padded square
+        return _svd_band_gk(A, band, u_refl, v_refl, k, want_vectors)
+    bsq = band[:npad, :npad]
     # small-n fallback: one-device dense SVD of the band. Padding rows/
     # cols are exactly zero, so the (npad - k) padding singular values
     # are exactly 0 and sort last in the descending spectrum.

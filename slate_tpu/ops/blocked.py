@@ -269,18 +269,20 @@ def herk_lower_rec(c: Array, a: Array, b: Optional[Array] = None,
     full gemm), which is where the reference's internal::herk wins too
     (src/internal/internal_herk.cc).
 
-    On a single-device TPU backend the pure herk case (b is a, real
-    dtype, block-divisible shapes) routes to the Pallas tile-triangle
-    kernel instead (ops/pallas_ops.herk_lower_update): same triangle
-    saving, but tiles are written in place (input/output aliasing) so
-    the recursion's concatenate copies — pure HBM traffic — disappear.
-    Multi-device grids keep the jnp recursion (GSPMD cannot partition a
-    pallas_call, and rebalance() constraints live here)."""
+    The Pallas tile-triangle kernel (ops/pallas_ops.herk_lower_update)
+    is an OPT-IN alternative for the pure-herk case
+    (SLATE_TPU_PALLAS_HERK=1, single device, divisible shapes): round-3
+    A/B measurement showed it HBM-bound on tile re-reads and no faster
+    than this recursion end-to-end (PERF.md), so the jnp path is the
+    default. Multi-device grids always use the recursion (GSPMD cannot
+    partition a pallas_call, and rebalance() constraints live here)."""
     if b is None:
         from . import pallas_ops
         blk = pallas_ops.default_block(a.shape[1])
         if _GRID_CTX.get() is None and pallas_ops.herk_eligible(
                 c.shape[0], a.shape[1], c.dtype, blk):
+            # kernel runs HIGHEST regardless of prec (see pallas_ops —
+            # it is HBM-bound, so the pass count doesn't matter)
             return pallas_ops.herk_lower_update(c, a, blk)
         b = a
     s = c.shape[0]
@@ -383,6 +385,39 @@ def chol_tile_blocked(a: Array, ib: int = 64) -> Array:
 # blocked panel LU (partial pivot)
 # ---------------------------------------------------------------------------
 
+def _panel_getrf_base_unrolled(a: Array) -> Tuple[Array, Array, Array]:
+    """Straight-line (unrolled) right-looking LU of an (H × ib) panel —
+    the _chol_unrolled treatment for the pivoted panel: no loop
+    construct, so XLA fuses the per-column pivot/swap/eliminate
+    recurrence instead of paying while-loop latency per column.
+    Same contract as _panel_getrf_base."""
+    hh, w = a.shape
+    rows = jnp.arange(hh)
+    cols = jnp.arange(w)
+    perm = jnp.arange(hh, dtype=jnp.int32)
+    info = jnp.zeros((), jnp.int32)
+    for j in range(w):
+        col = a[:, j]
+        score = jnp.where(rows >= j, jnp.abs(col), -1.0)
+        p = jnp.argmax(score).astype(jnp.int32)
+        row_j = a[j, :]
+        row_p = a[p, :]
+        a = a.at[j, :].set(row_p).at[p, :].set(row_j)
+        pj, pp = perm[j], perm[p]
+        perm = perm.at[j].set(pp).at[p].set(pj)
+        d = a[j, j]
+        bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
+        info = jnp.where((info == 0) & bad, j + 1, info)
+        dsafe = jnp.where(bad, jnp.ones((), a.dtype), d)
+        col2 = a[:, j]
+        lcol = jnp.where(rows > j, col2 / dsafe, col2)
+        a = a.at[:, j].set(lcol)
+        urow = jnp.where(cols > j, a[j, :], 0)
+        lmask = jnp.where(rows > j, lcol, 0)
+        a = a - jnp.outer(lmask, urow)
+    return a, perm, info
+
+
 def _panel_getrf_base(a: Array) -> Tuple[Array, Array, Array]:
     """Right-looking fori_loop LU on an (H × ib) panel.
 
@@ -454,6 +489,10 @@ def panel_getrf(a: Array, ib: int = PANEL_IB,
     Returns (lu, perm, info) with gather semantics a[perm] = L·U."""
     hh, w = a.shape
     if w <= ib:
+        # unrolled base when the straight-line HLO stays small (the
+        # fori variant for very tall panels keeps compile size bounded)
+        if hh * w <= 1 << 22:
+            return _panel_getrf_base_unrolled(a)
         return _panel_getrf_base(a)
     h = _round_to(w // 2, ib)
     if h >= w:

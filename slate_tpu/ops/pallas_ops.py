@@ -8,15 +8,23 @@ lower-triangle tiles (device_regions_build). XLA has no triangular
 matmul, so a plain jnp herk computes the FULL product and masks — 2× the
 FLOPs of the update that dominates potrf/hetrf/he2hb.
 
-``herk_lower_update`` restores the saving: a scalar-prefetch Pallas grid
-enumerates only the nt·(nt+1)/2 lower tile pairs (i ≥ j) and computes
-C[i,j] −= A[i]·A[j]ᴴ per block on the MXU at full f32 precision;
-untouched (upper) blocks alias through from the input. Call site:
-ops/blocked.herk_lower_rec routes its top-level herk case (b is A, real
-dtype, single-device) here when ``herk_eligible`` passes — i.e. the
-trailing updates of potrf/posv on a TPU backend; everything else takes
-the jnp recursion. ``SLATE_TPU_NO_PALLAS_HERK=1`` disables the route
-(used for A/B measurement; see PERF.md).
+``herk_lower_update`` restores the saving in FLOPs: a scalar-prefetch
+Pallas grid enumerates only the nt·(nt+1)/2 lower tile pairs (i ≥ j)
+and computes C[i,j] −= A[i]·A[j]ᴴ per block on the MXU at full f32
+precision; untouched (upper) blocks alias through from the input.
+
+MEASURED OUTCOME (round 3, one v5e chip): the kernel is HBM-bound on
+A-tile re-reads (each row tile is re-read once per pair), so the 2×
+flop saving does not become a time saving — potrf(8192, nb=1024) runs
+55.1 ms/iter with the kernel vs 53.8 ms/iter with the jnp recursion
+(whose full gemm XLA blocks properly), and the kernel's own rate is
+identical at "high"-equivalent and HIGHEST precision (11.2 ms per
+8192×1024 update either way). The route is therefore OPT-IN:
+``SLATE_TPU_PALLAS_HERK=1`` enables it at the call site in
+ops/blocked.herk_lower_rec; the default is the jnp recursion. The
+kernel stays as the seed for the real fix — a k-resident accumulation
+grid (iterate pairs per k-chunk so A streams once) — and for
+interpret-mode coverage of the pairs/aliasing machinery.
 """
 
 from __future__ import annotations
@@ -62,8 +70,8 @@ def default_block(k: int) -> int:
 def herk_eligible(n: int, k: int, dtype, block: int) -> bool:
     """Can the Pallas path run? TPU backend, real f32/bf16, divisible
     shapes, at least 2 tile rows (otherwise there is nothing to save)."""
-    if os.environ.get("SLATE_TPU_NO_PALLAS_HERK"):
-        return False
+    if os.environ.get("SLATE_TPU_PALLAS_HERK") != "1":
+        return False  # opt-in: measured no win over the jnp recursion
     try:
         backend = jax.default_backend()
     except Exception:
@@ -82,10 +90,18 @@ def _herk_lower_call(c, a, ii, jj, block: int, interpret: bool = False):
     n = c.shape[0]
     k = a.shape[1]
     npairs = ii.shape[0]
+    dims = (((1,), (1,)), ((), ()))
+
+    # Precision note: the kernel always runs HIGHEST. Mosaic rejects
+    # Precision.HIGH outright and a hand-rolled bf16x3 (hi/lo split + 3
+    # native bf16 passes) hits 'Bad lhs type' on some potrf shapes;
+    # measurement made the choice moot anyway — at (n=8192, k=1024) the
+    # kernel times are IDENTICAL at "high"-equivalent and HIGHEST
+    # (11.2 ms both): it is HBM-bound on tile re-reads, not MXU-bound.
 
     def kernel(ii_ref, jj_ref, ai_ref, aj_ref, cin_ref, out_ref):
         prod = jax.lax.dot_general(
-            ai_ref[:], aj_ref[:], (((1,), (1,)), ((), ())),
+            ai_ref[:], aj_ref[:], dims,
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
         out_ref[:] = cin_ref[:] - prod.astype(out_ref.dtype)
@@ -114,7 +130,9 @@ def herk_lower_update(c: jax.Array, a: jax.Array,
                       block: int = None, *,
                       interpret: bool = False,
                       force: bool = False) -> jax.Array:
-    """C ← C − A·Aᵀ on the lower tile triangle only (real dtypes).
+    """C ← C − A·Aᵀ on the lower tile triangle only (real dtypes),
+    always at HIGHEST (bf16x6) product precision — see the note in
+    _herk_lower_call.
 
     Strictly-upper blocks of C pass through unchanged; entries above the
     diagonal *within* diagonal blocks ARE updated (harmless for callers

@@ -122,6 +122,28 @@ def test_svd_band_gk_endgame(cplx, monkeypatch):
                                atol=1e-11 * sref[0])
 
 
+def test_svd_band_gk_rank_deficient(monkeypatch):
+    """σ≈0 columns on the band-GK path must be completed orthonormally
+    (same contract as bdsqr's logical_k completion)."""
+    import slate_tpu.linalg as L
+    monkeypatch.setattr(L.svd_module, "_BAND_DC_MIN", 64)
+
+    rng = np.random.default_rng(23)
+    n, nb, r = 96, 8, 60  # rank 60 of 96
+    b0 = rng.standard_normal((n, r))
+    a = b0 @ rng.standard_normal((r, n))
+    A = st.from_dense(a, nb=nb)
+    s, U, V = st.svd(A, want_vectors=True)
+    s = np.asarray(s)
+    assert (s >= 0).all()
+    assert (s[r:] < 1e-10 * s[0]).all()
+    u, v = U.to_numpy(), V.to_numpy()
+    assert np.abs(u.conj().T @ u - np.eye(n)).max() < 1e-10 * n
+    assert np.abs(v.conj().T @ v - np.eye(n)).max() < 1e-10 * n
+    rec = u @ np.diag(s) @ v.conj().T
+    assert np.abs(rec - a).max() < 1e-10 * s[0] * n
+
+
 def test_he2hb_preserves_spectrum():
     n, nb = 40, 8
     a = _herm(n, seed=3)

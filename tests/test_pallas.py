@@ -52,10 +52,11 @@ def test_herk_lower_update_fallback_matches():
 
 def test_herk_eligibility_gates(monkeypatch):
     f32 = jnp.float32
-    # the env kill switch must gate the route on ANY backend
-    monkeypatch.setenv("SLATE_TPU_NO_PALLAS_HERK", "1")
+    # opt-in route (round 3: measured no win, default off) — without the
+    # env enable the route must be off on ANY backend
+    monkeypatch.delenv("SLATE_TPU_PALLAS_HERK", raising=False)
     assert not pallas_ops.herk_eligible(512, 256, f32, 128)
-    monkeypatch.delenv("SLATE_TPU_NO_PALLAS_HERK")
+    monkeypatch.setenv("SLATE_TPU_PALLAS_HERK", "1")
     # shape gates are backend-independent: indivisible n/k never eligible
     assert not pallas_ops.herk_eligible(500, 256, f32, 128)
     assert not pallas_ops.herk_eligible(512, 100, f32, 128)
