@@ -364,28 +364,32 @@ def _svd_band_gk(A: TiledMatrix, band: Array, u_refl, v_refl, k: int,
     zsel = jnp.asarray(z[:, jnp.asarray(order)], C.dtype)
     zt = jnp.zeros((spad, k), C.dtype).at[:s2].set(zsel)
     zb = _unmtr_hb2td(Vh, Th, zt, phase)[:s2]
-    v = np.asarray(zb[0::2, :]) * np.sqrt(2.0)
-    u = np.asarray(zb[1::2, :]) * np.sqrt(2.0)
+    v = zb[0::2, :] * jnp.asarray(np.sqrt(2.0), rdt)
+    u = zb[1::2, :] * jnp.asarray(np.sqrt(2.0), rdt)
     # tiny/zero σ: the ±σ pair is near-degenerate and the vector may
     # split unevenly between the halves — renormalize per column
-    un = np.linalg.norm(u, axis=0)
-    vn = np.linalg.norm(v, axis=0)
-    u = u / np.where(un == 0, 1.0, un)
-    v = v / np.where(vn == 0, 1.0, vn)
+    un = jnp.linalg.norm(u, axis=0)
+    vn = jnp.linalg.norm(v, axis=0)
+    u = u / jnp.where(un == 0, 1.0, un)
+    v = v / jnp.where(vn == 0, 1.0, vn)
     # rank deficiency: σ≈0 columns are not orthonormal (the ±0 space
     # mixes halves arbitrarily); rebuild them as an orthonormal
     # completion inside the first k coordinates — same treatment and
-    # rationale as bdsqr's logical_k completion below
+    # rationale as bdsqr's logical_k completion below. ``g`` comes from
+    # the host-side sig, so the full-rank common case never leaves the
+    # device.
     tol = (sig[0] if k else 0.0) * 8 * s2 * _BD_EPS
     g = int((sig > tol).sum())
     if g < k:
-        basis = np.eye(npad, dtype=u.dtype)[:, :k]
-        for mat in (u, v):
+        uh = np.array(np.asarray(u))
+        vh = np.array(np.asarray(v))
+        basis = np.eye(npad, dtype=uh.dtype)[:, :k]
+        for mat in (uh, vh):
             qc, _ = np.linalg.qr(
                 np.concatenate([mat[:, :g], basis], axis=1))
             mat[:, g:k] = qc[:, g:k]
-    u = jnp.asarray(u, C.dtype)
-    v = jnp.asarray(v, C.dtype)
+        u = jnp.asarray(uh, C.dtype)
+        v = jnp.asarray(vh, C.dtype)
     u_pad = jnp.zeros((mpad, k), C.dtype).at[:npad].set(u)
     Uf = _apply_u(u_refl, u_pad, nbw, trans=False)
     Vf = _apply_v(v_refl, v, nbw, trans=False)

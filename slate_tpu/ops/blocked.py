@@ -385,39 +385,6 @@ def chol_tile_blocked(a: Array, ib: int = 64) -> Array:
 # blocked panel LU (partial pivot)
 # ---------------------------------------------------------------------------
 
-def _panel_getrf_base_unrolled(a: Array) -> Tuple[Array, Array, Array]:
-    """Straight-line (unrolled) right-looking LU of an (H × ib) panel —
-    the _chol_unrolled treatment for the pivoted panel: no loop
-    construct, so XLA fuses the per-column pivot/swap/eliminate
-    recurrence instead of paying while-loop latency per column.
-    Same contract as _panel_getrf_base."""
-    hh, w = a.shape
-    rows = jnp.arange(hh)
-    cols = jnp.arange(w)
-    perm = jnp.arange(hh, dtype=jnp.int32)
-    info = jnp.zeros((), jnp.int32)
-    for j in range(w):
-        col = a[:, j]
-        score = jnp.where(rows >= j, jnp.abs(col), -1.0)
-        p = jnp.argmax(score).astype(jnp.int32)
-        row_j = a[j, :]
-        row_p = a[p, :]
-        a = a.at[j, :].set(row_p).at[p, :].set(row_j)
-        pj, pp = perm[j], perm[p]
-        perm = perm.at[j].set(pp).at[p].set(pj)
-        d = a[j, j]
-        bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
-        info = jnp.where((info == 0) & bad, j + 1, info)
-        dsafe = jnp.where(bad, jnp.ones((), a.dtype), d)
-        col2 = a[:, j]
-        lcol = jnp.where(rows > j, col2 / dsafe, col2)
-        a = a.at[:, j].set(lcol)
-        urow = jnp.where(cols > j, a[j, :], 0)
-        lmask = jnp.where(rows > j, lcol, 0)
-        a = a - jnp.outer(lmask, urow)
-    return a, perm, info
-
-
 def _panel_getrf_base(a: Array) -> Tuple[Array, Array, Array]:
     """Right-looking fori_loop LU on an (H × ib) panel.
 
@@ -489,10 +456,11 @@ def panel_getrf(a: Array, ib: int = PANEL_IB,
     Returns (lu, perm, info) with gather semantics a[perm] = L·U."""
     hh, w = a.shape
     if w <= ib:
-        # unrolled base when the straight-line HLO stays small (the
-        # fori variant for very tall panels keeps compile size bounded)
-        if hh * w <= 1 << 22:
-            return _panel_getrf_base_unrolled(a)
+        # NOTE: a straight-line unrolled base (the _chol_unrolled
+        # treatment) was tried in round 3: no measurable win over the
+        # fori base on chip, and its HLO OOM-killed the compiler at
+        # n=16384 panel heights — the pivot search's argmax/swap chain
+        # doesn't fuse the way the Cholesky recurrence does.
         return _panel_getrf_base(a)
     h = _round_to(w // 2, ib)
     if h >= w:

@@ -563,6 +563,52 @@ def _t_heev(ctx):
     return secs, err
 
 
+@register("heev_2stage", flops=lambda m, n: 9 * n ** 3)
+def _t_heev_2stage(ctx):
+    """Two-stage stage-1 (he2hb + hb2td bulge chase, round 3)."""
+    import slate_tpu as st
+    from slate_tpu.core.types import MethodEig, Options
+    n = ctx.n
+    a = ctx.gen("heev_arith", n, n, cond=100.0)
+    A = ctx.herm(a)
+    # heev itself falls back to he2td when n < 3·nb (the hb2td window
+    # requirement) — no tester-side guard needed
+    opts = Options(method_eig=MethodEig.DC, eig_stage1="two_stage")
+    (w, Z), secs = ctx.timed(lambda: st.heev(A, opts))
+    z = _np64(Z.to_numpy())
+    wn = _np64(w)
+    an = _np64(a)
+    res = _rel(np.abs(an @ z - z * wn[None, :]).max(),
+               ctx.eps * n * max(np.abs(wn).max(), 1e-300))
+    orth = _rel(np.abs(z.conj().T @ z - np.eye(n)).max(), ctx.eps * n)
+    return secs, max(res, orth)
+
+
+@register("hb2td")  # no flops model: the chase's 4·n²·nb depends on nb,
+                    # which the registry lambda cannot see — time-only row
+def _t_hb2td(ctx):
+    """Band→tridiag bulge chase invariants (eigenvalues preserved)."""
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("heev_arith", n, n, cond=100.0)
+    if n < 3 * ctx.nb:
+        # hb2td needs a 3-bandwidth window; re-tile small test sizes
+        A = ctx.herm(a)
+        A = st.hermitian(np.tril(_np64(a)), nb=max(8, n // 8),
+                         uplo=A.uplo)
+    else:
+        A = ctx.herm(a)
+    band, refl = st.he2hb(A)
+    (out, secs) = ctx.timed(lambda: st.hb2td(band))
+    d, e = _np64(out[0]), _np64(out[1])
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    bf = _np64(band.full_dense_canonical())
+    err = _rel(np.abs(np.sort(np.linalg.eigvalsh(t))
+                      - np.sort(np.linalg.eigvalsh(bf))).max(),
+               ctx.eps * n * max(np.abs(bf).max(), 1e-300))
+    return secs, err
+
+
 @register("heev_vec", flops=lambda m, n: 9 * n ** 3)
 def _t_heev_vec(ctx):
     import slate_tpu as st
