@@ -21,6 +21,7 @@ stdout.
 """
 
 import json
+import os
 import sys
 import time
 from functools import partial
@@ -29,6 +30,26 @@ import jax
 import jax.numpy as jnp
 
 BASELINE_GFLOPS_PER_CHIP = 700.0  # reference SLATE dgemm per-GPU (docs/usage.md)
+
+
+def _probe_platform(timeout=90):
+    """Probe default-backend health in a subprocess with a hard timeout.
+
+    With the TPU tunnel down, jax.devices() hangs *uninterruptibly*
+    in-process at backend init (VERDICT r3 weak #1), so the probe must
+    run where it can be killed. Returns the platform string ('tpu',
+    'cpu', ...) or None if init failed or timed out."""
+    import subprocess
+
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except Exception:
+        pass
+    return None
 
 
 def _timed_scalar(fn, *args):
@@ -160,6 +181,33 @@ def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32):
 
 
 def main():
+    cpu_fallback = bool(os.environ.get("_SLATE_TPU_BENCH_CPU"))
+    if cpu_fallback:
+        # undo the sitecustomize's platform override before any backend
+        # initializes (shared workaround, see compat/platform.py)
+        from slate_tpu.compat.platform import apply_env_platforms
+
+        apply_env_platforms("cpu")
+    elif os.environ.get("_SLATE_TPU_BENCH_NO_PROBE") != "1":
+        plat = _probe_platform()
+        if plat is None:
+            # default backend is dead (tunnel down): fall back to a
+            # small CPU run so the driver still records a parseable
+            # measurement instead of a hang/traceback (VERDICT r3 #1c)
+            import subprocess
+
+            print("# default backend init failed/timed out; "
+                  "re-running on CPU fallback", file=sys.stderr)
+            env = dict(os.environ)
+            env["_SLATE_TPU_BENCH_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "1024"],
+                env=env)
+            sys.exit(r.returncode)
+        print(f"# default backend healthy: platform={plat}",
+              file=sys.stderr)
+
     # default raised 8192 → 16384 in round 3: the serial panel floor
     # amortizes with n (VERDICT r2 #3 asks for BASELINE-scale numbers);
     # 16384 is the largest size where gemm's 4 live operands fit the
@@ -197,14 +245,29 @@ def main():
         except Exception as e:  # keep headline metric alive regardless
             print(f"# {name} bench skipped: {e}", file=sys.stderr)
 
-    print(json.dumps({
+    out = {
         "metric": f"gemm_gflops_per_chip_fp32_n{n}",
         "value": round(gemm_gflops, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(gemm_gflops / BASELINE_GFLOPS_PER_CHIP, 2),
         **extra,
-    }))
+    }
+    if cpu_fallback:
+        out["platform"] = "cpu-fallback"  # tunnel down at bench time
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # one parseable JSON line, never a bare traceback
+        print(json.dumps({
+            "metric": "gemm_gflops_per_chip_fp32",
+            "value": 0.0,
+            "unit": "GFLOP/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)

@@ -11,6 +11,13 @@ from __future__ import annotations
 
 import numpy as np
 
+# Honor an inherited JAX_PLATFORMS before any backend initializes: this
+# module is the first thing the embedded interpreter (native/capi.c)
+# imports, so the override lands before any jax computation runs.
+from .platform import apply_env_platforms
+
+apply_env_platforms()
+
 
 def _as_cm(buf, rows, ld, cols, dtype=np.float64):
     """View a C memoryview as a column-major (rows, cols) array slice."""
