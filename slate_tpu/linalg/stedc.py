@@ -54,6 +54,13 @@ _SMALL_N = 32          # base-case size: dense eigh of the tridiagonal
 _BISECT_ITERS = 55
 _NEWTON_ITERS = 4
 _CHUNK = 2048          # secular-solver root chunking (bounds k×k temporaries)
+# double-single (hi+lo f32) unit roundoff — the working precision of the
+# DEVICE secular solver (ops/doublefloat.py). When it is active, the
+# deflation tolerance widens from 8·eps64 to 8·eps_df so the solver is
+# never asked to resolve gaps below its own representation (the same
+# principle as LAPACK deflating at its working eps).
+_DF_EPS = 2.0 ** -48
+_SECULAR_DEVICE_MIN_K = 512  # below this the host sweep is latency-free
 
 
 def _tridiag_eigh_base(d: np.ndarray, e: np.ndarray):
@@ -161,6 +168,187 @@ def _secular_roots(delta: np.ndarray, z2: np.ndarray, rho: float
     return shift_idx, mu
 
 
+def _secular_kernel_body(dhi, dlo, z2hi, z2lo, rho_hi, rho_lo,
+                         whi, wlo, j, notlast, chunk: int):
+    """Jitted df32 secular sweep: all padded roots, chunked lax.map.
+
+    Mirrors _secular_roots stage for stage (pole choice by midpoint
+    sign, 55 bisections, bracket-safeguarded Newton, near-pole fixed
+    point) in double-single f32 (ops/doublefloat.py) — the TPU-native
+    replacement of the host numpy sweep, which PERF.md measured at
+    13.5 s of a 19 s n=4096 solve. Reference: src/stedc_secular.cc
+    (grid-parallel dlaed4 calls); here every root is one lane of a
+    vectorized VPU program instead of one LAPACK call."""
+    import jax
+    from jax import lax
+
+    from ..ops import doublefloat as df
+
+    k = dhi.shape[0]
+    nc = whi.shape[0] // chunk
+    f32 = jnp.float32
+
+    def eval_f(mh, ml, gh, gl):
+        denh, denl = df.sub(gh, gl, mh[:, None], ml[:, None])
+        zero_d = denh == 0
+        denh = jnp.where(zero_d, f32(1e-30), denh)
+        denl = jnp.where(zero_d, f32(0), denl)
+        th, tl = df.div(z2hi[None, :], z2lo[None, :], denh, denl)
+        sh, sl = df.df_sum(th, tl, axis=1)
+        fh, fl = df.mul(rho_hi, rho_lo, sh, sl)
+        fh, fl = df.add(f32(1), f32(0), fh, fl)
+        return (fh, fl), (th, tl), (denh, denl)
+
+    def one_chunk(args):
+        jc, nl, wh, wl = args
+        djh, djl = dhi[jc], dlo[jc]
+        g0h, g0l = df.sub(dhi[None, :], dlo[None, :],
+                          djh[:, None], djl[:, None])
+        m0h, m0l = df.scale(wh, wl, 0.5)
+        (f0h, _), _, _ = eval_f(m0h, m0l, g0h, g0l)
+        upper = (f0h < 0) & nl
+        sj = jnp.where(upper, jc + 1, jc)
+        gh, gl = df.sub(dhi[None, :], dlo[None, :],
+                        dhi[sj][:, None], dlo[sj][:, None])
+        halfh, halfl = df.scale(wh, wl, 0.5)
+        zero = jnp.zeros_like(wh)
+        loh, lol = df.df_where(upper, -halfh, -halfl, zero, zero)
+        inh, inl = df.df_where(nl, halfh, halfl, wh, wl)
+        hih, hil = df.df_where(upper, zero, zero, inh, inl)
+
+        def bis(_, c):
+            loh, lol, hih, hil = c
+            mh, ml = df.scale(*df.add(loh, lol, hih, hil), 0.5)
+            (fh, _), _, _ = eval_f(mh, ml, gh, gl)
+            up = fh < 0
+            loh, lol = df.df_where(up, mh, ml, loh, lol)
+            hih, hil = df.df_where(up, hih, hil, mh, ml)
+            return (loh, lol, hih, hil)
+
+        loh, lol, hih, hil = lax.fori_loop(
+            0, _BISECT_ITERS, bis, (loh, lol, hih, hil))
+        mh, ml = df.scale(*df.add(loh, lol, hih, hil), 0.5)
+
+        def newton(_, c):
+            mh, ml, loh, lol, hih, hil = c
+            (fh, fl), (th, tl), (denh, denl) = eval_f(mh, ml, gh, gl)
+            t2h, t2l = df.div(th, tl, denh, denl)
+            s2h, s2l = df.df_sum(t2h, t2l, axis=1)
+            fph, fpl = df.mul(rho_hi, rho_lo, s2h, s2l)
+            up = fh < 0
+            loh, lol = df.df_where(up, mh, ml, loh, lol)
+            hih, hil = df.df_where(up, hih, hil, mh, ml)
+            good = fph > 0
+            sth, stl = df.div(fh, fl, jnp.where(good, fph, f32(1)),
+                              jnp.where(good, fpl, f32(0)))
+            sth = jnp.where(good, sth, f32(0))
+            stl = jnp.where(good, stl, f32(0))
+            nh, nlo = df.sub(mh, ml, sth, stl)
+            bad = (nh <= loh) | (nh >= hih) | ~jnp.isfinite(nh)
+            midh, midl = df.scale(*df.add(loh, lol, hih, hil), 0.5)
+            mh, ml = df.df_where(bad, midh, midl, nh, nlo)
+            return (mh, ml, loh, lol, hih, hil)
+
+        mh, ml, loh, lol, hih, hil = lax.fori_loop(
+            0, _NEWTON_ITERS, newton, (mh, ml, loh, lol, hih, hil))
+
+        # near-pole rational fixed point (relative accuracy for tiny mu)
+        zph, zpl = z2hi[sj], z2lo[sj]
+        cols = jnp.arange(k)
+        colmask = cols[None, :] == sj[:, None]
+        weff = jnp.where(upper, 0.5 * wh, wh)
+        near = jnp.abs(mh) < 1e-6 * weff
+        sgn_want = jnp.where(upper, f32(-1), f32(1))
+
+        def fp_iter(_, c):
+            mh, ml = c
+            denh, denl = df.sub(gh, gl, mh[:, None], ml[:, None])
+            msk = colmask | (denh == 0)
+            denh = jnp.where(msk, f32(1e30), denh)
+            denl = jnp.where(msk, f32(0), denl)
+            th, tl = df.div(z2hi[None, :], z2lo[None, :], denh, denl)
+            sh, sl = df.df_sum(th, tl, axis=1)
+            rsh, rsl = df.add(f32(1), f32(0),
+                              *df.mul(rho_hi, rho_lo, sh, sl))
+            rz = rsh == 0
+            rsh_s = jnp.where(rz, f32(1e-30), rsh)
+            rsl_s = jnp.where(rz, f32(0), rsl)
+            ch, cl = df.div(*df.mul(rho_hi, rho_lo, zph, zpl),
+                            rsh_s, rsl_s)
+            ok = (jnp.isfinite(ch) & ~rz & (jnp.sign(ch) == sgn_want)
+                  & (jnp.abs(ch) < 1e-5 * weff))
+            return df.df_where(near & ok, ch, cl, mh, ml)
+
+        mh, ml = lax.fori_loop(0, 2, fp_iter, (mh, ml))
+        return upper, mh, ml
+
+    jr = j.reshape(nc, chunk)
+    nlr = notlast.reshape(nc, chunk)
+    whr = whi.reshape(nc, chunk)
+    wlr = wlo.reshape(nc, chunk)
+    upper, mh, ml = lax.map(one_chunk, (jr, nlr, whr, wlr))
+    return upper.reshape(-1), mh.reshape(-1), ml.reshape(-1)
+
+
+if _HAVE_JAX:
+    _secular_kernel = functools.partial(jax.jit, static_argnames=("chunk",))(
+        _secular_kernel_body)
+
+
+def _secular_roots_device(delta: np.ndarray, z2: np.ndarray, rho: float
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Device df32 drop-in for _secular_roots (same contract).
+
+    Pole and root axes are padded to the next power of two so the jitted
+    kernel compiles once per size bucket (k varies per merge with
+    data-dependent deflation; unpadded shapes would recompile every
+    merge). Padded poles carry delta=1e30, z2=0 — exact zeros in every
+    sum; padded roots clamp to j=k−1 and are sliced off on the host.
+
+    The problem is scaled by s = max(|delta|, rho) before the f32 split
+    (the secular equation is scale-invariant: delta/s, rho/s give roots
+    mu/s), so f64-range inputs never overflow or denormalize the f32
+    hi/lo pair."""
+    from ..ops import doublefloat as df
+
+    k = delta.size
+    s = float(max(np.abs(delta).max(initial=0.0), rho, 1e-300))
+    delta = delta / s
+    rho = rho / s
+    kp = 1 << max(6, (k - 1).bit_length())  # bucketed padded size
+    chunk = min(2048, kp)
+
+    dpad = np.full(kp, 1e30)
+    dpad[:k] = delta
+    z2pad = np.zeros(kp)
+    z2pad[:k] = z2
+
+    znorm2 = float(z2.sum())
+    width = np.ones(kp)
+    width[:k - 1] = delta[1:] - delta[:-1]
+    width[k - 1] = rho * znorm2
+
+    j = np.minimum(np.arange(kp), k - 1).astype(np.int32)
+    notlast = j < (k - 1)
+
+    dhi, dlo = df.from_f64(dpad)
+    z2hi, z2lo = df.from_f64(z2pad)
+    whi, wlo = df.from_f64(width)
+    rhi = np.float32(rho)
+    rlo = np.float32(rho - float(rhi))
+
+    upper, mh, ml = _secular_kernel(
+        jnp.asarray(dhi), jnp.asarray(dlo), jnp.asarray(z2hi),
+        jnp.asarray(z2lo), float(rhi), float(rlo), jnp.asarray(whi),
+        jnp.asarray(wlo), jnp.asarray(j), jnp.asarray(notlast),
+        chunk=chunk)
+    upper = np.asarray(upper)[:k]
+    mu = df.to_f64(mh, ml)[:k] * s
+    idx = np.arange(k)
+    shift_idx = np.where(upper, idx + 1, idx)
+    return shift_idx, mu
+
+
 def _revised_z(delta: np.ndarray, shift: np.ndarray, mu: np.ndarray,
                rho: float) -> np.ndarray:
     """Gu/Eisenstat ẑ: |ẑ_i|² = ∏_j(λ_j − δ_i) / (rho·∏_{j≠i}(δ_j − δ_i)),
@@ -199,10 +387,15 @@ class _DeviceCtx:
     boundary rows that form z) plus one O(k²) transform up, instead of
     shipping the O(k²) basis both ways."""
 
-    def __init__(self, dtype, grid=None, min_k: int = 256):
+    def __init__(self, dtype, grid=None, min_k: int = 256,
+                 secular_device: bool = False):
         self.dtype = dtype
         self.grid = grid
         self.min_k = min_k
+        # run the secular sweep on-device in df32 (see _secular_kernel):
+        # on when the basis itself is f32 (accelerator / x64-off), where
+        # df32's ~1e-14 sits far below the f32 basis noise floor
+        self.secular_device = secular_device
 
     def upload(self, q_host):
         # no explicit sharding here: subtree sizes are rarely divisible
@@ -355,7 +548,11 @@ def _merge(w1, q1, w2, q2, rho_signed, matmul, vals_only=False,
         rho = rho * nrm * nrm
 
     n = dd.size
-    tol = 8.0 * _EPS * max(np.abs(dd).max(initial=0.0), rho)
+    # deflate at the working eps of the secular solver that will run:
+    # df32's 2⁻⁴⁸ when the device sweep is active, f64's eps otherwise
+    eps_eff = _DF_EPS if (device_ctx is not None
+                          and device_ctx.secular_device) else _EPS
+    tol = 8.0 * eps_eff * max(np.abs(dd).max(initial=0.0), rho)
 
     # --- deflation 1: rotate near-equal eigenvalue pairs so one z
     # component vanishes (dlaed2); rotations touch basis columns only.
@@ -390,7 +587,11 @@ def _merge(w1, q1, w2, q2, rho_signed, matmul, vals_only=False,
     zu = z[und]
     z2 = zu * zu
 
-    shift, mu = _secular_roots(delta, z2, rho)
+    if (device_ctx is not None and device_ctx.secular_device
+            and k >= _SECULAR_DEVICE_MIN_K):
+        shift, mu = _secular_roots_device(delta, z2, rho)
+    else:
+        shift, mu = _secular_roots(delta, z2, rho)
     dshift = delta[shift]
     lam = dshift + mu
 
@@ -538,7 +739,11 @@ def stedc(d, e, compute_z: bool = True, use_device: Optional[bool] = None,
         default_min_k = 256 if on_cpu else 1024
         min_k = int(os.environ.get("SLATE_TPU_STEDC_MIN_K",
                                    default_min_k))
-        ctx = _DeviceCtx(dtype, grid=grid, min_k=min_k)
+        sec_env = os.environ.get("SLATE_TPU_SECULAR_DEVICE")
+        secular_device = (dtype == jnp.float32) if sec_env is None \
+            else sec_env == "1"
+        ctx = _DeviceCtx(dtype, grid=grid, min_k=min_k,
+                         secular_device=secular_device)
         w, node = _stedc_rec(d, e, _host_matmul, device_ctx=ctx)
         return w, node.q
     w, q = _stedc_rec(d, e, _host_matmul)

@@ -1,0 +1,135 @@
+"""Double-single ("df32") arithmetic: unevaluated hi+lo f32 pairs.
+
+TPU has no f64 datapath, but the divide & conquer eigensolver's secular
+equation (reference: src/stedc_secular.cc, LAPACK dlaed4) needs ~1e-14
+relative accuracy — f32 alone loses eigenvector orthogonality on
+clustered spectra. The classic fix (Dekker 1971, Knuth TAOCP 4.2.2;
+the same trick behind CUDA's float-float and JAX's x64-on-TPU work) is
+to carry each value as an unevaluated sum hi + lo of two f32, giving
+an effective ~48-bit mantissa (unit roundoff ≈ 2⁻⁴⁸ ≈ 3.6e-15) at
+5–20 VPU flops per op — all vectorizable, no data-dependent control
+flow, so the whole secular sweep runs as one fused XLA program.
+
+All functions take and return (hi, lo) pairs of equal-shape f32 arrays
+and broadcast like jnp. No FMA is exposed by jnp, so two_prod uses
+Dekker splitting (exact for IEEE round-to-nearest f32, which XLA's
+elementwise VPU ops honor on both CPU and TPU backends).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Dekker split constant for f32: 2^12 + 1 (24-bit mantissa → 12+12).
+_SPLIT = 4097.0
+
+
+def two_sum(a, b):
+    """Exact sum: s + e == a + b with s = fl(a+b)."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def quick_two_sum(a, b):
+    """Exact sum assuming |a| >= |b| (renormalization step)."""
+    s = a + b
+    e = b - (s - a)
+    return s, e
+
+
+def _split(a):
+    t = a * _SPLIT
+    hi = t - (t - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """Exact product: p + e == a*b with p = fl(a*b) (Dekker, no FMA)."""
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def add(ahi, alo, bhi, blo):
+    s, e = two_sum(ahi, bhi)
+    e = e + (alo + blo)
+    return quick_two_sum(s, e)
+
+
+def sub(ahi, alo, bhi, blo):
+    return add(ahi, alo, -bhi, -blo)
+
+
+def mul(ahi, alo, bhi, blo):
+    p, e = two_prod(ahi, bhi)
+    e = e + (ahi * blo + alo * bhi)
+    return quick_two_sum(p, e)
+
+
+def div(ahi, alo, bhi, blo):
+    """Quotient accurate to ~2 ulp of double-single (one refinement)."""
+    q1 = ahi / bhi
+    # r = a − q1·b, exactly in df
+    p, e = two_prod(q1, bhi)
+    rhi, rlo = add(ahi, alo, -p, -(e + q1 * blo))
+    q2 = (rhi + rlo) / bhi
+    return quick_two_sum(q1, q2)
+
+
+def scale(ahi, alo, s):
+    """Multiply by an exact power of two (error-free)."""
+    return ahi * s, alo * s
+
+
+def neg(ahi, alo):
+    return -ahi, -alo
+
+
+def df_where(c, ahi, alo, bhi, blo):
+    return jnp.where(c, ahi, bhi), jnp.where(c, alo, blo)
+
+
+def df_sum(hi, lo, axis: int):
+    """Accurate reduction along ``axis`` by a pairwise two_sum tree —
+    error grows like log2(n)·2⁻⁴⁸·max|term| instead of n·2⁻²⁴ for a
+    plain f32 sum. The axis length is padded to a power of two with
+    zeros (exact)."""
+    n = hi.shape[axis]
+    p2 = 1
+    while p2 < n:
+        p2 *= 2
+    if p2 != n:
+        pad = [(0, 0)] * hi.ndim
+        pad[axis] = (0, p2 - n)
+        hi = jnp.pad(hi, pad)
+        lo = jnp.pad(lo, pad)
+    ax = axis % hi.ndim
+    while hi.shape[ax] > 1:
+        m = hi.shape[ax] // 2
+        h1 = jnp.take(hi, jnp.arange(m), axis=ax)
+        h2 = jnp.take(hi, jnp.arange(m, 2 * m), axis=ax)
+        l1 = jnp.take(lo, jnp.arange(m), axis=ax)
+        l2 = jnp.take(lo, jnp.arange(m, 2 * m), axis=ax)
+        hi, lo = add(h1, l1, h2, l2)
+    return jnp.squeeze(hi, ax), jnp.squeeze(lo, ax)
+
+
+def from_f64(x):
+    """Split a float64 host array into an (hi, lo) f32 pair."""
+    import numpy as np
+
+    x = np.asarray(x, np.float64)
+    hi = x.astype(np.float32)
+    lo = (x - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def to_f64(hi, lo):
+    """Recombine a device (hi, lo) pair into a float64 numpy array."""
+    import numpy as np
+
+    return np.asarray(hi, np.float64) + np.asarray(lo, np.float64)

@@ -335,3 +335,85 @@ def test_hegv_with_dc():
     x = X.to_numpy()
     res = np.abs(a @ x - (b @ x) * np.asarray(w)).max()
     assert res < n * 1e-11 * max(1.0, np.abs(np.asarray(w)).max())
+
+
+# ---------------------------------------------------------------------------
+# df32 device secular stage (round 4: VERDICT r3 #3)
+# ---------------------------------------------------------------------------
+
+def test_doublefloat_primitives():
+    """two_sum/two_prod are error-free; df ops hold ~2^-48 accuracy."""
+    import jax.numpy as jnp
+    from slate_tpu.ops import doublefloat as df
+
+    rng = np.random.default_rng(3)
+    a64 = rng.standard_normal(1000)
+    b64 = rng.standard_normal(1000) * 1e-3
+    ah, al = df.from_f64(a64)
+    bh, bl = df.from_f64(b64)
+    # representation error of the split itself
+    assert np.abs(df.to_f64(ah, al) - a64).max() < 3e-15 * np.abs(a64).max()
+    for op, ref in [(df.add, a64 + b64), (df.sub, a64 - b64),
+                    (df.mul, a64 * b64), (df.div, a64 / b64)]:
+        h, l = op(jnp.asarray(ah), jnp.asarray(al),
+                  jnp.asarray(bh), jnp.asarray(bl))
+        got = df.to_f64(h, l)
+        rel = np.abs(got - ref) / np.maximum(np.abs(ref), 1e-300)
+        assert rel.max() < 1e-13, (op.__name__, rel.max())
+    # accurate tree reduction: condition the sum badly on purpose
+    x = np.concatenate([np.full(512, 1.0), np.full(512, 1e-9),
+                        np.full(512, -1.0)])
+    xh, xl = df.from_f64(x)
+    sh, sl = df.df_sum(jnp.asarray(xh)[None, :], jnp.asarray(xl)[None, :],
+                       axis=1)
+    assert abs(df.to_f64(sh, sl)[0] - x.sum()) < 1e-11
+
+
+@pytest.mark.parametrize("case", ["uniform", "clustered", "geometric"])
+def test_secular_device_matches_host(case):
+    from slate_tpu.linalg import stedc as S
+
+    rng = np.random.default_rng(11)
+    if case == "uniform":
+        delta = np.sort(rng.uniform(-1, 1, 700))
+    elif case == "clustered":
+        delta = np.sort(np.concatenate([
+            np.full(400, 0.3) + rng.uniform(0, 1e-9, 400),
+            rng.uniform(-2, 2, 400)]))
+    else:
+        delta = np.sort(np.geomspace(1e-8, 1.0, 600))
+    # post-deflation invariant: gaps exceed the df32 deflation tol
+    tol = 8 * 2.0 ** -48 * np.abs(delta).max()
+    delta = delta[np.concatenate([[True], np.diff(delta) > tol])]
+    k = delta.size
+    z = rng.standard_normal(k)
+    z /= np.linalg.norm(z)
+    z2 = z * z + 1e-300
+    rho = 0.7
+    s_h, mu_h = S._secular_roots(delta, z2, rho)
+    s_d, mu_d = S._secular_roots_device(delta, z2, rho)
+    scale = np.abs(delta).max() + rho
+    lam_h = delta[s_h] + mu_h
+    lam_d = delta[s_d] + mu_d
+    # compare reconstructed roots, not shift indices: when a root sits
+    # near an interval midpoint the f64 and df32 evaluations may pick
+    # different (both valid) shift poles
+    assert np.abs(lam_h - lam_d).max() < 5e-14 * scale
+
+
+def test_stedc_device_secular_end_to_end(monkeypatch):
+    """Forced df32 secular stage: f32-grade vectors, f64-grade values."""
+    monkeypatch.setenv("SLATE_TPU_SECULAR_DEVICE", "1")
+    monkeypatch.setenv("SLATE_TPU_STEDC_MIN_K", "128")
+    rng = np.random.default_rng(5)
+    n = 768
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1) * 0.5 + 1.0
+    w, z = stedc(d, e, use_device=True)
+    z = np.asarray(z, np.float64)
+    t = _tridiag(d, e)
+    wref = np.linalg.eigvalsh(t)
+    assert np.abs(w - wref).max() < 1e-12 * np.abs(wref).max()
+    assert np.abs(z.T @ z - np.eye(n)).max() < n * 1e-8
+    assert np.abs(t @ z - z * w).max() < n * 1e-8 * max(1.0,
+                                                        np.abs(w).max())
