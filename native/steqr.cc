@@ -1,0 +1,107 @@
+// slate-tpu native host runtime: implicit-shift QR iteration (steqr).
+//
+// TPU-native analog of the reference's distributed steqr
+// (src/steqr_impl.cc): there, every rank redundantly computes the
+// Givens rotations of each sweep and applies them to its own rows of a
+// 1D-distributed Z with lapack::lasr (steqr_impl.cc:253-262, 389-398).
+// Here the tridiagonal recurrence runs once on the host (it is a
+// scalar chain no accelerator can parallelize) and the O(n) rotations
+// per sweep are journaled, then applied to Z row-blocks in parallel by
+// OpenMP threads — the same "redundant rotations, partitioned Z"
+// design with threads standing in for ranks. Z is row-major, so one
+// rotation touches adjacent elements z[r][i], z[r][i+1]: the inner
+// loop streams each row once per sweep, cache-resident.
+//
+// The Python fallback (slate_tpu/linalg/eig.py::_steqr_py) implements
+// the identical recurrence; this version lifts the per-rotation Python
+// overhead (~µs each) to ~ns, raising the practical n from ~1k to ~8k.
+
+#include <cstdint>
+#include <cmath>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// In-place QR iteration on the symmetric tridiagonal (d[n], e[n-1]).
+// If compute_z != 0, z is a row-major (n x n) matrix (typically I) to
+// which all rotations are applied on the right (columns i, i+1).
+// Returns 0 on convergence, >0 = LAPACK-style failure (unconverged),
+// values unsorted (caller sorts).
+int64_t st_steqr(int64_t n, double* d, double* e, double* z,
+                 int64_t compute_z, int64_t max_iters) {
+    if (n <= 1) return 0;
+    double* cj = new double[n];
+    double* sj = new double[n];
+
+    int64_t iter = 0;
+    for (; iter < max_iters; ++iter) {
+        // deflate negligible off-diagonals
+        for (int64_t i = 0; i < n - 1; ++i) {
+            const double tol = 1e-16 * (std::fabs(d[i]) +
+                                        std::fabs(d[i + 1]));
+            if (std::fabs(e[i]) <= tol) e[i] = 0.0;
+        }
+        // trailing undeflated block [lo, hi]
+        int64_t hi = n - 1;
+        while (hi > 0 && e[hi - 1] == 0.0) --hi;
+        if (hi == 0) { delete[] cj; delete[] sj; return 0; }
+        int64_t lo = hi - 1;
+        while (lo > 0 && e[lo - 1] != 0.0) --lo;
+
+        // Wilkinson shift from the trailing 2x2
+        const double a11 = d[hi - 1], a22 = d[hi], ab = e[hi - 1];
+        const double delta = (a11 - a22) / 2.0;
+        const double sgn = (delta > 0.0) ? 1.0
+                           : (delta < 0.0 ? -1.0 : 1.0);
+        const double denom = delta + sgn * std::hypot(delta, ab);
+        const double mu = (denom != 0.0) ? a22 - (ab * ab) / denom
+                                         : a22 - ab;
+
+        // bulge-chasing sweep over [lo, hi], journaling rotations
+        double f = d[lo] - mu, g = e[lo];
+        for (int64_t i = lo; i < hi; ++i) {
+            double c, s, r;
+            if (g == 0.0)      { c = 1.0; s = 0.0; r = f; }
+            else if (f == 0.0) { c = 0.0; s = 1.0; r = g; }
+            else { r = std::hypot(f, g); c = f / r; s = g / r; }
+            if (i > lo) e[i - 1] = r;
+            const double m11 = d[i], m12 = e[i], m22 = d[i + 1];
+            d[i]     = c * c * m11 + 2.0 * c * s * m12 + s * s * m22;
+            d[i + 1] = s * s * m11 - 2.0 * c * s * m12 + c * c * m22;
+            e[i] = (c * c - s * s) * m12 + c * s * (m22 - m11);
+            if (i < hi - 1) {
+                const double bulge = s * e[i + 1];
+                e[i + 1] = c * e[i + 1];
+                f = e[i]; g = bulge;
+            }
+            cj[i] = c; sj[i] = s;
+        }
+
+        if (compute_z) {
+            // apply the sweep's rotations to every row of Z; rows are
+            // independent — the reference's rank-partitioned lasr
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+            for (int64_t r = 0; r < n; ++r) {
+                double* zr = z + r * n;
+                for (int64_t i = lo; i < hi; ++i) {
+                    const double c = cj[i], s = sj[i];
+                    const double zi = zr[i];
+                    zr[i]     =  c * zi + s * zr[i + 1];
+                    zr[i + 1] = -s * zi + c * zr[i + 1];
+                }
+            }
+        }
+    }
+    delete[] cj; delete[] sj;
+    // unconverged: count remaining nonzero off-diagonals (info analog)
+    int64_t left = 0;
+    for (int64_t i = 0; i < n - 1; ++i) if (e[i] != 0.0) ++left;
+    return left > 0 ? left : 0;
+}
+
+}  // extern "C"

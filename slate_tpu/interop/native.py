@@ -48,11 +48,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _TRIED = True
         if not os.path.exists(_SO) and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        for name, argtypes in [
+        symbols = [
             ("st_numroc", [_I64, _I64, _I64, _I64]),
             ("st_bc_pack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
                             _I64, _PD, _I64]),
@@ -62,10 +58,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ("st_tile_unpack", [_PD, _I64, _I64, _I64, _I64, _PD]),
             ("st_colmajor_to_rowmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
             ("st_rowmajor_to_colmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
-        ]:
-            fn = getattr(lib, name)
-            fn.argtypes = argtypes
-            fn.restype = _I64
+            ("st_steqr", [_I64, _PD, _PD, _PD, _I64, _I64]),
+        ]
+
+        def _load():
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+            for name, argtypes in symbols:
+                fn = getattr(lib, name, None)
+                if fn is None:
+                    return None  # stale build missing a symbol
+                fn.argtypes = argtypes
+                fn.restype = _I64
+            return lib
+
+        lib = _load()
+        if lib is None and _build():
+            lib = _load()
         _LIB = lib
         return _LIB
 

