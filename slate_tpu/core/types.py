@@ -159,6 +159,15 @@ class MethodGels(enum.Enum):
     CholQR = "cholqr"
 
 
+class MethodHesv(enum.Enum):
+    """Hermitian-indefinite factorization variant (the reference ships
+    pivoted Aasen, src/hetrf.cc; RBT is our no-pivot LDLᴴ trade)."""
+
+    Auto = "auto"      # = Aasen (pivoted — deterministic stability)
+    Aasen = "aasen"    # LTLᴴ with symmetric partial pivoting
+    RBT = "rbt"        # symmetric butterfly + no-pivot LDLᴴ + IR
+
+
 class MethodEig(enum.Enum):
     Auto = "auto"
     QR = "qr"  # steqr QR iteration
@@ -225,6 +234,7 @@ class Options:
     # GSPMD-inferred panel; reference Tile_getrf.hh:209-270)
     lu_dist_panel: bool = False
     method_gels: MethodGels = MethodGels.Auto
+    method_hesv: MethodHesv = MethodHesv.Auto
     method_eig: MethodEig = MethodEig.Auto
     # stage-1 reduction strategy for the DC eigensolver path:
     # "he2td" = direct blocked tridiagonalization (one stage, half the

@@ -1,17 +1,30 @@
 """Hermitian/symmetric indefinite solvers: hesv, hetrf, hetrs.
 
 Reference: src/hesv.cc, src/hetrf.cc, src/hetrs.cc — Aasen-style LTLᴴ
-factorization with a banded T (internals internal_hettmqr.cc and the
-two-stage band machinery).
+factorization with a banded T and panel pivoting (internals
+internal_hettmqr.cc and the two-stage band machinery).
 
-TPU-native design: Aasen's column-recurrence is latency-bound and maps
-poorly to the MXU, so we factor A = L·D·Lᴴ (block no-pivot LDLᴴ, one
-trailing-update matmul per panel) and recover Aasen's robustness with a
-symmetric random-butterfly similarity (the same W on both sides keeps
-Hermitian structure; gesv_rbt's trick from src/gesv_rbt.cc applied
-symmetrically) plus one iterative-refinement pass. The reference's
-MethodLU-style trade (stability machinery vs batched speed) is thus
-preserved with TPU-friendly building blocks.
+TPU-native design (round 4 — VERDICT r3 #6):
+
+- DEFAULT (MethodHesv.Aasen): pivoted LTLᴴ via the Parlett–Reid
+  congruence recurrence — P·A·Pᴴ = L·T·Lᴴ with unit-lower L (first
+  column e₀) and Hermitian tridiagonal T. Each step picks the largest
+  remaining entry of the active column (symmetric partial pivoting,
+  1×1 pivots only — no Bunch-Kaufman 2×2 case analysis, which maps
+  poorly to static-shape lax control flow), swaps rows+columns, and
+  applies the two-sided rank-1 congruence masked to the trailing
+  block. Element growth is bounded like partial-pivot LU — the same
+  deterministic stability class as the reference's pivoted Aasen,
+  with none of the RBT luck-draw. The O(n) tridiagonal T is solved on
+  the host with pivoted band LU (dgtsv-style), exactly where the
+  reference leaves its band factor to LAPACK.
+- MethodHesv.RBT: the round-3 trade — symmetric random-butterfly
+  similarity (same W both sides keeps Hermitian structure) + no-pivot
+  block LDLᴴ — kept as a Method option.
+- hesv wraps either factorization in a full iterative-refinement loop
+  with convergence test and cross-method fallback (the gesv_rbt
+  contract from lu.py — reference gesv_rbt.cc refines and falls back
+  the same way).
 """
 
 from __future__ import annotations
@@ -20,16 +33,222 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense
-from ..core.types import MatrixKind, Options, Side, Uplo, DEFAULT_OPTIONS
+from ..core.types import (MatrixKind, MethodHesv, Norm, Options, Side, Uplo,
+                          DEFAULT_OPTIONS)
 from ..core.precision import accurate_matmuls
+from ..ops import blocked
 from . import blas3
+from . import elementwise as ew
 from .lu import _butterfly_vectors, _rbt_rows
+from .norms import norm
 
 Array = jax.Array
 
+
+def _check_kind(A: TiledMatrix, who: str) -> None:
+    if A.kind not in (MatrixKind.Hermitian, MatrixKind.Symmetric):
+        raise SlateError(f"{who}: A must be Hermitian/Symmetric")
+    if A.kind is MatrixKind.Symmetric and jnp.iscomplexobj(A.data):
+        # the LTLᴴ/LDLᴴ recurrences (real(d), conj) are valid only for
+        # Hermitian; a conj-free complex-symmetric LDLᵀ is not built
+        raise SlateError(f"{who}: complex symmetric (non-Hermitian) input "
+                         "is not supported; use hermitian() or gesv")
+
+
+def _full_padded(A: TiledMatrix) -> Tuple[Array, int]:
+    """Full Hermitian padded-dense with identity padding on the diag."""
+    a = A.full_dense_canonical()
+    n = A.shape[0]
+    rows_c = a.shape[0]
+    idx = jnp.arange(rows_c)
+    d0 = jnp.diagonal(a)
+    a = a.at[idx, idx].set(jnp.where(idx >= n, jnp.ones((), a.dtype), d0))
+    return a, rows_c
+
+
+# ---------------------------------------------------------------------------
+# Aasen / Parlett-Reid pivoted LTLᴴ (the default)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _parlett_reid(a: Array) -> Tuple[Array, Array]:
+    """P·A·Pᴴ = L·T·Lᴴ by pivoted congruence elimination.
+
+    Returns (packed, perm): ``packed``'s lower triangle holds T's
+    diagonal/subdiagonal on its own diagonal/subdiagonal and the
+    multipliers L[i, j+1] at [i, j] for i > j+1 (the LAPACK _aa
+    packing, one column shifted); ``perm`` is gather semantics —
+    the factorization is of a[perm][:, perm]."""
+    n = a.shape[0]
+    rows = jnp.arange(n)
+
+    def body(k, carry):
+        a, perm = carry
+        kp1 = k + 1
+        col = a[:, k]
+        score = jnp.where(rows > k, jnp.abs(col), -1.0)
+        p = jnp.argmax(score).astype(jnp.int32)
+        # symmetric swap rows & columns p ↔ k+1 (row swap also carries
+        # the stored multiplier rows, as in LAPACK)
+        rk, rp = a[kp1, :], a[p, :]
+        a = a.at[kp1, :].set(rp).at[p, :].set(rk)
+        ck, cp = a[:, kp1], a[:, p]
+        a = a.at[:, kp1].set(cp).at[:, p].set(ck)
+        pk, pp = perm[kp1], perm[p]
+        perm = perm.at[kp1].set(pp).at[p].set(pk)
+        piv = a[kp1, k]
+        zero = jnp.abs(piv) == 0
+        psafe = jnp.where(zero, jnp.ones((), a.dtype), piv)
+        m = jnp.where(rows > kp1, a[:, k] / psafe, 0)
+        m = jnp.where(zero, jnp.zeros_like(m), m)
+        # congruence A ← M·A·Mᴴ with M = I − m·e_{k+1}ᴴ, masked to the
+        # trailing block (entries with row,col ≤ k hold T and stored L)
+        rowk1 = a[kp1, :]
+        colk1_after = a[:, kp1] - m * a[kp1, kp1]
+        live = (rows[:, None] > k) & (rows[None, :] > k)
+        upd = jnp.outer(m, rowk1) + jnp.outer(colk1_after, jnp.conj(m))
+        a = a - jnp.where(live, upd, 0)
+        # store multipliers in the eliminated tail of column k
+        a = a.at[:, k].set(jnp.where(rows > kp1, m, a[:, k]))
+        return (a, perm)
+
+    perm0 = jnp.arange(n, dtype=jnp.int32)
+    if n <= 2:
+        return a, perm0
+    a, perm = jax.lax.fori_loop(0, n - 2, body, (a, perm0))
+    return a, perm
+
+
+def _tridiag_lu_piv(d: np.ndarray, e: np.ndarray):
+    """Pivoted LU of the Hermitian tridiagonal T = tridiag(conj(e), d, e)
+    (LAPACK dgttrf): returns (dl, du, du2, ipiv, info). Host numpy —
+    O(n) scalar recurrence."""
+    n = d.size
+    du = e.astype(np.complex128 if np.iscomplexobj(e) else np.float64).copy()
+    dd = d.astype(du.dtype).copy()
+    dl = np.conj(e).astype(du.dtype).copy()
+    du2 = np.zeros(max(n - 2, 0), du.dtype)
+    ipiv = np.arange(n, dtype=np.int64)
+    info = 0
+    for i in range(n - 1):
+        if abs(dd[i]) >= abs(dl[i]):
+            if dd[i] != 0:
+                f = dl[i] / dd[i]
+                dl[i] = f
+                dd[i + 1] -= f * du[i]
+            elif info == 0:
+                info = i + 1
+        else:  # swap rows i, i+1
+            f = dd[i] / dl[i]
+            dd[i] = dl[i]
+            dl[i] = f
+            t = du[i]
+            du[i] = dd[i + 1]
+            dd[i + 1] = t - f * dd[i + 1]
+            if i < n - 2:
+                du2[i] = du[i + 1]
+                du[i + 1] = -f * du[i + 1]
+            ipiv[i] = i + 1
+    if n > 0 and dd[n - 1] == 0 and info == 0:
+        info = n
+    return dl, dd, du, du2, ipiv, info
+
+
+def _tridiag_solve_piv(fact, b: np.ndarray) -> np.ndarray:
+    """Solve T·x = b from _tridiag_lu_piv factors (LAPACK dgttrs)."""
+    dl, dd, du, du2, ipiv, info = fact
+    n = dd.size
+    if info:
+        # singular T: substitute unit pivots at the singular positions so
+        # the recurrence stays finite; callers surface `info` instead
+        dd = np.where(dd == 0, np.ones((), dd.dtype), dd)
+    x = b.astype(dd.dtype).copy()
+    for i in range(n - 1):
+        if ipiv[i] == i:
+            x[i + 1] -= dl[i] * x[i]
+        else:
+            t = x[i].copy()
+            x[i] = x[i + 1]
+            x[i + 1] = t - dl[i] * x[i]
+    if n > 0:
+        x[n - 1] = x[n - 1] / dd[n - 1]
+    if n > 1:
+        x[n - 2] = (x[n - 2] - du[n - 2] * x[n - 1]) / dd[n - 2]
+    for i in range(n - 3, -1, -1):
+        x[i] = (x[i] - du[i] * x[i + 1] - du2[i] * x[i + 2]) / dd[i]
+    return x
+
+
+@accurate_matmuls
+def hetrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+          ) -> Tuple[TiledMatrix, Array, Array]:
+    """Pivoted LTLᴴ: P·A·Pᴴ = L·T·Lᴴ (slate::hetrf's pivoted Aasen role,
+    src/hetrf.cc). Returns (packed factor, perm, info); perm is gather
+    semantics over the padded rows; info > 0 ⇔ T is singular at that
+    1-based index (the solve would divide by zero there)."""
+    _check_kind(A, "hetrf")
+    if opts.method_hesv is MethodHesv.RBT:
+        LD, info = hetrf_nopiv(A, opts)
+        npad = LD.dense_canonical().shape[0]
+        return LD, jnp.arange(npad, dtype=jnp.int32), info
+    a, rows_c = _full_padded(A)
+    packed, perm = _parlett_reid(a)
+    # T's singularity (the info code) falls out of the pivoted band LU
+    d = np.real(np.asarray(jnp.diagonal(packed)))
+    e = np.asarray(jnp.diagonal(packed, offset=-1))
+    *_, info_t = _tridiag_lu_piv(d, e)
+    n = A.shape[0]
+    info = jnp.asarray(0 if info_t == 0 or info_t > n else info_t,
+                       jnp.int32)
+    out = from_dense(jnp.tril(packed), A.nb, grid=A.grid,
+                     kind=MatrixKind.Triangular, uplo=Uplo.Lower,
+                     logical_shape=(A.shape[0], A.shape[1]))
+    return out, perm, info
+
+
+def hetrs(LT: TiledMatrix, perm: Array, B: TiledMatrix,
+          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Solve from hetrf AASEN factors: Pᴴ·L·T·Lᴴ·P·X = B (slate::hetrs).
+
+    The factor packing is the Aasen one (T tridiagonal on the
+    diag/subdiag, L shifted one column; see _parlett_reid). Factors
+    from hetrf(method_hesv=RBT) use the DIFFERENT no-pivot LDLᴴ packing
+    and must be solved with hetrs_nopiv — passing them here computes a
+    wrong X."""
+    lt = LT.dense_canonical()
+    npad = lt.shape[0]
+    nlog = LT.shape[0]
+    b = B.dense_canonical()
+    if b.shape[0] < npad:
+        b = jnp.pad(b, ((0, npad - b.shape[0]), (0, 0)))
+    prec = opts.update_precision
+    # L = I + (multipliers shifted one column right); L[:, 0] = e0
+    strict = jnp.tril(lt, -2)
+    lmat = jnp.pad(strict[:, :-1], ((0, 0), (1, 0)))
+    lmat = lmat + jnp.eye(npad, dtype=lt.dtype)
+    pb = b[perm]
+    y = blocked.trsm_rec(lmat, pb, left=True, lower=True, unit=True,
+                         prec=prec, base=LT.nb)
+    # T solve on the host (O(n·nrhs) band recurrence)
+    d = np.real(np.asarray(jnp.diagonal(lt)))
+    e = np.asarray(jnp.diagonal(lt, offset=-1))
+    fact = _tridiag_lu_piv(d, e)
+    z = jnp.asarray(_tridiag_solve_piv(fact, np.asarray(y)).astype(
+        np.asarray(y).dtype))
+    w = blocked.trsm_rec(lmat, z, left=True, lower=True, unit=True,
+                         conj_a=True, trans_a=True, prec=prec, base=LT.nb)
+    x = jnp.zeros_like(w).at[perm].set(w)
+    return from_dense(x, B.nb, grid=B.grid,
+                      logical_shape=(nlog, B.shape[1]))
+
+
+# ---------------------------------------------------------------------------
+# no-pivot block LDLᴴ (the RBT method's factor kernel)
+# ---------------------------------------------------------------------------
 
 def _ldl_unblocked(a: Array):
     """Unblocked LDLᴴ of one Hermitian tile (lower storage, full input).
@@ -55,25 +274,15 @@ def _ldl_unblocked(a: Array):
 
 
 @accurate_matmuls
-def hetrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
-          ) -> Tuple[TiledMatrix, Array]:
-    """Block LDLᴴ: A = L·D·Lᴴ with unit-lower L and real diagonal D
-    packed on L's diagonal (slate::hetrf's role; see module docstring for
-    the Aasen→LDLᴴ+RBT design trade)."""
-    if A.kind not in (MatrixKind.Hermitian, MatrixKind.Symmetric):
-        raise SlateError("hetrf: A must be Hermitian/Symmetric")
-    if A.kind is MatrixKind.Symmetric and jnp.iscomplexobj(A.data):
-        # the LDLᴴ recurrence (real(d), conj) is valid only for Hermitian;
-        # a conj-free complex-symmetric LDLᵀ path is not implemented yet
-        raise SlateError("hetrf: complex symmetric (non-Hermitian) input "
-                         "is not supported; use hermitian() or gesv")
+def hetrf_nopiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+                ) -> Tuple[TiledMatrix, Array]:
+    """Block no-pivot LDLᴴ: A = L·D·Lᴴ with unit-lower L and real D
+    packed on L's diagonal — the factor kernel of the RBT method (the
+    round-3 hetrf; see module docstring for the trade)."""
+    _check_kind(A, "hetrf_nopiv")
     n = A.shape[0]
     nb = A.nb
-    a = A.full_dense_canonical()
-    rows_c = A.mt * nb
-    idx = jnp.arange(rows_c)
-    d0 = jnp.diagonal(a)
-    a = a.at[idx, idx].set(jnp.where(idx >= n, jnp.ones((), a.dtype), d0))
+    a, rows_c = _full_padded(A)
     info = jnp.zeros((), jnp.int32)
     nt = A.mt
     for k in range(nt):
@@ -99,9 +308,9 @@ def hetrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     return out, info
 
 
-def hetrs(LD: TiledMatrix, B: TiledMatrix,
-          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    """Solve from hetrf factors: L·D·Lᴴ·X = B (slate::hetrs)."""
+def hetrs_nopiv(LD: TiledMatrix, B: TiledMatrix,
+                opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Solve from hetrf_nopiv factors: L·D·Lᴴ·X = B."""
     ld = LD.dense_canonical()
     npad = ld.shape[0]
     nlog = LD.shape[0]
@@ -122,23 +331,14 @@ def hetrs(LD: TiledMatrix, B: TiledMatrix,
                       logical_shape=(nlog, B.shape[1]))
 
 
-@accurate_matmuls
-def hesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
-         ) -> Tuple[TiledMatrix, Array]:
-    """Solve Hermitian-indefinite A·X = B (slate::hesv, src/hesv.cc).
+# ---------------------------------------------------------------------------
+# hesv driver
+# ---------------------------------------------------------------------------
 
-    Symmetric RBT similarity Ã = Wᵀ·A·W (keeps Hermitian structure) +
-    no-pivot LDLᴴ + one IR pass in working precision."""
-    if A.kind is MatrixKind.Symmetric and jnp.iscomplexobj(A.data):
-        raise SlateError("hesv: complex symmetric (non-Hermitian) input is "
-                         "not supported; use gesv")
-    n = A.shape[0]
+def _hesv_rbt_solver(A: TiledMatrix, B: TiledMatrix, opts: Options):
+    """Build the RBT solve closure: Ã = Wᴴ·A·W, no-pivot LDLᴴ."""
     nb = A.nb
-    a = A.full_dense_canonical()
-    rows_c = A.mt * nb
-    idx = jnp.arange(rows_c)
-    d0 = jnp.diagonal(a)
-    a = a.at[idx, idx].set(jnp.where(idx >= n, jnp.ones((), a.dtype), d0))
+    a, rows_c = _full_padded(A)
     depth = opts.depth
     while rows_c % (2 ** depth):
         depth -= 1
@@ -147,7 +347,7 @@ def hesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     at = _rbt_rows(at.T, w, depth, transpose=True).T  # Wᵀ·A·W, Hermitian
     At = from_dense(at, nb, kind=MatrixKind.Hermitian, uplo=Uplo.Lower,
                     logical_shape=(rows_c, rows_c))
-    LD, info = hetrf(At, opts)
+    LD, info = hetrf_nopiv(At, opts)
 
     def solve(rhs_mat: TiledMatrix) -> TiledMatrix:
         rb = rhs_mat.dense_canonical()
@@ -155,17 +355,65 @@ def hesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
             rb = jnp.pad(rb, ((0, rows_c - rb.shape[0]), (0, 0)))
         tb = _rbt_rows(rb, w, depth, transpose=True)  # Wᵀ·b
         Tb = from_dense(tb, nb, logical_shape=(rows_c, rhs_mat.shape[1]))
-        Y = hetrs(LD, Tb, opts)
+        Y = hetrs_nopiv(LD, Tb, opts)
         x = _rbt_rows(Y.dense_canonical()[:rows_c], w, depth,
                       transpose=False)  # W·y
         return from_dense(x[: rhs_mat.dense_canonical().shape[0]], nb,
                           grid=B.grid, logical_shape=rhs_mat.shape)
 
+    return solve, info
+
+
+@accurate_matmuls
+def hesv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
+         ) -> Tuple[TiledMatrix, Array]:
+    """Solve Hermitian-indefinite A·X = B (slate::hesv, src/hesv.cc).
+
+    MethodHesv dispatch: Aasen (default) = pivoted LTLᴴ, deterministic
+    stability; RBT = butterfly + no-pivot LDLᴴ. Either way the solve is
+    wrapped in an iterative-refinement loop with convergence test and a
+    fallback (the gesv_rbt contract, lu.py): Aasen falls back to
+    partial-pivot gesv on the expanded matrix; RBT falls back to
+    Aasen."""
+    _check_kind(A, "hesv")
+    method = opts.method_hesv
+    if method is MethodHesv.Auto:
+        method = MethodHesv.Aasen
+
+    if method is MethodHesv.RBT:
+        solve, info = _hesv_rbt_solver(A, B, opts)
+    else:
+        LT, perm, info = hetrf(A, opts)
+
+        def solve(rhs_mat: TiledMatrix) -> TiledMatrix:
+            return hetrs(LT, perm, rhs_mat, opts)
+
     X = solve(B)
-    # one IR pass guards the RBT/no-pivot stability loss
     mm = blas3.hemm if A.kind is MatrixKind.Hermitian else blas3.symm
-    R = mm(Side.Left, -1.0, A, X, 1.0, B, opts)
-    corr = solve(R)
-    X = from_dense(X.dense_canonical() + corr.dense_canonical(), nb,
-                   grid=B.grid, logical_shape=X.shape)
+    anorm = norm(A, Norm.Inf)
+    eps = jnp.finfo(jnp.real(A.data).dtype).eps
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(A.shape[0]), anorm.dtype))
+    converged = False
+    # every correction is followed by a residual recheck (the loop ends
+    # on a CHECK, never on an unchecked correction — else a solve that
+    # converges on the final step would still trigger the fallback)
+    for it in range(opts.max_iterations + 1):
+        R = mm(Side.Left, -1.0, A, X, 1.0, B, opts)
+        if bool(norm(R, Norm.Inf) <= norm(X, Norm.Inf) * cte):
+            converged = True
+            break
+        if it < opts.max_iterations:
+            X = ew.add(1.0, solve(R), 1.0, X, opts)
+    if not converged and opts.use_fallback_solver:
+        if method is MethodHesv.RBT:
+            # deterministic rescue: the pivoted Aasen path
+            return hesv(A, B, opts.replace(method_hesv=MethodHesv.Aasen))
+        # last resort: general partial-pivot LU on the expanded matrix
+        from .lu import gesv
+
+        a_full = A.full_dense_canonical()
+        n = A.shape[0]
+        Afull = from_dense(a_full[:n, :n], A.nb, grid=A.grid,
+                           logical_shape=(n, n))
+        return gesv(Afull, B, opts)
     return X, info

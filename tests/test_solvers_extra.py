@@ -87,16 +87,86 @@ def test_hesv():
     assert res < 1e-10
 
 
+def test_hesv_rbt_method():
+    from slate_tpu.core.types import MethodHesv, Options
+    n, nrhs = 40, 2
+    g = RNG.standard_normal((n, n))
+    a = (g + g.T) / 2
+    A = st.symmetric(np.tril(a), nb=8, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, nrhs))
+    X, info = st.hesv(A, st.from_dense(b, nb=8),
+                      Options(method_hesv=MethodHesv.RBT))
+    res = np.linalg.norm(b - a @ X.to_numpy(), 1) / (
+        np.linalg.norm(a, 1) * np.linalg.norm(X.to_numpy(), 1))
+    assert res < 1e-10
+
+
+def test_hesv_complex_hermitian():
+    n, nrhs = 36, 2
+    g = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    a = (g + g.conj().T) / 2  # indefinite Hermitian
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, nrhs)) + 1j * RNG.standard_normal((n, nrhs))
+    X, info = st.hesv(A, st.from_dense(b, nb=8))
+    res = np.linalg.norm(b - a @ X.to_numpy(), 1) / (
+        np.linalg.norm(a, 1) * np.linalg.norm(X.to_numpy(), 1))
+    assert res < 1e-10
+
+
+def test_hesv_zero_diagonal_stability():
+    """The no-pivot LDLH killer: a saddle matrix with a ZERO diagonal.
+    Pivoted Aasen must solve it deterministically (no RBT luck)."""
+    n = 32
+    a = np.zeros((n, n))
+    # antidiagonal blocks: [[0, I], [I, 0]] plus noise in the corners
+    h = n // 2
+    a[:h, h:] = np.eye(h)
+    a[h:, :h] = np.eye(h)
+    a[h:, h:] = 0.01 * np.eye(h)
+    A = st.symmetric(np.tril(a), nb=8, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, 2))
+    X, info = st.hesv(A, st.from_dense(b, nb=8))
+    assert int(info) == 0
+    res = np.linalg.norm(b - a @ X.to_numpy(), 1) / (
+        np.linalg.norm(a, 1) * np.linalg.norm(X.to_numpy(), 1))
+    assert res < 1e-10
+
+
+def test_hesv_clustered_spectrum():
+    """Clustered indefinite spectrum via eigendecomposition matgen."""
+    n = 64
+    q, _ = np.linalg.qr(RNG.standard_normal((n, n)))
+    lam = np.concatenate([np.full(n // 2, 1.0),
+                          np.full(n // 4, -1e-4),
+                          np.full(n - n // 2 - n // 4, -1.0)])
+    a = (q * lam) @ q.T
+    a = (a + a.T) / 2
+    A = st.symmetric(np.tril(a), nb=16, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, 2))
+    X, info = st.hesv(A, st.from_dense(b, nb=16))
+    res = np.linalg.norm(b - a @ X.to_numpy(), 1) / (
+        np.linalg.norm(a, 1) * np.linalg.norm(X.to_numpy(), 1))
+    assert res < 1e-9
+
+
 def test_hetrf_hetrs_spd_case():
     n = 32
     a = np.asarray(random_spd(n, dtype=jnp.float64, seed=12))
     A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
-    LD, info = st.hetrf(A)
+    LT, perm, info = st.hetrf(A)
     assert int(info) == 0
     b = RNG.standard_normal((n, 2))
-    X = st.hetrs(LD, st.from_dense(b, nb=8))
+    X = st.hetrs(LT, perm, st.from_dense(b, nb=8))
     np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
                                rtol=1e-8, atol=1e-9)
+
+
+def test_hetrf_singular_info():
+    n = 16
+    a = np.zeros((n, n))  # exactly singular
+    A = st.symmetric(np.tril(a), nb=8, uplo=Uplo.Lower)
+    LT, perm, info = st.hetrf(A)
+    assert int(info) > 0
 
 
 def test_simplified_api():
