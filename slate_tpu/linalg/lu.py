@@ -105,15 +105,66 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
     return lu, perm, info
 
 
+def _getrf_iter(a: Array, nb: int, prec):
+    """Iterative right-looking blocked partial-pivot LU (round 4).
+
+    Same redesign as cholesky._potrf_iter: per panel ONE bucketed
+    pivoted panel factorization (blocked.panel_getrf), ONE batched-leaf
+    unit-lower inverse of L11 (blocked.trtri_lower_batched), then the
+    U12 block and Schur complement as single gemms — no recursive
+    trsm re-inverting the same diagonal blocks at every level. The
+    reference's DAG shape (panel → swaps → trsm → gemm per step,
+    src/getrf.cc:81-160) is recovered step for step."""
+    m, w = a.shape
+    nt = w // nb
+    perm = jnp.arange(m, dtype=jnp.int32)
+    info = jnp.zeros((), jnp.int32)
+    for k in range(nt):
+        k0, k1 = k * nb, (k + 1) * nb
+        rows = m - k0
+        hb = blocked.bucket_pow2(rows, nb)
+        panel = a[k0:, k0:k1]
+        if hb > rows:
+            panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
+        lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
+        p_p = p_p[:rows]
+        info = jnp.where((info == 0) & (i_p > 0), k0 + i_p,
+                         info).astype(jnp.int32)
+        # row swaps apply to the whole remaining row block, stored L
+        # included (reference applies pivots to left panels too)
+        moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
+        a = jax.lax.dynamic_update_slice(a, moved, (k0, 0))
+        perm = perm.at[k0:].set(perm[k0:][p_p])
+        a = jax.lax.dynamic_update_slice(a, lu_p[:rows], (k0, k0))
+        if k1 >= w:
+            continue
+        l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=a.dtype)
+        inv11 = blocked.trtri_lower_batched(l11, unit=True)
+        u12 = blocked.mm(inv11, a[k0:k1, k1:], prec)
+        a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
+        schur = blocked.rebalance(
+            a[k1:, k1:] - blocked.mm(a[k1:, k0:k1], u12, prec))
+        a = jax.lax.dynamic_update_slice(a, schur, (k1, k1))
+    return a, perm, info
+
+
+_GETRF_ITER_MAX_NT = 64  # same HLO-size bound as _POTRF_ITER_MAX_NT
+
+
 def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
                    dist_panel: bool = False):
     """Blocked partial-pivot LU on padded dense (possibly rectangular).
 
-    Factors the leading min(m,n) columns recursively; for wide matrices
-    the remaining U columns get one block solve + no further pivoting."""
+    Factors the leading min(m,n) columns (iterative panel loop when the
+    shape allows, else the width recursion); for wide matrices the
+    remaining U columns get one block solve + no further pivoting."""
     m, n = a.shape
     k = min(m, n)
-    lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel)
+    if (not dist_panel and k % nb == 0
+            and 1 < k // nb <= _GETRF_ITER_MAX_NT):
+        lu, perm, info = _getrf_iter(a[:, :k], nb, prec)
+    else:
+        lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel)
     if n > k:
         rest = blocked.permute_rows_limited(a[:, k:], perm, 2 * k)
         u_rest = blocked.trsm_rec(lu[:, :k], rest, left=True, lower=True,

@@ -192,6 +192,62 @@ def _trtri_block(l: Array, unit: bool) -> Array:
     return trtri_lower_rec(l, unit)
 
 
+def trtri_lower_batched(l: Array, unit: bool = False,
+                        leaf: int = 64) -> Array:
+    """inv(L) with ALL diagonal leaf blocks inverted in one vmapped
+    straight-line kernel, then combined by the 2×2 gemm recursion.
+
+    The plain recursion executes its fori_loop leaf inversions
+    sequentially — at (1024, leaf 64) that is 16 × ~0.3 ms of serial
+    latency per inverse; batching the leaves collapses it to one fused
+    kernel + log2(n/leaf) combine levels of MXU gemms. This is the
+    panel-inverse kernel of the iterative potrf/getrf paths (the
+    inverted-diagonal-block scheme cuBLAS/MAGMA use for GPU trsm, done
+    once per panel instead of once per trsm call)."""
+    n = l.shape[0]
+    nleaf = n // leaf if n % leaf == 0 else 0
+    if n <= leaf or nleaf == 0 or (nleaf & (nleaf - 1)) != 0:
+        return trtri_lower_rec(l, unit)  # needs a power-of-two leaf grid
+    idx = jnp.arange(nleaf) * leaf
+    diags = jax.vmap(
+        lambda i: lax.dynamic_slice(l, (i, i), (leaf, leaf)))(idx)
+    inv_leaves = jax.vmap(lambda d: _trtri_unrolled_u(d, leaf, unit))(diags)
+
+    # bottom-up assembly: at each level, pair up the current inverses —
+    # inv([[A,0],[B,C]]) = [[iA, 0], [−iC·B·iA, iC]]
+    inv = inv_leaves  # (nblk, s, s)
+    s = leaf
+    while s < n:
+        nblk = inv.shape[0]
+        ia = inv[0::2]  # (nblk/2, s, s)
+        ic = inv[1::2]
+        starts = jnp.arange(nblk // 2) * (2 * s)
+        b = jax.vmap(
+            lambda i: lax.dynamic_slice(l, (i + s, i), (s, s)))(starts)
+        off = -jnp.einsum("bij,bjk,bkl->bil", ic, b, ia,
+                          precision=lax.Precision.HIGHEST)
+        top = jnp.concatenate(
+            [ia, jnp.zeros((nblk // 2, s, s), l.dtype)], axis=2)
+        bot = jnp.concatenate([off, ic], axis=2)
+        inv = jnp.concatenate([top, bot], axis=1)
+        s *= 2
+    return inv[0]
+
+
+def _trtri_unrolled_u(l: Array, ib: int, unit: bool) -> Array:
+    """Straight-line inverse of a lower-triangular block, unit-aware."""
+    cols = jnp.arange(ib)
+    x = jnp.zeros_like(l)
+    for i in range(ib):
+        lrow = jnp.where(cols < i, l[i, :], 0)
+        e_i = (cols == i).astype(l.dtype)
+        row = e_i - lrow @ x
+        if not unit:
+            row = row / l[i, i]
+        x = x.at[i, :].set(row)
+    return x
+
+
 # ---------------------------------------------------------------------------
 # triangular solve
 # ---------------------------------------------------------------------------
