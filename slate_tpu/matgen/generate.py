@@ -1,19 +1,28 @@
 """Test-matrix generation (reference: matgen/ library, slate_matgen).
 
 Reference entry point: generate_matrix(MatrixParams, A) with ~40 kinds
-(matgen/generate_matrix_utils.cc:64-136; type builders
-generate_type_{rand,svd,heev,geev}.hh; spectra in generate_sigma.hh).
+(matgen/generate_matrix_utils.cc:64-136; entry formulas in
+generate_matrix_ge.cc:80-465; type builders generate_type_{rand,svd,
+heev}.hh; spectra in generate_sigma.hh).
 
 Here: ``generate_matrix(kind, m, n, ...)`` returns a dense jax array (wrap
 with core.from_dense to distribute). Determinism/distribution-independence
 comes from slate_tpu.matgen.random (counter-based, logical-shape keyed).
 
-Supported kind grammar (subset mirroring the reference):
-  zeros | ones | identity | jordan | minij | hilb | gcdmat | toeppen
-  rand | rands | randn | randb                    (+ _dominant suffix)
-  diag^{spectrum} | svd_{spectrum} | heev_{spectrum} | poev_{spectrum}
-with spectrum ∈ {logrand, arith, geo, cluster0, cluster1, rarith, rgeo,
-rcluster0, rcluster1, specified} and condition number ``cond``.
+Supported kind grammar (mirroring the reference):
+  zeros | ones | identity | ij | jordan | jordanT | chebspec | circul |
+  fiedler | gfpp | kms | orthog | riemann | ris | zielkeNS | minij |
+  hilb | frank | lehmer | lotkin | redheff | triw | pei | tridiag |
+  toeppen | parter | moler | cauchy | chow | clement | gcdmat
+  rand | rands | randn | randb | randr             (+ modifiers)
+  diag^ | svd^ | poev^ | spd^ | heev^ | syev^ | geev^
+with ^spectrum ∈ {logrand, arith, geo, cluster0, cluster1, rarith, rgeo,
+rcluster0, rcluster1, rand, rands, randn, specified} and condition
+number ``cond``; scaling suffixes _ufl/_ofl/_small/_large; modifiers
+_dominant and _zerocolN / _zerocolFRAC; condD row/col grading (column
+scaling A·D for svd kinds, two-sided D·A·D for heev/poev — the
+reference's generate_type_svd.hh:159-196 / generate_type_heev.hh:114-139
+semantics, with the same log-uniform random D).
 """
 
 from __future__ import annotations
@@ -22,41 +31,62 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.exceptions import SlateError
 from . import random as rnd
 
+_SPECTRA = ("logrand", "arith", "geo", "cluster0", "cluster1",
+            "rlogrand", "rarith", "rgeo", "rcluster0", "rcluster1",
+            "rand", "rands", "randn", "specified")
+_SCALINGS = ("ufl", "ofl", "small", "large")
+_SPECTRAL_BASES = ("diag", "svd", "heev", "syev", "poev", "spd", "geev")
 
-def _spectrum(kind: str, n: int, cond: float, dtype, seed: int) -> jax.Array:
+
+def _spectrum(kind: str, n: int, cond: float, dtype, seed: int,
+              sigma=None) -> jax.Array:
     """Singular/eigen-value profiles Σ (generate_sigma.hh analog).
 
-    All profiles have σ₁ = 1, σₙ = 1/cond (before random sign for 'r'
-    variants). Random profiles are keyed on the caller's seed, like the
-    reference matgen (matgen/random.cc keys everything on params.seed)."""
+    All deterministic profiles have σ₁ = 1, σₙ = 1/cond (before random
+    sign for 'r' variants). Random profiles are keyed on the caller's
+    seed, like the reference matgen."""
     real = jnp.finfo(dtype).dtype
     i = jnp.arange(n, dtype=real)
     inv = jnp.asarray(1.0 / cond, real)
-    if kind in ("logrand",):
+    if kind == "logrand":
         # log-uniform in [1/cond, 1]
         u = jax.random.uniform(jax.random.fold_in(jax.random.key(seed), 1),
                                (n,), real)
         sig = jnp.exp(u * jnp.log(inv))
-    elif kind in ("arith",):
+    elif kind == "arith":
         sig = 1.0 - i / max(n - 1, 1) * (1.0 - inv)
-    elif kind in ("geo",):
+    elif kind == "geo":
         sig = inv ** (i / max(n - 1, 1))
-    elif kind in ("cluster0",):  # {1, 1/cond, ..., 1/cond}
+    elif kind == "cluster0":  # {1, 1/cond, ..., 1/cond}
         sig = jnp.where(i == 0, 1.0, inv)
-    elif kind in ("cluster1",):  # {1, ..., 1, 1/cond}
+    elif kind == "cluster1":  # {1, ..., 1, 1/cond}
         sig = jnp.where(i == n - 1, inv, 1.0)
-    elif kind.startswith("r") and kind[1:] in ("logrand", "arith", "geo",
-                                               "cluster0", "cluster1"):
-        sig = _spectrum(kind[1:], n, cond, dtype, seed)
-        sign = jnp.where(
-            jax.random.bernoulli(jax.random.fold_in(jax.random.key(seed), 2),
-                                 0.5, (n,)), 1.0, -1.0
-        ).astype(real)
-        sig = sig * sign
+    elif kind == "rand":
+        sig = jax.random.uniform(jax.random.fold_in(jax.random.key(seed), 3),
+                                 (n,), real)
+    elif kind == "rands":
+        sig = jax.random.uniform(jax.random.fold_in(jax.random.key(seed), 4),
+                                 (n,), real, minval=-1.0, maxval=1.0)
+    elif kind == "randn":
+        sig = jax.random.normal(jax.random.fold_in(jax.random.key(seed), 5),
+                                (n,), real)
+    elif kind == "specified":
+        if sigma is None:
+            raise SlateError("spectrum 'specified' needs sigma=")
+        sig = jnp.asarray(sigma, real)
+        if sig.shape != (n,):
+            raise SlateError(f"sigma must have shape ({n},)")
+    elif kind.startswith("r") and kind[1:] in ("arith", "geo", "cluster0",
+                                               "cluster1", "logrand"):
+        sig = _spectrum(kind[1:], n, cond, dtype, seed)[::-1]
+        # classic 'r' variants ALSO randomize signs in the reference's
+        # heev use (rand_sign); plain reversal for svd keeps σ ≥ 0 —
+        # sign randomization belongs to heev kinds and is applied there
     else:
         raise SlateError(f"unknown spectrum '{kind}'")
     return sig.astype(real)
@@ -74,92 +104,258 @@ def _random_orthogonal(seed: int, n: int, dtype) -> jax.Array:
     return q * jnp.conj(ph)[None, :]
 
 
+def _cond_d_vector(condD: float, n: int, dtype, seed: int) -> jax.Array:
+    """The reference's condD scaling vector: D_i = exp(u_i · log condD),
+    u ~ U(0,1) — log-uniform in [1, condD] (generate_type_svd.hh:167)."""
+    real = jnp.finfo(dtype).dtype
+    u = jax.random.uniform(jax.random.fold_in(jax.random.key(seed), 9),
+                           (n,), real)
+    return jnp.exp(u * jnp.log(jnp.asarray(condD, real))).astype(dtype)
+
+
 def generate_matrix(kind: str, m: int, n: Optional[int] = None,
                     dtype=jnp.float32, seed: int = 42,
                     cond: Optional[float] = None,
-                    condD: Optional[float] = None) -> jax.Array:
-    """Dense (m × n) test matrix of the given kind.
+                    condD: Optional[float] = None,
+                    sigma=None) -> jax.Array:
+    """Dense (m × n) test matrix of the given kind (see module doc).
 
-    ``condD``: two-sided diagonal scaling A ← D·A·D with D log-spaced
-    over [condD^-½, condD^½] — the reference's condD knob
-    (matgen/generate_matrix_utils.cc:64-136), which grades row/column
-    norms to stress scaling-sensitive paths (equilibration, pivoting).
+    ``condD`` grades row/column norms to stress scaling-sensitive paths
+    (equilibration, pivoting): svd kinds get column scaling A·D, heev/
+    poev kinds get the two-sided D·A·D, matching the reference
+    (generate_type_svd.hh:159-196, generate_type_heev.hh:114-139).
+    ``sigma``: the user-specified spectrum for ^specified kinds.
     """
-    a = _generate_unscaled(kind, m, n, dtype, seed, cond)
+    base = kind.split("_")[0]
+    a = _generate_unscaled(kind, m, n, dtype, seed, cond, sigma)
     if condD is not None and condD != 1.0:
-        nn = a.shape
-        real = jnp.finfo(dtype).dtype
-        h = 0.5 * jnp.log(jnp.asarray(condD, real))
-        dr = jnp.exp(jnp.linspace(-h, h, nn[0])).astype(dtype)
-        dc = jnp.exp(jnp.linspace(-h, h, nn[1])).astype(dtype)
-        a = dr[:, None] * a * dc[None, :]
+        if base in ("heev", "syev", "poev", "spd"):
+            d = _cond_d_vector(condD, a.shape[0], dtype, seed)
+            a = d[:, None] * a * d[None, :]
+        elif base in ("svd", "gesvd", "rand", "rands", "randn", "randb",
+                      "randr", "diag"):
+            d = _cond_d_vector(condD, a.shape[1], dtype, seed)
+            a = a * d[None, :]
+        # other kinds ignore condD (the reference warns; we silently
+        # no-op to stay functional under sweeps)
     return a
 
 
+def _entrywise(m, n, dtype, fn):
+    """A[i, j] = fn(i, j) on 0-based index grids (the reference's
+    entry_type lambdas, generate_matrix_ge.cc:80-465)."""
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    return fn(i, j).astype(dtype)
+
+
 def _generate_unscaled(kind: str, m: int, n: Optional[int],
-                       dtype, seed: int, cond: Optional[float]) -> jax.Array:
+                       dtype, seed: int, cond: Optional[float],
+                       sigma=None) -> jax.Array:
     n = n if n is not None else m
     k = min(m, n)
     if cond is None:
         cond = 1.0e4
-    base, _, spec = kind.partition("_")
+    parts = kind.split("_")
+    base = parts[0]
+    mods = parts[1:]
 
-    if kind == "zeros" or kind == "zero":
+    # peel scaling/modifier suffixes (reference decode_matrix); unknown
+    # suffixes raise — a typo must not silently become the default
+    # logrand spectrum (it would turn a stress matrix benign)
+    scaling = None
+    dominant = False
+    zerocol = None
+    spec = None
+    for s in mods:
+        if s in _SCALINGS:
+            scaling = s
+        elif s == "dominant":
+            dominant = True
+        elif s.startswith("zerocol"):
+            v = s[len("zerocol"):]
+            zerocol = (int(round(float(v) * (n - 1)))
+                       if "." in v else int(v))
+        elif s in _SPECTRA:
+            if base not in _SPECTRAL_BASES:
+                raise SlateError(
+                    f"kind '{base}' takes no spectrum suffix '_{s}'")
+            spec = s
+        else:
+            raise SlateError(f"unknown suffix '_{s}' in kind '{kind}'")
+
+    a = _generate_base(base, spec, m, n, k, dtype, seed, cond, sigma)
+
+    if dominant:
+        if base in ("rand", "rands", "randn", "randb", "randr"):
+            # the established rand_dominant contract: + min(m,n)·I
+            a = a + k * jnp.eye(m, n, dtype=dtype)
+        else:
+            # reference: dominant only implemented for rand kinds; we
+            # extend it (sum of |row| added to the diagonal)
+            rs = jnp.sum(jnp.abs(a), axis=1)
+            idx = jnp.arange(k)
+            a = a.at[idx, idx].add(rs[:k].astype(a.dtype))
+    if scaling is not None:
+        real = jnp.finfo(dtype).dtype
+        fi = jnp.finfo(real)
+        target = {"ufl": float(fi.tiny), "ofl": float(fi.max),
+                  "small": float(np.sqrt(fi.tiny)),
+                  "large": float(np.sqrt(fi.max))}[scaling]
+        amax = jnp.max(jnp.abs(a))
+        a = a * jnp.where(amax == 0, 1.0,
+                          jnp.asarray(target, real) / amax).astype(dtype)
+    if zerocol is not None:
+        if not 0 <= zerocol < n:
+            raise SlateError(f"zerocol {zerocol} outside [0, {n})")
+        a = a.at[:, zerocol].set(0)
+        if base in ("heev", "syev", "poev", "spd") and zerocol < m:
+            a = a.at[zerocol, :].set(0)
+    return a
+
+
+def _generate_base(base, spec, m, n, k, dtype, seed, cond, sigma):
+    mx = max(m, n)
+    E = _entrywise
+
+    if base in ("zeros", "zero"):
         return jnp.zeros((m, n), dtype)
-    if kind == "ones" or kind == "one":
+    if base in ("ones", "one"):
         return jnp.ones((m, n), dtype)
-    if kind == "identity":
+    if base == "identity":
         return jnp.eye(m, n, dtype=dtype)
-    if kind == "jordan":
+    if base == "ij":
+        s = 1.0 / 10 ** np.ceil(np.log10(max(n, 2)))
+        return E(m, n, dtype, lambda i, j: i + j * s)
+    if base == "jordan":
         return jnp.eye(m, n, dtype=dtype) + jnp.eye(m, n, k=1, dtype=dtype)
-    if kind == "minij":
-        i = jnp.arange(1, m + 1)[:, None]
-        j = jnp.arange(1, n + 1)[None, :]
-        return jnp.minimum(i, j).astype(dtype)
-    if kind == "hilb":
-        i = jnp.arange(m)[:, None]
-        j = jnp.arange(n)[None, :]
-        return (1.0 / (i + j + 1)).astype(dtype)
-    if kind == "gcdmat":
+    if base == "jordanT":
+        return jnp.eye(m, n, dtype=dtype) + jnp.eye(m, n, k=-1, dtype=dtype)
+    if base == "chebspec":
+        # nonsingular Chebyshev spectral differentiation matrix
+        # (generate_matrix_ge.cc:129-151)
+        pi = np.pi
+
+        def cheb(i, j):
+            x_i = jnp.cos(pi * (i + 1) / mx)
+            x_j = jnp.cos(pi * (j + 1) / mx)
+            c_i = jnp.where(i == mx - 1, 2.0, 1.0)
+            c_j = jnp.where(j == mx - 1, 2.0, 1.0)
+            sgn = jnp.where((i + j) % 2 == 0, 1.0, -1.0)
+            off = sgn * c_i / (c_j * jnp.where(i == j, 1.0, x_j - x_i))
+            diag_last = (2.0 * mx * mx + 1) / -6.0
+            diag = jnp.where(j + 1 == mx, diag_last,
+                             -0.5 * x_i / (1.0 - x_i * x_i))
+            return jnp.where(i == j, diag, off)
+
+        return E(m, n, dtype, cheb)
+    if base == "circul":
+        return E(m, n, dtype,
+                 lambda i, j: (j - i) % mx + 1)
+    if base == "fiedler":
+        return E(m, n, dtype, lambda i, j: jnp.abs(j - i))
+    if base == "gfpp":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            j == n - 1, 1.0, jnp.where(i > j, -1.0,
+                                       jnp.where(i == j, 0.5, 0.0))))
+    if base == "kms":
+        return E(m, n, dtype, lambda i, j: 0.5 ** jnp.abs(j - i))
+    if base == "orthog":
+        oc = np.sqrt(2.0 / (mx + 1))
+        ic = np.pi / (mx + 1)
+        return E(m, n, dtype,
+                 lambda i, j: oc * jnp.sin((i + 1.0) * (j + 1.0) * ic))
+    if base == "riemann":
+        # matches the reference's own formula (generate_matrix_ge.cc:
+        # riemann_entry: B_j % B_i == 0 → B_j − 1), which transposes the
+        # classic Higham gallery definition; parity with the reference
+        # wins here
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            (j + 2) % (i + 2) == 0, (j + 2) - 1, -1))
+    if base == "ris":
+        return E(m, n, dtype, lambda i, j: 0.5 / (mx - j - i - 0.5))
+    if base == "zielkeNS":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            j < i, 1.0, jnp.where((j + 1 == mx) & (i == 0), -1.0, 0.0)))
+    if base == "minij":
+        return E(m, n, dtype, lambda i, j: jnp.minimum(i, j) + 1)
+    if base == "hilb":
+        return E(m, n, dtype, lambda i, j: 1.0 / (i + j + 1))
+    if base == "frank":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i - j > 1, 0, jnp.where(i - j == 1, mx - j - 1, mx - j)))
+    if base == "lehmer":
+        return E(m, n, dtype, lambda i, j: (jnp.minimum(i, j) + 1.0)
+                 / (jnp.maximum(i, j) + 1.0))
+    if base == "lotkin":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i == 0, 1.0, 1.0 / (i + j + 1)))
+    if base == "redheff":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            ((j + 1) % (i + 1) == 0) | (j == 0), 1, 0))
+    if base == "triw":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i == j, 1, jnp.where(i > j, 0, -1)))
+    if base == "pei":
+        return E(m, n, dtype, lambda i, j: jnp.where(i == j, 2, 1))
+    if base == "tridiag":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i == j, 2, jnp.where(jnp.abs(i - j) == 1, -1, 0)))
+    if base == "toeppen":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            jnp.abs(j - i) == 1, (j - i) * 10.0,
+            jnp.where(jnp.abs(i - j) == 2, 1.0, 0.0)))
+    if base == "parter":
+        return E(m, n, dtype, lambda i, j: 1.0 / (i - j + 0.5))
+    if base == "moler":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i == j, i + 1.0, jnp.minimum(i, j) - 1.0))
+    if base == "cauchy":
+        return E(m, n, dtype, lambda i, j: 1.0 / (i + j + 2))
+    if base == "chow":
+        return E(m, n, dtype, lambda i, j: jnp.where(i - j < -1, 0, 1))
+    if base == "clement":
+        return E(m, n, dtype, lambda i, j: jnp.where(
+            i - j == 1, mx - j - 1.0, jnp.where(i - j == -1, j * 1.0, 0.0)))
+    if base == "gcdmat":
         i = jnp.arange(1, m + 1)[:, None]
         j = jnp.arange(1, n + 1)[None, :]
         return jnp.gcd(i, j).astype(dtype)
-    if kind == "toeppen":
-        # pentadiagonal Toeplitz [1, -10, 0, 10, 1]
-        a = jnp.zeros((m, n), dtype)
-        for off, v in ((-2, 1.0), (-1, -10.0), (1, 10.0), (2, 1.0)):
-            a = a + v * jnp.eye(m, n, k=off, dtype=dtype)
-        return a
 
-    dominant = kind.endswith("_dominant")
-    rkind = base
-    if rkind in ("rand", "rands", "randn", "randb"):
+    if base in ("rand", "rands", "randn", "randb", "randr"):
         gen = {"rand": rnd.uniform, "rands": rnd.uniform_signed,
-               "randn": rnd.normal, "randb": rnd.binary}[rkind]
+               "randn": rnd.normal, "randb": rnd.binary,
+               "randr": rnd.rademacher}[base]
         a = gen(seed, m, n, dtype)
-        if dominant:
-            a = a + k * jnp.eye(m, n, dtype=dtype)
         return a
 
     if base == "diag":
-        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed, sigma)
         return jnp.zeros((m, n), dtype).at[jnp.arange(k), jnp.arange(k)].set(
             sig.astype(dtype))
 
     if base == "svd":
-        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed, sigma)
         u = _random_orthogonal(seed, m, dtype)[:, :k]
         v = _random_orthogonal(seed + 1, n, dtype)[:, :k]
         return (u * sig[None, :].astype(dtype)) @ jnp.conj(v).T
 
     if base in ("heev", "syev"):
-        sig = _spectrum(spec or "logrand", k, cond, dtype, seed)
+        sig = _spectrum(spec or "logrand", k, cond, dtype, seed, sigma)
+        if (spec or "").startswith("r") and spec in (
+                "rlogrand", "rarith", "rgeo", "rcluster0", "rcluster1"):
+            # reference heev 'r' variants: random signs (rand_sign)
+            sign = jnp.where(jax.random.bernoulli(
+                jax.random.fold_in(jax.random.key(seed), 2), 0.5,
+                (k,)), 1.0, -1.0).astype(sig.dtype)
+            sig = sig * sign
         q = _random_orthogonal(seed, n, dtype)
         a = (q * sig[None, :].astype(dtype)) @ jnp.conj(q).T
         return 0.5 * (a + jnp.conj(a).T)
 
-    if base == "poev":
-        sig = jnp.abs(_spectrum(spec or "logrand", k, cond, dtype, seed))
+    if base in ("poev", "spd"):
+        sig = jnp.abs(_spectrum(spec or "logrand", k, cond, dtype, seed,
+                                sigma))
         q = _random_orthogonal(seed, n, dtype)
         a = (q * sig[None, :].astype(dtype)) @ jnp.conj(q).T
         return 0.5 * (a + jnp.conj(a).T)
@@ -168,7 +364,7 @@ def _generate_unscaled(kind: str, m: int, n: Optional[int],
         # nonsymmetric with prescribed eigenvalues (reference
         # generate_type_geev.hh): A = V·Λ·V⁻¹ with a well-conditioned
         # nonorthogonal V = I + ½·strict_lower(G)/√n
-        lam = _spectrum(spec or "logrand", n, cond, dtype, seed)
+        lam = _spectrum(spec or "logrand", n, cond, dtype, seed, sigma)
         g = rnd.normal(seed + 3, n, n, dtype)
         v = jnp.eye(n, dtype=dtype) + 0.5 * jnp.tril(g, -1) / jnp.sqrt(
             jnp.asarray(float(n), jnp.finfo(dtype).dtype)).astype(dtype)
@@ -176,7 +372,7 @@ def _generate_unscaled(kind: str, m: int, n: Optional[int],
         vl = v * lam[None, :].astype(dtype)
         return jnp.linalg.solve(v.T, vl.T).T
 
-    raise SlateError(f"unknown matrix kind '{kind}'")
+    raise SlateError(f"unknown matrix kind '{base}'")
 
 
 def random_spd(m: int, nb_unused: int = 0, dtype=jnp.float32, seed: int = 0,

@@ -53,3 +53,10 @@ def binary(seed: int, m: int, n: int, dtype):
     """'randb' kind: entries in {0, 1}."""
     bits = jax.random.bernoulli(_key(seed), 0.5, (m, n))
     return bits.astype(dtype)
+
+
+def rademacher(seed: int, m: int, n: int, dtype):
+    """'randr' kind: entries in {-1, +1} (reference Dist::UniformSigned
+    rounded — matgen random.hh randr)."""
+    bits = jax.random.bernoulli(_key(seed), 0.5, (m, n))
+    return jnp.where(bits, 1.0, -1.0).astype(dtype)
