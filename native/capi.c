@@ -1,13 +1,17 @@
-/* slate-tpu routine-level C API.
+/* slate-tpu routine-level C API: embedding helpers.
  *
  * Reference analog: the generated C API (tools/c_api/generate_*.py +
  * src/c_api/wrappers.cc) that exposes each driver as a C symbol.
  *
  * The TPU compute path lives in the Python/JAX runtime, so these
  * symbols embed the CPython interpreter (once, lazily) and dispatch to
- * slate_tpu.compat.lapack_api. Matrices are COLUMN-MAJOR double
- * buffers with leading dimension, LAPACK conventions; info is the
- * return value (0 = success, <0 = argument/runtime error).
+ * slate_tpu.compat.c_glue. Matrices are COLUMN-MAJOR buffers with
+ * leading dimension, LAPACK conventions; info is the return value
+ * (0 = success, <0 = argument/runtime error).
+ *
+ * The routine entry points themselves (s/d/c/z × gesv...lange) are
+ * GENERATED into capi_gen.c by tools/gen_capi.py — this file holds
+ * only the shared embedding machinery.
  *
  * Build: native/Makefile target libslate_tpu_capi.so (links
  * libpython). C callers:
@@ -16,13 +20,11 @@
  *     info = slate_tpu_dgesv(n, nrhs, a, lda, ipiv, b, ldb);
  */
 
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "capi_common.h"
 
-#include <stdint.h>
 #include <string.h>
 
-static int ensure_python(void) {
+int ensure_python(void) {
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
         /* release the GIL acquired by initialization so other threads
@@ -33,50 +35,19 @@ static int ensure_python(void) {
     return Py_IsInitialized() ? 0 : -100;
 }
 
-/* Run a compat call: fn_name(args...) where buffers are passed through
- * memoryviews; results are copied back into the caller's buffers by
- * the Python helper (slate_tpu.compat.c_glue). */
-static int call_glue(const char* fn, PyObject* args) {
-    PyGILState_STATE g = PyGILState_Ensure();
-    int rc = -101;
-    PyObject *mod = NULL, *f = NULL, *res = NULL;
-    mod = PyImport_ImportModule("slate_tpu.compat.c_glue");
-    if (!mod) goto done;
-    f = PyObject_GetAttrString(mod, fn);
-    if (!f) goto done;
-    res = PyObject_CallObject(f, args);
-    if (!res) goto done;
-    rc = (int)PyLong_AsLong(res);
-done:
-    if (PyErr_Occurred()) {
-        PyErr_Print();
-        if (rc >= 0) rc = -102;
+PyObject* stc_mv(void* p, int64_t bytes) {
+    if (!p) {
+        Py_INCREF(Py_None);
+        return Py_None;
     }
-    Py_XDECREF(res);
-    Py_XDECREF(f);
-    Py_XDECREF(mod);
-    PyGILState_Release(g);
-    return rc;
+    return PyMemoryView_FromMemory((char*)p, bytes, PyBUF_WRITE);
 }
 
-static PyObject* mv(double* p, int64_t count) {
-    return PyMemoryView_FromMemory((char*)p, count * (int64_t)sizeof(double),
-                                   PyBUF_WRITE);
-}
-
-static PyObject* mvi(int64_t* p, int64_t count) {
-    return PyMemoryView_FromMemory((char*)p, count * (int64_t)sizeof(int64_t),
-                                   PyBUF_WRITE);
-}
-
-/* Build the args tuple from up to four pre-made memoryviews using the
- * "O" format (Py_BuildValue takes its own reference), then drop ours —
- * so a failure anywhere leaks nothing (each view is DECREFed exactly
- * once here whether or not the tuple was built). Any pending error is
- * printed while the GIL is still held. */
-static PyObject* finish_args4(PyGILState_STATE g, PyObject* args,
-                              PyObject* v0, PyObject* v1, PyObject* v2,
-                              PyObject* v3) {
+PyObject* stc_finish(PyGILState_STATE g, PyObject* args, PyObject* v0,
+                     PyObject* v1, PyObject* v2, PyObject* v3) {
+    /* each view was given to Py_BuildValue with "O" (which takes its
+     * own reference), so dropping ours here leaks nothing whether or
+     * not the tuple was built */
     Py_XDECREF(v0);
     Py_XDECREF(v1);
     Py_XDECREF(v2);
@@ -86,210 +57,27 @@ static PyObject* finish_args4(PyGILState_STATE g, PyObject* args,
     return args;
 }
 
-static PyObject* finish_args(PyGILState_STATE g, PyObject* args,
-                             PyObject* v0, PyObject* v1, PyObject* v2) {
-    return finish_args4(g, args, v0, v1, v2, NULL);
-}
-
-/* Dispatch one pre-built args tuple to a c_glue function and clean up. */
-static int64_t run_glue(const char* fn, PyObject* args) {
+int64_t stc_run(const char* fn, PyObject* args) {
     if (!args) return -103;
-    int rc = call_glue(fn, args);
     PyGILState_STATE g = PyGILState_Ensure();
+    int64_t rc = -101;
+    PyObject *mod = NULL, *f = NULL, *res = NULL;
+    mod = PyImport_ImportModule("slate_tpu.compat.c_glue");
+    if (!mod) goto done;
+    f = PyObject_GetAttrString(mod, fn);
+    if (!f) goto done;
+    res = PyObject_CallObject(f, args);
+    if (!res) goto done;
+    rc = (int64_t)PyLong_AsLongLong(res);
+done:
+    if (PyErr_Occurred()) {
+        PyErr_Print();
+        if (rc >= 0) rc = -102;
+    }
+    Py_XDECREF(res);
+    Py_XDECREF(f);
+    Py_XDECREF(mod);
     Py_DECREF(args);
     PyGILState_Release(g);
     return rc;
-}
-
-int64_t slate_tpu_dgesv(int64_t n, int64_t nrhs, double* a, int64_t lda,
-                        int64_t* ipiv, double* b, int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    /* short-circuit after a NULL: calling further C-API constructors
-     * with an exception pending is undefined (asserts on debug builds) */
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvp = mva ? mvi(ipiv, n) : NULL;
-    PyObject* mvb = mvp ? mv(b, ldb * nrhs) : NULL;
-    PyObject* args = (mva && mvp && mvb)
-        ? Py_BuildValue("(LLOLOOL)", (long long)n, (long long)nrhs, mva,
-                        (long long)lda, mvp, mvb, (long long)ldb)
-        : NULL;
-    return run_glue("c_dgesv", finish_args(g, args, mva, mvp, mvb));
-}
-
-int64_t slate_tpu_dpotrf(const char* uplo, int64_t n, double* a,
-                         int64_t lda) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* args = mva
-        ? Py_BuildValue("(sLOL)", uplo, (long long)n, mva, (long long)lda)
-        : NULL;
-    return run_glue("c_dpotrf", finish_args(g, args, mva, NULL, NULL));
-}
-
-int64_t slate_tpu_dposv(const char* uplo, int64_t n, int64_t nrhs,
-                        double* a, int64_t lda, double* b, int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvb = mva ? mv(b, ldb * nrhs) : NULL;
-    PyObject* args = (mva && mvb)
-        ? Py_BuildValue("(sLLOLOL)", uplo, (long long)n, (long long)nrhs,
-                        mva, (long long)lda, mvb, (long long)ldb)
-        : NULL;
-    return run_glue("c_dposv", finish_args(g, args, mva, mvb, NULL));
-}
-
-int64_t slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double* a,
-                        int64_t lda, double* b, int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvb = mva ? mv(b, ldb * nrhs) : NULL;
-    PyObject* args = (mva && mvb)
-        ? Py_BuildValue("(LLLOLOL)", (long long)m, (long long)n,
-                        (long long)nrhs, mva, (long long)lda, mvb,
-                        (long long)ldb)
-        : NULL;
-    return run_glue("c_dgels", finish_args(g, args, mva, mvb, NULL));
-}
-
-int64_t slate_tpu_dgetrf(int64_t m, int64_t n, double* a, int64_t lda,
-                         int64_t* ipiv) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    int64_t k = m < n ? m : n;
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvp = mva ? mvi(ipiv, k) : NULL;
-    PyObject* args = (mva && mvp)
-        ? Py_BuildValue("(LLOLO)", (long long)m, (long long)n, mva,
-                        (long long)lda, mvp)
-        : NULL;
-    return run_glue("c_dgetrf", finish_args(g, args, mva, mvp, NULL));
-}
-
-int64_t slate_tpu_dgetrs(const char* trans, int64_t n, int64_t nrhs,
-                         double* a, int64_t lda, int64_t* ipiv, double* b,
-                         int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvp = mva ? mvi(ipiv, n) : NULL;
-    PyObject* mvb = mvp ? mv(b, ldb * nrhs) : NULL;
-    PyObject* args = (mva && mvp && mvb)
-        ? Py_BuildValue("(sLLOLOOL)", trans, (long long)n, (long long)nrhs,
-                        mva, (long long)lda, mvp, mvb, (long long)ldb)
-        : NULL;
-    return run_glue("c_dgetrs", finish_args(g, args, mva, mvp, mvb));
-}
-
-int64_t slate_tpu_dpotrs(const char* uplo, int64_t n, int64_t nrhs,
-                         double* a, int64_t lda, double* b, int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvb = mva ? mv(b, ldb * nrhs) : NULL;
-    PyObject* args = (mva && mvb)
-        ? Py_BuildValue("(sLLOLOL)", uplo, (long long)n, (long long)nrhs,
-                        mva, (long long)lda, mvb, (long long)ldb)
-        : NULL;
-    return run_glue("c_dpotrs", finish_args(g, args, mva, mvb, NULL));
-}
-
-int64_t slate_tpu_dsyev(const char* jobz, const char* uplo, int64_t n,
-                        double* a, int64_t lda, double* w) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvw = mva ? mv(w, n) : NULL;
-    PyObject* args = (mva && mvw)
-        ? Py_BuildValue("(ssLOLO)", jobz, uplo, (long long)n, mva,
-                        (long long)lda, mvw)
-        : NULL;
-    return run_glue("c_dsyev", finish_args(g, args, mva, mvw, NULL));
-}
-
-int64_t slate_tpu_dgesvd(const char* jobu, const char* jobvt, int64_t m,
-                         int64_t n, double* a, int64_t lda, double* s,
-                         double* u, int64_t ldu, double* vt, int64_t ldvt) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    int64_t k = m < n ? m : n;
-    /* thin ('S') and values-only ('N') jobs only: 'A' (full square U/VT)
-     * and 'O' (overwrite A) are not provided by the thin-SVD driver —
-     * reject them instead of writing a partial result */
-    if (jobu && (jobu[0] == 'a' || jobu[0] == 'A'
-                 || jobu[0] == 'o' || jobu[0] == 'O')) return -1;
-    if (jobvt && (jobvt[0] == 'a' || jobvt[0] == 'A'
-                  || jobvt[0] == 'o' || jobvt[0] == 'O')) return -2;
-    int want_u = jobu && (jobu[0] == 's' || jobu[0] == 'S');
-    int want_v = jobvt && (jobvt[0] == 's' || jobvt[0] == 'S');
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvs = mva ? mv(s, k) : NULL;
-    PyObject* mvu = NULL;
-    PyObject* mvv = NULL;
-    PyObject* args = NULL;
-    if (mvs) {
-        mvu = want_u ? mv(u, ldu * k) : (Py_INCREF(Py_None), Py_None);
-        mvv = mvu && want_v ? mv(vt, ldvt * n)
-                            : (mvu ? (Py_INCREF(Py_None), Py_None) : NULL);
-    }
-    if (mva && mvs && mvu && mvv)
-        args = Py_BuildValue("(ssLLOLOOLOL)", jobu, jobvt, (long long)m,
-                             (long long)n, mva, (long long)lda, mvs, mvu,
-                             (long long)ldu, mvv, (long long)ldvt);
-    return run_glue("c_dgesvd", finish_args4(g, args, mva, mvs, mvu, mvv));
-}
-
-int64_t slate_tpu_dgemm(const char* transa, const char* transb, int64_t m,
-                        int64_t n, int64_t k, double alpha, double* a,
-                        int64_t lda, double* b, int64_t ldb, double beta,
-                        double* c, int64_t ldc) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    int64_t cols_a = (transa[0] == 'n' || transa[0] == 'N') ? k : m;
-    int64_t cols_b = (transb[0] == 'n' || transb[0] == 'N') ? n : k;
-    PyObject* mva = mv(a, lda * cols_a);
-    PyObject* mvb = mva ? mv(b, ldb * cols_b) : NULL;
-    PyObject* mvc = mvb ? mv(c, ldc * n) : NULL;
-    PyObject* args = (mva && mvb && mvc)
-        ? Py_BuildValue("(ssLLLdOLOLdOL)", transa, transb, (long long)m,
-                        (long long)n, (long long)k, alpha, mva,
-                        (long long)lda, mvb, (long long)ldb, beta, mvc,
-                        (long long)ldc)
-        : NULL;
-    return run_glue("c_dgemm", finish_args(g, args, mva, mvb, mvc));
-}
-
-int64_t slate_tpu_dtrsm(const char* side, const char* uplo,
-                        const char* transa, const char* diag, int64_t m,
-                        int64_t n, double alpha, double* a, int64_t lda,
-                        double* b, int64_t ldb) {
-    if (ensure_python()) return -100;
-    PyGILState_STATE g = PyGILState_Ensure();
-    int64_t ka = (side[0] == 'l' || side[0] == 'L') ? m : n;
-    PyObject* mva = mv(a, lda * ka);
-    PyObject* mvb = mva ? mv(b, ldb * n) : NULL;
-    PyObject* args = (mva && mvb)
-        ? Py_BuildValue("(ssssLLdOLOL)", side, uplo, transa, diag,
-                        (long long)m, (long long)n, alpha, mva,
-                        (long long)lda, mvb, (long long)ldb)
-        : NULL;
-    return run_glue("c_dtrsm", finish_args(g, args, mva, mvb, NULL));
-}
-
-double slate_tpu_dlange(const char* norm, int64_t m, int64_t n, double* a,
-                        int64_t lda) {
-    if (ensure_python()) return -1.0;
-    PyGILState_STATE g = PyGILState_Ensure();
-    double out = -1.0;
-    PyObject* mva = mv(a, lda * n);
-    PyObject* mvo = mva ? mv(&out, 1) : NULL;
-    PyObject* args = (mva && mvo)
-        ? Py_BuildValue("(sLLOLO)", norm, (long long)m, (long long)n, mva,
-                        (long long)lda, mvo)
-        : NULL;
-    int64_t rc = run_glue("c_dlange", finish_args(g, args, mva, mvo, NULL));
-    return rc == 0 ? out : -1.0;
 }

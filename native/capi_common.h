@@ -1,0 +1,33 @@
+/* Shared embedding helpers for the slate-tpu C API (implemented in
+ * capi.c, used by the generated capi_gen.c). */
+#ifndef SLATE_TPU_CAPI_COMMON_H
+#define SLATE_TPU_CAPI_COMMON_H
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Lazily initialize the embedded interpreter; 0 on success. */
+int ensure_python(void);
+
+/* Writable memoryview over caller memory; NULL pointer maps to
+ * Py_None (optional buffers, e.g. gesvd with jobu='n'). */
+PyObject* stc_mv(void* p, int64_t bytes);
+
+/* Drop up to four view references, print pending errors, release the
+ * GIL, and pass the args tuple through (possibly NULL). */
+PyObject* stc_finish(PyGILState_STATE g, PyObject* args, PyObject* v0,
+                     PyObject* v1, PyObject* v2, PyObject* v3);
+
+/* Call slate_tpu.compat.c_glue.<fn>(*args) and return its int result
+ * (negative on embedding/Python failure). Consumes args. */
+int64_t stc_run(const char* fn, PyObject* args);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

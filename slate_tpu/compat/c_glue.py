@@ -1,10 +1,14 @@
-"""Glue between the embedded-interpreter C API (native/capi.c) and the
-Python drivers: unpack C memoryviews (column-major, LAPACK layout),
-call the compat lapack_api, copy results back into the caller's
-buffers, and return info.
+"""Glue between the embedded-interpreter C API (native/capi.c +
+generated native/capi_gen.c) and the Python drivers: unpack C
+memoryviews (column-major, LAPACK layout), call the compat lapack_api,
+copy results back into the caller's buffers, and return info.
 
-Reference analog: src/c_api/wrappers.cc (the hand-written core of the
-generated C API).
+Every entry point is dtype-generic: the first argument ``dt`` is the
+LAPACK precision letter (s/d/c/z) baked into the generated C symbol
+(slate_tpu_sgesv passes "s", ...). Reference analog:
+src/c_api/wrappers.cc — the hand-written core that the generated C API
+(tools/c_api/generate_wrappers.py) dispatches into; our generator is
+tools/gen_capi.py.
 """
 
 from __future__ import annotations
@@ -18,29 +22,40 @@ from .platform import apply_env_platforms
 
 apply_env_platforms()
 
+_DT = {"s": np.float32, "d": np.float64,
+       "c": np.complex64, "z": np.complex128}
+_RDT = {"s": np.float32, "d": np.float64,
+        "c": np.float32, "z": np.float64}
 
-def _as_cm(buf, rows, ld, cols, dtype=np.float64):
+
+def _as_cm(buf, rows, ld, cols, dtype):
     """View a C memoryview as a column-major (rows, cols) array slice."""
     flat = np.frombuffer(buf, dtype=dtype)
     full = flat[: ld * cols].reshape((cols, ld)).T  # (ld, cols) col-major
     return full[:rows, :]
 
 
-def c_dgesv(n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    b = _as_cm(b_buf, n, ldb, nrhs)
-    lu, ipiv, x, info = lp.dgesv(n, nrhs, np.array(a), lda and n, b, n)
+def _lp():
+    from . import lapack_api
+    return lapack_api
+
+
+def c_gesv(dt, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    lu, ipiv, x, info = getattr(_lp(), dt + "gesv")(
+        n, nrhs, np.array(a), n, b, n)
     a[:, :] = lu
     b[:, :] = x
     np.frombuffer(ipiv_buf, dtype=np.int64)[:n] = ipiv
     return int(info)
 
 
-def c_dpotrf(uplo, n, a_buf, lda) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    f, info = lp.dpotrf(uplo, n, np.array(a), n)
+def c_potrf(dt, uplo, n, a_buf, lda) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    f, info = getattr(_lp(), dt + "potrf")(uplo, n, np.array(a), n)
     if uplo.lower().startswith("l"):
         a[:, :] = np.tril(f) + np.triu(np.array(a), 1)
     else:
@@ -48,114 +63,173 @@ def c_dpotrf(uplo, n, a_buf, lda) -> int:
     return int(info)
 
 
-def c_dposv(uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    b = _as_cm(b_buf, n, ldb, nrhs)
-    x, info = lp.dposv(uplo, n, nrhs, np.array(a), n, np.array(b), n)
+def c_posv(dt, uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    x, info = getattr(_lp(), dt + "posv")(
+        uplo, n, nrhs, np.array(a), n, np.array(b), n)
     b[:, :] = x
     return int(info)
 
 
-def c_dgels(m, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, m, lda, n)
-    b = _as_cm(b_buf, max(m, n), ldb, nrhs)
-    x, info = lp.dgels("n", m, n, nrhs, np.array(a), m,
-                       np.array(b[:m]), m)
+def c_gels(dt, m, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    b = _as_cm(b_buf, max(m, n), ldb, nrhs, et)
+    x, info = getattr(_lp(), dt + "gels")(
+        "n", m, n, nrhs, np.array(a), m, np.array(b[:m]), m)
     if info != 0:  # driver failure: report info, leave b untouched
         return int(info)
     b[:n, :] = x
     return int(info)
 
 
-def c_dgetrf(m, n, a_buf, lda, ipiv_buf) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, m, lda, n)
-    lu, ipiv, info = lp.dgetrf(m, n, np.array(a), m)
+def c_getrf(dt, m, n, a_buf, lda, ipiv_buf) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    lu, ipiv, info = getattr(_lp(), dt + "getrf")(m, n, np.array(a), m)
     a[:, :] = lu
     k = min(m, n)
     np.frombuffer(ipiv_buf, dtype=np.int64)[:k] = ipiv[:k]
     return int(info)
 
 
-def c_dgetrs(trans, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    b = _as_cm(b_buf, n, ldb, nrhs)
+def c_getrs(dt, trans, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
     ipiv = np.array(np.frombuffer(ipiv_buf, dtype=np.int64)[:n])
-    x, info = lp.dgetrs(trans, n, nrhs, np.array(a), n, ipiv,
-                        np.array(b), n)
+    x, info = getattr(_lp(), dt + "getrs")(
+        trans, n, nrhs, np.array(a), n, ipiv, np.array(b), n)
     b[:, :] = x
     return int(info)
 
 
-def c_dpotrs(uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    b = _as_cm(b_buf, n, ldb, nrhs)
-    x, info = lp.dpotrs(uplo, n, nrhs, np.array(a), n, np.array(b), n)
+def c_getri(dt, n, a_buf, lda, ipiv_buf) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    ipiv = np.array(np.frombuffer(ipiv_buf, dtype=np.int64)[:n])
+    inv, info = getattr(_lp(), dt + "getri")(n, np.array(a), n, ipiv)
+    a[:, :] = inv
+    return int(info)
+
+
+def c_potrs(dt, uplo, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    x, info = getattr(_lp(), dt + "potrs")(
+        uplo, n, nrhs, np.array(a), n, np.array(b), n)
     b[:, :] = x
     return int(info)
 
 
-def c_dsyev(jobz, uplo, n, a_buf, lda, w_buf) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, n, lda, n)
-    w, z, info = lp.dsyev(jobz, uplo, n, np.array(a), n)
-    np.frombuffer(w_buf, dtype=np.float64)[:n] = np.asarray(w)
+def c_heev(dt, jobz, uplo, n, a_buf, lda, w_buf) -> int:
+    et = _DT[dt]
+    name = dt + ("syev" if dt in "sd" else "heev")
+    a = _as_cm(a_buf, n, lda, n, et)
+    w, z, info = getattr(_lp(), name)(jobz, uplo, n, np.array(a), n)
+    np.frombuffer(w_buf, dtype=_RDT[dt])[:n] = np.asarray(w)
     if z is not None:
         a[:, :] = z  # LAPACK: eigenvectors overwrite A when jobz='V'
     return int(info)
 
 
-def c_dgesvd(jobu, jobvt, m, n, a_buf, lda, s_buf, u_buf, ldu, vt_buf,
-             ldvt) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, m, lda, n)
-    s, u, vt, info = lp.dgesvd(jobu, jobvt, m, n, np.array(a), m)
+def c_gesvd(dt, jobu, jobvt, m, n, a_buf, lda, s_buf, u_buf, ldu, vt_buf,
+            ldvt) -> int:
+    # thin ('S') and values-only ('N') jobs only: 'A' (full square U/VT)
+    # and 'O' (overwrite A) would leave part of the caller's buffers
+    # uninitialized — reject loudly instead of returning a partial
+    # result with rc=0 (the pre-generator C wrapper did the same)
+    if jobu and jobu[:1].lower() in ("a", "o"):
+        return -1
+    if jobvt and jobvt[:1].lower() in ("a", "o"):
+        return -2
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    s, u, vt, info = getattr(_lp(), dt + "gesvd")(
+        jobu, jobvt, m, n, np.array(a), m)
     if info:
         return int(info)
     k = min(m, n)
-    np.frombuffer(s_buf, dtype=np.float64)[:k] = np.asarray(s)[:k]
+    np.frombuffer(s_buf, dtype=_RDT[dt])[:k] = np.asarray(s)[:k]
     if u is not None and u_buf is not None:
-        _as_cm(u_buf, m, ldu, k)[:, :] = np.asarray(u)[:m, :k]
+        _as_cm(u_buf, m, ldu, k, et)[:, :] = np.asarray(u)[:m, :k]
     if vt is not None and vt_buf is not None:
-        _as_cm(vt_buf, k, ldvt, n)[:, :] = np.asarray(vt)[:k, :n]
+        _as_cm(vt_buf, k, ldvt, n, et)[:, :] = np.asarray(vt)[:k, :n]
     return 0
 
 
-def c_dgemm(transa, transb, m, n, k, alpha, a_buf, lda, b_buf, ldb, beta,
-            c_buf, ldc) -> int:
-    from . import lapack_api as lp
+def c_gemm(dt, transa, transb, m, n, k, alpha, a_buf, lda, b_buf, ldb,
+           beta, c_buf, ldc) -> int:
+    et = _DT[dt]
     rows_a = m if transa.lower().startswith("n") else k
     cols_a = k if transa.lower().startswith("n") else m
     rows_b = k if transb.lower().startswith("n") else n
     cols_b = n if transb.lower().startswith("n") else k
-    a = _as_cm(a_buf, rows_a, lda, cols_a)
-    b = _as_cm(b_buf, rows_b, ldb, cols_b)
-    c = _as_cm(c_buf, m, ldc, n)
-    out = lp.dgemm(transa, transb, m, n, k, alpha, np.array(a), rows_a,
-                   np.array(b), rows_b, beta, np.array(c), m)
+    a = _as_cm(a_buf, rows_a, lda, cols_a, et)
+    b = _as_cm(b_buf, rows_b, ldb, cols_b, et)
+    c = _as_cm(c_buf, m, ldc, n, et)
+    out = getattr(_lp(), dt + "gemm")(
+        transa, transb, m, n, k, alpha, np.array(a), rows_a,
+        np.array(b), rows_b, beta, np.array(c), m)
     c[:, :] = out
     return 0
 
 
-def c_dtrsm(side, uplo, transa, diag, m, n, alpha, a_buf, lda, b_buf,
-            ldb) -> int:
-    from . import lapack_api as lp
+def c_trsm(dt, side, uplo, transa, diag, m, n, alpha, a_buf, lda, b_buf,
+           ldb) -> int:
+    et = _DT[dt]
     ka = m if side.lower().startswith("l") else n
-    a = _as_cm(a_buf, ka, lda, ka)
-    b = _as_cm(b_buf, m, ldb, n)
-    out = lp.dtrsm(side, uplo, transa, diag, m, n, alpha, np.array(a), ka,
-                   np.array(b), m)
+    a = _as_cm(a_buf, ka, lda, ka, et)
+    b = _as_cm(b_buf, m, ldb, n, et)
+    out = getattr(_lp(), dt + "trsm")(
+        side, uplo, transa, diag, m, n, alpha, np.array(a), ka,
+        np.array(b), m)
     b[:, :] = out
     return 0
 
 
-def c_dlange(norm, m, n, a_buf, lda, out_buf) -> int:
-    from . import lapack_api as lp
-    a = _as_cm(a_buf, m, lda, n)
-    np.frombuffer(out_buf, dtype=np.float64)[0] = lp.dlange(
-        norm, m, n, np.array(a), m)
+def c_trmm(dt, side, uplo, transa, diag, m, n, alpha, a_buf, lda, b_buf,
+           ldb) -> int:
+    et = _DT[dt]
+    ka = m if side.lower().startswith("l") else n
+    a = _as_cm(a_buf, ka, lda, ka, et)
+    b = _as_cm(b_buf, m, ldb, n, et)
+    out = getattr(_lp(), dt + "trmm")(
+        side, uplo, transa, diag, m, n, alpha, np.array(a), ka,
+        np.array(b), m)
+    b[:, :] = out
     return 0
+
+
+def c_lange(dt, norm, m, n, a_buf, lda, out_buf) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    np.frombuffer(out_buf, dtype=np.float64)[0] = float(
+        getattr(_lp(), dt + "lange")(norm, m, n, np.array(a), m))
+    return 0
+
+
+# --- legacy d-only aliases (pre-round-4 symbol names; kept so older
+# compiled callers of c_dgesv etc. keep working) ---------------------------
+
+def _legacy(fn, dt="d"):
+    def wrap(*args):
+        return fn(dt, *args)
+    return wrap
+
+
+c_dgesv = _legacy(c_gesv)
+c_dpotrf = _legacy(c_potrf)
+c_dposv = _legacy(c_posv)
+c_dgels = _legacy(c_gels)
+c_dgetrf = _legacy(c_getrf)
+c_dgetrs = _legacy(c_getrs)
+c_dpotrs = _legacy(c_potrs)
+c_dsyev = _legacy(c_heev)
+c_dgesvd = _legacy(c_gesvd)
+c_dgemm = _legacy(c_gemm)
+c_dtrsm = _legacy(c_trsm)
+c_dlange = _legacy(c_lange)

@@ -1,0 +1,265 @@
+#!/usr/bin/env python3
+"""Generate the full-precision C API surface (s/d/c/z) from one routine
+table — the same trick the reference uses (tools/c_api/generate_*.py
+emits include/slate/c_api/* and wrappers from the C++ headers).
+
+Emits:
+  native/capi_gen.c          — entry points for every (routine, dtype)
+  include/slate_tpu_capi_gen.h — prototypes (included by slate_tpu_capi.h)
+  fortran/slate_tpu.f90      — BIND(C) interface module, all precisions
+
+Run from the repo root:  python tools/gen_capi.py
+The generated files are committed (like the reference ships generated
+headers) so users without the generator still build.
+
+Argument spec mini-language per routine (expanded per dtype):
+  i:<name>       int64 scalar
+  s:<name>       const char* (single-letter LAPACK mode string)
+  x:<name>       scalar of the matrix dtype (alpha/beta)
+  A:<name>:<cnt> matrix buffer of the dtype, <cnt> elements (C expr)
+  R:<name>:<cnt> real-typed buffer (w/sigma; float for s/c, double d/z)
+  P:<name>:<cnt> int64 pivot buffer
+"""
+
+import os
+
+ROUTINES = [
+    # (base, heev_rename, [args])  — heev_rename: s/d use syev name
+    ("gesv", None, ["i:n", "i:nrhs", "A:a:lda*n", "i:lda", "P:ipiv:n",
+                    "A:b:ldb*nrhs", "i:ldb"]),
+    ("potrf", None, ["s:uplo", "i:n", "A:a:lda*n", "i:lda"]),
+    ("posv", None, ["s:uplo", "i:n", "i:nrhs", "A:a:lda*n", "i:lda",
+                    "A:b:ldb*nrhs", "i:ldb"]),
+    ("gels", None, ["i:m", "i:n", "i:nrhs", "A:a:lda*n", "i:lda",
+                    "A:b:ldb*nrhs", "i:ldb"]),
+    ("getrf", None, ["i:m", "i:n", "A:a:lda*n", "i:lda",
+                     "P:ipiv:(m<n?m:n)"]),
+    ("getrs", None, ["s:trans", "i:n", "i:nrhs", "A:a:lda*n", "i:lda",
+                     "P:ipiv:n", "A:b:ldb*nrhs", "i:ldb"]),
+    ("getri", None, ["i:n", "A:a:lda*n", "i:lda", "P:ipiv:n"]),
+    ("potrs", None, ["s:uplo", "i:n", "i:nrhs", "A:a:lda*n", "i:lda",
+                     "A:b:ldb*nrhs", "i:ldb"]),
+    ("heev", {"s": "ssyev", "d": "dsyev", "c": "cheev", "z": "zheev"},
+     ["s:jobz", "s:uplo", "i:n", "A:a:lda*n", "i:lda", "R:w:n"]),
+    ("gesvd", None, ["s:jobu", "s:jobvt", "i:m", "i:n", "A:a:lda*n",
+                     "i:lda", "R:s:(m<n?m:n)", "A:u:ldu*(m<n?m:n)",
+                     "i:ldu", "A:vt:ldvt*n", "i:ldvt"]),
+    ("gemm", None, ["s:transa", "s:transb", "i:m", "i:n", "i:k", "x:alpha",
+                    "A:a:lda*((transa[0]=='n'||transa[0]=='N')?k:m)",
+                    "i:lda",
+                    "A:b:ldb*((transb[0]=='n'||transb[0]=='N')?n:k)",
+                    "i:ldb", "x:beta", "A:c:ldc*n", "i:ldc"]),
+    ("trsm", None, ["s:side", "s:uplo", "s:transa", "s:diag", "i:m", "i:n",
+                    "x:alpha",
+                    "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda",
+                    "A:b:ldb*n", "i:ldb"]),
+    ("trmm", None, ["s:side", "s:uplo", "s:transa", "s:diag", "i:m", "i:n",
+                    "x:alpha",
+                    "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda",
+                    "A:b:ldb*n", "i:ldb"]),
+    ("lange", None, ["s:norm", "i:m", "i:n", "A:a:lda*n", "i:lda"]),
+]
+
+CTYPE = {"s": "float", "d": "double",
+         "c": "float _Complex", "z": "double _Complex"}
+RTYPE = {"s": "float", "d": "double", "c": "float", "z": "double"}
+ESIZE = {"s": 4, "d": 8, "c": 8, "z": 16}
+RSIZE = {"s": 4, "d": 8, "c": 4, "z": 8}
+FTYPE = {"s": "real(c_float)", "d": "real(c_double)",
+         "c": "complex(c_float_complex)", "z": "complex(c_double_complex)"}
+FRTYPE = {"s": "real(c_float)", "d": "real(c_double)",
+          "c": "real(c_float)", "z": "real(c_double)"}
+
+
+def _parse(a):
+    parts = a.split(":", 2)
+    return (parts[0], parts[1], parts[2] if len(parts) > 2 else None)
+
+
+def c_sig(base, dt, args):
+    ps = []
+    for kind, name, _cnt in map(_parse, args):
+        if kind == "i":
+            ps.append(f"int64_t {name}")
+        elif kind == "s":
+            ps.append(f"const char* {name}")
+        elif kind == "x":
+            ps.append(f"{CTYPE[dt]} {name}")
+        elif kind == "A":
+            ps.append(f"{CTYPE[dt]}* {name}")
+        elif kind == "R":
+            ps.append(f"{RTYPE[dt]}* {name}")
+        elif kind == "P":
+            ps.append(f"int64_t* {name}")
+    ret = "double" if base == "lange" else "int64_t"
+    return f"{ret} slate_tpu_{dt}{base}({', '.join(ps)})"
+
+
+def c_body(base, dt, args, glue):
+    lines = []
+    lines.append("    if (ensure_python()) return -100;")
+    lines.append("    PyGILState_STATE g = PyGILState_Ensure();")
+    views = []
+    prev = None
+    for kind, name, cnt in map(_parse, args):
+        if kind in ("A", "R", "P"):
+            es = {"A": ESIZE[dt], "R": RSIZE[dt], "P": 8}[kind]
+            guard = f"{prev} ? " if prev else ""
+            alt = " : NULL" if prev else ""
+            lines.append(
+                f"    PyObject* mv_{name} = {guard}stc_mv({name}, "
+                f"({cnt}) * (int64_t){es}){alt};")
+            views.append(f"mv_{name}")
+            prev = f"mv_{name}"
+    # build format string + value list; first arg is the dtype letter
+    fmt = ["s"]
+    vals = [f'"{dt}"']
+    for kind, name, _cnt in map(_parse, args):
+        if kind == "i":
+            fmt.append("L")
+            vals.append(f"(long long){name}")
+        elif kind == "s":
+            fmt.append("s")
+            vals.append(name)
+        elif kind == "x":
+            if dt in "cz":
+                fmt.append("D")
+                lines.append(
+                    f"    Py_complex pc_{name} = "
+                    f"{{ creal({name}), cimag({name}) }};")
+                vals.append(f"&pc_{name}")
+            else:
+                fmt.append("d")
+                vals.append(f"(double){name}")
+        else:
+            fmt.append("O")
+            vals.append(f"mv_{name}")
+    cond = " && ".join(views) if views else "1"
+    lines.append(f"    PyObject* args = ({cond})")
+    lines.append(f"        ? Py_BuildValue(\"({''.join(fmt)})\", "
+                 f"{', '.join(vals)})")
+    lines.append("        : NULL;")
+    drops = ", ".join(views + ["NULL"] * (4 - len(views)))
+    if base == "lange":
+        # lange returns the norm through a 1-element out buffer appended
+        # to the args tuple
+        lines.insert(2, "    double out = -1.0;")
+        lines.append("    PyObject* mv_out = stc_mv(&out, 8);")
+        lines.append("    PyObject* args2 = NULL;")
+        lines.append("    if (args && mv_out) {")
+        lines.append("        PyObject* tail = Py_BuildValue(\"(O)\", "
+                     "mv_out);")
+        lines.append("        if (tail) {")
+        lines.append("            args2 = PySequence_Concat(args, tail);")
+        lines.append("            Py_DECREF(tail);")
+        lines.append("        }")
+        lines.append("    }")
+        lines.append("    Py_XDECREF(args);")
+        drops = ", ".join(views + ["mv_out"]
+                          + ["NULL"] * (3 - len(views)))
+        lines.append(f"    int64_t rc = stc_run(\"{glue}\", "
+                     f"stc_finish(g, args2, {drops}));")
+        lines.append("    return rc == 0 ? out : -1.0;")
+    else:
+        lines.append(f"    return stc_run(\"{glue}\", "
+                     f"stc_finish(g, args, {drops}));")
+    return "\n".join(lines)
+
+
+def fortran_iface(base, dt, args):
+    name = f"slate_tpu_{dt}{base}"
+    fargs = []
+    decls = []
+    for kind, aname, _cnt in map(_parse, args):
+        fargs.append(aname)
+        if kind == "i":
+            decls.append(f"         integer(c_int64_t), value :: {aname}")
+        elif kind == "s":
+            decls.append(f"         character(kind=c_char), dimension(*)"
+                         f" :: {aname}")
+        elif kind == "x":
+            decls.append(f"         {FTYPE[dt]}, value :: {aname}")
+        elif kind == "A":
+            decls.append(f"         {FTYPE[dt]}, dimension(*) :: {aname}")
+        elif kind == "R":
+            decls.append(f"         {FRTYPE[dt]}, dimension(*) :: {aname}")
+        elif kind == "P":
+            decls.append(f"         integer(c_int64_t), dimension(*)"
+                         f" :: {aname}")
+    ret = "real(c_double)" if base == "lange" else "integer(c_int64_t)"
+    arglist = ", ".join(fargs)
+    head = f"      function {name}({arglist}) &"
+    lines = [head,
+             f"            bind(c, name=\"{name}\") result(r)",
+             "         import :: c_int64_t, c_double, c_float, c_char, &",
+             "            c_float_complex, c_double_complex"]
+    lines += decls
+    lines.append(f"         {ret} :: r")
+    lines.append(f"      end function {name}")
+    return "\n".join(lines)
+
+
+def main():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cs = ['/* GENERATED by tools/gen_capi.py — do not edit.',
+          ' *',
+          ' * Full-precision (s/d/c/z) routine-level C API; dispatches',
+          ' * into slate_tpu.compat.c_glue through the shared embedding',
+          ' * helpers in capi.c. Reference analog: the generated',
+          ' * src/c_api/wrappers.cc surface. */',
+          '#define PY_SSIZE_T_CLEAN',
+          '#include <Python.h>',
+          '#include <stdint.h>',
+          '#include <complex.h>',
+          '#include "capi_common.h"',
+          '']
+    hs = ['/* GENERATED by tools/gen_capi.py — do not edit. */',
+          '#ifndef SLATE_TPU_CAPI_GEN_H',
+          '#define SLATE_TPU_CAPI_GEN_H',
+          '#include <stdint.h>',
+          '#include <complex.h>',
+          '#ifdef __cplusplus',
+          'extern "C" {',
+          '#endif',
+          '']
+    fs = ['! GENERATED by tools/gen_capi.py — do not edit.',
+          '! Fortran 2003 BIND(C) interfaces for the slate-tpu C API,',
+          '! all four precisions (reference analog: the generated',
+          '! Fortran module, tools/fortran/).',
+          'module slate_tpu',
+          '   use iso_c_binding, only: c_int64_t, c_double, c_float, &',
+          '      c_char, c_float_complex, c_double_complex',
+          '   implicit none',
+          '   interface',
+          '']
+    for base, rename, args in ROUTINES:
+        for dt in "sdcz":
+            sym = (rename[dt] if rename else dt + base)
+            sig = c_sig(base, dt, args).replace(
+                f"slate_tpu_{dt}{base}", f"slate_tpu_{sym}")
+            glue = "c_" + base
+            body = c_body(base, dt, args, glue)
+            cs.append(sig + " {")
+            cs.append(body)
+            cs.append("}")
+            cs.append("")
+            hs.append(sig + ";")
+            fi = fortran_iface(base, dt, args).replace(
+                f"slate_tpu_{dt}{base}", f"slate_tpu_{sym}")
+            fs.append(fi)
+            fs.append("")
+    hs += ["", "#ifdef __cplusplus", "}", "#endif", "#endif"]
+    fs += ["   end interface", "end module slate_tpu"]
+    with open(os.path.join(root, "native", "capi_gen.c"), "w") as f:
+        f.write("\n".join(cs))
+    with open(os.path.join(root, "include", "slate_tpu_capi_gen.h"),
+              "w") as f:
+        f.write("\n".join(hs))
+    with open(os.path.join(root, "fortran", "slate_tpu.f90"), "w") as f:
+        f.write("\n".join(fs))
+    nsym = sum(4 for _ in ROUTINES)
+    print(f"generated {nsym} C symbols + Fortran interfaces")
+
+
+if __name__ == "__main__":
+    main()
