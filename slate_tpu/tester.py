@@ -819,6 +819,482 @@ for _r in ("gecondest", "pocondest", "trcondest"):
     register(_r)(lambda ctx, _r=_r: _condest_case(ctx, _r))
 
 
+# -- band BLAS-3 (gbmm/hbmm/tbsm — reference test_gbmm.cc etc.) -------------
+
+def _band_dense(ctx, kl, ku, herm=False):
+    rng = np.random.default_rng(ctx.seed)
+    n = ctx.n
+    a = np.zeros((n, n))
+    for off in range(-ku, kl + 1):
+        a += np.diag(rng.standard_normal(n - abs(off)), -off)
+    if herm:
+        a = 0.5 * (a + a.T)
+    return a
+
+
+@register("gbmm", flops=lambda m, n: 0.0)
+def _t_gbmm(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    n = ctx.n
+    kl = ku = max(1, ctx.nb // 8)
+    a = _band_dense(ctx, kl, ku)
+    b = ctx.gen("randn", n, n, 1)
+    A = st.band(jnp.asarray(a, ctx.dtype), ctx.nb, kl, ku, grid=ctx.grid)
+    B = ctx.dense(b)
+    C = st.zeros(n, n, ctx.nb, ctx.dtype, grid=ctx.grid)
+    out, secs = ctx.timed(jax.jit(lambda: st.gbmm(1.0, A, B, 0.0, C)))
+    ref = _np64(a) @ _np64(b)
+    err = _rel(np.linalg.norm(out.to_numpy() - ref, 1),
+               ctx.eps * n * max(np.linalg.norm(ref, 1), 1e-300))
+    return secs, err
+
+
+@register("hbmm", flops=lambda m, n: 0.0)
+def _t_hbmm(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Side, Uplo
+    n = ctx.n
+    kd = max(1, ctx.nb // 8)
+    a = _band_dense(ctx, kd, kd, herm=True)
+    b = ctx.gen("randn", n, n, 1)
+    A = st.hermitian_band(jnp.asarray(np.tril(a), ctx.dtype), ctx.nb, kd,
+                          Uplo.Lower, grid=ctx.grid)
+    B = ctx.dense(b)
+    C = st.zeros(n, n, ctx.nb, ctx.dtype, grid=ctx.grid)
+    out, secs = ctx.timed(
+        jax.jit(lambda: st.hbmm(Side.Left, 1.0, A, B, 0.0, C)))
+    ref = _np64(a) @ _np64(b)
+    err = _rel(np.linalg.norm(out.to_numpy() - ref, 1),
+               ctx.eps * n * max(np.linalg.norm(ref, 1), 1e-300))
+    return secs, err
+
+
+@register("tbsm", flops=lambda m, n: 0.0)
+def _t_tbsm(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Side, Uplo
+    n = ctx.n
+    kd = max(1, ctx.nb // 8)
+    a = np.tril(_band_dense(ctx, kd, 0))
+    a[np.arange(n), np.arange(n)] = 2.0 + np.abs(a.diagonal())
+    b = ctx.gen("randn", n, 4, 1)
+    A = st.triangular_band(jnp.asarray(a, ctx.dtype), ctx.nb, kd,
+                           Uplo.Lower, grid=ctx.grid)
+    B = ctx.dense(b)
+    out, secs = ctx.timed(jax.jit(lambda: st.tbsm(Side.Left, 1.0, A, B)))
+    return secs, _solve_err(ctx, a, out.to_numpy(), np.asarray(b))
+
+
+# -- elementwise / aux (reference test_add.cc, test_copy.cc, ...) -----------
+
+@register("geadd")
+def _t_geadd(ctx):
+    import slate_tpu as st
+    import jax
+    n = ctx.n
+    a, b = ctx.gen("randn", ctx.m, n), ctx.gen("randn", ctx.m, n, 1)
+    A, B = ctx.dense(a), ctx.dense(b)
+    out, secs = ctx.timed(jax.jit(lambda: st.add(2.5, A, -0.5, B)))
+    ref = 2.5 * _np64(a) - 0.5 * _np64(b)
+    err = _rel(np.abs(out.to_numpy() - ref).max(),
+               ctx.eps * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@register("gecopy")
+def _t_gecopy(ctx):
+    import slate_tpu as st
+    import jax.numpy as jnp
+    n = ctx.n
+    a = ctx.gen("randn", ctx.m, n)
+    A = ctx.dense(a)
+    out, secs = ctx.timed(lambda: st.copy(A, dtype=jnp.float64))
+    err = _rel(np.abs(out.to_numpy() - _np64(a)).max(),
+               ctx.eps * max(np.abs(np.asarray(a)).max(), 1e-300))
+    return secs, err
+
+
+@register("gescale")
+def _t_gescale(ctx):
+    import slate_tpu as st
+    import jax
+    n = ctx.n
+    a = ctx.gen("randn", ctx.m, n)
+    A = ctx.dense(a)
+    out, secs = ctx.timed(jax.jit(lambda: st.scale(3.0, 2.0, A)))
+    err = _rel(np.abs(out.to_numpy() - 1.5 * _np64(a)).max(),
+               ctx.eps * max(np.abs(np.asarray(a)).max(), 1e-300))
+    return secs, err
+
+
+@register("gescale_row_col")
+def _t_gescale_row_col(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    m, n = ctx.m, ctx.n
+    a = ctx.gen("randn", m, n)
+    r = np.abs(np.asarray(ctx.gen("rands", m, 1, 2))).ravel() + 0.5
+    c = np.abs(np.asarray(ctx.gen("rands", n, 1, 3))).ravel() + 0.5
+    A = ctx.dense(a)
+    R, C = jnp.asarray(r, ctx.dtype), jnp.asarray(c, ctx.dtype)
+    out, secs = ctx.timed(jax.jit(lambda: st.scale_row_col(R, C, A)))
+    ref = r[:, None] * _np64(a) * c[None, :]
+    err = _rel(np.abs(out.to_numpy() - ref).max(),
+               ctx.eps * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@register("geset")
+def _t_geset(ctx):
+    import slate_tpu as st
+    import jax
+    n = ctx.n
+    A = ctx.dense(ctx.gen("randn", ctx.m, n))
+    out, secs = ctx.timed(jax.jit(lambda: st.set_matrix(0.25, 2.0, A)))
+    got = out.to_numpy()
+    ref = np.full((ctx.m, n), 0.25)
+    np.fill_diagonal(ref, 2.0)
+    err = _rel(np.abs(got - ref).max(), ctx.eps)
+    return secs, err
+
+
+@register("redistribute")
+def _t_redistribute(ctx):
+    import slate_tpu as st
+    from slate_tpu.core.grid import ProcessGrid
+    n = ctx.n
+    a = ctx.gen("randn", ctx.m, n)
+    A = ctx.dense(a)
+    # re-shard onto a different grid shape (1×1 when no grid is active —
+    # still exercises the data path)
+    if ctx.grid is not None and ctx.grid.size > 1:
+        tgt = ProcessGrid.create(ctx.grid.q, ctx.grid.p)
+    else:
+        tgt = ProcessGrid.create(1, 1)
+    out, secs = ctx.timed(lambda: st.redistribute(A, tgt))
+    err = _rel(np.abs(out.to_numpy() - np.asarray(a)).max(), ctx.eps)
+    return secs, err
+
+
+# -- factor-apply stages (getrs/potrs/hetrs, unmqr/unmlq, hegst, trtrm) -----
+
+@register("getrs", flops=lambda m, n: 2 * n * n * 8)
+def _t_getrs(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    A = ctx.dense(a)
+    LU, perm, _ = st.getrf(A)
+    b = ctx.gen("randn", n, 8, 1)
+    B = ctx.dense(b)
+    out, secs = ctx.timed(lambda: st.getrs(LU, perm, B))
+    return secs, _solve_err(ctx, a, out.to_numpy(), b)
+
+
+@register("potrs", flops=lambda m, n: 2 * n * n * 8)
+def _t_potrs(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.spd(n)
+    A = ctx.herm(a)
+    L, _ = st.potrf(A)
+    b = ctx.gen("randn", n, 8, 1)
+    B = ctx.dense(b)
+    out, secs = ctx.timed(lambda: st.potrs(L, B))
+    return secs, _solve_err(ctx, a, out.to_numpy(), b)
+
+
+@register("hetrf", flops=lambda m, n: n ** 3 / 3.0, tol=100)
+def _t_hetrf(ctx):
+    import slate_tpu as st
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Uplo
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    a = 0.5 * (a + a.T)
+    A = st.symmetric(jnp.tril(a), nb=ctx.nb, uplo=Uplo.Lower, grid=ctx.grid)
+    (LT, perm, info), secs = ctx.timed(lambda: st.hetrf(A))
+    b = ctx.gen("randn", n, 4, 1)
+    B = ctx.dense(b)
+    X = st.hetrs(LT, perm, B)
+    return secs, _solve_err(ctx, a, X.to_numpy(), b)
+
+
+@register("unmqr", tol=30)
+def _t_unmqr(ctx):
+    import slate_tpu as st
+    import jax
+    from slate_tpu.core.types import Side
+    m, n = max(ctx.m, ctx.n), ctx.n
+    a = ctx.gen("randn", m, n)
+    A = ctx.dense(a)
+    QR = st.geqrf(A)
+    c = ctx.gen("randn", m, 8, 1)
+    C = ctx.dense(c)
+    out, secs = ctx.timed(
+        jax.jit(lambda: st.unmqr(Side.Left, QR, C, trans=True)))
+    # QᴴC then Q·(QᴴC) must give back C (orthogonality in action)
+    back = st.unmqr(Side.Left, QR, out)
+    err = _rel(np.abs(back.to_numpy() - np.asarray(c)).max(),
+               ctx.eps * m * max(np.abs(np.asarray(c)).max(), 1e-300))
+    return secs, err
+
+
+@register("unmlq", tol=30)
+def _t_unmlq(ctx):
+    import slate_tpu as st
+    from slate_tpu.core.types import Side
+    m, n = ctx.n, max(ctx.m, ctx.n)
+    a = ctx.gen("randn", m, n)  # wide
+    A = ctx.dense(a)
+    LQ = st.gelqf(A)
+    c = ctx.gen("randn", n, 4, 1)
+    C = ctx.dense(c)
+    out, secs = ctx.timed(lambda: st.unmlq(Side.Left, LQ, C, trans=True))
+    back = st.unmlq(Side.Left, LQ, out)
+    err = _rel(np.abs(back.to_numpy() - np.asarray(c)).max(),
+               ctx.eps * n * max(np.abs(np.asarray(c)).max(), 1e-300))
+    return secs, err
+
+
+@register("hegst", tol=30)
+def _t_hegst(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("heev_arith", n, n, cond=10.0)
+    bsp = ctx.spd(n, 1)
+    A, B = ctx.herm(a), ctx.herm(bsp)
+    L, _ = st.potrf(B)
+    out, secs = ctx.timed(lambda: st.hegst(A, L))
+    # check: L·Ã·Lᴴ == A
+    lref = np.tril(_np64(L.full_dense_canonical()))[:n, :n]
+    got = _np64(out.full_dense_canonical())[:n, :n]
+    got = np.tril(got) + np.tril(got, -1).conj().T
+    rec = lref @ got @ lref.conj().T
+    an = _np64(a)
+    an = np.tril(an) + np.tril(an, -1).conj().T if ctx.uplo == "lower" \
+        else an
+    err = _rel(np.abs(rec - an).max(),
+               ctx.eps * n * max(np.abs(an).max(), 1e-300)
+               * max(np.linalg.norm(lref, 1) ** 2, 1.0))
+    return secs, err
+
+
+@register("trtrm", flops=lambda m, n: n ** 3 / 3.0)
+def _t_trtrm(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    L = ctx.tri(ctx.gen("randn", n, n))
+    out, secs = ctx.timed(lambda: st.trtrm(L))
+    lref = _np64(L.full_dense_canonical())[:n, :n]
+    got = _np64(out.full_dense_canonical())[:n, :n]
+    got = np.tril(got) + np.tril(got, -1).conj().T
+    ref = lref.conj().T @ lref
+    err = _rel(np.abs(got - ref).max(),
+               ctx.eps * n * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+# -- band factorizations + reductions + values-only tridiag -----------------
+
+@register("gbtrf", flops=lambda m, n: 0.0)
+def _t_gbtrf(ctx):
+    import slate_tpu as st
+    import jax.numpy as jnp
+    n = ctx.n
+    kl = ku = max(1, ctx.nb // 8)
+    a = _band_dense(ctx, kl, ku)
+    a += (kl + ku + 3) * np.eye(n)
+    A = st.band(jnp.asarray(a, ctx.dtype), ctx.nb, kl, ku, grid=ctx.grid)
+    (LU, perm, info), secs = ctx.timed(lambda: st.gbtrf(A))
+    b = ctx.gen("randn", n, 2, 1)
+    B = ctx.dense(b)
+    X = st.gbtrs(LU, perm, B)
+    return secs, _solve_err(ctx, a, X.to_numpy(), b)
+
+
+@register("pbtrf", flops=lambda m, n: 0.0)
+def _t_pbtrf(ctx):
+    import slate_tpu as st
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Uplo
+    n = ctx.n
+    kd = max(1, ctx.nb // 4)
+    a = _band_dense(ctx, kd, kd, herm=True)
+    a += (2 * kd + 4) * np.eye(n)
+    A = st.hermitian_band(jnp.asarray(np.tril(a), ctx.dtype), ctx.nb, kd,
+                          Uplo.Lower, grid=ctx.grid)
+    (L, info), secs = ctx.timed(lambda: st.pbtrf(A))
+    b = ctx.gen("randn", n, 2, 1)
+    B = ctx.dense(b)
+    X = st.pbtrs(L, B)
+    return secs, _solve_err(ctx, a, X.to_numpy(), b)
+
+
+@register("he2hb", tol=30)
+def _t_he2hb(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("heev_arith", n, n, cond=100.0)
+    A = ctx.herm(a)
+    (band, refl), secs = ctx.timed(lambda: st.he2hb(A))
+    bf = _np64(band.full_dense_canonical())
+    an = _np64(a)
+    werr = np.abs(np.sort(np.linalg.eigvalsh(bf))[:n]
+                  - np.sort(np.linalg.eigvalsh(an))).max()
+    err = _rel(werr, ctx.eps * n * max(np.abs(an).max(), 1e-300))
+    return secs, err
+
+
+@register("ge2tb", tol=30)
+def _t_ge2tb(ctx):
+    import slate_tpu as st
+    m, n = max(ctx.m, ctx.n), ctx.n
+    a = ctx.gen("svd_geo", m, n, cond=100.0)
+    A = ctx.dense(a)
+    out, secs = ctx.timed(lambda: st.ge2tb(A))
+    bf = _np64(out[0])  # (mpad, npad) band array (see svd.ge2tb)
+    sref = np.linalg.svd(_np64(a), compute_uv=False)
+    sgot = np.linalg.svd(bf, compute_uv=False)[: sref.size]
+    err = _rel(np.abs(np.sort(sgot) - np.sort(sref)).max(),
+               ctx.eps * max(m, n) * max(sref[0], 1e-300))
+    return secs, err
+
+
+@register("sterf")
+def _t_sterf(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    rng = np.random.default_rng(ctx.seed)
+    d, e = rng.standard_normal(n), rng.standard_normal(n - 1)
+    import jax
+    import jax.numpy as jnp
+    rdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dj = jnp.asarray(d, rdt)
+    w, secs = ctx.timed(lambda: st.sterf(dj, jnp.asarray(e, rdt)))
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    wref = np.linalg.eigvalsh(t)
+    err = _rel(np.abs(np.sort(np.asarray(w)) - wref).max(),
+               ctx.eps * n * max(np.abs(wref).max(), 1e-300))
+    return secs, err
+
+
+@register("stedc_grid")
+def _t_stedc_grid(ctx):
+    """stedc with the merge GEMMs sharded over the process grid
+    (reference stedc is grid-distributed, src/stedc_merge.cc:98-102)."""
+    from slate_tpu.linalg.stedc import stedc
+    n = ctx.n
+    rng = np.random.default_rng(ctx.seed)
+    d, e = rng.standard_normal(n), rng.standard_normal(n - 1)
+    t0 = time.perf_counter()
+    w, z = stedc(d, e, use_device=True, grid=ctx.grid)
+    secs = time.perf_counter() - t0
+    z = np.asarray(z)
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    epsz = np.finfo(z.dtype).eps
+    res = _rel(np.abs(t @ z - z * w).max(),
+               epsz * n * max(np.abs(w).max(), 1e-300))
+    orth = _rel(np.abs(z.T @ z - np.eye(n)).max(), epsz * n)
+    return secs, max(res, orth)
+
+
+@register("gbnorm")
+def _t_gbnorm(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Norm
+    n = ctx.n
+    kl = ku = max(1, ctx.nb // 8)
+    a = _band_dense(ctx, kl, ku)
+    A = st.band(jnp.asarray(a, ctx.dtype), ctx.nb, kl, ku, grid=ctx.grid)
+    errs = []
+    secs = 0.0
+    for nk, ref in ((Norm.One, lambda x: np.linalg.norm(x, 1)),
+                    (Norm.Inf, lambda x: np.linalg.norm(x, np.inf)),
+                    (Norm.Fro, lambda x: np.linalg.norm(x, "fro"))):
+        out, s = ctx.timed(jax.jit(lambda nk=nk: st.norm(A, nk)))
+        secs += s
+        r = ref(_np64(a))
+        errs.append(_rel(abs(float(out) - r),
+                         ctx.eps * n * max(r, 1e-300)))
+    return secs, max(errs)
+
+
+@register("hbnorm")
+def _t_hbnorm(ctx):
+    import slate_tpu as st
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.types import Norm, Uplo
+    n = ctx.n
+    kd = max(1, ctx.nb // 8)
+    a = _band_dense(ctx, kd, kd, herm=True)
+    A = st.hermitian_band(jnp.asarray(np.tril(a), ctx.dtype), ctx.nb, kd,
+                          Uplo.Lower, grid=ctx.grid)
+    out, secs = ctx.timed(jax.jit(lambda: st.norm(A, Norm.One)))
+    r = np.linalg.norm(_np64(a), 1)
+    err = _rel(abs(float(out) - r), ctx.eps * n * max(r, 1e-300))
+    return secs, err
+
+
+@register("col_norms")
+def _t_col_norms(ctx):
+    import slate_tpu as st
+    import jax
+    from slate_tpu.core.types import Norm
+    m, n = ctx.m, ctx.n
+    a = ctx.gen("randn", m, n)
+    A = ctx.dense(a)
+    out, secs = ctx.timed(jax.jit(lambda: st.col_norms(A, Norm.Max)))
+    ref = np.abs(_np64(a)).max(axis=0)
+    err = _rel(np.abs(np.asarray(out)[:n] - ref).max(),
+               ctx.eps * max(ref.max(), 1e-300))
+    return secs, err
+
+
+@register("getrf_nopiv", tol=1e4)
+def _t_getrf_nopiv(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    a = a + n * np.eye(n)  # diagonally dominant: no-pivot is stable here
+    A = ctx.dense(a)
+    (LU, info), secs = ctx.timed(lambda: st.getrf_nopiv(A))
+    lu = _np64(LU.dense_canonical())
+    npad = lu.shape[0]
+    l = np.tril(lu, -1) + np.eye(npad)
+    u = np.triu(lu)
+    an = _np64(A.dense_canonical())
+    err = _rel(np.linalg.norm(an - l @ u, 1),
+               ctx.eps * n * np.linalg.norm(an, 1))
+    return secs, err
+
+
+@register("tsqr", tol=30)
+def _t_tsqr(ctx):
+    import slate_tpu as st
+    m, n = max(ctx.m, 4 * ctx.n), ctx.n
+    a = ctx.gen("randn", m, n)
+    A = ctx.dense(a)
+    (Q, R), secs = ctx.timed(lambda: st.tsqr(A))
+    q = _np64(Q.to_numpy())
+    r = np.triu(_np64(R.to_numpy()))[:n, :n]
+    an = _np64(a)
+    err_f = _rel(np.linalg.norm(an - q @ r, 1),
+                 ctx.eps * m * np.linalg.norm(an, 1))
+    err_o = _rel(np.abs(q.conj().T @ q - np.eye(n)).max(), ctx.eps * m)
+    return secs, max(err_f, err_o)
+
+
 def run_one(routine: str, m: int, n: int, nb: int, grid, dtype, seed: int,
             iters: int, uplo: str = "lower", trans: str = "n"):
     """Returns (seconds, gflops, scaled_error, ok)."""
@@ -858,6 +1334,12 @@ def main(argv=None):
         for name in sorted(_REGISTRY):
             print(name)
         return 0
+
+    # honor JAX_PLATFORMS before any backend initializes (the axon
+    # sitecustomize overrides the env var; see compat/platform.py)
+    from slate_tpu.compat.platform import apply_env_platforms
+
+    apply_env_platforms()
 
     import jax.numpy as jnp
     from slate_tpu.core.grid import ProcessGrid
