@@ -19,12 +19,12 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      potrf, potrs, posv, trtri, trtrm, potri, posv_mixed,
                      getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv,
                      gesv_nopiv, gesv_rbt, gesv_mixed, gesv_mixed_gmres,
-                     posv_mixed_gmres, getri, gerbt,
+                     posv_mixed_gmres, getri, getri_oop, gerbt,
                      QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
                      PackedBand, BandLU, pb_pack, gb_pack, tbsm_packed,
-                     gecondest, pocondest, trcondest, hesv, hetrf, hetrs,
+                     gecondest, pocondest, trcondest, hesv, hetrf, hetrs, hetrf_nopiv, hetrs_nopiv,
                      heev, hegv, hegst, he2hb, he2td, hb2td, unmtr_he2hb,
                      unmtr_hb2td,
                      unmtr_he2td, steqr, sterf,

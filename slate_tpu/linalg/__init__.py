@@ -5,7 +5,7 @@ from .elementwise import (add, copy, scale, scale_row_col, set_matrix,
                           set_lambda, redistribute)
 from .cholesky import (potrf, potrs, posv, trtri, trtrm, potri, posv_mixed)
 from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
-                 gesv_rbt, gesv_mixed, getri, gerbt)
+                 gesv_rbt, gesv_mixed, getri, getri_oop, gerbt)
 from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
                  qr_multiply_explicit)
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
@@ -17,7 +17,8 @@ from .eig import (heev, hegv, hegst, he2hb, he2td, hb2td, unmtr_he2hb,
 from .svd import svd, ge2tb, bdsqr
 from .condest import gecondest, pocondest, trcondest
 from .gmres import gesv_mixed_gmres, posv_mixed_gmres
-from .indefinite import hesv, hetrf, hetrs
+from .indefinite import (hesv, hetrf, hetrs, hetrf_nopiv,
+                         hetrs_nopiv)
 # Explicit submodule attributes (not just import side effects):
 from . import (band, blas3, cholesky, condest, eig, elementwise,
                gmres, indefinite, lu, qr)

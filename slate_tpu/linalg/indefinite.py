@@ -128,9 +128,13 @@ def _tridiag_lu_piv(d: np.ndarray, e: np.ndarray):
     (LAPACK dgttrf): returns (dl, du, du2, ipiv, info). Host numpy —
     O(n) scalar recurrence."""
     n = d.size
-    du = e.astype(np.complex128 if np.iscomplexobj(e) else np.float64).copy()
-    dd = d.astype(du.dtype).copy()
-    dl = np.conj(e).astype(du.dtype).copy()
+    ct = np.complex128 if np.iscomplexobj(e) else np.float64
+    # e is T's SUBdiagonal (packed[k+1, k]); Hermitian T has conj(e) on
+    # the superdiagonal — real-symmetric input hides a swap here, so
+    # keep the orientation explicit
+    dl = e.astype(ct).copy()
+    dd = d.astype(ct).copy()
+    du = np.conj(e).astype(ct).copy()
     du2 = np.zeros(max(n - 2, 0), du.dtype)
     ipiv = np.arange(n, dtype=np.int64)
     info = 0

@@ -403,6 +403,17 @@ def getri(LU: TiledMatrix, perm: Array, opts: Options = DEFAULT_OPTIONS
     return getrs(LU, perm, I, opts)
 
 
+def getri_oop(LU: TiledMatrix, perm: Array,
+              opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Out-of-place inverse from getrf factors (slate::getriOOP,
+    src/getriOOP.cc). The reference distinguishes in-place (overwrite
+    the factor) from out-of-place (result in B, factors preserved);
+    functional semantics make every solve out-of-place here, so this is
+    the same computation under the reference's other name — kept so
+    callers porting from the reference find it."""
+    return getri(LU, perm, opts)
+
+
 # ---------------------------------------------------------------------------
 # Random Butterfly Transform (RBT)
 # ---------------------------------------------------------------------------

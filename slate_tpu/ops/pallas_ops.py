@@ -21,10 +21,17 @@ flop saving does not become a time saving — potrf(8192, nb=1024) runs
 identical at "high"-equivalent and HIGHEST precision (11.2 ms per
 8192×1024 update either way). The route is therefore OPT-IN:
 ``SLATE_TPU_PALLAS_HERK=1`` enables it at the call site in
-ops/blocked.herk_lower_rec; the default is the jnp recursion. The
-kernel stays as the seed for the real fix — a k-resident accumulation
-grid (iterate pairs per k-chunk so A streams once) — and for
-interpret-mode coverage of the pairs/aliasing machinery.
+ops/blocked.herk_lower_rec; the default is the jnp recursion.
+
+ROUND-4 CONCLUSION on the planned "k-resident accumulation" rewrite:
+cancelled by arithmetic. The jnp recursion's flop recurrence is
+T(n) = 2·T(n/2) + (n/2)²·k (one full off-diagonal gemm per level),
+which telescopes to n²k/2 MACs — exactly the triangular herk count.
+So the recursion ALREADY banks the 2× flop saving on XLA's own
+(roofline-blocked) gemms, and any Pallas kernel can at best tie it
+while re-implementing XLA's pipelining by hand. The kernel is retained
+opt-in as coverage for the scalar-prefetch/aliasing machinery (used by
+interpret-mode tests), not as a performance path.
 """
 
 from __future__ import annotations

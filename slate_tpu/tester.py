@@ -62,6 +62,11 @@ class Ctx:
     iters: int
     uplo: str = "lower"
     trans: str = "n"
+    # where user data starts (reference Origin::{Host,Devices,ScaLAPACK},
+    # test/test.hh:24-46): "device" = jax array, "host" = numpy array,
+    # "scalapack" = routed through the 2D block-cyclic local buffers
+    # (interop.scalapack round-trip — the fromScaLAPACK analog)
+    origin: str = "device"
 
     @property
     def eps(self):
@@ -99,6 +104,27 @@ class Ctx:
 
     def dense(self, a):
         import slate_tpu as st
+        if self.origin == "host":
+            a = np.asarray(a)
+        elif self.origin == "scalapack":
+            # round-trip through TRUE ScaLAPACK block-cyclic local
+            # buffers: exercises the fromScaLAPACK zero-copy analog
+            # (interop/scalapack.py + the native packers) inside the
+            # routine sweep, like the reference's Origin::ScaLAPACK
+            from slate_tpu.interop import scalapack as sca
+            if np.iscomplexobj(np.asarray(a)):
+                raise ValueError(
+                    "origin=scalapack supports real dtypes only (the "
+                    "native block-cyclic packers are f64)")
+            an = np.asarray(a, np.float64)
+            p, q = ((self.grid.p, self.grid.q) if self.grid is not None
+                    else (2, 2))
+            A0 = st.from_dense(an, nb=self.nb)
+            locals_ = sca.to_scalapack(A0, p, q)
+            return st.copy(
+                sca.from_scalapack(locals_, an.shape[0], an.shape[1],
+                                   self.nb, p, q, grid=self.grid),
+                dtype=self.dtype)
         return st.from_dense(a, nb=self.nb, grid=self.grid)
 
     def tri(self, a, diag_boost=True):
@@ -1015,11 +1041,10 @@ def _t_potrs(ctx):
 def _t_hetrf(ctx):
     import slate_tpu as st
     import jax.numpy as jnp
-    from slate_tpu.core.types import Uplo
     n = ctx.n
     a = ctx.gen("randn", n, n)
-    a = 0.5 * (a + a.T)
-    A = st.symmetric(jnp.tril(a), nb=ctx.nb, uplo=Uplo.Lower, grid=ctx.grid)
+    a = 0.5 * (a + jnp.conj(a).T)  # Hermitian: complex dtypes run too
+    A = ctx.herm(a)
     (LT, perm, info), secs = ctx.timed(lambda: st.hetrf(A))
     b = ctx.gen("randn", n, 4, 1)
     B = ctx.dense(b)
@@ -1147,6 +1172,14 @@ def _t_he2hb(ctx):
     (band, refl), secs = ctx.timed(lambda: st.he2hb(A))
     bf = _np64(band.full_dense_canonical())
     an = _np64(a)
+    npad = bf.shape[0]
+    if npad != n:
+        # padding block is exactly decoupled; shift its diagonal past
+        # the Gershgorin bound so pad eigenvalues sort strictly last
+        # (same trick as eig._heev_band_dense)
+        big = (2 * ctx.nb + 1) * np.abs(bf).max() + 1.0
+        idx = np.arange(npad)
+        bf[idx[n:], idx[n:]] = big
     werr = np.abs(np.sort(np.linalg.eigvalsh(bf))[:n]
                   - np.sort(np.linalg.eigvalsh(an))).max()
     err = _rel(werr, ctx.eps * n * max(np.abs(an).max(), 1e-300))
@@ -1295,15 +1328,128 @@ def _t_tsqr(ctx):
     return secs, max(err_f, err_o)
 
 
+# -- `--ref` cross-check mode ----------------------------------------------
+# The reference tester's `--ref y` runs the same problem through
+# ScaLAPACK and compares norms (test/test_gemm.cc:210-278). Our
+# reference oracle is the host LAPACK via numpy: each runner rebuilds
+# the IDENTICAL deterministic problem (same matgen seeds), solves it
+# both ways, and reports (ref seconds, scaled cross-difference).
+
+REF_RUNNERS: Dict[str, Callable] = {}
+
+
+def _ref(name):
+    def deco(fn):
+        REF_RUNNERS[name] = fn
+        return fn
+    return deco
+
+
+@_ref("gemm")
+def _r_gemm(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("randn", ctx.m, n)
+    b = ctx.gen("randn", n, ctx.m, 1)
+    C0 = st.zeros(ctx.m, ctx.m, ctx.nb, ctx.dtype, grid=ctx.grid)
+    ours = st.gemm(1.0, ctx.dense(a), ctx.dense(b), 0.0, C0).to_numpy()
+    an, bn = _np64(a), _np64(b)
+    t0 = time.perf_counter()
+    ref = an @ bn
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(_np64(ours) - ref).max(),
+               ctx.eps * n * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@_ref("gesv")
+def _r_gesv(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    b = ctx.gen("randn", n, 8, 1)
+    X, _ = st.gesv(ctx.dense(a), ctx.dense(b))
+    an, bn = _np64(a), _np64(b)
+    t0 = time.perf_counter()
+    ref = np.linalg.solve(an, bn)
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(_np64(X.to_numpy()) - ref).max(),
+               ctx.eps * n * np.linalg.cond(an, 1)
+               * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@_ref("posv")
+def _r_posv(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.spd(n)
+    b = ctx.gen("randn", n, 8, 1)
+    X, _ = st.posv(ctx.herm(a), ctx.dense(b))
+    an, bn = _np64(a), _np64(b)
+    t0 = time.perf_counter()
+    ref = np.linalg.solve(an, bn)
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(_np64(X.to_numpy()) - ref).max(),
+               ctx.eps * n * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@_ref("gels")
+def _r_gels(ctx):
+    import slate_tpu as st
+    m, n = max(ctx.m, ctx.n), ctx.n
+    a = ctx.gen("randn", m, n)
+    b = ctx.gen("randn", m, 4, 1)
+    X = st.gels(ctx.dense(a), ctx.dense(b))
+    an, bn = _np64(a), _np64(b)
+    t0 = time.perf_counter()
+    ref = np.linalg.lstsq(an, bn, rcond=None)[0]
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(_np64(X.to_numpy()[:n]) - ref).max(),
+               ctx.eps * m * max(np.abs(ref).max(), 1e-300)
+               * np.linalg.cond(an))
+    return secs, err
+
+
+@_ref("heev")
+def _r_heev(ctx):
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("heev_arith", n, n, cond=100.0)
+    w, _ = st.heev(ctx.herm(a), want_vectors=False)
+    t0 = time.perf_counter()
+    ref = np.linalg.eigvalsh(_np64(a))
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(np.asarray(w, np.float64) - ref).max(),
+               ctx.eps * n * max(np.abs(ref).max(), 1e-300))
+    return secs, err
+
+
+@_ref("svd")
+def _r_svd(ctx):
+    import slate_tpu as st
+    m, n = ctx.m, ctx.n
+    a = ctx.gen("svd_geo", m, n, cond=100.0)
+    s, *_ = st.svd(ctx.dense(a))
+    t0 = time.perf_counter()
+    ref = np.linalg.svd(_np64(a), compute_uv=False)
+    secs = time.perf_counter() - t0
+    err = _rel(np.abs(np.asarray(s, np.float64) - ref).max(),
+               ctx.eps * max(m, n) * ref[0])
+    return secs, err
+
+
 def run_one(routine: str, m: int, n: int, nb: int, grid, dtype, seed: int,
-            iters: int, uplo: str = "lower", trans: str = "n"):
+            iters: int, uplo: str = "lower", trans: str = "n",
+            origin: str = "device"):
     """Returns (seconds, gflops, scaled_error, ok)."""
     fn = _REGISTRY.get(routine)
     if fn is None:
         raise ValueError(
             f"unknown routine {routine}; --list shows all "
             f"{len(_REGISTRY)} registered")
-    ctx = Ctx(m, n, nb, grid, dtype, seed, iters, uplo, trans)
+    ctx = Ctx(m, n, nb, grid, dtype, seed, iters, uplo, trans, origin)
     secs, err = fn(ctx)
     flops = getattr(fn, "_flops", lambda m, n: 0.0)(m, n)
     gflops = flops / secs / 1e9 if secs > 0 else 0.0
@@ -1324,9 +1470,18 @@ def main(argv=None):
     ap.add_argument("--dtype", default="f32",
                     choices=["f32", "f64", "bf16", "c64", "c128"])
     ap.add_argument("--uplo", default="lower", choices=["lower", "upper"])
+    ap.add_argument("--origin", default="device",
+                    choices=["device", "host", "scalapack"],
+                    help="where user data starts (reference "
+                         "Origin::{Host,Devices,ScaLAPACK} sweeps)")
     ap.add_argument("--trans", default="n", choices=["n", "t", "c"])
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--iters", type=int, default=1)
+    ap.add_argument("--ref", action="store_true",
+                    help="also run the host-LAPACK (numpy) reference on "
+                         "the identical problem and report its time + "
+                         "the scaled cross-difference (the reference "
+                         "tester's --ref y ScaLAPACK comparison)")
     ap.add_argument("--trace", default=None, help="write SVG timeline")
     args = ap.parse_args(argv)
 
@@ -1369,7 +1524,7 @@ def main(argv=None):
                 try:
                     secs, gf, err, ok = run_one(
                         routine, m, n, args.nb, grid, dtype, args.seed,
-                        args.iters, args.uplo, args.trans)
+                        args.iters, args.uplo, args.trans, args.origin)
                 except Exception as e:  # surface per-row, keep sweeping
                     print(f"{routine:<18} {m:>6} {n:>6} {args.nb:>5} "
                           f"{args.p}x{args.q:>3} {'-':>10} {'-':>10} "
@@ -1381,6 +1536,16 @@ def main(argv=None):
             print(f"{routine:<18} {m:>6} {n:>6} {args.nb:>5} "
                   f"{args.p}x{args.q:>3} {secs:>10.4f} {gf:>10.1f} "
                   f"{err:>10.2e} {status}")
+            if args.ref and routine in REF_RUNNERS:
+                ctx = Ctx(m, n, args.nb, grid, dtype, args.seed, 1,
+                          args.uplo, args.trans)
+                rsecs, rerr = REF_RUNNERS[routine](ctx)
+                rok = rerr < 10 * _TOLS[routine]
+                failures += 0 if rok else 1
+                print(f"{routine + '/ref':<18} {m:>6} {n:>6} "
+                      f"{args.nb:>5} {'host':>5} {rsecs:>10.4f} "
+                      f"{'-':>10} {rerr:>10.2e} "
+                      f"{'pass' if rok else 'FAILED'}")
     if args.trace:
         trace_mod.Trace.off()
         path = trace_mod.Trace.finish(args.trace)

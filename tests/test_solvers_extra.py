@@ -219,3 +219,18 @@ def test_print_and_debug(capsys):
     assert "5x4" in out
     dbg = st.utils.debug_dump(A)
     assert "nb=2" in dbg
+
+
+def test_hetrf_hetrs_complex_direct():
+    """Factor-level complex Hermitian check (NO hesv IR/fallback in the
+    way — the round-4 tester caught a conj-transposition in T's band LU
+    that hesv's fallback masked)."""
+    n, nb = 36, 8
+    g = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    a = (g + g.conj().T) / 2
+    A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower)
+    b = RNG.standard_normal((n, 2)) + 1j * RNG.standard_normal((n, 2))
+    LT, perm, info = st.hetrf(A)
+    assert int(info) == 0
+    X = st.hetrs(LT, perm, st.from_dense(b, nb=nb))
+    assert np.abs(a @ X.to_numpy() - b).max() < n * 1e-12
