@@ -58,7 +58,8 @@ _pad_identity_diag = unit_pad_diag
 # partial-pivot LU
 # ---------------------------------------------------------------------------
 
-def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
+def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
+               threshold: float = 1.0):
     """Recursive blocked partial-pivot LU on an (M × W) column block,
     W ≤ M, recursing on width down to nb-wide panels.
 
@@ -77,6 +78,18 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
     perm length M, info 1-based first zero pivot."""
     m, w = a.shape
     if w <= nb:
+        if threshold < 1.0 and m > w:
+            # Option::PivotThreshold analog: tournament panel (see
+            # _getrf_iter) — compaction perm, so callers must apply it
+            # with a full gather
+            p_p = _tournament_perm(a, w, nb, m, m)
+            pan_w = a[p_p]
+            lu_top, info = _lu_nopiv_recursive(pan_w[:w])
+            below = jax.lax.linalg.triangular_solve(
+                lu_top, pan_w[w:], left_side=False, lower=False,
+                unit_diagonal=False)
+            return (jnp.concatenate([lu_top, below], axis=0), p_p,
+                    info.astype(jnp.int32))
         hb = blocked.bucket_pow2(m, nb)
         ap = jnp.pad(a, ((0, hb - m), (0, 0))) if hb > m else a
         g = blocked.current_grid()
@@ -87,15 +100,22 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
             lu, perm, info = blocked.panel_getrf_jit(ap)
         return lu[:m], perm[:m], info
     h = blocked._half(w, nb)
-    lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec, dist_panel)
-    right = blocked.permute_rows_limited(a[:, h:], p1, 2 * h)
+    lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec, dist_panel, threshold)
+    if threshold < 1.0:
+        right = a[:, h:][p1]
+    else:
+        right = blocked.permute_rows_limited(a[:, h:], p1, 2 * h)
     # U12 = L11⁻¹ · A12 (unit-lower block solve, gemm-based)
     u_top = blocked.trsm_rec(lu1[:h, :h], right[:h], left=True, lower=True,
                              unit=True, prec=prec, base=min(nb, h))
     schur = blocked.rebalance(
         right[h:] - blocked.mm(lu1[h:, :h], u_top, prec))
-    lu2, p2, i2 = _getrf_rec(schur, nb, prec, dist_panel)
-    low_left = blocked.permute_rows_limited(lu1[h:, :h], p2, 2 * (w - h))
+    lu2, p2, i2 = _getrf_rec(schur, nb, prec, dist_panel, threshold)
+    if threshold < 1.0:
+        low_left = lu1[h:, :h][p2]
+    else:
+        low_left = blocked.permute_rows_limited(lu1[h:, :h], p2,
+                                                2 * (w - h))
     lu = jnp.concatenate([
         jnp.concatenate([lu1[:h], u_top], axis=1),
         jnp.concatenate([low_left, lu2], axis=1)], axis=0)
@@ -105,7 +125,7 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False):
     return lu, perm, info
 
 
-def _getrf_iter(a: Array, nb: int, prec):
+def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0):
     """Iterative right-looking blocked partial-pivot LU (round 4).
 
     Same redesign as cholesky._potrf_iter: per panel ONE bucketed
@@ -114,7 +134,16 @@ def _getrf_iter(a: Array, nb: int, prec):
     U12 block and Schur complement as single gemms — no recursive
     trsm re-inverting the same diagonal blocks at every level. The
     reference's DAG shape (panel → swaps → trsm → gemm per step,
-    src/getrf.cc:81-160) is recovered step for step."""
+    src/getrf.cc:81-160) is recovered step for step.
+
+    ``threshold`` < 1 is the Option::PivotThreshold analog
+    (src/getrf.cc + Tile_getrf.hh threshold pivoting): relaxed pivot
+    quality buys a shorter critical path. Here that trades the
+    per-column argmax/swap chain of the panel for the vmap-batched
+    CALU tournament (winner rows selected by chunked LUs + a log₂
+    tree, then a no-pivot elimination) — tournament pivoting's growth
+    bound is weaker than partial pivoting's but strong in practice,
+    exactly the reference's CALU trade."""
     m, w = a.shape
     nt = w // nb
     perm = jnp.arange(m, dtype=jnp.int32)
@@ -122,17 +151,32 @@ def _getrf_iter(a: Array, nb: int, prec):
     for k in range(nt):
         k0, k1 = k * nb, (k + 1) * nb
         rows = m - k0
-        hb = blocked.bucket_pow2(rows, nb)
         panel = a[k0:, k0:k1]
-        if hb > rows:
-            panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
-        lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
-        p_p = p_p[:rows]
+        if threshold < 1.0:
+            # tournament panel: argmax/swap chain leaves the critical
+            # path; elimination is the no-pivot recursion on winners.
+            # One full-row gather (the tournament permutation compacts
+            # ALL rows — not a bounded-displacement swap list); the
+            # permuted panel is a slice of it.
+            p_p = _tournament_perm(panel, nb, nb, rows, m)
+            moved = a[k0:, :][p_p]
+            pan_w = moved[:, k0:k1]
+            lu_top, i_p = _lu_nopiv_recursive(pan_w[:nb])
+            below = jax.lax.linalg.triangular_solve(
+                lu_top, pan_w[nb:], left_side=False, lower=False,
+                unit_diagonal=False)
+            lu_p = jnp.concatenate([lu_top, below], axis=0)
+        else:
+            hb = blocked.bucket_pow2(rows, nb)
+            if hb > rows:
+                panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
+            lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
+            p_p = p_p[:rows]
+            # row swaps apply to the whole remaining row block, stored
+            # L included (reference applies pivots to left panels too)
+            moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
         info = jnp.where((info == 0) & (i_p > 0), k0 + i_p,
                          info).astype(jnp.int32)
-        # row swaps apply to the whole remaining row block, stored L
-        # included (reference applies pivots to left panels too)
-        moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
         a = jax.lax.dynamic_update_slice(a, moved, (k0, 0))
         perm = perm.at[k0:].set(perm[k0:][p_p])
         a = jax.lax.dynamic_update_slice(a, lu_p[:rows], (k0, k0))
@@ -152,7 +196,7 @@ _GETRF_ITER_MAX_NT = 64  # same HLO-size bound as _POTRF_ITER_MAX_NT
 
 
 def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
-                   dist_panel: bool = False):
+                   dist_panel: bool = False, threshold: float = 1.0):
     """Blocked partial-pivot LU on padded dense (possibly rectangular).
 
     Factors the leading min(m,n) columns (iterative panel loop when the
@@ -162,9 +206,10 @@ def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
     k = min(m, n)
     if (not dist_panel and k % nb == 0
             and 1 < k // nb <= _GETRF_ITER_MAX_NT):
-        lu, perm, info = _getrf_iter(a[:, :k], nb, prec)
+        lu, perm, info = _getrf_iter(a[:, :k], nb, prec, threshold)
     else:
-        lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel)
+        lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel,
+                                    threshold)
     if n > k:
         rest = blocked.permute_rows_limited(a[:, k:], perm, 2 * k)
         u_rest = blocked.trsm_rec(lu[:, :k], rest, left=True, lower=True,
@@ -192,7 +237,8 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     with blocked.distribute_on(A.grid):
         lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
                                         prec=opts.update_precision,
-                                        dist_panel=opts.lu_dist_panel)
+                                        dist_panel=opts.lu_dist_panel,
+                                        threshold=opts.pivot_threshold)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 
@@ -256,6 +302,51 @@ def _lu_nopiv_unblocked(a: Array):
     return mat, info
 
 
+def _tournament_perm(panel: Array, w: int, nb: int, prows: int,
+                     mpad: int) -> Array:
+    """CALU tournament over a (prows × w) panel: returns the length-
+    ``prows`` permutation putting the w winner rows on top (reference
+    src/getrf_tntpiv.cc:110-175 — local LU per nb-row chunk selects
+    candidates, then a log₂ tree of pairwise stacked LUs picks the
+    winners; all on device).
+
+    Padding sentinels (zero-padded chunk rows / odd-pairing fillers,
+    selectable only when a panel column is entirely zero) are replaced
+    by distinct unused rows so the permutation stays valid and
+    singularity surfaces only via info."""
+    nchunks = -(-prows // nb)
+    pad_rows = nchunks * nb - prows
+    stacked = jnp.pad(panel, ((0, pad_rows), (0, 0)))
+    chunks = stacked.reshape(nchunks, nb, w)
+    cand_idx = (jnp.arange(nchunks * nb, dtype=jnp.int32)
+                .reshape(nchunks, nb))
+    while chunks.shape[0] > 1:
+        _, _, perms_c = jax.vmap(jax.lax.linalg.lu)(chunks)
+        top = jax.vmap(lambda c, p: c[p][:w])(chunks, perms_c)
+        topi = jax.vmap(lambda ci, p: ci[p][:w])(cand_idx, perms_c)
+        nc = top.shape[0]
+        if nc % 2 == 1:
+            top = jnp.concatenate(
+                [top, jnp.zeros((1,) + top.shape[1:], top.dtype)])
+            topi = jnp.concatenate(
+                [topi, jnp.full((1, w), mpad, jnp.int32)])
+            nc += 1
+        chunks = top.reshape(nc // 2, 2 * w, w)
+        cand_idx = topi.reshape(nc // 2, 2 * w)
+    _, _, pfin = jax.lax.linalg.lu(chunks[0])
+    winners = cand_idx[0][pfin][:w]  # panel-relative row indices
+    valid = winners < prows
+    used = (jnp.zeros(prows + 1, bool)
+            .at[jnp.where(valid, winners, prows)].set(True))[:prows]
+    unused = jnp.nonzero(~used, size=prows,
+                         fill_value=prows - 1)[0].astype(jnp.int32)
+    slot = jnp.cumsum(~valid) - (~valid)  # per-slot sentinel ordinal
+    winners = jnp.where(valid, winners, unused[slot])
+    others_mask = jnp.ones(prows, bool).at[winners].set(False)
+    rest = jnp.nonzero(others_mask, size=prows - w, fill_value=0)[0]
+    return jnp.concatenate([winners, rest.astype(jnp.int32)])
+
+
 @accurate_matmuls
 def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
                  ) -> Tuple[TiledMatrix, Array, Array]:
@@ -280,47 +371,7 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
         w = k1 - k0
         prows = mpad - k0
         panel = a[k0:, k0:k1]
-        # --- tournament: find nb winner rows ---------------------------
-        nchunks = -(-prows // nb)
-        pad_rows = nchunks * nb - prows
-        stacked = jnp.pad(panel, ((0, pad_rows), (0, 0)))
-        chunks = stacked.reshape(nchunks, nb, w)
-        cand_idx = (jnp.arange(nchunks * nb, dtype=jnp.int32)
-                    .reshape(nchunks, nb))
-        # round 0: local LU per chunk picks each chunk's top-w rows
-        while chunks.shape[0] > 1:
-            _, _, perms_c = jax.vmap(jax.lax.linalg.lu)(chunks)
-            top = jax.vmap(lambda c, p: c[p][:w])(chunks, perms_c)
-            topi = jax.vmap(lambda ci, p: ci[p][:w])(cand_idx, perms_c)
-            # pair up winners for the next round
-            nc = top.shape[0]
-            if nc % 2 == 1:
-                top = jnp.concatenate(
-                    [top, jnp.zeros((1,) + top.shape[1:], top.dtype)])
-                topi = jnp.concatenate(
-                    [topi, jnp.full((1, w), mpad, jnp.int32)])
-                nc += 1
-            chunks = top.reshape(nc // 2, 2 * w, w)
-            cand_idx = topi.reshape(nc // 2, 2 * w)
-        _, _, pfin = jax.lax.linalg.lu(chunks[0])
-        winners = cand_idx[0][pfin][:w]  # panel-relative row indices
-        # A winner may be a padding sentinel (index ≥ prows: zero-padded
-        # rows of the last chunk, or the mpad filler of an odd pairing) —
-        # possible when a panel column is entirely zero. Clamping would
-        # duplicate a real row and corrupt the permutation; instead give
-        # each sentinel slot a distinct unused row, so p_perm stays a
-        # valid permutation and singularity surfaces only via info.
-        valid = winners < prows
-        used = (jnp.zeros(prows + 1, bool)
-                .at[jnp.where(valid, winners, prows)].set(True))[:prows]
-        unused = jnp.nonzero(~used, size=prows,
-                             fill_value=prows - 1)[0].astype(jnp.int32)
-        slot = jnp.cumsum(~valid) - (~valid)  # per-slot sentinel ordinal
-        winners = jnp.where(valid, winners, unused[slot])
-        # --- swap winners to the top, then no-pivot elimination --------
-        others_mask = jnp.ones(prows, bool).at[winners].set(False)
-        rest = jnp.nonzero(others_mask, size=prows - w, fill_value=0)[0]
-        p_perm = jnp.concatenate([winners, rest.astype(jnp.int32)])
+        p_perm = _tournament_perm(panel, w, nb, prows, mpad)
         a = a.at[k0:, :].set(a[k0:, :][p_perm])
         perm = perm.at[k0:].set(perm[k0:][p_perm])
         # eliminate panel without further pivoting

@@ -153,3 +153,23 @@ def test_gesv_mixed():
     res = np.linalg.norm(b - a @ X.to_numpy(), np.inf) / (
         np.linalg.norm(a, np.inf) * np.linalg.norm(X.to_numpy(), np.inf))
     assert res < 1e-13
+
+
+def test_getrf_pivot_threshold_tournament():
+    """pivot_threshold < 1 (the Option::PivotThreshold analog) swaps the
+    panel's argmax/swap chain for the vmap-batched CALU tournament."""
+    from slate_tpu.core.types import Options
+    n = 192
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((n, n))
+    A = st.from_dense(a, nb=64)
+    LU, perm, info = st.getrf(A, Options(pivot_threshold=0.5))
+    lu = np.asarray(LU.dense_canonical(), np.float64)
+    npad = lu.shape[0]
+    l = np.tril(lu, -1) + np.eye(npad)
+    u = np.triu(lu)
+    pa = np.asarray(A.dense_canonical(), np.float64)[np.asarray(perm)]
+    assert np.abs(pa - l @ u).max() < n * 1e-13
+    b = rng.standard_normal((n, 3))
+    X = st.getrs(LU, perm, st.from_dense(b, nb=64))
+    assert np.abs(a @ X.to_numpy() - b).max() < n * 1e-12
