@@ -79,17 +79,11 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
     m, w = a.shape
     if w <= nb:
         if threshold < 1.0 and m > w:
-            # Option::PivotThreshold analog: tournament panel (see
-            # _getrf_iter) — compaction perm, so callers must apply it
-            # with a full gather
-            p_p = _tournament_perm(a, w, nb, m, m)
-            pan_w = a[p_p]
-            lu_top, info = _lu_nopiv_recursive(pan_w[:w])
-            below = jax.lax.linalg.triangular_solve(
-                lu_top, pan_w[w:], left_side=False, lower=False,
-                unit_diagonal=False)
-            return (jnp.concatenate([lu_top, below], axis=0), p_p,
-                    info.astype(jnp.int32))
+            # Option::PivotThreshold analog: tournament panel —
+            # compaction perm, so callers must apply it with a full
+            # gather
+            lu_p, p_p, info = _tournament_panel(a, w, nb, m)
+            return lu_p, p_p, info
         hb = blocked.bucket_pow2(m, nb)
         ap = jnp.pad(a, ((0, hb - m), (0, 0))) if hb > m else a
         g = blocked.current_grid()
@@ -154,18 +148,13 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0):
         panel = a[k0:, k0:k1]
         if threshold < 1.0:
             # tournament panel: argmax/swap chain leaves the critical
-            # path; elimination is the no-pivot recursion on winners.
-            # One full-row gather (the tournament permutation compacts
-            # ALL rows — not a bounded-displacement swap list); the
-            # permuted panel is a slice of it.
+            # path. One full-row gather (the tournament permutation
+            # compacts ALL rows — not a bounded-displacement swap
+            # list); the panel elimination reuses the permuted slice.
             p_p = _tournament_perm(panel, nb, nb, rows, m)
             moved = a[k0:, :][p_p]
-            pan_w = moved[:, k0:k1]
-            lu_top, i_p = _lu_nopiv_recursive(pan_w[:nb])
-            below = jax.lax.linalg.triangular_solve(
-                lu_top, pan_w[nb:], left_side=False, lower=False,
-                unit_diagonal=False)
-            lu_p = jnp.concatenate([lu_top, below], axis=0)
+            lu_p, _, i_p = _tournament_panel(
+                moved[:, k0:k1], nb, nb, rows, perm_done=True)
         else:
             hb = blocked.bucket_pow2(rows, nb)
             if hb > rows:
@@ -345,6 +334,28 @@ def _tournament_perm(panel: Array, w: int, nb: int, prows: int,
     others_mask = jnp.ones(prows, bool).at[winners].set(False)
     rest = jnp.nonzero(others_mask, size=prows - w, fill_value=0)[0]
     return jnp.concatenate([winners, rest.astype(jnp.int32)])
+
+
+def _tournament_panel(panel: Array, w: int, nb: int, prows: int,
+                      perm_done: bool = False
+                      ) -> Tuple[Array, Array, Array]:
+    """Tournament-pivoted panel factorization: select winners
+    (_tournament_perm), then eliminate without further pivoting —
+    (lu packed, compaction perm, info). ``perm_done``: the caller
+    already applied the permutation to ``panel`` (it then passes the
+    permuted slice and ignores the returned iota)."""
+    if perm_done:
+        p_p = jnp.arange(prows, dtype=jnp.int32)
+        pan_w = panel
+    else:
+        p_p = _tournament_perm(panel, w, nb, prows, prows)
+        pan_w = panel[p_p]
+    lu_top, info = _lu_nopiv_recursive(pan_w[:w])
+    below = jax.lax.linalg.triangular_solve(
+        lu_top, pan_w[w:], left_side=False, lower=False,
+        unit_diagonal=False)
+    return (jnp.concatenate([lu_top, below], axis=0), p_p,
+            info.astype(jnp.int32))
 
 
 @accurate_matmuls

@@ -173,3 +173,20 @@ def test_getrf_pivot_threshold_tournament():
     b = rng.standard_normal((n, 3))
     X = st.getrs(LU, perm, st.from_dense(b, nb=64))
     assert np.abs(a @ X.to_numpy() - b).max() < n * 1e-12
+
+
+def test_getrf_pivot_threshold_recursive_base():
+    """Tall single-panel shape routes through _getrf_rec's tournament
+    base (the iterative path needs k % nb == 0 AND k//nb > 1)."""
+    from slate_tpu.core.types import Options
+    m, n, nb = 160, 32, 32
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((m, n))
+    A = st.from_dense(a, nb=nb)
+    LU, perm, info = st.getrf(A, Options(pivot_threshold=0.5))
+    lu = np.asarray(LU.dense_canonical(), np.float64)
+    mpad = lu.shape[0]
+    l = np.tril(lu, -1)[:, :n] + np.eye(mpad, n)
+    u = np.triu(lu)[:n, :]
+    pa = np.asarray(A.dense_canonical(), np.float64)[np.asarray(perm)]
+    assert np.abs(pa - l @ u).max() < m * 1e-13
