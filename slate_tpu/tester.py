@@ -758,7 +758,9 @@ def _t_bdsqr(ctx):
     s, u, vt = bdsqr(d, e, compute_uv=True)
     secs = time.perf_counter() - t0
     B = np.diag(d) + np.diag(e, 1)
-    epsd = np.finfo(np.float64).eps
+    # eps of the COMPUTED dtype: bdsqr's rotations run at the backend
+    # working precision (f32 when x64 is off), not the f64 inputs'
+    epsd = np.finfo(np.asarray(u).dtype).eps
     res = _rel(np.abs(B @ np.asarray(vt).T - np.asarray(u)
                       * np.asarray(s)).max(),
                epsd * n * max(np.abs(np.asarray(s)).max(), 1e-300))

@@ -149,8 +149,10 @@ def test_pad_diag_identity():
 
 def test_trtri_lower_batched_matches_recursion():
     """The batched-leaf inverse (round-4 panel kernel) against the plain
-    recursion and numpy, unit and non-unit, aligned and fallback."""
-    import jax.numpy as jnp
+    recursion and numpy, unit and non-unit, aligned and fallback.
+    Inputs carry garbage in the strict upper triangle (must be ignored)
+    and a non-unit stored diagonal in the unit case (unit=True must
+    ignore the stored diagonal)."""
     from slate_tpu.ops import blocked
 
     rng = np.random.default_rng(0)
@@ -159,14 +161,16 @@ def test_trtri_lower_batched_matches_recursion():
         # exponentially in n, which would swamp any entrywise check
         l = np.tril(rng.standard_normal((n, n))) / np.sqrt(n)
         l[np.arange(n), np.arange(n)] = 2.0 + np.abs(l.diagonal())
+        # garbage above the diagonal: only the lower triangle is read
+        lu = l + np.triu(rng.standard_normal((n, n)), 1) * 1e3
         for unit in (False, True):
-            lu = l.copy()
-            if unit:
-                lu[np.arange(n), np.arange(n)] = 1.0
             got = np.asarray(blocked.trtri_lower_batched(
                 jnp.asarray(lu, jnp.float64), unit=unit, leaf=leaf))
+            # the effective matrix: stored diagonal for non-unit,
+            # implicit ones (stored diagonal IGNORED) for unit
             tl = np.tril(lu)
-            # functional residual with the LAPACK-style scaling
+            if unit:
+                tl = np.tril(lu, -1) + np.eye(n)
             res = np.abs(tl @ got - np.eye(n)).max()
             bound = n * 1e-14 * np.linalg.norm(tl, 1) * np.linalg.norm(
                 got, 1)
@@ -178,7 +182,6 @@ def test_trtri_lower_batched_matches_recursion():
 
 
 def test_trtri_lower_batched_complex():
-    import jax.numpy as jnp
     from slate_tpu.ops import blocked
 
     rng = np.random.default_rng(1)
