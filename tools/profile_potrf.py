@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Phase decomposition + profiler trace for the iterative potrf.
+
+Pre-staged for the on-chip session (VERDICT r4 next-round #1): answers
+"where does the potrf time go on a real chip" with measurements, not
+arguments. Three chained-phase timings reconstruct the per-step budget
+of _potrf_iter (slate_tpu/linalg/cholesky.py):
+
+  tiles    — the nt sequential diagonal-tile Choleskys (latency floor)
+  panels   — per-step batched-leaf inverse + panel gemm
+  trailing — per-step triangle-aware herk recursion (the MXU flops)
+
+and the full driver is timed with the same scan methodology as bench.py
+(dispatch/sync overhead cancels between two scan lengths). If
+t_total ≈ t_tiles + t_panels + t_trailing the phases serialize (single
+chip: expected — there is a true data dependence); the printed
+panel_fraction is the share a mesh's async scheduler could hide under
+the trailing update (the Lookahead/P3 capability,
+/root/reference/src/potrf.cc:84-195).
+
+Optionally captures a jax.profiler trace of ONE full potrf call
+(--trace DIR) for the committed artifact; on a ≥2-device backend the
+trace is the direct overlap evidence (look for all-gather ops running
+concurrently with the trailing-update fusions).
+
+Usage: python tools/profile_potrf.py [n] [nb] [--trace DIR]
+Writes one JSON line to stdout; commentary to stderr.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from slate_tpu.compat.platform import apply_env_platforms  # noqa: E402
+
+apply_env_platforms()  # honor JAX_PLATFORMS despite the axon sitecustomize
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# single source of truth for the timing protocol — the two committed
+# evidence producers (bench.py, this) must share one methodology
+from bench import _per_iter_seconds  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int, nargs="?", default=8192)
+    ap.add_argument("nb", type=int, nargs="?", default=1024)
+    ap.add_argument("--trace", default=None, metavar="DIR")
+    opts = ap.parse_args()
+    n, nb, trace_dir = opts.n, opts.nb, opts.trace
+
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+    from slate_tpu.linalg.cholesky import _potrf_iter, _tile_chol
+    from slate_tpu.matgen import random_spd
+    from slate_tpu.ops import blocked
+
+    plat = jax.devices()[0].platform
+    print(f"# platform={plat} n={n} nb={nb} nt={n // nb}", file=sys.stderr)
+
+    a0 = jnp.tril(random_spd(n, dtype=jnp.float32, seed=3))
+    a0 = a0 + n * jnp.eye(n, dtype=jnp.float32)  # keep iterates SPD
+    nt = n // nb
+    prec = "high"
+
+    def full(a):
+        out, _ = _potrf_iter(a, nb, prec)
+        return a + 1e-30 * out
+
+    def tiles_only(a):
+        out = a
+        for k in range(nt):
+            k0, k1 = k * nb, (k + 1) * nb
+            lkk, _ = _tile_chol(out[k0:k1, k0:k1])
+            out = jax.lax.dynamic_update_slice(out, lkk, (k0, k0))
+        return a + 1e-30 * out
+
+    def panels_only(a):
+        out = a
+        for k in range(nt - 1):
+            k0, k1 = k * nb, (k + 1) * nb
+            inv = blocked.trtri_lower_batched(out[k0:k1, k0:k1])
+            pan = blocked.mm(out[k1:, k0:k1], jnp.conj(inv).T, prec)
+            out = jax.lax.dynamic_update_slice(out, pan, (k1, k0))
+        return a + 1e-30 * out
+
+    def trailing_only(a):
+        out = a
+        for k in range(nt - 1):
+            k0, k1 = k * nb, (k + 1) * nb
+            trail = blocked.herk_lower_rec(
+                out[k1:, k1:], out[k1:, k0:k1], prec=prec)
+            out = jax.lax.dynamic_update_slice(out, trail, (k1, k1))
+        return a + 1e-30 * out
+
+    res = {"platform": plat, "n": n, "nb": nb, "nt": nt}
+    for name, fn in (("total", full), ("tiles", tiles_only),
+                     ("panels", panels_only), ("trailing", trailing_only)):
+        t = _per_iter_seconds(lambda c, cs, f=fn: f(c), a0, (), k1=2, k2=6)
+        res[f"t_{name}_ms"] = round(t * 1e3, 2)
+        print(f"# {name:9s} {t * 1e3:8.2f} ms/iter", file=sys.stderr)
+    phase_sum = res["t_tiles_ms"] + res["t_panels_ms"] + res["t_trailing_ms"]
+    res["t_phase_sum_ms"] = round(phase_sum, 2)
+    res["panel_fraction"] = round(
+        (res["t_tiles_ms"] + res["t_panels_ms"]) / max(res["t_total_ms"], 1e-9), 3)
+    res["serialization"] = round(res["t_total_ms"] / max(phase_sum, 1e-9), 3)
+    gflops = (n ** 3 / 3.0) / 1e9 / (res["t_total_ms"] / 1e3)
+    res["potrf_gflops"] = round(gflops, 1)
+
+    if trace_dir:
+        # trace the JITTED program (eager dispatch would serialize ops
+        # host-side and falsely show zero overlap)
+        jit_potrf = jax.jit(lambda x: _potrf_iter(x, nb, prec))
+        jax.block_until_ready(jit_potrf(a0))  # warm the compile cache
+        with jax.profiler.trace(trace_dir):
+            out, info = jit_potrf(a0)
+            jax.block_until_ready(out)
+        res["trace_dir"] = trace_dir
+        print(f"# trace written to {trace_dir}", file=sys.stderr)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/bin/sh
+# Pre-staged on-chip measurement session (VERDICT r4 next-round #1).
+# Run the moment the TPU tunnel is healthy; every step has a hard
+# timeout with SIGKILL follow-up (the tunnel hang ignores SIGTERM) so a
+# mid-session drop cannot hang the shell, and each artifact is written
+# via a temp file so a failed step never ships an empty/partial JSON.
+#
+#   sh tools/tpu_session.sh
+#
+# Artifacts (commit them):
+#   PERF_r05_n16384.json  bench.py at BASELINE continuity size
+#   PERF_r05_n8192.json   bench.py at the r2 series size
+#   PERF_r05_profile.json phase decomposition of the iterative potrf
+#   perf_traces/          jax.profiler trace of one potrf call
+set -ex
+cd "$(dirname "$0")/.."
+
+# 1. probe (killable; bench.py re-probes too, belt and braces)
+timeout -k 10 90 python /tmp/probe_tpu.py || timeout -k 10 90 python -c \
+  "import jax; print(jax.devices())"
+
+# 2. headline bench at n=16384 (BASELINE size) and 8192 (r2 continuity)
+timeout -k 10 3600 python bench.py 16384 > PERF_r05_n16384.json.tmp \
+  && mv PERF_r05_n16384.json.tmp PERF_r05_n16384.json
+timeout -k 10 1800 python bench.py 8192 > PERF_r05_n8192.json.tmp \
+  && mv PERF_r05_n8192.json.tmp PERF_r05_n8192.json
+
+# 3. potrf phase decomposition + one profiler trace
+timeout -k 10 1800 python tools/profile_potrf.py 8192 1024 \
+  --trace perf_traces/potrf_n8192 > PERF_r05_profile.json.tmp \
+  && mv PERF_r05_profile.json.tmp PERF_r05_profile.json
+timeout -k 10 1800 python tools/profile_potrf.py 16384 1024 \
+  > PERF_r05_profile_n16384.json.tmp \
+  && mv PERF_r05_profile_n16384.json.tmp PERF_r05_profile_n16384.json
+
+tail -n 1 PERF_r05_n16384.json PERF_r05_n8192.json PERF_r05_profile.json
