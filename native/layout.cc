@@ -12,8 +12,15 @@
 // block-cyclic local arrays, and staging those into the global row-major
 // buffers jax.device_put expects is a memory-bound strided copy that
 // belongs in native code. These kernels are exposed through ctypes
-// (slate_tpu/interop/scalapack.py) and parallelized with OpenMP, matching
+// (slate_tpu/interop/native.py) and parallelized with OpenMP, matching
 // the reference's use of OpenMP for host-side data motion.
+//
+// All kernels are templated over the element TYPE and exported with an
+// explicit element-size argument (4 = f32, 8 = f64, 16 = c128; c64 rides
+// the f64 instantiation — any 8-byte POD moves identically), the same
+// four-precision surface the reference's scalapack_api exports per
+// routine (scalapack_api/scalapack_potrf.cc:44-110). The esize-less f64
+// symbols are kept as wrappers for existing callers.
 //
 // Layout conventions:
 //  - global: row-major (m x n), leading dimension ldg >= n.
@@ -36,16 +43,17 @@
 #include <omp.h>
 #endif
 
-extern "C" {
+namespace {
+
+struct alignas(16) c128 { double re, im; };
+static_assert(sizeof(c128) == 16, "c128 must be 16 bytes");
 
 // Number of local tile-rows for grid coordinate pi of p over mt tiles.
-static inline int64_t local_tiles(int64_t mt, int64_t p, int64_t pi) {
+inline int64_t local_tiles(int64_t mt, int64_t p, int64_t pi) {
     return (mt - pi + p - 1) / p;
 }
 
-// ScaLAPACK numroc (TOOLS/numroc.f) with source process 0: how many of
-// the m rows land on grid coordinate pi of p with block size nb.
-int64_t st_numroc(int64_t m, int64_t nb, int64_t pi, int64_t p) {
+inline int64_t numroc_impl(int64_t m, int64_t nb, int64_t pi, int64_t p) {
     const int64_t nblocks = m / nb;
     int64_t loc = (nblocks / p) * nb;
     const int64_t extra = nblocks % p;
@@ -54,15 +62,13 @@ int64_t st_numroc(int64_t m, int64_t nb, int64_t pi, int64_t p) {
     return loc;
 }
 
-// Pack a row-major global (m x n) matrix into one process's TRUE
-// ScaLAPACK local buffer: column-major (lld x nloc), lld >= mloc =
-// numroc(m, nb, pi, p). Returns 0 on success.
-int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
-                   int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
-                   double* local, int64_t lld) {
+template <typename T>
+int64_t bc_pack_t(const T* global, int64_t m, int64_t n, int64_t ldg,
+                  int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
+                  T* local, int64_t lld) {
     if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
     if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
-    if (lld < st_numroc(m, nb, pi, p)) return -3;
+    if (lld < numroc_impl(m, nb, pi, p)) return -3;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
     const int64_t mtl = local_tiles(mt, p, pi);
@@ -76,8 +82,8 @@ int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
             for (int64_t c = 0; c < cols; ++c) {
-                double* dst = local + (jl * nb + c) * lld + il * nb;
-                const double* src = global + r0 * ldg + (c0 + c);
+                T* dst = local + (jl * nb + c) * lld + il * nb;
+                const T* src = global + r0 * ldg + (c0 + c);
                 for (int64_t r = 0; r < rows; ++r)
                     dst[r] = src[r * ldg];
             }
@@ -86,15 +92,13 @@ int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
     return 0;
 }
 
-// Inverse of st_bc_pack: scatter one process's ScaLAPACK column-major
-// local buffer back into the row-major global matrix (only this
-// process's entries are written).
-int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
-                     int64_t nb, int64_t p, int64_t q, int64_t pi,
-                     int64_t qi, double* global, int64_t lld) {
+template <typename T>
+int64_t bc_unpack_t(const T* local, int64_t m, int64_t n, int64_t ldg,
+                    int64_t nb, int64_t p, int64_t q, int64_t pi,
+                    int64_t qi, T* global, int64_t lld) {
     if (!global || !local || nb <= 0 || p <= 0 || q <= 0) return -1;
     if (pi < 0 || pi >= p || qi < 0 || qi >= q) return -2;
-    if (lld < st_numroc(m, nb, pi, p)) return -3;
+    if (lld < numroc_impl(m, nb, pi, p)) return -3;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
     const int64_t mtl = local_tiles(mt, p, pi);
@@ -108,8 +112,8 @@ int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
             for (int64_t c = 0; c < cols; ++c) {
-                const double* src = local + (jl * nb + c) * lld + il * nb;
-                double* dst = global + r0 * ldg + (c0 + c);
+                const T* src = local + (jl * nb + c) * lld + il * nb;
+                T* dst = global + r0 * ldg + (c0 + c);
                 for (int64_t r = 0; r < rows; ++r)
                     dst[r * ldg] = src[r];
             }
@@ -118,11 +122,9 @@ int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
     return 0;
 }
 
-// Pack a row-major global matrix into tile-major (mt, nt, nb, nb) order
-// (padded). The host-side analog of the reference's tile layout
-// (Tile.hh + MatrixStorage tile map) used for fast staging.
-int64_t st_tile_pack(const double* global, int64_t m, int64_t n,
-                     int64_t ldg, int64_t nb, double* tiles) {
+template <typename T>
+int64_t tile_pack_t(const T* global, int64_t m, int64_t n, int64_t ldg,
+                    int64_t nb, T* tiles) {
     if (!global || !tiles || nb <= 0) return -1;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
@@ -132,23 +134,24 @@ int64_t st_tile_pack(const double* global, int64_t m, int64_t n,
             const int64_t r0 = i * nb, c0 = j * nb;
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
-            double* t = tiles + ((i * nt) + j) * nb * nb;
+            T* t = tiles + ((i * nt) + j) * nb * nb;
             for (int64_t r = 0; r < rows; ++r) {
                 std::memcpy(t + r * nb, global + (r0 + r) * ldg + c0,
-                            size_t(cols) * sizeof(double));
+                            size_t(cols) * sizeof(T));
                 if (cols < nb)
                     std::memset(t + r * nb + cols, 0,
-                                size_t(nb - cols) * sizeof(double));
+                                size_t(nb - cols) * sizeof(T));
             }
             for (int64_t r = rows; r < nb; ++r)
-                std::memset(t + r * nb, 0, size_t(nb) * sizeof(double));
+                std::memset(t + r * nb, 0, size_t(nb) * sizeof(T));
         }
     }
     return 0;
 }
 
-int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
-                       int64_t ldg, int64_t nb, double* global) {
+template <typename T>
+int64_t tile_unpack_t(const T* tiles, int64_t m, int64_t n, int64_t ldg,
+                      int64_t nb, T* global) {
     if (!global || !tiles || nb <= 0) return -1;
     const int64_t mt = (m + nb - 1) / nb;
     const int64_t nt = (n + nb - 1) / nb;
@@ -158,10 +161,10 @@ int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
             const int64_t r0 = i * nb, c0 = j * nb;
             const int64_t rows = std::min(nb, m - r0);
             const int64_t cols = std::min(nb, n - c0);
-            const double* t = tiles + ((i * nt) + j) * nb * nb;
+            const T* t = tiles + ((i * nt) + j) * nb * nb;
             for (int64_t r = 0; r < rows; ++r)
                 std::memcpy(global + (r0 + r) * ldg + c0, t + r * nb,
-                            size_t(cols) * sizeof(double));
+                            size_t(cols) * sizeof(T));
         }
     }
     return 0;
@@ -169,8 +172,9 @@ int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
 
 // Column-major (LAPACK/ScaLAPACK) <-> row-major conversion with OpenMP
 // blocking (the host analog of device_transpose.cu).
-int64_t st_colmajor_to_rowmajor(const double* cm, int64_t m, int64_t n,
-                                int64_t ldcm, double* rm, int64_t ldrm) {
+template <typename T>
+int64_t cm_to_rm_t(const T* cm, int64_t m, int64_t n, int64_t ldcm, T* rm,
+                   int64_t ldrm) {
     if (!cm || !rm) return -1;
     const int64_t B = 64;
 #pragma omp parallel for collapse(2) schedule(static)
@@ -186,8 +190,9 @@ int64_t st_colmajor_to_rowmajor(const double* cm, int64_t m, int64_t n,
     return 0;
 }
 
-int64_t st_rowmajor_to_colmajor(const double* rm, int64_t m, int64_t n,
-                                int64_t ldrm, double* cm, int64_t ldcm) {
+template <typename T>
+int64_t rm_to_cm_t(const T* rm, int64_t m, int64_t n, int64_t ldrm, T* cm,
+                   int64_t ldcm) {
     if (!rm || !cm) return -1;
     const int64_t B = 64;
 #pragma omp parallel for collapse(2) schedule(static)
@@ -201,6 +206,141 @@ int64_t st_rowmajor_to_colmajor(const double* rm, int64_t m, int64_t n,
         }
     }
     return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t st_numroc(int64_t m, int64_t nb, int64_t pi, int64_t p) {
+    return numroc_impl(m, nb, pi, p);
+}
+
+// ---- element-size generic entry points (4 = f32, 8 = f64/c64, 16 = c128)
+
+int64_t st_bc_pack_e(const void* global, int64_t m, int64_t n, int64_t ldg,
+                     int64_t nb, int64_t p, int64_t q, int64_t pi,
+                     int64_t qi, void* local, int64_t lld, int64_t esize) {
+    return esize == 8
+        ? bc_pack_t(static_cast<const double*>(global), m, n, ldg, nb,
+                    p, q, pi, qi, static_cast<double*>(local), lld)
+        : esize == 4
+        ? bc_pack_t(static_cast<const float*>(global), m, n, ldg, nb,
+                    p, q, pi, qi, static_cast<float*>(local), lld)
+        : esize == 16
+        ? bc_pack_t(static_cast<const c128*>(global), m, n, ldg, nb,
+                    p, q, pi, qi, static_cast<c128*>(local), lld)
+        : int64_t(-4);
+}
+
+int64_t st_bc_unpack_e(const void* local, int64_t m, int64_t n,
+                       int64_t ldg, int64_t nb, int64_t p, int64_t q,
+                       int64_t pi, int64_t qi, void* global, int64_t lld,
+                       int64_t esize) {
+    return esize == 8
+        ? bc_unpack_t(static_cast<const double*>(local), m, n, ldg, nb, p,
+                      q, pi, qi, static_cast<double*>(global), lld)
+        : esize == 4
+        ? bc_unpack_t(static_cast<const float*>(local), m, n, ldg, nb, p,
+                      q, pi, qi, static_cast<float*>(global), lld)
+        : esize == 16
+        ? bc_unpack_t(static_cast<const c128*>(local), m, n, ldg, nb, p,
+                      q, pi, qi, static_cast<c128*>(global), lld)
+        : int64_t(-4);
+}
+
+int64_t st_tile_pack_e(const void* global, int64_t m, int64_t n,
+                       int64_t ldg, int64_t nb, void* tiles,
+                       int64_t esize) {
+    return esize == 8
+        ? tile_pack_t(static_cast<const double*>(global), m, n, ldg, nb,
+                      static_cast<double*>(tiles))
+        : esize == 4
+        ? tile_pack_t(static_cast<const float*>(global), m, n, ldg, nb,
+                      static_cast<float*>(tiles))
+        : esize == 16
+        ? tile_pack_t(static_cast<const c128*>(global), m, n, ldg, nb,
+                      static_cast<c128*>(tiles))
+        : int64_t(-4);
+}
+
+int64_t st_tile_unpack_e(const void* tiles, int64_t m, int64_t n,
+                         int64_t ldg, int64_t nb, void* global,
+                         int64_t esize) {
+    return esize == 8
+        ? tile_unpack_t(static_cast<const double*>(tiles), m, n, ldg, nb,
+                        static_cast<double*>(global))
+        : esize == 4
+        ? tile_unpack_t(static_cast<const float*>(tiles), m, n, ldg, nb,
+                        static_cast<float*>(global))
+        : esize == 16
+        ? tile_unpack_t(static_cast<const c128*>(tiles), m, n, ldg, nb,
+                        static_cast<c128*>(global))
+        : int64_t(-4);
+}
+
+int64_t st_colmajor_to_rowmajor_e(const void* cm, int64_t m, int64_t n,
+                                  int64_t ldcm, void* rm, int64_t ldrm,
+                                  int64_t esize) {
+    return esize == 8
+        ? cm_to_rm_t(static_cast<const double*>(cm), m, n, ldcm,
+                     static_cast<double*>(rm), ldrm)
+        : esize == 4
+        ? cm_to_rm_t(static_cast<const float*>(cm), m, n, ldcm,
+                     static_cast<float*>(rm), ldrm)
+        : esize == 16
+        ? cm_to_rm_t(static_cast<const c128*>(cm), m, n, ldcm,
+                     static_cast<c128*>(rm), ldrm)
+        : int64_t(-4);
+}
+
+int64_t st_rowmajor_to_colmajor_e(const void* rm, int64_t m, int64_t n,
+                                  int64_t ldrm, void* cm, int64_t ldcm,
+                                  int64_t esize) {
+    return esize == 8
+        ? rm_to_cm_t(static_cast<const double*>(rm), m, n, ldrm,
+                     static_cast<double*>(cm), ldcm)
+        : esize == 4
+        ? rm_to_cm_t(static_cast<const float*>(rm), m, n, ldrm,
+                     static_cast<float*>(cm), ldcm)
+        : esize == 16
+        ? rm_to_cm_t(static_cast<const c128*>(rm), m, n, ldrm,
+                     static_cast<c128*>(cm), ldcm)
+        : int64_t(-4);
+}
+
+// ---- f64 compatibility wrappers (pre-round-5 symbol names) ------------
+
+int64_t st_bc_pack(const double* global, int64_t m, int64_t n, int64_t ldg,
+                   int64_t nb, int64_t p, int64_t q, int64_t pi, int64_t qi,
+                   double* local, int64_t lld) {
+    return bc_pack_t(global, m, n, ldg, nb, p, q, pi, qi, local, lld);
+}
+
+int64_t st_bc_unpack(const double* local, int64_t m, int64_t n, int64_t ldg,
+                     int64_t nb, int64_t p, int64_t q, int64_t pi,
+                     int64_t qi, double* global, int64_t lld) {
+    return bc_unpack_t(local, m, n, ldg, nb, p, q, pi, qi, global, lld);
+}
+
+int64_t st_tile_pack(const double* global, int64_t m, int64_t n,
+                     int64_t ldg, int64_t nb, double* tiles) {
+    return tile_pack_t(global, m, n, ldg, nb, tiles);
+}
+
+int64_t st_tile_unpack(const double* tiles, int64_t m, int64_t n,
+                       int64_t ldg, int64_t nb, double* global) {
+    return tile_unpack_t(tiles, m, n, ldg, nb, global);
+}
+
+int64_t st_colmajor_to_rowmajor(const double* cm, int64_t m, int64_t n,
+                                int64_t ldcm, double* rm, int64_t ldrm) {
+    return cm_to_rm_t(cm, m, n, ldcm, rm, ldrm);
+}
+
+int64_t st_rowmajor_to_colmajor(const double* rm, int64_t m, int64_t n,
+                                int64_t ldrm, double* cm, int64_t ldcm) {
+    return rm_to_cm_t(rm, m, n, ldrm, cm, ldcm);
 }
 
 }  // extern "C"

@@ -5,18 +5,27 @@ BaseMatrix's layout-conversion machinery. The shared library is built on
 first use with the repo's Makefile (g++ -fopenmp); if no compiler is
 available, every entry point falls back to an equivalent numpy path so
 the framework stays importable (reference behavior: the APIs are optional
-CMake components, CMakeLists.txt:56).
+CMake components, CMakeLists.txt:56). The fallback is LOGGED once
+(logging.warning) so a perf-relevant degradation can't pass silently.
+
+Round 5: all packers are dtype-generic — f32/f64/c64/c128 dispatch into
+the element-size-templated native kernels (st_*_e symbols), matching the
+reference's four-precision scalapack_api surface
+(scalapack_api/scalapack_potrf.cc:44-110).
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
+
+_LOG = logging.getLogger("slate_tpu.interop")
 
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -27,7 +36,17 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _SO = os.path.join(_NATIVE_DIR, "libslate_tpu_host.so")
 
 _I64 = ctypes.c_int64
+_PV = ctypes.c_void_p
 _PD = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+# dtypes the native kernels move (esize dispatch); everything else uses
+# the numpy fallback paths
+_NATIVE_DTYPES = {
+    np.dtype(np.float32): 4,
+    np.dtype(np.float64): 8,
+    np.dtype(np.complex64): 8,    # any 8-byte POD moves identically
+    np.dtype(np.complex128): 16,
+}
 
 
 def _build() -> bool:
@@ -40,24 +59,44 @@ def _build() -> bool:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable
+    (logged once — the numpy fallback is slower, not wrong)."""
     global _LIB, _TRIED
     with _LOCK:
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
         if not os.path.exists(_SO) and not _build():
+            _LOG.warning(
+                "native layout library unavailable (no compiler or build "
+                "failed); interop packers fall back to numpy — correct "
+                "but slower")
             return None
         symbols = [
             ("st_numroc", [_I64, _I64, _I64, _I64]),
+            # element-size generic entry points (round 5)
+            ("st_bc_pack_e", [_PV, _I64, _I64, _I64, _I64, _I64, _I64,
+                              _I64, _I64, _PV, _I64, _I64]),
+            ("st_bc_unpack_e", [_PV, _I64, _I64, _I64, _I64, _I64, _I64,
+                                _I64, _I64, _PV, _I64, _I64]),
+            ("st_tile_pack_e", [_PV, _I64, _I64, _I64, _I64, _PV, _I64]),
+            ("st_tile_unpack_e", [_PV, _I64, _I64, _I64, _I64, _PV,
+                                  _I64]),
+            ("st_colmajor_to_rowmajor_e", [_PV, _I64, _I64, _I64, _PV,
+                                           _I64, _I64]),
+            ("st_rowmajor_to_colmajor_e", [_PV, _I64, _I64, _I64, _PV,
+                                           _I64, _I64]),
+            # f64 compatibility names (older callers)
             ("st_bc_pack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
                             _I64, _PD, _I64]),
-            ("st_bc_unpack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64, _I64,
-                              _I64, _PD, _I64]),
+            ("st_bc_unpack", [_PD, _I64, _I64, _I64, _I64, _I64, _I64,
+                              _I64, _I64, _PD, _I64]),
             ("st_tile_pack", [_PD, _I64, _I64, _I64, _I64, _PD]),
             ("st_tile_unpack", [_PD, _I64, _I64, _I64, _I64, _PD]),
-            ("st_colmajor_to_rowmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
-            ("st_rowmajor_to_colmajor", [_PD, _I64, _I64, _I64, _PD, _I64]),
+            ("st_colmajor_to_rowmajor", [_PD, _I64, _I64, _I64, _PD,
+                                         _I64]),
+            ("st_rowmajor_to_colmajor", [_PD, _I64, _I64, _I64, _PD,
+                                         _I64]),
             ("st_steqr", [_I64, _PD, _PD, _PD, _I64, _I64]),
         ]
 
@@ -78,11 +117,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if lib is None and _build():
             lib = _load()
         _LIB = lib
+        if _LIB is None:
+            _LOG.warning(
+                "native layout library failed to load (stale or "
+                "unlinkable %s); interop packers fall back to numpy — "
+                "correct but slower", _SO)
         return _LIB
 
 
 def have_native() -> bool:
     return get_lib() is not None
+
+
+def _esize(dtype) -> Optional[int]:
+    """Native element size for ``dtype`` (None → numpy fallback only)."""
+    return _NATIVE_DTYPES.get(np.dtype(dtype))
+
+
+def _vp(a: np.ndarray):
+    return a.ctypes.data_as(_PV)
 
 
 # -- numpy fallbacks (same layout contracts as layout.cc) -------------------
@@ -111,15 +164,16 @@ def bc_pack(global_rm: np.ndarray, nb: int, p: int, q: int, pi: int,
             qi: int) -> np.ndarray:
     """Global row-major (m, n) → this process's TRUE ScaLAPACK local
     array: column-major (mloc, nloc) with mloc = numroc(m, nb, pi, p),
-    byte-compatible with BLACS/ScaLAPACK local buffers (lld = mloc)."""
-    a = np.ascontiguousarray(global_rm, dtype=np.float64)
+    byte-compatible with BLACS/ScaLAPACK local buffers (lld = mloc).
+    Keeps the input dtype (s/d/c/z all native-packed)."""
+    a = np.ascontiguousarray(global_rm)
     m, n = a.shape
     mloc, nloc = numroc(m, nb, pi, p), numroc(n, nb, qi, q)
-    lib = get_lib()
-    if lib is not None:
-        flat = np.zeros(mloc * nloc, np.float64)
-        rc = lib.st_bc_pack(a, m, n, a.strides[0] // 8, nb, p, q, pi, qi,
-                            flat, mloc)
+    lib, es = get_lib(), _esize(a.dtype)
+    if lib is not None and es is not None:
+        flat = np.zeros(mloc * nloc, a.dtype)
+        rc = lib.st_bc_pack_e(_vp(a), m, n, a.strides[0] // a.itemsize,
+                              nb, p, q, pi, qi, _vp(flat), mloc, es)
         if rc == 0:
             return flat.reshape((mloc, nloc), order="F")
     gr = _cyclic_indices(m, nb, pi, p)
@@ -136,10 +190,11 @@ def bc_unpack(local: np.ndarray, m: int, n: int, nb: int, p: int, q: int,
     ``local`` may be a (lld, nloc) 2-D array (any memory order; rows
     beyond mloc are the unused lld slack) or a flat column-major buffer
     with ``lld`` given."""
+    loc = np.asarray(local)
     if out is None:
-        out = np.zeros((m, n), np.float64)
+        out = np.zeros((m, n), loc.dtype)
     mloc, nloc = numroc(m, nb, pi, p), numroc(n, nb, qi, q)
-    loc = np.asarray(local, dtype=np.float64)
+    loc = np.asarray(loc, dtype=out.dtype)
     if loc.ndim == 1:
         ld = lld if lld is not None else mloc
         loc = loc.reshape((ld, nloc), order="F")
@@ -148,12 +203,12 @@ def bc_unpack(local: np.ndarray, m: int, n: int, nb: int, p: int, q: int,
         raise ValueError(
             f"bc_unpack: local buffer {np.asarray(local).shape} too small "
             f"for numroc sizes ({mloc}, {nloc})")
-    lib = get_lib()
-    if lib is not None and out.flags.c_contiguous:
+    lib, es = get_lib(), _esize(out.dtype)
+    if lib is not None and es is not None and out.flags.c_contiguous:
         locf = np.asfortranarray(loc)
-        rc = lib.st_bc_unpack(locf.ravel(order="F"), m, n,
-                              out.strides[0] // 8, nb, p, q, pi, qi, out,
-                              mloc)
+        rc = lib.st_bc_unpack_e(_vp(locf), m, n,
+                                out.strides[0] // out.itemsize, nb, p, q,
+                                pi, qi, _vp(out), mloc, es)
         if rc == 0:
             return out
     gr = _cyclic_indices(m, nb, pi, p)
@@ -163,14 +218,14 @@ def bc_unpack(local: np.ndarray, m: int, n: int, nb: int, p: int, q: int,
 
 
 def tile_pack(global_rm: np.ndarray, nb: int) -> np.ndarray:
-    a = np.ascontiguousarray(global_rm, dtype=np.float64)
+    a = np.ascontiguousarray(global_rm)
     m, n = a.shape
     mt, nt = -(-m // nb), -(-n // nb)
-    out = np.zeros((mt, nt, nb, nb), np.float64)
-    lib = get_lib()
-    if lib is not None:
-        rc = lib.st_tile_pack(a, m, n, a.strides[0] // 8, nb,
-                              out.reshape(-1))
+    out = np.zeros((mt, nt, nb, nb), a.dtype)
+    lib, es = get_lib(), _esize(a.dtype)
+    if lib is not None and es is not None:
+        rc = lib.st_tile_pack_e(_vp(a), m, n, a.strides[0] // a.itemsize,
+                                nb, _vp(out), es)
         if rc == 0:
             return out
     for i in range(mt):
@@ -182,13 +237,14 @@ def tile_pack(global_rm: np.ndarray, nb: int) -> np.ndarray:
 
 
 def tile_unpack(tiles: np.ndarray, m: int, n: int) -> np.ndarray:
-    t = np.ascontiguousarray(tiles, dtype=np.float64)
+    t = np.ascontiguousarray(tiles)
     mt, nt, nb, _ = t.shape
-    out = np.zeros((m, n), np.float64)
-    lib = get_lib()
-    if lib is not None:
-        rc = lib.st_tile_unpack(t.reshape(-1), m, n, out.strides[0] // 8,
-                                nb, out)
+    out = np.zeros((m, n), t.dtype)
+    lib, es = get_lib(), _esize(t.dtype)
+    if lib is not None and es is not None:
+        rc = lib.st_tile_unpack_e(_vp(t), m, n,
+                                  out.strides[0] // out.itemsize, nb,
+                                  _vp(out), es)
         if rc == 0:
             return out
     for i in range(mt):
@@ -200,15 +256,13 @@ def tile_unpack(tiles: np.ndarray, m: int, n: int) -> np.ndarray:
 
 
 def colmajor_to_rowmajor(cm: np.ndarray) -> np.ndarray:
-    a = np.asfortranarray(cm, dtype=np.float64)
+    a = np.asfortranarray(cm)
     m, n = a.shape
-    out = np.empty((m, n), np.float64)
-    lib = get_lib()
-    if lib is not None:
-        # fortran array: strides[1]//8 is the column stride (ldcm)
-        rc = lib.st_colmajor_to_rowmajor(
-            np.ascontiguousarray(a.T.reshape(-1)).reshape(n * m), m, n, m,
-            out, n)
+    out = np.empty((m, n), a.dtype)
+    lib, es = get_lib(), _esize(a.dtype)
+    if lib is not None and es is not None:
+        rc = lib.st_colmajor_to_rowmajor_e(_vp(a), m, n, m, _vp(out), n,
+                                           es)
         if rc == 0:
             return out
     return np.ascontiguousarray(cm)

@@ -46,7 +46,9 @@ def from_scalapack(locals_: List[np.ndarray], m: int, n: int, nb: int,
     (BLACS default) unless order says otherwise."""
     if len(locals_) != p * q:
         raise ValueError(f"expected {p*q} local buffers, got {len(locals_)}")
-    out = np.zeros((m, n), np.float64)
+    dtype = np.result_type(*[np.asarray(x).dtype for x in locals_]) \
+        if locals_ else np.float64
+    out = np.zeros((m, n), dtype)
     for rank, loc in enumerate(locals_):
         if order is GridOrder.Col:
             pi, qi = rank % p, rank // p
@@ -61,7 +63,7 @@ def to_scalapack(A: TiledMatrix, p: int, q: int,
     """Split a TiledMatrix into per-process ScaLAPACK local arrays —
     column-major (mloc × nloc) with lld = mloc (the export direction of
     the scalapack_api)."""
-    a = A.to_numpy().astype(np.float64)
+    a = np.asarray(A.to_numpy())  # keeps dtype: s/d/c/z all native-packed
     out = []
     for rank in range(p * q):
         if order is GridOrder.Col:

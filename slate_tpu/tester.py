@@ -115,11 +115,9 @@ class Ctx:
             import jax.numpy as jnp
             import slate_tpu as st
             from slate_tpu.interop import scalapack as sca
-            if np.iscomplexobj(np.asarray(a)):
-                raise ValueError(
-                    "origin=scalapack supports real dtypes only (the "
-                    "native block-cyclic packers are f64)")
-            an = np.asarray(a, np.float64)
+            # s/d/c/z all round-trip through the native packers (round 5:
+            # element-size-templated layout kernels)
+            an = np.asarray(a, self.dtype)
             p, q = ((self.grid.p, self.grid.q) if self.grid is not None
                     else (2, 2))
             A0 = st.from_dense(an, nb=self.nb)

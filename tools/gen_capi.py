@@ -271,6 +271,8 @@ def fortran_iface(base, dt, args):
                          f" :: {aname}")
         elif kind == "x":
             decls.append(f"         {FTYPE[dt]}, value :: {aname}")
+        elif kind == "r":
+            decls.append(f"         {FRTYPE[dt]}, value :: {aname}")
         elif kind == "A":
             decls.append(f"         {FTYPE[dt]}, dimension(*) :: {aname}")
         elif kind == "R":
@@ -278,7 +280,8 @@ def fortran_iface(base, dt, args):
         elif kind == "P":
             decls.append(f"         integer(c_int64_t), dimension(*)"
                          f" :: {aname}")
-    ret = "real(c_double)" if base == "lange" else "integer(c_int64_t)"
+    ret = ("real(c_double)" if base in NORM_BASES
+           else "integer(c_int64_t)")
     arglist = ", ".join(fargs)
     head = f"      function {name}({arglist}) &"
     lines = [head,
