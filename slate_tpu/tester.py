@@ -1468,6 +1468,25 @@ def _t_heev_qr(ctx):
     return secs, err
 
 
+@register("gesv_calu", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)
+def _t_gesv_calu(ctx):
+    """MethodLU.CALU: tournament-pivoted LU (round-5 mesh-breadth row —
+    the reference sweeps CALU under mpirun, test/run_tests.py)."""
+    from slate_tpu.core.types import MethodLU, Options
+    return _lu_solver_case(
+        ctx, lambda st, A, B: st.gesv(A, B,
+                                      Options(method_lu=MethodLU.CALU))[0])
+
+
+@register("gesv_dist_panel", flops=lambda m, n: 2 * n ** 3 / 3.0)
+def _t_gesv_dist_panel(ctx):
+    """lu_dist_panel: the explicit shard_map distributed-panel path."""
+    from slate_tpu.core.types import Options
+    return _lu_solver_case(
+        ctx, lambda st, A, B: st.gesv(A, B,
+                                      Options(lu_dist_panel=True))[0])
+
+
 @register("gesv_threshold", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)
 def _t_gesv_threshold(ctx):
     """pivot_threshold < 1: tournament panels (PivotThreshold analog)."""
