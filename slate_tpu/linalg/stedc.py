@@ -295,8 +295,42 @@ if _HAVE_JAX:
         _secular_kernel_body)
 
 
-def _secular_roots_device(delta: np.ndarray, z2: np.ndarray, rho: float
-                          ) -> Tuple[np.ndarray, np.ndarray]:
+@functools.lru_cache(maxsize=None)
+def _secular_sharded_fn(mesh, kp: int, chunk: int):
+    """Jitted shard_map'd secular sweep for one (mesh, padded-k) bucket.
+
+    The multi-host form of the secular stage (DESIGN.md "stedc beyond
+    one host"): ROOTS are data-parallel over every device of the mesh
+    (each root's bisection/Newton reads all k poles but writes only its
+    own mu), so the root axis is sharded over both mesh axes while the
+    pole vectors replicate — the direct analog of the reference
+    distributing dlaed4 calls over the Q process grid
+    (src/stedc_secular.cc:1-80). No collectives are needed inside the
+    sweep; GSPMD inserts only the initial broadcast of the O(k) pole
+    vectors."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.grid import COL_AXIS, ROW_AXIS
+
+    ndev = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    chunk_l = min(chunk, kp // ndev)
+    spec_r = P((ROW_AXIS, COL_AXIS))
+    spec_0 = P()
+
+    def body(dh, dl, zh, zl, rh, rl, wh, wl, jj, nl):
+        return _secular_kernel_body(dh, dl, zh, zl, rh, rl, wh, wl, jj,
+                                    nl, chunk_l)
+
+    fn = shard_map(body, mesh,
+                   in_specs=(spec_0, spec_0, spec_0, spec_0, spec_0,
+                             spec_0, spec_r, spec_r, spec_r, spec_r),
+                   out_specs=(spec_r, spec_r, spec_r))
+    return jax.jit(fn)
+
+
+def _secular_roots_device(delta: np.ndarray, z2: np.ndarray, rho: float,
+                          grid=None) -> Tuple[np.ndarray, np.ndarray]:
     """Device df32 drop-in for _secular_roots (same contract).
 
     Pole and root axes are padded to the next power of two so the jitted
@@ -337,11 +371,20 @@ def _secular_roots_device(delta: np.ndarray, z2: np.ndarray, rho: float
     rhi = np.float32(rho)
     rlo = np.float32(rho - float(rhi))
 
-    upper, mh, ml = _secular_kernel(
-        jnp.asarray(dhi), jnp.asarray(dlo), jnp.asarray(z2hi),
-        jnp.asarray(z2lo), float(rhi), float(rlo), jnp.asarray(whi),
-        jnp.asarray(wlo), jnp.asarray(j), jnp.asarray(notlast),
-        chunk=chunk)
+    ndev = getattr(grid, "size", 1) if grid is not None else 1
+    if ndev > 1 and kp % ndev == 0 and kp // ndev >= 64:
+        fn = _secular_sharded_fn(grid.mesh, kp, chunk)
+        upper, mh, ml = fn(
+            jnp.asarray(dhi), jnp.asarray(dlo), jnp.asarray(z2hi),
+            jnp.asarray(z2lo), jnp.float32(rhi), jnp.float32(rlo),
+            jnp.asarray(whi), jnp.asarray(wlo), jnp.asarray(j),
+            jnp.asarray(notlast))
+    else:
+        upper, mh, ml = _secular_kernel(
+            jnp.asarray(dhi), jnp.asarray(dlo), jnp.asarray(z2hi),
+            jnp.asarray(z2lo), float(rhi), float(rlo), jnp.asarray(whi),
+            jnp.asarray(wlo), jnp.asarray(j), jnp.asarray(notlast),
+            chunk=chunk)
     upper = np.asarray(upper)[:k]
     mu = df.to_f64(mh, ml)[:k] * s
     idx = np.arange(k)
@@ -589,7 +632,8 @@ def _merge(w1, q1, w2, q2, rho_signed, matmul, vals_only=False,
 
     if (device_ctx is not None and device_ctx.secular_device
             and k >= _SECULAR_DEVICE_MIN_K):
-        shift, mu = _secular_roots_device(delta, z2, rho)
+        shift, mu = _secular_roots_device(delta, z2, rho,
+                                          grid=device_ctx.grid)
     else:
         shift, mu = _secular_roots(delta, z2, rho)
     dshift = delta[shift]
