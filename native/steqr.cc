@@ -18,10 +18,65 @@
 
 #include <cstdint>
 #include <cmath>
+#include <limits>
 
 #if defined(_OPENMP)
 #include <omp.h>
 #endif
+
+namespace {
+
+// Analytic eigendecomposition of the symmetric 2x2 [[a, b], [b, c]]
+// (LAPACK dlaev2's formulas): rt1/rt2 the eigenvalues (|rt1| >= |rt2|),
+// (cs1, sn1) the unit eigenvector of rt1. Closing 2x2 blocks with one
+// exact rotation instead of iterating matches the reference's steqr
+// (src/steqr_impl.cc calls lapack::laev2 for trailing 2x2 blocks).
+void laev2(double a, double b, double c, double& rt1, double& rt2,
+           double& cs1, double& sn1) {
+    const double sm = a + c, df = a - c;
+    const double adf = std::fabs(df), tb = b + b;
+    const double ab = std::fabs(tb);
+    double acmx, acmn;
+    if (std::fabs(a) > std::fabs(c)) { acmx = a; acmn = c; }
+    else                             { acmx = c; acmn = a; }
+    double rt;
+    if (adf > ab)      rt = adf * std::sqrt(1.0 + (ab / adf) * (ab / adf));
+    else if (adf < ab) rt = ab * std::sqrt(1.0 + (adf / ab) * (adf / ab));
+    else               rt = ab * std::sqrt(2.0);
+    int sgn1;
+    if (sm < 0.0) {
+        rt1 = 0.5 * (sm - rt); sgn1 = -1;
+        rt2 = (acmx / rt1) * acmn - (b / rt1) * b;
+    } else if (sm > 0.0) {
+        rt1 = 0.5 * (sm + rt); sgn1 = 1;
+        rt2 = (acmx / rt1) * acmn - (b / rt1) * b;
+    } else {
+        rt1 = 0.5 * rt; rt2 = -0.5 * rt; sgn1 = 1;
+    }
+    double cs;
+    int sgn2;
+    if (df >= 0.0) { cs = df + rt; sgn2 = 1; }
+    else           { cs = df - rt; sgn2 = -1; }
+    const double acs = std::fabs(cs);
+    if (acs > ab) {
+        const double ct = -tb / cs;
+        sn1 = 1.0 / std::sqrt(1.0 + ct * ct);
+        cs1 = ct * sn1;
+    } else if (ab == 0.0) {
+        cs1 = 1.0; sn1 = 0.0;
+    } else {
+        const double tn = -cs / tb;
+        cs1 = 1.0 / std::sqrt(1.0 + tn * tn);
+        sn1 = tn * cs1;
+    }
+    if (sgn1 == sgn2) {
+        const double tn = cs1;
+        cs1 = -sn1;
+        sn1 = tn;
+    }
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -36,13 +91,23 @@ int64_t st_steqr(int64_t n, double* d, double* e, double* z,
     double* cj = new double[n];
     double* sj = new double[n];
 
+    // reference deflation criterion (src/steqr_impl.cc:238-241 —
+    // LAPACK dsteqr's): e_i^2 <= eps^2 |d_i||d_{i+1}| + safe_min. The
+    // geometric mean keeps small couplings between same-magnitude
+    // SMALL diagonal entries alive on graded spectra, where the old
+    // additive tolerance eps(|d_i|+|d_{i+1}|) would wrongly decouple
+    // them and lose the small eigenvalues.
+    const double eps = std::numeric_limits<double>::epsilon();
+    const double eps2 = eps * eps;
+    const double safmin = std::numeric_limits<double>::min();
+
     int64_t iter = 0;
     for (; iter < max_iters; ++iter) {
         // deflate negligible off-diagonals
         for (int64_t i = 0; i < n - 1; ++i) {
-            const double tol = 1e-16 * (std::fabs(d[i]) +
-                                        std::fabs(d[i + 1]));
-            if (std::fabs(e[i]) <= tol) e[i] = 0.0;
+            if (e[i] * e[i] <=
+                eps2 * std::fabs(d[i]) * std::fabs(d[i + 1]) + safmin)
+                e[i] = 0.0;
         }
         // trailing undeflated block [lo, hi]
         int64_t hi = n - 1;
@@ -50,6 +115,25 @@ int64_t st_steqr(int64_t n, double* d, double* e, double* z,
         if (hi == 0) { delete[] cj; delete[] sj; return 0; }
         int64_t lo = hi - 1;
         while (lo > 0 && e[lo - 1] != 0.0) --lo;
+
+        if (hi - lo == 1) {
+            // close the 2x2 block with one exact rotation (laev2)
+            double rt1, rt2, c2, s2;
+            laev2(d[lo], e[lo], d[hi], rt1, rt2, c2, s2);
+            d[lo] = rt1; d[hi] = rt2; e[lo] = 0.0;
+            if (compute_z) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+                for (int64_t r = 0; r < n; ++r) {
+                    double* zr = z + r * n;
+                    const double zi = zr[lo];
+                    zr[lo] =  c2 * zi + s2 * zr[hi];
+                    zr[hi] = -s2 * zi + c2 * zr[hi];
+                }
+            }
+            continue;
+        }
 
         // Wilkinson shift from the trailing 2x2
         const double a11 = d[hi - 1], a22 = d[hi], ab = e[hi - 1];

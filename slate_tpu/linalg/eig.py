@@ -494,6 +494,48 @@ def steqr(d, e, compute_z: bool = True,
     return _steqr_py(d, e, compute_z, max_sweeps)
 
 
+def _laev2(a, b, c):
+    """Symmetric 2x2 [[a, b], [b, c]] eigendecomposition (LAPACK
+    dlaev2's formulas): (rt1, rt2, cs1, sn1) with [cs1, sn1] the unit
+    eigenvector of rt1. Mirrors native/steqr.cc::laev2."""
+    sm, df = a + c, a - c
+    adf, tb = abs(df), b + b
+    ab = abs(tb)
+    acmx, acmn = (a, c) if abs(a) > abs(c) else (c, a)
+    if adf > ab:
+        rt = adf * np.sqrt(1.0 + (ab / adf) ** 2)
+    elif adf < ab:
+        rt = ab * np.sqrt(1.0 + (adf / ab) ** 2)
+    else:
+        rt = ab * np.sqrt(2.0)
+    if sm < 0.0:
+        rt1, sgn1 = 0.5 * (sm - rt), -1
+        rt2 = (acmx / rt1) * acmn - (b / rt1) * b
+    elif sm > 0.0:
+        rt1, sgn1 = 0.5 * (sm + rt), 1
+        rt2 = (acmx / rt1) * acmn - (b / rt1) * b
+    else:
+        rt1, rt2, sgn1 = 0.5 * rt, -0.5 * rt, 1
+    if df >= 0.0:
+        cs, sgn2 = df + rt, 1
+    else:
+        cs, sgn2 = df - rt, -1
+    acs = abs(cs)
+    if acs > ab:
+        ct = -tb / cs
+        sn1 = 1.0 / np.sqrt(1.0 + ct * ct)
+        cs1 = ct * sn1
+    elif ab == 0.0:
+        cs1, sn1 = 1.0, 0.0
+    else:
+        tn = -cs / tb
+        cs1 = 1.0 / np.sqrt(1.0 + tn * tn)
+        sn1 = tn * cs1
+    if sgn1 == sgn2:
+        cs1, sn1 = -sn1, cs1
+    return rt1, rt2, cs1, sn1
+
+
 def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
     """Pure-Python steqr recurrence (fallback + reference for tests)."""
     d = np.asarray(d, dtype=np.float64).copy()
@@ -511,13 +553,17 @@ def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
         r = np.hypot(f, g)
         return f / r, g / r, r
 
+    # reference deflation criterion + laev2 2x2 closing — kept in
+    # lockstep with native/steqr.cc (see there for the rationale)
+    eps2 = np.finfo(np.float64).eps ** 2
+    safmin = np.finfo(np.float64).tiny
+
     lo = 0
     converged = False
     for _ in range(max_sweeps * n):
-        # deflate
+        # deflate (eps^2 |d_i||d_{i+1}| + safe_min, steqr_impl.cc:238)
         for i in range(n - 1):
-            tol = 1e-16 * (abs(d[i]) + abs(d[i + 1]))
-            if abs(e[i]) <= tol:
+            if e[i] * e[i] <= eps2 * abs(d[i]) * abs(d[i + 1]) + safmin:
                 e[i] = 0.0
         # find an undeflated block [lo, hi]
         hi = n - 1
@@ -529,6 +575,14 @@ def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
         lo = hi - 1
         while lo > 0 and e[lo - 1] != 0.0:
             lo -= 1
+        if hi - lo == 1:
+            rt1, rt2, c2, s2 = _laev2(d[lo], e[lo], d[hi])
+            d[lo], d[hi], e[lo] = rt1, rt2, 0.0
+            if compute_z:
+                zi = z[:, lo].copy()
+                z[:, lo] = c2 * zi + s2 * z[:, hi]
+                z[:, hi] = -s2 * zi + c2 * z[:, hi]
+            continue
         # Wilkinson shift from the trailing 2x2 of the block
         a11, a22 = d[hi - 1], d[hi]
         ab = e[hi - 1]
