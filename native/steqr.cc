@@ -92,22 +92,25 @@ int64_t st_steqr(int64_t n, double* d, double* e, double* z,
     double* sj = new double[n];
 
     // reference deflation criterion (src/steqr_impl.cc:238-241 —
-    // LAPACK dsteqr's): e_i^2 <= eps^2 |d_i||d_{i+1}| + safe_min. The
-    // geometric mean keeps small couplings between same-magnitude
-    // SMALL diagonal entries alive on graded spectra, where the old
-    // additive tolerance eps(|d_i|+|d_{i+1}|) would wrongly decouple
-    // them and lose the small eigenvalues.
+    // LAPACK dsteqr's geometric mean): |e_i| <= eps sqrt(|d_i||d_{i+1}|)
+    // + safe_min, evaluated in the UNSQUARED form sqrt(|d_i|)*sqrt(|d_{i+1}|)
+    // so it cannot over/underflow at range extremes (LAPACK gets the
+    // same robustness by dlascl-scaling each block to mid-range first;
+    // the sqrt form needs no scaling pass). The geometric mean keeps
+    // small couplings between same-magnitude SMALL diagonal entries
+    // alive on graded spectra, where an additive tolerance
+    // eps(|d_i|+|d_{i+1}|) would wrongly decouple them.
     const double eps = std::numeric_limits<double>::epsilon();
-    const double eps2 = eps * eps;
     const double safmin = std::numeric_limits<double>::min();
 
     int64_t iter = 0;
     for (; iter < max_iters; ++iter) {
         // deflate negligible off-diagonals
         for (int64_t i = 0; i < n - 1; ++i) {
-            if (e[i] * e[i] <=
-                eps2 * std::fabs(d[i]) * std::fabs(d[i + 1]) + safmin)
-                e[i] = 0.0;
+            if (e[i] == 0.0) continue;  // already deflated: skip sqrts
+            const double tol = eps * std::sqrt(std::fabs(d[i])) *
+                               std::sqrt(std::fabs(d[i + 1])) + safmin;
+            if (std::fabs(e[i]) <= tol) e[i] = 0.0;
         }
         // trailing undeflated block [lo, hi]
         int64_t hi = n - 1;

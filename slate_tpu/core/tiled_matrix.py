@@ -69,19 +69,26 @@ class TiledMatrix:
     # owns tiles {i : i mod p == pi}. dense() unpermutes to logical
     # order (one gather = collective-permute over ICI).
     cyclic: bool = False
+    # factor-packing tag ("aasen", "ldl", ...): lets solvers reject a
+    # factor produced under a DIFFERENT packing than they consume
+    # (hetrf-RBT vs hetrs, ADVICE r4) instead of silently computing a
+    # wrong X. Empty = not a tagged factor.
+    packing: str = ""
 
     # -- pytree ----------------------------------------------------------
     def tree_flatten(self):
         meta = (self.m, self.n, self.nb, self.kind, self.uplo, self.op,
-                self.diag, self.kl, self.ku, self.grid, self.cyclic)
+                self.diag, self.kl, self.ku, self.grid, self.cyclic,
+                self.packing)
         return (self.data,), meta
 
     @classmethod
     def tree_unflatten(cls, meta, children):
         (data,) = children
-        m, n, nb, kind, uplo, op, diag, kl, ku, grid, cyclic = meta
+        (m, n, nb, kind, uplo, op, diag, kl, ku, grid, cyclic,
+         packing) = meta
         return cls(data, m, n, nb, kind, uplo, op, diag, kl, ku, grid,
-                   cyclic)
+                   cyclic, packing)
 
     # -- shape / tiles (op-adjusted, like BaseMatrix::m()/n()/mt()/nt()) --
     @property

@@ -452,6 +452,7 @@ def _steqr_native(d, e, compute_z, max_sweeps):
     n = d.size
     e = np.zeros(max(n, 1), np.float64)
     e[: n - 1] = e0
+    d, e, sigma = _steqr_prescale(d, e)
     z = np.eye(n) if compute_z else np.zeros((1, 1))
     rc = lib.st_steqr(n, d, e, z, 1 if compute_z else 0,
                       int(max_sweeps) * n)
@@ -460,7 +461,7 @@ def _steqr_native(d, e, compute_z, max_sweeps):
                          f"{max_sweeps}*n sweeps ({rc} off-diagonals "
                          "remain)")
     order = np.argsort(d, kind="stable")
-    return d[order], (z[:, order] if compute_z else None)
+    return sigma * d[order], (z[:, order] if compute_z else None)
 
 
 def steqr(d, e, compute_z: bool = True,
@@ -492,6 +493,19 @@ def steqr(d, e, compute_z: bool = True,
                 f"({_STEQR_PY_MAX_N}) and the native kernel is "
                 "unavailable (no C toolchain) — use MethodEig.DC")
     return _steqr_py(d, e, compute_z, max_sweeps)
+
+
+def _steqr_prescale(d, e):
+    """Scale (d, e) into mid exponent range before QR iteration and
+    return (d', e', sigma) with eigenvalues(T) = sigma * eigenvalues(T').
+    The iteration's shift computes ab*ab (overflows for |T| > ~1e154)
+    and the deflation products denormalize below ~1e-154 — LAPACK
+    dsteqr solves this with dlascl per block (dsteqr's SSFMAX/SSFMIN
+    brackets); one global scale is the same medicine."""
+    anrm = max(np.abs(d).max(initial=0.0), np.abs(e).max(initial=0.0))
+    if anrm == 0.0 or 1e-120 < anrm < 1e120:
+        return d, e, 1.0
+    return d / anrm, e / anrm, anrm
 
 
 def _laev2(a, b, c):
@@ -544,6 +558,7 @@ def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
     z = np.eye(n) if compute_z else None
     if n == 1:
         return d, z
+    d, e, sigma = _steqr_prescale(d, e)
 
     def givens(f, g):
         if g == 0:
@@ -554,16 +569,21 @@ def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
         return f / r, g / r, r
 
     # reference deflation criterion + laev2 2x2 closing — kept in
-    # lockstep with native/steqr.cc (see there for the rationale)
-    eps2 = np.finfo(np.float64).eps ** 2
+    # lockstep with native/steqr.cc (see there for the rationale; the
+    # unsquared sqrt form cannot over/underflow at range extremes)
+    eps = np.finfo(np.float64).eps
     safmin = np.finfo(np.float64).tiny
 
     lo = 0
     converged = False
     for _ in range(max_sweeps * n):
-        # deflate (eps^2 |d_i||d_{i+1}| + safe_min, steqr_impl.cc:238)
+        # deflate (eps sqrt(|d_i||d_{i+1}|) + safe_min, steqr_impl.cc:238)
         for i in range(n - 1):
-            if e[i] * e[i] <= eps2 * abs(d[i]) * abs(d[i + 1]) + safmin:
+            if e[i] == 0.0:
+                continue
+            tol = (eps * np.sqrt(abs(d[i])) * np.sqrt(abs(d[i + 1]))
+                   + safmin)
+            if abs(e[i]) <= tol:
                 e[i] = 0.0
         # find an undeflated block [lo, hi]
         hi = n - 1
@@ -619,7 +639,7 @@ def _steqr_py(d, e, compute_z: bool = True, max_sweeps: int = 60):
         raise SlateError("steqr: QR iteration did not converge within "
                          f"{max_sweeps}*n sweeps")
     order = np.argsort(d)
-    d = d[order]
+    d = sigma * d[order]
     if compute_z:
         z = z[:, order]
     return d, z

@@ -29,6 +29,8 @@ TPU-native design (round 4 — VERDICT r3 #6):
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Tuple
 
 import jax
@@ -211,6 +213,7 @@ def hetrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     out = from_dense(jnp.tril(packed), A.nb, grid=A.grid,
                      kind=MatrixKind.Triangular, uplo=Uplo.Lower,
                      logical_shape=(A.shape[0], A.shape[1]))
+    out = dataclasses.replace(out, packing="aasen")
     return out, perm, info
 
 
@@ -221,8 +224,13 @@ def hetrs(LT: TiledMatrix, perm: Array, B: TiledMatrix,
     The factor packing is the Aasen one (T tridiagonal on the
     diag/subdiag, L shifted one column; see _parlett_reid). Factors
     from hetrf(method_hesv=RBT) use the DIFFERENT no-pivot LDLᴴ packing
-    and must be solved with hetrs_nopiv — passing them here computes a
-    wrong X."""
+    and must be solved with hetrs_nopiv — the packing tag on the factor
+    makes the mismatch a loud error instead of a wrong X."""
+    if LT.packing and LT.packing != "aasen":
+        raise SlateError(
+            f"hetrs: factor is {LT.packing!r}-packed (from "
+            "hetrf(method_hesv=RBT)/hetrf_nopiv?) — solve it with "
+            "hetrs_nopiv")
     lt = LT.dense_canonical()
     npad = lt.shape[0]
     nlog = LT.shape[0]
@@ -309,12 +317,17 @@ def hetrf_nopiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     ld = jnp.tril(a)
     out = from_dense(ld, nb, grid=A.grid, kind=MatrixKind.Triangular,
                      uplo=Uplo.Lower, logical_shape=(n, n))
+    out = dataclasses.replace(out, packing="ldl")
     return out, info
 
 
 def hetrs_nopiv(LD: TiledMatrix, B: TiledMatrix,
                 opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """Solve from hetrf_nopiv factors: L·D·Lᴴ·X = B."""
+    if LD.packing and LD.packing != "ldl":
+        raise SlateError(
+            f"hetrs_nopiv: factor is {LD.packing!r}-packed (from the "
+            "pivoted hetrf?) — solve it with hetrs")
     ld = LD.dense_canonical()
     npad = ld.shape[0]
     nlog = LD.shape[0]

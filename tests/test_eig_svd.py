@@ -405,3 +405,36 @@ def test_steqr_torture_python_path():
     out = _steqr_native(d, e, compute_z=False, max_sweeps=60)
     if out is not None:  # both paths implement the identical recurrence
         assert np.abs(out[0] - w_py).max() / tnorm < 1e-12
+
+
+def test_steqr_extreme_range_no_wholesale_deflation():
+    """Round-5 review repro: uniformly tiny (|d|,|e| ~ 1e-160) and huge
+    (~1e170) spectra must NOT be wholesale-deflated by the geometric
+    deflation criterion (the squared form under/overflowed there; the
+    unsquared sqrt form is range-robust without LAPACK's dlascl pass)."""
+    from slate_tpu.linalg.eig import _steqr_native, _steqr_py
+
+    for scale in (1e-160, 1e170):
+        d = np.array([scale, scale])
+        e = np.array([scale])
+        wref = np.array([0.0, 2 * scale])
+        w_py, _ = _steqr_py(d, e, compute_z=False, max_sweeps=60)
+        np.testing.assert_allclose(np.sort(w_py), wref, atol=scale * 1e-12)
+        out = _steqr_native(d, e, compute_z=False, max_sweeps=60)
+        if out is not None:
+            np.testing.assert_allclose(np.sort(out[0]), wref,
+                                       atol=scale * 1e-12)
+        # full iteration (not just the 2x2 closing): the Wilkinson
+        # shift's ab*ab overflowed at ~1e170 before the global
+        # prescale (LAPACK's dlascl analog) was added
+        n = 48
+        rng = np.random.default_rng(3)
+        dn = scale * (1 + 0.1 * rng.standard_normal(n))
+        en = scale * 0.3 * rng.standard_normal(n - 1)
+        t = np.diag(dn) + np.diag(en, 1) + np.diag(en, -1)
+        wref_n = np.linalg.eigvalsh(t)
+        w_py_n, _ = _steqr_py(dn, en, compute_z=False, max_sweeps=60)
+        assert np.abs(w_py_n - wref_n).max()             < 1e-13 * np.abs(wref_n).max()
+        out_n = _steqr_native(dn, en, compute_z=False, max_sweeps=60)
+        if out_n is not None:
+            assert np.abs(out_n[0] - wref_n).max()                 < 1e-13 * np.abs(wref_n).max()

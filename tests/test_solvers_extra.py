@@ -234,3 +234,34 @@ def test_hetrf_hetrs_complex_direct():
     assert int(info) == 0
     X = st.hetrs(LT, perm, st.from_dense(b, nb=nb))
     assert np.abs(a @ X.to_numpy() - b).max() < n * 1e-12
+
+
+def test_hetrf_packing_tag_mismatch_raises():
+    """ADVICE r4: an RBT/no-pivot LDL factor passed to the Aasen hetrs
+    (or vice versa) must raise loudly, not compute a wrong X."""
+    import pytest
+    import slate_tpu as st
+    from slate_tpu.core.exceptions import SlateError
+    from slate_tpu.core.types import MethodHesv, Options, Uplo
+
+    n = 32
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((n, n))
+    a = a + a.T + n * np.eye(n)  # SPD: no-pivot LDL succeeds
+    A = st.hermitian(np.tril(a), nb=8, uplo=Uplo.Lower)
+    b = rng.standard_normal((n, 2))
+    B = st.from_dense(b, nb=8)
+
+    LD, perm_id, info = st.hetrf(A, Options(method_hesv=MethodHesv.RBT))
+    assert LD.packing == "ldl"
+    with pytest.raises(SlateError, match="hetrs_nopiv"):
+        st.hetrs(LD, perm_id, B)
+    X = st.hetrs_nopiv(LD, B)  # the right solver accepts it
+    assert np.abs(a @ X.to_numpy() - b).max() < 1e-6 * n
+
+    LT, perm, info = st.hetrf(A)
+    assert LT.packing == "aasen"
+    with pytest.raises(SlateError, match="hetrs\\b"):
+        st.hetrs_nopiv(LT, B)
+    X2 = st.hetrs(LT, perm, B)
+    assert np.abs(a @ X2.to_numpy() - b).max() < 1e-6 * n
