@@ -24,6 +24,7 @@ from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
                      PackedBand, BandLU, pb_pack, gb_pack, tbsm_packed,
+                     tbsm_pivots,
                      gecondest, pocondest, trcondest, hesv, hetrf, hetrs, hetrf_nopiv, hetrs_nopiv,
                      heev, hegv, hegst, he2hb, he2td, hb2td, unmtr_he2hb,
                      unmtr_hb2td,

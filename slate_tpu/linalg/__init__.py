@@ -11,6 +11,7 @@ from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
 from .band_packed import PackedBand, BandLU, pb_pack, gb_pack
 from .band_packed import tbsm as tbsm_packed
+from .band_packed import tbsm_pivots
 from .eig import (heev, hegv, hegst, he2hb, he2td, hb2td, unmtr_he2hb,
                   unmtr_hb2td,
                   unmtr_he2td, steqr, sterf)

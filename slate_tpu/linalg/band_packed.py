@@ -447,6 +447,23 @@ def _gb_backward(urows: Array, y: Array, w: int, n: int):
 
 
 @accurate_matmuls
+def tbsm_pivots(F: BandLU, b) -> Array:
+    """Pivoted triangular-band solve: X = L⁻¹·P·B for the unit-lower
+    band factor recorded by gbtrf (slate::tbsm's pivoted path,
+    src/tbsm.cc — applied there as gbtrs's forward sweep via
+    ``tbsmPivots``). The standalone entry lets a caller apply just the
+    pivoted L-solve, e.g. to form L⁻¹·P·B once and reuse it."""
+    b = jnp.asarray(b)
+    squeeze = b.ndim == 1
+    if squeeze:
+        b = b[:, None]
+    if b.shape[0] != F.n:
+        raise SlateError(f"tbsm_pivots: rhs rows {b.shape[0]} != n {F.n}")
+    y = _gb_forward(F.ls, F.pivots, b, F.kl, F.n)
+    return y[:, 0] if squeeze else y
+
+
+@accurate_matmuls
 def gbtrs(F: BandLU, b) -> Array:
     """Solve A·X = B from gbtrf factors (slate::gbtrs)."""
     b = jnp.asarray(b)
@@ -455,7 +472,7 @@ def gbtrs(F: BandLU, b) -> Array:
         b = b[:, None]
     if b.shape[0] != F.n:
         raise SlateError(f"gbtrs: rhs rows {b.shape[0]} != n {F.n}")
-    y = _gb_forward(F.ls, F.pivots, b, F.kl, F.n)
+    y = tbsm_pivots(F, b)
     x = _gb_backward(F.urows, y, F.urows.shape[1], F.n)
     return x[:, 0] if squeeze else x
 

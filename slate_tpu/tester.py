@@ -923,6 +923,27 @@ def _t_tbsm(ctx):
     return secs, _solve_err(ctx, a, out.to_numpy(), np.asarray(b))
 
 
+@register("tbsm_pivots", flops=lambda m, n: 0.0)
+def _t_tbsm_pivots(ctx):
+    """Standalone pivoted triangular-band solve (slate::tbsm pivoted
+    path): factor a general band with gbtrf, apply tbsm_pivots, then
+    finish with the banded-U back-substitution and check the full
+    solve residual."""
+    import jax.numpy as jnp
+    import slate_tpu as st
+    from slate_tpu.linalg import band_packed as bp
+    n = ctx.n
+    kl, ku = max(1, ctx.nb // 8), max(1, ctx.nb // 16)
+    a = _band_dense(ctx, kl, ku)
+    a += np.diag(2.0 * kl * np.ones(n))  # well-conditioned band
+    b = np.asarray(ctx.gen("randn", n, 4, 1))
+    F, info = bp.gbtrf(bp.gb_pack(jnp.asarray(a, ctx.dtype), kl, ku))
+    y, secs = ctx.timed(
+        lambda: st.tbsm_pivots(F, jnp.asarray(b, ctx.dtype)))
+    x = bp._gb_backward(F.urows, jnp.asarray(y), F.urows.shape[1], F.n)
+    return secs, _solve_err(ctx, a, np.asarray(x), b)
+
+
 # -- elementwise / aux (reference test_add.cc, test_copy.cc, ...) -----------
 
 @register("geadd")
@@ -1765,6 +1786,13 @@ def main(argv=None):
     from slate_tpu.compat.platform import apply_env_platforms
 
     apply_env_platforms()
+
+    if args.dtype in ("f64", "c128"):
+        # without x64 JAX silently truncates to f32 and every row fails
+        # its f64-eps bound; enable it up front (before array creation)
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
 
     import jax.numpy as jnp
     from slate_tpu.core.grid import ProcessGrid
