@@ -110,6 +110,9 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
     s = a.shape[0]
     if s <= nb:
         return _tile_chol(a)
+    if s <= _POTRF_ITER_BASE and s % nb == 0:
+        # crossover measured on-chip (see _potrf_blocked docstring)
+        return _potrf_iter(a, nb, prec)
     h = blocked._half(s, nb)
     l11, i1 = _potrf_rec(a[:h, :h], nb, prec)
     l21 = blocked.rebalance(
@@ -126,22 +129,26 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
     return out, info
 
 
-def _potrf_iter(a: jax.Array, nb: int, prec):
-    """Iterative right-looking blocked Cholesky (round 4).
+# On-chip crossover between the iterative right-looking loop and the
+# 2×2 recursion (round-5 A/B, tools/potrf_ab.py): below this size the
+# loop's single batched-leaf inverse per panel wins on latency; above
+# it the loop's O(n³/nb) trailing-block HBM traffic loses to the
+# recursion's O(n² log nt) touch pattern (perf_traces/SUMMARY.md).
+_POTRF_ITER_BASE = 2048
 
-    Why it replaces the 2×2 recursion as the default: the recursion's
-    trsm calls re-invert the same diagonal TRSM-base blocks at every
-    recursion level (O(log nt) redundant inversions per block), and
-    each inversion's fori_loop leaves execute sequentially — measured
-    as the bulk of the unexplained potrf time beyond the tile-Cholesky
-    floor. Here each panel step pays exactly ONE tile Cholesky + ONE
-    batched-leaf inverse (blocked.trtri_lower_batched), the panel
-    update is a single gemm against the cached inverse (the
-    inverted-diagonal-block trsm scheme), and the trailing update is
-    the triangle-aware herk recursion (pure gemms). The reference's
-    task DAG shape (panel → trsm → herk per step, src/potrf.cc:84-195)
-    is recovered exactly, with the lookahead slot (P3) being the mesh
-    rebalance of the one big herk per step."""
+
+def _potrf_iter(a: jax.Array, nb: int, prec):
+    """Iterative right-looking blocked Cholesky (round 4; since round
+    5 the ≤ _POTRF_ITER_BASE base case of _potrf_rec — see
+    _potrf_blocked for the measured dispatch rationale).
+
+    Each panel step pays exactly ONE tile Cholesky + ONE batched-leaf
+    inverse (blocked.trtri_lower_batched), the panel update is a
+    single gemm against the cached inverse (the inverted-diagonal-
+    block trsm scheme), and the trailing update is the triangle-aware
+    herk recursion (pure gemms). The reference's task DAG shape
+    (panel → trsm → herk per step, src/potrf.cc:84-195) is recovered
+    exactly."""
     s = a.shape[0]
     nt = s // nb
     info = jnp.zeros((), jnp.int32)
@@ -163,58 +170,18 @@ def _potrf_iter(a: jax.Array, nb: int, prec):
     return a, info
 
 
-# one _potrf_iter program unrolls O(nt) steps each carrying a trailing
-# herk recursion — past this many panels the flat loop's HLO gets big,
-# so _potrf_hier iterates SUPER-blocks of this many panels instead
-# (round 5, VERDICT r4 weak #4: previously nt > 64 silently fell back
-# to the 2×2 recursion whose redundant inversions the iterative path
-# exists to delete)
-_POTRF_ITER_MAX_NT = 64
-
-
-def _potrf_hier(a: jax.Array, nb: int, prec, sb: int = None):
-    """Hierarchical iterative Cholesky: right-looking loop over
-    (sb·nb)-wide super-blocks, each factored by _potrf_iter.
-
-    Keeps the batched-leaf fast path engaged for nt > sb (e.g. the
-    BASELINE flagship n=65536 at nb=512, nt=128) while bounding HLO
-    size: the off-diagonal super-panel is ONE gemm-based trsm against
-    the factored diagonal super-block (redundant leaf inversions
-    bounded within one super-block instead of the whole matrix) and the
-    trailing update is ONE triangle-aware herk per super-step — the
-    same DAG shape as the reference's per-panel loop, which has no nt
-    ceiling (src/getrf.cc:81-160 / src/potrf.cc:84-195)."""
-    sb = sb or _POTRF_ITER_MAX_NT
-    s = a.shape[0]
-    W = sb * nb
-    info = jnp.zeros((), jnp.int32)
-    for j0 in range(0, s, W):
-        j1 = min(j0 + W, s)
-        diag, i_j = _potrf_iter(a[j0:j1, j0:j1], nb, prec)
-        info = jnp.where((info == 0) & (i_j > 0), j0 + i_j,
-                         info).astype(jnp.int32)
-        a = jax.lax.dynamic_update_slice(a, diag, (j0, j0))
-        if j1 >= s:
-            continue
-        pan = blocked.rebalance(
-            blocked.trsm_rec(diag, a[j1:, j0:j1], left=False, lower=True,
-                             conj_a=True, trans_a=True, prec=prec, base=nb))
-        a = jax.lax.dynamic_update_slice(a, pan, (j1, j0))
-        trail = blocked.rebalance(
-            blocked.herk_lower_rec(a[j1:, j1:], pan, prec=prec))
-        a = jax.lax.dynamic_update_slice(a, trail, (j1, j1))
-    return a, info
-
-
 def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high"):
-    """Blocked Cholesky on padded dense (lower) → (tril factor, info)."""
-    nt_pad = a.shape[0] // nb if a.shape[0] % nb == 0 else 0
-    if nt_pad > _POTRF_ITER_MAX_NT:
-        out, info = _potrf_hier(a, nb, prec=prec)
-    elif nt_pad > 1:
-        out, info = _potrf_iter(a, nb, prec=prec)
-    else:
-        out, info = _potrf_rec(a, nb, prec=prec)
+    """Blocked Cholesky on padded dense (lower) → (tril factor, info).
+
+    Dispatch (round-5 on-chip A/B, tools/potrf_ab.py + PERF.md): the
+    2×2 recursion with the iterative loop as its ≤ _POTRF_ITER_BASE
+    base case. The round-4 flat iterative loop (and its super-block
+    hierarchy) measured SLOWER above the crossover — right-looking
+    re-reads the O(n²) trailing block nt times where the recursion
+    touches it O(log nt) times (138 vs 200 ms at n=16384 nb=1024) —
+    so right-looking survives only below the crossover, where it wins
+    on latency (16.6 vs 20.4 ms at n=2048)."""
+    out, info = _potrf_rec(a, nb, prec=prec)
     return jnp.tril(out), info
 
 
@@ -231,7 +198,22 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
         raise SlateError("potrf: A must be square")
     n = A.shape[0]
     nb = A.nb
-    a = A.full_dense_canonical()
+    # the factorization reads ONLY the lower triangle (upper content
+    # passes through untouched and is tril-masked at the end), so skip
+    # full_dense_canonical's Hermitian mirror — 2-3 full HBM passes at
+    # bench sizes (round-5 driver-overhead profiling). Upper storage
+    # reaches the lower triangle by conjugate-transposing the raw
+    # storage instead of mirroring.
+    if A.uplo is Uplo.Upper:
+        a = jnp.conj(A.dense_canonical()).T
+    else:
+        a = A.dense_canonical()
+    if jnp.iscomplexobj(a):
+        # zpotrf contract: imaginary parts of the diagonal are assumed
+        # zero and ignored (full_dense used to realify; the raw storage
+        # path must do it explicitly)
+        idx = jnp.arange(a.shape[0])
+        a = a.at[idx, idx].set(jnp.real(jnp.diagonal(a)).astype(a.dtype))
     a = unit_pad_diag(a, n, n)
     nt = A.mt
     with blocked.distribute_on(A.grid):

@@ -364,7 +364,10 @@ def chol_lower_rec(a: Array, base: int = 128) -> Array:
     NaN-poisons like lax.linalg.cholesky on non-SPD input."""
     n = a.shape[0]
     if n <= base:
-        return lax.linalg.cholesky(a)
+        # symmetrize_input=False: storage may be lower-only (the
+        # driver no longer mirrors); read the lower triangle like
+        # LAPACK dpotrf instead of averaging in a zero upper
+        return lax.linalg.cholesky(a, symmetrize_input=False)
     h = _half(n, 8)
     l11 = chol_lower_rec(a[:h, :h], base)
     l21 = trsm_rec(l11, a[h:, :h], left=False, lower=True, conj_a=True,
@@ -413,8 +416,16 @@ def chol_tile_blocked(a: Array, ib: int = 64) -> Array:
     3041/3267/3333 GFLOP/s at nb=512; nb=1024+ib=64 → 4187). NaN-poisons
     on non-SPD like lax.linalg.cholesky (sqrt of negative)."""
     b = a.shape[0]
+    from . import pallas_ops
+    if pallas_ops.chol_eligible(b, a.dtype):
+        # round 5: the whole tile factor as ONE Mosaic kernel — the
+        # fori_loop path below pays ~230 µs per ib-step in per-op
+        # dispatch latency (64 sequential trtri matvecs, each its own
+        # XLA op); in-kernel the same chain is pipeline-latency only
+        # (measured: perf_traces/SUMMARY.md, tools/potrf_ab.py)
+        return pallas_ops.chol_tile(a)
     if b % ib or b <= ib:
-        return jnp.tril(lax.linalg.cholesky(a))
+        return jnp.tril(lax.linalg.cholesky(a, symmetrize_input=False))
     rows = jnp.arange(b)
 
     def body(s, a):
@@ -511,16 +522,17 @@ def panel_getrf(a: Array, ib: int = PANEL_IB,
 
     Returns (lu, perm, info) with gather semantics a[perm] = L·U."""
     hh, w = a.shape
-    if w <= ib:
-        # NOTE: a straight-line unrolled base (the _chol_unrolled
-        # treatment) was tried in round 3: no measurable win over the
-        # fori base on chip, and its HLO OOM-killed the compiler at
-        # n=16384 panel heights — the pivot search's argmax/swap chain
-        # doesn't fuse the way the Cholesky recurrence does.
+    if w <= ib or _round_to(w // 2, ib) >= w:
+        # round 5: the base runs as ONE Mosaic kernel where eligible —
+        # the in-kernel column loop replaces ~30 XLA-op dispatches per
+        # column (pallas_ops._lu_panel_kernel; a straight-line unrolled
+        # XLA base was tried in round 3 and OOM-killed the compiler at
+        # n=16384 panel heights, the fori base is the fallback).
+        from . import pallas_ops
+        if pallas_ops.lu_panel_eligible(hh, w, a.dtype):
+            return pallas_ops.lu_panel_base(a)
         return _panel_getrf_base(a)
     h = _round_to(w // 2, ib)
-    if h >= w:
-        return _panel_getrf_base(a)
     lu1, p1, i1 = panel_getrf(a[:, :h], ib, prec)
     right = permute_rows_limited(a[:, h:], p1, 2 * h)
     u_top = trsm_rec(lu1[:h, :h], right[:h], left=True, lower=True,

@@ -168,3 +168,259 @@ def herk_lower_update(c: jax.Array, a: jax.Array,
     ii = jnp.asarray([p[0] for p in pairs], jnp.int32)
     jj = jnp.asarray([p[1] for p in pairs], jnp.int32)
     return _herk_lower_call(c, a, ii, jj, block, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# In-VMEM blocked tile Cholesky (round 5)
+# ---------------------------------------------------------------------------
+#
+# Round-5 on-chip profiling (perf_traces/SUMMARY.md) showed the tile
+# Cholesky is the single-chip potrf floor: chol_tile_blocked's
+# fori_loop pays ~230 us per ib-step, almost all of it the 64
+# SEQUENTIAL (1,ib)@(ib,ib) matvecs of the unrolled trtri — each a
+# separate XLA op with ~3 us dispatch latency. Inside ONE Mosaic
+# kernel the same dependent chain costs only MXU/VPU pipeline latency.
+# This kernel runs the whole (b,b) factor in VMEM with the classic
+# LAPACK three-level blocking (b -> 128-block -> 32-micro -> column),
+# all loops statically unrolled, all O(b^3) flops in MXU dots.
+# Reference analog: lapack::potrf on the GPU inside internal::potrf
+# (src/internal/internal_potrf.cc:58-75) — the reference also factors
+# the diagonal tile with a single device kernel rather than a host
+# round-trip.
+
+_CHOL_IB = 128  # lane-aligned panel width (outer block)
+_CHOL_MB = 32   # micro-block width inside a panel
+
+
+def _chol_cols_unrolled(d, m):
+    """Right-looking unrolled Cholesky of an (m, m) block (static m).
+    NaN-poisons on non-SPD input (rsqrt of a negative), matching
+    blocked.chol_tile_blocked semantics."""
+    rI = jax.lax.broadcasted_iota(jnp.int32, (m, m), 0)
+    cI = jax.lax.broadcasted_iota(jnp.int32, (m, m), 1)
+    one_col = rI[:, :1]
+    for j in range(m):
+        inv = jax.lax.rsqrt(d[j, j])
+        colm = d[:, j:j + 1] * inv                       # (m, 1)
+        colm = jnp.where(one_col > j, colm, 0.0)
+        # (Mosaic has no scatter — element writes are mask selects)
+        colm = jnp.where(one_col == j, d[j, j] * inv, colm)  # sqrt(d_jj)
+        rank1 = colm * jnp.transpose(colm)               # outer product
+        d = jnp.where((cI > j) & (rI > j), d - rank1, d)
+        d = jnp.where(cI == j, colm, d)                  # write column j
+    return d
+
+
+def _trtri_cols_unrolled(l, m):
+    """Unrolled inverse of the lower (m, m) triangle of ``l``."""
+    cI = jax.lax.broadcasted_iota(jnp.int32, (m, m), 1)
+    rI = jax.lax.broadcasted_iota(jnp.int32, (m, m), 0)
+    crow = cI[:1, :]
+    x = jnp.zeros_like(l)
+    for i in range(m):
+        lrow = jnp.where(crow < i, l[i:i + 1, :], 0.0)   # (1, m)
+        e_i = (crow == i).astype(l.dtype)
+        row = (e_i - jax.lax.dot_general(
+            lrow, x, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)) / l[i, i]
+        x = jnp.where(rI == i, row, x)
+    return x
+
+
+def _chol_tile_kernel(a_ref, out_ref):
+    """Kernel body. Mosaic's tpu.concatenate cannot mix pieces whose
+    layouts carry different lane offsets, so the micro-step does NO
+    concatenation/placement at all: the micro factor is applied to the
+    whole panel by one dot with X = I + sel·(L⁻¹ − I)·selᵀ (selection-
+    matrix placement — dots always produce offset-0 layouts), using
+    the exact-arithmetic identity D·L⁻ᵀ = L on the diagonal micro rows
+    (D = L·Lᴴ after the left-looking update)."""
+    b = out_ref.shape[0]
+    IB, MB = _CHOL_IB, _CHOL_MB
+    f32 = jnp.float32
+    hp = jax.lax.Precision.HIGHEST
+    nt_dims = (((1,), (1,)), ((), ()))   # X @ Y^T
+
+    rII = jax.lax.broadcasted_iota(jnp.int32, (IB, IB), 0)
+    cII = jax.lax.broadcasted_iota(jnp.int32, (IB, IB), 1)
+    eye_II = (rII == cII).astype(f32)
+    rIM = jax.lax.broadcasted_iota(jnp.int32, (IB, MB), 0)
+    cIM = jax.lax.broadcasted_iota(jnp.int32, (IB, MB), 1)
+    rMM = jax.lax.broadcasted_iota(jnp.int32, (MB, MB), 0)
+    cMM = jax.lax.broadcasted_iota(jnp.int32, (MB, MB), 1)
+    eye_MM = (rMM == cMM).astype(f32)
+    rbI = jax.lax.broadcasted_iota(jnp.int32, (b, IB), 0)
+    cbI = jax.lax.broadcasted_iota(jnp.int32, (b, IB), 1)
+
+    out_ref[:] = a_ref[:]
+    for jb in range(b // IB):
+        j0 = jb * IB
+        pan = out_ref[:, j0:j0 + IB]                     # (b, IB)
+        if jb:
+            left = out_ref[:, :j0]                       # (b, j0)
+            top = out_ref[j0:j0 + IB, :j0]               # (IB, j0)
+            pan = pan - jax.lax.dot_general(
+                left, top, nt_dims, precision=hp,
+                preferred_element_type=f32)
+        for mb in range(IB // MB):
+            m0 = mb * MB
+            if mb:
+                # left-looking within the panel: lanes [m0, m0+MB)
+                # minus pan[:, :m0] @ D[m0:m0+MB, :m0]^T, expressed as
+                # one full-width masked dot (M holds those D rows,
+                # zero elsewhere, so the product lands in-place)
+                D = pan[j0:j0 + IB, :]                   # (IB, IB)
+                M = jnp.where((rII >= m0) & (rII < m0 + MB) & (cII < m0),
+                              D, 0.0)
+                pan = pan - jax.lax.dot_general(
+                    jnp.where(cbI < m0, pan, 0.0), M, nt_dims,
+                    precision=hp, preferred_element_type=f32)
+            d = pan[j0 + m0:j0 + m0 + MB, m0:m0 + MB]    # (MB, MB)
+            l = _chol_cols_unrolled(d, MB)
+            linv = _trtri_cols_unrolled(l, MB)
+            # X = I + sel (linv − I) selᵀ ; pan ← pan · Xᵀ applies the
+            # micro trsm to lanes [m0, m0+MB) of every row: diagonal
+            # micro rows become l (D·L⁻ᵀ = L), rows below become the
+            # solved sub-panel, rows above transform masked-off junk
+            sel = ((rIM == cIM + m0)).astype(f32)        # (IB, MB)
+            placed = jax.lax.dot_general(
+                jax.lax.dot_general(sel, linv - eye_MM,
+                                    (((1,), (0,)), ((), ())),
+                                    precision=hp,
+                                    preferred_element_type=f32),
+                sel, nt_dims, precision=hp, preferred_element_type=f32)
+            pan = jax.lax.dot_general(
+                pan, eye_II + placed, nt_dims, precision=hp,
+                preferred_element_type=f32)
+        # tril-mask this panel at write time — a full-(b,b) mask at the
+        # end would need two b² int32 iotas (8 MiB at b=1024: VMEM OOM)
+        out_ref[:, j0:j0 + IB] = jnp.where(rbI >= cbI + j0, pan, 0.0)
+
+
+def chol_eligible(b: int, dtype) -> bool:
+    """Kernel gate: TPU backend, real f32, lane-aligned size that fits
+    VMEM (b=1024 is 2 x 4 MiB in+out). SLATE_TPU_PALLAS_CHOL=0 opts
+    out (the kernel is the DEFAULT tile factor on TPU — unlike the
+    herk kernel it replaces dispatch latency, not XLA's gemms, so it
+    wins by construction; measured on-chip before being made default)."""
+    if os.environ.get("SLATE_TPU_PALLAS_CHOL") == "0":
+        return False
+    # shape/dtype gates FIRST so CPU-host tests exercise them (the
+    # backend check last — it is False everywhere but a real TPU)
+    if dtype not in (jnp.float32.dtype, np.dtype("float32")):
+        return False
+    if not (b >= _CHOL_IB and b % _CHOL_IB == 0 and b <= 1024):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chol_tile(a: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Cholesky of one (b, b) tile as ONE Pallas kernel (lower factor,
+    strict upper zeroed). Caller is responsible for eligibility."""
+    b = a.shape[0]
+    return pl.pallas_call(
+        _chol_tile_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, b), a.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(a)
+
+
+# ---------------------------------------------------------------------------
+# In-VMEM pivoted LU panel base (round 5)
+# ---------------------------------------------------------------------------
+#
+# getrf's floor after the round-5 dispatch fix is the panel chain:
+# each (H, 32) fori_loop base (blocked._panel_getrf_base) pays ~30
+# XLA-op dispatches per column; a 16384-column factorization runs
+# ~512 such bases. This kernel runs one whole base as ONE Mosaic
+# program: the column loop is statically unrolled, the pivot search
+# is an in-kernel argmax, and the row swaps are dynamic-sublane ref
+# writes (no masked full-panel passes). Reference analog: the
+# multi-threaded panel of src/internal/internal_getrf.cc:64-119 /
+# Tile_getrf.hh:209-270 — one tight kernel owning the whole chain
+# instead of per-column task/MPI hops.
+
+_LU_PANEL_MAX_H = 32768  # (H, 32) f32 in+out alias + perm within VMEM
+
+
+def _lu_panel_kernel(a_ref, lu_ref, perm_ref, info_ref):
+    H, W = a_ref.shape
+    f32 = jnp.float32
+    rH1 = jax.lax.broadcasted_iota(jnp.int32, (H, 1), 0)
+    cW1 = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+
+    lu_ref[:] = a_ref[:]
+    perm_ref[:] = rH1
+    info_ref[0, 0] = jnp.int32(0)
+    for j in range(W):
+        col = lu_ref[:, j:j + 1]                         # (H, 1)
+        score = jnp.where(rH1 >= j, jnp.abs(col), -1.0)
+        # NaN-safe pivot choice: argmax ignores NaN rows unless all
+        # candidates are NaN (matching the fori base's argmax)
+        p = jnp.argmax(score).astype(jnp.int32)
+        row_j = lu_ref[j:j + 1, :]
+        row_p = lu_ref[pl.ds(p, 1), :]
+        lu_ref[pl.ds(p, 1), :] = row_j
+        lu_ref[j:j + 1, :] = row_p
+        pj = perm_ref[j:j + 1, :]
+        pp = perm_ref[pl.ds(p, 1), :]
+        perm_ref[pl.ds(p, 1), :] = pj
+        perm_ref[j:j + 1, :] = pp
+        d = lu_ref[j, j]
+        bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
+        info_ref[0, 0] = jnp.where(
+            (info_ref[0, 0] == 0) & bad, jnp.int32(j + 1), info_ref[0, 0])
+        dsafe = jnp.where(bad, jnp.ones((), f32), d)
+        col2 = lu_ref[:, j:j + 1]
+        lcol = jnp.where(rH1 > j, col2 / dsafe, col2)
+        urow = jnp.where(cW1 > j, lu_ref[j:j + 1, :], 0.0)
+        lmask = jnp.where(rH1 > j, lcol, 0.0)
+        # one fused pass: write the scaled column and apply the rank-1
+        # update (lmask is zero on rows <= j and urow on cols <= j, so
+        # the pivot row/column are preserved; the where writes col j)
+        cur = lu_ref[:]
+        cur = jnp.where(cW1 == j, lcol, cur)
+        lu_ref[:] = cur - lmask * urow
+
+
+def lu_panel_eligible(h: int, w: int, dtype) -> bool:
+    """Kernel gate (default on for TPU f32 panel bases;
+    SLATE_TPU_PALLAS_LU=0 opts out)."""
+    if os.environ.get("SLATE_TPU_PALLAS_LU") == "0":
+        return False
+    # shape/dtype gates first (see chol_eligible)
+    if dtype not in (jnp.float32.dtype, np.dtype("float32")):
+        return False
+    if not (8 <= w <= 128 and h % 8 == 0 and w <= h <= _LU_PANEL_MAX_H):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lu_panel_base(a: jax.Array, *, interpret: bool = False):
+    """Pivoted LU of one (H, w) panel base as ONE Pallas kernel.
+    Returns (lu, perm, info) with the _panel_getrf_base contract
+    (gather-semantics perm, 1-based first-zero-pivot info)."""
+    hh, w = a.shape
+    lu, perm, info = pl.pallas_call(
+        _lu_panel_kernel,
+        out_shape=(jax.ShapeDtypeStruct((hh, w), a.dtype),
+                   jax.ShapeDtypeStruct((hh, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        interpret=interpret,
+    )(a)
+    return lu, perm[:, 0], info[0, 0]

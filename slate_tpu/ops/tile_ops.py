@@ -90,9 +90,15 @@ def potrf(a, uplo: Uplo = Uplo.Lower):
     """Cholesky of one tile (tile::potrf → lapack::potrf,
     src/internal/Tile_lapack.hh:268). lax.linalg.cholesky lowers to a
     blocked TPU implementation; upper is handled by conjugate transposition."""
+    if jnp.iscomplexobj(a):
+        # lapack::potrf ignores imaginary parts of the diagonal; with
+        # symmetrize_input=False we must realify explicitly
+        idx = jnp.arange(a.shape[0])
+        a = a.at[idx, idx].set(jnp.real(jnp.diagonal(a)).astype(a.dtype))
     if uplo is Uplo.Lower:
-        return lax.linalg.cholesky(a)
-    return jnp.conj(lax.linalg.cholesky(jnp.conj(a).T)).T
+        return lax.linalg.cholesky(a, symmetrize_input=False)
+    return jnp.conj(lax.linalg.cholesky(
+        jnp.conj(a).T, symmetrize_input=False)).T
 
 
 def getrf(a):

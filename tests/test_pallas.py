@@ -73,3 +73,75 @@ def test_herk_lower_rec_unchanged_by_routing():
     ker = np.asarray(pallas_ops.herk_lower_update(
         jnp.asarray(c), jnp.asarray(a), 64, interpret=True, force=True))
     np.testing.assert_allclose(np.tril(rec), np.tril(ker), atol=1e-4)
+
+
+def test_chol_tile_kernel_interpret():
+    """In-VMEM blocked Cholesky kernel (round 5): interpret-mode
+    correctness vs LAPACK-precision numpy, including the strict-upper
+    zeroing contract. b=128 exercises a single 128-panel with all four
+    32-micro steps (larger b adds only more of the same blocks and is
+    validated on-chip, PERF.md round 5)."""
+    b = 128
+    x = RNG.standard_normal((b, b)).astype(np.float32)
+    a = (x @ x.T + b * np.eye(b)).astype(np.float32)
+    lk = np.asarray(pallas_ops.chol_tile(jnp.asarray(a), interpret=True))
+    lref = np.linalg.cholesky(a.astype(np.float64))
+    assert np.abs(lk - lref).max() / np.abs(lref).max() < 1e-5
+    assert np.allclose(np.triu(lk, 1), 0.0)
+
+
+def test_chol_tile_nan_poisons_nonspd():
+    """Non-SPD input must NaN-poison (the _tile_chol info contract)."""
+    b = 128
+    x = RNG.standard_normal((b, b)).astype(np.float32)
+    a = (x @ x.T + b * np.eye(b)).astype(np.float32)
+    a[40, 40] = -a[40, 40] - abs(a).sum()
+    lk = np.asarray(pallas_ops.chol_tile(jnp.asarray(a), interpret=True))
+    assert np.isnan(lk[40:, 40:]).any()
+
+
+def test_chol_eligibility_gates(monkeypatch):
+    f32 = jnp.float32.dtype
+    # default-on route, env kill switch
+    monkeypatch.setenv("SLATE_TPU_PALLAS_CHOL", "0")
+    assert not pallas_ops.chol_eligible(512, f32)
+    monkeypatch.delenv("SLATE_TPU_PALLAS_CHOL")
+    # shape/dtype gates are backend-independent
+    assert not pallas_ops.chol_eligible(100, f32)
+    assert not pallas_ops.chol_eligible(2048, f32)
+    assert not pallas_ops.chol_eligible(512, jnp.float64)
+    assert not pallas_ops.chol_eligible(512, jnp.complex64)
+
+
+def test_lu_panel_kernel_interpret():
+    """In-VMEM pivoted LU panel base (round 5): interpret-mode parity
+    with the fori base — identical LU content, identical gather perm,
+    identical info, including the zero-column keep-diagonal case."""
+    for (h, w) in ((128, 32), (256, 16)):
+        a = RNG.standard_normal((h, w)).astype(np.float32)
+        lu_k, p_k, i_k = pallas_ops.lu_panel_base(
+            jnp.asarray(a), interpret=True)
+        lu_r, p_r, i_r = blocked._panel_getrf_base(jnp.asarray(a))
+        assert int(i_k) == int(i_r) == 0
+        np.testing.assert_array_equal(np.asarray(p_k), np.asarray(p_r))
+        np.testing.assert_allclose(np.asarray(lu_k), np.asarray(lu_r),
+                                   atol=1e-5)
+        lm = np.tril(np.asarray(lu_k), -1)[:, :w]
+        lm[np.arange(w), np.arange(w)] = 1.0
+        u = np.triu(np.asarray(lu_k))[:w, :]
+        np.testing.assert_allclose(a[np.asarray(p_k)], lm @ u, atol=1e-4)
+    a = RNG.standard_normal((64, 8)).astype(np.float32)
+    a[:, 3] = 0.0
+    _, _, i_k = pallas_ops.lu_panel_base(jnp.asarray(a), interpret=True)
+    assert int(i_k) == 4
+
+
+def test_lu_panel_eligibility_gates(monkeypatch):
+    f32 = jnp.float32.dtype
+    monkeypatch.setenv("SLATE_TPU_PALLAS_LU", "0")
+    assert not pallas_ops.lu_panel_eligible(1024, 32, f32)
+    monkeypatch.delenv("SLATE_TPU_PALLAS_LU")
+    assert not pallas_ops.lu_panel_eligible(1024, 4, f32)       # w too small
+    assert not pallas_ops.lu_panel_eligible(16, 32, f32)        # h < w
+    assert not pallas_ops.lu_panel_eligible(10 ** 6, 32, f32)   # VMEM
+    assert not pallas_ops.lu_panel_eligible(1024, 32, jnp.float64)

@@ -107,15 +107,18 @@ def main():
     ap = jnp.tril(random_spd(np_, dtype=jnp.float32, seed=5))
     ap = ap + np_ * jnp.eye(np_, dtype=jnp.float32)
     full = ap + jnp.tril(ap, -1).T
-    ref = None
+    # the reference residual is ALWAYS the rec variant's — a partial
+    # variants_csv must not let a broken variant self-certify
+    out, _ = jax.jit(
+        lambda x: variants["rec"](x, nbp, "high"))(ap)
+    lref = jnp.tril(out)
+    ref = float(jnp.linalg.norm(lref @ lref.T - full))
     for name, fn in variants.items():
         if names and name not in names:
             continue
         out, _ = jax.jit(lambda x, f=fn: f(x, nbp, "high"))(ap)
         l = jnp.tril(out)
         r = float(jnp.linalg.norm(l @ l.T - full))
-        if ref is None:
-            ref = r
         print(f"# {name}: probe residual {r:.3e}", file=sys.stderr)
         if not (r <= 10 * ref + 1e-30):
             raise SystemExit(f"variant {name} FAILS the probe: "
