@@ -110,8 +110,11 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
     s = a.shape[0]
     if s <= nb:
         return _tile_chol(a)
-    if s <= _POTRF_ITER_BASE and s % nb == 0:
-        # crossover measured on-chip (see _potrf_blocked docstring)
+    if s <= _POTRF_ITER_BASE and s % nb == 0 and s // nb <= _ITER_MAX_NT:
+        # crossover measured on-chip (see _potrf_blocked docstring);
+        # the nt bound keeps the Python-unrolled loop's HLO bounded
+        # for small-nb configs (nt=128 unrolls cost minutes to compile;
+        # on a 1-core host — the crossover was measured at nb=1024)
         return _potrf_iter(a, nb, prec)
     h = blocked._half(s, nb)
     l11, i1 = _potrf_rec(a[:h, :h], nb, prec)
@@ -135,6 +138,9 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
 # it the loop's O(n³/nb) trailing-block HBM traffic loses to the
 # recursion's O(n² log nt) touch pattern (perf_traces/SUMMARY.md).
 _POTRF_ITER_BASE = 2048
+# HLO-size guard for the unrolled loop (the crossover was measured at
+# nb=1024 → nt=2; small nb would otherwise unroll 128+ panel steps)
+_ITER_MAX_NT = 64
 
 
 def _potrf_iter(a: jax.Array, nb: int, prec):
@@ -208,12 +214,9 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
         a = jnp.conj(A.dense_canonical()).T
     else:
         a = A.dense_canonical()
-    if jnp.iscomplexobj(a):
-        # zpotrf contract: imaginary parts of the diagonal are assumed
-        # zero and ignored (full_dense used to realify; the raw storage
-        # path must do it explicitly)
-        idx = jnp.arange(a.shape[0])
-        a = a.at[idx, idx].set(jnp.real(jnp.diagonal(a)).astype(a.dtype))
+    # zpotrf contract (full_dense used to realify; the raw storage
+    # path must do it explicitly)
+    a = tile_ops.realify_diag(a)
     a = unit_pad_diag(a, n, n)
     nt = A.mt
     with blocked.distribute_on(A.grid):

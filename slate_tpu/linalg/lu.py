@@ -62,6 +62,8 @@ _pad_identity_diag = unit_pad_diag
 # case — measured on-chip for potrf (cholesky._POTRF_ITER_BASE) and
 # shared by LU, whose loop has the same trailing-traffic structure
 _GETRF_ITER_BASE = 2048
+# HLO-size guard shared with cholesky._ITER_MAX_NT (unrolled steps)
+_ITER_MAX_NT = 64
 
 
 def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
@@ -99,9 +101,11 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
         else:
             lu, perm, info = blocked.panel_getrf_jit(ap)
         return lu[:m], perm[:m], info
-    if not dist_panel and w <= _GETRF_ITER_BASE and w % nb == 0:
+    if (not dist_panel and w <= _GETRF_ITER_BASE and w % nb == 0
+            and w // nb <= _ITER_MAX_NT):
         # crossover measured on-chip for potrf and shared by LU (same
-        # right-looking trailing-traffic structure; _getrf_blocked)
+        # right-looking trailing-traffic structure; _getrf_blocked);
+        # nt bound keeps the unrolled loop's HLO bounded for small nb
         return _getrf_iter(a, nb, prec, threshold)
     h = blocked._half(w, nb)
     lu1, p1, i1 = _getrf_rec(a[:, :h], nb, prec, dist_panel, threshold)

@@ -641,11 +641,16 @@ def panel_geqrf(a: Array, ib: int = PANEL_IB,
     taus). Recursion on width; flops above the ib base are gemms.
     Replaces the ~25 ms/panel lax.linalg.geqrf expansion."""
     hh, w = a.shape
-    if w <= ib:
+    if w <= ib or _round_to(w // 2, ib) >= w:
+        # round 5: one Mosaic kernel per base where eligible — the
+        # in-kernel column loop replaces ~12 XLA-op dispatches per
+        # column (pallas_ops._qr_panel_kernel; same rationale as the
+        # LU panel base above).
+        from . import pallas_ops
+        if pallas_ops.qr_panel_eligible(hh, w, a.dtype):
+            return pallas_ops.qr_panel_base(a)
         return _panel_geqrf_base(a)
     h = _round_to(w // 2, ib)
-    if h >= w:
-        return _panel_geqrf_base(a)
     vr1, taus1 = panel_geqrf(a[:, :h], ib, prec)
     v1 = _split_v(vr1, h)
     t1 = larft(v1, taus1, prec)

@@ -298,24 +298,31 @@ def _chol_tile_kernel(a_ref, out_ref):
         out_ref[:, j0:j0 + IB] = jnp.where(rbI >= cbI + j0, pan, 0.0)
 
 
+def _panel_gate(env_var: str, dtype, shape_ok: bool) -> bool:
+    """Shared eligibility gate for the in-VMEM factor kernels: env
+    kill switch, real f32 only, caller's shape predicate, and (last,
+    so CPU-host tests exercise the rest) a real-TPU backend check."""
+    if os.environ.get(env_var) == "0":
+        return False
+    if dtype not in (jnp.float32.dtype, np.dtype("float32")):
+        return False
+    if not shape_ok:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def chol_eligible(b: int, dtype) -> bool:
     """Kernel gate: TPU backend, real f32, lane-aligned size that fits
     VMEM (b=1024 is 2 x 4 MiB in+out). SLATE_TPU_PALLAS_CHOL=0 opts
     out (the kernel is the DEFAULT tile factor on TPU — unlike the
     herk kernel it replaces dispatch latency, not XLA's gemms, so it
     wins by construction; measured on-chip before being made default)."""
-    if os.environ.get("SLATE_TPU_PALLAS_CHOL") == "0":
-        return False
-    # shape/dtype gates FIRST so CPU-host tests exercise them (the
-    # backend check last — it is False everywhere but a real TPU)
-    if dtype not in (jnp.float32.dtype, np.dtype("float32")):
-        return False
-    if not (b >= _CHOL_IB and b % _CHOL_IB == 0 and b <= 1024):
-        return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    return _panel_gate(
+        "SLATE_TPU_PALLAS_CHOL", dtype,
+        b >= _CHOL_IB and b % _CHOL_IB == 0 and b <= 1024)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -347,7 +354,10 @@ def chol_tile(a: jax.Array, *, interpret: bool = False) -> jax.Array:
 # Tile_getrf.hh:209-270 — one tight kernel owning the whole chain
 # instead of per-column task/MPI hops.
 
-_LU_PANEL_MAX_H = 32768  # (H, 32) f32 in+out alias + perm within VMEM
+# VMEM budget for the panel-base kernels in f32 cells: sized for the
+# default (32768, 32) panel (in+out alias + perm ≈ 8 MiB); wider
+# panels get proportionally shorter so H·W stays within budget.
+_PANEL_MAX_CELLS = 32768 * 32
 
 
 def _lu_panel_kernel(a_ref, lu_ref, perm_ref, info_ref):
@@ -393,17 +403,10 @@ def _lu_panel_kernel(a_ref, lu_ref, perm_ref, info_ref):
 def lu_panel_eligible(h: int, w: int, dtype) -> bool:
     """Kernel gate (default on for TPU f32 panel bases;
     SLATE_TPU_PALLAS_LU=0 opts out)."""
-    if os.environ.get("SLATE_TPU_PALLAS_LU") == "0":
-        return False
-    # shape/dtype gates first (see chol_eligible)
-    if dtype not in (jnp.float32.dtype, np.dtype("float32")):
-        return False
-    if not (8 <= w <= 128 and h % 8 == 0 and w <= h <= _LU_PANEL_MAX_H):
-        return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    return _panel_gate(
+        "SLATE_TPU_PALLAS_LU", dtype,
+        8 <= w <= 128 and h % 8 == 0 and w <= h
+        and h * w <= _PANEL_MAX_CELLS)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -424,3 +427,80 @@ def lu_panel_base(a: jax.Array, *, interpret: bool = False):
         interpret=interpret,
     )(a)
     return lu, perm[:, 0], info[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# In-VMEM Householder QR panel base (round 5)
+# ---------------------------------------------------------------------------
+#
+# Same dispatch-latency analysis as the LU panel kernel: geqrf's panel
+# chain runs blocked._panel_geqrf_base once per (H, 32) base — a
+# w-step fori_loop whose body is ~12 XLA ops (slice, larfg scalars,
+# matvec, rank-1 update, two column writes). This kernel runs the
+# whole base as ONE Mosaic program with the column loop statically
+# unrolled. Reference analog: the panel task of
+# src/internal/internal_geqrf.cc:180-260 (one thread team owns the
+# whole panel; triangle-reduce across tiles) — here the panel is one
+# kernel and the cross-tile reduction is XLA's tsqr tree.
+
+def _qr_panel_kernel(a_ref, vr_ref, tau_ref):
+    H, W = a_ref.shape
+    f32 = jnp.float32
+    hp = jax.lax.Precision.HIGHEST
+    rH1 = jax.lax.broadcasted_iota(jnp.int32, (H, 1), 0)
+    cW1 = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+
+    vr_ref[:] = a_ref[:]
+    for j in range(W):
+        col = vr_ref[:, j:j + 1]                         # (H, 1)
+        alpha = vr_ref[j, j]
+        tail = jnp.where(rH1 > j, col, 0.0)
+        sig = jnp.sum(tail * tail)
+        anorm = jnp.sqrt(alpha * alpha + sig)
+        beta = jnp.where(alpha <= 0, anorm, -anorm)
+        # degenerate column (zero tail): tau = 0, H = I (larfg contract)
+        degen = sig == 0.0
+        beta_safe = jnp.where(degen | (beta == 0), jnp.ones((), f32), beta)
+        denom_safe = jnp.where(degen, jnp.ones((), f32), alpha - beta)
+        tau = jnp.where(degen, jnp.zeros((), f32), (beta - alpha) / beta_safe)
+        scale = 1.0 / denom_safe
+        v = jnp.where(rH1 > j, col * scale, 0.0)
+        v = jnp.where(rH1 == j, jnp.ones((), f32), v)
+        # eliminate: A ← A − τ·v·(vᵀA) on columns > j (real f32: Hᴴ = H)
+        w_row = jax.lax.dot_general(
+            v, vr_ref[:], (((0,), (0,)), ((), ())),
+            precision=hp, preferred_element_type=f32)    # (1, W)
+        upd = (tau * v) * jnp.where(cW1 > j, w_row, 0.0)
+        cur = vr_ref[:] - upd
+        # column j: beta on the diagonal, v's tail below, R above
+        newcol = jnp.where(rH1 > j, v, col)
+        newcol = jnp.where(rH1 == j, jnp.where(degen, alpha, beta), newcol)
+        vr_ref[:] = jnp.where(cW1 == j, newcol, cur)
+        tau_ref[j:j + 1, :] = jnp.reshape(tau, (1, 1))
+
+
+def qr_panel_eligible(h: int, w: int, dtype) -> bool:
+    """Kernel gate (default on for TPU f32 panel bases;
+    SLATE_TPU_PALLAS_QR=0 opts out)."""
+    return _panel_gate(
+        "SLATE_TPU_PALLAS_QR", dtype,
+        8 <= w <= 128 and h % 8 == 0 and w <= h
+        and h * w <= _PANEL_MAX_CELLS)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def qr_panel_base(a: jax.Array, *, interpret: bool = False):
+    """Householder QR of one (H, w) panel base as ONE Pallas kernel.
+    Returns (vr_packed, taus) with the _panel_geqrf_base contract
+    (beta on the diagonal, v tails below, R above, LAPACK taus)."""
+    hh, w = a.shape
+    vr, taus = pl.pallas_call(
+        _qr_panel_kernel,
+        out_shape=(jax.ShapeDtypeStruct((hh, w), a.dtype),
+                   jax.ShapeDtypeStruct((w, 1), a.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(a)
+    return vr, taus[:, 0]

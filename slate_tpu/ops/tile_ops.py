@@ -86,15 +86,21 @@ def trmm(side: Side, uplo: Uplo, alpha, a, b, diag: Diag = Diag.NonUnit):
 
 # -- LAPACK-style tile factorizations --------------------------------------
 
+def realify_diag(a):
+    """zpotrf contract: imaginary parts of the diagonal are assumed
+    zero and ignored; with symmetrize_input=False leaves the realify
+    must be explicit. No-op for real dtypes."""
+    if not jnp.iscomplexobj(a):
+        return a
+    idx = jnp.arange(a.shape[0])
+    return a.at[idx, idx].set(jnp.real(jnp.diagonal(a)).astype(a.dtype))
+
+
 def potrf(a, uplo: Uplo = Uplo.Lower):
     """Cholesky of one tile (tile::potrf → lapack::potrf,
     src/internal/Tile_lapack.hh:268). lax.linalg.cholesky lowers to a
     blocked TPU implementation; upper is handled by conjugate transposition."""
-    if jnp.iscomplexobj(a):
-        # lapack::potrf ignores imaginary parts of the diagonal; with
-        # symmetrize_input=False we must realify explicitly
-        idx = jnp.arange(a.shape[0])
-        a = a.at[idx, idx].set(jnp.real(jnp.diagonal(a)).astype(a.dtype))
+    a = realify_diag(a)
     if uplo is Uplo.Lower:
         return lax.linalg.cholesky(a, symmetrize_input=False)
     return jnp.conj(lax.linalg.cholesky(

@@ -79,15 +79,21 @@ def test_chol_tile_kernel_interpret():
     """In-VMEM blocked Cholesky kernel (round 5): interpret-mode
     correctness vs LAPACK-precision numpy, including the strict-upper
     zeroing contract. b=128 exercises a single 128-panel with all four
-    32-micro steps (larger b adds only more of the same blocks and is
-    validated on-chip, PERF.md round 5)."""
-    b = 128
-    x = RNG.standard_normal((b, b)).astype(np.float32)
-    a = (x @ x.T + b * np.eye(b)).astype(np.float32)
-    lk = np.asarray(pallas_ops.chol_tile(jnp.asarray(a), interpret=True))
-    lref = np.linalg.cholesky(a.astype(np.float64))
-    assert np.abs(lk - lref).max() / np.abs(lref).max() < 1e-5
-    assert np.allclose(np.triu(lk, 1), 0.0)
+    32-micro steps; b=256 adds the cross-panel left/top trailing
+    update (the `if jb:` branch), with junk in the strict upper
+    triangle to pin the lower-only read contract."""
+    for b in (128, 256):
+        x = RNG.standard_normal((b, b)).astype(np.float32)
+        a = (x @ x.T + b * np.eye(b)).astype(np.float32)
+        if b == 256:
+            a = np.tril(a) + 1e6 * np.triu(
+                RNG.standard_normal((b, b)).astype(np.float32), 1)
+        lk = np.asarray(pallas_ops.chol_tile(jnp.asarray(a), interpret=True))
+        lref = np.linalg.cholesky(
+            np.tril(a).astype(np.float64)
+            + np.tril(a, -1).astype(np.float64).T)
+        assert np.abs(lk - lref).max() / np.abs(lref).max() < 1e-5
+        assert np.allclose(np.triu(lk, 1), 0.0)
 
 
 def test_chol_tile_nan_poisons_nonspd():
@@ -145,3 +151,47 @@ def test_lu_panel_eligibility_gates(monkeypatch):
     assert not pallas_ops.lu_panel_eligible(16, 32, f32)        # h < w
     assert not pallas_ops.lu_panel_eligible(10 ** 6, 32, f32)   # VMEM
     assert not pallas_ops.lu_panel_eligible(1024, 32, jnp.float64)
+
+
+def test_qr_panel_kernel_interpret():
+    """In-VMEM Householder QR panel base (round 5): interpret-mode
+    parity with the fori base — identical packed V\\R and taus,
+    including the degenerate zero-tail column (tau = 0, H = I)."""
+    for (h, w) in ((128, 32), (256, 16)):
+        a = RNG.standard_normal((h, w)).astype(np.float32)
+        vr_k, tau_k = pallas_ops.qr_panel_base(jnp.asarray(a),
+                                               interpret=True)
+        vr_r, tau_r = blocked._panel_geqrf_base(jnp.asarray(a))
+        np.testing.assert_allclose(np.asarray(tau_k), np.asarray(tau_r),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vr_k), np.asarray(vr_r),
+                                   atol=1e-4)
+        # reconstruction: Q·R == A
+        v = np.tril(np.asarray(vr_k), -1)[:, :w]
+        v[np.arange(w), np.arange(w)] = 1.0
+        r = np.triu(np.asarray(vr_k))[:w, :]
+        q = np.eye(h, dtype=np.float64)
+        for j in range(w - 1, -1, -1):
+            vj = v[:, j].astype(np.float64)
+            q = q - float(tau_k[j]) * np.outer(vj, vj @ q)
+        np.testing.assert_allclose(q[:, :w] @ r.astype(np.float64), a,
+                                   atol=5e-4)
+    a = RNG.standard_normal((64, 8)).astype(np.float32)
+    a[3:, 3] = 0.0  # zero tail below the diagonal of column 3
+    vr_k, tau_k = pallas_ops.qr_panel_base(jnp.asarray(a), interpret=True)
+    vr_r, tau_r = blocked._panel_geqrf_base(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(tau_k), np.asarray(tau_r),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vr_k), np.asarray(vr_r),
+                               atol=1e-4)
+
+
+def test_qr_panel_eligibility_gates(monkeypatch):
+    f32 = jnp.float32.dtype
+    monkeypatch.setenv("SLATE_TPU_PALLAS_QR", "0")
+    assert not pallas_ops.qr_panel_eligible(1024, 32, f32)
+    monkeypatch.delenv("SLATE_TPU_PALLAS_QR")
+    assert not pallas_ops.qr_panel_eligible(1024, 4, f32)       # w too small
+    assert not pallas_ops.qr_panel_eligible(16, 32, f32)        # h < w
+    assert not pallas_ops.qr_panel_eligible(10 ** 6, 32, f32)   # VMEM
+    assert not pallas_ops.qr_panel_eligible(1024, 32, jnp.float64)
