@@ -12,9 +12,10 @@ TPU-native design (SURVEY §7.6):
 - Panel factorization: ``lax.linalg.geqrf`` on the whole (m−k)×nb panel —
   the analog of the reference's "gather panel to one contiguous device
   buffer and run lapack::geqrf on the GPU" trick.
-- Compact-WY T factor: larft recurrence with a single VᴴV Gram matmul +
-  an nb-step fori_loop (the reference gets T from tile::larft inside
-  internal_geqrf).
+- Compact-WY T factor: the larft recurrence in closed form,
+  T = D·(I + striu(VᴴV)·D)⁻¹ — one Gram matmul + a log-depth batched
+  triangular inverse (the reference gets T from tile::larft's serial
+  column loop inside internal_geqrf).
 - Trailing update: C −= V·Tᴴ·(Vᴴ·C) — two big MXU matmuls per panel;
   batching over tiles (internal::unmqr's batched gemm) is implicit.
 - The reference's cross-rank reduction tree (ttqrt/ttmqr, parallelism P7)

@@ -611,9 +611,10 @@ def _panel_geqrf_base(a: Array) -> Tuple[Array, Array]:
     return a, taus
 
 
-def larft(v: Array, taus: Array, prec: Optional[str] = None) -> Array:
-    """Forward columnwise T factor: T[:i,i] = −τᵢ·T[:i,:i]·(Vᴴvᵢ),
-    T[i,i] = τᵢ. One Gram matmul + a width-step fori_loop."""
+def _larft_base(v: Array, taus: Array, prec: Optional[str] = None) -> Array:
+    """LAPACK's columnwise T recurrence: T[:i,i] = −τᵢ·T[:i,:i]·(Vᴴvᵢ),
+    T[i,i] = τᵢ. One Gram matmul + a width-step fori_loop — kept as the
+    small-width base and the parity reference for the closed form."""
     nbb = taus.shape[0]
     w = mm(jnp.conj(v).T, v, prec)
     idx = jnp.arange(nbb)
@@ -627,6 +628,33 @@ def larft(v: Array, taus: Array, prec: Optional[str] = None) -> Array:
 
     t0 = jnp.zeros((nbb, nbb), v.dtype)
     return lax.fori_loop(0, nbb, body, t0)
+
+
+_LARFT_BASE = 32
+
+
+def larft(v: Array, taus: Array, prec: Optional[str] = None) -> Array:
+    """Forward columnwise T factor of the compact-WY representation.
+
+    LAPACK's w-step recurrence (see _larft_base) in matrix form reads
+    T·(I + S·D) = D with S = striu(VᴴV), D = diag(τ) — so
+        T = D·(I + S·D)⁻¹
+    one Gram matmul + one log-depth unit-upper triangular inverse
+    (trtri_lower_batched on the transpose) + a row scaling, replacing
+    the w-step serial chain. Degenerate columns (τᵢ = 0) come out
+    exactly zero: column i of (I + S·D) is then eᵢ, so column i of the
+    inverse is eᵢ and row-scaling by τᵢ = 0 zeroes T[:,i]'s support.
+    Reference analog: tile::larft inside the panel task
+    (src/internal/internal_geqrf.cc) — serial per tile there; here the
+    whole T is MXU gemms so back-transforms stay device-resident."""
+    nbb = taus.shape[0]
+    if nbb <= _LARFT_BASE:
+        return _larft_base(v, taus, prec)
+    g = mm(jnp.conj(v).T, v, prec)
+    s = jnp.triu(g, 1)
+    m = jnp.eye(nbb, dtype=v.dtype) + s * taus[None, :].astype(v.dtype)
+    minv = trtri_lower_batched(jnp.transpose(m), unit=True)
+    return taus[:, None].astype(v.dtype) * jnp.transpose(minv)
 
 
 def _split_v(vr: Array, w: int) -> Array:

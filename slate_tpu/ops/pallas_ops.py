@@ -354,13 +354,26 @@ def chol_tile(a: jax.Array, *, interpret: bool = False) -> jax.Array:
 # Tile_getrf.hh:209-270 — one tight kernel owning the whole chain
 # instead of per-column task/MPI hops.
 
-# VMEM budget for the panel-base kernels in f32 cells: sized for the
-# default (32768, 32) panel (in+out alias + perm ≈ 8 MiB); wider
-# panels get proportionally shorter so H·W stays within budget.
-_PANEL_MAX_CELLS = 32768 * 32
+# VMEM budget for the panel-base kernels in f32 cells. Measured
+# on-chip (round 5): Mosaic's scoped-vmem accounting charges ~8× the
+# (H, W) panel for the loop body's live temporaries — at H=16384 w=32
+# the QR kernel needs 25.3 MiB standalone and the LU kernel 16.12 MiB
+# inside the full getrf program, both over the 16 MiB scoped limit
+# (the margin shrinks inside larger programs). H=8192 compiles in
+# ~2.5 s and runs with headroom, so the budget is 8192·32 cells;
+# taller bases fall back to the XLA fori base.
+_PANEL_MAX_CELLS = 8192 * 32
 
 
 def _lu_panel_kernel(a_ref, lu_ref, perm_ref, info_ref):
+    # The column loop is a lax.fori_loop, NOT Python-unrolled: each
+    # call site embeds the serialized Mosaic module in the parent HLO,
+    # and getrf(n=16384) has ~512 panel-base sites — unrolled bodies
+    # pushed the program to 8 MB of MLIR and the remote compile helper
+    # was OOM-killed (round-5 measurement). Dynamic-j lane access is
+    # expressed as masked full-panel selects/reductions (Mosaic has no
+    # dynamic lane slicing); the panel is VMEM-resident so the extra
+    # (H, W) traffic per step is noise.
     H, W = a_ref.shape
     f32 = jnp.float32
     rH1 = jax.lax.broadcasted_iota(jnp.int32, (H, 1), 0)
@@ -369,35 +382,43 @@ def _lu_panel_kernel(a_ref, lu_ref, perm_ref, info_ref):
     lu_ref[:] = a_ref[:]
     perm_ref[:] = rH1
     info_ref[0, 0] = jnp.int32(0)
-    for j in range(W):
-        col = lu_ref[:, j:j + 1]                         # (H, 1)
+
+    def body(j, carry):
+        cur = lu_ref[:]
+        col = jnp.sum(jnp.where(cW1 == j, cur, 0.0), axis=1,
+                      keepdims=True)                     # (H, 1)
         score = jnp.where(rH1 >= j, jnp.abs(col), -1.0)
         # NaN-safe pivot choice: argmax ignores NaN rows unless all
         # candidates are NaN (matching the fori base's argmax)
         p = jnp.argmax(score).astype(jnp.int32)
-        row_j = lu_ref[j:j + 1, :]
+        row_j = lu_ref[pl.ds(j, 1), :]
         row_p = lu_ref[pl.ds(p, 1), :]
         lu_ref[pl.ds(p, 1), :] = row_j
-        lu_ref[j:j + 1, :] = row_p
-        pj = perm_ref[j:j + 1, :]
+        lu_ref[pl.ds(j, 1), :] = row_p
+        pj = perm_ref[pl.ds(j, 1), :]
         pp = perm_ref[pl.ds(p, 1), :]
         perm_ref[pl.ds(p, 1), :] = pj
-        perm_ref[j:j + 1, :] = pp
-        d = lu_ref[j, j]
+        perm_ref[pl.ds(j, 1), :] = pp
+        d = jnp.sum(jnp.where(cW1 == j, row_p, 0.0))     # new pivot
         bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
         info_ref[0, 0] = jnp.where(
-            (info_ref[0, 0] == 0) & bad, jnp.int32(j + 1), info_ref[0, 0])
+            (info_ref[0, 0] == 0) & bad, (j + 1).astype(jnp.int32),
+            info_ref[0, 0])
         dsafe = jnp.where(bad, jnp.ones((), f32), d)
-        col2 = lu_ref[:, j:j + 1]
+        cur = lu_ref[:]                                  # after swaps
+        col2 = jnp.sum(jnp.where(cW1 == j, cur, 0.0), axis=1,
+                       keepdims=True)
         lcol = jnp.where(rH1 > j, col2 / dsafe, col2)
-        urow = jnp.where(cW1 > j, lu_ref[j:j + 1, :], 0.0)
+        urow = jnp.where(cW1 > j, row_p, 0.0)            # pivot row
         lmask = jnp.where(rH1 > j, lcol, 0.0)
         # one fused pass: write the scaled column and apply the rank-1
         # update (lmask is zero on rows <= j and urow on cols <= j, so
         # the pivot row/column are preserved; the where writes col j)
-        cur = lu_ref[:]
         cur = jnp.where(cW1 == j, lcol, cur)
         lu_ref[:] = cur - lmask * urow
+        return carry
+
+    jax.lax.fori_loop(0, W, body, 0)
 
 
 def lu_panel_eligible(h: int, w: int, dtype) -> bool:
@@ -444,6 +465,8 @@ def lu_panel_base(a: jax.Array, *, interpret: bool = False):
 # kernel and the cross-tile reduction is XLA's tsqr tree.
 
 def _qr_panel_kernel(a_ref, vr_ref, tau_ref):
+    # lax.fori_loop column loop, masked-select dynamic-j lane access —
+    # same compile-payload rationale as _lu_panel_kernel above.
     H, W = a_ref.shape
     f32 = jnp.float32
     hp = jax.lax.Precision.HIGHEST
@@ -451,9 +474,12 @@ def _qr_panel_kernel(a_ref, vr_ref, tau_ref):
     cW1 = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
 
     vr_ref[:] = a_ref[:]
-    for j in range(W):
-        col = vr_ref[:, j:j + 1]                         # (H, 1)
-        alpha = vr_ref[j, j]
+
+    def body(j, carry):
+        cur = vr_ref[:]                                  # (H, W)
+        col = jnp.sum(jnp.where(cW1 == j, cur, 0.0), axis=1,
+                      keepdims=True)                     # (H, 1)
+        alpha = jnp.sum(jnp.where(rH1 == j, col, 0.0))
         tail = jnp.where(rH1 > j, col, 0.0)
         sig = jnp.sum(tail * tail)
         anorm = jnp.sqrt(alpha * alpha + sig)
@@ -468,15 +494,18 @@ def _qr_panel_kernel(a_ref, vr_ref, tau_ref):
         v = jnp.where(rH1 == j, jnp.ones((), f32), v)
         # eliminate: A ← A − τ·v·(vᵀA) on columns > j (real f32: Hᴴ = H)
         w_row = jax.lax.dot_general(
-            v, vr_ref[:], (((0,), (0,)), ((), ())),
+            v, cur, (((0,), (0,)), ((), ())),
             precision=hp, preferred_element_type=f32)    # (1, W)
         upd = (tau * v) * jnp.where(cW1 > j, w_row, 0.0)
-        cur = vr_ref[:] - upd
+        out = cur - upd
         # column j: beta on the diagonal, v's tail below, R above
         newcol = jnp.where(rH1 > j, v, col)
         newcol = jnp.where(rH1 == j, jnp.where(degen, alpha, beta), newcol)
-        vr_ref[:] = jnp.where(cW1 == j, newcol, cur)
-        tau_ref[j:j + 1, :] = jnp.reshape(tau, (1, 1))
+        vr_ref[:] = jnp.where(cW1 == j, newcol, out)
+        tau_ref[pl.ds(j, 1), :] = jnp.reshape(tau, (1, 1))
+        return carry
+
+    jax.lax.fori_loop(0, W, body, 0)
 
 
 def qr_panel_eligible(h: int, w: int, dtype) -> bool:

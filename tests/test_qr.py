@@ -131,3 +131,22 @@ def test_geqrf_jit_and_grid(grid2x2):
     QR = f(A)
     Q = st.qr_multiply_explicit(QR)
     _check_qr(a, Q, QR.r_matrix)
+
+
+@pytest.mark.parametrize("dtype,w,n", [(np.float64, 128, 512),
+                                       (np.complex128, 96, 300)])
+def test_larft_closed_form_matches_recurrence(dtype, w, n):
+    """larft's closed form T = D·(I + striu(VᴴV)·D)⁻¹ must reproduce
+    LAPACK's column recurrence (_larft_base) to machine precision,
+    including exact zeros for degenerate (tau = 0) columns."""
+    from slate_tpu.ops import blocked
+    a = RNG.standard_normal((n, w)).astype(dtype)
+    if np.iscomplexobj(a):
+        a = a + 1j * RNG.standard_normal((n, w))
+    vr, taus = blocked._panel_geqrf_base(jnp.asarray(a))
+    v = blocked._split_v(vr, w)
+    t_new = np.asarray(blocked.larft(v, taus))
+    t_ref = np.asarray(blocked._larft_base(v, taus))
+    assert np.abs(t_new - t_ref).max() / np.abs(t_ref).max() < 1e-13
+    taus0 = jnp.zeros((w,), dtype)
+    assert np.abs(np.asarray(blocked.larft(v, taus0))).max() == 0
