@@ -457,6 +457,66 @@ def c_gbsv(dt, n, kl, ku, nrhs, ab_buf, ldab, ipiv_buf, b_buf,
     return int(info)
 
 
+def c_trtri(dt, uplo, diag, n, a_buf, lda) -> int:
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    inv, info = getattr(_lp(), dt + "trtri")(uplo, diag, n, np.array(a), n)
+    if info == 0:
+        # LAPACK in-place contract: only the stored triangle is
+        # written; the opposite triangle's data stays untouched
+        if uplo.lower().startswith("l"):
+            a[:, :] = np.tril(inv) + np.triu(np.array(a), 1)
+        else:
+            a[:, :] = np.triu(inv) + np.tril(np.array(a), -1)
+    return int(info)
+
+
+def c_hegv(dt, itype, jobz, uplo, n, a_buf, lda, b_buf, ldb,
+           w_buf) -> int:
+    et = _DT[dt]
+    name = dt + ("sygv" if dt in "sd" else "hegv")
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, n, et)
+    w, z, info = getattr(_lp(), name)(
+        itype, jobz, uplo, n, np.array(a), n, np.array(b), n)
+    if w is None:
+        return int(info if info is not None else -1)
+    np.frombuffer(w_buf, dtype=_RDT[dt])[:n] = np.asarray(w)
+    if z is not None:
+        a[:, :] = z  # LAPACK: eigenvectors overwrite A when jobz='V'
+    if int(info) == 0:
+        # LAPACK exit state: B is overwritten by its Cholesky factor
+        # (U or L per uplo) — callers reuse it for back-transforms
+        bn = np.array(b)
+        lower = uplo.lower().startswith("l")
+        tri = np.tril(bn) if lower else np.triu(bn)
+        herm = (tri + np.conj(tri.T)
+                - np.diag(np.real(np.diagonal(tri)).astype(bn.dtype)))
+        f = np.linalg.cholesky(herm.astype(
+            np.complex128 if np.iscomplexobj(bn) else np.float64))
+        fac = f if lower else np.conj(f.T)
+        keep = np.triu(bn, 1) if lower else np.tril(bn, -1)
+        b[:, :] = (fac.astype(bn.dtype)
+                   + keep)
+    return int(info)
+
+
+def c_gesv_nopiv(dt, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
+    """slate_lu_solve_nopiv analog (no LAPACK symbol — the reference
+    exposes it only through the C API / slate.hh)."""
+    et = _DT[dt]
+    a = _as_cm(a_buf, n, lda, n, et)
+    b = _as_cm(b_buf, n, ldb, nrhs, et)
+    import slate_tpu as st
+    from slate_tpu.core.types import MethodLU, Options
+    A = st.from_dense(np.array(a, order="C"), nb=max(16, min(256, n)))
+    B = st.from_dense(np.array(b, order="C"), nb=max(16, min(256, n)))
+    X, info = st.gesv(A, B, Options(method_lu=MethodLU.NoPiv))
+    if int(info) == 0:
+        b[:, :] = np.asarray(X.to_numpy())[:n, :nrhs]
+    return int(info)
+
+
 # --- opaque matrix handles (reference analog: the generated
 # slate_Matrix_create_* C API, include/slate/c_api/matrix.h +
 # src/c_api/wrappers.cc) — C callers keep a device-resident TiledMatrix

@@ -833,6 +833,54 @@ def _make_gbsv(prefix, dtype):
     return gbsv
 
 
+def _make_trtri(prefix, dtype):
+    def trtri(uplo: str, diag: str, n: int, a, lda: int):
+        """?trtri: in-place triangular inverse. Returns (ainv, info).
+        C-API parity with slate_triangular_inverse (the reference's
+        c_api verb; trtri also ships in slate.hh)."""
+        st = _st()
+        from slate_tpu.core.types import Diag, Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
+        tri = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        if d is Diag.NonUnit and not np.all(np.diagonal(tri)):
+            k = int(np.argmin(np.abs(np.diagonal(tri)) > 0)) + 1
+            return np.asarray(tri), k  # LAPACK info: singular diagonal
+        L = st.triangular(tri, nb=_nb(n), uplo=u, diag=d)
+        inv = st.trtri(L)
+        return np.asarray(inv.full_dense_canonical())[:n, :n], 0
+
+    trtri.__name__ = prefix + "trtri"
+    return trtri
+
+
+def _make_hegv(prefix, dtype, name):
+    def hegv(itype: int, jobz: str, uplo: str, n: int, a, lda: int,
+             b, ldb: int):
+        """?sygv/?hegv: generalized Hermitian-definite eigenproblem
+        A·x = λ·B·x (itype 1 — the reference's hegv scope, src/hegv.cc).
+        Returns (w, z_or_None, info)."""
+        if itype != 1:
+            return None, None, -1
+        st = _st()
+        from slate_tpu.core.types import Uplo
+        an = _colmajor_in(np.asarray(a)[:lda, :n][:n], dtype)
+        bn = _colmajor_in(np.asarray(b)[:ldb, :n][:n], dtype)
+        u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+        tri_a = np.tril(an) if u is Uplo.Lower else np.triu(an)
+        tri_b = np.tril(bn) if u is Uplo.Lower else np.triu(bn)
+        A = st.hermitian(tri_a, nb=_nb(n), uplo=u)
+        B = st.hermitian(tri_b, nb=_nb(n), uplo=u)
+        want = jobz.lower().startswith("v")
+        w, Z, info = st.hegv(A, B, want_vectors=want)
+        return (np.asarray(w), Z.to_numpy() if Z is not None else None,
+                int(info))
+
+    hegv.__name__ = name
+    return hegv
+
+
 # materialize the drop-in surface: s/d/c/z × routine (mirrors the
 # reference's lapack_api/ file list: gecon gels gemm gesv gesv_mixed
 # gesvd getrf getri getrs heev heevd hemm her2k herk lange lanhe lansy
@@ -865,7 +913,9 @@ for _p, _dt in _DTYPES.items():
     globals()[_p + "gelqf"] = _make_gelqf(_p, _dt)
     globals()[_p + "pbsv"] = _make_pbsv(_p, _dt)
     globals()[_p + "gbsv"] = _make_gbsv(_p, _dt)
+    globals()[_p + "trtri"] = _make_trtri(_p, _dt)
 for _p in ("s", "d"):
+    globals()[_p + "sygv"] = _make_hegv(_p, _DTYPES[_p], _p + "sygv")
     globals()[_p + "syev"] = _make_heev(_p, _DTYPES[_p], _p + "syev")
     globals()[_p + "syevd"] = _make_heevd(_p, _DTYPES[_p], _p + "syevd")
     globals()[_p + "ormqr"] = _make_unmqr(_p, _DTYPES[_p], _p + "ormqr")
@@ -874,6 +924,7 @@ for _p in ("s", "d"):
     globals()[_p + "sytrf"] = _make_hetrf(_p, _DTYPES[_p], _p + "sytrf")
     globals()[_p + "sytrs"] = _make_hetrs(_p, _DTYPES[_p], _p + "sytrs")
 for _p in ("c", "z"):
+    globals()[_p + "hegv"] = _make_hegv(_p, _DTYPES[_p], _p + "hegv")
     globals()[_p + "heev"] = _make_heev(_p, _DTYPES[_p], _p + "heev")
     globals()[_p + "heevd"] = _make_heevd(_p, _DTYPES[_p], _p + "heevd")
     globals()[_p + "hemm"] = _make_symm_like(_p, _DTYPES[_p], _p + "hemm",

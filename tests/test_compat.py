@@ -960,3 +960,107 @@ def test_lapack_pbsv_gbsv_upper_and_packed():
     assert ipiv.shape == (n,)
     assert np.all(ipiv >= np.arange(n) + 1)
     assert np.all(ipiv <= np.minimum(np.arange(n) + 1 + kl, n))
+
+
+def test_lapack_trtri_sygv_hegv():
+    """Round-5 C-API-parity additions: ?trtri (slate_triangular_inverse
+    analog), ?sygv/?hegv (slate_generalized_hermitian_eig analog)."""
+    n = 40
+    a = RNG.standard_normal((n, n)) / n
+    a[np.arange(n), np.arange(n)] = 2.0 + np.abs(a.diagonal())
+    L = np.tril(a)
+    inv, info = lp.dtrtri("L", "N", n, L, n)
+    assert info == 0
+    np.testing.assert_allclose(L @ inv, np.eye(n), atol=1e-10)
+    # singular diagonal -> LAPACK info = first zero index
+    Ls = L.copy(); Ls[4, 4] = 0.0
+    _, info = lp.dtrtri("L", "N", n, Ls, n)
+    assert info == 5
+
+    b = _spd(n)
+    s = RNG.standard_normal((n, n)); s = (s + s.T) / 2
+    w, z, info = lp.dsygv(1, "V", "L", n, s, n, b, n)
+    assert info == 0
+    # reference via the standard transformation: B = C C^H,
+    # eig(C^-1 S C^-H) are the generalized eigenvalues
+    c = np.linalg.cholesky(b)
+    m = np.linalg.solve(c, np.linalg.solve(c, s).T).T
+    wref = np.linalg.eigvalsh((m + m.T) / 2)
+    np.testing.assert_allclose(np.sort(w), wref, atol=1e-7 * max(
+        1, np.abs(wref).max()))
+    # eigenvector residual: S z = w B z
+    r = s @ z - b @ z @ np.diag(w)
+    assert np.abs(r).max() < 1e-6 * max(1, np.abs(s).max())
+    # unsupported itype rejected
+    _, _, info = lp.dsygv(2, "N", "L", n, s, n, b, n)
+    assert info == -1
+
+    g = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
+    h = (g + g.conj().T) / 2
+    bz = g @ g.conj().T / n + 2 * np.eye(n)
+    w, z, info = lp.zhegv(1, "N", "L", n, h, n, bz, n)
+    assert info == 0
+    cz = np.linalg.cholesky(bz)
+    mz = np.linalg.solve(cz, np.linalg.solve(cz, h).conj().T).conj().T
+    wref = np.linalg.eigvalsh((mz + mz.conj().T) / 2)
+    np.testing.assert_allclose(np.sort(w), wref, atol=1e-7 * max(
+        1, np.abs(wref).max()))
+
+
+@pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
+                    reason="C toolchain test disabled")
+def test_c_api_trtri_sygv_nopiv_ctypes():
+    """New generated C entries: slate_tpu_dtrtri, slate_tpu_dsygv,
+    slate_tpu_dgesv_nopiv."""
+    import ctypes
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    so = os.path.join(native, "libslate_tpu_capi.so")
+    src = os.path.join(native, "capi_gen.c")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        subprocess.run(["make", "-C", native], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(so)
+    i64 = ctypes.c_int64
+    rng = np.random.default_rng(3)
+    n = 16
+
+    a = rng.standard_normal((n, n)) / n
+    a[np.arange(n), np.arange(n)] = 2.0 + np.abs(a.diagonal())
+    L = np.asfortranarray(np.tril(a))
+    L0 = L.copy()
+    lib.slate_tpu_dtrtri.restype = i64
+    rc = lib.slate_tpu_dtrtri(
+        ctypes.c_char_p(b"L"), ctypes.c_char_p(b"N"), i64(n),
+        L.ctypes.data_as(ctypes.c_void_p), i64(n))
+    assert rc == 0
+    assert np.abs(L0 @ L - np.eye(n)).max() < 1e-10
+
+    s = rng.standard_normal((n, n)); s = np.asfortranarray((s + s.T) / 2)
+    g = rng.standard_normal((n, n))
+    b = np.asfortranarray(g @ g.T / n + 2 * np.eye(n))
+    s0, b0 = s.copy(), b.copy()
+    w = np.zeros(n, np.float64)
+    lib.slate_tpu_dsygv.restype = i64
+    rc = lib.slate_tpu_dsygv(
+        i64(1), ctypes.c_char_p(b"V"), ctypes.c_char_p(b"L"), i64(n),
+        s.ctypes.data_as(ctypes.c_void_p), i64(n),
+        b.ctypes.data_as(ctypes.c_void_p), i64(n),
+        w.ctypes.data_as(ctypes.c_void_p))
+    assert rc == 0
+    r = s0 @ s - b0 @ s @ np.diag(w)  # eigenvectors overwrote S
+    assert np.abs(r).max() < 1e-6
+    # LAPACK exit state: B holds its Cholesky factor (lower here)
+    assert np.abs(np.tril(b) @ np.tril(b).T - b0).max() < 1e-8
+
+    an = np.asfortranarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    bn = np.asfortranarray(rng.standard_normal((n, 2)))
+    an0, bn0 = an.copy(), bn.copy()
+    lib.slate_tpu_dgesv_nopiv.restype = i64
+    rc = lib.slate_tpu_dgesv_nopiv(
+        i64(n), i64(2), an.ctypes.data_as(ctypes.c_void_p), i64(n),
+        bn.ctypes.data_as(ctypes.c_void_p), i64(n))
+    assert rc == 0
+    assert np.abs(an0 @ bn - bn0).max() < 1e-8

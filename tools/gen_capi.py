@@ -119,6 +119,14 @@ ROUTINES = [
                     "i:ldab", "A:b:ldb*nrhs", "i:ldb"]),
     ("gbsv", None, ["i:n", "i:kl", "i:ku", "i:nrhs", "A:ab:ldab*n",
                     "i:ldab", "P:ipiv:n", "A:b:ldb*nrhs", "i:ldb"]),
+    # slate_triangular_inverse / slate_generalized_hermitian_eig /
+    # slate_lu_solve_nopiv analogs (reference src/c_api/wrappers.cc)
+    ("trtri", None, ["s:uplo", "s:diag", "i:n", "A:a:lda*n", "i:lda"]),
+    ("hegv", {"s": "ssygv", "d": "dsygv", "c": "chegv", "z": "zhegv"},
+     ["i:itype", "s:jobz", "s:uplo", "i:n", "A:a:lda*n", "i:lda",
+      "A:b:ldb*n", "i:ldb", "R:w:n"]),
+    ("gesv_nopiv", None, ["i:n", "i:nrhs", "A:a:lda*n", "i:lda",
+                          "A:b:ldb*nrhs", "i:ldb"]),
     # --- opaque matrix handles (reference: include/slate/c_api/matrix.h
     # slate_Matrix_create_* + src/c_api/wrappers.cc): keep a
     # device-resident matrix across C calls, no per-call re-packing -------
