@@ -625,6 +625,57 @@ def c_hpotrf(dt, uplo, h) -> int:
     return int(info)
 
 
+def c_hgesv(dt, ha, hb) -> int:
+    """slate_lu_solve on handles: solve resident-A X = resident-B,
+    X replaces B's content (A's content is left as given — functional
+    semantics; the reference overwrites A with its LU factor)."""
+    import slate_tpu as st
+    A, B = _get_handle(ha), _get_handle(hb)
+    if A is None or B is None:
+        return -1
+    X, info = st.gesv(A, B)
+    if int(info) == 0:
+        _HANDLES[int(hb)] = X
+    return int(info)
+
+
+def c_htrsm(dt, side, uplo, transa, diag, alpha, ha, hb) -> int:
+    """slate_triangular_solve on handles: B <- alpha op(A)^-1 B (or
+    right side); the solution replaces B's handle content. The
+    triangle view is a device-side kind change (trsm masks the
+    opposite triangle itself) — no host round-trip."""
+    import dataclasses
+
+    import slate_tpu as st
+    from slate_tpu.core.types import Diag, MatrixKind, Side, Uplo
+    A, B = _get_handle(ha), _get_handle(hb)
+    if A is None or B is None:
+        return -1
+    u = Uplo.Lower if uplo.lower().startswith("l") else Uplo.Upper
+    d = Diag.Unit if diag.lower().startswith("u") else Diag.NonUnit
+    T = dataclasses.replace(A, kind=MatrixKind.Triangular, uplo=u,
+                            diag=d)
+    t = transa.lower()
+    if not t.startswith("n"):
+        T = T.T if t.startswith("t") else T.H
+    s = Side.Left if side.lower().startswith("l") else Side.Right
+    _HANDLES[int(hb)] = st.trsm(s, alpha, T, B)
+    return 0
+
+
+def c_hnorm(dt, norm, h, out_buf) -> int:
+    """slate_norm on a handle: Max/One/Inf/Fro of the resident matrix,
+    written to out_buf[0] (real scalar of the precision)."""
+    import slate_tpu as st
+    from .lapack_api import _norm_of
+    A = _get_handle(h)
+    if A is None:
+        return -1
+    v = st.norm(A, _norm_of(norm))
+    np.frombuffer(out_buf, dtype=_RDT[dt])[:1] = float(v)
+    return 0
+
+
 # --- legacy d-only aliases (pre-round-4 symbol names; kept so older
 # compiled callers of c_dgesv etc. keep working) ---------------------------
 

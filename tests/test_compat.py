@@ -26,6 +26,22 @@ def _spd(n, dtype=np.float64):
     return a @ a.T + n * np.eye(n, dtype=dtype)
 
 
+def _capi_lib():
+    """Load (rebuilding if stale) the embedded C API shared library."""
+    import ctypes
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    so = os.path.join(native, "libslate_tpu_capi.so")
+    srcs = [os.path.join(native, f) for f in ("capi_gen.c", "capi.c")]
+    if (not os.path.exists(so)
+            or any(os.path.exists(f)
+                   and os.path.getmtime(so) < os.path.getmtime(f)
+                   for f in srcs)):
+        subprocess.run(["make", "-C", native], check=True,
+                       capture_output=True)
+    return ctypes.CDLL(so)
+
+
 # -- LAPACK-style Python surface -------------------------------------------
 
 def test_lapack_dgesv_roundtrip():
@@ -640,13 +656,7 @@ def test_c_api_multiprecision_ctypes():
     600 s subprocess."""
     import ctypes
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    native = os.path.join(repo, "native")
-    so = os.path.join(native, "libslate_tpu_capi.so")
-    if not os.path.exists(so):
-        subprocess.run(["make", "-C", native], check=True,
-                       capture_output=True)
-    lib = ctypes.CDLL(so)
+    lib = _capi_lib()
     i64 = ctypes.c_int64
     rng = np.random.default_rng(0)
 
@@ -1014,15 +1024,7 @@ def test_c_api_trtri_sygv_nopiv_ctypes():
     slate_tpu_dgesv_nopiv."""
     import ctypes
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    native = os.path.join(repo, "native")
-    so = os.path.join(native, "libslate_tpu_capi.so")
-    src = os.path.join(native, "capi_gen.c")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(src)):
-        subprocess.run(["make", "-C", native], check=True,
-                       capture_output=True)
-    lib = ctypes.CDLL(so)
+    lib = _capi_lib()
     i64 = ctypes.c_int64
     rng = np.random.default_rng(3)
     n = 16
@@ -1064,3 +1066,62 @@ def test_c_api_trtri_sygv_nopiv_ctypes():
         bn.ctypes.data_as(ctypes.c_void_p), i64(n))
     assert rc == 0
     assert np.abs(an0 @ bn - bn0).max() < 1e-8
+
+
+@pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
+                    reason="C toolchain test disabled")
+def test_c_api_handle_verbs_ctypes():
+    """Round-5 handle-verb extensions: hgesv (slate_lu_solve on
+    handles), htrsm (slate_triangular_solve), hnorm (slate_norm) —
+    a resident matrix flows factor -> solve -> norm with no host
+    re-packing between calls."""
+    import ctypes
+
+    lib = _capi_lib()
+    i64 = ctypes.c_int64
+    dbl = ctypes.c_double
+    rng = np.random.default_rng(5)
+    n, nrhs = 24, 3
+
+    a = np.asfortranarray(
+        rng.standard_normal((n, n)) + n * np.eye(n))
+    b = np.asfortranarray(rng.standard_normal((n, nrhs)))
+    for f in ("matrix_from_buffer_d", "hgesv_d", "htrsm_d", "hnorm_d",
+              "matrix_to_buffer_d", "matrix_destroy"):
+        getattr(lib, "slate_tpu_" + f).restype = i64
+    ha = lib.slate_tpu_matrix_from_buffer_d(
+        i64(n), i64(n), a.ctypes.data_as(ctypes.c_void_p), i64(n), i64(8))
+    hb = lib.slate_tpu_matrix_from_buffer_d(
+        i64(n), i64(nrhs), b.ctypes.data_as(ctypes.c_void_p), i64(n),
+        i64(8))
+    assert ha > 0 and hb > 0
+    # resident solve: X replaces B's handle content
+    assert lib.slate_tpu_hgesv_d(i64(ha), i64(hb)) == 0
+    x = np.asfortranarray(np.zeros((n, nrhs)))
+    assert lib.slate_tpu_matrix_to_buffer_d(
+        i64(hb), i64(n), i64(nrhs),
+        x.ctypes.data_as(ctypes.c_void_p), i64(n)) == 0
+    assert np.abs(a @ x - b).max() < 1e-8
+
+    # resident triangular solve against the lower triangle of A
+    hb2 = lib.slate_tpu_matrix_from_buffer_d(
+        i64(n), i64(nrhs), b.ctypes.data_as(ctypes.c_void_p), i64(n),
+        i64(8))
+    assert lib.slate_tpu_htrsm_d(
+        ctypes.c_char_p(b"L"), ctypes.c_char_p(b"L"),
+        ctypes.c_char_p(b"N"), ctypes.c_char_p(b"N"), dbl(1.0),
+        i64(ha), i64(hb2)) == 0
+    y = np.asfortranarray(np.zeros((n, nrhs)))
+    assert lib.slate_tpu_matrix_to_buffer_d(
+        i64(hb2), i64(n), i64(nrhs),
+        y.ctypes.data_as(ctypes.c_void_p), i64(n)) == 0
+    assert np.abs(np.tril(a) @ y - b).max() < 1e-8
+
+    # resident norm
+    out = np.zeros(1, np.float64)
+    assert lib.slate_tpu_hnorm_d(
+        ctypes.c_char_p(b"1"), i64(ha),
+        out.ctypes.data_as(ctypes.c_void_p)) == 0
+    assert abs(out[0] - np.abs(a).sum(axis=0).max()) < 1e-9
+    for h in (ha, hb, hb2):
+        assert lib.slate_tpu_matrix_destroy(i64(h)) == 0

@@ -145,6 +145,12 @@ ROUTINES = [
      ["s:uplo", "i:ha", "i:hb"]),
     ("hpotrf", {dt: f"hpotrf_{dt}" for dt in "sdcz"},
      ["s:uplo", "i:h"]),
+    ("hgesv", {dt: f"hgesv_{dt}" for dt in "sdcz"}, ["i:ha", "i:hb"]),
+    ("htrsm", {dt: f"htrsm_{dt}" for dt in "sdcz"},
+     ["s:side", "s:uplo", "s:transa", "s:diag", "x:alpha", "i:ha",
+      "i:hb"]),
+    ("hnorm", {dt: f"hnorm_{dt}" for dt in "sdcz"},
+     ["s:norm", "i:h", "R:out:1"]),
 ]
 
 # routines whose return value is the computed norm (double), delivered
