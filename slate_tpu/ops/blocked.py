@@ -494,15 +494,16 @@ def _panel_getrf_base(a: Array) -> Tuple[Array, Array, Array]:
 def permute_rows_limited(x: Array, perm: Array, max_moved: int) -> Array:
     """out = x[perm] where perm moves at most ``max_moved`` rows (the case
     for partial-pivot panel permutations: w pivots displace ≤ 2w rows).
-    Gathers/scatters only the moved rows instead of materializing the
-    whole permuted array."""
-    n = x.shape[0]
-    if max_moved >= n:
-        return x[perm]
-    iota = jnp.arange(n, dtype=perm.dtype)
-    moved = jnp.nonzero(perm != iota, size=max_moved, fill_value=0)[0]
-    # fill rows duplicate index 0: perm[0] == 0 there, an idempotent write
-    return x.at[moved].set(x[perm[moved]])
+
+    Round-5 on-chip finding: the "touch only the moved rows" scheme
+    (nonzero + row gather + row SCATTER) measures SLOWER than the
+    plain full gather on TPU — 10.4 vs 6.4 ms at (16384², 2048 moved)
+    — because XLA:TPU lowers the dynamic row scatter far below HBM
+    bandwidth while the full-row gather streams. ``max_moved`` is kept
+    in the signature as documentation of the displacement bound (and
+    for any future backend where bounded scatter wins)."""
+    del max_moved
+    return x[perm]
 
 
 def _compose_tail(p1: Array, p2: Array, h: int) -> Array:
