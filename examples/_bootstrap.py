@@ -1,0 +1,11 @@
+"""Shared example bootstrap: make the repo importable when run from
+anywhere and honor JAX_PLATFORMS despite the axon sitecustomize
+(compat.platform docstring has the full story)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from slate_tpu.compat.platform import apply_env_platforms  # noqa: E402
+
+apply_env_platforms()

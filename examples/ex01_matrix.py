@@ -3,6 +3,8 @@
 Reference analog: examples/ex01_matrix.cc + ex02_conversion.cc.
 """
 
+import _bootstrap  # noqa: F401  (repo path + platform override)
+
 import jax.numpy as jnp
 import numpy as np
 

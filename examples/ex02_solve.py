@@ -5,6 +5,8 @@ Reference analog: examples/ex05_blas.cc, ex06_linear_system_lu.cc,
 ex07_linear_system_cholesky.cc, ex09_least_squares.cc.
 """
 
+import _bootstrap  # noqa: F401  (repo path + platform override)
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -4,6 +4,8 @@ Reference analog: examples/ex10_svd.cc, ex11_hermitian_eig.cc,
 ex12_generalized_hermitian_eig.cc.
 """
 
+import _bootstrap  # noqa: F401  (repo path + platform override)
+
 import jax.numpy as jnp
 import numpy as np
 

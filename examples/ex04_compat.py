@@ -7,8 +7,11 @@ for the slate shim and keeps its BLACS buffers; here the same data
 flows through interop.scalapack and the compat.lapack_api symbols).
 """
 
-import ctypes
+import _bootstrap  # noqa: F401  (repo path + platform override)
+
 import os
+
+import ctypes
 
 import numpy as np
 

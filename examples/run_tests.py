@@ -41,10 +41,16 @@ def main(argv=None):
             print("!!! mesh sweep FAILED")
         if "--mesh-sweep" in argv:
             return fails
+    env_ex = dict(env)
+    # smoke runs target the CPU backend: fast compiles, and the
+    # complex-dtype paths in ex03/ex04 (zheev, zgesv) hit UNIMPLEMENTED
+    # on the axon TPU backend; each example honors this via
+    # apply_env_platforms (the sitecustomize ignores plain env vars)
+    env_ex.setdefault("JAX_PLATFORMS", "cpu")
     for ex in sorted(here.glob("ex*.py")):
         print(f"=== {ex.name} ===")
         r = subprocess.run([sys.executable, str(ex)], cwd=here.parent,
-                           env=env)
+                           env=env_ex)
         if r.returncode != 0:
             fails += 1
             print(f"!!! {ex.name} FAILED")

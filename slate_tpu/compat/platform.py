@@ -8,9 +8,11 @@ Re-applying the env value through jax.config wins as long as it runs
 before any backend initializes.
 
 Call sites: compat/c_glue.py (the embedded C-API interpreter),
-bench.py's CPU-fallback child, and — as inline copies that cannot
-import this module before jax — tests/conftest.py and the generated
-child code in __graft_entry__.dryrun_multichip.
+bench.py's CPU-fallback child, tools/ (potrf_ab, profile_potrf),
+the tester CLI, examples/_bootstrap.py (shared by every ex*.py), and
+— as inline copies that cannot import this module before jax —
+tests/conftest.py and the generated child code in
+__graft_entry__.dryrun_multichip.
 """
 
 from __future__ import annotations
