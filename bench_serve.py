@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Serving benchmark: resident-factor cached solves vs per-request
+factor+solve.
+
+Drives the slate_tpu.runtime stack end to end — Session (HBM-budget
+factor cache) + Executor (batching, AOT warmup) — against the naive
+baseline every caller pays today: one full factor+solve per request.
+The headline is the throughput ratio; the artifact also records the
+serving percentiles and cache hit-rate the runtime's Metrics export.
+
+Artifact schema (JSON, one object; see PERF.md "bench_serve artifact"):
+  {"bench": "serve", "backend": ..., "dtype": ...,
+   "n": int, "nb": int, "requests": int, "max_batch": int,
+   "serve":       {"wall_s", "solves_per_sec", "p50_ms", "p99_ms",
+                   "cache_hit_rate", "batches", "gflops"},
+   "per_request": {"wall_s", "solves_per_sec"},
+   "speedup": serve.solves_per_sec / per_request.solves_per_sec}
+
+--smoke: small shapes on CPU, <60 s, exit 0 iff the artifact was
+written and cached-factor serving beat per-request factor+solve
+(speedup > 1) — wired into examples/run_tests.py.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from slate_tpu.compat.platform import apply_env_platforms
+
+apply_env_platforms()
+
+
+def _build_operator(n, nb, dtype):
+    import slate_tpu as st
+
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    spd = a @ a.T + n * np.eye(n, dtype=dtype)
+    A = st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower)
+    return A, spd
+
+
+def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
+          dtype=np.float32, out_path="BENCH_SERVE.json"):
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.runtime import Executor, Session
+
+    A, spd = _build_operator(n, nb, dtype)
+    rng = np.random.default_rng(11)
+    rhs = [rng.standard_normal(n).astype(dtype) for _ in range(requests)]
+
+    # -- baseline: factor+solve per request (what callers pay today) ------
+    def per_request_solve(b):
+        X, info = st.posv(A, st.from_dense(b[:, None], nb=nb))
+        return jax.block_until_ready(X.data)
+
+    per_request_solve(rhs[0])  # warm the compile caches
+    t0 = time.perf_counter()
+    for b in rhs:
+        per_request_solve(b)
+    per_request_wall = time.perf_counter() - t0
+
+    # -- serving runtime: resident factor + batched dispatch --------------
+    sess = Session(hbm_budget=1 << 30)
+    h = sess.register(A, op="chol")
+    with Executor(sess, max_batch=max_batch, max_wait=max_wait) as ex:
+        ex.warmup([h])  # factor + AOT compile off the request path
+        t0 = time.perf_counter()
+        futs = [ex.submit(h, b) for b in rhs]
+        xs = [f.result(timeout=600) for f in futs]
+        serve_wall = time.perf_counter() - t0
+
+    # correctness spot check (serving a wrong answer fast is not a win)
+    resid = max(float(np.abs(spd @ x - b).max()) / n
+                for x, b in zip(xs[:4], rhs[:4]))
+    if not resid < 1e-2:
+        raise RuntimeError(f"serving residual too large: {resid}")
+
+    snap = sess.metrics.snapshot()
+    lat = snap["histograms"].get("request_latency", {})
+    artifact = {
+        "bench": "serve",
+        "backend": jax.devices()[0].platform,
+        "dtype": np.dtype(dtype).name,
+        "n": n, "nb": nb, "requests": requests, "max_batch": max_batch,
+        "serve": {
+            "wall_s": serve_wall,
+            "solves_per_sec": requests / serve_wall,
+            "p50_ms": lat.get("p50", 0.0) * 1e3,
+            "p99_ms": lat.get("p99", 0.0) * 1e3,
+            "cache_hit_rate": snap["derived"]["cache_hit_rate"],
+            "batches": snap["counters"].get("batches_total", 0),
+            "gflops": snap["derived"]["gflops"],
+        },
+        "per_request": {
+            "wall_s": per_request_wall,
+            "solves_per_sec": requests / per_request_wall,
+        },
+    }
+    artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
+                           / artifact["per_request"]["solves_per_sec"])
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(artifact, sort_keys=True))
+    return artifact
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small CPU run, <60 s; exit 0 iff serving beat "
+                        "per-request factor+solve")
+    p.add_argument("--n", type=int, default=512)
+    p.add_argument("--nb", type=int, default=128)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--max-batch", type=int, default=16)
+    p.add_argument("--out", default="BENCH_SERVE.json")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.n, args.nb, args.requests = 192, 64, 48
+        args.out = (args.out if args.out != "BENCH_SERVE.json"
+                    else "BENCH_SERVE_smoke.json")
+    art = bench(n=args.n, nb=args.nb, requests=args.requests,
+                max_batch=args.max_batch, out_path=args.out)
+    ok = art["speedup"] > 1.0
+    print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
+          f"per-request {art['per_request']['solves_per_sec']:.1f} "
+          f"solves/s -> speedup {art['speedup']:.2f}x "
+          f"(hit-rate {art['serve']['cache_hit_rate']:.2f})",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

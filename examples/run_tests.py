@@ -3,10 +3,23 @@ examples/run_tests.py — doubles as an API regression test; the --grid
 sweep is the `mpirun -np 8 tester` artifact of SURVEY §4, run on the
 8-device virtual CPU mesh)."""
 
+import importlib.util
 import os
 import pathlib
 import subprocess
 import sys
+
+
+def _platform_mod():
+    """compat/platform.py loaded standalone (keeps jax out of this
+    parent process; the children initialize their own backends)."""
+    spec = importlib.util.spec_from_file_location(
+        "_slate_tpu_platform",
+        str(pathlib.Path(__file__).parent.parent / "slate_tpu" / "compat"
+            / "platform.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 # the multi-process tester artifact: a 2×4 virtual-mesh sweep over one
 # representative routine per family (VERDICT r3 #7 — the reference's
@@ -30,10 +43,14 @@ def main(argv=None):
         env_sweep["JAX_PLATFORMS"] = "cpu"
         flags = env_sweep.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
-            env_sweep["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-                " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-            ).strip()
+            flags = (flags
+                     + " --xla_force_host_platform_device_count=8").strip()
+            # unknown XLA_FLAGS abort the process on some jaxlib
+            # builds; the probe-gated helper adds the rendezvous-
+            # timeout raise only where it exists
+            flags += _platform_mod().collective_timeout_flag_if_supported(
+                cache_path=str(here.parent / ".xla_flag_probe.json"))
+            env_sweep["XLA_FLAGS"] = flags
         print("=== tester mesh sweep (2x4 virtual CPU mesh) ===")
         r = subprocess.run(MESH_SWEEP, cwd=here.parent, env=env_sweep)
         if r.returncode != 0:
@@ -54,6 +71,16 @@ def main(argv=None):
         if r.returncode != 0:
             fails += 1
             print(f"!!! {ex.name} FAILED")
+    # serving-runtime smoke: exercises Session/Executor/metrics end to
+    # end and asserts cached-factor serving beats per-request
+    # factor+solve (bench_serve.py exits nonzero otherwise)
+    print("=== bench_serve.py --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"), "--smoke"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --smoke FAILED")
     return fails
 
 

@@ -37,4 +37,6 @@ from .api import (multiply, rank_k_update, rank_2k_update,
                   lu_solve_using_factor, lu_inverse_using_factor,
                   chol_factor, chol_solve, chol_solve_using_factor,
                   chol_inverse_using_factor, band_solve, indefinite_solve,
+                  qr_factor, least_squares_solve_using_factor,
                   least_squares_solve)
+from . import runtime

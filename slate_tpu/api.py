@@ -150,6 +150,22 @@ def indefinite_solve(A: TiledMatrix, B: TiledMatrix,
     return X
 
 
+def qr_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
+    """Householder QR factor as a resident object (geqrf). The QR
+    analog of lu_factor/chol_factor for the factor-reuse verbs below."""
+    return qr_mod.geqrf(A, opts)
+
+
+def least_squares_solve_using_factor(QR, B: TiledMatrix,
+                                     opts: Options = DEFAULT_OPTIONS
+                                     ) -> TiledMatrix:
+    """Overdetermined least-squares solve from a resident qr_factor
+    result: X = R⁻¹·(Qᴴ·B)[:n]. Completes the *_solve_using_factor verb
+    family (simplified_api.hh pattern) so the serving runtime can keep
+    QR operators hot like LU/Cholesky ones."""
+    return qr_mod.gels_using_factor(QR, B, opts)
+
+
 def least_squares_solve(A: TiledMatrix, B: TiledMatrix,
                         opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     return qr_mod.gels(A, B, opts)

@@ -463,16 +463,32 @@ def c_trtri(dt, uplo, diag, n, a_buf, lda) -> int:
     inv, info = getattr(_lp(), dt + "trtri")(uplo, diag, n, np.array(a), n)
     if info == 0:
         # LAPACK in-place contract: only the stored triangle is
-        # written; the opposite triangle's data stays untouched
+        # written; the opposite triangle's data stays untouched — and
+        # with DIAG='U' the diagonal is neither referenced nor
+        # modified, so the caller's stored diagonal survives too
+        orig = np.array(a)
+        keep_diag = (np.diagonal(orig) if diag.lower().startswith("u")
+                     else np.diagonal(inv))
         if uplo.lower().startswith("l"):
-            a[:, :] = np.tril(inv) + np.triu(np.array(a), 1)
+            a[:, :] = (np.tril(inv, -1) + np.diag(keep_diag)
+                       + np.triu(orig, 1))
         else:
-            a[:, :] = np.triu(inv) + np.tril(np.array(a), -1)
+            a[:, :] = (np.triu(inv, 1) + np.diag(keep_diag)
+                       + np.tril(orig, -1))
     return int(info)
 
 
 def c_hegv(dt, itype, jobz, uplo, n, a_buf, lda, b_buf, ldb,
            w_buf) -> int:
+    """Generalized Hermitian-definite eigenproblem on LAPACK buffers.
+
+    Exit-state contract: on info=0, W holds the eigenvalues, A the
+    eigenvectors (jobz='V'), and B its Cholesky factor. When the device
+    solve succeeds but the host-side reconstruction of B's factor fails
+    (marginally-definite B), info = 2n+1 is returned — outside LAPACK's
+    1..2n failure coding, so it is distinguishable — and the exit state
+    is PARTIAL: W (and A's eigenvectors) are valid, but B still holds
+    the caller's original data, not its factor."""
     et = _DT[dt]
     name = dt + ("sygv" if dt in "sd" else "hegv")
     a = _as_cm(a_buf, n, lda, n, et)
@@ -497,9 +513,10 @@ def c_hegv(dt, itype, jobz, uplo, n, a_buf, lda, b_buf, ldb,
                 np.complex128 if np.iscomplexobj(bn) else np.float64))
         except np.linalg.LinAlgError:
             # marginally-definite B: the device solve succeeded but the
-            # stricter host factorization failed — leave B as given
-            # rather than raising through the embedded interpreter
-            return int(info)
+            # stricter host factorization failed — B is left as given
+            # (unmet LAPACK exit contract), flagged by the distinct
+            # info = 2n+1 documented above
+            return 2 * n + 1
         fac = f if lower else np.conj(f.T)
         keep = np.triu(bn, 1) if lower else np.tril(bn, -1)
         b[:, :] = (fac.astype(bn.dtype)
@@ -509,16 +526,23 @@ def c_hegv(dt, itype, jobz, uplo, n, a_buf, lda, b_buf, ldb,
 
 def c_gesv_nopiv(dt, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
     """slate_lu_solve_nopiv analog (no LAPACK symbol — the reference
-    exposes it only through the C API / slate.hh)."""
+    exposes it only through the C API / slate.hh). Matches the
+    reference's exit state: A is overwritten by its no-pivot LU factors
+    (L unit-lower below the diagonal, U on/above) whenever the
+    factorization ran, so callers can reuse the factored A; B gets the
+    solution only on info=0."""
     et = _DT[dt]
     a = _as_cm(a_buf, n, lda, n, et)
     b = _as_cm(b_buf, n, ldb, nrhs, et)
     import slate_tpu as st
     from slate_tpu.core.types import MethodLU, Options
+    opts = Options(method_lu=MethodLU.NoPiv)
     A = st.from_dense(np.array(a, order="C"), nb=max(16, min(256, n)))
     B = st.from_dense(np.array(b, order="C"), nb=max(16, min(256, n)))
-    X, info = st.gesv(A, B, Options(method_lu=MethodLU.NoPiv))
+    LU, perm, info = st.getrf(A, opts)
+    a[:, :] = np.asarray(LU.to_numpy())[:n, :n]
     if int(info) == 0:
+        X = st.getrs(LU, perm, B, opts)
         b[:, :] = np.asarray(X.to_numpy())[:n, :nrhs]
     return int(info)
 
@@ -526,10 +550,19 @@ def c_gesv_nopiv(dt, n, nrhs, a_buf, lda, b_buf, ldb) -> int:
 # --- opaque matrix handles (reference analog: the generated
 # slate_Matrix_create_* C API, include/slate/c_api/matrix.h +
 # src/c_api/wrappers.cc) — C callers keep a device-resident TiledMatrix
-# across calls instead of re-packing dense buffers per call ------------------
+# across calls instead of re-packing dense buffers per call. Solve verbs
+# route through the process-wide runtime Session (slate_tpu.runtime), so
+# repeated solves against the same handle reuse its resident
+# factorization from the shared HBM-budget cache. ---------------------------
 
 _HANDLES: dict = {}
 _HANDLE_SEQ = [0]
+_HANDLE_KEYS: dict = {}  # capi handle -> session keys registered for it
+
+
+def _serve_session():
+    from slate_tpu.runtime import default_session
+    return default_session()
 
 
 def _new_handle(M) -> int:
@@ -541,6 +574,50 @@ def _new_handle(M) -> int:
 
 def _get_handle(h: int):
     return _HANDLES.get(int(h))
+
+
+def _set_handle(h: int, M):
+    """Replace a handle's resident content — any factorization the
+    serving Session cached for the old content is now stale; drop it."""
+    _invalidate_handle(h)
+    _HANDLES[int(h)] = M
+
+
+def _invalidate_handle(h: int):
+    keys = _HANDLE_KEYS.pop(int(h), ())
+    if keys:
+        sess = _serve_session()
+        for k in keys:
+            sess.unregister(k)
+
+
+def _session_solver(h: int, M, op: str, uplo: str = None):
+    """(session, key) for solving against handle ``h``'s content,
+    registering the operator with the shared Session on first use."""
+    from slate_tpu.core.exceptions import SlateError
+    sess = _serve_session()
+    key = ("capi", int(h), op, uplo)
+    if key not in sess:
+        A = _handle_hermitian(M, uplo) if op == "chol" else M
+        try:
+            sess.register(A, op=op, handle=key)
+        except SlateError:
+            # a concurrent native thread won the register race — the
+            # content is identical (same handle), so just use its entry
+            pass
+        _HANDLE_KEYS.setdefault(int(h), set()).add(key)
+        cur = _HANDLES.get(int(h))
+        if cur is not M:
+            # the handle was rewritten (or destroyed) between our read
+            # and the registration recording — the invalidation in
+            # _set_handle could not see our key yet, so drop the stale
+            # registration ourselves and re-resolve from current content
+            sess.unregister(key)
+            _HANDLE_KEYS.get(int(h), set()).discard(key)
+            if cur is None:
+                return sess, key  # destroyed: solve will fail cleanly
+            return _session_solver(h, cur, op, uplo)
+    return sess, key
 
 
 def c_matrix_create(dt, m, n, nb) -> int:
@@ -570,6 +647,7 @@ def c_matrix_to_buffer(dt, h, m, n, a_buf, lda) -> int:
 
 
 def c_matrix_destroy(dt, h) -> int:
+    _invalidate_handle(h)
     return 0 if _HANDLES.pop(int(h), None) is not None else -1
 
 
@@ -586,8 +664,8 @@ def c_hgemm(dt, transa, transb, alpha, ha, hb, beta, hc) -> int:
         return M if t.startswith("n") else (M.T if t.startswith("t")
                                             else M.H)
 
-    _HANDLES[int(hc)] = st.gemm(alpha, op(A, transa), op(B, transb),
-                                beta, C)
+    _set_handle(hc, st.gemm(alpha, op(A, transa), op(B, transb),
+                            beta, C))
     return 0
 
 
@@ -601,15 +679,32 @@ def _handle_hermitian(M, uplo: str):
 
 def c_hposv(dt, uplo, ha, hb) -> int:
     """Solve resident-A X = resident-B; X replaces B's handle content.
-    A's handle content is the dense Hermitian data (uplo triangle)."""
-    import slate_tpu as st
+    A's handle content is the dense Hermitian data (uplo triangle).
+    Routed through the shared runtime Session: the Cholesky factor of A
+    stays resident, so repeated solves against the same handle skip the
+    factorization (cache-hit) until the handle's content changes or the
+    factor is evicted under HBM pressure."""
+    from slate_tpu.core.exceptions import SlateError
     A, B = _get_handle(ha), _get_handle(hb)
     if A is None or B is None:
         return -1
-    X, info = st.posv(_handle_hermitian(A, uplo), B)
-    if int(info) == 0:
-        _HANDLES[int(hb)] = X
-    return int(info)
+    sess, key = _session_solver(ha, A, "chol", uplo)
+    try:
+        X = sess.solve_matrix(key, B)
+    except SlateError:
+        # factorization failure (potrf info > 0) or solve failure; the
+        # factor record is cached, so the info peek costs no access.
+        # A solve failure with a clean factor returns 2n+1 — positive
+        # and outside LAPACK's 1..n info range (info < 0 would falsely
+        # claim an illegal argument)
+        try:
+            info = sess.factor_info(key)
+        except SlateError:
+            return -1  # handle destroyed/unregistered mid-call
+        n = A.shape[0]
+        return int(info) if int(info) != 0 else 2 * n + 1
+    _set_handle(hb, X)
+    return 0
 
 
 def c_hpotrf(dt, uplo, h) -> int:
@@ -621,22 +716,37 @@ def c_hpotrf(dt, uplo, h) -> int:
         return -1
     L, info = st.potrf(_handle_hermitian(A, uplo))
     if int(info) == 0:
-        _HANDLES[int(h)] = L
+        _set_handle(h, L)
     return int(info)
 
 
 def c_hgesv(dt, ha, hb) -> int:
     """slate_lu_solve on handles: solve resident-A X = resident-B,
     X replaces B's content (A's content is left as given — functional
-    semantics; the reference overwrites A with its LU factor)."""
-    import slate_tpu as st
+    semantics; the reference overwrites A with its LU factor). Routed
+    through the shared runtime Session: A's LU factor stays resident
+    across calls (see c_hposv)."""
+    from slate_tpu.core.exceptions import SlateError
     A, B = _get_handle(ha), _get_handle(hb)
     if A is None or B is None:
         return -1
-    X, info = st.gesv(A, B)
-    if int(info) == 0:
-        _HANDLES[int(hb)] = X
-    return int(info)
+    sess, key = _session_solver(ha, A, "lu")
+    try:
+        X = sess.solve_matrix(key, B)
+    except SlateError:
+        # factorization failure (getrf info > 0) or solve failure; the
+        # factor record is cached, so the info peek costs no access.
+        # A solve failure with a clean factor returns 2n+1 — positive
+        # and outside LAPACK's 1..n info range (info < 0 would falsely
+        # claim an illegal argument)
+        try:
+            info = sess.factor_info(key)
+        except SlateError:
+            return -1  # handle destroyed/unregistered mid-call
+        n = A.shape[0]
+        return int(info) if int(info) != 0 else 2 * n + 1
+    _set_handle(hb, X)
+    return 0
 
 
 def c_htrsm(dt, side, uplo, transa, diag, alpha, ha, hb) -> int:
@@ -659,7 +769,7 @@ def c_htrsm(dt, side, uplo, transa, diag, alpha, ha, hb) -> int:
     if not t.startswith("n"):
         T = T.T if t.startswith("t") else T.H
     s = Side.Left if side.lower().startswith("l") else Side.Right
-    _HANDLES[int(hb)] = st.trsm(s, alpha, T, B)
+    _set_handle(hb, st.trsm(s, alpha, T, B))
     return 0
 
 

@@ -858,10 +858,13 @@ def _make_trtri(prefix, dtype):
 def _make_hegv(prefix, dtype, name):
     def hegv(itype: int, jobz: str, uplo: str, n: int, a, lda: int,
              b, ldb: int):
-        """?sygv/?hegv: generalized Hermitian-definite eigenproblem
-        A·x = λ·B·x (itype 1 — the reference's hegv scope, src/hegv.cc).
-        Returns (w, z_or_None, info)."""
-        if itype != 1:
+        """?sygv/?hegv: generalized Hermitian-definite eigenproblem,
+        all three LAPACK problem types (itype 1: A·x = λ·B·x, 2:
+        A·B·x = λ·x, 3: B·A·x = λ·x — the hegst congruence handles 2/3,
+        matching the reference's src/hegv.cc scope). Returns
+        (w, z_or_None, info); itype out of range → info=-1 (LAPACK
+        argument-1 error)."""
+        if itype not in (1, 2, 3):
             return None, None, -1
         st = _st()
         from slate_tpu.core.types import Uplo
@@ -873,7 +876,7 @@ def _make_hegv(prefix, dtype, name):
         A = st.hermitian(tri_a, nb=_nb(n), uplo=u)
         B = st.hermitian(tri_b, nb=_nb(n), uplo=u)
         want = jobz.lower().startswith("v")
-        w, Z, info = st.hegv(A, B, want_vectors=want)
+        w, Z, info = st.hegv(A, B, want_vectors=want, itype=itype)
         return (np.asarray(w), Z.to_numpy() if Z is not None else None,
                 int(info))
 

@@ -7,7 +7,7 @@ from .cholesky import (potrf, potrs, posv, trtri, trtrm, potri, posv_mixed)
 from .lu import (getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv, gesv_nopiv,
                  gesv_rbt, gesv_mixed, getri, getri_oop, gerbt)
 from .qr import (QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr, gels,
-                 qr_multiply_explicit)
+                 gels_using_factor, qr_multiply_explicit)
 from .band import gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv
 from .band_packed import PackedBand, BandLU, pb_pack, gb_pack
 from .band_packed import tbsm as tbsm_packed

@@ -157,22 +157,30 @@ def _potrf_iter(a: jax.Array, nb: int, prec):
     exactly."""
     s = a.shape[0]
     nt = s // nb
+
+    def dus(x, val, i, j):
+        # int32 start indices: with x64 on, python ints lower to s64
+        # constants and the pre-0.6 SPMD partitioner emits a mixed
+        # s64/s32 compare the HLO verifier rejects
+        return jax.lax.dynamic_update_slice(
+            x, val, (jnp.int32(i), jnp.int32(j)))
+
     info = jnp.zeros((), jnp.int32)
     for k in range(nt):
         k0, k1 = k * nb, (k + 1) * nb
         lkk, tinfo = _tile_chol(a[k0:k1, k0:k1])
         info = jnp.where((info == 0) & (tinfo > 0), k0 + tinfo,
                          info).astype(jnp.int32)
-        a = jax.lax.dynamic_update_slice(a, lkk, (k0, k0))
+        a = dus(a, lkk, k0, k0)
         if k1 >= s:
             continue
         inv = blocked.trtri_lower_batched(lkk)
         pan = blocked.mm(a[k1:, k0:k1], jnp.conj(inv).T, prec)
         pan = blocked.rebalance(pan)
-        a = jax.lax.dynamic_update_slice(a, pan, (k1, k0))
+        a = dus(a, pan, k1, k0)
         trail = blocked.rebalance(
             blocked.herk_lower_rec(a[k1:, k1:], pan, prec=prec))
-        a = jax.lax.dynamic_update_slice(a, trail, (k1, k1))
+        a = dus(a, trail, k1, k1)
     return a, info
 
 

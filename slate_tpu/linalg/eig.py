@@ -798,49 +798,72 @@ def heev(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
 
 @accurate_matmuls
 def hegst(A: TiledMatrix, L: TiledMatrix,
-          opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    """Reduce generalized A·x = λ·B·x to standard form (itype 1;
-    slate::hegst, src/hegst.cc): A ← L⁻¹·A·L⁻ᴴ for a Lower factor
-    (B = L·Lᴴ) or A ← U⁻ᴴ·A·U⁻¹ for an Upper factor (B = UᴴU)."""
+          opts: Options = DEFAULT_OPTIONS, itype: int = 1) -> TiledMatrix:
+    """Reduce a generalized Hermitian-definite problem to standard form
+    (slate::hegst, src/hegst.cc — all three LAPACK itypes).
+
+    itype 1 (A·x = λ·B·x): A ← L⁻¹·A·L⁻ᴴ for a Lower factor (B = L·Lᴴ)
+    or A ← U⁻ᴴ·A·U⁻¹ for an Upper factor (B = UᴴU).
+    itype 2/3 (A·B·x = λ·x / B·A·x = λ·x): A ← Lᴴ·A·L (Lower) or
+    U·A·Uᴴ (Upper) — the same congruence for both problem types."""
+    if itype not in (1, 2, 3):
+        raise ValueError(f"hegst: itype must be 1, 2, or 3, got {itype}")
     a = A.full_dense_canonical()
     n = A.shape[0]
     lmat = L.full_dense_canonical()
     lmat = unit_pad_diag(lmat, n, n)
     lower = L.uplo is Uplo.Lower
-    if lower:
-        x = jax.lax.linalg.triangular_solve(
-            lmat, a, left_side=True, lower=True, unit_diagonal=False)
-        y = jax.lax.linalg.triangular_solve(
-            jnp.conj(lmat), x, left_side=False, lower=True,
-            unit_diagonal=False, transpose_a=True)
+    if itype == 1:
+        if lower:
+            x = jax.lax.linalg.triangular_solve(
+                lmat, a, left_side=True, lower=True, unit_diagonal=False)
+            y = jax.lax.linalg.triangular_solve(
+                jnp.conj(lmat), x, left_side=False, lower=True,
+                unit_diagonal=False, transpose_a=True)
+        else:
+            # U⁻ᴴ·A: solve Uᴴ·X = A (upper factor, conj-transposed solve)
+            x = jax.lax.linalg.triangular_solve(
+                jnp.conj(lmat), a, left_side=True, lower=False,
+                unit_diagonal=False, transpose_a=True)
+            # (U⁻ᴴA)·U⁻¹: solve Y·U = X
+            y = jax.lax.linalg.triangular_solve(
+                lmat, x, left_side=False, lower=False, unit_diagonal=False)
     else:
-        # U⁻ᴴ·A: solve Uᴴ·X = A (upper factor, conj-transposed solve)
-        x = jax.lax.linalg.triangular_solve(
-            jnp.conj(lmat), a, left_side=True, lower=False,
-            unit_diagonal=False, transpose_a=True)
-        # (U⁻ᴴA)·U⁻¹: solve Y·U = X
-        y = jax.lax.linalg.triangular_solve(
-            lmat, x, left_side=False, lower=False, unit_diagonal=False)
+        # multiplies instead of solves; the unit-padded diagonal makes
+        # the padding rows inert fixed points here too
+        tri = jnp.tril(lmat) if lower else jnp.triu(lmat)
+        if lower:
+            y = jnp.conj(tri).T @ a @ tri
+        else:
+            y = tri @ a @ jnp.conj(tri).T
     y = 0.5 * (y + jnp.conj(y).T)
     return from_dense(y, A.nb, grid=A.grid, kind=A.kind, uplo=Uplo.Lower,
                       logical_shape=(n, n))
 
 
 def hegv(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
-         want_vectors: bool = True
+         want_vectors: bool = True, itype: int = 1
          ) -> Tuple[Array, Optional[TiledMatrix], Array]:
     """Generalized Hermitian-definite eigensolver (slate::hegv = potrf(B)
-    + hegst + heev + trsm back-transform).
+    + hegst + heev + trsm/trmm back-transform; itype 1/2/3 as in
+    src/hegv.cc).
 
+    itype 1: A·x = λ·B·x;  itype 2: A·B·x = λ·x;  itype 3: B·A·x = λ·x.
     Returns (Lambda, X or None, info); info > 0 ⇔ B was not positive
     definite (potrf's code, propagated like the reference)."""
     from .cholesky import potrf
     Lb, info = potrf(B, opts)
-    As = hegst(A, Lb, opts)
+    As = hegst(A, Lb, opts, itype=itype)
     w, Z = heev(As, opts, want_vectors=want_vectors)
     if not want_vectors:
         return w, None, info
-    # x = L⁻ᴴ·z (Lower factor) or U⁻¹·z (Upper factor)
-    back = Lb.H if Lb.uplo is Uplo.Lower else Lb
-    X = blas3.trsm(Side.Left, 1.0, back, Z, opts)
+    lower = Lb.uplo is Uplo.Lower
+    if itype in (1, 2):
+        # x = L⁻ᴴ·z (Lower factor) or U⁻¹·z (Upper factor)
+        back = Lb.H if lower else Lb
+        X = blas3.trsm(Side.Left, 1.0, back, Z, opts)
+    else:
+        # itype 3: x = L·z (Lower) or Uᴴ·z (Upper)
+        mul = Lb if lower else Lb.H
+        X = blas3.trmm(Side.Left, 1.0, mul, Z, opts)
     return w, X, info

@@ -227,10 +227,15 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     m, n = A.shape
     a = _canonical(A)
     a = _pad_identity_diag(a, m, n)
+    from ..parallel import panel as panel_mod
+    # on pre-0.6 jax the dist-panel recursion mis-partitions under GSPMD
+    # (old shard_map rep semantics + partitioner bugs — see panel.py);
+    # honor the option only where the composition is sound
+    dist_panel = opts.lu_dist_panel and panel_mod.DRIVER_COMPOSABLE
     with blocked.distribute_on(A.grid):
         lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
                                         prec=opts.update_precision,
-                                        dist_panel=opts.lu_dist_panel,
+                                        dist_panel=dist_panel,
                                         threshold=opts.pivot_threshold)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info

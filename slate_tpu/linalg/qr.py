@@ -296,6 +296,21 @@ def tsqr(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
 # -- least squares ---------------------------------------------------------
 
 @accurate_matmuls
+def gels_using_factor(QR: QRFactors, B: TiledMatrix,
+                      opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Overdetermined least-squares solve from resident geqrf factors:
+    X = R⁻¹·(Qᴴ·B)[:n]. The factor-reusing verb the serving runtime
+    amortizes (analog of the tester's *_solve_using_factor pattern;
+    the reference exposes it by keeping its QR workspace alive)."""
+    n = QR.n
+    QtB = unmqr(Side.Left, QR, B, trans=True, opts=opts)
+    # top n rows: R X = (QᴴB)[:n]
+    qtb = QtB.dense_canonical()[: -(-n // QR.nb) * QR.nb]
+    QtB_top = from_dense(qtb, QR.nb, logical_shape=(n, B.shape[1]))
+    return blas3.trsm(Side.Left, 1.0, QR.r_matrix, QtB_top, opts)
+
+
+@accurate_matmuls
 def gels(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
          ) -> TiledMatrix:
     """Minimum-norm least squares solve min‖AX − B‖ (slate::gels,
@@ -313,11 +328,7 @@ def gels(A: TiledMatrix, B: TiledMatrix, opts: Options = DEFAULT_OPTIONS
                              logical_shape=(n, B.shape[1]))
             return blas3.trsm(Side.Left, 1.0, R, QtB, opts)
         QR = geqrf(A, opts)
-        QtB = unmqr(Side.Left, QR, B, trans=True, opts=opts)
-        # top n rows: R X = (QᴴB)[:n]
-        qtb = QtB.dense_canonical()[: -(-n // A.nb) * A.nb]
-        QtB_top = from_dense(qtb, A.nb, logical_shape=(n, B.shape[1]))
-        return blas3.trsm(Side.Left, 1.0, QR.r_matrix, QtB_top, opts)
+        return gels_using_factor(QR, B, opts)
     # underdetermined: minimum-norm via LQ: A = L·Q, X = Qᴴ·L⁻¹·B
     LQ = gelqf(A, opts)
     # L is R(of AᴴQR)ᴴ: lower (n? m×m)

@@ -21,7 +21,20 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+    # new-style shard_map (jax >= 0.6): sound replication tracking; the
+    # explicit panel may be composed into the GSPMD-partitioned driver
+    DRIVER_COMPOSABLE = True
+except ImportError:  # pre-0.6 jax: experimental namespace
+    from jax.experimental.shard_map import shard_map
+    # old shard_map: check_rep=True rejects the fori_loop carry (rep
+    # mismatch) and check_rep=False silently mis-lowers the P() outputs
+    # (psum over the unmentioned q axis) when NESTED inside the
+    # GSPMD-partitioned getrf driver — standalone calls are fine, so
+    # only the driver route is gated (linalg/lu.py falls back to the
+    # GSPMD panel there)
+    DRIVER_COMPOSABLE = False
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.grid import ROW_AXIS
@@ -92,10 +105,16 @@ def dist_panel_getrf(a: jax.Array, grid) -> Tuple[jax.Array, jax.Array,
             0, w, col_step, (al, perm0, jnp.zeros((), jnp.int32)))
         return al, perm, info
 
-    fn = shard_map(body, mesh=mesh,
-                   in_specs=P(ROW_AXIS, None),
-                   out_specs=(P(ROW_AXIS, None), P(), P()),
-                   check_vma=False)
+    try:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=P(ROW_AXIS, None),
+                       out_specs=(P(ROW_AXIS, None), P(), P()),
+                       check_vma=False)
+    except TypeError:  # pre-0.6 jax spells the kwarg check_rep
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=P(ROW_AXIS, None),
+                       out_specs=(P(ROW_AXIS, None), P(), P()),
+                       check_rep=False)
     a = lax.with_sharding_constraint(
         a, NamedSharding(mesh, P(ROW_AXIS, None)))
     return fn(a)

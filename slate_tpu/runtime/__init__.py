@@ -1,0 +1,17 @@
+"""slate_tpu.runtime — resident-factorization solve service.
+
+The serving layer over the simplified-API verbs: a Session keeps
+factored operators hot in an HBM-budget LRU cache, a Batcher coalesces
+same-shape solve requests into one stacked dispatch, an Executor gives
+an async submit/future front end with AOT warmup and bounded retry, and
+Metrics exports counters + latency percentiles as JSON. See
+DESIGN.md ("Serving runtime") and bench_serve.py for the measured win.
+"""
+
+from .batching import Batcher
+from .executor import Executor
+from .metrics import Histogram, Metrics
+from .session import Session, default_session
+
+__all__ = ["Batcher", "Executor", "Histogram", "Metrics", "Session",
+           "default_session"]

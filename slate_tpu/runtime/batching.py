@@ -1,0 +1,142 @@
+"""Shape-bucketing request batcher.
+
+N callers each asking for one right-hand side against the same resident
+operator should cost ONE kernel launch, not N: requests are bucketed by
+(handle, single-RHS shape, dtype), column-stacked into one (n, K)
+right-hand side, solved once through the Session, and split back —
+every *_solve_using_factor verb is column-independent, and dense
+right-hand sides are tile-padded to the operator's nb, so a K≤nb batch
+runs the SAME padded shape (hence the same compiled executable) as a
+single request and returns bit-identical per-request results.
+
+A bucket dispatches when it reaches ``max_batch`` or when its oldest
+request has waited ``max_wait`` seconds (the serving deadline knob:
+latency floor vs launch amortization). The Batcher itself owns no
+thread — the Executor drives ``pop_ready``/``run``; ``flush`` exists
+for synchronous callers and tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Dict, Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from .session import Session
+
+
+@dataclasses.dataclass
+class _Request:
+    b: np.ndarray          # always 2-D (rows, 1..k) column block
+    vector: bool           # original rank (reshape on completion)
+    future: Future
+    t_submit: float
+
+
+BucketKey = Tuple[Hashable, Tuple[int, ...], str]
+
+
+class Batcher:
+    """Coalesces same-operator/same-shape solve requests (see module
+    docstring). Thread-safe; dispatch runs on the caller of ``run``."""
+
+    def __init__(self, session: Session, max_batch: int = 32,
+                 max_wait: float = 2e-3):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.session = session
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self._lock = threading.Lock()
+        self._buckets: Dict[BucketKey, List[_Request]] = {}
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, handle: Hashable, b) -> Future:
+        """Enqueue one solve request; resolves to the solution array
+        with the same rank as ``b``."""
+        b = np.asarray(b)
+        vector = b.ndim == 1
+        b2 = b[:, None] if vector else b
+        key: BucketKey = (handle, tuple(b2.shape), str(b2.dtype))
+        req = _Request(b2, vector, Future(), time.monotonic())
+        self.session.metrics.inc("requests_total")
+        with self._lock:
+            self._buckets.setdefault(key, []).append(req)
+        return req.future
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._buckets.values())
+
+    # -- readiness ---------------------------------------------------------
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest monotonic time any bucket must dispatch, or None."""
+        with self._lock:
+            oldest = [reqs[0].t_submit for reqs in self._buckets.values()
+                      if reqs]
+        if not oldest:
+            return None
+        return min(oldest) + self.max_wait
+
+    def pop_ready(self, now: Optional[float] = None, force: bool = False
+                  ) -> List[Tuple[BucketKey, List[_Request]]]:
+        """Detach buckets that are full or past deadline (all of them
+        when ``force``). Requests beyond max_batch stay queued."""
+        now = time.monotonic() if now is None else now
+        out: List[Tuple[BucketKey, List[_Request]]] = []
+        with self._lock:
+            for key in list(self._buckets):
+                reqs = self._buckets[key]
+                while (len(reqs) >= self.max_batch
+                       or (reqs and force)
+                       or (reqs and now - reqs[0].t_submit >= self.max_wait)):
+                    take, rest = reqs[:self.max_batch], reqs[self.max_batch:]
+                    out.append((key, take))
+                    self._buckets[key] = reqs = rest
+                if not reqs:
+                    del self._buckets[key]
+        return out
+
+    # -- dispatch ----------------------------------------------------------
+
+    def run(self, key: BucketKey, reqs: List[_Request]):
+        """Solve one detached bucket: stack → one Session solve → split.
+        Future resolution (including request latency metrics) happens
+        here; exceptions propagate to the caller AND the unresolved
+        futures are left pending so the caller can retry (see Executor).
+        Idempotent over futures: already-done (resolved on an earlier
+        attempt, or client-cancelled) requests are skipped, so a retry
+        only covers what is still unresolved."""
+        handle = key[0]
+        live = [r for r in reqs if not r.future.done()]
+        if not live:
+            return
+        stacked = np.concatenate([r.b for r in live], axis=1)
+        x = self.session.solve(handle, stacked)
+        m = self.session.metrics
+        m.inc("batches_total")
+        m.observe("batch_size", float(len(live)))
+        done = time.monotonic()
+        col = 0
+        for r in live:
+            w = r.b.shape[1]
+            xi = x[:, col:col + w]
+            col += w
+            try:
+                r.future.set_result(xi[:, 0] if r.vector else xi)
+            except InvalidStateError:
+                # client cancelled between our done() check and here
+                m.inc("cancelled_requests")
+                continue
+            m.observe("request_latency", done - r.t_submit)
+
+    def flush(self):
+        """Synchronously dispatch everything pending (caller's thread)."""
+        for key, reqs in self.pop_ready(force=True):
+            self.run(key, reqs)

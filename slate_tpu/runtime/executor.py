@@ -1,0 +1,155 @@
+"""Async submit/future front end for the solve service.
+
+An ``Executor`` owns a background worker thread that drives the
+Batcher: callers ``submit(handle, b)`` and get a
+``concurrent.futures.Future``; the worker sleeps until a bucket is full
+or its max-wait deadline expires, then dispatches it as one stacked
+Session solve. Transient dispatch failures (a flaky device tunnel, an
+interrupted transfer) are retried a bounded number of times before the
+batch's futures are failed.
+
+``warmup`` is the AOT path: for each registered shape bucket it factors
+the operator and ``jit(...).lower(...).compile()``s the solve off the
+request path (Session.warmup), so the first real request pays neither
+factorization nor compilation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Hashable, Iterable, Optional
+
+from .batching import Batcher
+from .session import Session
+
+
+class Executor:
+    """Background-thread serving front end over a Session.
+
+    Usage::
+
+        sess = Session(hbm_budget=2 << 30)
+        h = sess.register(A, op="chol")
+        with Executor(sess, max_batch=32, max_wait=2e-3) as ex:
+            ex.warmup([h])
+            futs = [ex.submit(h, b) for b in rhs_stream]
+            xs = [f.result() for f in futs]
+    """
+
+    def __init__(self, session: Session, max_batch: int = 32,
+                 max_wait: float = 2e-3, retries: int = 2):
+        self.session = session
+        self.retries = retries
+        self.batcher = Batcher(session, max_batch=max_batch,
+                               max_wait=max_wait)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._inflight = 0  # batches detached from the Batcher, unsolved
+        self._thread = threading.Thread(target=self._run,
+                                        name="slate-tpu-serve", daemon=True)
+        self._thread.start()
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, handle: Hashable, b) -> Future:
+        """Enqueue one solve request; never blocks on the device. The
+        shutdown check and the enqueue are one atomic step under the
+        lock, so a request can never land in a drained Batcher after
+        the worker has exited (its Future would hang forever)."""
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("Executor is shut down")
+            fut = self.batcher.submit(handle, b)
+            self._cv.notify_all()
+        return fut
+
+    def warmup(self, handles: Iterable[Hashable], nrhs: int = 1):
+        """AOT compile the solve for each handle's (rows, nrhs) bucket
+        (tile padding makes nrhs=1 cover widths up to nb for dense
+        operators — see Session.warmup)."""
+        for h in handles:
+            self.session.warmup(h, nrhs)
+
+    def flush(self):
+        """Block until everything queued at call time has been solved
+        (queued buckets AND batches already detached to the worker)."""
+        with self._cv:
+            self._cv.notify_all()
+            while self.batcher.pending() or self._inflight:
+                self._cv.wait(timeout=0.05)
+
+    def shutdown(self, wait: bool = True):
+        """Stop the worker; pending requests are force-dispatched."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if wait:
+            self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- worker ------------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cv:
+                if not self._stop:
+                    deadline = self.batcher.next_deadline()
+                    if deadline is None:
+                        self._cv.wait()
+                    else:
+                        timeout = deadline - time.monotonic()
+                        if timeout > 0:
+                            self._cv.wait(timeout)
+                stopping = self._stop
+                # detach + count in-flight under the SAME lock hold, so
+                # flush() never observes pending()==0 while a batch sits
+                # between pop_ready and dispatch
+                batches = self.batcher.pop_ready(force=stopping)
+                self._inflight += len(batches)
+            for key, reqs in batches:
+                try:
+                    self._dispatch(key, reqs)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
+            if stopping and not batches:
+                with self._cv:
+                    if not self.batcher.pending() and not self._inflight:
+                        return
+
+    def _dispatch(self, key, reqs):
+        """Run one bucket with bounded retry on TRANSIENT dispatch
+        failure (flaky tunnel, interrupted transfer). SlateError is
+        deterministic — unknown handle, factorization info≠0 — and
+        fails fast without retrying or touching the retries metric
+        (DESIGN.md: retry covers dispatch, not numerical failure)."""
+        from ..core.exceptions import SlateError
+
+        err: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                self.batcher.run(key, reqs)
+                return
+            except SlateError as e:
+                err = e
+                break
+            except Exception as e:  # noqa: BLE001 — failed futures carry it
+                err = e
+                if attempt < self.retries:
+                    self.session.metrics.inc("retries")
+        self.session.metrics.inc("failed_batches")
+        for r in reqs:
+            try:
+                if not r.future.done():
+                    r.future.set_exception(err)
+            except Exception:  # client cancelled concurrently — same
+                pass           # race Batcher.run guards on set_result

@@ -1,0 +1,200 @@
+"""Serving metrics: counters + latency histograms for the runtime.
+
+The reference ships coarse per-phase timers (the global ``timers`` map
+filled by drivers, printed by the tester at --timer-level 2) and the SVG
+trace timeline; a serving runtime needs the inference-stack versions of
+those: monotonically increasing counters (solves, cache hits/misses,
+evictions), latency histograms with percentile readout (p50/p99), and
+derived rates (solves/sec, GFLOP/s, cache hit-rate) — exported as JSON
+so a fleet scraper can ingest them.
+
+Phases are recorded through ``utils.trace.phase`` so every runtime
+measurement also lands in the existing Trace SVG timeline and the coarse
+``trace.timers`` map — one clock, three views.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils import trace
+
+
+class Histogram:
+    """Latency histogram backed by a capped sample reservoir.
+
+    Keeps exact count/sum/min/max plus the most recent ``cap`` samples
+    for percentile queries — at serving rates the recent window is what
+    p50/p99 should describe anyway (a day-old tail says nothing about
+    current latency)."""
+
+    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_samples")
+
+    def __init__(self, cap: int = 8192):
+        self.cap = cap
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = 0.0
+        self._samples = collections.deque(maxlen=cap)
+
+    def observe(self, value: float):
+        self.count += 1
+        self.total += value
+        self.vmin = min(self.vmin, value)
+        self.vmax = max(self.vmax, value)
+        self._samples.append(value)
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; nearest-rank over the retained window."""
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else 0.0,
+            "max": self.vmax,
+            "mean": self.total / self.count if self.count else 0.0,
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
+        }
+
+
+class Metrics:
+    """Thread-safe counter/histogram registry for one serving Session.
+
+    Counter vocabulary (all monotone):
+      solves_total, requests_total, batches_total, cache_hits,
+      cache_misses, evictions, factors_total, retries, aot_compiles,
+      flops_total (factor+solve work), solve_flops_total /
+      factor_flops_total (the split — the derived gflops rate is
+      solve_flops_total over solve_latency seconds, so amortized
+      factorizations do not inflate it), budget_overflows
+    Histograms (seconds, except batch_size):
+      solve_latency, factor_latency, request_latency, batch_size
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = collections.defaultdict(float)
+        self._hists: Dict[str, Histogram] = {}
+        self._t0 = time.perf_counter()
+
+    def inc(self, name: str, value: float = 1.0):
+        with self._lock:
+            self._counters[name] += value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def observe(self, name: str, value: float):
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
+    def phase(self, name: str, hist: Optional[str] = None):
+        """Context manager: a trace phase whose elapsed time also lands
+        in histogram ``hist`` (default: same name)."""
+        return _MetricPhase(self, name, hist or name)
+
+    # -- derived views -----------------------------------------------------
+
+    @staticmethod
+    def _derive(hits: float, misses: float, solves: float, flops: float,
+                solve_seconds: float) -> Dict[str, float]:
+        """One definition of the serving headline formulas, shared by
+        the accessor methods and the JSON snapshot — so a counting-
+        convention change cannot diverge the two."""
+        total = hits + misses
+        return {
+            "cache_hit_rate": hits / total if total else 0.0,
+            "solves_per_sec": (solves / solve_seconds
+                               if solve_seconds > 0 else 0.0),
+            "gflops": (flops / solve_seconds / 1e9
+                       if solve_seconds > 0 else 0.0),
+        }
+
+    def _derived_now(self) -> Dict[str, float]:
+        with self._lock:
+            h = self._hists.get("solve_latency")
+            return self._derive(
+                self._counters.get("cache_hits", 0.0),
+                self._counters.get("cache_misses", 0.0),
+                self._counters.get("solves_total", 0.0),
+                self._counters.get("solve_flops_total", 0.0),
+                h.total if h is not None else 0.0)
+
+    def cache_hit_rate(self) -> float:
+        return self._derived_now()["cache_hit_rate"]
+
+    def solves_per_sec(self) -> float:
+        """Throughput over accumulated device-solve time (dispatch+block),
+        not wall time — the bench driver reports wall-clock separately."""
+        return self._derived_now()["solves_per_sec"]
+
+    def gflops(self) -> float:
+        return self._derived_now()["gflops"]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            hists = {k: h.snapshot() for k, h in self._hists.items()}
+            uptime = time.perf_counter() - self._t0
+        # derived serving headline numbers (computed outside the lock
+        # from the consistent copies above)
+        solve = hists.get("solve_latency", {})
+        return {
+            "uptime_s": uptime,
+            "counters": counters,
+            "histograms": hists,
+            "derived": self._derive(
+                counters.get("cache_hits", 0.0),
+                counters.get("cache_misses", 0.0),
+                counters.get("solves_total", 0.0),
+                counters.get("solve_flops_total", 0.0),
+                solve.get("sum", 0.0)),
+        }
+
+    def to_json(self, path: Optional[str] = None, indent: int = 2) -> str:
+        """Serialize the snapshot; writes to ``path`` when given."""
+        text = json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text + "\n")
+        return text
+
+
+class _MetricPhase:
+    """trace.phase that feeds its elapsed time into a Metrics histogram."""
+
+    __slots__ = ("_metrics", "_hist", "_phase")
+
+    def __init__(self, metrics: Metrics, name: str, hist: str):
+        self._metrics = metrics
+        self._hist = hist
+        self._phase = trace.phase(name)
+
+    def __enter__(self):
+        self._phase.__enter__()
+        return self
+
+    @property
+    def elapsed(self) -> float:
+        return self._phase.elapsed
+
+    def __exit__(self, *exc):
+        self._phase.__exit__(*exc)
+        self._metrics.observe(self._hist, self._phase.elapsed)
+        return False

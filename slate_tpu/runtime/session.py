@@ -1,0 +1,447 @@
+"""Resident-factorization solve service.
+
+A ``Session`` owns device-resident factored operators (LU / Cholesky /
+QR / banded) keyed by a user handle, so N solve requests against the
+same operator pay ONE factorization — the TPU-native generalization of
+the reference tester's persistent-matrix + ``*_solve_using_factor``
+amortization (include/slate/simplified_api.hh), grown into a serving
+component: an HBM-byte-budget LRU cache over the factors, explicit
+eviction, refactor-on-miss, AOT compile warmup, and serving metrics.
+
+Layering: the Session only calls the public simplified-API verbs
+(``lu_factor``/``lu_solve_using_factor``, ``chol_factor``/..., the new
+``qr_factor``/``least_squares_solve_using_factor``), so anything those
+verbs learn (method dispatch, precision policy, sharding) is served
+automatically. The C API's opaque-handle solves (compat/c_glue.py)
+route through a process-wide ``default_session()`` so native callers
+share the same cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import api
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import MatrixKind, Options, DEFAULT_OPTIONS
+from ..linalg.band_packed import PackedBand
+from .metrics import Metrics
+
+# operator kinds a Session can keep resident
+OPS = ("lu", "chol", "qr", "band_lu", "band_chol")
+
+
+def _tree_nbytes(payload) -> int:
+    """Device bytes held by a factor payload (sum over pytree leaves)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(payload):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = int(np.asarray(leaf).nbytes)
+        total += int(nbytes)
+    return total
+
+
+def _factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
+    if op == "lu":
+        return 2.0 / 3.0 * n ** 3
+    if op == "chol":
+        return 1.0 / 3.0 * n ** 3
+    if op == "qr":
+        return 2.0 * m * n * n - 2.0 / 3.0 * n ** 3
+    # band factorizations: O(n · band²)
+    return 2.0 * n * band * band if band else 2.0 * n
+
+
+def _solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
+    if op in ("lu", "chol"):
+        return 2.0 * n * n * k
+    if op == "qr":
+        return (4.0 * m * n - 2.0 * n * n) * k
+    return 4.0 * n * band * k if band else 4.0 * n * k
+
+
+@dataclasses.dataclass
+class _Operator:
+    """A registered (not necessarily factored) operator."""
+
+    A: Any                   # TiledMatrix or PackedBand
+    op: str
+    opts: Options
+    m: int
+    n: int
+    band: int = 0            # kl+ku (band ops) for flop accounting
+
+
+@dataclasses.dataclass
+class _Resident:
+    """A cached factorization (the HBM the LRU budget governs)."""
+
+    payload: Tuple           # args for the *_solve_using_factor verb
+    info: int
+    nbytes: int
+
+
+class Session:
+    """Resident-factorization solve service with an HBM-budget LRU cache.
+
+    ``hbm_budget`` bounds the total device bytes of CACHED FACTORS (the
+    registered operators themselves are the caller's inputs and are not
+    charged). ``None`` means unbounded. Factors are built lazily on the
+    first solve (refactor-on-miss) and evicted least-recently-used when
+    an insert would exceed the budget; a single factor larger than the
+    whole budget is kept (you cannot serve without it) and counted in
+    the ``budget_overflows`` metric.
+
+    All public methods are thread-safe; solve dispatch is serialized
+    under one lock (the device executes one program at a time anyway —
+    the batcher, not thread fan-out, is the throughput lever).
+    """
+
+    def __init__(self, hbm_budget: Optional[int] = None,
+                 opts: Options = DEFAULT_OPTIONS,
+                 metrics: Optional[Metrics] = None):
+        self.hbm_budget = hbm_budget
+        self.opts = opts
+        self.metrics = metrics or Metrics()
+        self._lock = threading.RLock()
+        self._ops: Dict[Hashable, _Operator] = {}
+        self._cache: "OrderedDict[Hashable, _Resident]" = OrderedDict()
+        # per-(op, opts) jitted solve fns and per-shape AOT executables;
+        # both LRU-capped: compiled programs hold device memory, and a
+        # long-lived session serving many distinct shapes would
+        # otherwise re-grow the unbounded-residency problem the factor
+        # budget bounds (evicted entries simply recompile on reuse)
+        self._jit: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._compiled: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._jit_cap = 64
+        self._compiled_cap = 128
+        self._seq = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, A, op: str = "auto",
+                 handle: Optional[Hashable] = None,
+                 opts: Optional[Options] = None) -> Hashable:
+        """Register an operator; returns its handle (auto-allocated int
+        when not given). ``op``: one of {lu, chol, qr, band_lu,
+        band_chol} or "auto" (PackedBand → band_*, Hermitian/Symmetric
+        → chol, rectangular → qr, else lu)."""
+        if op == "auto":
+            op = self._infer_op(A)
+        if op not in OPS:
+            raise SlateError(f"Session.register: unknown op {op!r}")
+        # operand/op agreement, checked here so a mismatch fails at
+        # registration, not on the first request-path solve
+        if (op in ("band_lu", "band_chol")) != isinstance(A, PackedBand):
+            raise SlateError(
+                f"Session.register: op {op!r} requires a "
+                f"{'PackedBand' if op.startswith('band') else 'TiledMatrix'}"
+                f" operand, got {type(A).__name__}")
+        if isinstance(A, PackedBand):
+            m = n = A.n
+            band = A.kl + A.ku
+        else:
+            m, n = A.shape
+            band = 0
+        if op == "qr" and m < n:
+            # gels_using_factor covers only the overdetermined case; the
+            # underdetermined minimum-norm path needs LQ factors (gels
+            # handles it per call). Reject at registration instead of
+            # crashing on the first solve.
+            raise SlateError(
+                "Session.register: wide (m < n) operators are not "
+                "servable via resident QR; use least_squares_solve "
+                "per call")
+        with self._lock:
+            if handle is None:
+                self._seq += 1
+                while self._seq in self._ops:  # skip caller-chosen ints
+                    self._seq += 1
+                handle = self._seq
+            if handle in self._ops:
+                raise SlateError(f"Session.register: handle {handle!r} "
+                                 "already registered (unregister first)")
+            self._ops[handle] = _Operator(A, op, opts or self.opts, m, n,
+                                          band)
+        return handle
+
+    @staticmethod
+    def _infer_op(A) -> str:
+        if isinstance(A, PackedBand):
+            return "band_chol" if A.hermitian else "band_lu"
+        if A.kind in (MatrixKind.Hermitian, MatrixKind.Symmetric,
+                      MatrixKind.HermitianBand):
+            return "chol"
+        if A.shape[0] != A.shape[1]:
+            return "qr"
+        return "lu"
+
+    def unregister(self, handle: Hashable):
+        """Drop an operator and its cached factor (no error if absent)."""
+        with self._lock:
+            self._ops.pop(handle, None)
+            self._cache.pop(handle, None)
+
+    def __contains__(self, handle: Hashable) -> bool:
+        with self._lock:
+            return handle in self._ops
+
+    def handles(self):
+        with self._lock:
+            return list(self._ops)
+
+    # -- cache -------------------------------------------------------------
+
+    @property
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return sum(r.nbytes for r in self._cache.values())
+
+    def cached_handles(self):
+        """LRU → MRU order."""
+        with self._lock:
+            return list(self._cache)
+
+    def evict(self, handle: Hashable) -> bool:
+        """Explicitly drop a cached factor (operator stays registered)."""
+        with self._lock:
+            hit = self._cache.pop(handle, None) is not None
+        if hit:
+            self.metrics.inc("evictions")
+        return hit
+
+    def clear_cache(self):
+        with self._lock:
+            n = len(self._cache)
+            self._cache.clear()
+        self.metrics.inc("evictions", n)
+
+    def factor(self, handle: Hashable) -> _Resident:
+        """Resident factor for ``handle``: cache hit or refactor-on-miss
+        (LRU-touch either way, evict-to-budget on insert)."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            res = self._cache.get(handle)
+            if res is not None:
+                self._cache.move_to_end(handle)
+                self.metrics.inc("cache_hits")
+                return res
+            self.metrics.inc("cache_misses")
+            with self.metrics.phase("serve.factor", "factor_latency"):
+                res = self._factor(entry)
+            self.metrics.inc("factors_total")
+            fl = _factor_flops(entry.op, entry.m, entry.n, entry.band)
+            self.metrics.inc("flops_total", fl)
+            self.metrics.inc("factor_flops_total", fl)
+            self._cache[handle] = res
+            self._evict_to_budget(keep=handle)
+            return res
+
+    def factor_info(self, handle: Hashable) -> int:
+        """info of the resident factor (factoring on miss). A cached
+        factor is peeked without counting a hit or touching LRU order,
+        so an info-check-then-solve pair costs one cache access."""
+        with self._lock:
+            res = self._cache.get(handle)
+            if res is not None:
+                return res.info
+            return self.factor(handle).info
+
+    def _factor(self, entry: _Operator) -> _Resident:
+        op, A, opts = entry.op, entry.A, entry.opts
+        if op in ("lu", "band_lu"):
+            LU, perm, info = api.lu_factor(A, opts)
+            payload = (LU, perm)
+        elif op in ("chol", "band_chol"):
+            L, info = api.chol_factor(A, opts)
+            payload = (L,)
+        else:  # qr
+            payload = (api.qr_factor(A, opts),)
+            info = 0
+        payload = jax.block_until_ready(payload)
+        return _Resident(payload, int(info), _tree_nbytes(payload))
+
+    def _evict_to_budget(self, keep: Hashable):
+        """Caller holds the lock. Drop LRU entries (never ``keep``)
+        until the cache fits the budget."""
+        if self.hbm_budget is None:
+            return
+        used = sum(r.nbytes for r in self._cache.values())
+        for h in list(self._cache):
+            if used <= self.hbm_budget:
+                return
+            if h == keep:
+                continue
+            used -= self._cache.pop(h).nbytes
+            self.metrics.inc("evictions")
+        if used > self.hbm_budget:
+            # the just-inserted factor alone exceeds the budget; keep it
+            # (nothing can be served without it) but record the overflow
+            self.metrics.inc("budget_overflows")
+
+    # -- solve -------------------------------------------------------------
+
+    def solve_matrix(self, handle: Hashable, B: TiledMatrix) -> TiledMatrix:
+        """Solve with the resident factor; B is a TiledMatrix (dense
+        ops) or a padded dense array (band ops). Returns the TiledMatrix
+        (or array) solution. Raises on factorization failure (info>0)."""
+        with self._lock:
+            entry = self._ops[handle] if handle in self._ops else None
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            res = self.factor(handle)
+            if res.info != 0:
+                raise SlateError(
+                    f"Session: operator {handle!r} factorization failed "
+                    f"(info={res.info})")
+            k = int(B.shape[1])
+            with self.metrics.phase("serve.solve", "solve_latency"):
+                X = self._dispatch(entry, res, B)
+                X = jax.block_until_ready(X)
+            self.metrics.inc("solves_total", k)
+            self.metrics.inc("dispatches_total")
+            fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
+            self.metrics.inc("flops_total", fl)
+            self.metrics.inc("solve_flops_total", fl)
+            return X
+
+    def solve(self, handle: Hashable, b) -> np.ndarray:
+        """Array-in/array-out solve (the serving entry point): ``b`` is
+        a host/device array of shape (rows,) or (rows, k); returns the
+        solution with the matching rank (QR operators return n-row
+        least-squares solutions for m-row right-hand sides)."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            b = np.asarray(b)
+            vector = b.ndim == 1
+            b2 = b[:, None] if vector else b
+            B = self._wrap_rhs(entry, b2)
+            X = self.solve_matrix(handle, B)
+            x = (X.to_numpy() if isinstance(X, TiledMatrix)
+                 else np.asarray(X)[: entry.n])
+            return x[:, 0] if vector else x
+
+    def _wrap_rhs(self, entry: _Operator, b2: np.ndarray):
+        dtype = (entry.A.dtype if not isinstance(entry.A, PackedBand)
+                 else entry.A.ab.dtype)
+        b2 = np.ascontiguousarray(b2, dtype=np.dtype(dtype))
+        if entry.op in ("band_lu", "band_chol"):
+            return jax.numpy.asarray(b2)
+        nb = entry.A.nb
+        return from_dense(b2, nb=nb)
+
+    def _dispatch(self, entry: _Operator, res: _Resident, B):
+        """Run the solve through a per-(op, opts) jitted function,
+        preferring an AOT-compiled executable from warmup() when shapes
+        match. opts is part of both cache keys: two operators of the
+        same kind registered with different Options (precision, method
+        selection) must not share a closure."""
+        fn = self._solve_fn(entry)
+        key = self._aot_key(entry, res.payload, B)
+        exe = self._compiled.get(key)
+        if exe is not None:
+            self._compiled.move_to_end(key)
+            return exe(res.payload, B)
+        return fn(res.payload, B)
+
+    def _solve_fn(self, entry: _Operator):
+        jkey = (entry.op, entry.opts)
+        fn = self._jit.get(jkey)
+        if fn is None:
+            fn = self._jit[jkey] = jax.jit(
+                _make_solve_fn(entry.op, entry.opts))
+            while len(self._jit) > self._jit_cap:
+                self._jit.popitem(last=False)
+        else:
+            self._jit.move_to_end(jkey)
+        return fn
+
+    @staticmethod
+    def _aot_key(entry: _Operator, payload, B) -> Hashable:
+        leaves, treedef = jax.tree_util.tree_flatten((payload, B))
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        return (entry.op, entry.opts, treedef, shapes)
+
+    # -- AOT warmup --------------------------------------------------------
+
+    def warmup(self, handle: Hashable, nrhs: int = 1):
+        """Ahead-of-time path: factor ``handle`` now (off the request
+        path) and ``jit(...).lower(...).compile()`` the solve for an
+        (rows, nrhs) right-hand side, caching the executable so request-
+        time solves of that bucket skip tracing AND compilation. Dense
+        right-hand sides are tile-padded, so one warmup at nrhs=1 covers
+        every bucket width up to the operator's nb."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            res = self.factor(handle)
+            B = self._wrap_rhs(
+                entry, np.zeros((entry.m, nrhs)))
+            key = self._aot_key(entry, res.payload, B)
+            if key in self._compiled:
+                return
+            fn = self._solve_fn(entry)
+            with self.metrics.phase("serve.warmup"):
+                self._compiled[key] = fn.lower(res.payload, B).compile()
+            while len(self._compiled) > self._compiled_cap:
+                self._compiled.popitem(last=False)
+            self.metrics.inc("aot_compiles")
+
+
+def _make_solve_fn(op: str, opts: Options):
+    """The *_solve_using_factor verb as a (payload, B) -> X function —
+    one jit per op kind; jax's cache keys the rest off shapes/treedefs."""
+    if op in ("lu", "band_lu"):
+        def solve(payload, B):
+            LU, perm = payload
+            return api.lu_solve_using_factor(LU, perm, B, opts)
+    elif op in ("chol", "band_chol"):
+        def solve(payload, B):
+            return api.chol_solve_using_factor(payload[0], B, opts)
+    else:
+        def solve(payload, B):
+            return api.least_squares_solve_using_factor(payload[0], B, opts)
+    solve.__name__ = f"serve_{op}_solve"
+    return solve
+
+
+# -- process-wide session shared with the C API ----------------------------
+
+_DEFAULT: Optional[Session] = None
+_DEFAULT_LOCK = threading.Lock()
+
+# resident-factor budget for the shared session: without a bound, every
+# handle a long-lived native caller ever solves against would pin its
+# factor in HBM forever. 4 GiB default (a quarter of a v5e chip's HBM),
+# overridable in bytes via the env var.
+_DEFAULT_BUDGET_ENV = "SLATE_TPU_SERVE_HBM_BUDGET"
+_DEFAULT_BUDGET = 4 << 30
+
+
+def default_session() -> Session:
+    """The process-wide Session. The C-API opaque-handle solve verbs
+    (compat/c_glue.py) and in-process Python callers share this one
+    instance, so a factorization paid by either side serves both. Its
+    factor cache is bounded (see _DEFAULT_BUDGET / the
+    SLATE_TPU_SERVE_HBM_BUDGET env var)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            import os
+            budget = int(os.environ.get(_DEFAULT_BUDGET_ENV,
+                                        _DEFAULT_BUDGET))
+            _DEFAULT = Session(hbm_budget=budget)
+        return _DEFAULT

@@ -125,6 +125,33 @@ class Block:
 timers: Dict[str, float] = collections.defaultdict(float)
 
 
+class phase:
+    """Block + timer in one: ``with trace.phase("serve.solve") as p: ...``
+    records an SVG trace event (when tracing is on), accumulates into the
+    coarse ``timers`` map, and exposes ``p.elapsed`` afterwards so callers
+    (the serving runtime's metrics histograms) can reuse the measurement
+    instead of timing twice."""
+
+    __slots__ = ("name", "lane", "start", "elapsed")
+
+    def __init__(self, name: str, lane: int = 0):
+        self.name = name
+        self.lane = lane
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        stop = time.perf_counter()
+        self.elapsed = stop - self.start
+        if Trace.enabled:
+            Trace.record(self.name, self.start, stop, self.lane)
+        timers[self.name] += self.elapsed
+        return False
+
+
 class timer:
     """``with timer("heev_stage1"): ...`` accumulates into timers[name]."""
 

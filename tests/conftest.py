@@ -7,11 +7,23 @@ runs in CI without a TPU pod — the same trick the driver's
 dryrun_multichip uses. Single-device degeneracy is tested with 1×1 grids.
 """
 
+import importlib.util
 import os
+
+# load compat/platform.py standalone (importing the slate_tpu package
+# here would initialize jax before XLA_FLAGS is finalized)
+_spec = importlib.util.spec_from_file_location(
+    "_slate_tpu_platform",
+    os.path.join(os.path.dirname(__file__), os.pardir, "slate_tpu",
+                 "compat", "platform.py"))
+_platform = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_platform)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+_probe_cache = os.path.join(os.path.dirname(__file__), os.pardir,
+                            ".xla_flag_probe.json")
 if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
     # ROOT CAUSE of the round-2 intermittent hard-crash: XLA CPU
     # cross-module collectives rendezvous with a 40 s termination
@@ -21,8 +33,11 @@ if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
     # test processes / BLAS threads). Reproduced deliberately in round 3
     # by running the suite next to a busy bench process. Raise the
     # timeout so a loaded CI box degrades to slow instead of crashing.
-    flags = (flags
-             + " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+    # GUARDED by a support probe: jaxlib builds that dropped this flag
+    # ABORT on unknown XLA_FLAGS (parse_flags_from_env.cc), which used
+    # to kill the entire suite at CPU-client creation.
+    flags = (flags + _platform.collective_timeout_flag_if_supported(
+        cache_path=_probe_cache))
 os.environ["XLA_FLAGS"] = flags
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1001,8 +1001,17 @@ def test_lapack_trtri_sygv_hegv():
     # eigenvector residual: S z = w B z
     r = s @ z - b @ z @ np.diag(w)
     assert np.abs(r).max() < 1e-6 * max(1, np.abs(s).max())
-    # unsupported itype rejected
-    _, _, info = lp.dsygv(2, "N", "L", n, s, n, b, n)
+    # itype 2 (A·B·x = λ·x) and 3 (B·A·x = λ·x) via the hegst
+    # congruence (reference src/hegv.cc supports all three)
+    for itype, resid in ((2, lambda z, w: s @ (b @ z) - z @ np.diag(w)),
+                         (3, lambda z, w: b @ (s @ z) - z @ np.diag(w))):
+        for uplo in ("L", "U"):
+            w, z, info = lp.dsygv(itype, "V", uplo, n, s, n, b, n)
+            assert info == 0
+            assert np.abs(resid(z, w)).max() < 1e-6 * max(
+                1, np.abs(s).max(), np.abs(b).max())
+    # out-of-range itype rejected with the LAPACK argument-1 code
+    _, _, info = lp.dsygv(4, "N", "L", n, s, n, b, n)
     assert info == -1
 
     g = RNG.standard_normal((n, n)) + 1j * RNG.standard_normal((n, n))
@@ -1125,3 +1134,60 @@ def test_c_api_handle_verbs_ctypes():
     assert abs(out[0] - np.abs(a).sum(axis=0).max()) < 1e-9
     for h in (ha, hb, hb2):
         assert lib.slate_tpu_matrix_destroy(i64(h)) == 0
+
+
+# -- opaque-handle solves share the serving runtime's Session ---------------
+
+def _cm(x):
+    """Column-major (LAPACK) buffer: a C-contiguous transpose."""
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+def test_capi_handle_solves_share_runtime_session():
+    """The C-API opaque-handle solve verbs route through the shared
+    slate_tpu.runtime Session: the first hgesv/hposv against a handle
+    factors (cache miss), every further solve against the same handle
+    reuses the resident factor (cache hit-rate climbs), and replacing or
+    destroying the handle invalidates its cached factors."""
+    from slate_tpu.compat import c_glue
+    from slate_tpu.runtime import default_session
+
+    sess = default_session()
+    rng = np.random.default_rng(17)
+    n, nrhs = 24, 2
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, nrhs))
+    ha = c_glue.c_matrix_from_buffer("d", n, n, _cm(a), n, 8)
+    hits0 = sess.metrics.get("cache_hits")
+    misses0 = sess.metrics.get("cache_misses")
+
+    solves = 4
+    for _ in range(solves):
+        hb = c_glue.c_matrix_from_buffer("d", n, nrhs, _cm(b), n, 8)
+        assert c_glue.c_hgesv("d", ha, hb) == 0
+        x = _cm(np.zeros((n, nrhs)))
+        assert c_glue.c_matrix_to_buffer("d", hb, n, nrhs, x, n) == 0
+        np.testing.assert_allclose(a @ x.T, b, atol=1e-8)
+        assert c_glue.c_matrix_destroy("d", hb) == 0
+    hits = sess.metrics.get("cache_hits") - hits0
+    misses = sess.metrics.get("cache_misses") - misses0
+    # one factorization amortized over all solves — each solve is ONE
+    # factor-cache access, so hit-rate is exactly 1 - 1/solves
+    assert misses == 1
+    assert hits == solves - 1
+    assert hits / (hits + misses) == 1 - 1 / solves
+
+    # hposv shares the same session through its own (handle, chol) key
+    spd = a @ a.T / n + n * np.eye(n)
+    hs = c_glue.c_matrix_from_buffer("d", n, n, _cm(spd), n, 8)
+    for _ in range(2):
+        hb = c_glue.c_matrix_from_buffer("d", n, 1, _cm(b[:, :1]), n, 8)
+        assert c_glue.c_hposv("d", "L", hs, hb) == 0
+        assert c_glue.c_matrix_destroy("d", hb) == 0
+    assert ("capi", hs, "chol", "L") in sess
+
+    # destroying the handle unregisters its operators from the Session
+    assert c_glue.c_matrix_destroy("d", hs) == 0
+    assert ("capi", hs, "chol", "L") not in sess
+    assert c_glue.c_matrix_destroy("d", ha) == 0
+    assert ("capi", ha, "lu", None) not in sess
