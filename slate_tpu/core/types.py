@@ -233,6 +233,21 @@ class Options:
     # axis (parallel/panel.py — the hand-scheduled counterpart of the
     # GSPMD-inferred panel; reference Tile_getrf.hh:209-270)
     lu_dist_panel: bool = False
+    # Round-6 fast paths (PERF.md "Round 6"). lu_pivot_fusion: fold the
+    # per-level row permutation into the trailing-update gemm READS
+    # (gather-as-you-read + deferred left swaps) instead of
+    # materializing a full-width permuted copy per level — the
+    # TPU-native analog of the reference's device-batched swaps
+    # (internal_swap.cc:503-560). False restores the materialized-copy
+    # reference path (bit-identical results; kept for A/B + tests).
+    lu_pivot_fusion: bool = True
+    # factor_iter_large: run the right-looking iterative outer loop with
+    # in-place (dynamic_update_slice) trailing updates at ALL sizes with
+    # nt ≤ 64 for potrf/getrf — the round-5 n=2048 crossover was set by
+    # the loop's concatenation/permute-copy traffic, which the in-place
+    # slab updates and pivot fusion remove. False restores the 2×2
+    # recursion dispatch above the old crossover.
+    factor_iter_large: bool = True
     method_gels: MethodGels = MethodGels.Auto
     method_hesv: MethodHesv = MethodHesv.Auto
     method_eig: MethodEig = MethodEig.Auto

@@ -135,35 +135,48 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
 # On-chip crossover between the iterative right-looking loop and the
 # 2×2 recursion (round-5 A/B, tools/potrf_ab.py): below this size the
 # loop's single batched-leaf inverse per panel wins on latency; above
-# it the loop's O(n³/nb) trailing-block HBM traffic loses to the
-# recursion's O(n² log nt) touch pattern (perf_traces/SUMMARY.md).
+# it the round-5 loop's trailing-block re-traffic (herk_lower_rec's
+# per-level concatenation copies) lost to the recursion's O(n² log nt)
+# touch pattern (perf_traces/SUMMARY.md). Round 6: the crossover only
+# gates the RECURSION's base case (the legacy dispatch,
+# Options.factor_iter_large=False) — the default dispatch runs the
+# iterative loop at ALL sizes with nt ≤ _ITER_MAX_NT, because its
+# trailing update is now written in place slab-by-slab
+# (blocked.herk_trailing_inplace: no concatenation copies, the lower
+# trapezoid touched once per step) with the Pallas chol_tile kernel as
+# the diagonal base at every step.
 _POTRF_ITER_BASE = 2048
 # HLO-size guard for the unrolled loop (the crossover was measured at
-# nb=1024 → nt=2; small nb would otherwise unroll 128+ panel steps)
-_ITER_MAX_NT = 64
+# nb=1024 → nt=2; small nb would otherwise unroll 128+ panel steps;
+# single source of truth in ops/blocked.py, shared with lu.py)
+_ITER_MAX_NT = blocked.ITER_MAX_NT
+
+
+def _iter_eligible(s: int, nb: int) -> bool:
+    """Static-shape predicate: can the in-place iterative loop own an
+    s×s factorization? (Shared with the tests' dispatch-policy probe —
+    n=16384 @ nb=1024 must answer yes without compiling anything.)"""
+    return s > nb and s % nb == 0 and s // nb <= _ITER_MAX_NT
 
 
 def _potrf_iter(a: jax.Array, nb: int, prec):
-    """Iterative right-looking blocked Cholesky (round 4; since round
-    5 the ≤ _POTRF_ITER_BASE base case of _potrf_rec — see
-    _potrf_blocked for the measured dispatch rationale).
+    """Iterative right-looking blocked Cholesky (round 4; round-6
+    default at every nt ≤ _ITER_MAX_NT size — see _potrf_blocked).
 
-    Each panel step pays exactly ONE tile Cholesky + ONE batched-leaf
-    inverse (blocked.trtri_lower_batched), the panel update is a
-    single gemm against the cached inverse (the inverted-diagonal-
-    block trsm scheme), and the trailing update is the triangle-aware
-    herk recursion (pure gemms). The reference's task DAG shape
-    (panel → trsm → herk per step, src/potrf.cc:84-195) is recovered
-    exactly."""
+    Each panel step pays exactly ONE tile Cholesky (the Pallas
+    chol_tile kernel where eligible — at EVERY step, not just below
+    the old crossover) + ONE batched-leaf inverse
+    (blocked.trtri_lower_batched), the panel update is a single gemm
+    against the cached inverse (the inverted-diagonal-block trsm
+    scheme), and the trailing update is written IN PLACE one column
+    slab at a time (blocked.herk_trailing_inplace — triangular-herk
+    flops, no per-level concatenation copies). The reference's task
+    DAG shape (panel → trsm → herk per step, src/potrf.cc:84-195,
+    with the right-looking in-place trailing discipline of
+    src/potrf.cc:136-176) is recovered exactly."""
     s = a.shape[0]
     nt = s // nb
-
-    def dus(x, val, i, j):
-        # int32 start indices: with x64 on, python ints lower to s64
-        # constants and the pre-0.6 SPMD partitioner emits a mixed
-        # s64/s32 compare the HLO verifier rejects
-        return jax.lax.dynamic_update_slice(
-            x, val, (jnp.int32(i), jnp.int32(j)))
+    dus = blocked.dus_i32
 
     info = jnp.zeros((), jnp.int32)
     for k in range(nt):
@@ -178,24 +191,31 @@ def _potrf_iter(a: jax.Array, nb: int, prec):
         pan = blocked.mm(a[k1:, k0:k1], jnp.conj(inv).T, prec)
         pan = blocked.rebalance(pan)
         a = dus(a, pan, k1, k0)
-        trail = blocked.rebalance(
-            blocked.herk_lower_rec(a[k1:, k1:], pan, prec=prec))
-        a = dus(a, trail, k1, k1)
+        a = blocked.herk_trailing_inplace(a, pan, k1, nb, prec=prec)
     return a, info
 
 
-def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high"):
+def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high",
+                   iter_large: bool = True):
     """Blocked Cholesky on padded dense (lower) → (tril factor, info).
 
-    Dispatch (round-5 on-chip A/B, tools/potrf_ab.py + PERF.md): the
-    2×2 recursion with the iterative loop as its ≤ _POTRF_ITER_BASE
-    base case. The round-4 flat iterative loop (and its super-block
-    hierarchy) measured SLOWER above the crossover — right-looking
-    re-reads the O(n²) trailing block nt times where the recursion
-    touches it O(log nt) times (138 vs 200 ms at n=16384 nb=1024) —
-    so right-looking survives only below the crossover, where it wins
-    on latency (16.6 vs 20.4 ms at n=2048)."""
-    out, info = _potrf_rec(a, nb, prec=prec)
+    Dispatch (round 6): the in-place iterative loop owns EVERY size
+    with nt ≤ _ITER_MAX_NT. The round-5 crossover (_POTRF_ITER_BASE,
+    on-chip A/B tools/potrf_ab.py) was set by the loop's trailing
+    re-traffic — herk_lower_rec's per-level concatenation copies, 131
+    ms of a 200 ms n=16384 call — which the slab-wise in-place update
+    (blocked.herk_trailing_inplace) removes; what remains is
+    right-looking's inherent once-per-step trailing write, an
+    O(n³/(3nb)) HBM term (~11 GB ≈ one-digit ms at n=16384 nb=1024 on
+    v5e). The 2×2 recursion remains for nt > _ITER_MAX_NT (HLO-size
+    guard) and as the legacy dispatch (Options.factor_iter_large=False
+    — the round-5 policy, iterative only below the crossover), which
+    is also the reassociation-tolerance reference arm for tests."""
+    s = a.shape[0]
+    if iter_large and _iter_eligible(s, nb):
+        out, info = _potrf_iter(a, nb, prec=prec)
+    else:
+        out, info = _potrf_rec(a, nb, prec=prec)
     return jnp.tril(out), info
 
 
@@ -228,7 +248,8 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     a = unit_pad_diag(a, n, n)
     nt = A.mt
     with blocked.distribute_on(A.grid):
-        lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision)
+        lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision,
+                                     iter_large=opts.factor_iter_large)
     if A.uplo is Uplo.Upper:
         out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
                          kind=MatrixKind.Triangular, uplo=Uplo.Upper,

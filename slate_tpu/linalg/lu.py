@@ -14,10 +14,13 @@ factorization with cross-rank MAXLOC pivot search becomes
 ``lax.linalg.lu`` on the whole (m−k)×nb panel — XLA keeps the pivot
 search on-device; the fine-grained row swaps (the hard part on
 distributed memory, internal_swap.cc:503-560 batches them on GPUs)
-become one gather of the row block, which GSPMD turns into the
-collective-permute traffic the reference hand-codes with MPI_Sendrecv.
-Pivots are carried as a full row-permutation vector (the analog of the
-reference's Pivots list): ``a_factored = A[perm] = L·U``.
+become, since round 6, gathers FUSED INTO THE TRAILING-UPDATE READS
+(pivot fusion — no full permuted row block is materialized per level;
+stored L columns are reordered once at the end by the composed suffix
+permutations), which GSPMD turns into the collective-permute traffic
+the reference hand-codes with MPI_Sendrecv. Pivots are carried as a
+full row-permutation vector (the analog of the reference's Pivots
+list): ``a_factored = A[perm] = L·U``.
 
 Padding note: padded rows/cols carry an identity diagonal
 (pad_diag_identity), so the padded system is block-diagonal
@@ -60,10 +63,26 @@ _pad_identity_diag = unit_pad_diag
 
 # width crossover for the flat iterative loop as the recursion's base
 # case — measured on-chip for potrf (cholesky._POTRF_ITER_BASE) and
-# shared by LU, whose loop has the same trailing-traffic structure
+# shared by LU, whose loop has the same trailing-traffic structure.
+# Round 6: the crossover now only gates the RECURSION's base case (the
+# legacy dispatch, Options.factor_iter_large=False). The default
+# dispatch runs the pivot-fused iterative loop at ALL sizes with
+# nt ≤ _ITER_MAX_NT: the O(n³/nb) full-width permute-copy traffic that
+# made the flat loop lose above 2048 is exactly what pivot fusion
+# (gather-as-you-read + deferred left swaps) removes.
 _GETRF_ITER_BASE = 2048
-# HLO-size guard shared with cholesky._ITER_MAX_NT (unrolled steps)
-_ITER_MAX_NT = 64
+# HLO-size guard for the unrolled loop (single source of truth in
+# ops/blocked.py, shared with cholesky._ITER_MAX_NT)
+_ITER_MAX_NT = blocked.ITER_MAX_NT
+
+
+def _iter_eligible(w: int, nb: int) -> bool:
+    """Can the iterative loop own an (·, w) factorization? Static-shape
+    predicate for the default dispatch (and the tests' policy probe —
+    n=16384 @ nb=1024 must say yes without compiling anything). Unlike
+    cholesky's, w == nb is allowed: a single pivoted panel is exactly
+    what the loop's one step does."""
+    return w % nb == 0 and w // nb <= _ITER_MAX_NT
 
 
 def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
@@ -127,8 +146,48 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
     return lu, perm, info
 
 
-def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0):
-    """Iterative right-looking blocked partial-pivot LU (round 4).
+def _suffix_perms(pps, m: int, nb: int):
+    """σⱼ = q_{j+1}∘…∘q_{nt−1} for every step j, as gather perms.
+
+    ``pps[k]`` is step k's local permutation on rows [k·nb, m); lifting
+    it to the full index space gives q_k (identity above k·nb). The
+    deferred-left-swap fix-up needs, for each stored L column block j,
+    the composition of every LATER step's permutation — computed by one
+    backward pass: σ_{nt−1} = ι, σⱼ = q_{j+1}[σ_{j+1}] (gather-compose:
+    (x[q1])[q2] = x[q1[q2]]). Returns sigmas[j] for j = 0..nt−2."""
+    nt = len(pps)
+    sigmas = [None] * nt
+    sig = jnp.arange(m, dtype=jnp.int32)
+    for j in range(nt - 2, -1, -1):
+        k0n = (j + 1) * nb
+        q = jnp.concatenate([jnp.arange(k0n, dtype=jnp.int32),
+                             k0n + pps[j + 1]])
+        sig = q[sig]
+        sigmas[j] = sig
+    return sigmas
+
+
+def _apply_deferred_left_swaps(a: Array, pps, nb: int) -> Array:
+    """The deferred-left-swap fix-up shared by _getrf_iter and
+    getrf_tntpiv: reorder each stored L column block ONCE by its
+    composed suffix permutation (≈ HALF a full-matrix permute in total,
+    vs one full-width permute per level before). σⱼ is the identity
+    above row (j+1)·nb, so only the strictly-below-diagonal L rows it
+    actually moves are gathered. The ragged final column block (if any)
+    has no later permutations and is skipped (σ = None)."""
+    m = a.shape[0]
+    for j, sig in enumerate(_suffix_perms(pps, m, nb)):
+        if sig is None:
+            continue
+        j0, j1 = j * nb, (j + 1) * nb
+        a = blocked.dus_i32(a, a[:, j0:j1][sig[j1:]], j1, j0)
+    return a
+
+
+def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0,
+                fused: bool = True):
+    """Iterative right-looking blocked partial-pivot LU (round 4; the
+    round-6 default at every size with nt ≤ _ITER_MAX_NT).
 
     Same redesign as cholesky._potrf_iter: per panel ONE bucketed
     pivoted panel factorization (blocked.panel_getrf), ONE batched-leaf
@@ -137,6 +196,28 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0):
     trsm re-inverting the same diagonal blocks at every level. The
     reference's DAG shape (panel → swaps → trsm → gemm per step,
     src/getrf.cc:81-160) is recovered step for step.
+
+    ``fused`` (round 6, the default): PIVOT-FUSED trailing updates.
+    The round-5 profile isolated ~35% of getrf's time in the per-level
+    ``moved = a[k0:, :][p_p]`` full-width permuted copy. Fused, the
+    permutation is folded into the trailing update's ROW READS:
+
+      u12   = L11⁻¹ · right[p_p[:nb]]          (nb-row gather → gemm)
+      schur = right[p_p[nb:]] − L21·u12        (gather fused into the
+                                                subtract that writes
+                                                the Schur block — the
+                                                only HBM write, which
+                                                right-looking pays
+                                                anyway)
+
+    so NO full permuted matrix is ever written to HBM per level — the
+    TPU-native analog of the reference's device-batched row swaps
+    folded into the lookahead task (internal_swap.cc:503-560,
+    src/getrf.cc:121-160). Already-stored L columns are NOT re-permuted
+    per step; the composed suffix permutations (_suffix_perms) reorder
+    each column block ONCE at the end — O(n²) one-time traffic instead
+    of O(n³/nb). Results are bit-identical to fused=False (gathers are
+    exact; every arithmetic op sees the same values in the same order).
 
     ``threshold`` < 1 is the Option::PivotThreshold analog
     (src/getrf.cc + Tile_getrf.hh threshold pivoting): relaxed pivot
@@ -150,59 +231,82 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0):
     nt = w // nb
     perm = jnp.arange(m, dtype=jnp.int32)
     info = jnp.zeros((), jnp.int32)
+    pps = []
     for k in range(nt):
         k0, k1 = k * nb, (k + 1) * nb
         rows = m - k0
         panel = a[k0:, k0:k1]
         if threshold < 1.0:
             # tournament panel: argmax/swap chain leaves the critical
-            # path. One full-row gather (the tournament permutation
-            # compacts ALL rows — not a bounded-displacement swap
-            # list); the panel elimination reuses the permuted slice.
+            # path. The tournament permutation compacts ALL rows (not a
+            # bounded-displacement swap list); fused, only the nb-wide
+            # panel slice is gathered for the elimination.
             p_p = _tournament_perm(panel, nb, nb, rows, m)
-            moved = a[k0:, :][p_p]
             lu_p, _, i_p = _tournament_panel(
-                moved[:, k0:k1], nb, nb, rows, perm_done=True)
+                panel[p_p], nb, nb, rows, perm_done=True)
         else:
             hb = blocked.bucket_pow2(rows, nb)
             if hb > rows:
                 panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
             lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
             p_p = p_p[:rows]
-            # row swaps apply to the whole remaining row block, stored
-            # L included (reference applies pivots to left panels too)
-            moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
         info = jnp.where((info == 0) & (i_p > 0), k0 + i_p,
                          info).astype(jnp.int32)
-        a = jax.lax.dynamic_update_slice(a, moved, (k0, 0))
         perm = perm.at[k0:].set(perm[k0:][p_p])
+        pps.append(p_p)
+        if not fused:
+            # legacy materialized path (reference arm for the A/B and
+            # the bit-equivalence tests): permute the whole remaining
+            # row block, stored L included, then update in place
+            moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
+            a = jax.lax.dynamic_update_slice(a, moved, (k0, 0))
         a = jax.lax.dynamic_update_slice(a, lu_p[:rows], (k0, k0))
         if k1 >= w:
             continue
         l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=a.dtype)
         inv11 = blocked.trtri_lower_batched(l11, unit=True)
-        u12 = blocked.mm(inv11, a[k0:k1, k1:], prec)
-        a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
-        schur = blocked.rebalance(
-            a[k1:, k1:] - blocked.mm(a[k1:, k0:k1], u12, prec))
+        if fused:
+            right = a[k0:, k1:]
+            u12 = blocked.mm(inv11, right[p_p[:nb]], prec)
+            a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
+            schur = blocked.rebalance(
+                right[p_p[nb:]] - blocked.mm(lu_p[nb:rows], u12, prec))
+        else:
+            u12 = blocked.mm(inv11, a[k0:k1, k1:], prec)
+            a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
+            schur = blocked.rebalance(
+                a[k1:, k1:] - blocked.mm(a[k1:, k0:k1], u12, prec))
         a = jax.lax.dynamic_update_slice(a, schur, (k1, k1))
+    if fused:
+        a = _apply_deferred_left_swaps(a, pps, nb)
     return a, perm, info
 
 
 def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
-                   dist_panel: bool = False, threshold: float = 1.0):
+                   dist_panel: bool = False, threshold: float = 1.0,
+                   fused: bool = True, iter_large: bool = True):
     """Blocked partial-pivot LU on padded dense (possibly rectangular).
 
-    Dispatch mirrors cholesky._potrf_blocked (round-5 on-chip A/B):
-    the width recursion everywhere, with the flat iterative loop as
-    its ≤ _GETRF_ITER_BASE base case — the round-4 flat loop (and its
-    super-block hierarchy) re-reads the O(n²) trailing block per panel
-    and measured slower above the crossover. For wide matrices the
-    remaining U columns get one block solve + no further pivoting."""
+    Dispatch (round 6): the pivot-fused iterative loop (_getrf_iter)
+    owns EVERY width with nt ≤ _ITER_MAX_NT — the round-5 n=2048
+    crossover was set by the flat loop's per-level full-width permute
+    copies, which pivot fusion removes (the Schur write it still pays
+    is right-looking's inherent O(n³/nb) term, ~11 GB at n=16384
+    nb=1024 ≈ a one-digit-ms HBM budget per the round-5 roofline
+    numbers). The 2×2 width recursion remains for nt > _ITER_MAX_NT
+    (HLO-size guard), for the dist-panel route, and as the legacy
+    dispatch under Options.factor_iter_large=False (its iterative base
+    case keeps the measured ≤ _GETRF_ITER_BASE crossover). For wide
+    matrices the remaining U columns get one block solve + no further
+    pivoting."""
     m, n = a.shape
     k = min(m, n)
-    lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel,
-                                threshold)
+    if not dist_panel and iter_large and _iter_eligible(k, nb):
+        lu, perm, info = _getrf_iter(a[:, :k], nb, prec, threshold,
+                                     fused=fused)
+    else:
+        lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel,
+                                    threshold)
     if n > k:
         rest = blocked.permute_rows_limited(a[:, k:], perm, 2 * k)
         u_rest = blocked.trsm_rec(lu[:, :k], rest, left=True, lower=True,
@@ -236,7 +340,9 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
         lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
                                         prec=opts.update_precision,
                                         dist_panel=dist_panel,
-                                        threshold=opts.pivot_threshold)
+                                        threshold=opts.pivot_threshold,
+                                        fused=opts.lu_pivot_fusion,
+                                        iter_large=opts.factor_iter_large)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 
@@ -377,38 +483,62 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     binary tournament over ranks exchanging candidate row blocks via
     tileSend/Recv. Here: vmap-batched LU over nb-row chunks selects each
     chunk's candidate rows, then a log₂ tree of pairwise stacked LUs
-    picks the panel's winners — all on device, no host round-trips."""
+    picks the panel's winners — all on device, no host round-trips.
+
+    Round 6: the tournament permutation is pivot-fused like the
+    partial-pivot loop (opts.lu_pivot_fusion, default on): the winner
+    compaction is folded into the panel/trailing READS and the stored L
+    columns are reordered once at the end (_suffix_perms), instead of
+    the per-step ``a.at[k0:, :].set(a[k0:, :][p_perm])`` full-width
+    copy. Bit-identical either way."""
     m, n = A.shape
     nb = A.nb
+    fused = opts.lu_pivot_fusion
     a = _canonical(A)
     a = _pad_identity_diag(a, m, n)
     mpad = a.shape[0]
     perm = jnp.arange(mpad, dtype=jnp.int32)
     info = jnp.zeros((), jnp.int32)
     nt = min(A.mt, A.nt)
+    pps = []
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, a.shape[1])
         w = k1 - k0
         prows = mpad - k0
         panel = a[k0:, k0:k1]
         p_perm = _tournament_perm(panel, w, nb, prows, mpad)
-        a = a.at[k0:, :].set(a[k0:, :][p_perm])
         perm = perm.at[k0:].set(perm[k0:][p_perm])
+        pps.append(p_perm)
+        if fused:
+            pan_g = panel[p_perm]  # w-wide gather, no full-width copy
+        else:
+            a = a.at[k0:, :].set(a[k0:, :][p_perm])
+            pan_g = a[k0:, k0:k1]
         # eliminate panel without further pivoting
-        lu_pan, pinfo = _lu_nopiv_recursive(a[k0:k1, k0:k1])
+        lu_pan, pinfo = _lu_nopiv_recursive(pan_g[:w])
         a = a.at[k0:k1, k0:k1].set(lu_pan)
         info = jnp.where((info == 0) & (pinfo > 0), k0 + pinfo, info)
         lkk = lu_pan
         below = jax.lax.linalg.triangular_solve(
-            lkk, a[k1:, k0:k1], left_side=False, lower=False,
+            lkk, pan_g[w:], left_side=False, lower=False,
             unit_diagonal=False)
         a = a.at[k1:, k0:k1].set(below)
         if k1 < a.shape[1]:
-            urow = jax.lax.linalg.triangular_solve(
-                lkk, a[k0:k1, k1:], left_side=True, lower=True,
-                unit_diagonal=True)
-            a = a.at[k0:k1, k1:].set(urow)
-            a = a.at[k1:, k1:].set(a[k1:, k1:] - below @ urow)
+            if fused:
+                right = a[k0:, k1:]
+                urow = jax.lax.linalg.triangular_solve(
+                    lkk, right[p_perm[:w]], left_side=True, lower=True,
+                    unit_diagonal=True)
+                a = a.at[k0:k1, k1:].set(urow)
+                a = a.at[k1:, k1:].set(right[p_perm[w:]] - below @ urow)
+            else:
+                urow = jax.lax.linalg.triangular_solve(
+                    lkk, a[k0:k1, k1:], left_side=True, lower=True,
+                    unit_diagonal=True)
+                a = a.at[k0:k1, k1:].set(urow)
+                a = a.at[k1:, k1:].set(a[k1:, k1:] - below @ urow)
+    if fused:
+        a = _apply_deferred_left_swaps(a, pps, nb)
     out = from_dense(a, nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 
@@ -431,6 +561,9 @@ def getrs(LU: TiledMatrix, perm: Array, B: TiledMatrix,
         b = jnp.pad(b, ((0, pad), (0, 0)))
     prec = opts.update_precision
     if not trans:
+        # same fusion contract as the factorization's trailing reads:
+        # b[perm] is ONE gather feeding the first trsm's operand (XLA
+        # fuses it into the solve's reads) — never a per-level copy
         pb = b[perm]
         y = blocked.trsm_rec(lu, pb, left=True, lower=True, unit=True,
                              prec=prec, base=LU.nb)

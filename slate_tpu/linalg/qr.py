@@ -110,11 +110,21 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
 
     Panels are factored by blocked.panel_geqrf_with_t (the TPU analog of
     the reference's gather-panel-to-device + lapack::geqrf trick,
-    internal_geqrf.cc:235-254; XLA's own QR expansion costs ~25 ms per
-    panel). Panel heights are bucketed to powers of two — zero rows below
-    a panel are inert for Householder QR — so only O(log nt) panel
-    shapes compile. Trailing updates are two large MXU gemms per panel
-    at opts.update_precision."""
+    internal_geqrf.cc:235-254, with the Pallas qr_panel_base kernel as
+    the in-VMEM base at EVERY step where eligible; XLA's own QR
+    expansion costs ~25 ms per panel). Panel heights are bucketed to
+    powers of two — zero rows below a panel are inert for Householder
+    QR — so only O(log nt) panel shapes compile. Trailing updates are
+    two large MXU gemms per panel at opts.update_precision.
+
+    Round 6 (the potrf/getrf in-place recipe mirrored): the outer loop
+    writes the packed V\\R panel and the reflected trailing block via
+    dynamic_update_slice into the resident matrix — the factored panel
+    is stored VERBATIM (the old ``triu(vr) + v − I`` reassembly is the
+    identity on disjoint supports and cost one extra full-panel pass)
+    and no per-step concatenation or full-matrix copy is made. geqrf
+    has no 2×2-recursion alternative, so there is no crossover to
+    revise here; the loop IS the large-n path."""
     m, n = A.shape
     nb = A.nb
     prec = opts.update_precision
@@ -123,6 +133,7 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     mpad, npad = a.shape
     kt = -(-min(m, n) // nb)  # panels covering the logical diagonal
     ts = []
+    dus = blocked.dus_i32
     with blocked.distribute_on(A.grid):
         for k in range(kt):
             k0, k1 = k * nb, min((k + 1) * nb, npad)
@@ -134,18 +145,18 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
                 panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
             vr, taus, t = blocked.panel_geqrf_with_t(panel)
             vr = vr[:rows]
-            v = jnp.tril(vr, -1)
-            v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+            # store the packed panel as-is: R rows on/above the
+            # diagonal, V tails below (beta on the diagonal)
+            a = dus(a, vr, k0, k0)
+            if k1 < npad:
+                v = jnp.tril(vr, -1)
+                v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
+                a = dus(a, blocked.rebalance(
+                    _apply_block_reflector_H(v, t[:w, :w],
+                                             a[k0:, k1:], prec)), k0, k1)
             if w < nb:  # ragged final panel: embed into (nb, nb)
                 t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
             ts.append(t)
-            # store R rows + V below diagonal
-            a = a.at[k0:, k0:k1].set(jnp.triu(vr) + v -
-                                     jnp.eye(rows, w, dtype=a.dtype))
-            if k1 < npad:
-                a = a.at[k0:, k1:].set(blocked.rebalance(
-                    _apply_block_reflector_H(v, t[:w, :w],
-                                             a[k0:, k1:], prec)))
     t_all = jnp.stack(ts) if ts else jnp.zeros((0, nb, nb), a.dtype)
     return QRFactors(a, t_all, m, n, nb)
 

@@ -103,6 +103,10 @@ TRTRI_BASE = 64
 TRSM_BASE = 512
 HERK_BASE = 1024
 PANEL_IB = 32
+# HLO-size guard for the unrolled iterative outer loops of the
+# factorization drivers — single source of truth for linalg/lu.py and
+# linalg/cholesky.py (their _ITER_MAX_NT aliases)
+ITER_MAX_NT = 64
 
 
 def mm(a: Array, b: Array, prec: Optional[str] = None) -> Array:
@@ -353,6 +357,46 @@ def herk_lower_rec(c: Array, a: Array, b: Optional[Array] = None,
     return jnp.concatenate([top, bot], axis=0)
 
 
+def dus_i32(x: Array, val: Array, i: int, j: int) -> Array:
+    """dynamic_update_slice with int32 starts: with x64 on, python ints
+    lower to s64 constants and the pre-0.6 SPMD partitioner emits a
+    mixed s64/s32 compare the HLO verifier rejects (shared by the
+    iterative potrf/getrf/geqrf outer loops)."""
+    return lax.dynamic_update_slice(x, val, (jnp.int32(i), jnp.int32(j)))
+
+
+def herk_trailing_inplace(a: Array, pan: Array, k1: int, nb: int,
+                          prec: Optional[str] = None) -> Array:
+    """A[k1:, k1:] ← A[k1:, k1:] − pan·panᴴ written IN PLACE, one
+    nb-wide column slab at a time (round 6).
+
+    The iterative right-looking loops previously routed this update
+    through herk_lower_rec, whose 2×2 recursion concatenates full
+    copies of the trailing block per level — the measured
+    O(n²·log nt)-per-step re-traffic that set the round-5 n=2048
+    crossover (perf_traces/SUMMARY.md). Here each trailing column slab
+    j gets ONE gemm  pan[j0−k1:]·pan[j0−k1:j1−k1]ᴴ  and ONE
+    dynamic_update_slice write of the (s−j0)×nb slab — the lower
+    trapezoid is touched exactly once per step and the flop count is
+    the triangular herk count (plus the slab-internal strict-upper
+    corner, garbage by the factor contract). This is the reference's
+    right-looking in-place trailing discipline (src/potrf.cc:136-176:
+    per-block-column herk + gemm into resident tiles) in XLA form.
+
+    Only the lower trapezoid of the result is meaningful; entries above
+    the diagonal inside a diagonal slab receive the (harmless)
+    symmetric update. Each slab is rebalance()d so multi-device grids
+    keep the per-level resharding constraints."""
+    s = a.shape[0]
+    for j0 in range(k1, s, nb):
+        jw = min(nb, s - j0)
+        rows = pan[j0 - k1:]
+        cols = pan[j0 - k1:j0 - k1 + jw]
+        slab = a[j0:, j0:j0 + jw] - mm(rows, jnp.conj(cols).T, prec)
+        a = dus_i32(a, rebalance(slab), j0, j0)
+    return a
+
+
 # ---------------------------------------------------------------------------
 # Cholesky of one diagonal block
 # ---------------------------------------------------------------------------
@@ -501,7 +545,14 @@ def permute_rows_limited(x: Array, perm: Array, max_moved: int) -> Array:
     — because XLA:TPU lowers the dynamic row scatter far below HBM
     bandwidth while the full-row gather streams. ``max_moved`` is kept
     in the signature as documentation of the displacement bound (and
-    for any future backend where bounded scatter wins)."""
+    for any future backend where bounded scatter wins).
+
+    Round 6: the DEFAULT getrf/getrf_tntpiv paths no longer call this
+    per level at all — the permutation is folded into the trailing
+    update's row reads (pivot fusion, linalg/lu.py) and the stored L
+    columns are reordered once at the end. This materialized permute
+    remains in the recursion (_getrf_rec), the legacy arm
+    (Options.lu_pivot_fusion=False), and the wide-matrix rest solve."""
     del max_moved
     return x[perm]
 

@@ -319,7 +319,16 @@ def chol_eligible(b: int, dtype) -> bool:
     VMEM (b=1024 is 2 x 4 MiB in+out). SLATE_TPU_PALLAS_CHOL=0 opts
     out (the kernel is the DEFAULT tile factor on TPU — unlike the
     herk kernel it replaces dispatch latency, not XLA's gemms, so it
-    wins by construction; measured on-chip before being made default)."""
+    wins by construction; measured on-chip before being made default).
+
+    Round 6: with the in-place iterative outer loop promoted to every
+    nt ≤ 64 size (linalg/cholesky.py::_potrf_blocked), this kernel is
+    the diagonal base at EVERY panel step of the large-n default path
+    — previously the 2×2 recursion above n=2048 only reached it
+    through its iterative base case. Same for lu_panel_eligible /
+    qr_panel_eligible below: the panel kernels now sit on the large-n
+    default dispatch of getrf/geqrf rather than only below the old
+    crossover."""
     return _panel_gate(
         "SLATE_TPU_PALLAS_CHOL", dtype,
         b >= _CHOL_IB and b % _CHOL_IB == 0 and b <= 1024)

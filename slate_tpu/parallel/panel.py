@@ -12,6 +12,18 @@ purely local rank-1 update. This is the explicit counterpart of the
 GSPMD-inferred panel (ops/blocked.panel_getrf); `getrf` routes here
 when ``Options.lu_dist_panel`` is set and a multi-device grid is
 active. Measured comparison against the GSPMD panel: PERF.md.
+
+Round-6 dispatch note: the default getrf now runs the PIVOT-FUSED
+iterative outer loop (linalg/lu.py::_getrf_iter — permutation folded
+into the trailing-update reads, deferred left swaps). The dist-panel
+route keeps the 2×2 width recursion as its driver: the explicit
+shard_map panel is a per-PANEL replacement and composes with either
+outer loop, but on pre-0.6 jax (DRIVER_COMPOSABLE=False) the old
+shard_map mis-lowers inside any GSPMD-partitioned driver, so the
+conservative recursion pairing is kept until the new-style shard_map
+is the floor. The fused loop's deferred left swaps would subsume the
+reference's cross-rank pivot-row exchange the same way (the suffix
+gathers become collective-permutes on a mesh).
 """
 
 from __future__ import annotations

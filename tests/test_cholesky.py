@@ -134,10 +134,12 @@ def test_posv_mixed():
 
 
 def test_potrf_rec_iter_base_dispatch(monkeypatch):
-    """Round-5 hybrid dispatch: 2x2 recursion above the crossover,
-    iterative loop as its base case. With the crossover lowered to 64,
-    n=128 must split once in _potrf_rec and factor each 64-half with
-    _potrf_iter."""
+    """Round-5 hybrid dispatch — now the LEGACY arm
+    (Options(factor_iter_large=False); the round-6 default routes every
+    nt ≤ 64 size straight to the in-place iterative loop): 2x2
+    recursion above the crossover, iterative loop as its base case.
+    With the crossover lowered to 64, n=128 must split once in
+    _potrf_rec and factor each 64-half with _potrf_iter."""
     from slate_tpu.linalg import cholesky as chol_mod
 
     monkeypatch.setattr(chol_mod, "_POTRF_ITER_BASE", 64)
@@ -155,7 +157,7 @@ def test_potrf_rec_iter_base_dispatch(monkeypatch):
     n, nb = 128, 16  # 128 > 64 -> rec splits; 64-halves -> iter
     a = np.asarray(random_spd(n, dtype=jnp.float64, seed=77))
     A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower)
-    L, info = st.potrf(A)
+    L, info = st.potrf(A, Options(factor_iter_large=False))
     assert int(info) == 0
     assert calls["rec"] >= 1 and calls["iter"] == 2
     assert _residual_factor(a, L) < 3.0
@@ -164,7 +166,8 @@ def test_potrf_rec_iter_base_dispatch(monkeypatch):
 def test_potrf_hybrid_info_offset(monkeypatch):
     """Non-SPD pivot inside the SECOND recursion half reports the
     correct absolute 1-based LAPACK info index through the hybrid
-    rec->iter dispatch."""
+    rec->iter dispatch — and identically through the round-6 default
+    (iterative in-place) dispatch."""
     from slate_tpu.linalg import cholesky as chol_mod
 
     monkeypatch.setattr(chol_mod, "_POTRF_ITER_BASE", 64)
@@ -173,7 +176,9 @@ def test_potrf_hybrid_info_offset(monkeypatch):
     bad = 100  # 0-based, inside the second half
     a[bad, bad] = -(abs(a).sum())  # dominate: leading minor fails there
     A = st.hermitian(np.tril(a), nb=nb, uplo=Uplo.Lower)
-    L, info = st.potrf(A)
+    L, info = st.potrf(A, Options(factor_iter_large=False))
+    assert int(info) == bad + 1
+    L, info = st.potrf(A)  # round-6 default: iterative in-place loop
     assert int(info) == bad + 1
 
 

@@ -153,14 +153,10 @@ def test_hlo_gemm_has_collectives(grid2x4):
 
 
 def test_hlo_potrf_has_collectives(grid2x4):
-    n, nb = 256, 32
-    a = _spd(n)
-    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower, grid=grid2x4)
-
-    def f(A):
-        return st.potrf(A)[0].data
-
-    assert _collective_count(f, A) > 0, \
+    # shares ONE mesh-potrf compile with the two schedule tests below
+    # (_scheduled_potrf_entry caches it — the compile is ~40 s here)
+    hlo, _ = _scheduled_potrf_entry(grid2x4)
+    assert sum(hlo.count(c) for c in _COLLECTIVES) > 0, \
         "potrf compiled without any collective: GSPMD replicated the work"
 
 
@@ -249,6 +245,100 @@ def test_dist_panel_maxloc(grid2x4):
     lu0 = st.getrf(A)[0].to_numpy()
     lu1 = st.getrf(A, st.Options(lu_dist_panel=True))[0].to_numpy()
     np.testing.assert_allclose(lu1, lu0, rtol=1e-10, atol=1e-10)
+
+
+# -- P3 static evidence: scheduled-HLO collective/compute interleaving ------
+
+import re
+
+
+_SCHED_CACHE = {}
+
+
+def _scheduled_potrf_entry(grid, n=256, nb=32):
+    """Scheduled HLO (post-optimization, is_scheduled=true) of mesh
+    potrf's entry computation, line-classified: 'C' collective,
+    'X' compute (fusion/dot/custom-call). The compile is cached across
+    the two schedule tests — it is the expensive part."""
+    if (n, nb) in _SCHED_CACHE:
+        return _SCHED_CACHE[(n, nb)]
+    a = _spd(n)
+    A = st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower, grid=grid)
+
+    def f(A):
+        return st.potrf(A)[0].data
+
+    hlo = jax.jit(f).lower(A).compile().as_text()
+    m = re.search(r"^ENTRY [^{]*\{(.*?)^\}", hlo, re.S | re.M)
+    assert m, "no ENTRY computation in compiled HLO"
+    coll = ("all-gather", "all-reduce", "collective-permute",
+            "reduce-scatter", "all-to-all")
+    comp = ("fusion(", " dot(", "custom-call(", "convolution(")
+    seq = []
+    for ln in m.group(1).splitlines():
+        if any(c + "(" in ln or c + "-start(" in ln or c + "-done(" in ln
+               for c in coll):
+            seq.append("C")
+        elif any(c in ln for c in comp):
+            seq.append("X")
+    _SCHED_CACHE[(n, nb)] = (hlo, seq)
+    return hlo, seq
+
+
+def test_mesh_potrf_schedule_interleaves_collectives_with_updates(grid2x4):
+    """VERDICT r5 'Missing #6' / ISSUE 2 P3 static evidence: in mesh
+    potrf's SCHEDULED HLO, collective ops must be interleaved with the
+    trailing-update compute (fusions/dots) throughout the instruction
+    stream — the compiler-scheduled analog of the reference's lookahead
+    (panel broadcast overlapping trailing work, src/potrf.cc:84-195) —
+    rather than clumped into a prologue/epilogue. The 8-step n=256
+    factorization must show at least 2·nt separate collective runs
+    embedded in compute."""
+    n, nb = 256, 32
+    hlo, seq = _scheduled_potrf_entry(grid2x4, n, nb)
+    assert "is_scheduled=true" in hlo, "compiled module is not scheduled"
+    ncoll = seq.count("C")
+    ncomp = seq.count("X")
+    assert ncoll > 0 and ncomp > 0
+    runs = sum(1 for i, s in enumerate(seq)
+               if s == "C" and (i == 0 or seq[i - 1] != "C"))
+    assert runs >= 2 * (n // nb), (
+        f"collectives clumped: {ncoll} collectives in only {runs} runs "
+        f"against {ncomp} compute ops")
+
+
+def test_mesh_potrf_async_collective_start_done_interleaving(grid2x4):
+    """The stronger TPU-shaped assertion: async collective start/done
+    pairs with independent trailing-update compute scheduled BETWEEN
+    start and done (true latency hiding). XLA:CPU lowers collectives
+    synchronously (zero *-start/done pairs — verified in PERF.md round
+    4), so this skips off-TPU and runs on a TPU-attached session."""
+    hlo, _ = _scheduled_potrf_entry(grid2x4)
+    starts = re.findall(r"%(\S*?(?:all-gather|all-reduce|"
+                        r"collective-permute)-start\S*)\s*=", hlo)
+    if not starts:
+        pytest.skip("backend lowers collectives synchronously (no "
+                    "async start/done pairs in scheduled HLO); the "
+                    "interleaving assertion needs a TPU backend")
+    m = re.search(r"^ENTRY [^{]*\{(.*?)^\}", hlo, re.S | re.M)
+    lines = m.group(1).splitlines()
+    hidden = 0
+    open_since = {}  # start instruction NAME -> schedule index
+    for i, ln in enumerate(lines):
+        if "-start(" in ln and "=" in ln:
+            open_since[ln.split("=")[0].strip().lstrip("%")] = i
+        elif "-done(" in ln:
+            # a done op references ITS start by name as an operand;
+            # the (?!\d) guard keeps %op.1 from matching %op.10
+            for sname, j in list(open_since.items()):
+                if re.search(re.escape(sname) + r"(?!\d)", ln):
+                    if any("fusion(" in s or " dot(" in s
+                           for s in lines[j + 1:i]):
+                        hidden += 1
+                    open_since.pop(sname)
+                    break
+    assert hidden > 0, ("no compute scheduled inside any async "
+                        "collective start/done window")
 
 
 # -- explicit SUMMA routing -------------------------------------------------

@@ -193,11 +193,13 @@ def test_getrf_pivot_threshold_recursive_base():
 
 
 def test_getrf_rec_iter_base_dispatch(monkeypatch):
-    """Round-5 hybrid dispatch: the width recursion above the iter
-    crossover, the flat iterative loop as its base case. With the
-    crossover lowered to 64, n=128 must split once in _getrf_rec and
-    factor each 64-wide half with _getrf_iter. Verifies the residual
-    AND the solve built on it."""
+    """Round-5 hybrid dispatch — now the LEGACY arm
+    (Options(factor_iter_large=False); the round-6 default routes every
+    nt ≤ 64 width straight to the pivot-fused iterative loop): the
+    width recursion above the iter crossover, the flat iterative loop
+    as its base case. With the crossover lowered to 64, n=128 must
+    split once in _getrf_rec and factor each 64-wide half with
+    _getrf_iter. Verifies the residual AND the solve built on it."""
     monkeypatch.setattr(lu_mod, "_GETRF_ITER_BASE", 64)
     calls = {"iter": 0, "rec": 0}
     for name in ("_getrf_iter", "_getrf_rec"):
@@ -213,7 +215,7 @@ def test_getrf_rec_iter_base_dispatch(monkeypatch):
     n, nb = 128, 16  # 128 > 64 -> rec splits; halves 64 <= 64 -> iter
     a = RNG.standard_normal((n, n))
     A = st.from_dense(a, nb=nb)
-    LU, perm, info = lu_mod.getrf(A)
+    LU, perm, info = lu_mod.getrf(A, Options(factor_iter_large=False))
     assert int(info) == 0
     assert calls["rec"] >= 1 and calls["iter"] == 2
     lu = np.asarray(LU.dense_canonical())
@@ -239,7 +241,8 @@ def test_getrf_rec_tournament_threshold(monkeypatch):
     n, nb = 128, 16
     a = RNG.standard_normal((n, n))
     A = st.from_dense(a, nb=nb)
-    LU, perm, info = lu_mod.getrf(A, Options(pivot_threshold=0.5))
+    LU, perm, info = lu_mod.getrf(
+        A, Options(pivot_threshold=0.5, factor_iter_large=False))
     assert int(info) == 0
     lu = np.asarray(LU.dense_canonical())
     l = np.tril(lu, -1) + np.eye(len(perm))
