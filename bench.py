@@ -282,8 +282,11 @@ def bench_factor_phases(n=1024, nb=256, dtype=jnp.float32):
     herk_lower_rec concat recursion vs the new in-place slab update
     (blocked.herk_trailing_inplace), plus end-to-end potrf through the
     default in-place iterative dispatch vs the true 2×2 recursion
-    (crossover forced to 0 for the legacy arm). All slope-timed inside
-    one jit (the bench.py scan methodology)."""
+    (crossover forced to 0 for the legacy arm). Round 7 adds the
+    LOOKAHEAD A/B (pipeline vs sequential schedule per driver — a
+    control pair off-TPU, see the in-body honesty note) and the
+    batched-vs-tree CALU tournament round timing. All slope-timed
+    inside one jit (the bench.py scan methodology)."""
     import slate_tpu as st
     from slate_tpu.core.types import Options, Uplo
     from slate_tpu.linalg import cholesky as chol_mod
@@ -380,6 +383,62 @@ def bench_factor_phases(n=1024, nb=256, dtype=jnp.float32):
         "trailing_update_concat_rec": round(t_rec * 1e3, 3),
         "trailing_update_inplace": round(t_inp * 1e3, 3),
         "trailing_copy_saving": round((t_rec - t_inp) * 1e3, 3),
+    }
+
+    # --- round 7: lookahead A/B (panel-hidden vs exposed schedule) ---
+    # The default (lookahead=1) pipeline vs the sequential round-6
+    # schedule (lookahead=0), per driver. HONESTY (per the round-6
+    # precedent): XLA:CPU executes its thunk sequence serially, so NO
+    # overlap is expected off-TPU and these totals should read as a
+    # wash (they are recorded as the control pair); the schedule
+    # DECOUPLING is the structurally-asserted term
+    # (tests/test_lookahead.py jaxpr + scheduled-HLO guards) and the
+    # time saving is a TPU/mesh scheduler property — re-measure
+    # on-chip. The batched-vs-tree CALU round A/B below IS
+    # CPU-measurable (different lowering: one batched fori program per
+    # round vs the custom-call's sequential per-block loop).
+    t_seq_potrf = t_potrf(Options(lookahead=0))
+    t_seq_getrf = t_getrf(Options(lookahead=0))
+
+    aq = generate_matrix("randn", n, n, dtype, seed=8)
+    Aq = st.from_dense(aq, nb=nb)
+
+    def t_geqrf(opts):
+        def step(a_data, cs):
+            (Aq,) = cs
+            qr = st.geqrf(Aq.with_data(a_data), opts)
+            return a_data + 1e-30 * qr.vr
+        return _per_iter_seconds(step, Aq.data, (Aq,), k1=2, k2=6)
+
+    t_qr1 = t_geqrf(Options())
+    t_qr0 = t_geqrf(Options(lookahead=0))
+    out["lookahead_ms"] = {
+        "potrf_lookahead1": round(t_iter * 1e3, 3),
+        "potrf_lookahead0": round(t_seq_potrf * 1e3, 3),
+        "getrf_lookahead1": round(t_fused * 1e3, 3),
+        "getrf_lookahead0": round(t_seq_getrf * 1e3, 3),
+        "geqrf_lookahead1": round(t_qr1 * 1e3, 3),
+        "geqrf_lookahead0": round(t_qr0 * 1e3, 3),
+        "cpu_measurable": False,  # overlap is a TPU/mesh scheduler term
+    }
+
+    # --- round 7: batched-vs-tree CALU tournament round timing ---
+    from slate_tpu.linalg import lu as lu_mod
+
+    panel0 = generate_matrix("randn", n, nb, dtype, seed=9)
+
+    def t_tournament(batched):
+        def step(x, cs):
+            p = lu_mod._tournament_perm(x, nb, nb, n, n, batched=batched)
+            return x + 1e-30 * jnp.sum(p.astype(x.dtype))
+        return _per_iter_seconds(step, panel0, (), k1=2, k2=8)
+
+    t_round_b = t_tournament(True)
+    t_round_t = t_tournament(False)
+    out["calu_round_ms"] = {
+        "batched": round(t_round_b * 1e3, 3),
+        "tree": round(t_round_t * 1e3, 3),
+        "cpu_measurable": True,  # lowering difference, visible off-TPU
     }
     return out
 

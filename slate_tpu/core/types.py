@@ -207,6 +207,17 @@ class Options:
     HoldLocalWorkspace, TileReleaseStrategy) are kept as inert parity fields.
     """
 
+    # Depth of the factorization pipeline (the reference's
+    # Option::Lookahead, enums.hh:461-498; functional since round 7).
+    # ≥ 1: the iterative outer loops of potrf/getrf/geqrf split each
+    # trailing update at the next-panel slab and factor panel k+1
+    # between the slab and the remainder, so the serial panel chain of
+    # step k+1 carries no data edge to step k's remainder gemms and the
+    # scheduler may interleave them (lookahead-1 — PLASMA/DPLASMA
+    # lineage puts most of the win there; depths > 1 are accepted but
+    # currently schedule as depth 1). 0 = the strictly sequential
+    # round-6 schedule (bit-identical results; the reference arm for
+    # tests and A/B timing).
     lookahead: int = 1
     block_size: int = 256  # nb — tile size
     inner_blocking: int = 32  # ib — panel inner blocking
@@ -241,6 +252,13 @@ class Options:
     # (internal_swap.cc:503-560). False restores the materialized-copy
     # reference path (bit-identical results; kept for A/B + tests).
     lu_pivot_fusion: bool = True
+    # Round 7: CALU tournament rounds as ONE batched panel LU per round
+    # (blocked.panel_getrf_batched) instead of vmap(lax.linalg.lu)'s
+    # sequential per-block custom-call loop. False restores the
+    # lax.linalg.lu rounds (A/B timing + dispatch-policy reference;
+    # winner selection may differ between arms — both valid tournament
+    # pivotings).
+    lu_tournament_batched: bool = True
     # factor_iter_large: run the right-looking iterative outer loop with
     # in-place (dynamic_update_slice) trailing updates at ALL sizes with
     # nt ≤ 64 for potrf/getrf — the round-5 n=2048 crossover was set by

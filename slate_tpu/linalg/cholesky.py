@@ -19,13 +19,18 @@ loop inside one jit:
 Each step's trailing update is ONE large MXU matmul; under GSPMD the
 panel is all-gathered along the mesh axes (the analog of
 tileBcast/listBcastMT at src/potrf.cc:109-132) and the update runs on
-all devices. Lookahead (Option::Lookahead, P3) has no explicit analog
-— and measurement (PERF.md "Lookahead / overlap") shows the functional
-recursion genuinely serializes panel and update on one chip; the
-panel-latency budget is attacked directly (bucketed leaves, ib
-blocking) and on a mesh the rebalanced updates keep all devices busy
-while the panel runs. A double-buffered true-lookahead scan is future
-work for communication-bound multi-host meshes.
+all devices. Lookahead (Option::Lookahead, P3) has, since round 7, a
+DIRECT analog: ``Options.lookahead`` ≥ 1 (the default) restructures the
+iterative outer loop into a lookahead-1 pipeline — at step k the
+trailing update is split at the next-panel slab, panel k+1's diagonal
+tile is factored immediately after that slab, and the remainder slabs
+follow with no data edge to the factor (see _potrf_iter). The round-4
+finding stands that a single TPU core executes one kernel at a time;
+what the pipeline buys is SCHEDULE freedom — the compiler may interleave
+the serial panel chain with the remainder gemms (latency-hiding
+scheduler on TPU, overlap of the panel's broadcast with remainder
+compute on a mesh), and lookahead=0 restores the strictly sequential
+round-6 schedule bit-identically.
 
 Unlike LAPACK's in-place convention the factor is returned as a new
 lower-TriangularMatrix (functional semantics); ``info`` follows the
@@ -93,7 +98,7 @@ def _tile_chol(akk: jax.Array):
     return lkk, tile_info
 
 
-def _potrf_rec(a: jax.Array, nb: int, prec):
+def _potrf_rec(a: jax.Array, nb: int, prec, lookahead: int = 1):
     """Recursive blocked Cholesky on padded dense (lower).
 
     TPU redesign of the reference's panel/trailing task DAG
@@ -115,15 +120,15 @@ def _potrf_rec(a: jax.Array, nb: int, prec):
         # the nt bound keeps the Python-unrolled loop's HLO bounded
         # for small-nb configs (nt=128 unrolls cost minutes to compile;
         # on a 1-core host — the crossover was measured at nb=1024)
-        return _potrf_iter(a, nb, prec)
+        return _potrf_iter(a, nb, prec, lookahead)
     h = blocked._half(s, nb)
-    l11, i1 = _potrf_rec(a[:h, :h], nb, prec)
+    l11, i1 = _potrf_rec(a[:h, :h], nb, prec, lookahead)
     l21 = blocked.rebalance(
         blocked.trsm_rec(l11, a[h:, :h], left=False, lower=True,
                          conj_a=True, trans_a=True, prec=prec, base=nb))
     a22 = blocked.rebalance(
         blocked.herk_lower_rec(a[h:, h:], l21, prec=prec))
-    l22, i2 = _potrf_rec(a22, nb, prec)
+    l22, i2 = _potrf_rec(a22, nb, prec, lookahead)
     out = jnp.concatenate([
         jnp.concatenate([l11, a[:h, h:]], axis=1),
         jnp.concatenate([l21, l22], axis=1)], axis=0)
@@ -159,9 +164,10 @@ def _iter_eligible(s: int, nb: int) -> bool:
     return s > nb and s % nb == 0 and s // nb <= _ITER_MAX_NT
 
 
-def _potrf_iter(a: jax.Array, nb: int, prec):
+def _potrf_iter(a: jax.Array, nb: int, prec, lookahead: int = 1):
     """Iterative right-looking blocked Cholesky (round 4; round-6
-    default at every nt ≤ _ITER_MAX_NT size — see _potrf_blocked).
+    default at every nt ≤ _ITER_MAX_NT size — see _potrf_blocked),
+    restructured in round 7 as a LOOKAHEAD-1 PIPELINE.
 
     Each panel step pays exactly ONE tile Cholesky (the Pallas
     chol_tile kernel where eligible — at EVERY step, not just below
@@ -173,30 +179,73 @@ def _potrf_iter(a: jax.Array, nb: int, prec):
     flops, no per-level concatenation copies). The reference's task
     DAG shape (panel → trsm → herk per step, src/potrf.cc:84-195,
     with the right-looking in-place trailing discipline of
-    src/potrf.cc:136-176) is recovered exactly."""
+    src/potrf.cc:136-176) is recovered exactly.
+
+    ``lookahead`` ≥ 1 (the default; the reference's Option::Lookahead,
+    src/potrf.cc:84-103 — lookahead tasks factor panel k+1 while the
+    rest of trailing update k runs): the trailing update is SPLIT at
+    the next-panel slab — slab k+1 is written first, the diagonal tile
+    of step k+1 is factored IMMEDIATELY from it, and only then are the
+    remainder slabs written. The step-(k+1) tile factor (the serial
+    ~n·sqrt/divide chain that is potrf's single-chip latency floor,
+    PERF.md) therefore has NO data edge to the remainder slabs of step
+    k — the scheduler is free to interleave the panel's VPU/scalar
+    chain with the remainder's MXU gemms (asserted structurally in
+    tests/test_lookahead.py, and on the scheduled HLO where the
+    backend schedules it so). Every slab gemm is IDENTICAL to the
+    lookahead=0 schedule (same shapes, same operands — only the op
+    order between independent ops changes), so lookahead=1 is
+    bit-identical to lookahead=0, which reproduces the round-6
+    program exactly."""
     s = a.shape[0]
     nt = s // nb
     dus = blocked.dus_i32
 
     info = jnp.zeros((), jnp.int32)
+    ahead = None  # panel k's tile factor, produced at step k−1
     for k in range(nt):
         k0, k1 = k * nb, (k + 1) * nb
-        lkk, tinfo = _tile_chol(a[k0:k1, k0:k1])
+        if ahead is None:
+            with jax.named_scope(f"potrf_l{k}_tile"):
+                lkk, tinfo = _tile_chol(a[k0:k1, k0:k1])
+        else:
+            lkk, tinfo = ahead
+            ahead = None
         info = jnp.where((info == 0) & (tinfo > 0), k0 + tinfo,
                          info).astype(jnp.int32)
         a = dus(a, lkk, k0, k0)
         if k1 >= s:
             continue
-        inv = blocked.trtri_lower_batched(lkk)
-        pan = blocked.mm(a[k1:, k0:k1], jnp.conj(inv).T, prec)
-        pan = blocked.rebalance(pan)
+        with jax.named_scope(f"potrf_l{k}_panel"):
+            inv = blocked.trtri_lower_batched(lkk)
+            pan = blocked.mm(a[k1:, k0:k1], jnp.conj(inv).T, prec)
+            pan = blocked.rebalance(pan)
         a = dus(a, pan, k1, k0)
-        a = blocked.herk_trailing_inplace(a, pan, k1, nb, prec=prec)
+        if lookahead >= 1 and k1 + nb <= s:
+            # (a) the next-panel slab alone …
+            with jax.named_scope(f"potrf_l{k}_trail_next"):
+                a = blocked.herk_trailing_inplace(a, pan, k1, nb,
+                                                  prec=prec,
+                                                  j_stop=k1 + nb)
+            # … (b) factor panel k+1 NOW (reads only slab k+1's
+            # diagonal block; the remainder slabs below never touch
+            # rows/cols < k1+nb, so the value is final) …
+            with jax.named_scope(f"potrf_l{k + 1}_tile_lookahead"):
+                ahead = _tile_chol(a[k1:k1 + nb, k1:k1 + nb])
+            # … (c) the remainder slabs, independent of (b)
+            with jax.named_scope(f"potrf_l{k}_trail_rest"):
+                a = blocked.herk_trailing_inplace(a, pan, k1, nb,
+                                                  prec=prec,
+                                                  j_start=k1 + nb)
+        else:
+            with jax.named_scope(f"potrf_l{k}_trail"):
+                a = blocked.herk_trailing_inplace(a, pan, k1, nb,
+                                                  prec=prec)
     return a, info
 
 
 def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high",
-                   iter_large: bool = True):
+                   iter_large: bool = True, lookahead: int = 1):
     """Blocked Cholesky on padded dense (lower) → (tril factor, info).
 
     Dispatch (round 6): the in-place iterative loop owns EVERY size
@@ -210,12 +259,18 @@ def _potrf_blocked(a: jax.Array, nb: int, nt: int, prec: str = "high",
     v5e). The 2×2 recursion remains for nt > _ITER_MAX_NT (HLO-size
     guard) and as the legacy dispatch (Options.factor_iter_large=False
     — the round-5 policy, iterative only below the crossover), which
-    is also the reassociation-tolerance reference arm for tests."""
+    is also the reassociation-tolerance reference arm for tests.
+
+    ``lookahead`` (round 7, Options.lookahead): ≥ 1 runs the iterative
+    loop as the lookahead pipeline (panel k+1 factored between the
+    next-panel slab and the remainder slabs of trailing update k —
+    bit-identical, schedule-decoupled); 0 restores the strictly
+    sequential round-6 schedule (the tolerance/HLO reference arm)."""
     s = a.shape[0]
     if iter_large and _iter_eligible(s, nb):
-        out, info = _potrf_iter(a, nb, prec=prec)
+        out, info = _potrf_iter(a, nb, prec=prec, lookahead=lookahead)
     else:
-        out, info = _potrf_rec(a, nb, prec=prec)
+        out, info = _potrf_rec(a, nb, prec=prec, lookahead=lookahead)
     return jnp.tril(out), info
 
 
@@ -249,7 +304,8 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     nt = A.mt
     with blocked.distribute_on(A.grid):
         lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision,
-                                     iter_large=opts.factor_iter_large)
+                                     iter_large=opts.factor_iter_large,
+                                     lookahead=opts.lookahead)
     if A.uplo is Uplo.Upper:
         out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
                          kind=MatrixKind.Triangular, uplo=Uplo.Upper,

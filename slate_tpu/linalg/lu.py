@@ -118,7 +118,11 @@ def _getrf_rec(a: Array, nb: int, prec, dist_panel: bool = False,
             from ..parallel.panel import dist_panel_getrf
             lu, perm, info = dist_panel_getrf(ap, g)
         else:
-            lu, perm, info = blocked.panel_getrf_jit(ap)
+            # replicate the thin panel operand on an active grid (the
+            # panel broadcast; pre-0.6 partitioner soundness — see
+            # blocked.replicate_on_grid)
+            lu, perm, info = blocked.panel_getrf_jit(
+                blocked.replicate_on_grid(ap))
         return lu[:m], perm[:m], info
     if (not dist_panel and w <= _GETRF_ITER_BASE and w % nb == 0
             and w // nb <= _ITER_MAX_NT):
@@ -154,14 +158,19 @@ def _suffix_perms(pps, m: int, nb: int):
     deferred-left-swap fix-up needs, for each stored L column block j,
     the composition of every LATER step's permutation — computed by one
     backward pass: σ_{nt−1} = ι, σⱼ = q_{j+1}[σ_{j+1}] (gather-compose:
-    (x[q1])[q2] = x[q1[q2]]). Returns sigmas[j] for j = 0..nt−2."""
+    (x[q1])[q2] = x[q1[q2]]). Returns sigmas[j] for j = 0..nt−2.
+
+    The lift uses blocked.lift_tail_perm (iota/where/clamped-gather,
+    NOT a concatenate): the pre-0.6 SPMD partitioner mis-lowers a
+    concatenate whose second operand is a sharded int vector — the
+    root cause of the round-6 "mesh getrf at nb=64 returns a corrupted
+    perm" open item (see lift_tail_perm's docstring)."""
     nt = len(pps)
     sigmas = [None] * nt
     sig = jnp.arange(m, dtype=jnp.int32)
     for j in range(nt - 2, -1, -1):
         k0n = (j + 1) * nb
-        q = jnp.concatenate([jnp.arange(k0n, dtype=jnp.int32),
-                             k0n + pps[j + 1]])
+        q = blocked.lift_tail_perm(pps[j + 1], k0n, m, jnp.int32)
         sig = q[sig]
         sigmas[j] = sig
     return sigmas
@@ -185,9 +194,28 @@ def _apply_deferred_left_swaps(a: Array, pps, nb: int) -> Array:
 
 
 def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0,
-                fused: bool = True):
+                fused: bool = True, lookahead: int = 1,
+                tournament_batched: bool = True):
     """Iterative right-looking blocked partial-pivot LU (round 4; the
-    round-6 default at every size with nt ≤ _ITER_MAX_NT).
+    round-6 default at every size with nt ≤ _ITER_MAX_NT), restructured
+    in round 7 as a LOOKAHEAD-1 PIPELINE (``lookahead`` ≥ 1, the
+    default — Options.lookahead; 0 restores the sequential round-6
+    schedule).
+
+    Lookahead (fused arm only — the materialized legacy arm keeps the
+    reference schedule): at step k the trailing update is split at the
+    next-panel column block — the thin nb-wide u12/Schur slab is
+    computed and written first, panel k+1 is factored IMMEDIATELY from
+    that slab (the serial pivot-search/column chain that is getrf's
+    latency floor), and only then do the remainder u12/Schur gemms run.
+    The panel-(k+1) chain has no data edge to the remainder gemms, so
+    the scheduler may interleave them (the reference's lookahead task,
+    src/getrf.cc:121-160). Splitting the u12/Schur gemms by columns
+    leaves every output element's contraction unchanged, so
+    lookahead=1 is bit-identical to lookahead=0 (asserted across
+    dtypes and the mesh in tests/test_lookahead.py; the formal
+    guarantee is tolerance-level — column tiling of a gemm is a
+    backend scheduling detail — and bit-level on the backends we test).
 
     Same redesign as cholesky._potrf_iter: per panel ONE bucketed
     pivoted panel factorization (blocked.panel_getrf), ONE batched-leaf
@@ -229,27 +257,45 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0,
     exactly the reference's CALU trade."""
     m, w = a.shape
     nt = w // nb
+    dus = blocked.dus_i32  # raw python-int starts lower to s64 under
+    # x64 and trip the pre-0.6 partitioner's mixed-width compare
     perm = jnp.arange(m, dtype=jnp.int32)
     info = jnp.zeros((), jnp.int32)
     pps = []
+
+    def factor_panel(panel: Array, prows: int):
+        """One pivoted nb-wide panel factorization → (lu rows-sliced,
+        perm, info): the bucketed partial-pivot base, or under
+        ``threshold`` < 1 the tournament arm (argmax/swap chain leaves
+        the critical path; the tournament permutation compacts ALL
+        rows, and fused, only the nb-wide panel slice is gathered for
+        the elimination). The panel operand is pinned replicated on an
+        active grid first (blocked.replicate_on_grid — the panel
+        broadcast; also the pre-0.6 partitioner soundness fix for the
+        mesh nb=64 open item)."""
+        panel = blocked.replicate_on_grid(panel)
+        if threshold < 1.0:
+            p_p = _tournament_perm(panel, nb, nb, prows, m,
+                                   batched=tournament_batched)
+            lu_p, _, i_p = _tournament_panel(
+                panel[p_p], nb, nb, prows, perm_done=True)
+            return lu_p, p_p, i_p
+        hb = blocked.bucket_pow2(prows, nb)
+        if hb > prows:
+            panel = jnp.pad(panel, ((0, hb - prows), (0, 0)))
+        lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
+        return lu_p[:prows], p_p[:prows], i_p
+
+    ahead = None  # panel k's factorization, produced at step k−1
     for k in range(nt):
         k0, k1 = k * nb, (k + 1) * nb
         rows = m - k0
-        panel = a[k0:, k0:k1]
-        if threshold < 1.0:
-            # tournament panel: argmax/swap chain leaves the critical
-            # path. The tournament permutation compacts ALL rows (not a
-            # bounded-displacement swap list); fused, only the nb-wide
-            # panel slice is gathered for the elimination.
-            p_p = _tournament_perm(panel, nb, nb, rows, m)
-            lu_p, _, i_p = _tournament_panel(
-                panel[p_p], nb, nb, rows, perm_done=True)
+        if ahead is None:
+            with jax.named_scope(f"getrf_l{k}_panel"):
+                lu_p, p_p, i_p = factor_panel(a[k0:, k0:k1], rows)
         else:
-            hb = blocked.bucket_pow2(rows, nb)
-            if hb > rows:
-                panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
-            lu_p, p_p, i_p = blocked.panel_getrf_jit(panel)
-            p_p = p_p[:rows]
+            lu_p, p_p, i_p = ahead
+            ahead = None
         info = jnp.where((info == 0) & (i_p > 0), k0 + i_p,
                          info).astype(jnp.int32)
         perm = perm.at[k0:].set(perm[k0:][p_p])
@@ -259,24 +305,49 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0,
             # the bit-equivalence tests): permute the whole remaining
             # row block, stored L included, then update in place
             moved = blocked.permute_rows_limited(a[k0:, :], p_p, 2 * nb)
-            a = jax.lax.dynamic_update_slice(a, moved, (k0, 0))
-        a = jax.lax.dynamic_update_slice(a, lu_p[:rows], (k0, k0))
+            a = dus(a, moved, k0, 0)
+        a = dus(a, lu_p, k0, k0)
         if k1 >= w:
             continue
         l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=a.dtype)
         inv11 = blocked.trtri_lower_batched(l11, unit=True)
-        if fused:
+        if fused and lookahead >= 1 and k1 + nb < w:
             right = a[k0:, k1:]
-            u12 = blocked.mm(inv11, right[p_p[:nb]], prec)
-            a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
-            schur = blocked.rebalance(
-                right[p_p[nb:]] - blocked.mm(lu_p[nb:rows], u12, prec))
+            top = right[p_p[:nb]]  # pivot rows, one thin gather
+            # (a) next-panel columns: the thin nb-wide trailing slab
+            with jax.named_scope(f"getrf_l{k}_trail_next"):
+                u12n = blocked.mm(inv11, top[:, :nb], prec)
+                schur_n = blocked.rebalance(
+                    right[:, :nb][p_p[nb:]]
+                    - blocked.mm(lu_p[nb:], u12n, prec))
+            a = dus(a, u12n, k0, k1)
+            a = dus(a, schur_n, k1, k1)
+            # (b) factor panel k+1 from the fresh slab — the serial
+            # pivot/column chain, no data edge to the remainder gemms
+            with jax.named_scope(f"getrf_l{k + 1}_panel_lookahead"):
+                ahead = factor_panel(schur_n, m - k1)
+            # (c) the remainder slab, independent of (b)
+            with jax.named_scope(f"getrf_l{k}_trail_rest"):
+                u12r = blocked.mm(inv11, top[:, nb:], prec)
+                schur_r = blocked.rebalance(
+                    right[:, nb:][p_p[nb:]]
+                    - blocked.mm(lu_p[nb:], u12r, prec))
+            a = dus(a, u12r, k0, k1 + nb)
+            a = dus(a, schur_r, k1, k1 + nb)
+        elif fused:
+            with jax.named_scope(f"getrf_l{k}_trail"):
+                right = a[k0:, k1:]
+                u12 = blocked.mm(inv11, right[p_p[:nb]], prec)
+                a = dus(a, u12, k0, k1)
+                schur = blocked.rebalance(
+                    right[p_p[nb:]] - blocked.mm(lu_p[nb:], u12, prec))
+            a = dus(a, schur, k1, k1)
         else:
             u12 = blocked.mm(inv11, a[k0:k1, k1:], prec)
-            a = jax.lax.dynamic_update_slice(a, u12, (k0, k1))
+            a = dus(a, u12, k0, k1)
             schur = blocked.rebalance(
                 a[k1:, k1:] - blocked.mm(a[k1:, k0:k1], u12, prec))
-        a = jax.lax.dynamic_update_slice(a, schur, (k1, k1))
+            a = dus(a, schur, k1, k1)
     if fused:
         a = _apply_deferred_left_swaps(a, pps, nb)
     return a, perm, info
@@ -284,7 +355,8 @@ def _getrf_iter(a: Array, nb: int, prec, threshold: float = 1.0,
 
 def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
                    dist_panel: bool = False, threshold: float = 1.0,
-                   fused: bool = True, iter_large: bool = True):
+                   fused: bool = True, iter_large: bool = True,
+                   lookahead: int = 1, tournament_batched: bool = True):
     """Blocked partial-pivot LU on padded dense (possibly rectangular).
 
     Dispatch (round 6): the pivot-fused iterative loop (_getrf_iter)
@@ -303,7 +375,8 @@ def _getrf_blocked(a: Array, nb: int, nt: int, prec: str = "high",
     k = min(m, n)
     if not dist_panel and iter_large and _iter_eligible(k, nb):
         lu, perm, info = _getrf_iter(a[:, :k], nb, prec, threshold,
-                                     fused=fused)
+                                     fused=fused, lookahead=lookahead,
+                                     tournament_batched=tournament_batched)
     else:
         lu, perm, info = _getrf_rec(a[:, :k], nb, prec, dist_panel,
                                     threshold)
@@ -337,12 +410,15 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     # honor the option only where the composition is sound
     dist_panel = opts.lu_dist_panel and panel_mod.DRIVER_COMPOSABLE
     with blocked.distribute_on(A.grid):
-        lu, perm, info = _getrf_blocked(a, A.nb, min(A.mt, A.nt),
-                                        prec=opts.update_precision,
-                                        dist_panel=dist_panel,
-                                        threshold=opts.pivot_threshold,
-                                        fused=opts.lu_pivot_fusion,
-                                        iter_large=opts.factor_iter_large)
+        lu, perm, info = _getrf_blocked(
+            a, A.nb, min(A.mt, A.nt),
+            prec=opts.update_precision,
+            dist_panel=dist_panel,
+            threshold=opts.pivot_threshold,
+            fused=opts.lu_pivot_fusion,
+            iter_large=opts.factor_iter_large,
+            lookahead=opts.lookahead,
+            tournament_batched=opts.lu_tournament_batched)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info
 
@@ -407,25 +483,65 @@ def _lu_nopiv_unblocked(a: Array):
 
 
 def _tournament_perm(panel: Array, w: int, nb: int, prows: int,
-                     mpad: int) -> Array:
+                     mpad: int, batched: bool = True) -> Array:
     """CALU tournament over a (prows × w) panel: returns the length-
     ``prows`` permutation putting the w winner rows on top (reference
     src/getrf_tntpiv.cc:110-175 — local LU per nb-row chunk selects
     candidates, then a log₂ tree of pairwise stacked LUs picks the
     winners; all on device).
 
+    ``batched`` (round 7, Options.lu_tournament_batched, default on):
+    each round's chunk factorizations run as ONE batched panel LU
+    (blocked.panel_getrf_batched — a single fori_loop whose body does
+    the pivot search / swap / rank-1 update for every chunk at once),
+    instead of vmap(lax.linalg.lu), whose custom-call backends execute
+    the batch as a sequential per-block loop. A round's sequential
+    depth is then w column steps regardless of the chunk count. Winner
+    SELECTION may differ between the two arms (different elimination
+    arithmetic ⇒ different rounding ⇒ occasionally different pivot
+    rows); both are valid tournament pivotings with the same growth
+    properties — the escape hatch exists for A/B timing and as the
+    dispatch-policy reference, not bit-parity.
+
     Padding sentinels (zero-padded chunk rows / odd-pairing fillers,
     selectable only when a panel column is entirely zero) are replaced
     by distinct unused rows so the permutation stays valid and
     singularity surfaces only via info."""
     nchunks = -(-prows // nb)
-    pad_rows = nchunks * nb - prows
+    if batched and nchunks > 1:
+        # bucket the chunk count to a power of two with zero chunks
+        # (their candidate rows carry the mpad sentinel, the same
+        # mechanism as the odd-pairing fillers below): round shapes
+        # become SIZE-INDEPENDENT — (2^i, nb, w) and (2^i, 2w, w) only
+        # — so the batched-round programs compile once per (nb, w)
+        # and amortize across every panel step and problem size, and
+        # every pairing is even (no filler branch on this arm).
+        nck = 1
+        while nck < nchunks:
+            nck *= 2
+    else:
+        nck = nchunks
+    pad_rows = nck * nb - prows
     stacked = jnp.pad(panel, ((0, pad_rows), (0, 0)))
-    chunks = stacked.reshape(nchunks, nb, w)
-    cand_idx = (jnp.arange(nchunks * nb, dtype=jnp.int32)
-                .reshape(nchunks, nb))
+    chunks = stacked.reshape(nck, nb, w)
+    cand_idx = (jnp.arange(nck * nb, dtype=jnp.int32)
+                .reshape(nck, nb))
+    if nck != nchunks:
+        # rows past the real panel are sentinels, not candidates
+        cand_idx = jnp.where(cand_idx < prows, cand_idx, mpad)
+
+    def round_perms(chs: Array) -> Array:
+        if batched:
+            _, perms_c, _ = blocked.panel_getrf_batched(chs)
+            return perms_c
+        _, _, perms_c = jax.vmap(jax.lax.linalg.lu)(chs)
+        return perms_c
+
+    rnd = 0
     while chunks.shape[0] > 1:
-        _, _, perms_c = jax.vmap(jax.lax.linalg.lu)(chunks)
+        with jax.named_scope(f"calu_round{rnd}"):
+            perms_c = round_perms(chunks)
+        rnd += 1
         top = jax.vmap(lambda c, p: c[p][:w])(chunks, perms_c)
         topi = jax.vmap(lambda ci, p: ci[p][:w])(cand_idx, perms_c)
         nc = top.shape[0]
@@ -437,7 +553,8 @@ def _tournament_perm(panel: Array, w: int, nb: int, prows: int,
             nc += 1
         chunks = top.reshape(nc // 2, 2 * w, w)
         cand_idx = topi.reshape(nc // 2, 2 * w)
-    _, _, pfin = jax.lax.linalg.lu(chunks[0])
+    with jax.named_scope(f"calu_round{rnd}_final"):
+        pfin = round_perms(chunks[:1])[0]
     winners = cand_idx[0][pfin][:w]  # panel-relative row indices
     valid = winners < prows
     used = (jnp.zeros(prows + 1, bool)
@@ -452,7 +569,7 @@ def _tournament_perm(panel: Array, w: int, nb: int, prows: int,
 
 
 def _tournament_panel(panel: Array, w: int, nb: int, prows: int,
-                      perm_done: bool = False
+                      perm_done: bool = False, batched: bool = True
                       ) -> Tuple[Array, Array, Array]:
     """Tournament-pivoted panel factorization: select winners
     (_tournament_perm), then eliminate without further pivoting —
@@ -463,7 +580,7 @@ def _tournament_panel(panel: Array, w: int, nb: int, prows: int,
         p_p = jnp.arange(prows, dtype=jnp.int32)
         pan_w = panel
     else:
-        p_p = _tournament_perm(panel, w, nb, prows, prows)
+        p_p = _tournament_perm(panel, w, nb, prows, prows, batched=batched)
         pan_w = panel[p_p]
     lu_top, info = _lu_nopiv_recursive(pan_w[:w])
     below = jax.lax.linalg.triangular_solve(
@@ -490,10 +607,16 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     compaction is folded into the panel/trailing READS and the stored L
     columns are reordered once at the end (_suffix_perms), instead of
     the per-step ``a.at[k0:, :].set(a[k0:, :][p_perm])`` full-width
-    copy. Bit-identical either way."""
+    copy. Bit-identical either way.
+
+    Round 7: the tournament rounds run BATCHED by default
+    (opts.lu_tournament_batched — one batched panel LU per round via
+    blocked.panel_getrf_batched instead of vmap(lax.linalg.lu)'s
+    sequential per-block custom-call loop; see _tournament_perm)."""
     m, n = A.shape
     nb = A.nb
     fused = opts.lu_pivot_fusion
+    batched = opts.lu_tournament_batched
     a = _canonical(A)
     a = _pad_identity_diag(a, m, n)
     mpad = a.shape[0]
@@ -505,8 +628,10 @@ def getrf_tntpiv(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
         k0, k1 = k * nb, min((k + 1) * nb, a.shape[1])
         w = k1 - k0
         prows = mpad - k0
-        panel = a[k0:, k0:k1]
-        p_perm = _tournament_perm(panel, w, nb, prows, mpad)
+        with blocked.distribute_on(A.grid):
+            panel = blocked.replicate_on_grid(a[k0:, k0:k1])
+        p_perm = _tournament_perm(panel, w, nb, prows, mpad,
+                                  batched=batched)
         perm = perm.at[k0:].set(perm[k0:][p_perm])
         pps.append(p_perm)
         if fused:

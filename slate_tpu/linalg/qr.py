@@ -124,36 +124,97 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     identity on disjoint supports and cost one extra full-panel pass)
     and no per-step concatenation or full-matrix copy is made. geqrf
     has no 2×2-recursion alternative, so there is no crossover to
-    revise here; the loop IS the large-n path."""
+    revise here; the loop IS the large-n path.
+
+    Round 7 (Options.lookahead ≥ 1, the default): lookahead-1
+    pipeline. The trailing reflection of step k is split at the
+    next-panel column block — the nb-wide block is reflected first,
+    panel k+1 (the serial Householder column chain) is factored
+    immediately from it, and the remainder columns are reflected after,
+    with no data edge to the panel factor. Bit-identity discipline:
+    the height-K contraction Vᴴ·C and the small Tᴴ·(Vᴴ·C) stay ONE
+    gemm each (splitting the contraction-heavy operand lets the
+    backend re-block the K reduction — measured non-bitwise); only the
+    K=w gemm V·Z and the elementwise subtract split by columns, which
+    leaves every output element's contraction unchanged. Panel k+1
+    therefore overlaps the remainder's V·Z gemm and subtract (≈ half
+    the trailing flops); lookahead=0 restores the sequential round-6
+    schedule bit-identically."""
     m, n = A.shape
     nb = A.nb
     prec = opts.update_precision
+    lookahead = opts.lookahead
     a = A.dense_canonical()
     a = _pad_identity_diag(a, m, n)
     mpad, npad = a.shape
     kt = -(-min(m, n) // nb)  # panels covering the logical diagonal
     ts = []
     dus = blocked.dus_i32
+
+    def factor_panel(panel, prows):
+        """One bucketed panel QR + T factor, rows-sliced."""
+        hb = blocked.bucket_pow2(prows, nb)
+        if hb > prows:
+            panel = jnp.pad(panel, ((0, hb - prows), (0, 0)))
+        vr, taus, t = blocked.panel_geqrf_with_t(panel)
+        return vr[:prows], t
+
+    ahead = None  # panel k's (vr, t), produced at step k−1
     with blocked.distribute_on(A.grid):
         for k in range(kt):
             k0, k1 = k * nb, min((k + 1) * nb, npad)
             w = k1 - k0
             rows = mpad - k0
-            hb = blocked.bucket_pow2(rows, nb)
-            panel = a[k0:, k0:k1]
-            if hb > rows:
-                panel = jnp.pad(panel, ((0, hb - rows), (0, 0)))
-            vr, taus, t = blocked.panel_geqrf_with_t(panel)
-            vr = vr[:rows]
+            if ahead is None:
+                with jax.named_scope(f"geqrf_l{k}_panel"):
+                    vr, t = factor_panel(a[k0:, k0:k1], rows)
+            else:
+                vr, t = ahead
+                ahead = None
             # store the packed panel as-is: R rows on/above the
             # diagonal, V tails below (beta on the diagonal)
             a = dus(a, vr, k0, k0)
             if k1 < npad:
                 v = jnp.tril(vr, -1)
                 v = v.at[jnp.arange(w), jnp.arange(w)].set(1.0)
-                a = dus(a, blocked.rebalance(
-                    _apply_block_reflector_H(v, t[:w, :w],
-                                             a[k0:, k1:], prec)), k0, k1)
+                k2 = min(k1 + nb, npad)
+                if lookahead >= 1 and k2 < npad and k + 1 < kt:
+                    # the large-K contraction (Vᴴ·C over the panel
+                    # height) and the small Tᴴ·(Vᴴ·C) stay WHOLE —
+                    # splitting a gemm along its contraction-heavy
+                    # operand lets the backend re-block the K reduction
+                    # and breaks bit-identity; only the K=w gemm V·Z
+                    # and the elementwise subtract are split by columns
+                    mmo = blocked.mm
+                    c_full = a[k0:, k1:]
+                    wn = k2 - k1
+                    with jax.named_scope(f"geqrf_l{k}_trail_y"):
+                        # precision parity with _apply_block_reflector_H:
+                        # inner Vᴴ·C at ``prec``, the T gemm at the
+                        # caller's HIGHEST context (None) — reflector
+                        # math always runs highest (core/types.py)
+                        z = mmo(jnp.conj(t[:w, :w]).T,
+                                mmo(jnp.conj(v).T, c_full, prec))
+                    # (a) reflect the next-panel columns alone …
+                    with jax.named_scope(f"geqrf_l{k}_trail_next"):
+                        upd_next = c_full[:, :wn] - mmo(v, z[:, :wn],
+                                                        prec)
+                    a = dus(a, blocked.rebalance(upd_next), k0, k1)
+                    # … (b) factor panel k+1 from the fresh block
+                    # (rows w: of the slab = rows k1: of the matrix) …
+                    with jax.named_scope(f"geqrf_l{k + 1}_panel_lookahead"):
+                        ahead = factor_panel(upd_next[w:], mpad - k1)
+                    # … (c) the remainder columns, independent of (b)
+                    with jax.named_scope(f"geqrf_l{k}_trail_rest"):
+                        upd_rest = c_full[:, wn:] - mmo(v, z[:, wn:],
+                                                        prec)
+                    a = dus(a, blocked.rebalance(upd_rest), k0, k2)
+                else:
+                    with jax.named_scope(f"geqrf_l{k}_trail"):
+                        a = dus(a, blocked.rebalance(
+                            _apply_block_reflector_H(
+                                v, t[:w, :w], a[k0:, k1:], prec)),
+                            k0, k1)
             if w < nb:  # ragged final panel: embed into (nb, nb)
                 t = jnp.pad(t, ((0, nb - w), (0, nb - w)))
             ts.append(t)

@@ -96,6 +96,30 @@ def rebalance(x: Array) -> Array:
     return lax.with_sharding_constraint(
         x, NamedSharding(g.mesh, P(ROW_AXIS, COL_AXIS)))
 
+
+def replicate_on_grid(x: Array) -> Array:
+    """Pin ``x`` FULLY REPLICATED over the active grid (no-op without
+    one) — the GSPMD analog of the reference's panel broadcast
+    (tileBcast/listBcastMT, src/potrf.cc:109-132): the thin pivoted
+    panel is factored identically on every device while the O(n³)
+    trailing updates stay sharded.
+
+    This is also the round-7 soundness fix for the second half of the
+    "mesh getrf at nb=64" open item: with a ROW-SHARDED panel operand,
+    the pre-0.6 SPMD partitioner mis-lowers the permutation gathers
+    inside panel_getrf's width recursion (wrong VALUES, valid perm —
+    distinct from the lift_tail_perm concatenate bug, bisected the
+    same way). A replicated operand partitions trivially, so every
+    lowering is sound; the cost is one all-gather of an (m, nb) strip
+    per level — traffic the reference pays for the same panel by
+    design."""
+    g = _GRID_CTX.get()
+    if g is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return lax.with_sharding_constraint(
+        x, NamedSharding(g.mesh, P(*([None] * x.ndim))))
+
 # base sizes, chosen for TPU: ib such that the fori-loop bases touch
 # O(m·nb·ib) bytes total; bases for recursion chosen so leaf ops stay
 # MXU-sized without blowing up HLO op count.
@@ -366,9 +390,21 @@ def dus_i32(x: Array, val: Array, i: int, j: int) -> Array:
 
 
 def herk_trailing_inplace(a: Array, pan: Array, k1: int, nb: int,
-                          prec: Optional[str] = None) -> Array:
+                          prec: Optional[str] = None,
+                          j_start: Optional[int] = None,
+                          j_stop: Optional[int] = None) -> Array:
     """A[k1:, k1:] ← A[k1:, k1:] − pan·panᴴ written IN PLACE, one
     nb-wide column slab at a time (round 6).
+
+    ``j_start``/``j_stop`` (round 7) bound the slab range [j_start,
+    j_stop) so the lookahead pipeline can write the NEXT-panel slab
+    (j_stop = k1 + nb) separately from the remainder (j_start =
+    k1 + nb): each slab's gemm is unchanged (rows/cols sliced from the
+    same ``pan`` at the same offsets), so splitting the call is
+    bit-identical to one call over the full range — only the op ORDER
+    between the two calls changes, which is exactly the point (the
+    panel-(k+1) factor slots between them with no data edge to the
+    remainder).
 
     The iterative right-looking loops previously routed this update
     through herk_lower_rec, whose 2×2 recursion concatenates full
@@ -388,7 +424,9 @@ def herk_trailing_inplace(a: Array, pan: Array, k1: int, nb: int,
     symmetric update. Each slab is rebalance()d so multi-device grids
     keep the per-level resharding constraints."""
     s = a.shape[0]
-    for j0 in range(k1, s, nb):
+    lo = k1 if j_start is None else j_start
+    hi = s if j_stop is None else min(j_stop, s)
+    for j0 in range(lo, hi, nb):
         jw = min(nb, s - j0)
         rows = pan[j0 - k1:]
         cols = pan[j0 - k1:j0 - k1 + jw]
@@ -557,11 +595,32 @@ def permute_rows_limited(x: Array, perm: Array, max_moved: int) -> Array:
     return x[perm]
 
 
+def lift_tail_perm(p_tail: Array, h: int, m: int, dtype=None) -> Array:
+    """The length-``m`` gather perm [0..h) ++ (h + p_tail) WITHOUT a
+    concatenate.
+
+    Root cause of the long-open "mesh getrf at nb=64 returns a corrupted
+    perm" item (CHANGES.md round 6, reproduced + bisected this round):
+    on jax 0.4.37's old SPMD partitioner, lowering
+    ``concatenate([iota(h), h + p_tail])`` with a SHARDED ``p_tail``
+    (GSPMD propagates the panel's row sharding into the perm carry of
+    the fori base) produces OUT-OF-RANGE indices — the partitioned
+    concatenate mis-applies shard offsets to the second operand. The
+    iota/where/clamped-gather formulation below lowers correctly under
+    the same shardings (verified against the minimal repro, now a
+    regression test: tests/test_lookahead.py::test_compose_tail_sharded
+    and the nb=64 mesh getrf it unblocks). nb=32 never hit it because a
+    32-wide panel is one fori base — no composition."""
+    if dtype is None:
+        dtype = p_tail.dtype
+    iota = jnp.arange(m, dtype=dtype)
+    tail = p_tail[jnp.maximum(iota - h, 0)]
+    return jnp.where(iota < h, iota, h + tail.astype(dtype))
+
+
 def _compose_tail(p1: Array, p2: Array, h: int) -> Array:
     """Total gather perm for 'apply p1, then p2 on rows h:'."""
-    idx = jnp.concatenate(
-        [jnp.arange(h, dtype=p1.dtype), h + p2.astype(p1.dtype)])
-    return p1[idx]
+    return p1[lift_tail_perm(p2, h, p1.shape[0], p1.dtype)]
 
 
 def panel_getrf(a: Array, ib: int = PANEL_IB,
@@ -584,6 +643,17 @@ def panel_getrf(a: Array, ib: int = PANEL_IB,
         if pallas_ops.lu_panel_eligible(hh, w, a.dtype):
             return pallas_ops.lu_panel_base(a)
         return _panel_getrf_base(a)
+    from . import pallas_ops
+    if pallas_ops.lu_panel_eligible(hh, w, a.dtype):
+        # round 7 (deeper-unrolled bases): a WIDE base (w ≤ 128) runs
+        # as ONE kernel invocation instead of recursing into 32-wide
+        # bases with XLA trsm/gemm aggregation between them — the
+        # kernel's column loop is arithmetic-identical to the fori
+        # base at any width, so this only removes dispatch/fusion
+        # boundaries. Gated by the same scoped-VMEM cells budget, so
+        # it activates on the SHORT panels of a factorization's tail —
+        # exactly the latency-dominated steps.
+        return pallas_ops.lu_panel_base(a)
     h = _round_to(w // 2, ib)
     lu1, p1, i1 = panel_getrf(a[:, :h], ib, prec)
     right = permute_rows_limited(a[:, h:], p1, 2 * h)
@@ -605,6 +675,74 @@ def panel_getrf(a: Array, ib: int = PANEL_IB,
 def panel_getrf_jit(a: Array, ib: int = PANEL_IB):
     """jit entry so bucketed panel shapes compile once per bucket."""
     return panel_getrf(a, ib)
+
+
+def panel_getrf_batched(stack: Array) -> Tuple[Array, Array, Array]:
+    """One BATCHED pivoted panel factorization over a (B, H, w) chunk
+    stack — the per-round kernel of the CALU tournament (round 7).
+
+    The tournament previously ran each round through
+    ``vmap(lax.linalg.lu)``: a batched custom-call whose backends
+    execute the batch as a SEQUENTIAL loop of per-block column
+    recurrences (XLA:CPU loops lapack getrf over the batch dim;
+    XLA:TPU's LuDecompositionBlock expansion is likewise serial per
+    block — the "per-block sequential tree" of ISSUE 3). Here the whole
+    round is ONE fori_loop of w column steps whose body does the pivot
+    search / swap / rank-1 update for EVERY chunk at once: batch
+    parallelism lives INSIDE each op (batched argmax, batched outer
+    product — VPU/MXU-wide), and the sequential depth of a round is w
+    column steps regardless of the chunk count. The body is written
+    HAND-BATCHED — row swaps as take_along_axis gathers of a swapped
+    index map rather than vmap of the fori base's dynamic scatters
+    (vmapped batched-index scatters compile ~40 s and run ~6× slower
+    per round on XLA:CPU; the gather form is also the natural TPU
+    lowering). Arithmetic is op-for-op the fori base's, so per-chunk
+    results match _panel_getrf_base exactly. Reference analog: the
+    reference plays its tournament across ranks in parallel
+    (src/getrf_tntpiv.cc:110-175, tileSend/Recv pairs); a single XLA
+    program gets the same concurrency from batching, not message
+    passing.
+
+    Returns (lu, perm, info) stacks with the _panel_getrf_base
+    contract per chunk."""
+    return _panel_getrf_batched_jit(stack)
+
+
+@jax.jit
+def _panel_getrf_batched_jit(stack: Array):
+    bsz, hh, w = stack.shape
+    iot = jnp.arange(hh)[None, :]                     # (1, H)
+    rdtype = jnp.real(stack).dtype
+
+    def body(j, carry):
+        a, perm, info = carry
+        col = lax.dynamic_slice_in_dim(a, j, 1, axis=2)[:, :, 0]  # (B, H)
+        score = jnp.where(iot >= j, jnp.abs(col), -1.0).astype(rdtype)
+        p = jnp.argmax(score, axis=1).astype(jnp.int32)           # (B,)
+        # swap rows j <-> p_b as ONE gather of a swapped index map
+        idx = jnp.where(iot == j, p[:, None], iot)
+        idx = jnp.where(iot == p[:, None], j, idx)    # p == j stays j
+        a = jnp.take_along_axis(a, idx[:, :, None], axis=1)
+        perm = jnp.take_along_axis(perm, idx, axis=1)
+        d = jnp.take_along_axis(col, p[:, None], axis=1)[:, 0]    # (B,)
+        bad = jnp.isnan(jnp.abs(d)) | (jnp.abs(d) == 0)
+        info = jnp.where((info == 0) & bad, j + 1, info).astype(jnp.int32)
+        dsafe = jnp.where(bad, jnp.ones((), a.dtype), d)
+        col2 = lax.dynamic_slice_in_dim(a, j, 1, axis=2)[:, :, 0]
+        lcol = jnp.where(iot > j, col2 / dsafe[:, None], col2)    # (B, H)
+        cW = jnp.arange(w)[None, None, :]
+        a = jnp.where(cW == j, lcol[:, :, None], a)
+        urow = lax.dynamic_slice_in_dim(a, j, 1, axis=1)[:, 0, :]  # (B, w)
+        urow = jnp.where(cW[0] > j, urow, 0)
+        lmask = jnp.where(iot > j, lcol, 0)
+        a = a - lmask[:, :, None] * urow[:, None, :]
+        return (a, perm, info)
+
+    perm0 = jnp.broadcast_to(jnp.arange(hh, dtype=jnp.int32)[None, :],
+                             (bsz, hh))
+    a, perm, info = lax.fori_loop(
+        0, w, body, (stack, perm0, jnp.zeros((bsz,), jnp.int32)))
+    return a, perm, info
 
 
 # ---------------------------------------------------------------------------
@@ -730,6 +868,14 @@ def panel_geqrf(a: Array, ib: int = PANEL_IB,
         if pallas_ops.qr_panel_eligible(hh, w, a.dtype):
             return pallas_ops.qr_panel_base(a)
         return _panel_geqrf_base(a)
+    from . import pallas_ops
+    if pallas_ops.qr_panel_wide_eligible(hh, w, a.dtype):
+        # round 7 (deeper-unrolled bases): a wide base runs as ONE
+        # micro-blocked kernel — per-column Householder updates
+        # restricted to 32-lane micro-blocks, compact-WY MXU updates
+        # between blocks (chol_tile's three-level structure brought to
+        # the QR panel; see pallas_ops._qr_panel_wide_kernel).
+        return pallas_ops.qr_panel_base_wide(a)
     h = _round_to(w // 2, ib)
     vr1, taus1 = panel_geqrf(a[:, :h], ib, prec)
     v1 = _split_v(vr1, h)

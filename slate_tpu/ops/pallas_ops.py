@@ -526,6 +526,159 @@ def qr_panel_eligible(h: int, w: int, dtype) -> bool:
         and h * w <= _PANEL_MAX_CELLS)
 
 
+# ---------------------------------------------------------------------------
+# Deeper-unrolled WIDE QR panel kernel (round 7)
+# ---------------------------------------------------------------------------
+#
+# ISSUE 3's "deeper-unrolled fused panel base": chol_tile already
+# factors a whole nb tile per invocation with three-level blocking
+# (b → 128-panel → 32-micro → column); this kernel gives the QR panel
+# the same structure so a 64/128-wide base runs as ONE Mosaic program
+# instead of a width recursion over 32-wide bases with XLA gemm
+# aggregation between them (each base call site is a kernel dispatch +
+# fusion boundary; the recursion for a 128-wide panel pays 4 bases +
+# ~6 aggregation gemms). Inside: the column loop is a fori PER
+# 32-micro-block (compile-payload bounded — the round-5 lesson), each
+# column's Householder update masked to the micro lanes only, and the
+# trailing lanes of the panel get ONE compact-WY block update per
+# micro-block (T from the closed form T = D·(I + striu(VᵀV)·D)⁻¹, the
+# unit-triangular inverse by its nilpotent fixed point — all MXU dots,
+# the in-kernel analog of ops/blocked.larft). Unlike the w ≤ 32 base
+# kernel this reassociates the trailing arithmetic (deferral), so it
+# is residual-tested, not bit-parity-tested, against the fori base.
+
+_QR_WIDE_MB = 32
+
+
+def _qr_wide_micro_fori(vr_ref, tau_ref, m0, H, W):
+    """fori over the MB columns of micro-block at lane offset ``m0``;
+    per-column Householder elimination restricted to micro lanes."""
+    f32 = jnp.float32
+    rH1 = jax.lax.broadcasted_iota(jnp.int32, (H, 1), 0)
+    cW1 = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    hp = jax.lax.Precision.HIGHEST
+    hi = m0 + _QR_WIDE_MB
+
+    def body(j, carry):
+        cur = vr_ref[:]
+        col = jnp.sum(jnp.where(cW1 == j, cur, 0.0), axis=1,
+                      keepdims=True)
+        alpha = jnp.sum(jnp.where(rH1 == j, col, 0.0))
+        tail = jnp.where(rH1 > j, col, 0.0)
+        sig = jnp.sum(tail * tail)
+        anorm = jnp.sqrt(alpha * alpha + sig)
+        beta = jnp.where(alpha <= 0, anorm, -anorm)
+        degen = sig == 0.0
+        beta_safe = jnp.where(degen | (beta == 0), jnp.ones((), f32), beta)
+        denom_safe = jnp.where(degen, jnp.ones((), f32), alpha - beta)
+        tau = jnp.where(degen, jnp.zeros((), f32),
+                        (beta - alpha) / beta_safe)
+        scale = 1.0 / denom_safe
+        v = jnp.where(rH1 > j, col * scale, 0.0)
+        v = jnp.where(rH1 == j, jnp.ones((), f32), v)
+        w_row = jax.lax.dot_general(
+            v, cur, (((0,), (0,)), ((), ())),
+            precision=hp, preferred_element_type=f32)     # (1, W)
+        # update masked to THIS micro-block's later lanes only — the
+        # rest of the panel is updated once per block, by compact WY
+        upd = (tau * v) * jnp.where((cW1 > j) & (cW1 < hi), w_row, 0.0)
+        out = cur - upd
+        newcol = jnp.where(rH1 > j, v, col)
+        newcol = jnp.where(rH1 == j, jnp.where(degen, alpha, beta), newcol)
+        vr_ref[:] = jnp.where(cW1 == j, newcol, out)
+        tau_ref[pl.ds(j, 1), :] = jnp.reshape(tau, (1, 1))
+        return carry
+
+    jax.lax.fori_loop(m0, hi, body, 0)
+
+
+def _qr_panel_wide_kernel(a_ref, vr_ref, tau_ref):
+    H, W = a_ref.shape
+    MB = _QR_WIDE_MB
+    f32 = jnp.float32
+    hp = jax.lax.Precision.HIGHEST
+    nt_dims = (((1,), (1,)), ((), ()))   # X @ Yᵀ
+    tn_dims = (((0,), (0,)), ((), ()))   # Xᵀ @ Y
+
+    rHW = jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
+    cHW = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
+    rWW = jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+    cWW = jax.lax.broadcasted_iota(jnp.int32, (W, W), 1)
+    eye_WW = (rWW == cWW).astype(f32)
+
+    vr_ref[:] = a_ref[:]
+    tau_ref[:] = jnp.zeros((W, 1), f32)
+    for mb in range(W // MB):
+        m0 = mb * MB
+        hi = m0 + MB
+        _qr_wide_micro_fori(vr_ref, tau_ref, m0, H, W)
+        if hi >= W:
+            break
+        cur = vr_ref[:]
+        micro_l = (cHW >= m0) & (cHW < hi)
+        # V of this micro-block as a masked (H, W) form (unit lower)
+        vm = jnp.where(micro_l & (rHW > cHW), cur, 0.0)
+        vm = jnp.where(micro_l & (rHW == cHW), 1.0, vm)
+        # T = D·(I + striu(VᵀV)·D)⁻¹ — inverse of the unit-upper
+        # factor by its nilpotent fixed point X ← I − N·X (N strictly
+        # upper within the micro block ⇒ exact after MB iterations)
+        g = jax.lax.dot_general(vm, vm, tn_dims, precision=hp,
+                                preferred_element_type=f32)  # (W, W)
+        tau_row = jnp.transpose(tau_ref[:])                  # (1, W)
+        micro_ww = ((rWW >= m0) & (rWW < hi)
+                    & (cWW >= m0) & (cWW < hi))
+        n_mat = jnp.where(micro_ww & (rWW < cWW), g * tau_row, 0.0)
+        x = eye_WW
+        for _ in range(MB):
+            x = eye_WW - jax.lax.dot_general(
+                n_mat, x, (((1,), (0,)), ((), ())), precision=hp,
+                preferred_element_type=f32)
+        # T = D·X: row-scale the inverse by tau (micro rows live only)
+        t_mat = jnp.where(micro_ww, tau_ref[:] * x, 0.0)
+        # one compact-WY update of the REMAINING lanes:
+        # C ← C − V·(Tᵀ·(Vᵀ·C)) on lanes ≥ hi
+        cmask = jnp.where(cHW >= hi, cur, 0.0)
+        y = jax.lax.dot_general(vm, cmask, tn_dims, precision=hp,
+                                preferred_element_type=f32)  # (W, W)
+        z = jax.lax.dot_general(t_mat, y, tn_dims, precision=hp,
+                                preferred_element_type=f32)
+        upd = jax.lax.dot_general(vm, z, (((1,), (0,)), ((), ())),
+                                  precision=hp,
+                                  preferred_element_type=f32)
+        vr_ref[:] = jnp.where(cHW >= hi, cur - upd, cur)
+
+
+def qr_panel_wide_eligible(h: int, w: int, dtype) -> bool:
+    """Gate for the wide (micro-blocked) QR panel kernel: widths past
+    the w ≤ 32 base up to 128, MB-divisible, within the measured
+    scoped-VMEM cells budget. Shares the SLATE_TPU_PALLAS_QR kill
+    switch with the base kernel."""
+    return _panel_gate(
+        "SLATE_TPU_PALLAS_QR", dtype,
+        _QR_WIDE_MB < w <= 128 and w % _QR_WIDE_MB == 0
+        and h % 8 == 0 and w <= h and h * w <= _PANEL_MAX_CELLS)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def qr_panel_base_wide(a: jax.Array, *, interpret: bool = False):
+    """Householder QR of one WIDE (H, w) panel (32 < w ≤ 128) as ONE
+    micro-blocked Mosaic kernel — same output contract as
+    qr_panel_base. Trailing-lane updates are compact-WY per micro
+    block (reassociated ⇒ tolerance-level, not bit-level, parity with
+    the fori base)."""
+    hh, w = a.shape
+    vr, taus = pl.pallas_call(
+        _qr_panel_wide_kernel,
+        out_shape=(jax.ShapeDtypeStruct((hh, w), a.dtype),
+                   jax.ShapeDtypeStruct((w, 1), a.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(a)
+    return vr, taus[:, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def qr_panel_base(a: jax.Array, *, interpret: bool = False):
     """Householder QR of one (H, w) panel base as ONE Pallas kernel.

@@ -24,6 +24,28 @@ conservative recursion pairing is kept until the new-style shard_map
 is the floor. The fused loop's deferred left swaps would subsume the
 reference's cross-rank pivot-row exchange the same way (the suffix
 gathers become collective-permutes on a mesh).
+
+Round-7 notes. (1) LOOKAHEAD: the default outer loops now pipeline —
+panel k+1 is factored between the next-panel slab and the remainder
+of trailing update k (Options.lookahead; linalg/lu.py). On a mesh
+this is exactly the schedule this module's explicit panel wants to
+overlap with: the panel's collectives (or, on the default GSPMD
+route, the replicated-panel all-gather) carry no data edge to the
+remainder's sharded gemms. (2) BATCHED TOURNAMENT PANELS are the
+multi-chip panel story for LU at scale: CALU's per-round chunk
+factorizations run as ONE batched panel LU
+(ops/blocked.panel_getrf_batched) — on a mesh, sharding the chunk
+batch axis gives each device its own chunk rounds with only the
+pairing exchanges between rounds, the reference's rank-tournament
+(src/getrf_tntpiv.cc) without per-column collectives; the explicit
+per-column maxloc schedule below remains the measured-against
+reference arm. (3) The GSPMD default panel is now fed a REPLICATED
+operand (blocked.replicate_on_grid — the tileBcast analog): bisected
+this round, the pre-0.6 partitioner mis-lowers both the perm-compose
+concatenate (blocked.lift_tail_perm) and the permutation gathers of a
+row-sharded panel — the root causes of the round-6 "mesh getrf at
+nb=64" open item, both now fixed + regression-pinned
+(tests/test_lookahead.py).
 """
 
 from __future__ import annotations

@@ -259,17 +259,60 @@ class Session:
 
     def _factor(self, entry: _Operator) -> _Resident:
         op, A, opts = entry.op, entry.A, entry.opts
-        if op in ("lu", "band_lu"):
-            LU, perm, info = api.lu_factor(A, opts)
-            payload = (LU, perm)
-        elif op in ("chol", "band_chol"):
-            L, info = api.chol_factor(A, opts)
-            payload = (L,)
-        else:  # qr
-            payload = (api.qr_factor(A, opts),)
-            info = 0
+        if op in ("band_lu", "band_chol"):
+            # band factors stay on the eager verbs (PackedBand pipelines
+            # host-side packing the whole-program jit cannot absorb)
+            if op == "band_lu":
+                LU, perm, info = api.lu_factor(A, opts)
+                payload = (LU, perm)
+            else:
+                L, info = api.chol_factor(A, opts)
+                payload = (L,)
+        else:
+            # dense factors run as ONE compiled program (round 7):
+            # warmup() AOT-compiles it per operand shape, so a served
+            # operator's first refactor-on-miss skips tracing AND
+            # compilation — and the program is the LOOKAHEAD pipeline
+            # (entry.opts.lookahead flows into the jitted driver), so
+            # served factors compile the lookahead variant ahead of the
+            # first request (ISSUE 3 satellite).
+            key = self._factor_key(entry)
+            exe = self._compiled.get(key)
+            if exe is not None:
+                self._compiled.move_to_end(key)
+                payload, info = exe(A)
+            else:
+                payload, info = self._factor_fn(entry)(A)
         payload = jax.block_until_ready(payload)
         return _Resident(payload, int(info), _tree_nbytes(payload))
+
+    def _jit_cached(self, jkey: Hashable, make):
+        """LRU-jit-cache shared by the solve and factor programs."""
+        fn = self._jit.get(jkey)
+        if fn is None:
+            fn = self._jit[jkey] = jax.jit(make())
+            while len(self._jit) > self._jit_cap:
+                self._jit.popitem(last=False)
+        else:
+            self._jit.move_to_end(jkey)
+        return fn
+
+    def _compiled_put(self, key: Hashable, exe):
+        """Insert an AOT executable under the shared cap."""
+        self._compiled[key] = exe
+        while len(self._compiled) > self._compiled_cap:
+            self._compiled.popitem(last=False)
+
+    def _factor_fn(self, entry: _Operator):
+        return self._jit_cached(
+            ("factor", entry.op, entry.opts),
+            lambda: _make_factor_fn(entry.op, entry.opts))
+
+    @staticmethod
+    def _factor_key(entry: _Operator) -> Hashable:
+        leaves, treedef = jax.tree_util.tree_flatten(entry.A)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        return ("factor", entry.op, entry.opts, treedef, shapes)
 
     def _evict_to_budget(self, keep: Hashable):
         """Caller holds the lock. Drop LRU entries (never ``keep``)
@@ -357,16 +400,9 @@ class Session:
         return fn(res.payload, B)
 
     def _solve_fn(self, entry: _Operator):
-        jkey = (entry.op, entry.opts)
-        fn = self._jit.get(jkey)
-        if fn is None:
-            fn = self._jit[jkey] = jax.jit(
-                _make_solve_fn(entry.op, entry.opts))
-            while len(self._jit) > self._jit_cap:
-                self._jit.popitem(last=False)
-        else:
-            self._jit.move_to_end(jkey)
-        return fn
+        return self._jit_cached(
+            (entry.op, entry.opts),
+            lambda: _make_solve_fn(entry.op, entry.opts))
 
     @staticmethod
     def _aot_key(entry: _Operator, payload, B) -> Hashable:
@@ -377,16 +413,27 @@ class Session:
     # -- AOT warmup --------------------------------------------------------
 
     def warmup(self, handle: Hashable, nrhs: int = 1):
-        """Ahead-of-time path: factor ``handle`` now (off the request
-        path) and ``jit(...).lower(...).compile()`` the solve for an
-        (rows, nrhs) right-hand side, caching the executable so request-
-        time solves of that bucket skip tracing AND compilation. Dense
-        right-hand sides are tile-padded, so one warmup at nrhs=1 covers
-        every bucket width up to the operator's nb."""
+        """Ahead-of-time path: AOT-compile the whole-factor program
+        (dense operators; the lookahead-pipeline driver — round 7),
+        factor ``handle`` through it now (off the request path), and
+        ``jit(...).lower(...).compile()`` the solve for an
+        (rows, nrhs) right-hand side, caching the executables so
+        request-time refactors AND solves skip tracing and
+        compilation. Dense right-hand sides are tile-padded, so one
+        warmup at nrhs=1 covers every bucket width up to the
+        operator's nb."""
         with self._lock:
             entry = self._ops.get(handle)
             if entry is None:
                 raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op in ("lu", "chol", "qr"):
+                fkey = self._factor_key(entry)
+                if fkey not in self._compiled:
+                    ffn = self._factor_fn(entry)
+                    with self.metrics.phase("serve.warmup"):
+                        self._compiled_put(
+                            fkey, ffn.lower(entry.A).compile())
+                    self.metrics.inc("factor_aot_compiles")
             res = self.factor(handle)
             B = self._wrap_rhs(
                 entry, np.zeros((entry.m, nrhs)))
@@ -395,10 +442,29 @@ class Session:
                 return
             fn = self._solve_fn(entry)
             with self.metrics.phase("serve.warmup"):
-                self._compiled[key] = fn.lower(res.payload, B).compile()
-            while len(self._compiled) > self._compiled_cap:
-                self._compiled.popitem(last=False)
+                self._compiled_put(key, fn.lower(res.payload, B).compile())
             self.metrics.inc("aot_compiles")
+
+
+def _make_factor_fn(op: str, opts: Options):
+    """The dense factor verb as an A -> (payload, info) function — one
+    whole-program jit per (op, opts). opts carries the round-7
+    ``lookahead`` pipeline flag into the compiled driver."""
+    import jax.numpy as jnp
+
+    if op == "lu":
+        def factor(A):
+            LU, perm, info = api.lu_factor(A, opts)
+            return (LU, perm), info
+    elif op == "chol":
+        def factor(A):
+            L, info = api.chol_factor(A, opts)
+            return (L,), info
+    else:
+        def factor(A):
+            return (api.qr_factor(A, opts),), jnp.zeros((), jnp.int32)
+    factor.__name__ = f"serve_{op}_factor"
+    return factor
 
 
 def _make_solve_fn(op: str, opts: Options):

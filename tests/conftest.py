@@ -65,6 +65,12 @@ _fh_log = open(os.path.join(os.path.dirname(__file__),
 faulthandler.enable(file=_fh_log, all_threads=True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: large/expensive cases excluded from the tier-1 "
+        "budget (run explicitly with -m slow)")
+
+
 @pytest.fixture(scope="session")
 def devices():
     d = jax.devices()

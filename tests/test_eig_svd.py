@@ -319,7 +319,11 @@ def test_steqr_native_midsize():
     pure-Python path could not reach in test time."""
     from slate_tpu.linalg.eig import _steqr_native
     rng = np.random.default_rng(3)
-    n = 1200
+    # 800 (from 1200) for the tier-1 budget: the kernel wall time is
+    # Θ(n³)/cores on this 2-core host and the size still sits well past
+    # the old pure-Python ceiling; the convergence/orthogonality
+    # contract is size-independent
+    n = 800
     d = rng.standard_normal(n)
     e = rng.standard_normal(n - 1)
     out = _steqr_native(d, e, True, 60)
@@ -354,18 +358,26 @@ def test_heev_qr_redirects_above_cap(monkeypatch):
         1, np.abs(wref).max())
 
 
-@pytest.mark.parametrize("spectrum", ["graded", "clustered"])
-def test_steqr_torture_graded_clustered_native(spectrum):
+@pytest.mark.parametrize("spectrum,n", [
+    ("graded", 2048), ("clustered", 2048),
+    # the original n=4096 cases ride along outside the tier-1 budget
+    # (the dominant cost is the n=4096 eigvalsh REFERENCE, ~10 s each
+    # on this 2-core host; the convergence property is exercised
+    # identically at 2048 — round-7 wall-time headroom, ISSUE 3)
+    pytest.param("graded", 4096, marks=pytest.mark.slow),
+    pytest.param("clustered", 4096, marks=pytest.mark.slow),
+])
+def test_steqr_torture_graded_clustered_native(spectrum, n):
     """Round-5 steqr numerics (VERDICT r4 weak #6): the reference
     deflation criterion eps^2|d_i||d_{i+1}|+safe_min (parity with
     src/steqr_impl.cc:238-241) + laev2 2x2 closing must CONVERGE on
-    16-decades-graded and on tightly clustered spectra at n=4096 and
-    deliver normwise-backward-stable eigenvalues (|w-wref| <= c*eps*|T|
-    — QR iteration's guarantee; relative accuracy on tiny eigenvalues
-    of graded matrices is not steqr's contract, LAPACK's included)."""
+    16-decades-graded and on tightly clustered spectra at torture
+    sizes and deliver normwise-backward-stable eigenvalues
+    (|w-wref| <= c*eps*|T| — QR iteration's guarantee; relative
+    accuracy on tiny eigenvalues of graded matrices is not steqr's
+    contract, LAPACK's included)."""
     from slate_tpu.linalg.eig import _steqr_native
 
-    n = 4096
     rng = np.random.default_rng(31)
     if spectrum == "graded":
         d = np.logspace(-8, 8, n)
@@ -391,7 +403,10 @@ def test_steqr_torture_python_path():
     recurrence is O(n^2) interpreter-bound) + native/python agreement."""
     from slate_tpu.linalg.eig import _steqr_native, _steqr_py
 
-    n = 512
+    # 384 (from 512) for the tier-1 budget: the Python recurrence is
+    # O(n²) interpreter-bound and the torture property (16-decade
+    # grading + native/python agreement) is size-independent
+    n = 384
     d = np.logspace(-6, 6, n)
     e = 0.25 * np.sqrt(d[:-1] * d[1:])
     w_py, z = _steqr_py(d, e, compute_z=True, max_sweeps=60)

@@ -122,12 +122,11 @@ def test_getrf_pivot_fusion_bit_identical_mesh(grid2x4):
     """Bit-level equivalence must survive the 8-device mesh (the
     deferred-left-swap suffix gathers become collective traffic there),
     and the mesh result must match the 1×1 grid."""
-    # nb=32 like every mesh factorization test here: on this pre-0.6
-    # jax, mesh getrf at (256, nb=64) returns a corrupted perm — at
-    # HEAD before this round too (verified via stash, fused and
-    # materialized arms identically affected; single-device fine) —
-    # the old SPMD partitioner mis-lowering class panel.py documents.
-    # Recorded as an open item in CHANGES.md.
+    # nb=32 keeps this test on the round-6 shape; the (256, nb=64)
+    # corruption recorded here as an open item was ROOT-CAUSED AND
+    # FIXED in round 7 (two pre-0.6 partitioner mis-lowerings:
+    # blocked.lift_tail_perm + blocked.replicate_on_grid) and is
+    # regression-pinned at nb=64 in tests/test_lookahead.py.
     n, nb = 256, 32
     a = _randn(n, n, np.float64)
     Ag = st.from_dense(a, nb=nb, grid=grid2x4)

@@ -312,6 +312,39 @@ def test_executor_warmup_aot():
     assert np.array_equal(x, sess2.solve(h2, b))
 
 
+def test_warmup_compiles_factor_program():
+    """Round 7: warmup AOT-compiles the whole-factor program (the
+    lookahead-pipeline driver) per operand shape, so refactor-on-miss
+    after an eviction reuses the executable — no request-path tracing
+    or compilation."""
+    sess = Session()
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    assert sess.metrics.get("factor_aot_compiles") == 1
+    assert sess.metrics.get("aot_compiles") == 1  # the solve program
+    sess.warmup(h)  # idempotent: same shapes, no recompiles
+    assert sess.metrics.get("factor_aot_compiles") == 1
+    assert sess.evict(h)
+    b = RNG.standard_normal(N)
+    x = sess.solve(h, b)  # refactor-on-miss rides the AOT executable
+    assert np.abs(spd @ x - b).max() < 1e-8
+    assert sess.metrics.get("factors_total") == 2
+
+
+def test_factor_program_bit_identical_warmed_vs_cold():
+    """The AOT factor executable and the on-demand jitted factor are
+    the same program: factors (hence solves) agree bit for bit."""
+    spd = _spd()
+    A = st.hermitian(np.tril(spd), nb=NB, uplo=st.Uplo.Lower)
+    b = RNG.standard_normal(N)
+    warm = Session()
+    hw = warm.register(A, op="chol")
+    warm.warmup(hw)
+    cold = Session()
+    hc = cold.register(A, op="chol")
+    assert np.array_equal(warm.solve(hw, b), cold.solve(hc, b))
+
+
 # -- Metrics ---------------------------------------------------------------
 
 

@@ -18,12 +18,24 @@ panel_fraction is the share a mesh's async scheduler could hide under
 the trailing update (the Lookahead/P3 capability,
 /root/reference/src/potrf.cc:84-195).
 
+Round 7: the iterative loop is the LOOKAHEAD pipeline by default and
+every level's ops carry jax.named_scope labels (potrf_l{k}_tile /
+_panel / _trail_next / _l{k+1}_tile_lookahead / _trail_rest — see
+linalg/cholesky.py::_potrf_iter), so a --trace artifact shows
+per-level panel/trailing timestamps directly: overlap, where the
+backend schedules it, appears as the l{k+1} tile-factor region
+straddling the l{k} trail_rest gemms. --lookahead {0,1} selects the
+schedule; the output also reports the lookahead A/B total
+(panel-hidden vs exposed — the lookahead model's per-level floor is
+max(panel, trailing) instead of their sum).
+
 Optionally captures a jax.profiler trace of ONE full potrf call
 (--trace DIR) for the committed artifact; on a ≥2-device backend the
 trace is the direct overlap evidence (look for all-gather ops running
 concurrently with the trailing-update fusions).
 
 Usage: python tools/profile_potrf.py [n] [nb] [--trace DIR]
+                                     [--lookahead {0,1}]
 Writes one JSON line to stdout; commentary to stderr.
 """
 
@@ -50,8 +62,13 @@ def main():
     ap.add_argument("n", type=int, nargs="?", default=8192)
     ap.add_argument("nb", type=int, nargs="?", default=1024)
     ap.add_argument("--trace", default=None, metavar="DIR")
+    ap.add_argument("--lookahead", type=int, default=1, choices=(0, 1),
+                    help="pipeline schedule for the traced/timed "
+                         "driver (1 = lookahead pipeline, 0 = the "
+                         "sequential round-6 schedule)")
     opts = ap.parse_args()
     n, nb, trace_dir = opts.n, opts.nb, opts.trace
+    lookahead = opts.lookahead
 
     import slate_tpu as st
     from slate_tpu.core.types import Uplo
@@ -68,7 +85,11 @@ def main():
     prec = "high"
 
     def full(a):
-        out, _ = _potrf_iter(a, nb, prec)
+        out, _ = _potrf_iter(a, nb, prec, lookahead)
+        return a + 1e-30 * out
+
+    def full_other(a):
+        out, _ = _potrf_iter(a, nb, prec, 1 - lookahead)
         return a + 1e-30 * out
 
     def tiles_only(a):
@@ -99,24 +120,39 @@ def main():
                 out, out[k1:, k0:k1], k1, nb, prec=prec)
         return a + 1e-30 * out
 
-    res = {"platform": plat, "n": n, "nb": nb, "nt": nt}
+    res = {"platform": plat, "n": n, "nb": nb, "nt": nt,
+           "lookahead": lookahead}
     for name, fn in (("total", full), ("tiles", tiles_only),
                      ("panels", panels_only), ("trailing", trailing_only)):
         t = _per_iter_seconds(lambda c, cs, f=fn: f(c), a0, (), k1=2, k2=6)
         res[f"t_{name}_ms"] = round(t * 1e3, 2)
         print(f"# {name:9s} {t * 1e3:8.2f} ms/iter", file=sys.stderr)
+    # lookahead A/B: the other schedule's total (round 7). The
+    # lookahead model's floor replaces tiles+panels+trailing SUM with
+    # per-level max(panel chain, remainder trailing): hidden_floor
+    # below is that model evaluated from the measured phase terms.
+    t_other = _per_iter_seconds(lambda c, cs: full_other(c), a0, (),
+                                k1=2, k2=6)
+    res[f"t_total_lookahead{1 - lookahead}_ms"] = round(t_other * 1e3, 2)
+    print(f"# total(lookahead={1 - lookahead}) {t_other * 1e3:8.2f} "
+          "ms/iter", file=sys.stderr)
     phase_sum = res["t_tiles_ms"] + res["t_panels_ms"] + res["t_trailing_ms"]
     res["t_phase_sum_ms"] = round(phase_sum, 2)
     res["panel_fraction"] = round(
         (res["t_tiles_ms"] + res["t_panels_ms"]) / max(res["t_total_ms"], 1e-9), 3)
     res["serialization"] = round(res["t_total_ms"] / max(phase_sum, 1e-9), 3)
+    # per-level lookahead floor: panel terms hide under the remainder
+    # trailing (or vice versa) — the exposed schedule pays their sum
+    res["t_lookahead_model_floor_ms"] = round(
+        max(res["t_tiles_ms"] + res["t_panels_ms"], res["t_trailing_ms"]),
+        2)
     gflops = (n ** 3 / 3.0) / 1e9 / (res["t_total_ms"] / 1e3)
     res["potrf_gflops"] = round(gflops, 1)
 
     if trace_dir:
         # trace the JITTED program (eager dispatch would serialize ops
         # host-side and falsely show zero overlap)
-        jit_potrf = jax.jit(lambda x: _potrf_iter(x, nb, prec))
+        jit_potrf = jax.jit(lambda x: _potrf_iter(x, nb, prec, lookahead))
         jax.block_until_ready(jit_potrf(a0))  # warm the compile cache
         with jax.profiler.trace(trace_dir):
             out, info = jit_potrf(a0)
