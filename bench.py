@@ -29,6 +29,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+# model-GFLOP formulas: the one home is the FLOP ledger (ISSUE 4) —
+# bench.py, slate_tpu/tester.py, and runtime/session.py all share it
+from slate_tpu.obs import flops as model_flops
+
 BASELINE_GFLOPS_PER_CHIP = 700.0  # reference SLATE dgemm per-GPU (docs/usage.md)
 
 
@@ -114,7 +118,7 @@ def bench_gemm(n=8192, nb=512, dtype=jnp.float32, precision=None):
         else contextlib.nullcontext()
     with ctx:
         t = _per_iter_seconds(step, B.data, (A, B, C0))
-    return 2.0 * n * n * n / 1e9 / t, t
+    return model_flops.gemm(n, n, n) / 1e9 / t, t
 
 
 def bench_potrf(n=8192, nb=1024, dtype=jnp.float32):
@@ -133,7 +137,7 @@ def bench_potrf(n=8192, nb=1024, dtype=jnp.float32):
         return a_data + 1e-30 * L.data
 
     t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
-    return (n ** 3 / 3.0) / 1e9 / t, t
+    return model_flops.potrf(n) / 1e9 / t, t
 
 
 def bench_getrf(n=8192, nb=1024, dtype=jnp.float32, opts=None):
@@ -153,7 +157,7 @@ def bench_getrf(n=8192, nb=1024, dtype=jnp.float32, opts=None):
         return a_data + 1e-30 * LU.data
 
     t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
-    return (2.0 * n ** 3 / 3.0) / 1e9 / t, t
+    return model_flops.getrf(n) / 1e9 / t, t
 
 
 def bench_getrf_calu(n=8192, nb=1024, dtype=jnp.float32):
@@ -177,7 +181,7 @@ def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32):
         return a_data + 1e-30 * qr.vr
 
     t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
-    return (4.0 * n ** 3 / 3.0) / 1e9 / t, t
+    return model_flops.geqrf(n, n) / 1e9 / t, t
 
 
 # ---------------------------------------------------------------------------
@@ -223,9 +227,9 @@ def bench_heev(n=8192, nb=1024, dtype=jnp.float32):
         "n": n, "nb": nb,
         "values_s": round(t_vals, 4),
         "vectors_s": round(t_vecs, 4),
-        "values_gflops": round((4.0 / 3.0) * n ** 3 / 1e9 / t_vals, 1),
-        "vectors_gflops": round((4.0 / 3.0 + 2.0) * n ** 3 / 1e9 / t_vecs,
-                                1),
+        "values_gflops": round(model_flops.heev(n) / 1e9 / t_vals, 1),
+        "vectors_gflops": round(
+            model_flops.heev(n, vectors=True) / 1e9 / t_vecs, 1),
         "stages_s": {k: round(v, 4) for k, v in stages.items()},
         "dominant_stage": max(stages, key=stages.get),
     }
@@ -259,9 +263,9 @@ def bench_svd(n=8192, nb=1024, dtype=jnp.float32):
         "n": n, "nb": nb,
         "values_s": round(t_vals, 4),
         "vectors_s": round(t_vecs, 4),
-        "values_gflops": round((8.0 / 3.0) * n ** 3 / 1e9 / t_vals, 1),
-        "vectors_gflops": round((8.0 / 3.0 + 4.0) * n ** 3 / 1e9 / t_vecs,
-                                1),
+        "values_gflops": round(model_flops.svd(n, n) / 1e9 / t_vals, 1),
+        "vectors_gflops": round(
+            model_flops.svd(n, n, vectors=True) / 1e9 / t_vecs, 1),
         "stages_s": {k: round(v, 4) for k, v in stages.items()},
         "dominant_stage": max(stages, key=stages.get),
     }

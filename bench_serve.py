@@ -107,6 +107,17 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
+    # exposition-format fixtures alongside the headline artifact
+    # (ISSUE 4): the full Metrics snapshot as JSON and the Prometheus
+    # text rendering a fleet scraper would pull from /metrics — so a
+    # BENCH_SERVE run doubles as a committed example of both formats
+    stem = out_path[:-5] if out_path.endswith(".json") else out_path
+    sess.metrics.to_json(stem + ".metrics.json")
+    from slate_tpu.obs import render_prometheus
+    with open(stem + ".prom", "w") as f:
+        f.write(render_prometheus(snap))
+    print(f"# metrics snapshot -> {stem}.metrics.json, prometheus text "
+          f"-> {stem}.prom", file=sys.stderr)
     print(json.dumps(artifact, sort_keys=True))
     return artifact
 

@@ -81,6 +81,17 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --smoke FAILED")
+    # observability smoke: traced served workload -> Chrome-trace JSON
+    # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
+    # endpoint (tools/obs_dump.py exits nonzero on any export failure)
+    print("=== tools/obs_dump.py --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "tools" / "obs_dump.py"),
+         "--smoke"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! obs_dump --smoke FAILED")
     return fails
 
 

@@ -40,3 +40,4 @@ from .api import (multiply, rank_k_update, rank_2k_update,
                   qr_factor, least_squares_solve_using_factor,
                   least_squares_solve)
 from . import runtime
+from . import obs

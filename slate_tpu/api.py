@@ -6,10 +6,22 @@ band_solve, lu_solve, lu_factor, lu_solve_using_factor, chol_solve,
 chol_factor, chol_solve_using_factor, indefinite_solve,
 least_squares_solve, plus eig/svd entries. Dispatch keys off matrix
 kinds, mirroring the reference's overload sets.
+
+Observability: every verb routes through ``obs.driver`` — the process
+FLOP ledger (obs/flops.py) is credited with the verb's model flops on
+every EAGER call (so ``flops_total`` is monotone whether or not a
+serving Session is involved), and when the default tracer is enabled
+the call body runs inside an ``api.<verb>`` span carrying shape/dtype
+attributes. With tracing off the span machinery allocates nothing.
+Under a ``jax.jit`` trace the hook is a no-op — the trace runs once
+per compiled shape, not per execution — and the executed work is
+credited by the caller that runs the compiled program (the serving
+Session records ``serve.factor``/``serve.solve`` ledger ops).
 """
 
 from __future__ import annotations
 
+from . import obs as _obs
 from .core.exceptions import SlateError
 from .core.tiled_matrix import TiledMatrix
 from .core.types import MatrixKind, Options, Side, DEFAULT_OPTIONS
@@ -17,143 +29,240 @@ from .linalg import (blas3, band as band_mod, cholesky, indefinite, lu as
                      lu_mod, qr as qr_mod)
 from .linalg.band_packed import PackedBand
 
+_flops = _obs.flops
+
 
 def multiply(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
              opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     """C = α·A·B + β·C, dispatching on A/B kind (simplified_api.hh
     multiply → gemm/hemm/symm/gbmm/hbmm)."""
-    if A.kind is MatrixKind.Hermitian:
-        return blas3.hemm(Side.Left, alpha, A, B, beta, C, opts)
-    if B.kind is MatrixKind.Hermitian:
-        return blas3.hemm(Side.Right, alpha, B, A, beta, C, opts)
-    if A.kind is MatrixKind.Symmetric:
-        return blas3.symm(Side.Left, alpha, A, B, beta, C, opts)
-    if B.kind is MatrixKind.Symmetric:
-        return blas3.symm(Side.Right, alpha, B, A, beta, C, opts)
-    if A.kind is MatrixKind.Band:
-        return blas3.gbmm(alpha, A, B, beta, C, opts)
-    if A.kind is MatrixKind.HermitianBand:
-        return blas3.hbmm(Side.Left, alpha, A, B, beta, C, opts)
-    return blas3.gemm(alpha, A, B, beta, C, opts)
+    bw = int(getattr(A, "kl", 0)) + int(getattr(A, "ku", 0))
+    fl = (_flops.band_mm(A.shape[1], B.shape[1], bw)
+          if A.kind in (MatrixKind.Band, MatrixKind.HermitianBand)
+          else _flops.gemm(A.shape[0], B.shape[1], A.shape[1]))
+    with _obs.driver("multiply", fl,
+                     m=A.shape[0], n=B.shape[1], k=A.shape[1],
+                     dtype=str(A.dtype)):
+        if A.kind is MatrixKind.Hermitian:
+            return blas3.hemm(Side.Left, alpha, A, B, beta, C, opts)
+        if B.kind is MatrixKind.Hermitian:
+            return blas3.hemm(Side.Right, alpha, B, A, beta, C, opts)
+        if A.kind is MatrixKind.Symmetric:
+            return blas3.symm(Side.Left, alpha, A, B, beta, C, opts)
+        if B.kind is MatrixKind.Symmetric:
+            return blas3.symm(Side.Right, alpha, B, A, beta, C, opts)
+        if A.kind is MatrixKind.Band:
+            return blas3.gbmm(alpha, A, B, beta, C, opts)
+        if A.kind is MatrixKind.HermitianBand:
+            return blas3.hbmm(Side.Left, alpha, A, B, beta, C, opts)
+        return blas3.gemm(alpha, A, B, beta, C, opts)
 
 
 def rank_k_update(alpha, A: TiledMatrix, beta, C: TiledMatrix,
                   opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    if C.kind is MatrixKind.Hermitian:
-        return blas3.herk(alpha, A, beta, C, opts)
-    return blas3.syrk(alpha, A, beta, C, opts)
+    with _obs.driver("rank_k_update",
+                     _flops.rank_k(C.shape[0], A.shape[1])):
+        if C.kind is MatrixKind.Hermitian:
+            return blas3.herk(alpha, A, beta, C, opts)
+        return blas3.syrk(alpha, A, beta, C, opts)
 
 
 def rank_2k_update(alpha, A: TiledMatrix, B: TiledMatrix, beta,
                    C: TiledMatrix, opts: Options = DEFAULT_OPTIONS
                    ) -> TiledMatrix:
-    if C.kind is MatrixKind.Hermitian:
-        return blas3.her2k(alpha, A, B, beta, C, opts)
-    return blas3.syr2k(alpha, A, B, beta, C, opts)
+    with _obs.driver("rank_2k_update",
+                     _flops.rank_2k(C.shape[0], A.shape[1])):
+        if C.kind is MatrixKind.Hermitian:
+            return blas3.her2k(alpha, A, B, beta, C, opts)
+        return blas3.syr2k(alpha, A, B, beta, C, opts)
 
 
 def triangular_multiply(alpha, A: TiledMatrix, B: TiledMatrix,
                         side: Side = Side.Left,
                         opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    return blas3.trmm(side, alpha, A, B, opts)
+    with _obs.driver("triangular_multiply",
+                     _flops.tri_mm(A.shape[0],
+                                   B.shape[1] if side is Side.Left
+                                   else B.shape[0])):
+        return blas3.trmm(side, alpha, A, B, opts)
 
 
 def triangular_solve(alpha, A: TiledMatrix, B: TiledMatrix,
                      side: Side = Side.Left,
                      opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    k = B.shape[1] if side is Side.Left else B.shape[0]
     if A.kind is MatrixKind.TriangularBand:
-        return blas3.tbsm(side, alpha, A, B, opts)
-    return blas3.trsm(side, alpha, A, B, opts)
+        bw = int(getattr(A, "kl", 0)) + int(getattr(A, "ku", 0))
+        fl = _flops.band_mm(A.shape[0], k, bw)
+    else:
+        fl = _flops.tri_mm(A.shape[0], k)
+    with _obs.driver("triangular_solve", fl):
+        if A.kind is MatrixKind.TriangularBand:
+            return blas3.tbsm(side, alpha, A, B, opts)
+        return blas3.trsm(side, alpha, A, B, opts)
+
+
+def _band_of(A) -> int:
+    """Model bandwidth for the FLOP ledger: kl+ku, or kd for Hermitian
+    bands (``flops.band_factor``'s convention). PackedBand and
+    band-kind TiledMatrix both carry kl/ku; dense operands are 0."""
+    kl, ku = int(getattr(A, "kl", 0)), int(getattr(A, "ku", 0))
+    if (getattr(A, "hermitian", False)
+            or getattr(A, "kind", None) is MatrixKind.HermitianBand):
+        return max(kl, ku)
+    return kl + ku
 
 
 def lu_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
     if isinstance(A, PackedBand):
-        return band_mod.gbtrf(A, opts)
-    return lu_mod.getrf(A, opts)
+        with _obs.driver("lu_factor",
+                         _flops.band_factor(A.n, _band_of(A)),
+                         n=A.n, band=_band_of(A)):
+            return band_mod.gbtrf(A, opts)
+    with _obs.driver("lu_factor", _flops.getrf(A.shape[1]),
+                     m=A.shape[0], n=A.shape[1], nb=A.nb,
+                     dtype=str(A.dtype)):
+        return lu_mod.getrf(A, opts)
 
 
 def lu_solve(A: TiledMatrix, B: TiledMatrix,
              opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    if isinstance(A, PackedBand):
-        X, info = band_mod.gbsv(A, B, opts)
+    if isinstance(A, PackedBand) or A.kind is MatrixKind.Band:
+        n = A.n if isinstance(A, PackedBand) else A.shape[0]
+        fl = (_flops.band_factor(n, _band_of(A))
+              + _flops.solve_flops("band_lu", n, n, B.shape[1],
+                                   band=_band_of(A)))
+        with _obs.driver("lu_solve", fl, n=n):
+            X, info = band_mod.gbsv(A, B, opts)
+            return X
+    n = A.shape[1]
+    fl = _flops.getrf(n) + _flops.solve_flops("lu", n, n, B.shape[1])
+    with _obs.driver("lu_solve", fl, n=n, k=B.shape[1],
+                     dtype=str(A.dtype)):
+        X, info = lu_mod.gesv(A, B, opts)
         return X
-    if A.kind is MatrixKind.Band:
-        X, info = band_mod.gbsv(A, B, opts)
-        return X
-    X, info = lu_mod.gesv(A, B, opts)
-    return X
 
 
 def lu_solve_using_factor(LU, perm, B: TiledMatrix,
                           opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     from .linalg.band_packed import BandLU
+    n, k = B.shape[0], B.shape[1]
     if isinstance(LU, BandLU):
-        return band_mod.gbtrs(LU, perm, B, opts)
-    return lu_mod.getrs(LU, perm, B, opts)
+        with _obs.driver("lu_solve_using_factor",
+                         _flops.solve_flops("band_lu", n, n, k,
+                                            band=LU.kl + LU.ku)):
+            return band_mod.gbtrs(LU, perm, B, opts)
+    with _obs.driver("lu_solve_using_factor",
+                     _flops.solve_flops("lu", n, n, k), n=n, k=k):
+        return lu_mod.getrs(LU, perm, B, opts)
 
 
 def lu_inverse_using_factor(LU, perm, opts: Options = DEFAULT_OPTIONS):
-    return lu_mod.getri(LU, perm, opts)
+    with _obs.driver("lu_inverse_using_factor",
+                     _flops.getri(LU.shape[1])):
+        return lu_mod.getri(LU, perm, opts)
 
 
 def chol_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
     if isinstance(A, PackedBand):
-        return band_mod.pbtrf(A, opts)
+        with _obs.driver("chol_factor",
+                         _flops.band_factor(A.n, _band_of(A)),
+                         n=A.n, band=_band_of(A)):
+            return band_mod.pbtrf(A, opts)
     if A.kind is MatrixKind.HermitianBand:
-        return band_mod.pbtrf(A, opts)
-    return cholesky.potrf(A, opts)
+        with _obs.driver("chol_factor",
+                         _flops.band_factor(A.shape[0], _band_of(A)),
+                         n=A.shape[0], band=_band_of(A)):
+            return band_mod.pbtrf(A, opts)
+    with _obs.driver("chol_factor", _flops.potrf(A.shape[1]),
+                     n=A.shape[1], nb=A.nb, dtype=str(A.dtype)):
+        return cholesky.potrf(A, opts)
 
 
 def chol_solve(A: TiledMatrix, B: TiledMatrix,
                opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
     if isinstance(A, PackedBand):
-        X, _ = band_mod.pbsv(A, B, opts)
-        return X
+        fl = (_flops.band_factor(A.n, _band_of(A))
+              + _flops.solve_flops("band_chol", A.n, A.n, B.shape[1],
+                                   band=_band_of(A)))
+        with _obs.driver("chol_solve", fl, n=A.n):
+            X, _ = band_mod.pbsv(A, B, opts)
+            return X
     if A.kind is MatrixKind.HermitianBand:
-        X, info = band_mod.pbsv(A, B, opts)
+        n = A.shape[0]
+        fl = (_flops.band_factor(n, _band_of(A))
+              + _flops.solve_flops("band_chol", n, n, B.shape[1],
+                                   band=_band_of(A)))
+        with _obs.driver("chol_solve", fl, n=n, band=_band_of(A)):
+            X, info = band_mod.pbsv(A, B, opts)
+            return X
+    n = A.shape[1]
+    fl = _flops.potrf(n) + _flops.solve_flops("chol", n, n, B.shape[1])
+    with _obs.driver("chol_solve", fl, n=n, k=B.shape[1],
+                     dtype=str(A.dtype)):
+        X, info = cholesky.posv(A, B, opts)
         return X
-    X, info = cholesky.posv(A, B, opts)
-    return X
 
 
 def chol_solve_using_factor(L, B: TiledMatrix,
                             opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    n, k = B.shape[0], B.shape[1]
     if isinstance(L, PackedBand):
-        return band_mod.pbtrs(L, B, opts)
-    return cholesky.potrs(L, B, opts)
+        with _obs.driver("chol_solve_using_factor",
+                         _flops.solve_flops("band_chol", n, n, k,
+                                            band=_band_of(L))):
+            return band_mod.pbtrs(L, B, opts)
+    with _obs.driver("chol_solve_using_factor",
+                     _flops.solve_flops("chol", n, n, k), n=n, k=k):
+        return cholesky.potrs(L, B, opts)
 
 
 def chol_inverse_using_factor(L, opts: Options = DEFAULT_OPTIONS):
-    return cholesky.potri(L, opts)
+    with _obs.driver("chol_inverse_using_factor",
+                     _flops.potri(L.shape[1])):
+        return cholesky.potri(L, opts)
 
 
 def band_solve(A: TiledMatrix, B: TiledMatrix,
                opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    if isinstance(A, PackedBand):
-        if A.hermitian:
+    n = A.n if isinstance(A, PackedBand) else A.shape[0]
+    hermitian = (getattr(A, "hermitian", False)
+                 or getattr(A, "kind", None) is MatrixKind.HermitianBand)
+    fl = (_flops.band_factor(n, _band_of(A))
+          + _flops.solve_flops("band_chol" if hermitian else "band_lu",
+                               n, n, B.shape[1], band=_band_of(A)))
+    with _obs.driver("band_solve", fl, n=n, band=_band_of(A)):
+        if isinstance(A, PackedBand):
+            if A.hermitian:
+                X, _ = band_mod.pbsv(A, B, opts)
+            else:
+                X, _ = band_mod.gbsv(A, B, opts)
+            return X
+        if A.kind is MatrixKind.HermitianBand:
             X, _ = band_mod.pbsv(A, B, opts)
-        else:
+            return X
+        if A.kind is MatrixKind.Band:
             X, _ = band_mod.gbsv(A, B, opts)
-        return X
-    if A.kind is MatrixKind.HermitianBand:
-        X, _ = band_mod.pbsv(A, B, opts)
-        return X
-    if A.kind is MatrixKind.Band:
-        X, _ = band_mod.gbsv(A, B, opts)
-        return X
-    raise SlateError("band_solve: A must be a band matrix")
+            return X
+        raise SlateError("band_solve: A must be a band matrix")
 
 
 def indefinite_solve(A: TiledMatrix, B: TiledMatrix,
                      opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    X, info = indefinite.hesv(A, B, opts)
-    return X
+    n = A.shape[1]
+    fl = _flops.hetrf(n) + _flops.solve_flops("lu", n, n, B.shape[1])
+    with _obs.driver("indefinite_solve", fl, n=n, k=B.shape[1]):
+        X, info = indefinite.hesv(A, B, opts)
+        return X
 
 
 def qr_factor(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS):
     """Householder QR factor as a resident object (geqrf). The QR
     analog of lu_factor/chol_factor for the factor-reuse verbs below."""
-    return qr_mod.geqrf(A, opts)
+    with _obs.driver("qr_factor", _flops.geqrf(A.shape[0], A.shape[1]),
+                     m=A.shape[0], n=A.shape[1], nb=A.nb,
+                     dtype=str(A.dtype)):
+        return qr_mod.geqrf(A, opts)
 
 
 def least_squares_solve_using_factor(QR, B: TiledMatrix,
@@ -163,9 +272,15 @@ def least_squares_solve_using_factor(QR, B: TiledMatrix,
     result: X = R⁻¹·(Qᴴ·B)[:n]. Completes the *_solve_using_factor verb
     family (simplified_api.hh pattern) so the serving runtime can keep
     QR operators hot like LU/Cholesky ones."""
-    return qr_mod.gels_using_factor(QR, B, opts)
+    with _obs.driver("least_squares_solve_using_factor",
+                     _flops.solve_flops("qr", QR.m, QR.n, B.shape[1]),
+                     m=QR.m, n=QR.n, k=B.shape[1]):
+        return qr_mod.gels_using_factor(QR, B, opts)
 
 
 def least_squares_solve(A: TiledMatrix, B: TiledMatrix,
                         opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
-    return qr_mod.gels(A, B, opts)
+    with _obs.driver("least_squares_solve",
+                     _flops.gels(A.shape[0], A.shape[1]),
+                     m=A.shape[0], n=A.shape[1], k=B.shape[1]):
+        return qr_mod.gels(A, B, opts)

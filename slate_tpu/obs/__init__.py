@@ -1,0 +1,82 @@
+"""slate_tpu.obs — unified observability layer.
+
+One span model flowing from the simplified-API drivers through the
+serving runtime (Session/Batcher/Executor), exported in formats real
+tools ingest:
+
+* :mod:`.tracing`    — structured spans (trace/span/parent ids,
+  attributes, error status), request-scoped propagation, slow-request
+  log; subsumes ``utils.trace.phase`` (feeds the legacy timers map and
+  SVG timeline on every span finish).
+* :mod:`.export`     — Chrome-trace/Perfetto ``trace_event`` JSON
+  (one lane per thread + one per phase class) with a schema validator.
+* :mod:`.flops`      — the FLOP ledger: every model-GFLOP formula in
+  one module (bench.py, tester.py, and runtime/session.py all import
+  from here) plus the process-wide monotone flop counter the drivers
+  credit.
+* :mod:`.exposition` — Prometheus text rendering of runtime Metrics +
+  an opt-in stdlib-only HTTP endpoint (/metrics, /healthz,
+  /trace.json).
+* :mod:`.merge`      — aligns host spans with ``jax.profiler`` device
+  traces via the ``potrf_l{k}_*``/``geqrf_l{k}_*`` named scopes and
+  computes the measured lookahead-overlap metric (PERF.md round 7's
+  modeled number, measured).
+
+See DESIGN.md "Observability (round 8)" for the reference mapping
+(Trace.hh Block/SVG -> span model + Chrome export; the global timers
+map / --timer-level -> Metrics histograms / Prometheus text).
+"""
+
+from . import flops
+from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
+from .exposition import ObsServer, render_prometheus
+from .merge import lookahead_overlap, merge_traces
+from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
+
+__all__ = [
+    "NOOP_SPAN", "ObsServer", "Span", "Tracer", "chrome_trace",
+    "default_tracer", "flops", "lookahead_overlap", "merge_traces",
+    "render_prometheus", "validate_chrome_trace", "write_chrome_trace",
+]
+
+
+_trace_state_clean = None
+
+
+def _jax_eager() -> bool:
+    """True when we are executing eagerly (NOT inside a jax trace).
+    Driver calls re-executed by ``jax.jit`` tracing (the serving
+    Session's compiled factor/solve programs call api.* verbs inside
+    jit) must credit NOTHING: the trace runs once per compiled shape,
+    not per execution — crediting there would freeze the ledger at
+    ~one call per shape and record compile durations as spans. The
+    probe resolves lazily so importing obs never imports jax."""
+    global _trace_state_clean
+    if _trace_state_clean is None:
+        try:
+            from jax.core import trace_state_clean as tsc
+        except ImportError:
+            try:
+                from jax._src.core import trace_state_clean as tsc
+            except ImportError:  # unknown jax: assume eager (pre-existing
+                tsc = lambda: True  # noqa: E731 — behavior, never worse)
+        _trace_state_clean = tsc
+    return _trace_state_clean()
+
+
+def driver(name: str, flops_value: float = 0.0, **attrs):
+    """Driver-entry hook used by api.py: credits the process FLOP
+    ledger on every EAGER call (flops_total stays monotone with
+    tracing off) and opens an ``api.<name>`` span when the default
+    tracer is on. Under a jax trace it is a no-op (see ``_jax_eager``);
+    work executed through compiled programs is credited by its caller
+    — the serving Session records its executed factor/solve flops as
+    ``serve.factor``/``serve.solve`` ledger ops."""
+    if not _jax_eager():
+        return NOOP_SPAN
+    if flops_value:
+        flops.LEDGER.record(name, flops_value)
+    t = default_tracer()
+    if not t.enabled:
+        return NOOP_SPAN
+    return t.span(f"api.{name}", **attrs)

@@ -1,0 +1,169 @@
+"""Metrics exposition: Prometheus text format + stdlib HTTP endpoint.
+
+``render_prometheus`` turns a ``runtime.Metrics`` snapshot (counters +
+histograms + derived gauges) and the process FLOP ledger into the
+Prometheus text exposition format (version 0.0.4 — the format every
+fleet scraper ingests). ``ObsServer`` is the opt-in serving endpoint:
+a stdlib-only (http.server) threaded listener with
+
+* ``GET /metrics``    — Prometheus text of the bound Metrics + ledger
+* ``GET /healthz``    — liveness JSON ({"status": "ok", uptime, ...})
+* ``GET /trace.json`` — Chrome-trace JSON of the bound Tracer's spans
+
+No third-party dependency, daemon threads only, ephemeral port by
+default (``port=0``) so tests and co-located sessions never collide.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import flops as flops_mod
+from .export import chrome_trace
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _san(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def render_prometheus(snapshot, prefix: str = "slate_tpu",
+                      ledger: Optional["flops_mod.FlopLedger"] = None
+                      ) -> str:
+    """Metrics snapshot (or a Metrics instance) -> Prometheus text.
+
+    Counters render as ``counter``; histograms as ``summary`` (count,
+    sum, p50/p99 quantiles) with ``_min``/``_max`` gauges beside them
+    (omitted while empty — see Histogram.snapshot's null contract);
+    derived ratios as ``gauge``. ``ledger=None`` binds the process
+    ledger; pass ``ledger=False``-y explicitly off with a fresh one."""
+    if hasattr(snapshot, "snapshot"):
+        snapshot = snapshot.snapshot()
+    if ledger is None:
+        ledger = flops_mod.LEDGER
+    elif not ledger:  # explicit falsy (False/0): no ledger section
+        ledger = None
+    lines = []
+
+    def emit(name, value, mtype=None, labels=""):
+        if mtype:
+            lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{labels} {_num(value)}")
+
+    emit(f"{prefix}_uptime_seconds", snapshot.get("uptime_s", 0.0), "gauge")
+    for k in sorted(snapshot.get("counters", {})):
+        emit(f"{prefix}_{_san(k)}", snapshot["counters"][k], "counter")
+    for k in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][k]
+        base = f"{prefix}_{_san(k)}"
+        lines.append(f"# TYPE {base} summary")
+        lines.append(f'{base}{{quantile="0.5"}} {_num(h.get("p50", 0.0))}')
+        lines.append(f'{base}{{quantile="0.99"}} {_num(h.get("p99", 0.0))}')
+        lines.append(f"{base}_sum {_num(h.get('sum', 0.0))}")
+        lines.append(f"{base}_count {_num(h.get('count', 0))}")
+        # min/max are None for an empty histogram (indistinguishability
+        # fix, runtime/metrics.py) — omit rather than fake a 0.0
+        for stat in ("min", "max", "mean"):
+            v = h.get(stat)
+            if v is not None:
+                emit(f"{base}_{stat}", v, "gauge")
+    for k in sorted(snapshot.get("derived", {})):
+        emit(f"{prefix}_{_san(k)}", snapshot["derived"][k], "gauge")
+    if ledger is not None:
+        snap = ledger.snapshot()
+        emit(f"{prefix}_driver_flops_total", snap["flops_total"], "counter")
+        if snap["per_op"]:
+            lines.append(f"# TYPE {prefix}_driver_flops counter")
+            for op in sorted(snap["per_op"]):
+                lines.append(f'{prefix}_driver_flops{{op="{_san(op)}"}} '
+                             f'{_num(snap["per_op"][op])}')
+    return "\n".join(lines) + "\n"
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the bound ObsServer is attached to the server object
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        obs: "ObsServer" = self.server.obs  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = render_prometheus(obs.metrics, ledger=obs.ledger)
+            self._reply(200, body, "text/plain; version=0.0.4")
+        elif path == "/healthz":
+            snap = obs.metrics.snapshot()
+            body = json.dumps({
+                "status": "ok",
+                "uptime_s": snap.get("uptime_s", 0.0),
+                "solves_total": snap.get("counters", {}).get(
+                    "solves_total", 0.0),
+                "tracing": bool(obs.tracer is not None
+                                and obs.tracer.enabled),
+            }) + "\n"
+            self._reply(200, body, "application/json")
+        elif path == "/trace.json":
+            spans = obs.tracer.spans() if obs.tracer is not None else []
+            body = json.dumps(chrome_trace(spans)) + "\n"
+            self._reply(200, body, "application/json")
+        else:
+            self._reply(404, "not found\n", "text/plain")
+
+    def _reply(self, code: int, body: str, ctype: str):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet: scrapes are high-frequency
+        pass
+
+
+class ObsServer:
+    """Opt-in observability endpoint over one Metrics (+Tracer).
+
+    Binds 127.0.0.1 by an ephemeral port by default; ``url()`` gives
+    the scrape target. Serving runs on a daemon thread; ``close()``
+    shuts it down (also a context manager)."""
+
+    def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
+                 port: int = 0, ledger=None):
+        self.metrics = metrics
+        self.tracer = tracer
+        self.ledger = ledger if ledger is not None else flops_mod.LEDGER
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs = self  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="slate-tpu-obs-http", daemon=True)
+        self._thread.start()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

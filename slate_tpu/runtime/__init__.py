@@ -4,8 +4,13 @@ The serving layer over the simplified-API verbs: a Session keeps
 factored operators hot in an HBM-budget LRU cache, a Batcher coalesces
 same-shape solve requests into one stacked dispatch, an Executor gives
 an async submit/future front end with AOT warmup and bounded retry, and
-Metrics exports counters + latency percentiles as JSON. See
-DESIGN.md ("Serving runtime") and bench_serve.py for the measured win.
+Metrics exports counters + latency percentiles as JSON and Prometheus
+text. Observability (slate_tpu.obs): enable ``session.tracer`` for a
+request-scoped span tree per served solve (batch → request /
+solve → factor / dispatch / block) exportable as Chrome-trace JSON, and
+``session.serve_obs()`` for the /metrics, /healthz, /trace.json HTTP
+endpoint. See DESIGN.md ("Serving runtime", "Observability") and
+bench_serve.py for the measured win.
 """
 
 from .batching import Batcher

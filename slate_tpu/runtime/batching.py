@@ -26,6 +26,7 @@ from typing import Dict, Hashable, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.tracing import NOOP_SPAN as _NOOP_SPAN
 from .session import Session
 
 
@@ -35,6 +36,9 @@ class _Request:
     vector: bool           # original rank (reshape on completion)
     future: Future
     t_submit: float
+    # obs span, opened at dispatch (parent: the batch span) and closed
+    # at future resolution; None while tracing is off or pre-dispatch
+    span: object = None
 
 
 BucketKey = Tuple[Hashable, Tuple[int, ...], str]
@@ -112,29 +116,65 @@ class Batcher:
         futures are left pending so the caller can retry (see Executor).
         Idempotent over futures: already-done (resolved on an earlier
         attempt, or client-cancelled) requests are skipped, so a retry
-        only covers what is still unresolved."""
+        only covers what is still unresolved.
+
+        Tracing: the batch span is the trace ROOT — N requests meet in
+        one dispatch, and a tree has one root, so the per-request spans
+        are parented onto the batch span (their queue wait rides along
+        as the ``queue_s`` attribute, their end is future resolution);
+        the Session's solve/factor/dispatch spans nest under the batch
+        span via the contextvar scope."""
         handle = key[0]
         live = [r for r in reqs if not r.future.done()]
         if not live:
             return
-        stacked = np.concatenate([r.b for r in live], axis=1)
-        x = self.session.solve(handle, stacked)
-        m = self.session.metrics
-        m.inc("batches_total")
-        m.observe("batch_size", float(len(live)))
-        done = time.monotonic()
-        col = 0
-        for r in live:
-            w = r.b.shape[1]
-            xi = x[:, col:col + w]
-            col += w
+        tr = self.session.tracer
+        now = time.monotonic()
+        bctx = (tr.span("serve.batch", handle=repr(handle),
+                        batch_size=len(live), shape=list(key[1]),
+                        dtype=key[2]) if tr.enabled else _NOOP_SPAN)
+        with bctx as bspan:
+            for r in live:
+                # None unless this attempt re-runs a bucket whose spans
+                # the Executor already closed (errored attempt) — each
+                # attempt gets spans nested in ITS batch span
+                if r.span is None:
+                    r.span = tr.start_span(
+                        "serve.request", parent=bspan, kind="request",
+                        handle=repr(handle), shape=list(r.b.shape),
+                        dtype=key[2], queue_s=now - r.t_submit)
             try:
-                r.future.set_result(xi[:, 0] if r.vector else xi)
-            except InvalidStateError:
-                # client cancelled between our done() check and here
-                m.inc("cancelled_requests")
-                continue
-            m.observe("request_latency", done - r.t_submit)
+                stacked = np.concatenate([r.b for r in live], axis=1)
+                x = self.session.solve(handle, stacked)
+            except Exception as e:
+                # close this attempt's request spans INSIDE the batch
+                # scope: the exception is about to close the batch span
+                # via bctx.__exit__, and children ending after their
+                # parent fail the Chrome-trace nesting validator
+                for r in live:
+                    tr.finish_span(r.span, error=e)
+                raise
+            m = self.session.metrics
+            m.inc("batches_total")
+            m.observe("batch_size", float(len(live)))
+            done = time.monotonic()
+            col = 0
+            for r in live:
+                w = r.b.shape[1]
+                xi = x[:, col:col + w]
+                col += w
+                try:
+                    r.future.set_result(xi[:, 0] if r.vector else xi)
+                except InvalidStateError:
+                    # client cancelled between our done() check and here
+                    m.inc("cancelled_requests")
+                    tr.finish_span(r.span, cancelled=True)
+                    continue
+                lat = done - r.t_submit
+                m.observe("request_latency", lat)
+                # total_s (submit -> resolve) is what the slow-request
+                # log thresholds on — the client-visible latency
+                tr.finish_span(r.span, total_s=lat)
 
     def flush(self):
         """Synchronously dispatch everything pending (caller's thread)."""

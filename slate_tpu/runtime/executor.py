@@ -131,8 +131,26 @@ class Executor:
         failure (flaky tunnel, interrupted transfer). SlateError is
         deterministic — unknown handle, factorization info≠0 — and
         fails fast without retrying or touching the retries metric
-        (DESIGN.md: retry covers dispatch, not numerical failure)."""
+        (DESIGN.md: retry covers dispatch, not numerical failure).
+
+        Error capture (obs): a failed attempt's request spans are
+        closed with the exception (status="error") by Batcher.run —
+        inside the batch span's scope, so the exported tree stays
+        properly nested — and each attempt opens fresh spans, so a
+        retried request shows one errored span per failed attempt plus
+        the final one."""
         from ..core.exceptions import SlateError
+
+        tr = self.session.tracer
+
+        def _fail_spans(e, attempt):
+            for r in reqs:
+                # Batcher.run already closed spans it opened (finish is
+                # idempotent); this covers spans from a partial stack /
+                # pre-dispatch failure, and detaches for the retry
+                tr.finish_span(getattr(r, "span", None), error=e,
+                               attempt=attempt)
+                r.span = None  # the next attempt opens a fresh span
 
         err: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
@@ -141,9 +159,11 @@ class Executor:
                 return
             except SlateError as e:
                 err = e
+                _fail_spans(e, attempt)
                 break
             except Exception as e:  # noqa: BLE001 — failed futures carry it
                 err = e
+                _fail_spans(e, attempt)
                 if attempt < self.retries:
                     self.session.metrics.inc("retries")
         self.session.metrics.inc("failed_batches")

@@ -6,7 +6,8 @@ trace timeline; a serving runtime needs the inference-stack versions of
 those: monotonically increasing counters (solves, cache hits/misses,
 evictions), latency histograms with percentile readout (p50/p99), and
 derived rates (solves/sec, GFLOP/s, cache hit-rate) — exported as JSON
-so a fleet scraper can ingest them.
+and as Prometheus text (``to_prometheus`` / the obs HTTP endpoint's
+/metrics route) so a fleet scraper can ingest them.
 
 Phases are recorded through ``utils.trace.phase`` so every runtime
 measurement also lands in the existing Trace SVG timeline and the coarse
@@ -58,12 +59,17 @@ class Histogram:
         return s[idx]
 
     def snapshot(self) -> Dict[str, float]:
+        # min/max are None (JSON null) while empty: a fabricated 0.0
+        # would be indistinguishable from a real zero-latency sample
+        # (and `max: 0.0` read as "slowest observation was 0") — the
+        # Prometheus renderer omits the null gauges entirely
+        empty = self.count == 0
         return {
             "count": self.count,
             "sum": self.total,
-            "min": self.vmin if self.count else 0.0,
-            "max": self.vmax,
-            "mean": self.total / self.count if self.count else 0.0,
+            "min": None if empty else self.vmin,
+            "max": None if empty else self.vmax,
+            "mean": None if empty else self.total / self.count,
             "p50": self.percentile(50),
             "p99": self.percentile(99),
         }
@@ -104,10 +110,16 @@ class Metrics:
                 h = self._hists[name] = Histogram()
             h.observe(value)
 
-    def phase(self, name: str, hist: Optional[str] = None):
+    def phase(self, name: str, hist: Optional[str] = None,
+              tracer=None, **attrs):
         """Context manager: a trace phase whose elapsed time also lands
-        in histogram ``hist`` (default: same name)."""
-        return _MetricPhase(self, name, hist or name)
+        in histogram ``hist`` (default: same name). With a ``tracer``
+        (obs.tracing.Tracer) that is enabled, the phase is recorded as
+        a structured SPAN instead — which itself feeds the legacy
+        timers map and SVG timeline on finish, so no view is lost —
+        with ``attrs`` attached; when tracing is off the span path
+        costs one attribute check and no allocation."""
+        return _MetricPhase(self, name, hist or name, tracer, attrs)
 
     # -- derived views -----------------------------------------------------
 
@@ -175,26 +187,56 @@ class Metrics:
                 f.write(text + "\n")
         return text
 
+    def to_prometheus(self, path: Optional[str] = None) -> str:
+        """Prometheus text exposition of the snapshot (plus the process
+        FLOP ledger) — the /metrics payload; see obs/exposition.py."""
+        from ..obs.exposition import render_prometheus
+        text = render_prometheus(self.snapshot())
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
 
 class _MetricPhase:
-    """trace.phase that feeds its elapsed time into a Metrics histogram."""
+    """trace.phase that feeds its elapsed time into a Metrics histogram
+    — upgraded to a structured span when an enabled obs Tracer is
+    bound (the span's finish bridges back to the legacy views)."""
 
-    __slots__ = ("_metrics", "_hist", "_phase")
+    __slots__ = ("_metrics", "_hist", "_phase", "_span_ctx", "_span",
+                 "elapsed")
 
-    def __init__(self, metrics: Metrics, name: str, hist: str):
+    def __init__(self, metrics: Metrics, name: str, hist: str,
+                 tracer=None, attrs=None):
         self._metrics = metrics
         self._hist = hist
-        self._phase = trace.phase(name)
+        self.elapsed = 0.0
+        if tracer is not None and tracer.enabled:
+            self._phase = None
+            self._span_ctx = tracer.span(name, **(attrs or {}))
+        else:
+            self._phase = trace.phase(name)
+            self._span_ctx = None
 
     def __enter__(self):
-        self._phase.__enter__()
+        if self._span_ctx is not None:
+            self._span = self._span_ctx.__enter__()
+        else:
+            self._phase.__enter__()
         return self
 
     @property
-    def elapsed(self) -> float:
-        return self._phase.elapsed
+    def span(self):
+        """The live span (None on the legacy path) — for attaching
+        attributes discovered mid-phase (cache hit, batch size)."""
+        return getattr(self, "_span", None)
 
     def __exit__(self, *exc):
-        self._phase.__exit__(*exc)
-        self._metrics.observe(self._hist, self._phase.elapsed)
+        if self._span_ctx is not None:
+            self._span_ctx.__exit__(*exc)
+            self.elapsed = self._span.duration or 0.0
+        else:
+            self._phase.__exit__(*exc)
+            self.elapsed = self._phase.elapsed
+        self._metrics.observe(self._hist, self.elapsed)
         return False

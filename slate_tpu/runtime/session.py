@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from collections import OrderedDict
-from typing import Any, Dict, Hashable, Optional, Tuple
+from typing import Any, Dict, Hashable, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +33,12 @@ from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense
 from ..core.types import MatrixKind, Options, DEFAULT_OPTIONS
 from ..linalg.band_packed import PackedBand
+# model-GFLOP formulas live in the ledger (obs/flops.py) — one home
+# shared with bench.py and tester.py instead of a private copy here
+from ..obs.flops import LEDGER as _LEDGER
+from ..obs.flops import factor_flops as _factor_flops
+from ..obs.flops import solve_flops as _solve_flops
+from ..obs.tracing import Tracer, default_tracer
 from .metrics import Metrics
 
 # operator kinds a Session can keep resident
@@ -47,25 +54,6 @@ def _tree_nbytes(payload) -> int:
             nbytes = int(np.asarray(leaf).nbytes)
         total += int(nbytes)
     return total
-
-
-def _factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
-    if op == "lu":
-        return 2.0 / 3.0 * n ** 3
-    if op == "chol":
-        return 1.0 / 3.0 * n ** 3
-    if op == "qr":
-        return 2.0 * m * n * n - 2.0 / 3.0 * n ** 3
-    # band factorizations: O(n · band²)
-    return 2.0 * n * band * band if band else 2.0 * n
-
-
-def _solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
-    if op in ("lu", "chol"):
-        return 2.0 * n * n * k
-    if op == "qr":
-        return (4.0 * m * n - 2.0 * n * n) * k
-    return 4.0 * n * band * k if band else 4.0 * n * k
 
 
 @dataclasses.dataclass
@@ -107,10 +95,19 @@ class Session:
 
     def __init__(self, hbm_budget: Optional[int] = None,
                  opts: Options = DEFAULT_OPTIONS,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None):
         self.hbm_budget = hbm_budget
         self.opts = opts
         self.metrics = metrics or Metrics()
+        # request-scoped tracing: disabled by default (the shared
+        # default tracer starts off) — zero spans, no per-solve cost
+        # beyond one enabled-flag check per phase
+        self.tracer = tracer or default_tracer()
+        # per-shape compile observability (Session.warmup + refactor-on-
+        # miss): [{op, what, shape, lower_s, compile_s}, ...]
+        self.compile_log: List[dict] = []
+        self._obs_server = None
         self._lock = threading.RLock()
         self._ops: Dict[Hashable, _Operator] = {}
         self._cache: "OrderedDict[Hashable, _Resident]" = OrderedDict()
@@ -237,12 +234,25 @@ class Session:
                 self.metrics.inc("cache_hits")
                 return res
             self.metrics.inc("cache_misses")
-            with self.metrics.phase("serve.factor", "factor_latency"):
+            # attrs built only when tracing is on: the disabled path
+            # must not allocate per solve (ISSUE 4 acceptance)
+            fattrs = (self._span_attrs(entry, handle)
+                      if self.tracer.enabled else {})
+            with self.metrics.phase("serve.factor", "factor_latency",
+                                    tracer=self.tracer, **fattrs):
                 res = self._factor(entry)
             self.metrics.inc("factors_total")
             fl = _factor_flops(entry.op, entry.m, entry.n, entry.band)
             self.metrics.inc("flops_total", fl)
             self.metrics.inc("factor_flops_total", fl)
+            # executed work credits the PROCESS ledger here (the api.*
+            # verbs inside the compiled factor program only run at
+            # trace time and deliberately credit nothing — obs.driver).
+            # Band factors are the exception: _factor runs them through
+            # the EAGER api verbs, whose driver hook already credited
+            # the ledger — crediting serve.factor too would double-count
+            if entry.op not in ("band_lu", "band_chol"):
+                _LEDGER.record("serve.factor", fl)
             self._cache[handle] = res
             self._evict_to_budget(keep=handle)
             return res
@@ -287,9 +297,13 @@ class Session:
         return _Resident(payload, int(info), _tree_nbytes(payload))
 
     def _jit_cached(self, jkey: Hashable, make):
-        """LRU-jit-cache shared by the solve and factor programs."""
+        """LRU-jit-cache shared by the solve and factor programs. A
+        miss means the next call pays tracing (+compilation unless an
+        AOT executable covers the shape) on the request path — counted
+        so a serving fleet can alarm on jit-cache churn."""
         fn = self._jit.get(jkey)
         if fn is None:
+            self.metrics.inc("jit_cache_misses")
             fn = self._jit[jkey] = jax.jit(make())
             while len(self._jit) > self._jit_cap:
                 self._jit.popitem(last=False)
@@ -334,6 +348,19 @@ class Session:
 
     # -- solve -------------------------------------------------------------
 
+    def _span_attrs(self, entry: _Operator, handle: Hashable) -> dict:
+        """Span attributes for one operator: op, shape, dtype, nb,
+        lookahead, handle — the vocabulary the ISSUE fixes."""
+        A = entry.A
+        dtype = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+        return {
+            "op": entry.op, "m": entry.m, "n": entry.n,
+            "nb": getattr(A, "nb", entry.band),
+            "dtype": str(dtype),
+            "lookahead": getattr(entry.opts, "lookahead", 0),
+            "handle": repr(handle),
+        }
+
     def solve_matrix(self, handle: Hashable, B: TiledMatrix) -> TiledMatrix:
         """Solve with the resident factor; B is a TiledMatrix (dense
         ops) or a padded dense array (band ops). Returns the TiledMatrix
@@ -342,20 +369,33 @@ class Session:
             entry = self._ops[handle] if handle in self._ops else None
             if entry is None:
                 raise SlateError(f"Session: unknown handle {handle!r}")
+            hit = handle in self._cache  # before factor() counts it
             res = self.factor(handle)
             if res.info != 0:
                 raise SlateError(
                     f"Session: operator {handle!r} factorization failed "
                     f"(info={res.info})")
             k = int(B.shape[1])
-            with self.metrics.phase("serve.solve", "solve_latency"):
-                X = self._dispatch(entry, res, B)
-                X = jax.block_until_ready(X)
+            tr = self.tracer
+            sattrs = (dict(self._span_attrs(entry, handle), k=k,
+                           cache_hit=hit) if tr.enabled else {})
+            with self.metrics.phase("serve.solve", "solve_latency",
+                                    tracer=tr, **sattrs):
+                # dispatch (trace/launch) and device-block are split
+                # sub-spans so a trace shows where the latency sits
+                with tr.span("serve.dispatch"):
+                    X = self._dispatch(entry, res, B)
+                with tr.span("serve.block"):
+                    X = jax.block_until_ready(X)
             self.metrics.inc("solves_total", k)
             self.metrics.inc("dispatches_total")
             fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
             self.metrics.inc("flops_total", fl)
             self.metrics.inc("solve_flops_total", fl)
+            # executed work credits the PROCESS ledger here (the api.*
+            # verbs inside the compiled solve program only run at trace
+            # time and deliberately credit nothing — obs.driver)
+            _LEDGER.record("serve.solve", fl)
             return X
 
     def solve(self, handle: Hashable, b) -> np.ndarray:
@@ -430,9 +470,9 @@ class Session:
                 fkey = self._factor_key(entry)
                 if fkey not in self._compiled:
                     ffn = self._factor_fn(entry)
-                    with self.metrics.phase("serve.warmup"):
-                        self._compiled_put(
-                            fkey, ffn.lower(entry.A).compile())
+                    self._compiled_put(
+                        fkey, self._aot_compile(
+                            "factor", entry, handle, ffn, (entry.A,)))
                     self.metrics.inc("factor_aot_compiles")
             res = self.factor(handle)
             B = self._wrap_rhs(
@@ -441,9 +481,59 @@ class Session:
             if key in self._compiled:
                 return
             fn = self._solve_fn(entry)
-            with self.metrics.phase("serve.warmup"):
-                self._compiled_put(key, fn.lower(res.payload, B).compile())
+            self._compiled_put(
+                key, self._aot_compile("solve", entry, handle, fn,
+                                       (res.payload, B)))
             self.metrics.inc("aot_compiles")
+
+    def _aot_compile(self, what: str, entry: _Operator, handle: Hashable,
+                     fn, args: Tuple):
+        """``jit(...).lower(...).compile()`` with compile-time
+        observability: the trace+lower and compile stages are timed
+        separately into ``warmup_lower_latency`` /
+        ``warmup_compile_latency`` histograms and appended per shape to
+        ``Session.compile_log`` — the numbers a serving fleet needs to
+        budget warmup and alarm on recompiles."""
+        with self.metrics.phase("serve.warmup", tracer=self.tracer,
+                                stage=what,
+                                **self._span_attrs(entry, handle)):
+            t0 = time.perf_counter()
+            lowered = fn.lower(*args)
+            t1 = time.perf_counter()
+            exe = lowered.compile()
+            t2 = time.perf_counter()
+        self.metrics.observe("warmup_lower_latency", t1 - t0)
+        self.metrics.observe("warmup_compile_latency", t2 - t1)
+        leaves = jax.tree_util.tree_leaves(args)
+        self.compile_log.append({
+            "op": entry.op, "what": what,
+            "shape": [tuple(getattr(l, "shape", ())) for l in leaves],
+            "lower_s": t1 - t0, "compile_s": t2 - t1,
+        })
+        return exe
+
+    # -- observability endpoint --------------------------------------------
+
+    def serve_obs(self, host: str = "127.0.0.1", port: int = 0):
+        """Opt-in observability HTTP endpoint for THIS session
+        (stdlib-only): /metrics (Prometheus text), /healthz,
+        /trace.json (Chrome trace of the session's tracer). Returns
+        the ObsServer (``.url()`` gives the scrape target); idempotent
+        — a second call returns the running server."""
+        with self._lock:
+            if self._obs_server is None:
+                from ..obs.exposition import ObsServer
+                self._obs_server = ObsServer(self.metrics,
+                                             tracer=self.tracer,
+                                             host=host, port=port)
+            return self._obs_server
+
+    def close_obs(self):
+        """Shut down the observability endpoint, if started."""
+        with self._lock:
+            srv, self._obs_server = self._obs_server, None
+        if srv is not None:
+            srv.close()
 
 
 def _make_factor_fn(op: str, opts: Options):

@@ -45,6 +45,11 @@ import numpy as np
 
 DEFAULT_TOL = 3.0
 
+# model-GFLOP formulas come from the central FLOP ledger (obs/flops.py
+# — shared with bench.py and runtime/session.py); `_fl(name)` is the
+# tester's (m, n) signature over that table, `_fl2` adapts ad-hoc rows
+from .obs.flops import tester_model as _fl
+
 _REGISTRY: Dict[str, Callable] = {}
 _TOLS: Dict[str, float] = {}
 
@@ -248,7 +253,7 @@ def _prod_err(ctx, got, ref, lhs, rhs):
 
 # -- BLAS-3 -----------------------------------------------------------------
 
-@register("gemm", flops=lambda m, n: 2.0 * m * m * n)
+@register("gemm", flops=_fl("gemm"))
 def _t_gemm(ctx):
     import slate_tpu as st
     import jax
@@ -280,7 +285,7 @@ def _t_gemm(ctx):
     return secs, err
 
 
-@register("symm", flops=lambda m, n: 2.0 * n * n * n)
+@register("symm", flops=_fl("symm"))
 def _t_symm(ctx):
     import slate_tpu as st
     import jax
@@ -302,7 +307,7 @@ def _t_symm(ctx):
     return secs, err
 
 
-@register("hemm", flops=lambda m, n: 2.0 * n * n * n)
+@register("hemm", flops=_fl("hemm"))
 def _t_hemm(ctx):
     import slate_tpu as st
     import jax
@@ -353,14 +358,14 @@ def _rank_k(ctx, routine):
 
 
 for _r in ("syrk", "herk"):
-    register(_r, flops=lambda m, n: n * n * n)(
+    register(_r, flops=_fl("syrk"))(
         lambda ctx, _r=_r: _rank_k(ctx, _r))
 for _r in ("syr2k", "her2k"):
-    register(_r, flops=lambda m, n: 2.0 * n * n * n)(
+    register(_r, flops=_fl("syr2k"))(
         lambda ctx, _r=_r: _rank_k(ctx, _r))
 
 
-@register("trmm", flops=lambda m, n: n * n * n)
+@register("trmm", flops=_fl("trmm"))
 def _t_trmm(ctx):
     import slate_tpu as st
     import jax
@@ -376,7 +381,7 @@ def _t_trmm(ctx):
     return secs, err
 
 
-@register("trsm", flops=lambda m, n: n * n * n)
+@register("trsm", flops=_fl("trsm"))
 def _t_trsm(ctx):
     import slate_tpu as st
     import jax
@@ -391,7 +396,7 @@ def _t_trsm(ctx):
     return secs, err
 
 
-@register("trtri", flops=lambda m, n: n * n * n / 3.0)
+@register("trtri", flops=_fl("trtri"))
 def _t_trtri(ctx):
     import slate_tpu as st
     import jax
@@ -442,7 +447,7 @@ for _r in ("genorm", "henorm", "trnorm"):
 
 # -- Cholesky family --------------------------------------------------------
 
-@register("potrf", flops=lambda m, n: n ** 3 / 3.0)
+@register("potrf", flops=_fl("potrf"))
 def _t_potrf(ctx):
     import slate_tpu as st
     import jax
@@ -462,7 +467,7 @@ def _t_potrf(ctx):
     return secs, err
 
 
-@register("posv", flops=lambda m, n: n ** 3 / 3.0)
+@register("posv", flops=_fl("posv"))
 def _t_posv(ctx):
     import slate_tpu as st
     import jax
@@ -475,7 +480,7 @@ def _t_posv(ctx):
     return secs, _solve_err(ctx, a, out.to_numpy(), b)
 
 
-@register("potri", flops=lambda m, n: 2 * n ** 3 / 3.0)
+@register("potri", flops=_fl("potri"))
 def _t_potri(ctx):
     import slate_tpu as st
     import jax
@@ -491,7 +496,7 @@ def _t_potri(ctx):
     return secs, err
 
 
-@register("posv_mixed", flops=lambda m, n: n ** 3 / 3.0, tol=30)
+@register("posv_mixed", flops=_fl("posv_mixed"), tol=30)
 def _t_posv_mixed(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -503,7 +508,7 @@ def _t_posv_mixed(ctx):
     return secs, _solve_err(ctx, a, X.to_numpy(), b)
 
 
-@register("posv_mixed_gmres", flops=lambda m, n: n ** 3 / 3.0, tol=30)
+@register("posv_mixed_gmres", flops=_fl("posv_mixed_gmres"), tol=30)
 def _t_posv_gmres(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -517,7 +522,7 @@ def _t_posv_gmres(ctx):
 
 # -- LU family --------------------------------------------------------------
 
-@register("getrf", flops=lambda m, n: 2 * n ** 3 / 3.0)
+@register("getrf", flops=_fl("getrf"))
 def _t_getrf(ctx):
     import slate_tpu as st
     import jax
@@ -550,9 +555,9 @@ def _lu_solver_case(ctx, solver, **kw):
     return secs, _solve_err(ctx, a, out.to_numpy(), b)
 
 
-register("gesv", flops=lambda m, n: 2 * n ** 3 / 3.0)(
+register("gesv", flops=_fl("gesv"))(
     lambda ctx: _lu_solver_case(ctx, lambda st, A, B: st.gesv(A, B)[0]))
-@register("gesv_nopiv", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)
+@register("gesv_nopiv", flops=_fl("gesv_nopiv"), tol=30)
 def _t_gesv_nopiv(ctx):
     """No pivoting on a random matrix: growth is unbounded by design,
     so the residual is normalized by the REALIZED growth ‖L‖‖U‖/‖A‖
@@ -576,7 +581,7 @@ def _t_gesv_nopiv(ctx):
     (X, LU), secs = ctx.timed(solve)
     err = _solve_err(ctx, a, X.to_numpy(), b) / _lu_growth(LU, a)
     return secs, err
-register("gesv_rbt", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)(
+register("gesv_rbt", flops=_fl("gesv_rbt"), tol=30)(
     lambda ctx: _lu_solver_case(
         ctx, lambda st, A, B: st.gesv_rbt(A, B)[0]))
 def _gesv_calu(st, A, B):
@@ -584,17 +589,17 @@ def _gesv_calu(st, A, B):
     return st.gesv(A, B, Options(method_lu=MethodLU.CALU))[0]
 
 
-register("gesv_tntpiv", flops=lambda m, n: 2 * n ** 3 / 3.0)(
+register("gesv_tntpiv", flops=_fl("gesv_tntpiv"))(
     lambda ctx: _lu_solver_case(ctx, _gesv_calu))
-register("gesv_mixed", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)(
+register("gesv_mixed", flops=_fl("gesv_mixed"), tol=30)(
     lambda ctx: _lu_solver_case(
         ctx, lambda st, A, B: st.gesv_mixed(A, B)[0]))
-register("gesv_mixed_gmres", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)(
+register("gesv_mixed_gmres", flops=_fl("gesv_mixed_gmres"), tol=30)(
     lambda ctx: _lu_solver_case(
         ctx, lambda st, A, B: st.gesv_mixed_gmres(A, B)[0]))
 
 
-@register("getri", flops=lambda m, n: 2 * n ** 3)
+@register("getri", flops=_fl("getri"))
 def _t_getri(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -612,7 +617,7 @@ def _t_getri(ctx):
 # -- QR / LS ----------------------------------------------------------------
 
 @register("geqrf", tol=30,  # orthogonality |QᴴQ−I|/(ε·m) sits ~5-10
-          flops=lambda m, n: 2 * m * n * n - 2 * n ** 3 / 3.0)
+          flops=_fl("geqrf"))
 def _t_geqrf(ctx):
     import slate_tpu as st
     import jax
@@ -633,7 +638,7 @@ def _t_geqrf(ctx):
 
 
 @register("gelqf", tol=30,
-          flops=lambda m, n: 2 * m * m * n - 2 * m ** 3 / 3.0)
+          flops=_fl("gelqf"))
 def _t_gelqf(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -649,7 +654,7 @@ def _t_gelqf(ctx):
     return secs, err
 
 
-@register("cholqr", tol=30, flops=lambda m, n: 2 * m * n * n)
+@register("cholqr", tol=30, flops=_fl("cholqr"))
 def _t_cholqr(ctx):
     import slate_tpu as st
     m = max(ctx.m, 2 * ctx.n)
@@ -666,7 +671,7 @@ def _t_cholqr(ctx):
     return secs, err_f
 
 
-@register("gels", flops=lambda m, n: 2 * m * n * n)
+@register("gels", flops=_fl("gels"))
 def _t_gels(ctx):
     import slate_tpu as st
     m, n = max(ctx.m, ctx.n), ctx.n
@@ -686,7 +691,7 @@ def _t_gels(ctx):
 
 # -- eigen / svd ------------------------------------------------------------
 
-@register("heev", flops=lambda m, n: 4 * n ** 3 / 3.0)
+@register("heev", flops=_fl("heev"))
 def _t_heev(ctx):
     import slate_tpu as st
     import jax
@@ -704,7 +709,7 @@ def _t_heev(ctx):
     return secs, err
 
 
-@register("heev_2stage", flops=lambda m, n: 9 * n ** 3)
+@register("heev_2stage", flops=_fl("heev_2stage"))
 def _t_heev_2stage(ctx):
     """Two-stage stage-1 (he2hb + hb2td bulge chase, round 3)."""
     import slate_tpu as st
@@ -750,7 +755,7 @@ def _t_hb2td(ctx):
     return secs, err
 
 
-@register("heev_vec", flops=lambda m, n: 9 * n ** 3)
+@register("heev_vec", flops=_fl("heev_vec"))
 def _t_heev_vec(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -766,7 +771,7 @@ def _t_heev_vec(ctx):
     return secs, max(res, orth)
 
 
-@register("hegv", flops=lambda m, n: 9 * n ** 3, tol=30)
+@register("hegv", flops=_fl("hegv"), tol=30)
 def _t_hegv(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -784,7 +789,7 @@ def _t_hegv(ctx):
     return secs, res
 
 
-@register("svd", flops=lambda m, n: 8 * m * n * n / 3.0)
+@register("svd", flops=_fl("svd"))
 def _t_svd(ctx):
     import slate_tpu as st
     import jax
@@ -798,7 +803,7 @@ def _t_svd(ctx):
     return secs, err
 
 
-@register("svd_vec", flops=lambda m, n: 9 * n ** 3)
+@register("svd_vec", flops=_fl("svd_vec"))
 def _t_svd_vec(ctx):
     import slate_tpu as st
     m, n = ctx.m, ctx.n
@@ -876,7 +881,7 @@ def _t_bdsqr(ctx):
 
 # -- indefinite / band / condest -------------------------------------------
 
-@register("hesv", flops=lambda m, n: n ** 3 / 3.0, tol=30)
+@register("hesv", flops=_fl("hesv"), tol=30)
 def _t_hesv(ctx):
     import slate_tpu as st
     import jax.numpy as jnp
@@ -1178,7 +1183,7 @@ def _t_potrs(ctx):
     return secs, _solve_err(ctx, a, out.to_numpy(), b)
 
 
-@register("hetrf", flops=lambda m, n: n ** 3 / 3.0, tol=30)
+@register("hetrf", flops=_fl("hesv"), tol=30)
 def _t_hetrf(ctx):
     import slate_tpu as st
     import jax.numpy as jnp
@@ -1255,7 +1260,7 @@ def _t_hegst(ctx):
     return secs, err
 
 
-@register("trtrm", flops=lambda m, n: n ** 3 / 3.0)
+@register("trtrm", flops=_fl("trtri"))
 def _t_trtrm(ctx):
     import slate_tpu as st
     n = ctx.n
@@ -1477,7 +1482,7 @@ def _t_tsqr(ctx):
 #    measured under the sweep; the reference's test.cc registers method
 #    sweeps the same way)
 
-@register("gemm_a", flops=lambda m, n: 2.0 * m * m * n)
+@register("gemm_a", flops=_fl("gemm"))
 def _t_gemm_a(ctx):
     """Stationary-A gemm (MethodGemm.A — reduce instead of bcast)."""
     import slate_tpu as st
@@ -1496,7 +1501,7 @@ def _t_gemm_a(ctx):
     return secs, err
 
 
-@register("gemm_summa", flops=lambda m, n: 2.0 * m * m * n)
+@register("gemm_summa", flops=_fl("gemm"))
 def _t_gemm_summa(ctx):
     """Explicit hand-scheduled SUMMA (MethodGemm.SUMMA, shard_map)."""
     import slate_tpu as st
@@ -1547,7 +1552,7 @@ register("trsm_a")(_t_trsm_a)
 register("trsm_b")(_t_trsm_b)
 
 
-@register("hemm_a", flops=lambda m, n: 2.0 * n * n * n)
+@register("hemm_a", flops=_fl("hemm"))
 def _t_hemm_a(ctx):
     """Stationary-A hemm (MethodHemm.A — the listReduce analog)."""
     import slate_tpu as st
@@ -1569,7 +1574,7 @@ def _t_hemm_a(ctx):
     return secs, err
 
 
-@register("gels_cholqr", flops=lambda m, n: 2 * m * n * n, tol=30)
+@register("gels_cholqr", flops=_fl("gels"), tol=30)
 def _t_gels_cholqr(ctx):
     """MethodGels.CholQR (reference gels_cholqr.cc path)."""
     import slate_tpu as st
@@ -1588,7 +1593,7 @@ def _t_gels_cholqr(ctx):
     return secs, err
 
 
-@register("heev_qr", flops=lambda m, n: 4 * n ** 3 / 3.0)
+@register("heev_qr", flops=_fl("heev"))
 def _t_heev_qr(ctx):
     """MethodEig.QR (native steqr tridiagonal stage)."""
     import slate_tpu as st
@@ -1604,7 +1609,7 @@ def _t_heev_qr(ctx):
     return secs, err
 
 
-@register("gesv_calu", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)
+@register("gesv_calu", flops=_fl("gesv"), tol=30)
 def _t_gesv_calu(ctx):
     """MethodLU.CALU: tournament-pivoted LU (round-5 mesh-breadth row —
     the reference sweeps CALU under mpirun, test/run_tests.py)."""
@@ -1614,7 +1619,7 @@ def _t_gesv_calu(ctx):
                                       Options(method_lu=MethodLU.CALU))[0])
 
 
-@register("gesv_dist_panel", flops=lambda m, n: 2 * n ** 3 / 3.0)
+@register("gesv_dist_panel", flops=_fl("gesv"))
 def _t_gesv_dist_panel(ctx):
     """lu_dist_panel: the explicit shard_map distributed-panel path."""
     from slate_tpu.core.types import Options
@@ -1623,7 +1628,7 @@ def _t_gesv_dist_panel(ctx):
                                       Options(lu_dist_panel=True))[0])
 
 
-@register("gesv_threshold", flops=lambda m, n: 2 * n ** 3 / 3.0, tol=30)
+@register("gesv_threshold", flops=_fl("gesv"), tol=30)
 def _t_gesv_threshold(ctx):
     """pivot_threshold < 1: tournament panels (PivotThreshold analog)."""
     from slate_tpu.core.types import Options
@@ -1632,7 +1637,7 @@ def _t_gesv_threshold(ctx):
                                       Options(pivot_threshold=0.5))[0])
 
 
-@register("hesv_rbt", flops=lambda m, n: n ** 3 / 3.0, tol=30)
+@register("hesv_rbt", flops=_fl("hesv"), tol=30)
 def _t_hesv_rbt(ctx):
     """MethodHesv.RBT: butterfly + no-pivot LDLH + IR."""
     import jax.numpy as jnp

@@ -16,6 +16,7 @@ reference ships. No MPI gather is needed (single host process per slice).
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -34,17 +35,25 @@ class Event:
 
 
 class Trace:
-    """Global trace registry (reference: static members of trace::Trace)."""
+    """Global trace registry (reference: static members of trace::Trace).
+
+    Thread-safe: the serving runtime records phases from the Executor
+    worker thread while the submitting threads record their own —
+    ``record`` appends under a class lock (a bare ``list.append`` is
+    atomic in CPython today, but ``clear``/``finish`` snapshotting
+    concurrently with appends is not, and the GIL is not a spec)."""
 
     enabled: bool = False
     _events: List[Event] = []
     _t0: Optional[float] = None
+    _lock = threading.Lock()
 
     @classmethod
     def on(cls):
-        cls.enabled = True
-        if cls._t0 is None:
-            cls._t0 = time.perf_counter()
+        with cls._lock:
+            cls.enabled = True
+            if cls._t0 is None:
+                cls._t0 = time.perf_counter()
 
     @classmethod
     def off(cls):
@@ -52,32 +61,41 @@ class Trace:
 
     @classmethod
     def clear(cls):
-        cls._events = []
-        cls._t0 = time.perf_counter()
+        with cls._lock:
+            cls._events = []
+            cls._t0 = time.perf_counter()
 
     @classmethod
     def record(cls, name: str, start: float, stop: float, lane: int = 0):
-        cls._events.append(Event(name, start, stop, lane))
+        with cls._lock:
+            cls._events.append(Event(name, start, stop, lane))
+
+    @classmethod
+    def events(cls) -> List[Event]:
+        """Consistent snapshot of the recorded events."""
+        with cls._lock:
+            return list(cls._events)
 
     @classmethod
     def finish(cls, path: str = None) -> Optional[str]:
         """Write the SVG timeline (Trace::finish analog,
         src/auxiliary/Trace.cc:330-446). Returns the path."""
-        if not cls._events:
+        events = cls.events()
+        if not events:
             return None
         if path is None:
             path = f"trace_{int(time.time())}.svg"
-        t0 = min(e.start for e in cls._events)
-        t1 = max(e.stop for e in cls._events)
+        t0 = min(e.start for e in events)
+        t1 = max(e.stop for e in events)
         span = max(t1 - t0, 1e-9)
-        lanes = sorted({e.lane for e in cls._events})
-        names = sorted({e.name for e in cls._events})
+        lanes = sorted({e.lane for e in events})
+        names = sorted({e.name for e in events})
         color = {n: _COLORS[i % len(_COLORS)] for i, n in enumerate(names)}
         W, row_h, pad = 1000.0, 24.0, 4.0
         H = len(lanes) * (row_h + pad) + 60
         parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
                  f'height="{H + 20 * len(names)}">']
-        for e in cls._events:
+        for e in events:
             x = (e.start - t0) / span * W
             w = max((e.stop - e.start) / span * W, 0.5)
             y = lanes.index(e.lane) * (row_h + pad)
@@ -123,6 +141,15 @@ class Block:
 
 # coarse per-phase timers (reference: global `timers` map, src/heev.cc)
 timers: Dict[str, float] = collections.defaultdict(float)
+_timers_lock = threading.Lock()
+
+
+def add_timer(name: str, dur: float) -> None:
+    """Thread-safe accumulate into ``timers``: the Executor worker and
+    submitting threads both land here, and ``timers[k] += d`` is a
+    load-add-store interleaving hazard without the lock."""
+    with _timers_lock:
+        timers[name] += dur
 
 
 class phase:
@@ -148,7 +175,7 @@ class phase:
         self.elapsed = stop - self.start
         if Trace.enabled:
             Trace.record(self.name, self.start, stop, self.lane)
-        timers[self.name] += self.elapsed
+        add_timer(self.name, self.elapsed)
         return False
 
 
@@ -163,7 +190,7 @@ class timer:
         return self
 
     def __exit__(self, *exc):
-        timers[self.name] += time.perf_counter() - self.start
+        add_timer(self.name, time.perf_counter() - self.start)
         return False
 
 

@@ -1,0 +1,563 @@
+"""Observability layer (slate_tpu.obs): span model, Chrome-trace
+export + schema validation, FLOP ledger, Prometheus exposition, HTTP
+endpoint, device-trace merger, and the satellite fixes (Trace lock,
+Histogram empty-snapshot nulls).
+
+Reference analog: include/slate/internal/Trace.hh Block/SVG grown into
+structured spans + trace_event export; the tester's --timer-level
+timers map grown into Metrics histograms + Prometheus text. Fast: the
+jax-touching tests use one tiny (n=32, nb=16) LU operator; everything
+else is pure-host.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import obs
+from slate_tpu.obs import flops as model_flops
+from slate_tpu.obs.tracing import Tracer
+from slate_tpu.runtime import Batcher, Executor, Metrics, Session
+from slate_tpu.utils import trace as legacy_trace
+
+RNG = np.random.default_rng(23)
+N, NB = 32, 16
+
+
+def _lu_session(tracer=None):
+    sess = Session(tracer=tracer)
+    a = RNG.standard_normal((N, N)) + N * np.eye(N)
+    h = sess.register(st.from_dense(a, nb=NB), op="lu")
+    return sess, h, a
+
+
+# -- span model -------------------------------------------------------------
+
+
+def test_zero_spans_when_tracing_disabled():
+    """Acceptance: with tracing disabled the runtime records zero
+    spans (the span() fast path hands out one shared no-op object)."""
+    tracer = Tracer()  # disabled by default
+    assert tracer.span("anything") is obs.NOOP_SPAN  # no allocation
+    sess, h, a = _lu_session(tracer=tracer)
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=0)
+    assert tracer.spans() == []
+
+
+def test_span_tree_through_batcher_coalescing():
+    """Acceptance: a served solve yields a CONNECTED span tree —
+    batched request spans share the batch span as parent; the
+    factor/solve (and dispatch/block) spans nest under the batch."""
+    tracer = Tracer().on()
+    sess, h, a = _lu_session(tracer=tracer)
+    batcher = Batcher(sess, max_batch=8, max_wait=10.0)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=0)
+    spans = tracer.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    (batch,) = by_name["serve.batch"]
+    reqs = by_name["serve.request"]
+    assert len(reqs) == 4
+    # the satellite contract: batched request spans share the batch
+    # span as parent (and its trace id)
+    assert all(r.parent_id == batch.span_id for r in reqs)
+    assert all(r.trace_id == batch.trace_id for r in reqs)
+    assert all(r.kind == "request" for r in reqs)
+    assert all("queue_s" in r.attrs and "total_s" in r.attrs for r in reqs)
+    # factor + solve nest under the batch; dispatch/block under solve
+    (solve,) = by_name["serve.solve"]
+    (factor,) = by_name["serve.factor"]
+    assert solve.parent_id == batch.span_id
+    assert factor.parent_id == batch.span_id
+    assert by_name["serve.dispatch"][0].parent_id == solve.span_id
+    assert by_name["serve.block"][0].parent_id == solve.span_id
+    # attribute vocabulary (op, shape, dtype, nb, cache hit/miss, handle)
+    assert solve.attrs["op"] == "lu" and solve.attrs["n"] == N
+    assert solve.attrs["nb"] == NB and solve.attrs["cache_hit"] is False
+    assert "lookahead" in solve.attrs and "handle" in solve.attrs
+    # connectedness: one root (the batch), every parent resolves
+    ids = {s.span_id for s in spans}
+    roots = [s for s in spans if s.parent_id is None]
+    assert roots == [batch]
+    assert all(s.parent_id in ids for s in spans if s.parent_id is not None)
+
+
+def test_chrome_trace_schema_valid():
+    tracer = Tracer().on()
+    sess, h, a = _lu_session(tracer=tracer)
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    for _ in range(2):
+        batcher.submit(h, RNG.standard_normal(N))
+    batcher.flush()
+    obj = obs.chrome_trace(tracer.spans())
+    assert obs.validate_chrome_trace(obj) == []
+    xev = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert xev, "no events exported"
+    # required keys + monotone ts, re-checked directly (not only via
+    # the validator under test)
+    for e in xev:
+        for k in ("ph", "ts", "dur", "pid", "tid", "name", "args"):
+            assert k in e
+    ts = [e["ts"] for e in xev]
+    assert ts == sorted(ts)
+    # both views: a thread lane (pid 0) and a phase-class lane (pid 1)
+    assert {e["pid"] for e in xev} == {0, 1}
+    # round-trips through json
+    assert obs.validate_chrome_trace(json.loads(json.dumps(obj))) == []
+
+
+def test_chrome_trace_validator_catches_violations():
+    good = {"ph": "X", "ts": 1.0, "dur": 2.0, "pid": 0, "tid": 0,
+            "name": "a", "args": {"span_id": 1, "parent_id": None}}
+    assert obs.validate_chrome_trace({"traceEvents": [good]}) == []
+    missing = {k: v for k, v in good.items() if k != "dur"}
+    assert obs.validate_chrome_trace({"traceEvents": [missing]})
+    non_monotone = [dict(good, ts=5.0), dict(good, ts=1.0)]
+    assert any("monotone" in e for e in
+               obs.validate_chrome_trace({"traceEvents": non_monotone}))
+    # child escaping its parent's interval
+    parent = dict(good, args={"span_id": 1, "parent_id": None})
+    child = dict(good, ts=2.0, dur=10.0,
+                 args={"span_id": 2, "parent_id": 1})
+    assert any("nested" in e for e in
+               obs.validate_chrome_trace({"traceEvents": [parent, child]}))
+
+
+def test_error_capture_and_slow_request_log():
+    tracer = Tracer(slow_threshold=0.0).on()  # everything is "slow"
+    sess, h, a = _lu_session(tracer=tracer)
+    with Executor(sess, max_batch=4, max_wait=1e-3, retries=0) as ex:
+        ok = ex.submit(h, RNG.standard_normal(N))
+        assert ok.result(timeout=60).shape == (N,)
+        bad = ex.submit("ghost", RNG.standard_normal(N))
+        with pytest.raises(Exception):
+            bad.result(timeout=60)
+    spans = tracer.spans()
+    errored = [s for s in spans if s.status == "error"]
+    assert errored, "failed dispatch recorded no error spans"
+    assert any("unknown handle" in (s.error or "") for s in errored)
+    # the slow-request log captured the (threshold-0) request spans
+    assert len(tracer.slow_log) >= 1
+    assert all(s.kind == "request" for s in tracer.slow_log)
+
+
+def test_span_bridges_to_legacy_timers_and_svg(tmp_path):
+    """The span model subsumes utils.trace.phase: finishing a span
+    feeds the coarse timers map and (when Trace is on) the SVG."""
+    tracer = Tracer().on()
+    legacy_trace.Trace.clear()
+    legacy_trace.Trace.on()
+    try:
+        before = legacy_trace.timers.get("obs.bridge", 0.0)
+        with tracer.span("obs.bridge"):
+            time.sleep(0.002)
+        assert legacy_trace.timers["obs.bridge"] > before
+        assert any(e.name == "obs.bridge"
+                   for e in legacy_trace.Trace.events())
+        path = legacy_trace.Trace.finish(str(tmp_path / "t.svg"))
+        assert path and "obs.bridge" in open(path).read()
+    finally:
+        legacy_trace.Trace.off()
+        legacy_trace.Trace.clear()
+
+
+# -- satellite: Trace thread-safety -----------------------------------------
+
+
+def test_trace_record_thread_safe_under_concurrent_writers():
+    """Two threads hammer Trace.record (as Executor worker + main do)
+    while a third snapshots/clears: no lost events in the final tally,
+    no exceptions from mutation-during-iteration."""
+    legacy_trace.Trace.clear()
+    legacy_trace.Trace.on()
+    try:
+        per_thread = 2000
+        errs = []
+
+        def writer(lane):
+            try:
+                for i in range(per_thread):
+                    legacy_trace.Trace.record(f"w{lane}", float(i),
+                                              float(i) + 0.5, lane)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        def reader():
+            try:
+                for _ in range(200):
+                    legacy_trace.Trace.events()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(2)] + [threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        assert len(legacy_trace.Trace.events()) == 2 * per_thread
+    finally:
+        legacy_trace.Trace.off()
+        legacy_trace.Trace.clear()
+
+
+# -- satellite: Histogram empty snapshot ------------------------------------
+
+
+def test_histogram_empty_snapshot_reports_null_min_max():
+    """Empty histogram: min/max/mean are None (JSON null), NOT 0.0 —
+    a real zero-latency sample must stay distinguishable."""
+    m = Metrics()
+    m._hists["empty"] = __import__(
+        "slate_tpu.runtime.metrics", fromlist=["Histogram"]).Histogram()
+    snap = m.snapshot()["histograms"]["empty"]
+    assert snap["count"] == 0
+    assert snap["min"] is None and snap["max"] is None
+    assert snap["mean"] is None
+    # ...and survives JSON round-trip as null
+    assert json.loads(json.dumps(snap))["max"] is None
+    # a REAL 0.0 sample is distinguishable from emptiness
+    m.observe("real", 0.0)
+    real = m.snapshot()["histograms"]["real"]
+    assert real["min"] == 0.0 and real["max"] == 0.0 and real["count"] == 1
+
+
+# -- FLOP ledger ------------------------------------------------------------
+
+
+def test_flop_ledger_centralizes_model_formulas():
+    # the formulas the three call sites used to duplicate
+    assert model_flops.potrf(100) == 100 ** 3 / 3.0
+    assert model_flops.getrf(100) == 2 * 100 ** 3 / 3.0
+    assert model_flops.geqrf(200, 100) == 2 * 200 * 100 ** 2 - 2 * 100 ** 3 / 3
+    assert model_flops.gemm(2, 3, 4) == 48
+    assert model_flops.heev(10) == pytest.approx(4 / 3 * 1000)
+    assert model_flops.heev(10, vectors=True) == pytest.approx(
+        (4 / 3 + 2) * 1000)
+    assert model_flops.svd(10, 10) == pytest.approx(8 / 3 * 1000)
+    # the session accounting entry points
+    assert model_flops.factor_flops("chol", 64, 64) == 64 ** 3 / 3.0
+    assert model_flops.solve_flops("lu", 64, 64, 3) == 2 * 64 * 64 * 3
+    assert model_flops.solve_flops("qr", 96, 48, 2) == (
+        4 * 96 * 48 - 2 * 48 * 48) * 2
+    # the tester's (m, n) table agrees with the canonical functions
+    assert model_flops.tester_model("potrf")(64, 64) == model_flops.potrf(64)
+    assert model_flops.tester_model("gemm")(8, 4) == 2.0 * 8 * 8 * 4
+
+
+def test_driver_calls_increment_process_ledger():
+    ledger = model_flops.LEDGER
+    base = ledger.snapshot()
+    a = RNG.standard_normal((N, N)) + N * np.eye(N)
+    A = st.from_dense(a, nb=NB)
+    LU, perm, info = st.lu_factor(A)
+    X = st.lu_solve_using_factor(
+        LU, perm, st.from_dense(RNG.standard_normal((N, 2)), nb=NB))
+    snap = ledger.snapshot()
+    assert snap["flops_total"] >= base["flops_total"] + model_flops.getrf(N)
+    got = (snap["per_op"].get("lu_factor", 0.0)
+           - base["per_op"].get("lu_factor", 0.0))
+    assert got == pytest.approx(model_flops.getrf(N))
+    got = (snap["per_op"].get("lu_solve_using_factor", 0.0)
+           - base["per_op"].get("lu_solve_using_factor", 0.0))
+    assert got == pytest.approx(model_flops.solve_flops("lu", N, N, 2))
+    # gflops_report joins the ledger against the phase timers map
+    rep = ledger.gflops_report({"api.lu_factor": 1.0})
+    assert rep["per_op"]["lu_factor"]["gflops"] is not None
+
+
+# -- Prometheus + HTTP endpoint ---------------------------------------------
+
+
+def _fake_metrics():
+    m = Metrics()
+    m.inc("solves_total", 5)
+    m.inc("cache_hits", 3)
+    m.inc("cache_misses", 2)
+    for v in (0.01, 0.02, 0.03):
+        m.observe("solve_latency", v)
+    return m
+
+
+def test_prometheus_rendering():
+    text = obs.render_prometheus(_fake_metrics())
+    assert "# TYPE slate_tpu_solves_total counter" in text
+    assert "slate_tpu_solves_total 5.0" in text
+    assert 'slate_tpu_solve_latency{quantile="0.5"} 0.02' in text
+    assert "slate_tpu_solve_latency_count 3" in text
+    assert "slate_tpu_solve_latency_sum" in text
+    assert "slate_tpu_cache_hit_rate 0.6" in text
+    assert "slate_tpu_driver_flops_total" in text
+    # exposition-format discipline: every non-comment line is
+    # "name{labels} value"
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+        assert name[0].isalpha()
+    # empty histograms render no min/max (the null contract)
+    m = Metrics()
+    from slate_tpu.runtime.metrics import Histogram
+    m._hists["empty"] = Histogram()
+    text = obs.render_prometheus(m)
+    assert "empty_min" not in text and "empty_max" not in text
+    assert "slate_tpu_empty_count 0" in text
+
+
+def test_http_endpoint_serves_metrics_healthz_trace():
+    tracer = Tracer().on()
+    with tracer.span("serve.solve", op="lu"):
+        pass
+    m = _fake_metrics()
+    with obs.ObsServer(m, tracer=tracer) as srv:
+        body = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=10).read().decode()
+        assert "slate_tpu_solves_total 5.0" in body
+        health = json.loads(urllib.request.urlopen(
+            srv.url("/healthz"), timeout=10).read().decode())
+        assert health["status"] == "ok" and health["tracing"] is True
+        tr = json.loads(urllib.request.urlopen(
+            srv.url("/trace.json"), timeout=10).read().decode())
+        assert obs.validate_chrome_trace(tr) == []
+        assert any(e.get("name") == "serve.solve"
+                   for e in tr["traceEvents"])
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url("/nope"), timeout=10)
+
+
+def test_session_serve_obs_endpoint():
+    sess, h, a = _lu_session()
+    sess.solve(h, RNG.standard_normal(N))
+    srv = sess.serve_obs()
+    try:
+        assert srv is sess.serve_obs()  # idempotent
+        body = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=10).read().decode()
+        assert "slate_tpu_solves_total 1.0" in body
+        assert "slate_tpu_jit_cache_misses" in body
+    finally:
+        sess.close_obs()
+
+
+# -- compile-time observability ---------------------------------------------
+
+
+def test_warmup_records_compile_observability():
+    sess, h, a = _lu_session()
+    sess.warmup(h)
+    snap = sess.metrics.snapshot()
+    assert snap["counters"]["jit_cache_misses"] >= 2  # factor + solve
+    lower = snap["histograms"]["warmup_lower_latency"]
+    comp = snap["histograms"]["warmup_compile_latency"]
+    assert lower["count"] == 2 and comp["count"] == 2  # factor + solve
+    assert lower["min"] > 0 and comp["min"] > 0
+    # per-shape compile log: factor program + solve program
+    whats = sorted(e["what"] for e in sess.compile_log)
+    assert whats == ["factor", "solve"]
+    for e in sess.compile_log:
+        assert e["op"] == "lu" and e["shape"] and e["lower_s"] > 0
+
+
+# -- device-trace merger / lookahead overlap --------------------------------
+
+
+def _dev_event(name, ts_us, dur_us):
+    return {"ph": "X", "ts": ts_us, "dur": dur_us, "pid": 9, "tid": 1,
+            "name": f"jit__potrf/{name}/fusion.1", "args": {}}
+
+
+def test_lookahead_overlap_metric():
+    # level-1 lookahead tile factor [10, 30] runs under level-0
+    # trail_rest [0, 100]: fully hidden. level-2 lookahead [150, 170]
+    # has NO concurrent level-1 trail_rest (it ran [100, 140]): exposed.
+    events = [
+        _dev_event("potrf_l0_trail_rest", 0, 100),
+        _dev_event("potrf_l1_tile_lookahead", 10, 20),
+        _dev_event("potrf_l1_trail_rest", 100, 40),
+        _dev_event("potrf_l2_tile_lookahead", 150, 20),
+    ]
+    ov = obs.lookahead_overlap(events, driver="potrf")
+    assert ov["levels"]["1"]["hidden_fraction"] == pytest.approx(1.0)
+    assert ov["levels"]["2"]["hidden_fraction"] == pytest.approx(0.0)
+    assert ov["panel_s"] == pytest.approx(40e-6)
+    assert ov["hidden_s"] == pytest.approx(20e-6)
+    assert ov["overlap_fraction"] == pytest.approx(0.5)
+    # a lookahead=0 trace (no lookahead scopes) reports empty, not junk
+    ov0 = obs.lookahead_overlap([_dev_event("potrf_l0_trail", 0, 10)])
+    assert ov0["levels"] == {} and ov0["overlap_fraction"] == 0.0
+    # TPU xplane exports carry the scope in args, not the name
+    args_events = [
+        {"ph": "X", "ts": 0, "dur": 100, "pid": 9, "tid": 1,
+         "name": "fusion.7",
+         "args": {"long_name": "jit__potrf/potrf_l0_trail_rest/dot"}},
+        {"ph": "X", "ts": 10, "dur": 20, "pid": 9, "tid": 1,
+         "name": "fusion.9",
+         "args": {"long_name": "jit__potrf/potrf_l1_tile_lookahead/x"}},
+    ]
+    ova = obs.lookahead_overlap(args_events, driver="potrf")
+    assert ova["overlap_fraction"] == pytest.approx(1.0)
+
+
+def test_merge_traces_rebases_device_lane():
+    tracer = Tracer().on()
+    with tracer.span("serve.factor"):
+        time.sleep(0.001)
+    host = obs.chrome_trace(tracer.spans())
+    dev = [_dev_event("potrf_l0_panel", 5000, 100)]
+    merged = obs.merge_traces(host, dev, anchor="serve.factor")
+    ev = merged["traceEvents"]
+    dev_x = [e for e in ev if e["pid"] == 2 and e.get("ph") == "X"]
+    host_factor = [e for e in ev if e.get("name") == "serve.factor"]
+    assert dev_x and host_factor
+    # earliest device event aligned onto the anchor span's start
+    assert dev_x[0]["ts"] == pytest.approx(host_factor[0]["ts"])
+    assert any(e["pid"] == 2 and e.get("name") == "process_name"
+               for e in ev)
+
+
+# -- review-fix regression pins ---------------------------------------------
+
+
+def test_served_solves_credit_ledger_per_execution():
+    """The api.* verbs inside the Session's jitted factor/solve
+    programs run only at jax-trace time and credit NOTHING (obs.driver
+    is a no-op under a trace); the executed work lands in the process
+    ledger as serve.factor/serve.solve — one credit PER solve, not per
+    compiled shape."""
+    ledger = model_flops.LEDGER
+    sess, h, a = _lu_session()
+    base = ledger.snapshot()["per_op"].get("serve.solve", 0.0)
+    n_solves = 4
+    for _ in range(n_solves):
+        sess.solve(h, RNG.standard_normal(N))
+    got = ledger.snapshot()["per_op"]["serve.solve"] - base
+    assert got == pytest.approx(
+        n_solves * model_flops.solve_flops("lu", N, N, 1))
+
+
+def test_start_span_accepts_noop_parent():
+    """A parent captured while tracing was off is the shared NOOP span
+    (e.g. the Batcher's batch context before on()); start_span must
+    treat it like an absent parent, not dereference its trace_id."""
+    t = Tracer().on()
+    sp = t.start_span("req", parent=obs.NOOP_SPAN)
+    assert sp is not None and sp.parent_id is None
+    t.finish_span(sp, parent=obs.NOOP_SPAN)  # finish side stays guarded
+    assert t.spans()[0].parent_id is None
+
+
+def test_render_prometheus_falsy_ledger_disables_section():
+    text = obs.render_prometheus(Metrics(), ledger=False)
+    assert "driver_flops" not in text
+    assert "slate_tpu_uptime_seconds" in text
+
+
+def test_legacy_timers_accumulate_thread_safe():
+    """timers[k] += d is a load-add-store interleaving hazard across
+    the Executor worker and submitting threads; add_timer serializes
+    it, so the concurrent sum must be exact."""
+    key = "obs_test_timer_race"
+    legacy_trace.timers.pop(key, None)
+    per_thread, dur = 2000, 0.001
+    def work():
+        for _ in range(per_thread):
+            legacy_trace.add_timer(key, dur)
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = legacy_trace.timers.pop(key)
+    assert got == pytest.approx(4 * per_thread * dur)
+
+
+def test_band_flop_models_consistent_across_verbs():
+    """_band_of understands every band container (it used to return 0
+    for band-kind TiledMatrix), and chol_factor/chol_solve on the SAME
+    HermitianBand operand credit the same kd-band model (chol_solve
+    used to credit dense potrf beside chol_factor's band_factor)."""
+    from slate_tpu.api import _band_of
+    from slate_tpu.linalg.band_packed import PackedBand
+    kd, n, nb = 2, 16, 8
+    a = np.eye(n) * (n + 4.0)
+    for d in range(1, kd + 1):
+        a += np.diag(np.ones(n - d), -d) + np.diag(np.ones(n - d), d)
+    H = st.hermitian_band(a, nb, kd, st.Uplo.Lower)
+    Bk = st.band(a, nb, 1, 2)
+    pb = PackedBand(np.zeros((kd + 1, n)), n, kd, 0, hermitian=True)
+    assert _band_of(H) == kd        # was 0 (TiledMatrix fell through)
+    assert _band_of(Bk) == 3        # Band kind: kl+ku
+    assert _band_of(pb) == kd       # packed hermitian-lower unchanged
+    ledger = model_flops.LEDGER
+    b0 = ledger.snapshot()["per_op"]
+    st.chol_factor(H)
+    f_factor = (ledger.snapshot()["per_op"]["chol_factor"]
+                - b0.get("chol_factor", 0.0))
+    assert f_factor == pytest.approx(model_flops.band_factor(n, kd))
+    B = st.from_dense(RNG.standard_normal((n, 2)), nb=nb)
+    b1 = ledger.snapshot()["per_op"]
+    st.chol_solve(H, B)
+    f_solve = (ledger.snapshot()["per_op"]["chol_solve"]
+               - b1.get("chol_solve", 0.0))
+    assert f_solve == pytest.approx(
+        model_flops.band_factor(n, kd)
+        + model_flops.solve_flops("band_chol", n, n, 2, band=kd))
+
+
+def test_band_factor_credits_ledger_once():
+    """Band factors run through the EAGER api verbs (whose driver hook
+    credits the ledger); Session.factor must not credit serve.factor on
+    top — one band factorization, exactly one ledger credit."""
+    from slate_tpu.linalg.band_packed import pb_pack
+    n, kd = 32, 2
+    a = np.eye(n) * (n + 4.0)
+    for d in range(1, kd + 1):
+        a += np.diag(np.ones(n - d), -d) + np.diag(np.ones(n - d), d)
+    sess = Session()
+    h = sess.register(pb_pack(a, kd), op="auto")
+    base = model_flops.LEDGER.snapshot()["flops_total"]
+    sess.factor(h)
+    delta = model_flops.LEDGER.snapshot()["flops_total"] - base
+    assert delta == pytest.approx(model_flops.band_factor(n, kd))
+
+
+def test_errored_attempt_trace_stays_validly_nested():
+    """A failed dispatch attempt closes its request spans INSIDE the
+    batch span's scope (Batcher.run) — children ending after their
+    parent used to fail the package's own Chrome-trace nesting check
+    on any retried workload."""
+    tracer = Tracer().on()
+    sess, h, a = _lu_session(tracer=tracer)
+    calls = {"n": 0}
+    orig = sess.solve_matrix
+    def flaky(handle, B):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient tunnel failure")
+        return orig(handle, B)
+    sess.solve_matrix = flaky
+    from slate_tpu.runtime import Executor
+    with Executor(sess, max_batch=4, max_wait=0.01, retries=2) as ex:
+        futs = [ex.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+        for f in futs:
+            f.result(timeout=120)
+    assert calls["n"] == 2  # one failure, one retry
+    spans = tracer.spans()
+    errored = {s.name for s in spans if s.status == "error"}
+    assert "serve.batch" in errored and "serve.request" in errored
+    assert obs.validate_chrome_trace(obs.chrome_trace(spans)) == []

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Observability smoke: serve a small workload, dump every export.
+
+Drives the full obs surface end to end — tracing ON through
+Session/Batcher/Executor, the legacy SVG timeline, the Prometheus
+text exposition, and the HTTP endpoint — then writes the artifacts:
+
+  <out-dir>/trace.json    Chrome-trace/Perfetto JSON of the span tree
+  <out-dir>/metrics.prom  Prometheus text (same bytes as GET /metrics)
+  <out-dir>/metrics.json  Metrics snapshot JSON
+  <out-dir>/trace.svg     legacy SVG timeline (utils.trace)
+
+Exit status is nonzero if the Chrome JSON fails schema validation
+(obs.validate_chrome_trace: required keys, monotone ts, span nesting),
+if the span tree is disconnected, or if the HTTP endpoint serves the
+wrong payloads — wired into examples/run_tests.py as the obs smoke.
+
+Usage: python tools/obs_dump.py [--smoke] [--out-dir DIR]
+                                [--n N] [--nb NB] [--requests R]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from slate_tpu.compat.platform import apply_env_platforms  # noqa: E402
+
+apply_env_platforms()
+
+import numpy as np  # noqa: E402
+
+
+def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
+    import slate_tpu as st
+    from slate_tpu import obs
+    from slate_tpu.runtime import Executor, Session
+    from slate_tpu.utils import trace as legacy_trace
+
+    os.makedirs(out_dir, exist_ok=True)
+    fails = []
+
+    tracer = obs.Tracer(slow_threshold=slow_threshold)
+    tracer.on()
+    legacy_trace.Trace.clear()
+    legacy_trace.Trace.on()
+
+    rng = np.random.default_rng(5)
+    spd = rng.standard_normal((n, n))
+    spd = spd @ spd.T + n * np.eye(n)
+    A = st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower)
+
+    sess = Session(tracer=tracer)
+    h = sess.register(A, op="chol")
+    srv = sess.serve_obs()  # opt-in HTTP endpoint, ephemeral port
+    try:
+        bs = [rng.standard_normal(n) for _ in range(requests)]
+        with Executor(sess, max_batch=4, max_wait=1e-3) as ex:
+            ex.warmup([h])
+            futs = [ex.submit(h, b) for b in bs]
+            xs = [f.result(timeout=120) for f in futs]
+        resid = max(float(np.abs(spd @ x - b).max()) / n
+                    for x, b in zip(xs, bs))
+        if not resid < 1e-2:
+            fails.append(f"serving residual too large: {resid}")
+
+        # -- exports --------------------------------------------------
+        spans = tracer.spans()
+        trace_path = os.path.join(out_dir, "trace.json")
+        obs.write_chrome_trace(spans, trace_path)
+        with open(trace_path) as f:
+            errs = obs.validate_chrome_trace(json.load(f))
+        if errs:
+            fails.append(f"chrome-trace schema: {errs[:3]}")
+
+        # connectedness: every parent_id resolves to a recorded span
+        ids = {s.span_id for s in spans}
+        dangling = [s for s in spans
+                    if s.parent_id is not None and s.parent_id not in ids]
+        if dangling:
+            fails.append(f"span tree disconnected: {len(dangling)} orphans")
+        if not any(s.name == "serve.batch" for s in spans):
+            fails.append("no serve.batch span recorded")
+        if not any(s.kind == "request" for s in spans):
+            fails.append("no request spans recorded")
+
+        sess.metrics.to_json(os.path.join(out_dir, "metrics.json"))
+        prom = obs.render_prometheus(sess.metrics)
+        with open(os.path.join(out_dir, "metrics.prom"), "w") as f:
+            f.write(prom)
+        if "slate_tpu_solves_total" not in prom:
+            fails.append("prometheus text missing solves_total")
+
+        svg = legacy_trace.Trace.finish(os.path.join(out_dir, "trace.svg"))
+        if svg is None:
+            fails.append("SVG timeline empty (span bridge broken)")
+
+        # -- HTTP endpoint --------------------------------------------
+        for path, needle in (("/metrics", "slate_tpu_solves_total"),
+                             ("/healthz", '"status": "ok"'),
+                             ("/trace.json", "traceEvents")):
+            body = urllib.request.urlopen(srv.url(path),
+                                          timeout=10).read().decode()
+            if needle not in body:
+                fails.append(f"GET {path}: missing {needle!r}")
+    finally:
+        sess.close_obs()
+        tracer.off()
+        legacy_trace.Trace.off()
+
+    summary = {
+        "out_dir": out_dir,
+        "spans": len(tracer.spans()),
+        "requests": requests,
+        "schema_errors": 0 if not fails else fails,
+        "ok": not fails,
+    }
+    print(json.dumps(summary))
+    for msg in fails:
+        print(f"!!! {msg}", file=sys.stderr)
+    return 0 if not fails else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU run into a temp dir (CI wiring)")
+    p.add_argument("--out-dir", default="obs_dump")
+    p.add_argument("--n", type=int, default=96)
+    p.add_argument("--nb", type=int, default=32)
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--slow-ms", type=float, default=None,
+                   help="slow-request log threshold (milliseconds)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir
+    if args.smoke and out_dir == "obs_dump":
+        out_dir = tempfile.mkdtemp(prefix="slate_tpu_obs_")
+    thr = args.slow_ms * 1e-3 if args.slow_ms is not None else None
+    return run(out_dir, n=args.n, nb=args.nb, requests=args.requests,
+               slow_threshold=thr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,7 +146,8 @@ def main():
     res["t_lookahead_model_floor_ms"] = round(
         max(res["t_tiles_ms"] + res["t_panels_ms"], res["t_trailing_ms"]),
         2)
-    gflops = (n ** 3 / 3.0) / 1e9 / (res["t_total_ms"] / 1e3)
+    from slate_tpu.obs import flops as model_flops
+    gflops = model_flops.potrf(n) / 1e9 / max(res["t_total_ms"] / 1e3, 1e-9)
     res["potrf_gflops"] = round(gflops, 1)
 
     if trace_dir:
@@ -159,6 +160,39 @@ def main():
             jax.block_until_ready(out)
         res["trace_dir"] = trace_dir
         print(f"# trace written to {trace_dir}", file=sys.stderr)
+        # MEASURED lookahead overlap (ISSUE 4): when the profiler run
+        # left a chrome-format device trace, align the per-level
+        # potrf_l{k}_* named scopes and report how much of each
+        # level-(k+1) lookahead tile-factor ran under the level-k
+        # trail_rest gemms — the number the PERF.md round-7 model
+        # (per-level floor = max(panel, trailing)) only predicts.
+        from slate_tpu.obs import merge as obs_merge
+        paths = obs_merge.find_device_traces(trace_dir)
+        if paths:
+            events = obs_merge.load_trace(paths[0])
+            ov = obs_merge.lookahead_overlap(events, driver="potrf")
+            res["lookahead_overlap"] = {
+                "panel_s": round(ov["panel_s"], 6),
+                "hidden_s": round(ov["hidden_s"], 6),
+                "overlap_fraction": round(ov["overlap_fraction"], 3),
+                "levels": len(ov["levels"]),
+                "source": paths[0],
+            }
+            if ov["levels"]:
+                print(f"# measured lookahead overlap: "
+                      f"{ov['overlap_fraction']:.1%} of lookahead-panel "
+                      "time hidden under trailing gemms", file=sys.stderr)
+            else:
+                print("# no lookahead-scoped device events in the trace "
+                      "(XLA:CPU strips named-scope metadata; on TPU the "
+                      "scopes survive in event args) — overlap reported "
+                      "as 0 levels", file=sys.stderr)
+        else:
+            res["lookahead_overlap"] = None
+            print("# no chrome-format device trace found under "
+                  f"{trace_dir} (xplane-only profiler output needs the "
+                  "tensorboard converter) — overlap not measured",
+                  file=sys.stderr)
 
     print(json.dumps(res))
 
