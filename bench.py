@@ -32,6 +32,10 @@ import jax.numpy as jnp
 # model-GFLOP formulas: the one home is the FLOP ledger (ISSUE 4) —
 # bench.py, slate_tpu/tester.py, and runtime/session.py all share it
 from slate_tpu.obs import flops as model_flops
+# bytes/roofline side of the ledger (ISSUE 5): XLA cost harvest +
+# intensity/roof join for the --phases roofline rows
+from slate_tpu.obs import costs as obs_costs
+from slate_tpu.obs import roofline as obs_roofline
 
 BASELINE_GFLOPS_PER_CHIP = 700.0  # reference SLATE dgemm per-GPU (docs/usage.md)
 
@@ -447,6 +451,78 @@ def bench_factor_phases(n=1024, nb=256, dtype=jnp.float32):
     return out
 
 
+def _single_call_costs(name, n, nb, dtype=jnp.float32):
+    """XLA cost/memory analysis of ONE application of a driver verb
+    (the scan programs time well but XLA counts a while body once, so
+    per-iteration bytes must come from a single-call program). Returns
+    a ProgramCosts; degrades to partial=True on any backend gap."""
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+    from slate_tpu.matgen import generate_matrix, random_spd
+
+    if name == "gemm":
+        a = generate_matrix("randn", n, n, dtype, seed=1)
+        A = st.from_dense(a, nb=nb)
+        fn = jax.jit(lambda x, y: st.gemm(
+            1.0, A.with_data(x), A.with_data(y), 0.0,
+            st.zeros(n, n, nb, dtype)).data)
+        args = (A.data, A.data)
+    elif name == "potrf":
+        a = random_spd(n, dtype=dtype, seed=3)
+        A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower)
+        fn = jax.jit(lambda x: st.potrf(A.with_data(x))[0].data)
+        args = (A.data,)
+    elif name in ("getrf", "getrf_calu"):
+        a = generate_matrix("randn", n, n, dtype, seed=4)
+        a = a + n * jnp.eye(n, dtype=dtype)
+        A = st.from_dense(a, nb=nb)
+        from slate_tpu.core.types import MethodLU, Options
+        opts = (Options(method_lu=MethodLU.CALU)
+                if name == "getrf_calu" else Options())
+        fn = jax.jit(lambda x: st.getrf(A.with_data(x), opts)[0].data)
+        args = (A.data,)
+    elif name == "geqrf":
+        a = generate_matrix("randn", n, n, dtype, seed=5)
+        A = st.from_dense(a, nb=nb)
+        fn = jax.jit(lambda x: st.geqrf(A.with_data(x)).vr)
+        args = (A.data,)
+    else:
+        raise ValueError(name)
+    return obs_costs.program_costs(fn.lower(*args).compile())
+
+
+def _roofline_rows(n, model_fl, seconds):
+    """One roofline row per headline verb: model flops ÷ XLA
+    bytes-accessed (single-call program) joined with the measured
+    per-iteration seconds; machine roofs from SLATE_TPU_PEAK_GFLOPS /
+    SLATE_TPU_HBM_GBPS when set (obs/roofline.py). The analyzed
+    program is built at the SAME nb the timed bench_* function used —
+    tile size changes bytes-accessed and temp HBM, so mixing tilings
+    would join one program's seconds with another's bytes."""
+    bench_nb = {"gemm": 512}  # bench_gemm default; factor verbs: 1024
+    machine = obs_roofline.MachineModel.from_env()
+    rows = []
+    for name, secs in seconds.items():
+        try:
+            pc = _single_call_costs(name, n, bench_nb.get(name, 1024))
+        except Exception as e:
+            print(f"# roofline {name} skipped: {e}", file=sys.stderr)
+            continue
+        row = obs_roofline.roofline_row(
+            name, model_fl[name], pc.bytes_accessed, secs,
+            pc.collective_bytes or None, machine)
+        row["xla_flops"] = pc.flops
+        row["temp_bytes"] = pc.temp_bytes
+        row["peak_bytes"] = pc.peak_bytes
+        rows.append(row)
+        ai = row["intensity"]
+        print(f"# roofline {name}  n={n}: intensity "
+              f"{ai:.1f} flop/B" if ai is not None else
+              f"# roofline {name}  n={n}: bytes unavailable",
+              file=sys.stderr)
+    return rows
+
+
 def main():
     import argparse
 
@@ -517,6 +593,8 @@ def main():
     print(f"# gemm   n={n} fp32: {gemm_gflops:9.1f} GFLOP/s  ({gemm_t*1e3:.1f} ms/iter)",
           file=sys.stderr)
     extra = {}
+    # measured per-iter seconds per verb, for the --phases roofline join
+    routine_secs = {"gemm": gemm_t}
     try:
         gemm_hi, t_hi = bench_gemm(n=n, precision="high")
         extra["gemm_high_gflops"] = round(gemm_hi, 1)
@@ -531,6 +609,7 @@ def main():
                      ("geqrf", bench_geqrf)):
         try:
             gflops, t = fn(n=n)
+            routine_secs[name] = t
             extra[f"{name}_gflops"] = round(gflops, 1)
             extra[f"{name}_pct_of_gemm"] = round(100 * gflops / gemm_gflops, 1)
             if gemm_hi:
@@ -584,6 +663,17 @@ def main():
                   f"{json.dumps(extra['factor_phases'])}", file=sys.stderr)
         except Exception as e:
             print(f"# phase timer skipped: {e}", file=sys.stderr)
+        # roofline rows (round 9): model flops ÷ XLA bytes-accessed per
+        # verb, with the measured rate beside the attainable one when a
+        # machine model is configured (obs/roofline.py)
+        model_fl = {
+            "gemm": model_flops.gemm(n, n, n),
+            "potrf": model_flops.potrf(n),
+            "getrf": model_flops.getrf(n),
+            "getrf_calu": model_flops.getrf(n),
+            "geqrf": model_flops.geqrf(n, n),
+        }
+        extra["roofline"] = _roofline_rows(n, model_fl, routine_secs)
 
     out = {
         "metric": f"gemm_gflops_per_chip_fp32_n{n}",
@@ -592,8 +682,14 @@ def main():
         "vs_baseline": round(gemm_gflops / BASELINE_GFLOPS_PER_CHIP, 2),
         **extra,
     }
-    if cpu_fallback:
-        out["platform"] = "cpu-fallback"  # tunnel down at bench time
+    # the trajectory gate (tools/bench_gate.py) groups series by
+    # platform; record it on EVERY artifact (it used to be written only
+    # on the cpu-fallback path, which left TPU rounds ungateable)
+    try:
+        out["platform"] = ("cpu-fallback" if cpu_fallback
+                           else jax.devices()[0].platform)
+    except Exception:
+        out["platform"] = "unknown"
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

@@ -101,6 +101,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
             "wall_s": per_request_wall,
             "solves_per_sec": requests / per_request_wall,
         },
+        # round 9: per-shape cost rows harvested at the AOT seam (model
+        # flops, XLA bytes-accessed, arg/out/temp/peak HBM, collective
+        # census) and the session's point-in-time HBM gauges
+        "cost_log": sess.cost_log,
+        "hbm": snap.get("gauges", {}),
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])

@@ -92,6 +92,19 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! obs_dump --smoke FAILED")
+    # bench-trajectory gate (round 9): every committed BENCH_*.json must
+    # parse against the normalized schema, and no tracked TPU series may
+    # end in a regression beyond tolerance (tools/bench_gate.py exits
+    # nonzero on either; no jax import — runs in-process-cheap)
+    for gate_args in (["--check-schema"], []):
+        print(f"=== tools/bench_gate.py {' '.join(gate_args) or '(gate)'}"
+              " ===")
+        r = subprocess.run(
+            [sys.executable, str(here.parent / "tools" / "bench_gate.py")]
+            + gate_args, cwd=here.parent, env=env)
+        if r.returncode != 0:
+            fails += 1
+            print("!!! bench_gate FAILED")
     return fails
 
 

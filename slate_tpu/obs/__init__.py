@@ -27,16 +27,17 @@ See DESIGN.md "Observability (round 8)" for the reference mapping
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import flops
+from . import costs, flops, roofline
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
 from .merge import lookahead_overlap, merge_traces
 from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
 
 __all__ = [
-    "NOOP_SPAN", "ObsServer", "Span", "Tracer", "chrome_trace",
+    "NOOP_SPAN", "ObsServer", "Span", "Tracer", "chrome_trace", "costs",
     "default_tracer", "flops", "lookahead_overlap", "merge_traces",
-    "render_prometheus", "validate_chrome_trace", "write_chrome_trace",
+    "render_prometheus", "roofline", "validate_chrome_trace",
+    "write_chrome_trace",
 ]
 
 

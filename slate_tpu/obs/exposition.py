@@ -33,21 +33,29 @@ def _san(name: str) -> str:
 
 
 def render_prometheus(snapshot, prefix: str = "slate_tpu",
-                      ledger: Optional["flops_mod.FlopLedger"] = None
-                      ) -> str:
+                      ledger: Optional["flops_mod.FlopLedger"] = None,
+                      bytes_ledger=None) -> str:
     """Metrics snapshot (or a Metrics instance) -> Prometheus text.
 
     Counters render as ``counter``; histograms as ``summary`` (count,
     sum, p50/p99 quantiles) with ``_min``/``_max`` gauges beside them
     (omitted while empty — see Histogram.snapshot's null contract);
-    derived ratios as ``gauge``. ``ledger=None`` binds the process
-    ledger; pass ``ledger=False``-y explicitly off with a fresh one."""
+    derived ratios and explicit gauges (resident_bytes, peak_hbm_bytes,
+    hbm_headroom) as ``gauge``. ``ledger=None`` binds the process flop
+    ledger and ``bytes_ledger=None`` the process bytes ledger
+    (``driver_bytes_total`` / ``collective_bytes_total`` — round 9);
+    pass either ``False`` to disable its section."""
     if hasattr(snapshot, "snapshot"):
         snapshot = snapshot.snapshot()
     if ledger is None:
         ledger = flops_mod.LEDGER
     elif not ledger:  # explicit falsy (False/0): no ledger section
         ledger = None
+    if bytes_ledger is None:
+        from . import costs as costs_mod
+        bytes_ledger = costs_mod.BYTES
+    elif not bytes_ledger:
+        bytes_ledger = None
     lines = []
 
     def emit(name, value, mtype=None, labels=""):
@@ -72,6 +80,8 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
             v = h.get(stat)
             if v is not None:
                 emit(f"{base}_{stat}", v, "gauge")
+    for k in sorted(snapshot.get("gauges", {})):
+        emit(f"{prefix}_{_san(k)}", snapshot["gauges"][k], "gauge")
     for k in sorted(snapshot.get("derived", {})):
         emit(f"{prefix}_{_san(k)}", snapshot["derived"][k], "gauge")
     if ledger is not None:
@@ -82,6 +92,31 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
             for op in sorted(snap["per_op"]):
                 lines.append(f'{prefix}_driver_flops{{op="{_san(op)}"}} '
                              f'{_num(snap["per_op"][op])}')
+    if bytes_ledger is not None:
+        # the round-9 bytes/communication section: XLA bytes-accessed
+        # and modeled collective (ICI) traffic, per op and per kind
+        bsnap = bytes_ledger.snapshot()
+        emit(f"{prefix}_driver_bytes_total", bsnap["bytes_total"],
+             "counter")
+        emit(f"{prefix}_collective_bytes_total",
+             bsnap["collective_bytes_total"], "counter")
+        if bsnap["per_op"]:
+            lines.append(f"# TYPE {prefix}_driver_bytes counter")
+            for op in sorted(bsnap["per_op"]):
+                lines.append(
+                    f'{prefix}_driver_bytes{{op="{_san(op)}"}} '
+                    f'{_num(bsnap["per_op"][op]["bytes"])}')
+        if bsnap["per_collective"]:
+            lines.append(f"# TYPE {prefix}_collective_bytes counter")
+            lines.append(f"# TYPE {prefix}_collective_ops_total counter")
+            for kind in sorted(bsnap["per_collective"]):
+                row = bsnap["per_collective"][kind]
+                lines.append(
+                    f'{prefix}_collective_bytes{{kind="{_san(kind)}"}} '
+                    f'{_num(row["bytes"])}')
+                lines.append(
+                    f'{prefix}_collective_ops_total{{kind="{_san(kind)}"}}'
+                    f' {_num(row["count"])}')
     return "\n".join(lines) + "\n"
 
 

@@ -247,20 +247,33 @@ class FlopLedger:
                       ) -> dict:
         """Per-op flops joined against a phase-timer map (default: the
         legacy ``utils.trace.timers``): ops whose name matches a timer
-        phase (``api.<op>``) get a measured GFLOP/s column."""
+        phase (``api.<op>``) get a measured GFLOP/s column. Round 9:
+        ops the bytes ledger (obs/costs.py) also knows gain
+        ``bytes`` / ``collective_bytes`` / ``intensity`` (flops per
+        byte) columns — the roofline join, see obs/roofline.py for the
+        full report with machine roofs."""
         if timers is None:
             from ..utils.trace import timers as timers_
             timers = timers_
+        from . import costs as costs_mod
+        bsnap = costs_mod.BYTES.snapshot()
         snap = self.snapshot()
         report = {}
         for op, fl in snap["per_op"].items():
             secs = timers.get(f"api.{op}", 0.0) or timers.get(op, 0.0)
-            report[op] = {
+            row = {
                 "flops": fl,
                 "calls": snap["calls"][op],
                 "seconds": secs,
                 "gflops": fl / secs / 1e9 if secs > 0 else None,
             }
+            brow = bsnap["per_op"].get(op)
+            if brow is not None:
+                row["bytes"] = brow["bytes"]
+                row["collective_bytes"] = brow["collective_bytes"]
+                row["intensity"] = (fl / brow["bytes"]
+                                    if brow["bytes"] else None)
+            report[op] = row
         return {"flops_total": snap["flops_total"], "per_op": report}
 
 

@@ -72,6 +72,7 @@ except ImportError:  # pre-0.6 jax: experimental namespace
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.grid import ROW_AXIS
+from ..obs import costs as obs_costs
 from .collectives import bcast_from, maxloc
 
 
@@ -151,4 +152,11 @@ def dist_panel_getrf(a: jax.Array, grid) -> Tuple[jax.Array, jax.Array,
                        check_rep=False)
     a = lax.with_sharding_constraint(
         a, NamedSharding(mesh, P(ROW_AXIS, None)))
-    return fn(a)
+    # cost telemetry (round 9): per-shape AOT analysis of the compiled
+    # panel (the per-column maxloc pmax/pmin/psum + two masked-psum row
+    # broadcasts show up in the collective census; note the fori_loop
+    # body is counted once per INSTRUCTION, so the census is a per-
+    # column lower bound — PERF.md Round 9), credited to the process
+    # bytes ledger on every eager call (obs/costs.py).
+    return obs_costs.call_analyzed(
+        fn, (a,), label=f"parallel.panel_getrf[p{p}]")

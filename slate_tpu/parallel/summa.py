@@ -29,6 +29,7 @@ except ImportError:  # pre-0.6 jax: experimental namespace
 
 from ..core.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..obs import costs as obs_costs
 from .collectives import bcast_from
 
 
@@ -97,7 +98,14 @@ def gemm_summa(alpha, A: TiledMatrix, B: TiledMatrix, beta,
         prod = lax.fori_loop(0, steps, body, acc0)
         return alpha * prod + beta * c_blk
 
-    out = summa(a, b, c)
+    # cost telemetry (round 9): the first call per (grid, shape) AOT-
+    # analyzes the compiled SUMMA program (XLA bytes-accessed + the
+    # per-collective census — the two psum broadcasts per panel round),
+    # and EVERY call credits the process bytes ledger under this label;
+    # inside an outer jit it degrades to a plain call (the outer
+    # program's compiler owns the analysis). See obs/costs.py.
+    out = obs_costs.call_analyzed(
+        summa, (a, b, c), label=f"parallel.summa[{p}x{q}]")
     out = out[: C.mt * C.nb, : C.nt * C.nb]
     return from_dense(out, C.nb, grid=grid, kind=C.kind, uplo=C.uplo,
                       logical_shape=C.shape)

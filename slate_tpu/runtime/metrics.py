@@ -80,19 +80,24 @@ class Metrics:
 
     Counter vocabulary (all monotone):
       solves_total, requests_total, batches_total, cache_hits,
-      cache_misses, evictions, factors_total, retries, aot_compiles,
-      flops_total (factor+solve work), solve_flops_total /
+      cache_misses, evictions, evicted_bytes, factors_total, retries,
+      aot_compiles, flops_total (factor+solve work), solve_flops_total /
       factor_flops_total (the split — the derived gflops rate is
       solve_flops_total over solve_latency seconds, so amortized
-      factorizations do not inflate it), budget_overflows
+      factorizations do not inflate it), budget_overflows,
+      oom_risk_warnings, bytes_accessed_total, collective_bytes_total
     Histograms (seconds, except batch_size):
       solve_latency, factor_latency, request_latency, batch_size
+    Gauges (point-in-time, set not incremented):
+      resident_bytes, peak_hbm_bytes, hbm_headroom — the Session's HBM
+      truth (factor residency + largest program transient, round 9)
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._hists: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, float] = {}
         self._t0 = time.perf_counter()
 
     def inc(self, name: str, value: float = 1.0):
@@ -102,6 +107,16 @@ class Metrics:
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0.0)
+
+    def set_gauge(self, name: str, value: float):
+        """Point-in-time gauge (resident_bytes, hbm_headroom, ...):
+        last write wins, rendered as a Prometheus gauge."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
 
     def observe(self, name: str, value: float):
         with self._lock:
@@ -163,6 +178,7 @@ class Metrics:
         with self._lock:
             counters = dict(self._counters)
             hists = {k: h.snapshot() for k, h in self._hists.items()}
+            gauges = dict(self._gauges)
             uptime = time.perf_counter() - self._t0
         # derived serving headline numbers (computed outside the lock
         # from the consistent copies above)
@@ -171,6 +187,7 @@ class Metrics:
             "uptime_s": uptime,
             "counters": counters,
             "histograms": hists,
+            "gauges": gauges,
             "derived": self._derive(
                 counters.get("cache_hits", 0.0),
                 counters.get("cache_misses", 0.0),

@@ -38,7 +38,8 @@ from ..linalg.band_packed import PackedBand
 from ..obs.flops import LEDGER as _LEDGER
 from ..obs.flops import factor_flops as _factor_flops
 from ..obs.flops import solve_flops as _solve_flops
-from ..obs.tracing import Tracer, default_tracer
+from ..obs import costs as _costs
+from ..obs.tracing import Tracer, default_tracer, log as _obs_log
 from .metrics import Metrics
 
 # operator kinds a Session can keep resident
@@ -46,13 +47,26 @@ OPS = ("lu", "chol", "qr", "band_lu", "band_chol")
 
 
 def _tree_nbytes(payload) -> int:
-    """Device bytes held by a factor payload (sum over pytree leaves)."""
+    """Device bytes held by a factor payload (sum over pytree leaves).
+
+    Computed from shape/dtype metadata ONLY: the old
+    ``np.asarray(leaf).nbytes`` fallback device-transferred any leaf
+    lacking ``.nbytes`` — a full factor copy through the host on the
+    cache-accounting path (pinned by test: no ``__array__`` call)."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(payload):
-        nbytes = getattr(leaf, "nbytes", None)
-        if nbytes is None:
-            nbytes = int(np.asarray(leaf).nbytes)
-        total += int(nbytes)
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * np.dtype(dtype).itemsize
+        elif getattr(leaf, "nbytes", None) is not None:
+            total += int(leaf.nbytes)
+        else:  # python scalar leaf: its device form is one element
+            total += np.dtype(type(leaf)).itemsize if isinstance(
+                leaf, (int, float, complex)) else 0
     return total
 
 
@@ -107,6 +121,14 @@ class Session:
         # per-shape compile observability (Session.warmup + refactor-on-
         # miss): [{op, what, shape, lower_s, compile_s}, ...]
         self.compile_log: List[dict] = []
+        # per-shape COST observability (ISSUE 5): one row per AOT-
+        # compiled program — model flops, XLA bytes-accessed, arg/out/
+        # temp/peak HBM, collective census (obs/costs.py)
+        self.cost_log: List[dict] = []
+        # AOT-key -> ProgramCosts for resident executables; drives the
+        # per-execution bytes crediting and the transient-footprint
+        # term of the HBM budget (evicted in step with _compiled)
+        self._program_costs: Dict[Hashable, _costs.ProgramCosts] = {}
         self._obs_server = None
         self._lock = threading.RLock()
         self._ops: Dict[Hashable, _Operator] = {}
@@ -185,7 +207,11 @@ class Session:
         """Drop an operator and its cached factor (no error if absent)."""
         with self._lock:
             self._ops.pop(handle, None)
-            self._cache.pop(handle, None)
+            res = self._cache.pop(handle, None)
+            if res is not None:
+                self.metrics.inc("evictions")
+                self.metrics.inc("evicted_bytes", res.nbytes)
+            self._update_hbm_gauges()
 
     def __contains__(self, handle: Hashable) -> bool:
         with self._lock:
@@ -210,16 +236,21 @@ class Session:
     def evict(self, handle: Hashable) -> bool:
         """Explicitly drop a cached factor (operator stays registered)."""
         with self._lock:
-            hit = self._cache.pop(handle, None) is not None
-        if hit:
-            self.metrics.inc("evictions")
-        return hit
+            res = self._cache.pop(handle, None)
+            if res is not None:
+                self.metrics.inc("evictions")
+                self.metrics.inc("evicted_bytes", res.nbytes)
+            self._update_hbm_gauges()
+        return res is not None
 
     def clear_cache(self):
         with self._lock:
             n = len(self._cache)
+            nbytes = sum(r.nbytes for r in self._cache.values())
             self._cache.clear()
+            self._update_hbm_gauges()
         self.metrics.inc("evictions", n)
+        self.metrics.inc("evicted_bytes", nbytes)
 
     def factor(self, handle: Hashable) -> _Resident:
         """Resident factor for ``handle``: cache hit or refactor-on-miss
@@ -291,10 +322,25 @@ class Session:
             if exe is not None:
                 self._compiled.move_to_end(key)
                 payload, info = exe(A)
+                self._credit_program(key, "serve.factor")
             else:
                 payload, info = self._factor_fn(entry)(A)
         payload = jax.block_until_ready(payload)
         return _Resident(payload, int(info), _tree_nbytes(payload))
+
+    def _credit_program(self, key: Hashable, op: str):
+        """One execution of an analyzed AOT program: credit the process
+        BYTES ledger (bytes-accessed + modeled collective traffic) and
+        the session counters — the per-execution discipline the flop
+        ledger already follows (compile-time tracing credits nothing)."""
+        pc = self._program_costs.get(key)
+        if pc is None:
+            return
+        _costs.BYTES.record_costs(op, pc)
+        if pc.bytes_accessed:
+            self.metrics.inc("bytes_accessed_total", pc.bytes_accessed)
+        if pc.collective_bytes:
+            self.metrics.inc("collective_bytes_total", pc.collective_bytes)
 
     def _jit_cached(self, jkey: Hashable, make):
         """LRU-jit-cache shared by the solve and factor programs. A
@@ -312,10 +358,13 @@ class Session:
         return fn
 
     def _compiled_put(self, key: Hashable, exe):
-        """Insert an AOT executable under the shared cap."""
+        """Insert an AOT executable under the shared cap (its cost
+        analysis is dropped in step, so the transient-footprint term of
+        the budget only counts programs that can still run)."""
         self._compiled[key] = exe
         while len(self._compiled) > self._compiled_cap:
-            self._compiled.popitem(last=False)
+            old, _ = self._compiled.popitem(last=False)
+            self._program_costs.pop(old, None)
 
     def _factor_fn(self, entry: _Operator):
         return self._jit_cached(
@@ -328,23 +377,68 @@ class Session:
         shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
         return ("factor", entry.op, entry.opts, treedef, shapes)
 
+    def _largest_transient(self) -> int:
+        """Caller holds the lock. Transient HBM (temp scratch + output
+        allocation) of the largest resident AOT program — the
+        peak-memory truth XLA's memory_analysis reports at the compile
+        seam. 0 when no program has been analyzed (XLA:CPU reports 0
+        temp bytes: graceful degradation to the round-6 accounting)."""
+        return max((pc.transient_bytes
+                    for pc in self._program_costs.values()), default=0)
+
+    def _update_hbm_gauges(self):
+        """Caller holds the lock. Publish the HBM truth as gauges:
+        resident factor bytes, the worst-case peak (factors + largest
+        program transient), and the headroom against the budget."""
+        resident = sum(r.nbytes for r in self._cache.values())
+        peak = resident + self._largest_transient()
+        self.metrics.set_gauge("resident_bytes", resident)
+        self.metrics.set_gauge("peak_hbm_bytes", peak)
+        if self.hbm_budget is not None:
+            self.metrics.set_gauge("hbm_headroom", self.hbm_budget - peak)
+
+    def hbm_headroom(self) -> Optional[int]:
+        """Budget minus (resident factors + largest program transient);
+        None when the session is unbounded."""
+        with self._lock:
+            if self.hbm_budget is None:
+                return None
+            return self.hbm_budget - (
+                sum(r.nbytes for r in self._cache.values())
+                + self._largest_transient())
+
     def _evict_to_budget(self, keep: Hashable):
         """Caller holds the lock. Drop LRU entries (never ``keep``)
-        until the cache fits the budget."""
+        until resident factors PLUS the largest resident program's
+        transient footprint fit the budget (round 9: the budget used to
+        be an honor-system sum of factor nbytes that ignored what the
+        programs themselves allocate while running)."""
         if self.hbm_budget is None:
+            self._update_hbm_gauges()
             return
-        used = sum(r.nbytes for r in self._cache.values())
+        transient = self._largest_transient()
+        used = sum(r.nbytes for r in self._cache.values()) + transient
         for h in list(self._cache):
             if used <= self.hbm_budget:
-                return
+                break
             if h == keep:
                 continue
-            used -= self._cache.pop(h).nbytes
+            nbytes = self._cache.pop(h).nbytes
+            used -= nbytes
             self.metrics.inc("evictions")
+            self.metrics.inc("evicted_bytes", nbytes)
         if used > self.hbm_budget:
-            # the just-inserted factor alone exceeds the budget; keep it
-            # (nothing can be served without it) but record the overflow
+            # the kept factor (+ program transient) alone exceeds the
+            # budget; serving must continue, but this is OOM risk —
+            # record the overflow and warn on the slow-log path
             self.metrics.inc("budget_overflows")
+            self.metrics.inc("oom_risk_warnings")
+            _obs_log.warning(
+                "OOM risk: resident factors + largest program transient "
+                "= %d bytes exceed hbm_budget=%d (transient=%d); serving "
+                "continues with negative headroom", used, self.hbm_budget,
+                transient)
+        self._update_hbm_gauges()
 
     # -- solve -------------------------------------------------------------
 
@@ -436,6 +530,7 @@ class Session:
         exe = self._compiled.get(key)
         if exe is not None:
             self._compiled.move_to_end(key)
+            self._credit_program(key, "serve.solve")
             return exe(res.payload, B)
         return fn(res.payload, B)
 
@@ -472,7 +567,8 @@ class Session:
                     ffn = self._factor_fn(entry)
                     self._compiled_put(
                         fkey, self._aot_compile(
-                            "factor", entry, handle, ffn, (entry.A,)))
+                            "factor", entry, handle, ffn, (entry.A,),
+                            key=fkey))
                     self.metrics.inc("factor_aot_compiles")
             res = self.factor(handle)
             B = self._wrap_rhs(
@@ -483,17 +579,24 @@ class Session:
             fn = self._solve_fn(entry)
             self._compiled_put(
                 key, self._aot_compile("solve", entry, handle, fn,
-                                       (res.payload, B)))
+                                       (res.payload, B), key=key))
             self.metrics.inc("aot_compiles")
 
     def _aot_compile(self, what: str, entry: _Operator, handle: Hashable,
-                     fn, args: Tuple):
+                     fn, args: Tuple, key: Optional[Hashable] = None):
         """``jit(...).lower(...).compile()`` with compile-time
         observability: the trace+lower and compile stages are timed
         separately into ``warmup_lower_latency`` /
         ``warmup_compile_latency`` histograms and appended per shape to
         ``Session.compile_log`` — the numbers a serving fleet needs to
-        budget warmup and alarm on recompiles."""
+        budget warmup and alarm on recompiles.
+
+        Round 9: the same seam harvests XLA's cost/memory analyses
+        (obs/costs.py) into ``Session.cost_log`` — per shape: model
+        flops, bytes-accessed, argument/output/temp/peak HBM, and the
+        collective census — and keeps the ProgramCosts keyed under the
+        executable's cache key so every execution credits the bytes
+        ledger and the budget accounts the program's transient HBM."""
         with self.metrics.phase("serve.warmup", tracer=self.tracer,
                                 stage=what,
                                 **self._span_attrs(entry, handle)):
@@ -505,11 +608,24 @@ class Session:
         self.metrics.observe("warmup_lower_latency", t1 - t0)
         self.metrics.observe("warmup_compile_latency", t2 - t1)
         leaves = jax.tree_util.tree_leaves(args)
+        shapes = [tuple(getattr(l, "shape", ())) for l in leaves]
         self.compile_log.append({
-            "op": entry.op, "what": what,
-            "shape": [tuple(getattr(l, "shape", ())) for l in leaves],
+            "op": entry.op, "what": what, "shape": shapes,
             "lower_s": t1 - t0, "compile_s": t2 - t1,
         })
+        pc = _costs.program_costs(exe)
+        if key is not None:
+            self._program_costs[key] = pc
+        model_fl = (_factor_flops(entry.op, entry.m, entry.n, entry.band)
+                    if what == "factor" else
+                    _solve_flops(entry.op, entry.m, entry.n,
+                                 shapes[-1][1] if shapes and
+                                 len(shapes[-1]) > 1 else 1, entry.band))
+        self.cost_log.append({
+            "op": entry.op, "what": what, "shape": shapes,
+            "model_flops": model_fl, **pc.to_dict(),
+        })
+        self._update_hbm_gauges()
         return exe
 
     # -- observability endpoint --------------------------------------------

@@ -1,0 +1,180 @@
+"""tools/bench_gate.py: BENCH-trajectory schema normalization and the
+regression gate (ISSUE 5 tentpole c). Pure-host — no jax import; the
+gate must stay cheap enough to run in every CI invocation.
+
+Acceptance pins: nonzero exit on an injected regression in a fixture
+trajectory, zero on the real committed history, and --check-schema
+validating every committed BENCH_*.json.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import pytest
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", str(_REPO / "tools" / "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+gate_mod = _load_gate()
+
+
+def _bench_artifact(value, platform="tpu", n=8192, **extra):
+    return {
+        "metric": f"gemm_gflops_per_chip_fp32_n{n}",
+        "value": value,
+        "unit": "GFLOP/s",
+        "vs_baseline": round(value / 700.0, 2),
+        "platform": platform,
+        **extra,
+    }
+
+
+def _write(dirpath, name, obj):
+    with open(os.path.join(dirpath, name), "w") as f:
+        json.dump(obj, f)
+
+
+# -- normalization ----------------------------------------------------------
+
+
+def test_normalize_all_three_schemas(tmp_path):
+    # rounds 1-5 harness wrapper (metrics inside "parsed", platform
+    # inferred from the tail's axon warning)
+    _write(tmp_path, "BENCH_r01.json", {
+        "n": 1, "cmd": "python bench.py", "rc": 0,
+        "tail": "Platform 'axon' is experimental\n...",
+        "parsed": _bench_artifact(140000.0, platform=None)})
+    rec = gate_mod.normalize(str(tmp_path / "BENCH_r01.json"))
+    assert rec["kind"] == "bench" and rec["round"] == 1
+    assert rec["platform"] == "tpu" and rec["n"] == 8192
+    assert rec["metrics"]["value"] == 140000.0
+
+    # bare bench.py --out artifact (round 6+)
+    _write(tmp_path, "BENCH_r06.json",
+           _bench_artifact(100.0, platform="cpu-fallback", n=512,
+                           potrf_gflops=1.5))
+    rec = gate_mod.normalize(str(tmp_path / "BENCH_r06.json"))
+    assert rec["round"] == 6 and rec["platform"] == "cpu-fallback"
+    assert rec["n"] == 512 and rec["metrics"]["potrf_gflops"] == 1.5
+
+    # bench_serve artifact (nested tracked metric via dotted path)
+    _write(tmp_path, "BENCH_SERVE_smoke.json", {
+        "bench": "serve", "backend": "cpu", "n": 192, "nb": 64,
+        "requests": 48, "max_batch": 16,
+        "serve": {"solves_per_sec": 120.0},
+        "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3})
+    rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
+    assert rec["kind"] == "serve" and rec["platform"] == "cpu"
+    assert rec["metrics"]["serve.solves_per_sec"] == 120.0
+    assert rec["metrics"]["speedup"] == 13.3
+
+
+def test_normalize_rejects_unknown_schema(tmp_path):
+    _write(tmp_path, "BENCH_r99.json", {"something": "else"})
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize(str(tmp_path / "BENCH_r99.json"))
+    (tmp_path / "BENCH_r98.json").write_text("{not json")
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize(str(tmp_path / "BENCH_r98.json"))
+
+
+def test_failed_round_is_excluded_not_an_error(tmp_path):
+    # round 3's rc=1 wrapper (a crashed bench run) must normalize (the
+    # history stays schema-clean) but contribute no gated points
+    _write(tmp_path, "BENCH_r03.json", {
+        "n": 3, "cmd": "python bench.py", "rc": 1,
+        "tail": "Traceback ..."})
+    rec = gate_mod.normalize(str(tmp_path / "BENCH_r03.json"))
+    assert rec["ok"] is False and rec["metrics"] == {}
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_injected_tpu_regression_fails_gate(tmp_path, capsys):
+    _write(tmp_path, "BENCH_r01.json",
+           _bench_artifact(15000.0, potrf_gflops=5000.0))
+    _write(tmp_path, "BENCH_r02.json",
+           _bench_artifact(15100.0, potrf_gflops=3000.0))  # -40% potrf
+    rc = gate_mod.main(["--dir", str(tmp_path)])
+    assert rc == 1
+    summary = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert summary["ok"] is False
+    (reg,) = summary["regressions"]
+    assert reg["metric"] == "potrf_gflops" and reg["platform"] == "tpu"
+    assert reg["best_prior"] == 5000.0 and reg["last"] == 3000.0
+
+
+def test_within_tolerance_passes(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_artifact(15000.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_artifact(14000.0))  # -6.7%
+    assert gate_mod.main(["--dir", str(tmp_path)]) == 0
+    # ...and the same drop fails under a tighter tolerance
+    assert gate_mod.main(["--dir", str(tmp_path),
+                          "--tolerance", "0.05"]) == 1
+
+
+def test_cpu_drop_is_informational_only(tmp_path, capsys):
+    # the documented policy: CPU smoke numbers are dispatch-noise-
+    # dominated (PERF.md rounds 6-7) — reported, never gated
+    _write(tmp_path, "BENCH_r01.json",
+           _bench_artifact(100.0, platform="cpu-fallback", n=512))
+    _write(tmp_path, "BENCH_r02.json",
+           _bench_artifact(10.0, platform="cpu-fallback", n=512))
+    rc = gate_mod.main(["--dir", str(tmp_path)])
+    summary = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rc == 0 and summary["ok"] is True
+    assert summary["informational_drops"]
+
+
+def test_series_keyed_by_platform_and_n(tmp_path):
+    # a TPU round at n=16384 must NOT gate against an n=8192 round,
+    # nor against a CPU round at any size
+    _write(tmp_path, "BENCH_r01.json", _bench_artifact(15000.0, n=8192))
+    _write(tmp_path, "BENCH_r02.json",
+           _bench_artifact(100.0, platform="cpu-fallback", n=8192))
+    _write(tmp_path, "BENCH_r03.json", _bench_artifact(900.0, n=16384))
+    assert gate_mod.main(["--dir", str(tmp_path)]) == 0
+
+
+# -- --check-schema ---------------------------------------------------------
+
+
+def test_check_schema_flags_corrupt_artifact(tmp_path, capsys):
+    _write(tmp_path, "BENCH_r01.json", _bench_artifact(1.0))
+    assert gate_mod.main(["--dir", str(tmp_path), "--check-schema"]) == 0
+    capsys.readouterr()
+    (tmp_path / "BENCH_r02.json").write_text('{"bogus": true}')
+    assert gate_mod.main(["--dir", str(tmp_path), "--check-schema"]) == 1
+    summary = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert summary["schema_errors"]
+
+
+# -- the real committed history (the acceptance pins) -----------------------
+
+
+def test_real_history_schema_clean():
+    paths = gate_mod.discover(str(_REPO))
+    assert len(paths) >= 8  # seven BENCH rounds + the serve smoke
+    assert gate_mod.check_schema(paths) == []
+
+
+def test_real_history_passes_gate(capsys):
+    rc = gate_mod.main(["--dir", str(_REPO)])
+    summary = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rc == 0 and summary["ok"] is True
+    assert summary["rounds"], "trajectory read as empty"
+    # the known CPU-smoke noise shows up as informational, proving the
+    # platform split actually separated the series
+    assert all(r["platform"] not in gate_mod.GATED_PLATFORMS
+               for r in summary["informational_drops"])

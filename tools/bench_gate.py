@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression gate over the committed BENCH artifacts.
+
+Seven rounds of ``BENCH_r*.json`` (plus ``BENCH_SERVE_*.json``) sit in
+the repo and form a performance trajectory nothing read until now —
+regressions were invisible until a human reread PERF.md. This tool:
+
+1. **Normalizes** the three artifact schemas that accumulated across
+   rounds into one record shape
+   ``{round, source, kind, platform, n, ok, metrics:{name: float}}``:
+
+   * the harness wrapper (rounds 1–5): ``{"n": round, "cmd", "rc",
+     "tail", "parsed": {...}}`` — metrics from ``parsed``, platform
+     inferred from the tail (the axon warning / ``platform=tpu`` probe
+     line / the CPU-fallback notice) when ``parsed`` lacks it;
+   * the bare bench.py artifact (rounds 6+, ``--out``): has a
+     ``"metric"`` key; ``n`` parsed out of the metric name;
+   * the bench_serve artifact: ``{"bench": "serve", "backend", ...}``.
+
+2. **Gates**: for every tracked metric, series are keyed by
+   ``(metric, platform, n)`` — numbers from different backends or
+   problem sizes are never compared. The newest gateable round is
+   compared against the best prior value in the same series; the gate
+   FAILS (exit 1) on a drop beyond the tolerance. Policy (PERF.md
+   Round 9): only TPU series gate — the CPU smoke rounds are
+   dispatch-noise-dominated by the repo's own repeated measurement
+   (PERF.md rounds 6–7 call their CPU totals "a wash") and are
+   reported informationally. Default tolerance 10 %.
+
+3. **Summarizes**: one JSON line on stdout — rounds seen, series
+   tracked, regressions — machine-greppable trajectory state.
+
+``--check-schema`` validates every committed ``BENCH_*.json`` against
+the normalized schema and exits nonzero on any unparseable artifact
+(this is why the trajectory read as empty: nothing enforced the
+files). Wired into examples/run_tests.py beside tools/obs_dump.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+# metric name -> where to find it in a bare bench.py artifact; every
+# tracked metric is higher-is-better (GFLOP/s, solves/s, speedup)
+TRACKED_BENCH = ("value", "potrf_gflops", "getrf_gflops",
+                 "getrf_calu_gflops", "geqrf_gflops", "gemm_high_gflops")
+TRACKED_SERVE = ("serve.solves_per_sec", "speedup")
+GATED_PLATFORMS = ("tpu", "axon")
+DEFAULT_TOLERANCE = 0.10
+
+_N_RE = re.compile(r"_n(\d+)$")
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _infer_platform_from_tail(tail: str) -> Optional[str]:
+    if "CPU fallback" in tail or "cpu-fallback" in tail:
+        return "cpu-fallback"
+    if "platform=tpu" in tail or "'axon'" in tail.lower():
+        return "tpu"
+    if "platform=cpu" in tail:
+        return "cpu"
+    return None
+
+
+def _flat_metrics(parsed: dict, tracked) -> dict:
+    out = {}
+    for name in tracked:
+        cur = parsed
+        for part in name.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                cur = None
+                break
+            cur = cur[part]
+        if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+            out[name] = float(cur)
+    return out
+
+
+def normalize(path: str) -> dict:
+    """One artifact file -> one normalized record (SchemaError when the
+    file fits none of the three known schemas)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SchemaError(f"{name}: unreadable JSON ({e})")
+    if not isinstance(obj, dict):
+        raise SchemaError(f"{name}: top level is not an object")
+
+    m = _ROUND_RE.search(name)
+    fname_round = int(m.group(1)) if m else None
+
+    if obj.get("bench") == "serve":
+        for k in ("backend", "n", "serve", "per_request", "speedup"):
+            if k not in obj:
+                raise SchemaError(f"{name}: serve artifact missing {k!r}")
+        return {
+            "round": fname_round, "source": name, "kind": "serve",
+            "platform": str(obj["backend"]), "n": int(obj["n"]),
+            "ok": True, "metrics": _flat_metrics(obj, TRACKED_SERVE),
+        }
+
+    if "cmd" in obj and "rc" in obj:  # rounds 1-5 harness wrapper
+        rnd = obj.get("n", fname_round)
+        if not isinstance(rnd, int):
+            raise SchemaError(f"{name}: wrapper round index missing")
+        ok = obj["rc"] == 0
+        parsed = obj.get("parsed") or {}
+        if ok and "metric" not in parsed:
+            raise SchemaError(f"{name}: rc=0 wrapper without parsed "
+                              "metrics")
+        platform = (parsed.get("platform")
+                    or _infer_platform_from_tail(str(obj.get("tail", "")))
+                    or "unknown")
+        n = None
+        mm = _N_RE.search(parsed.get("metric", ""))
+        if mm:
+            n = int(mm.group(1))
+        return {
+            "round": rnd, "source": name, "kind": "bench",
+            "platform": platform, "n": n, "ok": ok,
+            "metrics": _flat_metrics(parsed, TRACKED_BENCH) if ok else {},
+        }
+
+    if "metric" in obj and "value" in obj:  # bare bench.py artifact
+        mm = _N_RE.search(obj["metric"])
+        return {
+            "round": fname_round, "source": name, "kind": "bench",
+            "platform": str(obj.get("platform", "unknown")),
+            "n": int(mm.group(1)) if mm else None,
+            "ok": "error" not in obj,
+            "metrics": _flat_metrics(obj, TRACKED_BENCH),
+        }
+
+    raise SchemaError(f"{name}: matches no known BENCH schema "
+                      "(wrapper / bench.py / serve)")
+
+
+def discover(root: str) -> List[str]:
+    paths = (glob.glob(os.path.join(root, "BENCH_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_SERVE*.json")))
+    # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
+    # fixtures beside the headline artifact — different schema, not
+    # part of the trajectory
+    return sorted(p for p in paths if not p.endswith(".metrics.json"))
+
+
+def _series_key(rec: dict, metric: str):
+    return (rec["kind"], metric, rec["platform"], rec["n"])
+
+
+def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
+         ) -> dict:
+    """Compare the newest gateable record of every (metric, platform,
+    n) series against the best prior value. Only GATED_PLATFORMS fail
+    the gate; other platforms are summarized as informational."""
+    series: dict = {}
+    for rec in sorted(records,
+                      key=lambda r: (r["round"] is None, r["round"] or 0)):
+        if not rec["ok"]:
+            continue
+        for metric, value in rec["metrics"].items():
+            series.setdefault(_series_key(rec, metric), []).append(
+                {"round": rec["round"], "source": rec["source"],
+                 "value": value})
+    regressions, informational = [], []
+    for key, points in series.items():
+        if len(points) < 2:
+            continue
+        *prior, last = points
+        best = max(p["value"] for p in prior)
+        if best <= 0:
+            continue
+        drop = (best - last["value"]) / best
+        if drop <= tolerance:
+            continue
+        row = {
+            "kind": key[0], "metric": key[1], "platform": key[2],
+            "n": key[3], "best_prior": best, "last": last["value"],
+            "drop_pct": round(100 * drop, 1),
+            "last_source": last["source"],
+        }
+        (regressions if key[2] in GATED_PLATFORMS
+         else informational).append(row)
+    return {
+        "rounds": sorted({r["round"] for r in records
+                          if r["round"] is not None}),
+        "artifacts": len(records),
+        "series": len(series),
+        "tolerance": tolerance,
+        "regressions": regressions,
+        "informational_drops": informational,
+        "ok": not regressions,
+    }
+
+
+def check_schema(paths: List[str]) -> List[str]:
+    """Validate every artifact; returns error strings (empty = clean)."""
+    errors = []
+    for path in paths:
+        try:
+            normalize(path)
+        except SchemaError as e:
+            errors.append(str(e))
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=None,
+                   help="artifact directory (default: the repo root, "
+                        "i.e. this file's parent's parent)")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="max fractional drop vs the best prior round "
+                        f"(default {DEFAULT_TOLERANCE})")
+    p.add_argument("--check-schema", action="store_true",
+                   help="only validate artifact schemas (exit 1 on any "
+                        "unparseable BENCH_*.json)")
+    args = p.parse_args(argv)
+    root = args.dir or os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir)
+    paths = discover(root)
+    if not paths:
+        print(json.dumps({"ok": False,
+                          "error": f"no BENCH_*.json under {root}"}))
+        return 1
+    errors = check_schema(paths)
+    if args.check_schema:
+        print(json.dumps({"checked": len(paths),
+                          "schema_errors": errors, "ok": not errors}))
+        return 0 if not errors else 1
+    if errors:
+        print(json.dumps({"ok": False, "schema_errors": errors}))
+        return 1
+    records = [normalize(p_) for p_ in paths]
+    summary = gate(records, tolerance=args.tolerance)
+    print(json.dumps(summary, sort_keys=True))
+    for row in summary["regressions"]:
+        print(f"!!! regression: {row['metric']} "
+              f"[{row['platform']}, n={row['n']}] "
+              f"{row['best_prior']:.1f} -> {row['last']:.1f} "
+              f"(-{row['drop_pct']}%, {row['last_source']})",
+              file=sys.stderr)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

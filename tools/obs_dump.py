@@ -8,12 +8,18 @@ text exposition, and the HTTP endpoint — then writes the artifacts:
   <out-dir>/trace.json    Chrome-trace/Perfetto JSON of the span tree
   <out-dir>/metrics.prom  Prometheus text (same bytes as GET /metrics)
   <out-dir>/metrics.json  Metrics snapshot JSON
+  <out-dir>/costs.json    per-shape cost rows (model flops, XLA
+                          bytes-accessed, temp/peak HBM, collective
+                          census) + bytes ledger + roofline join
   <out-dir>/trace.svg     legacy SVG timeline (utils.trace)
 
 Exit status is nonzero if the Chrome JSON fails schema validation
 (obs.validate_chrome_trace: required keys, monotone ts, span nesting),
-if the span tree is disconnected, or if the HTTP endpoint serves the
-wrong payloads — wired into examples/run_tests.py as the obs smoke.
+if the span tree is disconnected, if the HTTP endpoint serves the
+wrong payloads, or if the round-9 cost exports are missing/incomplete
+(empty cost_log, absent Prometheus bytes/HBM sections, or a mesh run
+that credited zero collective bytes) — wired into examples/run_tests.py
+as the obs smoke.
 
 Usage: python tools/obs_dump.py [--smoke] [--out-dir DIR]
                                 [--n N] [--nb NB] [--requests R]
@@ -27,14 +33,32 @@ import tempfile
 import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-from slate_tpu.compat.platform import apply_env_platforms  # noqa: E402
+from slate_tpu.compat.platform import (  # noqa: E402
+    apply_env_platforms, collective_timeout_flag_if_supported)
 
 apply_env_platforms()
+
+# On the CPU backend, run the smoke on an 8-way virtual-device mesh so
+# the MESH-driver cost telemetry (parallel.summa collective bytes —
+# round 9 acceptance) is exercised; must land in XLA_FLAGS before jax
+# initializes. The rendezvous-timeout raise is probe-gated exactly like
+# tests/conftest.py (unknown XLA_FLAGS abort some jaxlib builds).
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        _flags = (_flags + " --xla_force_host_platform_device_count=8"
+                  ).strip()
+        _flags += collective_timeout_flag_if_supported(
+            cache_path=os.path.join(os.path.dirname(__file__), os.pardir,
+                                    ".xla_flag_probe.json"))
+        os.environ["XLA_FLAGS"] = _flags
 
 import numpy as np  # noqa: E402
 
 
 def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
+    import jax
+
     import slate_tpu as st
     from slate_tpu import obs
     from slate_tpu.runtime import Executor, Session
@@ -67,6 +91,27 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         if not resid < 1e-2:
             fails.append(f"serving residual too large: {resid}")
 
+        # -- mesh-driver cost telemetry (round 9) ---------------------
+        # one explicitly-scheduled SUMMA gemm over a 2x2 grid: its
+        # compiled program's collective census must land in the bytes
+        # ledger (the acceptance's "collective bytes for at least one
+        # mesh driver"). Skipped (honestly) below 4 devices.
+        mesh_ran = False
+        if len(jax.devices()) >= 4:
+            from slate_tpu.core.grid import ProcessGrid
+            from slate_tpu.parallel.summa import gemm_summa
+
+            g = ProcessGrid.create(2, 2)
+            Ag = st.from_dense(rng.standard_normal((n, n)), nb=nb, grid=g)
+            Bg = st.from_dense(rng.standard_normal((n, n)), nb=nb, grid=g)
+            Cg = st.zeros(n, n, nb, Ag.dtype, grid=g)
+            out = gemm_summa(1.0, Ag, Bg, 0.0, Cg)
+            gres = float(np.abs(out.to_numpy()
+                                - Ag.to_numpy() @ Bg.to_numpy()).max()) / n
+            if not gres < 1e-2:
+                fails.append(f"summa residual too large: {gres}")
+            mesh_ran = True
+
         # -- exports --------------------------------------------------
         spans = tracer.spans()
         trace_path = os.path.join(out_dir, "trace.json")
@@ -93,6 +138,42 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
             f.write(prom)
         if "slate_tpu_solves_total" not in prom:
             fails.append("prometheus text missing solves_total")
+        # round-9 sections: bytes/collective ledgers + HBM gauges
+        for needle in ("slate_tpu_driver_bytes_total",
+                       "slate_tpu_collective_bytes_total",
+                       "slate_tpu_peak_hbm_bytes",
+                       "slate_tpu_resident_bytes"):
+            if needle not in prom:
+                fails.append(f"prometheus text missing {needle}")
+
+        # -- cost exports (round 9): per-shape rows + ledgers ---------
+        bytes_snap = obs.costs.BYTES.snapshot()
+        costs_doc = {
+            "cost_log": sess.cost_log,
+            "bytes_ledger": bytes_snap,
+            "roofline": obs.roofline.roofline_report(),
+        }
+        with open(os.path.join(out_dir, "costs.json"), "w") as f:
+            json.dump(costs_doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        # schema: every AOT-compiled shape exports the full cost row
+        if not sess.cost_log:
+            fails.append("cost_log empty: AOT seam harvested nothing")
+        for row in sess.cost_log:
+            for k in ("op", "what", "shape", "model_flops",
+                      "bytes_accessed", "temp_bytes", "peak_bytes",
+                      "collective_bytes"):
+                if k not in row:
+                    fails.append(f"cost_log row missing {k!r}")
+                    break
+        if mesh_ran:
+            summa_ops = [op for op in bytes_snap["per_op"]
+                         if op.startswith("parallel.summa")]
+            if not summa_ops:
+                fails.append("mesh driver credited no bytes-ledger op")
+            elif not any(bytes_snap["per_op"][op]["collective_bytes"] > 0
+                         for op in summa_ops):
+                fails.append("mesh driver recorded zero collective bytes")
 
         svg = legacy_trace.Trace.finish(os.path.join(out_dir, "trace.svg"))
         if svg is None:
