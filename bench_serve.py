@@ -19,6 +19,19 @@ Artifact schema (JSON, one object; see PERF.md "bench_serve artifact"):
 --smoke: small shapes on CPU, <60 s, exit 0 iff the artifact was
 written and cached-factor serving beat per-request factor+solve
 (speedup > 1) — wired into examples/run_tests.py.
+
+--batched (round 10): the many-small-problems A/B — B independent
+small systems served as ONE batched program (api.gesv_batched /
+posv_batched through the pow2 batch-bucket engine) vs B per-request
+programs (the same engine at B=1 per call). Emits one
+``serve_batched`` row per (op, n, B) combo to ``--batched-out``
+(BENCH_r08.json) — a JSON LIST that tools/bench_gate.py normalizes and
+gates per (metric, platform, n, batch) series. The per-request arm is
+measured on a bounded sample at large B (recorded in the row); the
+throughput claim on CPU is SMOKE ONLY — in-op batch parallelism is a
+TPU lowering property, backed structurally by the rows'
+``hlo_one_program`` flag (no per-item factorization custom-call loop
+in the batched program, same evidence class as rounds 6–7).
 """
 
 import argparse
@@ -127,17 +140,142 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     return artifact
 
 
+def _hlo_one_program(name: str, batch: int, n: int) -> bool:
+    """Structural evidence for one row: THIS row's bucket program's
+    optimized HLO carries NO per-item factorization custom call (a
+    vmap of lax.linalg custom calls would — the lowering class round 7
+    measured 6× slower). Filtered to the row's (pow2 batch, n) program
+    so one offending shape can't taint every other row's flag."""
+    import re as _re
+
+    from slate_tpu.linalg import batched as lb
+
+    texts = lb.bucket_hlo(name, batch=batch, n=n)
+    if not texts:
+        return False
+    pat = _re.compile(r"custom-call.*(getrf|potrf|geqrf|lu|cholesky)",
+                      _re.IGNORECASE)
+    return not any(pat.search(t) for t in texts)
+
+
+def bench_batched(batch_sizes=(100, 1000, 10000), sizes=(32, 64, 128, 256),
+                  ops=("gesv", "posv"), dtype=np.float32,
+                  per_request_cap=64, mem_cap_bytes=1 << 30,
+                  out_path="BENCH_r08.json"):
+    """Req/s A/B per (op, n, B): ONE batched program vs B per-request
+    (B=1) programs, both through the pow2-bucket engine, both warmed
+    (compilation excluded — the bucket cache makes it a one-time cost
+    per (op, n, nb, dtype, pow2-B)). Writes a JSON list of
+    ``serve_batched`` rows."""
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.linalg import batched as lb
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(23)
+    rows = []
+    for n in sizes:
+        for bsz in batch_sizes:
+            itemsize = np.dtype(dtype).itemsize
+            need = lb.batch_bucket(bsz) * n * n * itemsize * 4
+            if need > mem_cap_bytes:
+                print(f"# skip n={n} B={bsz}: ~{need >> 20} MiB stacked "
+                      f"operands over the {mem_cap_bytes >> 20} MiB cap",
+                      file=sys.stderr)
+                continue
+            base = rng.standard_normal((bsz, n, n)).astype(dtype)
+            rhs = rng.standard_normal((bsz, n, 2)).astype(dtype)
+            for op in ops:
+                if op == "posv":
+                    a = (base @ np.swapaxes(base, 1, 2)
+                         + n * np.eye(n, dtype=dtype))
+                    fn = st.posv_batched
+                else:
+                    a = base
+                    fn = st.gesv_batched
+                # warm both program buckets (pow2-B and B=1)
+                jax.block_until_ready(fn(a, rhs)[0])
+                jax.block_until_ready(fn(a[:1], rhs[:1])[0])
+                t0 = time.perf_counter()
+                x, info = fn(a, rhs)
+                jax.block_until_ready(x)
+                batched_wall = time.perf_counter() - t0
+                # per-request arm: bounded sample, same engine at B=1
+                m = min(bsz, per_request_cap)
+                t0 = time.perf_counter()
+                for i in range(m):
+                    xi, _ = fn(a[i:i + 1], rhs[i:i + 1])
+                jax.block_until_ready(xi)
+                per_req_wall = (time.perf_counter() - t0) * (bsz / m)
+                row = {
+                    "bench": "serve_batched", "platform": platform,
+                    "dtype": np.dtype(dtype).name, "op": op,
+                    "n": n, "batch": bsz,
+                    "bucket": lb.batch_bucket(bsz),
+                    "batched": {
+                        "wall_s": batched_wall,
+                        "reqs_per_sec": bsz / batched_wall,
+                    },
+                    "per_request": {
+                        "wall_s": per_req_wall,
+                        "reqs_per_sec": bsz / per_req_wall,
+                        "sampled": m,
+                    },
+                    "speedup": per_req_wall / batched_wall,
+                    "hlo_one_program": _hlo_one_program(
+                        f"{op}_batched", lb.batch_bucket(bsz), n),
+                }
+                rows.append(row)
+                print(f"# {op} n={n} B={bsz}: batched "
+                      f"{row['batched']['reqs_per_sec']:.0f} req/s vs "
+                      f"per-request "
+                      f"{row['per_request']['reqs_per_sec']:.0f} req/s "
+                      f"({row['speedup']:.2f}x, "
+                      f"one-program={row['hlo_one_program']})",
+                      file=sys.stderr)
+    with open(out_path, "w") as f:
+        json.dump(rows, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"rows": len(rows), "out": out_path,
+                      "platform": platform}))
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
                    help="small CPU run, <60 s; exit 0 iff serving beat "
-                        "per-request factor+solve")
+                        "per-request factor+solve (and, with --batched, "
+                        "iff the batched rows were written and "
+                        "structurally one-program)")
+    p.add_argument("--batched", action="store_true",
+                   help="run the many-small-problems req/s A/B instead "
+                        "of the resident-factor bench")
     p.add_argument("--n", type=int, default=512)
     p.add_argument("--nb", type=int, default=128)
     p.add_argument("--requests", type=int, default=64)
     p.add_argument("--max-batch", type=int, default=16)
     p.add_argument("--out", default="BENCH_SERVE.json")
+    p.add_argument("--batched-out", default="BENCH_r08.json")
+    p.add_argument("--batch-sizes", type=int, nargs="+",
+                   default=[100, 1000, 10000])
+    p.add_argument("--sizes", type=int, nargs="+",
+                   default=[32, 64, 128, 256])
     args = p.parse_args(argv)
+    if args.batched:
+        if args.smoke:
+            # CPU smoke: tiny stacks, exit on schema/structure only —
+            # the throughput number is dispatch-noise on a host CPU
+            rows = bench_batched(batch_sizes=(24, 100), sizes=(32, 48),
+                                 per_request_cap=16,
+                                 out_path=args.batched_out)
+        else:
+            rows = bench_batched(batch_sizes=tuple(args.batch_sizes),
+                                 sizes=tuple(args.sizes),
+                                 out_path=args.batched_out)
+        ok = bool(rows) and all(r["hlo_one_program"] for r in rows)
+        return 0 if ok else 1
     if args.smoke:
         args.n, args.nb, args.requests = 192, 64, 48
         args.out = (args.out if args.out != "BENCH_SERVE.json"

@@ -81,6 +81,19 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --smoke FAILED")
+    # many-small-problems smoke (round 10): batched vs per-request
+    # req/s rows into a throwaway artifact; exits nonzero unless every
+    # batched program is structurally one-program (no per-item
+    # factorization custom-call loop in the HLO)
+    print("=== bench_serve.py --batched --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--batched", "--smoke", "--batched-out",
+         "/tmp/BENCH_r08_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --batched --smoke FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure)

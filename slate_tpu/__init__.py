@@ -16,10 +16,9 @@ from . import matgen
 from .linalg import (norm, col_norms, gemm, symm, hemm, syrk, herk, syr2k,
                      her2k, trmm, trsm, gbmm, hbmm, tbsm, add, copy, scale,
                      scale_row_col, set_matrix, set_lambda, redistribute,
-                     potrf, potrs, posv, trtri, trtrm, potri, posv_mixed,
+                     potrf, potrs, posv, trtri, trtrm, potri,
                      getrf, getrf_nopiv, getrf_tntpiv, getrs, gesv,
-                     gesv_nopiv, gesv_rbt, gesv_mixed, gesv_mixed_gmres,
-                     posv_mixed_gmres, getri, getri_oop, gerbt,
+                     gesv_nopiv, gesv_rbt, getri, getri_oop, gerbt,
                      QRFactors, geqrf, unmqr, gelqf, unmlq, cholqr, tsqr,
                      gels, qr_multiply_explicit,
                      gbtrf, gbtrs, gbsv, pbtrf, pbtrs, pbsv,
@@ -38,6 +37,12 @@ from .api import (multiply, rank_k_update, rank_2k_update,
                   chol_factor, chol_solve, chol_solve_using_factor,
                   chol_inverse_using_factor, band_solve, indefinite_solve,
                   qr_factor, least_squares_solve_using_factor,
-                  least_squares_solve)
+                  least_squares_solve, gesv_batched, posv_batched,
+                  geqrf_batched, gels_batched,
+                  # the instrumented api wrappers, NOT the raw linalg
+                  # drivers — st.gesv_mixed must credit the flop ledger
+                  # like every other public verb (round-10 satellite)
+                  gesv_mixed, posv_mixed, gesv_mixed_gmres,
+                  posv_mixed_gmres)
 from . import runtime
 from . import obs

@@ -21,11 +21,14 @@ Session records ``serve.factor``/``serve.solve`` ledger ops).
 
 from __future__ import annotations
 
+import numpy as _np
+
 from . import obs as _obs
 from .core.exceptions import SlateError
 from .core.tiled_matrix import TiledMatrix
 from .core.types import MatrixKind, Options, Side, DEFAULT_OPTIONS
-from .linalg import (blas3, band as band_mod, cholesky, indefinite, lu as
+from .linalg import (batched as batched_mod, blas3, band as band_mod,
+                     cholesky, gmres as gmres_mod, indefinite, lu as
                      lu_mod, qr as qr_mod)
 from .linalg.band_packed import PackedBand
 
@@ -284,3 +287,143 @@ def least_squares_solve(A: TiledMatrix, B: TiledMatrix,
                      _flops.gels(A.shape[0], A.shape[1]),
                      m=A.shape[0], n=A.shape[1], k=B.shape[1]):
         return qr_mod.gels(A, B, opts)
+
+
+# ---------------------------------------------------------------------------
+# batched small-problem verbs (round 10)
+# ---------------------------------------------------------------------------
+# The many-small-problems engine at the api layer: [B, n, n] stacks
+# through the hand-batched blocked kernels (linalg/batched over
+# ops/blocked — never vmap of per-item custom calls), one compiled
+# program per (op, pow2-B-bucket, n, nb, dtype). The FLOP ledger is
+# credited B × the per-item model — a batch of B small solves is B
+# solves' worth of work whichever lowering executes it. SLATE analog:
+# the HostBatch/Devices batched-gemm target class (PAPER.md L3).
+# No Options parameter on these verbs: matmul precision is pinned
+# HIGHEST inside each bucket program (a cache hit must never change
+# numerics — linalg/batched), so nb is the only meaningful knob.
+
+
+def _stack_dims(A, what: str):
+    shape = tuple(_np.shape(A))
+    if len(shape) != 3:
+        raise SlateError(f"{what}: expected a [B, m, n] stack, got "
+                         f"shape {shape}")
+    return shape
+
+
+def _rhs_cols(B) -> int:
+    shape = tuple(_np.shape(B))
+    return shape[2] if len(shape) == 3 else 1
+
+
+def gesv_batched(A, B, nb=None):
+    """Batched A·X = B over a [B, n, n] stack → (X, info[B]): batched
+    LU factor + solve as ONE compiled program per batch bucket."""
+    bsz, _, n = _stack_dims(A, "gesv_batched")
+    k = _rhs_cols(B)
+    fl = bsz * (_flops.getrf(n) + _flops.solve_flops("lu", n, n, k))
+    with _obs.driver("gesv_batched", fl, b=bsz, n=n, k=k):
+        return batched_mod.gesv_batched(A, B, nb)
+
+
+def posv_batched(A, B, nb=None):
+    """Batched Hermitian-positive-definite A·X = B (lower storage) over
+    a [B, n, n] stack → (X, info[B]): batched Cholesky factor + solve
+    as ONE compiled program per batch bucket."""
+    bsz, _, n = _stack_dims(A, "posv_batched")
+    k = _rhs_cols(B)
+    fl = bsz * (_flops.potrf(n) + _flops.solve_flops("chol", n, n, k))
+    with _obs.driver("posv_batched", fl, b=bsz, n=n, k=k):
+        return batched_mod.posv_batched(A, B, nb)
+
+
+def geqrf_batched(A, nb=None):
+    """Batched Householder QR over a [B, m, n] stack (m ≥ n) →
+    (packed V\\R, taus, Ts) — the factor the batched least-squares
+    solve (gels_batched_using_factor) consumes."""
+    bsz, m, n = _stack_dims(A, "geqrf_batched")
+    fl = bsz * _flops.geqrf(m, n)
+    with _obs.driver("geqrf_batched", fl, b=bsz, m=m, n=n):
+        return batched_mod.geqrf_batched(A, nb)
+
+
+def gels_batched(A, B, nb=None):
+    """Batched least squares min‖A·X − B‖ over a [B, m, n] stack
+    (m ≥ n) → (X, info[B]): batched QR factor + solve as ONE compiled
+    program per batch bucket."""
+    bsz, m, n = _stack_dims(A, "gels_batched")
+    fl = bsz * _flops.gels(m, n)
+    with _obs.driver("gels_batched", fl, b=bsz, m=m, n=n,
+                     k=_rhs_cols(B)):
+        return batched_mod.gels_batched(A, B, nb)
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision solves (round 10 satellite; ROADMAP item 2 first step)
+# ---------------------------------------------------------------------------
+# The linalg drivers existed since the seed (slate::gesv_mixed /
+# posv_mixed, src/gesv_mixed.cc; the *_mixed_gmres GMRES-IR variants,
+# src/gesv_mixed_gmres.cc) but were reachable only as linalg internals.
+# Exposed here with the driver-hook ledger discipline every other verb
+# follows, and with the refinement iteration count surfaced — the
+# number a caller needs to decide whether low-precision factorization
+# is paying for itself on their operator.
+
+
+def gesv_mixed(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS, factor_dtype=None):
+    """Solve A·X = B with a low-precision LU factor + iterative
+    refinement in the working precision → (X, info, iters); iters < 0
+    ⇒ the full-precision fallback ran (reference convention)."""
+    import jax.numpy as jnp
+    factor_dtype = jnp.float32 if factor_dtype is None else factor_dtype
+    n, k = A.shape[1], B.shape[1]
+    fl = _flops.getrf(n) + _flops.solve_flops("lu", n, n, k)
+    with _obs.driver("gesv_mixed", fl, n=n, k=k, dtype=str(A.dtype),
+                     factor_dtype=str(jnp.dtype(factor_dtype))):
+        return lu_mod.gesv_mixed(A, B, opts, factor_dtype=factor_dtype)
+
+
+def posv_mixed(A: TiledMatrix, B: TiledMatrix,
+               opts: Options = DEFAULT_OPTIONS, factor_dtype=None):
+    """Hermitian-positive-definite mixed-precision solve → (X, info,
+    iters); iters < 0 ⇒ full-precision fallback."""
+    import jax.numpy as jnp
+    factor_dtype = jnp.float32 if factor_dtype is None else factor_dtype
+    n, k = A.shape[1], B.shape[1]
+    fl = _flops.potrf(n) + _flops.solve_flops("chol", n, n, k)
+    with _obs.driver("posv_mixed", fl, n=n, k=k, dtype=str(A.dtype),
+                     factor_dtype=str(jnp.dtype(factor_dtype))):
+        return cholesky.posv_mixed(A, B, opts, factor_dtype=factor_dtype)
+
+
+def gesv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
+                     opts: Options = DEFAULT_OPTIONS, factor_dtype=None):
+    """GMRES-IR solve: low-precision LU as the preconditioner, FGMRES
+    in the working precision → (X, info, iters); iters < 0 ⇒ not
+    converged / fallback (see linalg.gmres.gesv_mixed_gmres)."""
+    import jax.numpy as jnp
+    factor_dtype = jnp.float32 if factor_dtype is None else factor_dtype
+    n, k = A.shape[1], B.shape[1]
+    fl = _flops.getrf(n) + _flops.solve_flops("lu", n, n, k)
+    with _obs.driver("gesv_mixed_gmres", fl, n=n, k=k,
+                     dtype=str(A.dtype),
+                     factor_dtype=str(jnp.dtype(factor_dtype))):
+        return gmres_mod.gesv_mixed_gmres(A, B, opts,
+                                          factor_dtype=factor_dtype)
+
+
+def posv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
+                     opts: Options = DEFAULT_OPTIONS, factor_dtype=None):
+    """GMRES-IR Hermitian-positive-definite solve: low-precision
+    Cholesky preconditioner, FGMRES refinement → (X, info, iters)."""
+    import jax.numpy as jnp
+    factor_dtype = jnp.float32 if factor_dtype is None else factor_dtype
+    n, k = A.shape[1], B.shape[1]
+    fl = _flops.potrf(n) + _flops.solve_flops("chol", n, n, k)
+    with _obs.driver("posv_mixed_gmres", fl, n=n, k=k,
+                     dtype=str(A.dtype),
+                     factor_dtype=str(jnp.dtype(factor_dtype))):
+        return gmres_mod.posv_mixed_gmres(A, B, opts,
+                                          factor_dtype=factor_dtype)

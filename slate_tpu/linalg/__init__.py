@@ -21,7 +21,7 @@ from .gmres import gesv_mixed_gmres, posv_mixed_gmres
 from .indefinite import (hesv, hetrf, hetrs, hetrf_nopiv,
                          hetrs_nopiv)
 # Explicit submodule attributes (not just import side effects):
-from . import (band, blas3, cholesky, condest, eig, elementwise,
+from . import (band, batched, blas3, cholesky, condest, eig, elementwise,
                gmres, indefinite, lu, qr)
 # The driver function `svd` shadows the submodule attribute of the same
 # name (so `import slate_tpu.linalg.svd as m` would bind the *function*).

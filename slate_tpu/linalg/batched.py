@@ -1,0 +1,446 @@
+"""Batched small-problem drivers: many [B, n, n] systems, ONE program.
+
+Production traffic is overwhelmingly *many small systems*, not one
+giant one — the reference's answer is the HostBatch/Devices batched-
+gemm target class (PAPER.md L3) and the batched one-sided
+factorizations of Haidar et al. (IJHPCA 2015). This module is the
+driver layer over the hand-batched blocked kernels in ops/blocked.py
+(potrf_batched / getrf_batched / geqrf_batched and the batched
+triangular solves) — which are never ``vmap`` of per-item custom calls
+(backends execute those as a sequential per-item loop; the round-7
+CALU measurement was 6× slower with ~40 s more compile).
+
+**Pow2 batch-bucket compilation.** Every entry point pads the batch
+dim to the next power of two and runs through a per-bucket compiled
+program cache: one ``jit(...).lower(...).compile()`` per
+(op, B-bucket, n, nb, dtype), so a serving fleet handling arbitrary
+batch sizes compiles ≤ log2(B_max) programs per operator class
+instead of one per batch size. Padding items are identities (LU/QR) —
+they factor cleanly, flag no info, and cannot perturb their neighbors
+because every kernel's arithmetic is batch-independent; results are
+therefore BIT-IDENTICAL across paddings of the same bucket for every
+dtype, and across different buckets (a B=1 per-request run vs a B=100
+batched one) for real dtypes. Complex is the one caveat: XLA:CPU
+FMA-contracts the real mul/add pairs inside fused complex arithmetic
+differently at different batch shapes (a single complex multiply
+reproduces it), so c64 lanes agree across buckets only to a few ulp
+on the CPU backend — exact within a bucket, and not a TPU property
+(complex matmuls lower to real MXU pairs there). All pinned in
+tests/test_batched.py; PERF.md Round 10 documents the caveat.
+
+Per-item ``info`` vectors follow the LAPACK convention (0 = ok,
+k > 0 = first failing column/minor); one singular item flags itself
+and leaves its neighbors' bits untouched.
+
+Observability: each compiled bucket program is cost-analyzed at the
+compile seam (obs/costs.program_costs) and every execution credits the
+process BYTES ledger under the driver name — the round-9 per-execution
+discipline. Model flops are credited B×model by the api.py verbs
+(api.gesv_batched / posv_batched / geqrf_batched / gels_batched).
+Under an outer jax trace the drivers degrade to plain traced calls
+(composition into a larger program; whoever compiles it accounts it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import OrderedDict
+from typing import Hashable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.exceptions import SlateError
+from ..obs import costs as _costs
+from ..ops import blocked
+
+Array = jax.Array
+
+# default panel width for the small-problem regime: one panel for
+# n ≤ 32 (the whole factorization is one hand-batched kernel), 32-wide
+# panels above it (n ≤ 256 stays ≤ 8 python-unrolled outer steps)
+DEFAULT_NB = 32
+
+
+def default_nb(n: int) -> int:
+    return n if n <= DEFAULT_NB else DEFAULT_NB
+
+
+def batch_bucket(b: int) -> int:
+    """Smallest power of two ≥ b — the batch-dim compilation bucket."""
+    return blocked.bucket_pow2(max(int(b), 1), 1)
+
+
+# -- per-bucket compiled program cache --------------------------------------
+
+_LOCK = threading.Lock()
+_PROGRAMS: "OrderedDict[Hashable, Tuple]" = OrderedDict()
+_PROGRAM_CAP = 128
+_COMPILES = 0
+
+
+def _arg_key(args) -> Tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple((tuple(l.shape), str(l.dtype))
+                           for l in leaves))
+
+
+_SUPPRESS = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_accounting():
+    """Skip the per-execution BYTES-ledger crediting inside this block
+    (this thread only). For warmup probes: Session.warmup runs a
+    zero-rhs solve purely to populate the bucket program cache — a
+    probe must not show up as served traffic in the round-9 ledger."""
+    _SUPPRESS.on = True
+    try:
+        yield
+    finally:
+        _SUPPRESS.on = False
+
+
+def _run_bucket(name: str, fn, nb: int, *args):
+    """Run ``fn(*args, nb)`` through the per-bucket program cache: the
+    first call per (name, nb, arg shapes/dtypes) lowers + compiles ONE
+    program (cost-analyzed at the seam), later calls reuse the
+    executable; every execution credits the process bytes ledger under
+    ``name``. Under an outer jax trace this degrades to a plain traced
+    call — the composition is compiled (and accounted) by the caller."""
+    global _COMPILES
+    from ..obs import _jax_eager
+    if not _jax_eager():
+        return fn(*args, nb)
+    key = (name, nb) + _arg_key(args)
+    with _LOCK:
+        hit = _PROGRAMS.get(key)
+        if hit is not None:
+            _PROGRAMS.move_to_end(key)
+    if hit is None:
+        exe = jax.jit(lambda *a: fn(*a, nb)).lower(*args).compile()
+        pc = _costs.program_costs(exe)
+        with _LOCK:
+            _COMPILES += 1
+            _PROGRAMS[key] = hit = (exe, pc)
+            while len(_PROGRAMS) > _PROGRAM_CAP:
+                _PROGRAMS.popitem(last=False)
+    exe, pc = hit
+    if not getattr(_SUPPRESS, "on", False):
+        _costs.BYTES.record_costs(name, pc)
+    return exe(*args)
+
+
+def bucket_stats() -> dict:
+    """Bucket-cache introspection (tests + bench): resident program
+    count and the monotone compile counter — "compiles once per
+    (op, n, nb, dtype, B-bucket)" is asserted against this."""
+    with _LOCK:
+        return {"programs": len(_PROGRAMS), "compiles": _COMPILES}
+
+
+def bucket_hlo(name: str, batch: Optional[int] = None,
+               n: Optional[int] = None):
+    """Optimized-HLO texts of the cached programs for ``name`` — the
+    tests'/bench's structural evidence (no per-item factorization
+    custom-call loop in a batched program). ``batch``/``n`` filter by
+    the FIRST program operand's leading/trailing dims (the [B, m, n]
+    operand stack every driver passes first), so a caller can assert
+    about one specific bucket program instead of everything ever
+    compiled under ``name``."""
+    def _match(key) -> bool:
+        if batch is None and n is None:
+            return True
+        shapes = key[3] if len(key) > 3 else ()
+        if not shapes:
+            return False
+        shp = shapes[0][0]
+        if batch is not None and (not shp or shp[0] != batch):
+            return False
+        if n is not None and (not shp or shp[-1] != n):
+            return False
+        return True
+
+    with _LOCK:
+        entries = [v[0] for k, v in _PROGRAMS.items()
+                   if k[0] == name and _match(k)]
+    out = []
+    for exe in entries:
+        try:
+            out.append(exe.as_text())
+        except Exception:
+            pass
+    return out
+
+
+def clear_programs():
+    """Drop the program cache (tests)."""
+    global _COMPILES
+    with _LOCK:
+        _PROGRAMS.clear()
+        _COMPILES = 0
+
+
+# -- kernels (traced bodies; precision pinned inside the program) -----------
+# Panel/base math must run at HIGHEST regardless of the caller's
+# context (core/precision.py rationale); pinning INSIDE the traced
+# body makes the compiled bucket program independent of call-site
+# context, so a cache hit can never silently change precision.
+
+
+def _k_potrf(a, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.potrf_batched(a, nb)
+
+
+def _k_getrf(a, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.getrf_batched(a, nb)
+
+
+def _k_geqrf(a, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.geqrf_batched(a, nb)
+
+
+def _k_getrs(lu, perm, b, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.getrs_batched(lu, perm, b)
+
+
+def _k_potrs(l, b, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.potrs_batched(l, b)
+
+
+def _k_gels_solve(vr, taus, ts, b, nb):
+    with jax.default_matmul_precision("highest"):
+        return blocked.gels_qr_solve_batched(vr, taus, ts, b, nb)
+
+
+def _k_gesv(a, b, nb):
+    with jax.default_matmul_precision("highest"):
+        lu, perm, info = blocked.getrf_batched(a, nb)
+        return blocked.getrs_batched(lu, perm, b), info
+
+
+def _k_posv(a, b, nb):
+    with jax.default_matmul_precision("highest"):
+        l, info = blocked.potrf_batched(a, nb)
+        return blocked.potrs_batched(l, b), info
+
+
+def _k_gels(a, b, nb):
+    with jax.default_matmul_precision("highest"):
+        vr, taus, ts = blocked.geqrf_batched(a, nb)
+        return blocked.gels_qr_solve_batched(vr, taus, ts, b, nb)
+
+
+# -- stacking / padding helpers ---------------------------------------------
+
+
+def _as_stack(A, what: str) -> Array:
+    a = jnp.asarray(A)
+    if a.ndim != 3:
+        raise SlateError(f"{what}: expected a [B, m, n] stack, got "
+                         f"shape {tuple(a.shape)}")
+    return a
+
+
+def _rhs_stack(B, bsz: int, rows: int, dtype, what: str):
+    """Normalize right-hand sides to a [B, rows, k'] stack; returns
+    (stack, vector_rank, k) where vector_rank restores [B, rows]
+    inputs and k is the CALLER's column count (callers slice
+    ``x[:, :, :k]`` back out).
+
+    k' = max(k, 2): a zero column pads single-column solves because
+    XLA:CPU lowers a batch-of-matvec ([B, n, n]·[B, n, 1]) with a
+    reduction order that DEPENDS on the batch size — k ≥ 2 keeps every
+    per-item gemm in the batch-size-independent regime, which is what
+    makes the B=1 per-request path bit-identical to the batched bucket
+    (pinned by tests/test_batched.py). On TPU any k below the 128
+    lane width pads to the same tile regardless."""
+    b = jnp.asarray(B, dtype=dtype)
+    vector = b.ndim == 2
+    if vector:
+        b = b[:, :, None]
+    if b.ndim != 3 or b.shape[0] != bsz or b.shape[1] != rows:
+        raise SlateError(f"{what}: rhs stack must be [B, {rows}, k] or "
+                         f"[B, {rows}], got {tuple(b.shape)}")
+    k = b.shape[2]
+    if k < 2:
+        b = jnp.concatenate(
+            [b, jnp.zeros((bsz, rows, 2 - k), b.dtype)], axis=2)
+    return b, vector, k
+
+
+def _pad_eye(a: Array, bb: int) -> Array:
+    """Pad the batch dim to the bucket with IDENTITY items: they factor
+    cleanly under every op here (LU picks its unit diagonal pivots, QR
+    of I embeds trivially), flag info = 0, and — the arithmetic being
+    batch-independent — cannot change any real item's bits."""
+    bsz, m, n = a.shape
+    if bsz == bb:
+        return a
+    pad = jnp.broadcast_to(jnp.eye(m, n, dtype=a.dtype)[None],
+                           (bb - bsz, m, n))
+    return jnp.concatenate([a, pad], axis=0)
+
+
+def _pad_zeros(b: Array, bb: int) -> Array:
+    bsz = b.shape[0]
+    if bsz == bb:
+        return b
+    pad = jnp.zeros((bb - bsz,) + b.shape[1:], b.dtype)
+    return jnp.concatenate([b, pad], axis=0)
+
+
+def _pad_arange(perm: Array, bb: int) -> Array:
+    bsz, n = perm.shape
+    if bsz == bb:
+        return perm
+    pad = jnp.broadcast_to(jnp.arange(n, dtype=perm.dtype)[None],
+                           (bb - bsz, n))
+    return jnp.concatenate([perm, pad], axis=0)
+
+
+# -- factorization drivers --------------------------------------------------
+
+
+def getrf_batched(A, nb: Optional[int] = None):
+    """Batched partial-pivot LU of a [B, n, n] stack → (LU, perm,
+    info[B]) with gather-semantics perms (a[perm] = L·U per item)."""
+    a = _as_stack(A, "getrf_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("getrf_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    ap = _pad_eye(a, batch_bucket(bsz))
+    lu, perm, info = _run_bucket("getrf_batched", _k_getrf, nb, ap)
+    return lu[:bsz], perm[:bsz], info[:bsz]
+
+
+def potrf_batched(A, nb: Optional[int] = None):
+    """Batched lower Cholesky of a Hermitian [B, n, n] stack →
+    (tril L, info[B]). Only the lower triangles are read."""
+    a = _as_stack(A, "potrf_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("potrf_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    ap = _pad_eye(a, batch_bucket(bsz))
+    l, info = _run_bucket("potrf_batched", _k_potrf, nb, ap)
+    return l[:bsz], info[:bsz]
+
+
+def geqrf_batched(A, nb: Optional[int] = None):
+    """Batched Householder QR of a [B, m, n] stack (m ≥ n) →
+    (packed V\\R, taus [B, n], Ts [B, ceil(n/nb), nb, nb])."""
+    a = _as_stack(A, "geqrf_batched")
+    bsz, m, n = a.shape
+    if m < n:
+        raise SlateError("geqrf_batched: items must have m >= n")
+    nb = default_nb(n) if nb is None else nb
+    ap = _pad_eye(a, batch_bucket(bsz))
+    vr, taus, ts = _run_bucket("geqrf_batched", _k_geqrf, nb, ap)
+    return vr[:bsz], taus[:bsz], ts[:bsz]
+
+
+# -- solve-using-factor drivers (the serving Session's batched path) --------
+
+
+def getrs_batched(LU, perm, B):
+    """Batched solve from getrf_batched factors."""
+    lu = _as_stack(LU, "getrs_batched")
+    bsz, n, _ = lu.shape
+    b, vector, k = _rhs_stack(B, bsz, n, lu.dtype, "getrs_batched")
+    bb = batch_bucket(bsz)
+    x = _run_bucket("getrs_batched", _k_getrs, 0, _pad_eye(lu, bb),
+                    _pad_arange(jnp.asarray(perm), bb), _pad_zeros(b, bb))
+    x = x[:bsz, :, :k]
+    return x[:, :, 0] if vector else x
+
+
+def potrs_batched(L, B):
+    """Batched solve from potrf_batched factors."""
+    l = _as_stack(L, "potrs_batched")
+    bsz, n, _ = l.shape
+    b, vector, k = _rhs_stack(B, bsz, n, l.dtype, "potrs_batched")
+    bb = batch_bucket(bsz)
+    x = _run_bucket("potrs_batched", _k_potrs, 0, _pad_eye(l, bb),
+                    _pad_zeros(b, bb))
+    x = x[:bsz, :, :k]
+    return x[:, :, 0] if vector else x
+
+
+def gels_batched_using_factor(VR, taus, Ts, B, nb: Optional[int] = None):
+    """Batched least-squares solve from geqrf_batched factors →
+    [B, n, k] (or [B, n]) minimizers."""
+    vr = _as_stack(VR, "gels_batched_using_factor")
+    bsz, m, n = vr.shape
+    taus = jnp.asarray(taus)
+    ts = jnp.asarray(Ts)
+    nb = int(ts.shape[-1]) if nb is None else nb
+    b, vector, k = _rhs_stack(B, bsz, m, vr.dtype,
+                              "gels_batched_using_factor")
+    bb = batch_bucket(bsz)
+    x = _run_bucket("gels_batched_using_factor", _k_gels_solve, nb,
+                    _pad_eye(vr, bb), _pad_zeros(taus, bb),
+                    _pad_zeros(ts, bb), _pad_zeros(b, bb))
+    x = x[:bsz, :, :k]
+    return x[:, :, 0] if vector else x
+
+
+# -- fused factor+solve drivers (one program per bucket) --------------------
+
+
+def gesv_batched(A, B, nb: Optional[int] = None):
+    """Batched A·X = B: factor + solve as ONE program per bucket →
+    (X, info[B])."""
+    a = _as_stack(A, "gesv_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("gesv_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "gesv_batched")
+    bb = batch_bucket(bsz)
+    x, info = _run_bucket("gesv_batched", _k_gesv, nb, _pad_eye(a, bb),
+                          _pad_zeros(b, bb))
+    x, info = x[:bsz, :, :k], info[:bsz]
+    return (x[:, :, 0] if vector else x), info
+
+
+def posv_batched(A, B, nb: Optional[int] = None):
+    """Batched Hermitian-positive-definite A·X = B (lower storage):
+    factor + solve as ONE program per bucket → (X, info[B])."""
+    a = _as_stack(A, "posv_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("posv_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "posv_batched")
+    bb = batch_bucket(bsz)
+    x, info = _run_bucket("posv_batched", _k_posv, nb, _pad_eye(a, bb),
+                          _pad_zeros(b, bb))
+    x, info = x[:bsz, :, :k], info[:bsz]
+    return (x[:, :, 0] if vector else x), info
+
+
+def gels_batched(A, B, nb: Optional[int] = None):
+    """Batched least squares min‖A·X − B‖ (m ≥ n): QR factor + solve
+    as ONE program per bucket → (X [B, n, k], info[B] — always 0; QR
+    of a full stack never fails structurally, matching gels)."""
+    a = _as_stack(A, "gels_batched")
+    bsz, m, n = a.shape
+    if m < n:
+        raise SlateError("gels_batched: items must have m >= n")
+    nb = default_nb(n) if nb is None else nb
+    b, vector, k = _rhs_stack(B, bsz, m, a.dtype, "gels_batched")
+    bb = batch_bucket(bsz)
+    x = _run_bucket("gels_batched", _k_gels, nb, _pad_eye(a, bb),
+                    _pad_zeros(b, bb))
+    x = x[:bsz, :, :k]
+    info = np.zeros((bsz,), np.int32)
+    return (x[:, :, 0] if vector else x), info

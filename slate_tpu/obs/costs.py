@@ -39,9 +39,14 @@ per participant; all-gather and reduce-scatter move ``(g−1)/g`` of the
 gathered/scattered buffer; collective-permute and all-to-all move the
 payload once. These are the standard bandwidth-optimal counts (the
 reference's hypercube bcast/reduce overlays have the same asymptotics);
-the census counts each HLO instruction once — a collective inside a
-``while`` body executes once per iteration but is counted once, so
-looped programs report a LOWER bound (documented in PERF.md Round 9).
+the census counts each HLO instruction once, EXCEPT inside ``while``
+bodies whose instruction carries XLA's ``known_trip_count`` backend
+config (round 10): those collectives are multiplied by the trip count,
+because they execute once per iteration. A while without a trip count
+(data-dependent loops) falls back to counted-once — so looped programs
+report a LOWER bound exactly when XLA itself cannot bound the loop
+(documented in PERF.md Rounds 9–10; nested whiles multiply by the
+innermost counted loop only, again a lower bound).
 """
 
 from __future__ import annotations
@@ -68,6 +73,14 @@ _COLLECTIVE_RE = re.compile(
     r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
     r"all-to-all)(?:-start)?\(")
 _GROUPS_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+# computation header: "%region_0.24 (args...) -> type {" / "ENTRY %main ..."
+_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+\([^=]*\)\s*->")
+# while instr: "... while(%t), condition=%c, body=%region_0.24,
+#   backend_config={"known_trip_count":{"n":"5"}}"
+_WHILE_BODY_RE = re.compile(r"\bbody=%?([\w.\-]+)")
+_TRIP_RE = re.compile(
+    r"known_trip_count[\"']?\s*[:=]?\s*\{\s*[\"']?n[\"']?\s*[:=]?"
+    r"\s*[\"']?(\d+)")
 # XLA's iota form: replica_groups=[2,4]<=[8] — 2 groups of 4 (the
 # common TPU spelling for sharded programs; the brace form above is
 # what small CPU meshes emit)
@@ -154,11 +167,38 @@ def _shape_bytes(dtype: str, dims: str) -> int:
     return n * itemsize
 
 
+def while_trip_counts(hlo_text: str) -> Dict[str, int]:
+    """body-computation name → trip count, parsed off ``while``
+    instructions whose ``backend_config`` carries XLA's
+    ``known_trip_count`` estimate. Data-dependent loops (no trip count)
+    are absent — their bodies fall back to counted-once."""
+    out: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        if "while(" not in line:
+            continue
+        bm = _WHILE_BODY_RE.search(line)
+        tm = _TRIP_RE.search(line)
+        if bm is not None and tm is not None:
+            out[bm.group(1)] = max(int(tm.group(1)), 1)
+    return out
+
+
 def parse_collectives(hlo_text: str) -> Dict[str, CollectiveCost]:
     """Census of collective instructions in optimized HLO text: kind →
-    aggregated (count, payload bytes, modeled traffic, group size)."""
+    aggregated (count, payload bytes, modeled traffic, group size).
+
+    Computation-aware (round 10): a collective inside a while BODY
+    whose ``while`` carries ``known_trip_count`` is credited once per
+    iteration (count/payload/traffic × trip count); bodies of
+    data-dependent loops keep the counted-once lower bound."""
+    trips = while_trip_counts(hlo_text)
     out: Dict[str, CollectiveCost] = {}
+    comp = None
     for line in hlo_text.splitlines():
+        cm = _COMP_RE.match(line)
+        if cm is not None and "{" in line:
+            comp = cm.group(1)
+            continue
         m = _COLLECTIVE_RE.search(line)
         if m is None:
             continue
@@ -174,10 +214,11 @@ def parse_collectives(hlo_text: str) -> Dict[str, CollectiveCost]:
             group = 2  # permute: pairwise exchange
         else:
             group = 1
+        mult = trips.get(comp, 1)
         cc = out.setdefault(kind, CollectiveCost(kind))
-        cc.count += 1
-        cc.payload_bytes += payload
-        cc.traffic_bytes += collective_traffic(kind, payload, group)
+        cc.count += mult
+        cc.payload_bytes += payload * mult
+        cc.traffic_bytes += collective_traffic(kind, payload, group) * mult
         cc.group_size = max(cc.group_size, group)
     return out
 

@@ -140,10 +140,12 @@ def band_factor(n: int, band: int) -> float:
 
 def factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
     """Model flops of one factorization, keyed by the Session op kind
-    ({lu, chol, qr, band_lu, band_chol})."""
-    if op == "lu":
+    ({lu, chol, qr, band_lu, band_chol, lu_small, chol_small} — the
+    *_small ops are one ITEM of the batched engine: same per-item
+    model, credited B× by the batched dispatch)."""
+    if op in ("lu", "lu_small"):
         return getrf(n)
-    if op == "chol":
+    if op in ("chol", "chol_small"):
         return potrf(n)
     if op == "qr":
         return geqrf(m, n)
@@ -152,7 +154,7 @@ def factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
 
 def solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
     """Model flops of a k-column solve against a resident factor."""
-    if op in ("lu", "chol"):
+    if op in ("lu", "chol", "lu_small", "chol_small"):
         return 2.0 * n * n * k
     if op == "qr":
         return (4.0 * m * n - 2.0 * n * n) * k

@@ -9,6 +9,19 @@ right-hand sides are tile-padded to the operator's nb, so a K≤nb batch
 runs the SAME padded shape (hence the same compiled executable) as a
 single request and returns bit-identical per-request results.
 
+**Distinct-operator grouping (round 10).** Small-problem operators
+(``Session`` op kinds ``lu_small``/``chol_small``) are additionally
+grouped ACROSS handles: every request whose operator shares
+(op, n, dtype) and whose rhs shares a shape lands in one bucket
+regardless of which operator it targets, and the bucket dispatches as
+ONE batched program pass (``Session.solve_small_batched`` — batched
+factor for the cache misses, one batched solve over the stacked
+resident factors) instead of B per-request programs. Results are
+bit-identical to per-request dispatch because the batched kernels'
+arithmetic is batch-independent (linalg/batched); a singular item
+fails ITS future with the per-item info and leaves its bucket
+neighbors' solutions untouched.
+
 A bucket dispatches when it reaches ``max_batch`` or when its oldest
 request has waited ``max_wait`` seconds (the serving deadline knob:
 latency floor vs launch amortization). The Batcher itself owns no
@@ -26,6 +39,7 @@ from typing import Dict, Hashable, List, Optional, Tuple
 
 import numpy as np
 
+from ..core.exceptions import SlateError
 from ..obs.tracing import NOOP_SPAN as _NOOP_SPAN
 from .session import Session
 
@@ -36,12 +50,21 @@ class _Request:
     vector: bool           # original rank (reshape on completion)
     future: Future
     t_submit: float
+    # the operator this request targets (small-problem grouped buckets
+    # hold requests against DISTINCT handles; same-operator buckets
+    # carry it in the key too)
+    handle: Hashable = None
     # obs span, opened at dispatch (parent: the batch span) and closed
     # at future resolution; None while tracing is off or pre-dispatch
     span: object = None
 
 
 BucketKey = Tuple[Hashable, Tuple[int, ...], str]
+
+# first element of a grouped small-problem bucket key — a private
+# sentinel, so no user handle (which may be any hashable, including
+# the string "small") can collide with it
+_SMALL = object()
 
 
 class Batcher:
@@ -62,12 +85,20 @@ class Batcher:
 
     def submit(self, handle: Hashable, b) -> Future:
         """Enqueue one solve request; resolves to the solution array
-        with the same rank as ``b``."""
+        with the same rank as ``b``. Small-problem operators are
+        grouped across handles (module docstring): their bucket key is
+        (op, n, dtype, rhs-shape), not the handle."""
         b = np.asarray(b)
         vector = b.ndim == 1
         b2 = b[:, None] if vector else b
-        key: BucketKey = (handle, tuple(b2.shape), str(b2.dtype))
-        req = _Request(b2, vector, Future(), time.monotonic())
+        skey = self.session.small_group_key(handle)
+        if skey is not None:
+            key: BucketKey = (_SMALL,) + skey + (tuple(b2.shape),
+                                                 str(b2.dtype))
+        else:
+            key = (handle, tuple(b2.shape), str(b2.dtype))
+        req = _Request(b2, vector, Future(), time.monotonic(),
+                       handle=handle)
         self.session.metrics.inc("requests_total")
         with self._lock:
             self._buckets.setdefault(key, []).append(req)
@@ -124,6 +155,8 @@ class Batcher:
         as the ``queue_s`` attribute, their end is future resolution);
         the Session's solve/factor/dispatch spans nest under the batch
         span via the contextvar scope."""
+        if key and key[0] is _SMALL:
+            return self._run_small(key, reqs)
         handle = key[0]
         live = [r for r in reqs if not r.future.done()]
         if not live:
@@ -174,6 +207,63 @@ class Batcher:
                 m.observe("request_latency", lat)
                 # total_s (submit -> resolve) is what the slow-request
                 # log thresholds on — the client-visible latency
+                tr.finish_span(r.span, total_s=lat)
+
+    def _run_small(self, key: BucketKey, reqs: List[_Request]):
+        """Grouped small-problem dispatch: one bucket of DISTINCT-
+        operator requests → ONE batched program pass through
+        ``Session.solve_small_batched`` (batched factor for misses +
+        one batched solve over the stacked factors). A singular item
+        fails ITS OWN future with the per-item info (the SlateError the
+        per-request path would have raised); its neighbors' solutions
+        are bit-identical to what per-request dispatch produces."""
+        _, op, n, opdt, shape, bdt = key
+        live = [r for r in reqs if not r.future.done()]
+        if not live:
+            return
+        tr = self.session.tracer
+        now = time.monotonic()
+        bctx = (tr.span("serve.batch", op=op, n=n, grouped=True,
+                        batch_size=len(live), shape=list(shape),
+                        dtype=bdt) if tr.enabled else _NOOP_SPAN)
+        with bctx as bspan:
+            for r in live:
+                if r.span is None:
+                    r.span = tr.start_span(
+                        "serve.request", parent=bspan, kind="request",
+                        handle=repr(r.handle), shape=list(r.b.shape),
+                        dtype=bdt, queue_s=now - r.t_submit)
+            try:
+                xs, infos = self.session.solve_small_batched(
+                    [r.handle for r in live], [r.b for r in live])
+            except Exception as e:
+                for r in live:
+                    tr.finish_span(r.span, error=e)
+                raise
+            m = self.session.metrics
+            m.inc("batches_total")
+            m.observe("batch_size", float(len(live)))
+            done = time.monotonic()
+            for i, r in enumerate(live):
+                if infos[i] != 0:
+                    err = SlateError(
+                        f"Session: operator {r.handle!r} factorization "
+                        f"failed (info={infos[i]})")
+                    try:
+                        r.future.set_exception(err)
+                    except InvalidStateError:
+                        m.inc("cancelled_requests")
+                    tr.finish_span(r.span, error=err)
+                    continue
+                xi = xs[i]
+                try:
+                    r.future.set_result(xi[:, 0] if r.vector else xi)
+                except InvalidStateError:
+                    m.inc("cancelled_requests")
+                    tr.finish_span(r.span, cancelled=True)
+                    continue
+                lat = done - r.t_submit
+                m.observe("request_latency", lat)
                 tr.finish_span(r.span, total_s=lat)
 
     def flush(self):

@@ -42,8 +42,16 @@ from ..obs import costs as _costs
 from ..obs.tracing import Tracer, default_tracer, log as _obs_log
 from .metrics import Metrics
 
-# operator kinds a Session can keep resident
-OPS = ("lu", "chol", "qr", "band_lu", "band_chol")
+# operator kinds a Session can keep resident. The *_small family
+# (round 10) is the many-small-problems engine: dense [n, n] ARRAY
+# operators served through the hand-batched blocked kernels
+# (linalg/batched) — the per-request path runs the SAME kernels at
+# B=1 that the Batcher's grouped dispatch runs at B=bucket, so the
+# batched and per-request paths are bit-identical by construction
+# (batch-independent arithmetic, pinned by tests/test_batched.py).
+OPS = ("lu", "chol", "qr", "band_lu", "band_chol",
+       "lu_small", "chol_small")
+SMALL_OPS = ("lu_small", "chol_small")
 
 
 def _tree_nbytes(payload) -> int:
@@ -164,12 +172,24 @@ class Session:
                 f"Session.register: op {op!r} requires a "
                 f"{'PackedBand' if op.startswith('band') else 'TiledMatrix'}"
                 f" operand, got {type(A).__name__}")
+        if (op in SMALL_OPS) != (not isinstance(A, PackedBand)
+                                 and not hasattr(A, "kind")):
+            raise SlateError(
+                f"Session.register: op {op!r} requires a "
+                f"{'plain dense [n, n] array' if op in SMALL_OPS else 'TiledMatrix'}"
+                f" operand, got {type(A).__name__}")
         if isinstance(A, PackedBand):
             m = n = A.n
             band = A.kl + A.ku
         else:
             m, n = A.shape
             band = 0
+        if op in SMALL_OPS:
+            if m != n:
+                raise SlateError(
+                    "Session.register: small-problem operators must be "
+                    f"square, got {(m, n)}")
+            A = np.ascontiguousarray(A)
         if op == "qr" and m < n:
             # gels_using_factor covers only the overdetermined case; the
             # underdetermined minimum-norm path needs LQ factors (gels
@@ -196,6 +216,11 @@ class Session:
     def _infer_op(A) -> str:
         if isinstance(A, PackedBand):
             return "band_chol" if A.hermitian else "band_lu"
+        if not hasattr(A, "kind"):
+            # plain dense [n, n] array: the small-problem engine (a
+            # symmetry-blind default — register op="chol_small"
+            # explicitly for Hermitian-positive-definite operators)
+            return "lu_small"
         if A.kind in (MatrixKind.Hermitian, MatrixKind.Symmetric,
                       MatrixKind.HermitianBand):
             return "chol"
@@ -300,6 +325,23 @@ class Session:
 
     def _factor(self, entry: _Operator) -> _Resident:
         op, A, opts = entry.op, entry.A, entry.opts
+        if op in SMALL_OPS:
+            # the per-request arm of the many-small-problems engine:
+            # ONE item through the SAME hand-batched kernels the
+            # grouped dispatch uses at B=bucket (linalg/batched's
+            # per-bucket program cache compiles/reuses the B=1
+            # program) — so a cached factor is bit-identical to the
+            # slice a batched factor would have produced
+            from ..linalg import batched as _batched
+            if op == "lu_small":
+                lu, perm, info = _batched.getrf_batched(A[None])
+                payload = (lu[0], perm[0])
+            else:
+                l, info = _batched.potrf_batched(A[None])
+                payload = (l[0],)
+            payload = jax.block_until_ready(payload)
+            return _Resident(payload, int(info[0]),
+                             _tree_nbytes(payload))
         if op in ("band_lu", "band_chol"):
             # band factors stay on the eager verbs (PackedBand pipelines
             # host-side packing the whole-program jit cannot absorb)
@@ -463,6 +505,10 @@ class Session:
             entry = self._ops[handle] if handle in self._ops else None
             if entry is None:
                 raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op in SMALL_OPS:
+                raise SlateError(
+                    "Session.solve_matrix: small-problem operators take "
+                    "plain arrays — use Session.solve")
             hit = handle in self._cache  # before factor() counts it
             res = self.factor(handle)
             if res.info != 0:
@@ -504,11 +550,212 @@ class Session:
             b = np.asarray(b)
             vector = b.ndim == 1
             b2 = b[:, None] if vector else b
+            if entry.op in SMALL_OPS:
+                x = self._solve_small(handle, entry, b2)
+                return x[:, 0] if vector else x
             B = self._wrap_rhs(entry, b2)
             X = self.solve_matrix(handle, B)
             x = (X.to_numpy() if isinstance(X, TiledMatrix)
                  else np.asarray(X)[: entry.n])
             return x[:, 0] if vector else x
+
+    # -- the many-small-problems engine (round 10) -------------------------
+
+    def small_group_key(self, handle: Hashable) -> Optional[Tuple]:
+        """Grouping key for the Batcher's distinct-operator coalescing:
+        (op, n, dtype) for small-problem operators, None otherwise —
+        requests whose keys match can be served by ONE batched program
+        regardless of which operator each one targets.
+
+        LOCK-FREE on purpose: Batcher.submit calls this on every
+        enqueue, and the session lock is held across whole device
+        executions (solve/solve_small_batched) — taking it here would
+        head-of-line-block enqueues behind in-flight solves, exactly
+        the accumulation window batching needs. A bare dict read is
+        atomic under the GIL and _Operator entries are immutable after
+        register(); a concurrent unregister just yields None (the
+        request then falls back to a per-handle bucket and fails with
+        unknown-handle at dispatch, same as the per-request path)."""
+        entry = self._ops.get(handle)
+        if entry is None or entry.op not in SMALL_OPS:
+            return None
+        return (entry.op, entry.n, str(np.dtype(entry.A.dtype)))
+
+    def _solve_small(self, handle: Hashable, entry: _Operator,
+                     b2: np.ndarray) -> np.ndarray:
+        """Caller holds the lock. Per-request arm: the B=1 run of the
+        same batched kernels the grouped dispatch uses (the bit-identity
+        reference for the Batcher's batched path)."""
+        from ..linalg import batched as _batched
+        hit = handle in self._cache
+        res = self.factor(handle)
+        if res.info != 0:
+            raise SlateError(
+                f"Session: operator {handle!r} factorization failed "
+                f"(info={res.info})")
+        b2 = np.ascontiguousarray(b2, dtype=np.dtype(entry.A.dtype))
+        k = b2.shape[1]
+        tr = self.tracer
+        sattrs = (dict(self._span_attrs(entry, handle), k=k,
+                       cache_hit=hit) if tr.enabled else {})
+        with self.metrics.phase("serve.solve", "solve_latency",
+                                tracer=tr, **sattrs):
+            with tr.span("serve.dispatch"):
+                if entry.op == "lu_small":
+                    lu, perm = res.payload
+                    x = _batched.getrs_batched(lu[None], perm[None],
+                                               b2[None])
+                else:
+                    x = _batched.potrs_batched(res.payload[0][None],
+                                               b2[None])
+            with tr.span("serve.block"):
+                x = jax.block_until_ready(x)
+        self.metrics.inc("solves_total", k)
+        self.metrics.inc("dispatches_total")
+        fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
+        self.metrics.inc("flops_total", fl)
+        self.metrics.inc("solve_flops_total", fl)
+        _LEDGER.record("serve.solve", fl)
+        return np.asarray(x[0])
+
+    def solve_small_batched(self, handles: List[Hashable], bs: List
+                            ) -> Tuple[np.ndarray, List[int]]:
+        """ONE batched pass for a shape bucket of DISTINCT-operator
+        small requests (the Batcher's grouped dispatch). Cache-miss
+        operators are factored first in one batched factor program and
+        the per-item factor slices inserted into the cache (bit-identical
+        to the B=1 factors the per-request path would have cached —
+        batch-independent kernels); then every request's factor is
+        stacked — resident hits and fresh misses alike — and served by
+        one batched solve program. Returns ``(xs, infos)``: solutions
+        ``[B, rows, k]`` in request order plus per-item factorization
+        info — a singular item flags itself, its lane carries the
+        garbage, and its neighbors' bits are untouched (per-item
+        isolation, pinned by tests/test_batched.py).
+
+        Observability: ``batched_programs`` counts the batched programs
+        executed (≤ 2 per bucket: factor for the misses, solve for
+        everyone — vs O(B) per-request programs), ``bucket_occupancy``
+        records the pow2-bucket fill fraction, and the flop ledger is
+        credited B × the per-item serve models."""
+        from ..linalg import batched as _batched
+        if not handles or len(handles) != len(bs):
+            raise SlateError("solve_small_batched: handles and bs must "
+                             "be equal-length and nonempty")
+        with self._lock:
+            entries = []
+            for h in handles:
+                e = self._ops.get(h)
+                if e is None:
+                    raise SlateError(f"Session: unknown handle {h!r}")
+                if e.op not in SMALL_OPS:
+                    raise SlateError(
+                        f"solve_small_batched: {h!r} is op {e.op!r}, "
+                        "not a small-problem operator")
+                entries.append(e)
+            op, n = entries[0].op, entries[0].n
+            dt = np.dtype(entries[0].A.dtype)
+            for e in entries[1:]:
+                if e.op != op or e.n != n or np.dtype(e.A.dtype) != dt:
+                    raise SlateError(
+                        "solve_small_batched: mixed bucket (op/n/dtype "
+                        "must agree across the batch)")
+            bsz = len(handles)
+            tr = self.tracer
+            battrs = ({"op": op, "n": n, "batch": bsz, "dtype": str(dt)}
+                      if tr.enabled else {})
+            programs = 0
+            # residency BEFORE factoring: a request against an operator
+            # that was already resident counts a cache hit, everything
+            # else a miss — the same tallies B per-request solves give
+            was_resident = {h: (h in self._cache) for h in set(handles)}
+            with self.metrics.phase("serve.solve_batched",
+                                    "solve_latency", tracer=tr,
+                                    **battrs):
+                miss_handles = []
+                for h in handles:
+                    if not was_resident[h] and h not in miss_handles:
+                        miss_handles.append(h)
+                if miss_handles:
+                    amiss = np.stack([np.asarray(self._ops[h].A)
+                                      for h in miss_handles])
+                    with tr.span("serve.factor_batched",
+                                 batch=len(miss_handles)):
+                        if op == "lu_small":
+                            lus, perms, infos = _batched.getrf_batched(
+                                amiss)
+                            lus, perms, infos = jax.block_until_ready(
+                                (lus, perms, infos))
+                            payloads = [(lus[i], perms[i])
+                                        for i in range(len(miss_handles))]
+                        else:
+                            ls, infos = _batched.potrf_batched(amiss)
+                            ls, infos = jax.block_until_ready((ls, infos))
+                            payloads = [(ls[i],)
+                                        for i in range(len(miss_handles))]
+                    ffl = _factor_flops(op, n, n, 0)
+                    for h, payload, inf in zip(miss_handles, payloads,
+                                               infos):
+                        self._cache[h] = _Resident(
+                            payload, int(inf), _tree_nbytes(payload))
+                        self.metrics.inc("factors_total")
+                        self.metrics.inc("flops_total", ffl)
+                        self.metrics.inc("factor_flops_total", ffl)
+                        _LEDGER.record("serve.factor", ffl)
+                        self._evict_to_budget(keep=h)
+                    programs += 1
+                # per-request residents, in request order (the budget
+                # can in principle evict a just-inserted factor while
+                # later misses insert; self.factor refactors that item
+                # at B=1 — same bits, counted as one more miss).
+                # Duplicate handles: only the FIRST request against a
+                # cold handle is a miss — its duplicates hit the factor
+                # it just inserted, exactly the tallies B sequential
+                # per-request solves give (1 miss + B−1 hits).
+                res_list = []
+                counted_miss = set()
+                for h in handles:
+                    if was_resident[h] or h in counted_miss:
+                        self.metrics.inc("cache_hits")
+                        if h in self._cache:
+                            self._cache.move_to_end(h)
+                    else:
+                        self.metrics.inc("cache_misses")
+                        counted_miss.add(h)
+                    res = self._cache.get(h)
+                    if res is None:
+                        res = self.factor(h)
+                    res_list.append(res)
+                infos_req = [r.info for r in res_list]
+                import jax.numpy as jnp
+                bstack = np.stack([
+                    np.ascontiguousarray(np.asarray(b), dtype=dt)
+                    for b in bs])
+                with tr.span("serve.dispatch", batch=bsz):
+                    if op == "lu_small":
+                        x = _batched.getrs_batched(
+                            jnp.stack([r.payload[0] for r in res_list]),
+                            jnp.stack([r.payload[1] for r in res_list]),
+                            bstack)
+                    else:
+                        x = _batched.potrs_batched(
+                            jnp.stack([r.payload[0] for r in res_list]),
+                            bstack)
+                with tr.span("serve.block"):
+                    x = jax.block_until_ready(x)
+                programs += 1
+            k = bstack.shape[2]
+            self.metrics.inc("solves_total", bsz * k)
+            self.metrics.inc("dispatches_total")
+            self.metrics.inc("batched_programs", programs)
+            self.metrics.observe(
+                "bucket_occupancy",
+                bsz / _batched.batch_bucket(bsz))
+            sfl = bsz * _solve_flops(op, n, n, k, 0)
+            self.metrics.inc("flops_total", sfl)
+            self.metrics.inc("solve_flops_total", sfl)
+            _LEDGER.record("serve.solve", sfl)
+            return np.asarray(x), infos_req
 
     def _wrap_rhs(self, entry: _Operator, b2: np.ndarray):
         dtype = (entry.A.dtype if not isinstance(entry.A, PackedBand)
@@ -561,6 +808,27 @@ class Session:
             entry = self._ops.get(handle)
             if entry is None:
                 raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op in SMALL_OPS:
+                # small ops compile through linalg/batched's own
+                # per-bucket program cache: factor now (real work — the
+                # cached factor serves requests, so it IS credited) and
+                # run one zero-rhs solve so the B=1 solve bucket program
+                # exists before the first request; the probe solve is
+                # fake traffic and its ledger crediting is suppressed
+                from ..linalg import batched as _batched
+                res = self.factor(handle)
+                if res.info == 0:
+                    b0 = np.zeros((entry.n, nrhs),
+                                  dtype=np.dtype(entry.A.dtype))
+                    with _batched.suppress_accounting():
+                        if entry.op == "lu_small":
+                            lu, perm = res.payload
+                            _batched.getrs_batched(lu[None], perm[None],
+                                                   b0[None])
+                        else:
+                            _batched.potrs_batched(res.payload[0][None],
+                                                   b0[None])
+                return
             if entry.op in ("lu", "chol", "qr"):
                 fkey = self._factor_key(entry)
                 if fkey not in self._compiled:

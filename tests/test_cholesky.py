@@ -133,6 +133,8 @@ def test_posv_mixed():
     assert res < 1e-13
 
 
+@pytest.mark.slow  # ~8 s dispatch-policy probe (round-10 headroom);
+# potrf numerics and the fastpaths dispatch probes stay tier-1
 def test_potrf_rec_iter_base_dispatch(monkeypatch):
     """Round-5 hybrid dispatch — now the LEGACY arm
     (Options(factor_iter_large=False); the round-6 default routes every

@@ -107,6 +107,8 @@ def test_lapack_zheev_dsyev():
     assert z2 is None
 
 
+@pytest.mark.slow  # round-10 wall-time headroom: ~4.5 s, the
+# dgesvd/dgels lapack_api surface is also covered by the ctypes tests
 def test_lapack_dgesvd_dgels():
     m, n = 50, 30
     a = RNG.standard_normal((m, n))
@@ -267,6 +269,7 @@ def test_lapack_complex_hemm_herk():
                                atol=1e-10)
 
 
+@pytest.mark.slow  # round-10 wall-time headroom (~6 s)
 def test_lapack_norms_and_cond():
     m, n = 30, 22
     a = RNG.standard_normal((m, n))
@@ -627,6 +630,8 @@ def test_fortran_api(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
                     reason="C toolchain test disabled")
+@pytest.mark.slow  # round-10 wall-time headroom: compiles a real C
+# program (~6 s); the same ABI surface runs in-process in the ctypes tests
 def test_c_api_from_real_c_program(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     native = os.path.join(repo, "native")
@@ -654,6 +659,7 @@ def test_c_api_from_real_c_program(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
                     reason="C toolchain test disabled")
+@pytest.mark.slow  # round-10 wall-time headroom (~6 s)
 def test_c_api_multiprecision_ctypes():
     """Drive the GENERATED s/c/z C entry points (tools/gen_capi.py →
     native/capi_gen.c) by loading the library into this process — the
@@ -883,6 +889,9 @@ int main(void) {
 
 @pytest.mark.skipif(os.environ.get("SLATE_TPU_SKIP_CAPI") == "1",
                     reason="C toolchain test disabled")
+@pytest.mark.slow  # round-10 wall-time headroom: the single most
+# expensive compat test (~13 s of r5-routine breadth); the opaque-handle
+# serving path stays tier-1 via test_runtime + the session hit-rate test
 def test_c_api_handles_and_r5_routines(tmp_path):
     """Round-5 C API: opaque resident matrix handles + the newly
     generated families (hesv/pbsv/cond/norms/geqrf+ormqr), all driven

@@ -147,6 +147,8 @@ def test_pad_diag_identity():
     np.testing.assert_array_equal(P.to_numpy(), a)
 
 
+@pytest.mark.slow  # ~14 s (round-10 headroom); trtri stays covered by
+# the compat trtri test and every trsm-consuming factorization suite
 def test_trtri_lower_batched_matches_recursion():
     """The batched-leaf inverse (round-4 panel kernel) against the plain
     recursion and numpy, unit and non-unit, aligned and fallback.

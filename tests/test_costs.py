@@ -89,6 +89,48 @@ def test_parse_collectives_iota_replica_groups():
     assert ar.traffic_bytes == 2 * 3 * (8 * 4 * 4) // 4
 
 
+def test_parse_collectives_while_trip_count_multiplies():
+    # round 10: a collective inside a while BODY whose instruction
+    # carries known_trip_count is credited once per iteration; a
+    # data-dependent while (no trip count) keeps the counted-once
+    # lower-bound fallback
+    hlo = "\n".join([
+        "HloModule m",
+        "",
+        "%region_0.24 (arg.25: (s32[], f32[8,8])) -> (s32[], f32[8,8]) {",
+        "  %ar.1 = f32[8,8]{1,0} all-reduce(%x), "
+        "replica_groups={{0,1,2,3}}, to_apply=%add",
+        "  ROOT %t = (s32[], f32[8,8]) tuple(%i, %ar.1)",
+        "}",
+        "",
+        "%region_1.30 (arg.31: (s32[], f32[4])) -> (s32[], f32[4]) {",
+        "  %ar.2 = f32[4]{0} all-reduce(%y), "
+        "replica_groups={{0,1}}, to_apply=%add",
+        "  ROOT %t2 = (s32[], f32[4]) tuple(%j, %ar.2)",
+        "}",
+        "",
+        "ENTRY %main.40 (p0: f32[8,8]) -> f32[8,8] {",
+        "  %w1 = (s32[], f32[8,8]) while(%init), condition=%cond.1, "
+        "body=%region_0.24, "
+        "backend_config={\"known_trip_count\":{\"n\":\"5\"}}",
+        "  %w2 = (s32[], f32[4]) while(%init2), condition=%cond.2, "
+        "body=%region_1.30",
+        "  %ar.3 = f32[2,2]{1,0} all-reduce(%z), "
+        "replica_groups={{0,1,2,3}}, to_apply=%add",
+        "  ROOT %r = f32[8,8] get-tuple-element(%w1), index=1",
+        "}",
+    ])
+    assert costs_mod.while_trip_counts(hlo) == {"region_0.24": 5}
+    ar = costs_mod.parse_collectives(hlo)["all-reduce"]
+    # counted body: 5 iterations x 256B ring g=4; data-dependent body:
+    # once (16B g=2); entry: once (16B g=4)
+    assert ar.count == 5 + 1 + 1
+    body0 = 2 * 3 * (8 * 8 * 4) // 4
+    assert ar.traffic_bytes == 5 * body0 + 2 * 1 * 16 // 2 + \
+        2 * 3 * 16 // 4
+    assert ar.payload_bytes == 5 * 256 + 16 + 16
+
+
 def test_program_costs_never_raises_on_hostile_backend():
     class Hostile:
         def cost_analysis(self):

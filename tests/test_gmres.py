@@ -82,6 +82,8 @@ def test_gesv_mixed_gmres_multiple_rhs():
     np.testing.assert_allclose(X.to_numpy(), x_true, rtol=1e-6, atol=1e-8)
 
 
+@pytest.mark.slow  # ~5 s (round-10 headroom); GMRES-IR stays tier-1
+# via the well-conditioned + beats-plain-IR real-dtype tests
 def test_gesv_mixed_gmres_complex():
     n, nb = 64, 16
     a = _cond_matrix(n, 1e6, complex_=True)

@@ -59,6 +59,8 @@ def test_gesv_trans():
                                rtol=1e-8, atol=1e-10)
 
 
+@pytest.mark.slow  # ~5 s (round-10 headroom); mesh factor+solve stays
+# covered by test_grid_matches_single_device + the getrs grid tests
 def test_gesv_on_grid(grid2x2):
     n, nrhs = 64, 8
     a = RNG.standard_normal((n, n))
@@ -113,6 +115,8 @@ def test_getrf_tntpiv():
     assert _solve_residual(a, b, X.to_numpy()) < 50.0
 
 
+@pytest.mark.slow  # ~9 s multi-method compile bill (round-10 headroom);
+# each method keeps its own dedicated numerics test in tier-1
 def test_gesv_method_dispatch():
     n = 32
     a = np.asarray(generate_matrix("rand_dominant", n, n, jnp.float64, seed=6))
@@ -232,6 +236,8 @@ def test_getrf_rec_iter_base_dispatch(monkeypatch):
     assert _solve_residual(a, b, X.to_numpy()) < 30.0
 
 
+@pytest.mark.slow  # ~10 s (round-10 headroom); threshold/tournament
+# pivoting stays pinned by test_getrf_pivot_threshold_tournament
 def test_getrf_rec_tournament_threshold(monkeypatch):
     """pivot_threshold < 1 with the crossover lowered: the recursion's
     full-gather permutation composition (threshold < 1 path) composes

@@ -94,6 +94,8 @@ def _chol_tile_interpret_case(b, junk_upper):
     assert np.allclose(np.triu(lk, 1), 0.0)
 
 
+@pytest.mark.slow  # ~14 s interpret-mode numerics (round-10 headroom);
+# the dispatch-wiring spy tests keep the Pallas seam in tier-1
 def test_chol_tile_kernel_interpret():
     """In-VMEM blocked Cholesky kernel (round 5): interpret-mode
     correctness vs LAPACK-precision numpy, including the strict-upper
@@ -214,6 +216,7 @@ def test_qr_panel_eligibility_gates(monkeypatch):
 
 # -- round 7: deeper-unrolled WIDE panel bases ------------------------------
 
+@pytest.mark.slow  # ~6 s interpret-mode numerics (round-10 headroom)
 def test_qr_panel_wide_kernel_interpret():
     """Micro-blocked wide QR panel kernel (round 7): interpret-mode
     correctness at 64/128-wide bases — f32-level agreement with the

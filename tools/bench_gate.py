@@ -15,7 +15,14 @@ regressions were invisible until a human reread PERF.md. This tool:
      line / the CPU-fallback notice) when ``parsed`` lacks it;
    * the bare bench.py artifact (rounds 6+, ``--out``): has a
      ``"metric"`` key; ``n`` parsed out of the metric name;
-   * the bench_serve artifact: ``{"bench": "serve", "backend", ...}``.
+   * the bench_serve artifact: ``{"bench": "serve", "backend", ...}``;
+   * the batched-serving A/B rows (round 10, ``bench_serve.py
+     --batched`` → ``BENCH_r08.json``): a JSON LIST of
+     ``{"bench": "serve_batched", "platform", "op", "n", "batch",
+     "batched": {"reqs_per_sec", ...}, "per_request": {...},
+     "speedup"}`` rows — one record per row, series additionally keyed
+     by the batch size (a B=10⁴ bucket never gates against a B=10²
+     one).
 
 2. **Gates**: for every tracked metric, series are keyed by
    ``(metric, platform, n)`` — numbers from different backends or
@@ -51,6 +58,7 @@ from typing import List, Optional
 TRACKED_BENCH = ("value", "potrf_gflops", "getrf_gflops",
                  "getrf_calu_gflops", "geqrf_gflops", "gemm_high_gflops")
 TRACKED_SERVE = ("serve.solves_per_sec", "speedup")
+TRACKED_SERVE_BATCHED = ("batched.reqs_per_sec", "speedup")
 GATED_PLATFORMS = ("tpu", "axon")
 DEFAULT_TOLERANCE = 0.10
 
@@ -86,20 +94,57 @@ def _flat_metrics(parsed: dict, tracked) -> dict:
     return out
 
 
-def normalize(path: str) -> dict:
-    """One artifact file -> one normalized record (SchemaError when the
-    file fits none of the three known schemas)."""
+def _load(path: str):
     name = os.path.basename(path)
     try:
         with open(path) as f:
-            obj = json.load(f)
+            return name, json.load(f)
     except (OSError, json.JSONDecodeError) as e:
         raise SchemaError(f"{name}: unreadable JSON ({e})")
+
+
+def normalize(path: str) -> dict:
+    """One single-object artifact file -> one normalized record
+    (SchemaError when the file fits none of the known schemas; list
+    artifacts — the serve_batched row files — go through
+    :func:`normalize_all`)."""
+    name, obj = _load(path)
+    if isinstance(obj, list):
+        raise SchemaError(f"{name}: list artifact — use normalize_all")
+    m = _ROUND_RE.search(name)
+    return _normalize_obj(name, obj, int(m.group(1)) if m else None)
+
+
+def normalize_all(path: str) -> List[dict]:
+    """Every record in one artifact file: a single object yields one
+    record, a serve_batched row LIST yields one per row."""
+    name, obj = _load(path)
+    m = _ROUND_RE.search(name)
+    rnd = int(m.group(1)) if m else None
+    if isinstance(obj, list):
+        if not obj:
+            raise SchemaError(f"{name}: empty artifact list")
+        return [_normalize_obj(f"{name}[{i}]", row, rnd)
+                for i, row in enumerate(obj)]
+    return [_normalize_obj(name, obj, rnd)]
+
+
+def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
 
-    m = _ROUND_RE.search(name)
-    fname_round = int(m.group(1)) if m else None
+    if obj.get("bench") == "serve_batched":
+        for k in ("platform", "op", "n", "batch", "batched",
+                  "per_request", "speedup"):
+            if k not in obj:
+                raise SchemaError(
+                    f"{name}: serve_batched row missing {k!r}")
+        return {
+            "round": fname_round, "source": name, "kind": "serve_batched",
+            "platform": str(obj["platform"]), "n": int(obj["n"]),
+            "batch": int(obj["batch"]), "op": str(obj["op"]), "ok": True,
+            "metrics": _flat_metrics(obj, TRACKED_SERVE_BATCHED),
+        }
 
     if obj.get("bench") == "serve":
         for k in ("backend", "n", "serve", "per_request", "speedup"):
@@ -144,7 +189,7 @@ def normalize(path: str) -> dict:
         }
 
     raise SchemaError(f"{name}: matches no known BENCH schema "
-                      "(wrapper / bench.py / serve)")
+                      "(wrapper / bench.py / serve / serve_batched)")
 
 
 def discover(root: str) -> List[str]:
@@ -157,7 +202,10 @@ def discover(root: str) -> List[str]:
 
 
 def _series_key(rec: dict, metric: str):
-    return (rec["kind"], metric, rec["platform"], rec["n"])
+    # "batch"/"op" (serve_batched rows) keep batch-size buckets and
+    # operator classes in separate series — None for every other schema
+    return (rec["kind"], metric, rec["platform"], rec["n"],
+            rec.get("batch"), rec.get("op"))
 
 
 def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
@@ -187,7 +235,8 @@ def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
             continue
         row = {
             "kind": key[0], "metric": key[1], "platform": key[2],
-            "n": key[3], "best_prior": best, "last": last["value"],
+            "n": key[3], "batch": key[4], "op": key[5],
+            "best_prior": best, "last": last["value"],
             "drop_pct": round(100 * drop, 1),
             "last_source": last["source"],
         }
@@ -210,7 +259,7 @@ def check_schema(paths: List[str]) -> List[str]:
     errors = []
     for path in paths:
         try:
-            normalize(path)
+            normalize_all(path)
         except SchemaError as e:
             errors.append(str(e))
     return errors
@@ -243,12 +292,13 @@ def main(argv=None) -> int:
     if errors:
         print(json.dumps({"ok": False, "schema_errors": errors}))
         return 1
-    records = [normalize(p_) for p_ in paths]
+    records = [rec for p_ in paths for rec in normalize_all(p_)]
     summary = gate(records, tolerance=args.tolerance)
     print(json.dumps(summary, sort_keys=True))
     for row in summary["regressions"]:
+        bat = f", B={row['batch']}" if row.get("batch") else ""
         print(f"!!! regression: {row['metric']} "
-              f"[{row['platform']}, n={row['n']}] "
+              f"[{row['platform']}, n={row['n']}{bat}] "
               f"{row['best_prior']:.1f} -> {row['last']:.1f} "
               f"(-{row['drop_pct']}%, {row['last_source']})",
               file=sys.stderr)
