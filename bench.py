@@ -491,6 +491,137 @@ def _single_call_costs(name, n, nb, dtype=jnp.float32):
     return obs_costs.program_costs(fn.lower(*args).compile())
 
 
+def _mixed_roofline_rows(n, nb, dtype=jnp.float32):
+    """Roofline rows for the mixed-precision solves (ROADMAP item 2):
+    ``gesv_mixed``/``posv_mixed`` with a bf16 factor refined to the
+    f32 working precision. The verbs carry a host-side convergence
+    loop (not jittable whole), so the bytes column composes the
+    COMPONENT programs exactly as one mixed solve executes them: one
+    low-precision factor, (iters+1) low-precision
+    solve-using-factor passes, and iters working-precision residual
+    gemms — precision-conversion copies uncounted, so bytes are a
+    documented lower bound and the intensity column an upper bound.
+    The point is the SHIFT: the bf16 factor halves the dominant
+    factor-phase bytes while the model flops stay the lawn41 count,
+    so intensity moves up vs the uniform-precision verb (the
+    ``factor_intensity_lo``/``factor_intensity_working`` pair shows
+    it directly — the MXU lever the Session wires in next round).
+    CPU-smoke honesty (PERF.md Round 11): XLA:CPU materializes
+    f32<->bf16 converts around every gemm, so on this host the lo
+    intensity reads LOWER — the shift is a TPU (native-bf16) claim;
+    the column pair is the before/after hook for the on-chip re-run.
+    One eager call per verb credits the flop ledger (the PR-6
+    instrumented wrappers) and the composed bytes are credited under
+    the verb name, so ``LEDGER.gflops_report()`` renders the same
+    intensity column."""
+    import slate_tpu as st
+    from slate_tpu.core.types import Uplo
+    from slate_tpu.matgen import generate_matrix, random_spd
+    from slate_tpu.obs.flops import LEDGER
+
+    machine = obs_roofline.MachineModel.from_env()
+    factor_dtype = jnp.bfloat16
+    rows = []
+    for name in ("posv_mixed", "gesv_mixed"):
+        try:
+            if name == "posv_mixed":
+                a = random_spd(n, dtype=dtype, seed=13)
+                A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower)
+                A_lo = st.hermitian(jnp.tril(a).astype(factor_dtype),
+                                    nb=nb, uplo=Uplo.Lower)
+                fl = (model_flops.potrf(n)
+                      + model_flops.solve_flops("chol", n, n, 1))
+                verb = st.posv_mixed
+            else:
+                a = generate_matrix("randn", n, n, dtype, seed=14)
+                a = a + n * jnp.eye(n, dtype=dtype)
+                A = st.from_dense(a, nb=nb)
+                A_lo = st.from_dense(a.astype(factor_dtype), nb=nb)
+                fl = (model_flops.getrf(n)
+                      + model_flops.solve_flops("lu", n, n, 1))
+                verb = st.gesv_mixed
+            B = st.from_dense(jnp.ones((n, 1), dtype), nb=nb)
+            B_lo = st.from_dense(jnp.ones((n, 1), factor_dtype), nb=nb)
+            # timed: the real verb, eagerly (host loop included); this
+            # call also credits the flop ledger through the api wrapper
+            x, info, iters_ = verb(A, B, factor_dtype=factor_dtype)
+            jax.block_until_ready(x.data)
+            t0 = time.perf_counter()
+            x, info, iters_ = verb(A, B, factor_dtype=factor_dtype)
+            jax.block_until_ready(x.data)
+            secs = time.perf_counter() - t0
+            iters = max(abs(int(iters_)), 1)
+            # component programs, analyzed at the same (n, nb)
+            if name == "posv_mixed":
+                f_pc = obs_costs.program_costs(jax.jit(
+                    lambda ad: st.chol_factor(A_lo.with_data(ad))[0].data
+                ).lower(A_lo.data).compile())
+                L_lo, _ = st.chol_factor(A_lo)
+                s_pc = obs_costs.program_costs(jax.jit(
+                    lambda ld, bd: st.chol_solve_using_factor(
+                        L_lo.with_data(ld), B_lo.with_data(bd)).data
+                ).lower(L_lo.data, B_lo.data).compile())
+            else:
+                f_pc = obs_costs.program_costs(jax.jit(
+                    lambda ad: st.lu_factor(A_lo.with_data(ad))[0].data
+                ).lower(A_lo.data).compile())
+                LU_lo, perm_lo, _ = st.lu_factor(A_lo)
+                s_pc = obs_costs.program_costs(jax.jit(
+                    lambda ld, bd: st.lu_solve_using_factor(
+                        LU_lo.with_data(ld), perm_lo,
+                        B_lo.with_data(bd)).data
+                ).lower(LU_lo.data, B_lo.data).compile())
+            g_pc = obs_costs.program_costs(jax.jit(
+                lambda ad, xd, bd: st.gemm(
+                    -1.0, A.with_data(ad), B.with_data(xd), 1.0,
+                    B.with_data(bd)).data
+            ).lower(A.data, B.data, B.data).compile())
+            comp = [f_pc, s_pc, g_pc]
+            if any(pc.bytes_accessed is None for pc in comp):
+                bytes_mixed = None
+            else:
+                bytes_mixed = (f_pc.bytes_accessed
+                               + (iters + 1) * s_pc.bytes_accessed
+                               + iters * g_pc.bytes_accessed)
+            obs_costs.BYTES.record(name, bytes_mixed or 0.0)
+            row = obs_roofline.roofline_row(
+                name, fl, bytes_mixed, secs, None, machine)
+            row["factor_dtype"] = str(jnp.dtype(factor_dtype))
+            row["working_dtype"] = str(jnp.dtype(dtype))
+            row["refine_iters"] = int(iters_)
+            row["factor_bytes_lo"] = f_pc.bytes_accessed
+            row["factor_intensity_lo"] = obs_roofline.intensity(
+                model_flops.potrf(n) if name == "posv_mixed"
+                else model_flops.getrf(n), f_pc.bytes_accessed)
+            # the uniform-precision factor at the working dtype — the
+            # baseline the intensity shift is measured against
+            w_pc = _single_call_costs(
+                "potrf" if name == "posv_mixed" else "getrf", n, nb,
+                dtype=dtype)
+            row["factor_bytes_working"] = w_pc.bytes_accessed
+            row["factor_intensity_working"] = obs_roofline.intensity(
+                model_flops.potrf(n) if name == "posv_mixed"
+                else model_flops.getrf(n), w_pc.bytes_accessed)
+            rows.append(row)
+            ai = row["intensity"]
+            print(f"# roofline {name}  n={n} (bf16 factor): "
+                  + (f"intensity {ai:.1f} flop/B, factor "
+                     f"{row['factor_intensity_lo']:.1f} vs "
+                     f"{row['factor_intensity_working']:.1f} flop/B "
+                     f"uniform, iters={int(iters_)}"
+                     if ai is not None else "bytes unavailable"),
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"# roofline {name} skipped: {e}", file=sys.stderr)
+    # the ledger-side join: intensity columns for the mixed verbs as
+    # gflops_report renders them (flops credited by the instrumented
+    # api wrappers ÷ the bytes credited above)
+    report = LEDGER.gflops_report().get("per_op", {})
+    mixed_report = {k: v for k, v in report.items()
+                    if k in ("gesv_mixed", "posv_mixed")}
+    return {"rows": rows, "gflops_report": mixed_report}
+
+
 def _roofline_rows(n, model_fl, seconds):
     """One roofline row per headline verb: model flops ÷ XLA
     bytes-accessed (single-call program) joined with the measured
@@ -674,6 +805,12 @@ def main():
             "geqrf": model_flops.geqrf(n, n),
         }
         extra["roofline"] = _roofline_rows(n, model_fl, routine_secs)
+        # mixed-precision intensity rows (round 11 satellite — ROADMAP
+        # item 2): bf16-factor gesv_mixed/posv_mixed at the phase size
+        try:
+            extra["roofline_mixed"] = _mixed_roofline_rows(pn, pnb)
+        except Exception as e:
+            print(f"# mixed roofline skipped: {e}", file=sys.stderr)
 
     out = {
         "metric": f"gemm_gflops_per_chip_fp32_n{n}",

@@ -94,6 +94,21 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --batched --smoke FAILED")
+    # pod-scale serving smoke (round 11, --all only: the forced
+    # 8-device mesh AOT compiles cost minutes on a small host):
+    # mesh-sharded resident serving A/B into a throwaway artifact;
+    # exits nonzero unless every row is sharded-resident with a
+    # nonzero served-solve collective census (bench_serve.py)
+    if "--all" in argv:
+        print("=== bench_serve.py --multichip --smoke ===")
+        r = subprocess.run(
+            [sys.executable, str(here.parent / "bench_serve.py"),
+             "--multichip", "--smoke", "--multichip-out",
+             "/tmp/MULTICHIP_r06_smoke.json"],
+            cwd=here.parent, env=env_ex)
+        if r.returncode != 0:
+            fails += 1
+            print("!!! bench_serve --multichip --smoke FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure)

@@ -179,6 +179,31 @@ def _near_square_factor(n: int) -> int:
     return p
 
 
+def as_grid(mesh) -> Optional[ProcessGrid]:
+    """Coerce a mesh-ish argument to a ProcessGrid (or None).
+
+    Accepts ``None``, a :class:`ProcessGrid`, or a raw
+    ``jax.sharding.Mesh`` whose axes are named ("p", "q") — the serving
+    runtime's ``Session(mesh=...)`` entry point takes either spelling.
+    A 1×1 grid coerces to ``None`` (single-device serving needs no
+    distribution machinery)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, ProcessGrid):
+        grid = mesh
+    elif isinstance(mesh, Mesh):
+        if ROW_AXIS not in mesh.shape or COL_AXIS not in mesh.shape:
+            raise ValueError(
+                f"as_grid: mesh axes must be named ({ROW_AXIS!r}, "
+                f"{COL_AXIS!r}), got {tuple(mesh.shape)}")
+        grid = ProcessGrid(mesh)
+    else:
+        raise TypeError(
+            f"as_grid: expected ProcessGrid, Mesh, or None — got "
+            f"{type(mesh).__name__}")
+    return grid if grid.size > 1 else None
+
+
 def gridinfo(grid: ProcessGrid):
     """Reference: BaseMatrix::gridinfo (BaseMatrix.hh:161) — reverse lookup
     of (order, p, q). Trivial here because the grid is first-class."""

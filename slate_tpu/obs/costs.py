@@ -133,6 +133,14 @@ class ProgramCosts:
             return None
         return self.flops / self.bytes_accessed
 
+    def collective_counts(self) -> Dict[str, int]:
+        """kind → instruction count (trip-count-weighted): the compact
+        census summary the mesh-serving tests assert on — nonzero
+        counts mean the scheduled HLO really contains collectives (the
+        multichip artifact rows carry the same summary, built from the
+        ``to_dict`` form in ``Session.cost_log``)."""
+        return {k: c.count for k, c in self.collectives.items()}
+
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["collectives"] = {k: v.to_dict()

@@ -40,8 +40,13 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
     Counters render as ``counter``; histograms as ``summary`` (count,
     sum, p50/p99 quantiles) with ``_min``/``_max`` gauges beside them
     (omitted while empty — see Histogram.snapshot's null contract);
-    derived ratios and explicit gauges (resident_bytes, peak_hbm_bytes,
-    hbm_headroom) as ``gauge``. ``ledger=None`` binds the process flop
+    derived ratios and explicit gauges as ``gauge`` — the Session's
+    HBM truth is the round-11 per-chip vocabulary: ``resident_bytes``
+    / ``peak_hbm_bytes`` / ``hbm_headroom`` are PER-CHIP numbers
+    (max-per-shard charge for mesh residents) and
+    ``resident_bytes_total`` is the aggregate across the mesh; the
+    ``solve_collective_bytes_total`` / ``factor_collective_bytes_total``
+    counters split the served ICI traffic per verb. ``ledger=None`` binds the process flop
     ledger and ``bytes_ledger=None`` the process bytes ledger
     (``driver_bytes_total`` / ``collective_bytes_total`` — round 9);
     pass either ``False`` to disable its section."""

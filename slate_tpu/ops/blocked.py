@@ -507,6 +507,17 @@ def chol_tile_blocked(a: Array, ib: int = 64) -> Array:
         # (measured: perf_traces/SUMMARY.md, tools/potrf_ab.py)
         return pallas_ops.chol_tile(a)
     if b % ib or b <= ib:
+        if a.dtype in (jnp.bfloat16, jnp.float16):
+            # the lax.linalg.cholesky base lowers to a LAPACK custom
+            # call with no bf16/f16 kernel (CPU raises, round 11);
+            # factor the ONE diagonal tile in f32 and round back — the
+            # standard low-precision-factorization recipe (tile math
+            # in higher precision, the O(n³) trailing gemms stay low),
+            # and what the mixed-precision drivers (gesv_mixed/
+            # posv_mixed factor_dtype=bf16) need to run at all
+            hi = lax.linalg.cholesky(a.astype(jnp.float32),
+                                     symmetrize_input=False)
+            return jnp.tril(hi).astype(a.dtype)
         return jnp.tril(lax.linalg.cholesky(a, symmetrize_input=False))
     rows = jnp.arange(b)
 

@@ -72,12 +72,22 @@ class Batcher:
     docstring). Thread-safe; dispatch runs on the caller of ``run``."""
 
     def __init__(self, session: Session, max_batch: int = 32,
-                 max_wait: float = 2e-3):
+                 max_wait: float = 2e-3, pad_widths: bool = False):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.session = session
         self.max_batch = max_batch
         self.max_wait = max_wait
+        # pow2 width quantization (round 11): pad the stacked
+        # right-hand side out to the next power of two with zero
+        # columns before dispatch, so a varying coalesced width lowers
+        # to O(log max_batch) distinct solve programs instead of one
+        # per width — the knob that keeps a MESH session's expensive
+        # sharded AOT compiles bounded. Per-request results are
+        # untouched: every *_solve_using_factor verb is
+        # column-independent, so the extra zero columns never feed the
+        # real ones (and they are sliced off before futures resolve).
+        self.pad_widths = pad_widths
         self._lock = threading.Lock()
         self._buckets: Dict[BucketKey, List[_Request]] = {}
 
@@ -178,7 +188,30 @@ class Batcher:
                         dtype=key[2], queue_s=now - r.t_submit)
             try:
                 stacked = np.concatenate([r.b for r in live], axis=1)
-                x = self.session.solve(handle, stacked)
+                cols = stacked.shape[1]
+                if self.pad_widths:
+                    # the shared pow2 quantum (also the batch-dim
+                    # bucket of linalg/batched) — one definition, so
+                    # the Batcher's padded widths can never drift
+                    # from the bucketing the rest of the repo primes
+                    from ..ops.blocked import bucket_pow2
+                    w = bucket_pow2(cols, 1)
+                    if w > cols:
+                        stacked = np.concatenate(
+                            [stacked, np.zeros((stacked.shape[0],
+                                                w - cols),
+                                               stacked.dtype)], axis=1)
+                # served_cols: only the CLIENT columns count as solves
+                # — the padded zero columns are executed work (the
+                # ledgers see them) but not served requests. Passed
+                # only when padding actually happened, so the
+                # unpadded path keeps the bare solve(handle, b)
+                # signature.
+                if stacked.shape[1] != cols:
+                    x = self.session.solve(handle, stacked,
+                                           served_cols=cols)
+                else:
+                    x = self.session.solve(handle, stacked)
             except Exception as e:
                 # close this attempt's request spans INSIDE the batch
                 # scope: the exception is about to close the batch span

@@ -39,11 +39,12 @@ class Executor:
     """
 
     def __init__(self, session: Session, max_batch: int = 32,
-                 max_wait: float = 2e-3, retries: int = 2):
+                 max_wait: float = 2e-3, retries: int = 2,
+                 pad_widths: bool = False):
         self.session = session
         self.retries = retries
         self.batcher = Batcher(session, max_batch=max_batch,
-                               max_wait=max_wait)
+                               max_wait=max_wait, pad_widths=pad_widths)
         self._cv = threading.Condition()
         self._stop = False
         self._inflight = 0  # batches detached from the Batcher, unsolved

@@ -15,6 +15,27 @@ verbs learn (method dispatch, precision policy, sharding) is served
 automatically. The C API's opaque-handle solves (compat/c_glue.py)
 route through a process-wide ``default_session()`` so native callers
 share the same cache.
+
+**Mesh-native serving (round 11).** ``Session(mesh=...)`` (or
+``register(A, mesh=...)``) makes the service pod-scale: a dense
+operator registered against a p×q :class:`~..core.grid.ProcessGrid` is
+2D-block placed over the mesh at registration (``TiledMatrix.shard`` —
+the ``NamedSharding`` analog of the reference's ``BaseMatrix``
+2D-block-cyclic layout), its factor is computed by the existing mesh
+drivers (the GSPMD-partitioned blocked loops plus the explicit
+``parallel/`` schedules the Options select) and stays **resident as a
+sharded array across the mesh** — so aggregate HBM, not one chip's, is
+the capacity ceiling. Mesh solves always run as ONE AOT-compiled
+sharded program per (op, operand shapes, dtype, mesh): the first touch
+of a shape compiles at the `_aot_compile` seam (off the request path
+via ``warmup``; on it otherwise, counted in ``aot_compiles``), and
+every execution credits the measured collective census — the
+``collective_bytes_total`` / ``solve_collective_bytes_total`` counters
+move per served solve, not per compile. The LRU budget becomes
+**per-chip**: a sharded resident is charged its max-per-shard bytes
+and the transient term is the largest analyzed program's per-device
+temp+output footprint (XLA's memory analysis describes the per-device
+SPMD module), so ``hbm_budget`` bounds what the worst chip holds.
 """
 
 from __future__ import annotations
@@ -30,6 +51,7 @@ import numpy as np
 
 from .. import api
 from ..core.exceptions import SlateError
+from ..core.grid import ProcessGrid, as_grid
 from ..core.tiled_matrix import TiledMatrix, from_dense
 from ..core.types import MatrixKind, Options, DEFAULT_OPTIONS
 from ..linalg.band_packed import PackedBand
@@ -54,18 +76,33 @@ OPS = ("lu", "chol", "qr", "band_lu", "band_chol",
 SMALL_OPS = ("lu_small", "chol_small")
 
 
-def _tree_nbytes(payload) -> int:
+def _tree_nbytes(payload, per_chip: bool = False) -> int:
     """Device bytes held by a factor payload (sum over pytree leaves).
 
     Computed from shape/dtype metadata ONLY: the old
     ``np.asarray(leaf).nbytes`` fallback device-transferred any leaf
     lacking ``.nbytes`` — a full factor copy through the host on the
-    cache-accounting path (pinned by test: no ``__array__`` call)."""
+    cache-accounting path (pinned by test: no ``__array__`` call).
+
+    ``per_chip=True`` (round 11) charges a SHARDED leaf its
+    max-per-shard bytes — ``sharding.shard_shape`` is pure metadata,
+    and GSPMD shards are even, so the max shard is any shard — which
+    is the number the per-chip HBM budget must bound. Unsharded (or
+    fully replicated) leaves charge their full bytes on every chip,
+    which is exactly what replication costs."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(payload):
         shape = getattr(leaf, "shape", None)
         dtype = getattr(leaf, "dtype", None)
         if shape is not None and dtype is not None:
+            if per_chip:
+                sharding = getattr(leaf, "sharding", None)
+                shard_shape = getattr(sharding, "shard_shape", None)
+                if shard_shape is not None:
+                    try:
+                        shape = shard_shape(tuple(shape))
+                    except Exception:
+                        pass  # charge the full (replicated) bytes
             n = 1
             for d in shape:
                 n *= int(d)
@@ -88,27 +125,44 @@ class _Operator:
     m: int
     n: int
     band: int = 0            # kl+ku (band ops) for flop accounting
+    # serving mesh (round 11): dense operators registered against a
+    # multi-device grid are factored/solved as sharded AOT programs
+    # and their residents charged per-chip; None = single-device
+    grid: Optional[ProcessGrid] = None
 
 
 @dataclasses.dataclass
 class _Resident:
-    """A cached factorization (the HBM the LRU budget governs)."""
+    """A cached factorization (the HBM the LRU budget governs).
+
+    ``nbytes`` is the BUDGET CHARGE: per-chip bytes (max-per-shard for
+    mesh residents — the worst chip's share; equal to the total on a
+    single device). ``nbytes_total`` is the aggregate bytes across the
+    mesh, kept for the ``resident_bytes_total`` gauge."""
 
     payload: Tuple           # args for the *_solve_using_factor verb
     info: int
     nbytes: int
+    nbytes_total: int = 0
+
+    def __post_init__(self):
+        if not self.nbytes_total:
+            self.nbytes_total = self.nbytes
 
 
 class Session:
     """Resident-factorization solve service with an HBM-budget LRU cache.
 
-    ``hbm_budget`` bounds the total device bytes of CACHED FACTORS (the
-    registered operators themselves are the caller's inputs and are not
-    charged). ``None`` means unbounded. Factors are built lazily on the
-    first solve (refactor-on-miss) and evicted least-recently-used when
-    an insert would exceed the budget; a single factor larger than the
-    whole budget is kept (you cannot serve without it) and counted in
-    the ``budget_overflows`` metric.
+    ``hbm_budget`` bounds the PER-CHIP device bytes of CACHED FACTORS
+    (the registered operators themselves are the caller's inputs and
+    are not charged): a mesh resident is charged its max-per-shard
+    bytes, a single-device resident its full bytes — identical when
+    there is no mesh, so the budget means "what the worst chip holds"
+    uniformly. ``None`` means unbounded. Factors are built lazily on
+    the first solve (refactor-on-miss) and evicted least-recently-used
+    when an insert would exceed the budget; a single factor larger than
+    the whole budget is kept (you cannot serve without it) and counted
+    in the ``budget_overflows`` metric.
 
     All public methods are thread-safe; solve dispatch is serialized
     under one lock (the device executes one program at a time anyway —
@@ -118,9 +172,15 @@ class Session:
     def __init__(self, hbm_budget: Optional[int] = None,
                  opts: Options = DEFAULT_OPTIONS,
                  metrics: Optional[Metrics] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 mesh=None):
         self.hbm_budget = hbm_budget
         self.opts = opts
+        # serving mesh: a ProcessGrid or a jax Mesh with ("p", "q")
+        # axes; every dense operator registered without an explicit
+        # per-operator mesh is sharded over it (mesh docstring above).
+        # With a mesh, hbm_budget bounds PER-CHIP bytes.
+        self.grid = as_grid(mesh)
         self.metrics = metrics or Metrics()
         # request-scoped tracing: disabled by default (the shared
         # default tracer starts off) — zero spans, no per-solve cost
@@ -156,13 +216,48 @@ class Session:
 
     def register(self, A, op: str = "auto",
                  handle: Optional[Hashable] = None,
-                 opts: Optional[Options] = None) -> Hashable:
+                 opts: Optional[Options] = None,
+                 mesh=None) -> Hashable:
         """Register an operator; returns its handle (auto-allocated int
         when not given). ``op``: one of {lu, chol, qr, band_lu,
         band_chol} or "auto" (PackedBand → band_*, Hermitian/Symmetric
-        → chol, rectangular → qr, else lu)."""
+        → chol, rectangular → qr, else lu).
+
+        ``mesh`` (a ProcessGrid or ("p", "q") jax Mesh) places THIS
+        operator on a grid, overriding the session mesh in BOTH
+        directions — an explicit 1×1 grid registers the operator
+        single-device on a mesh session. A dense TiledMatrix is
+        2D-block sharded over the grid at registration and its factor
+        stays mesh-resident (module docstring). An operand that
+        already carries a multi-device grid is served mesh-native
+        without any mesh argument."""
         if op == "auto":
             op = self._infer_op(A)
+        if mesh is not None:
+            # explicit per-operator override; as_grid maps a 1×1 grid
+            # to None = explicit single-device placement
+            grid = as_grid(mesh)
+        else:
+            grid = self.grid
+            if grid is None and isinstance(A, TiledMatrix):
+                grid = A.grid if (A.grid is not None
+                                  and A.grid.size > 1) else None
+        if grid is not None:
+            if op not in ("lu", "chol", "qr"):
+                raise SlateError(
+                    f"Session.register: mesh serving covers the dense "
+                    f"operator kinds (lu/chol/qr), not {op!r}")
+            if not isinstance(A, TiledMatrix):
+                raise SlateError(
+                    "Session.register: mesh serving requires a "
+                    f"TiledMatrix operand, got {type(A).__name__}")
+            if A.grid is not grid or A.data.shape[0] % (grid.p * A.nb) \
+                    or A.data.shape[1] % (grid.q * A.nb):
+                # 2D-block placement over the mesh (NamedSharding; the
+                # BaseMatrix tileRank analog — core/grid.py): the
+                # registered operand itself is mesh-resident, so the
+                # factor program reads sharded inputs
+                A = A.shard(grid)
         if op not in OPS:
             raise SlateError(f"Session.register: unknown op {op!r}")
         # operand/op agreement, checked here so a mismatch fails at
@@ -209,7 +304,7 @@ class Session:
                 raise SlateError(f"Session.register: handle {handle!r} "
                                  "already registered (unregister first)")
             self._ops[handle] = _Operator(A, op, opts or self.opts, m, n,
-                                          band)
+                                          band, grid=grid)
         return handle
 
     @staticmethod
@@ -296,7 +391,7 @@ class Session:
                       if self.tracer.enabled else {})
             with self.metrics.phase("serve.factor", "factor_latency",
                                     tracer=self.tracer, **fattrs):
-                res = self._factor(entry)
+                res = self._factor(entry, handle)
             self.metrics.inc("factors_total")
             fl = _factor_flops(entry.op, entry.m, entry.n, entry.band)
             self.metrics.inc("flops_total", fl)
@@ -323,7 +418,8 @@ class Session:
                 return res.info
             return self.factor(handle).info
 
-    def _factor(self, entry: _Operator) -> _Resident:
+    def _factor(self, entry: _Operator, handle: Hashable = None
+                ) -> _Resident:
         op, A, opts = entry.op, entry.A, entry.opts
         if op in SMALL_OPS:
             # the per-request arm of the many-small-problems engine:
@@ -361,6 +457,17 @@ class Session:
             # first request (ISSUE 3 satellite).
             key = self._factor_key(entry)
             exe = self._compiled.get(key)
+            if exe is None and entry.grid is not None:
+                # mesh discipline: the factor ALWAYS runs as one
+                # analyzed sharded AOT program per shape — the census
+                # and per-chip transient accounting need the compiled
+                # seam, and warmup() may not have covered this shape
+                # (this is the on-request-path compile, counted)
+                exe = self._aot_compile("factor", entry, handle,
+                                        self._factor_fn(entry), (A,),
+                                        key=key)
+                self._compiled_put(key, exe)
+                self.metrics.inc("factor_aot_compiles")
             if exe is not None:
                 self._compiled.move_to_end(key)
                 payload, info = exe(A)
@@ -368,7 +475,9 @@ class Session:
             else:
                 payload, info = self._factor_fn(entry)(A)
         payload = jax.block_until_ready(payload)
-        return _Resident(payload, int(info), _tree_nbytes(payload))
+        return _Resident(payload, int(info),
+                         _tree_nbytes(payload, per_chip=True),
+                         _tree_nbytes(payload))
 
     def _credit_program(self, key: Hashable, op: str):
         """One execution of an analyzed AOT program: credit the process
@@ -383,6 +492,13 @@ class Session:
             self.metrics.inc("bytes_accessed_total", pc.bytes_accessed)
         if pc.collective_bytes:
             self.metrics.inc("collective_bytes_total", pc.collective_bytes)
+            # per-verb ICI split (round 11): a capacity planner needs
+            # the steady-state (solve) traffic separate from the
+            # amortized factor traffic — both move per EXECUTION
+            self.metrics.inc(
+                ("solve_collective_bytes_total" if op == "serve.solve"
+                 else "factor_collective_bytes_total"),
+                pc.collective_bytes)
 
     def _jit_cached(self, jkey: Hashable, make):
         """LRU-jit-cache shared by the solve and factor programs. A
@@ -430,18 +546,26 @@ class Session:
 
     def _update_hbm_gauges(self):
         """Caller holds the lock. Publish the HBM truth as gauges:
-        resident factor bytes, the worst-case peak (factors + largest
-        program transient), and the headroom against the budget."""
+        resident factor bytes (the PER-CHIP charge — max-per-shard for
+        mesh residents, the whole factor on a single device), the
+        worst-case per-chip peak (factors + largest program transient —
+        XLA's memory analysis describes the per-device SPMD module),
+        the aggregate bytes across the mesh, and the per-chip headroom
+        against the budget."""
         resident = sum(r.nbytes for r in self._cache.values())
         peak = resident + self._largest_transient()
         self.metrics.set_gauge("resident_bytes", resident)
+        self.metrics.set_gauge(
+            "resident_bytes_total",
+            sum(r.nbytes_total for r in self._cache.values()))
         self.metrics.set_gauge("peak_hbm_bytes", peak)
         if self.hbm_budget is not None:
             self.metrics.set_gauge("hbm_headroom", self.hbm_budget - peak)
 
     def hbm_headroom(self) -> Optional[int]:
-        """Budget minus (resident factors + largest program transient);
-        None when the session is unbounded."""
+        """PER-CHIP budget minus (per-chip resident factor charge +
+        largest program's per-device transient); None when the session
+        is unbounded."""
         with self._lock:
             if self.hbm_budget is None:
                 return None
@@ -489,18 +613,30 @@ class Session:
         lookahead, handle — the vocabulary the ISSUE fixes."""
         A = entry.A
         dtype = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
-        return {
+        attrs = {
             "op": entry.op, "m": entry.m, "n": entry.n,
             "nb": getattr(A, "nb", entry.band),
             "dtype": str(dtype),
             "lookahead": getattr(entry.opts, "lookahead", 0),
             "handle": repr(handle),
         }
+        if entry.grid is not None:
+            attrs["mesh"] = f"{entry.grid.p}x{entry.grid.q}"
+        return attrs
 
-    def solve_matrix(self, handle: Hashable, B: TiledMatrix) -> TiledMatrix:
+    def solve_matrix(self, handle: Hashable, B: TiledMatrix,
+                     served_cols: Optional[int] = None) -> TiledMatrix:
         """Solve with the resident factor; B is a TiledMatrix (dense
         ops) or a padded dense array (band ops). Returns the TiledMatrix
-        (or array) solution. Raises on factorization failure (info>0)."""
+        (or array) solution. Raises on factorization failure (info>0).
+
+        ``served_cols``: how many of B's columns are real client
+        requests (default: all). The Batcher's pow2 width padding
+        passes the pre-padding count so ``solves_total`` keeps meaning
+        "client columns served" — the denominator of every per-solve
+        rate — while the flop/bytes ledgers keep crediting the
+        EXECUTED width (padding waste is real device work a fleet
+        should see)."""
         with self._lock:
             entry = self._ops[handle] if handle in self._ops else None
             if entry is None:
@@ -524,10 +660,11 @@ class Session:
                 # dispatch (trace/launch) and device-block are split
                 # sub-spans so a trace shows where the latency sits
                 with tr.span("serve.dispatch"):
-                    X = self._dispatch(entry, res, B)
+                    X = self._dispatch(entry, res, B, handle)
                 with tr.span("serve.block"):
                     X = jax.block_until_ready(X)
-            self.metrics.inc("solves_total", k)
+            self.metrics.inc("solves_total",
+                             k if served_cols is None else served_cols)
             self.metrics.inc("dispatches_total")
             fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
             self.metrics.inc("flops_total", fl)
@@ -538,11 +675,13 @@ class Session:
             _LEDGER.record("serve.solve", fl)
             return X
 
-    def solve(self, handle: Hashable, b) -> np.ndarray:
+    def solve(self, handle: Hashable, b,
+              served_cols: Optional[int] = None) -> np.ndarray:
         """Array-in/array-out solve (the serving entry point): ``b`` is
         a host/device array of shape (rows,) or (rows, k); returns the
         solution with the matching rank (QR operators return n-row
-        least-squares solutions for m-row right-hand sides)."""
+        least-squares solutions for m-row right-hand sides).
+        ``served_cols``: see solve_matrix (Batcher width padding)."""
         with self._lock:
             entry = self._ops.get(handle)
             if entry is None:
@@ -554,7 +693,12 @@ class Session:
                 x = self._solve_small(handle, entry, b2)
                 return x[:, 0] if vector else x
             B = self._wrap_rhs(entry, b2)
-            X = self.solve_matrix(handle, B)
+            # forward served_cols only when set: solve_matrix keeps
+            # its bare (handle, B) call shape on the common path
+            # (test doubles and subclasses depend on it)
+            X = (self.solve_matrix(handle, B)
+                 if served_cols is None else
+                 self.solve_matrix(handle, B, served_cols=served_cols))
             x = (X.to_numpy() if isinstance(X, TiledMatrix)
                  else np.asarray(X)[: entry.n])
             return x[:, 0] if vector else x
@@ -764,17 +908,32 @@ class Session:
         if entry.op in ("band_lu", "band_chol"):
             return jax.numpy.asarray(b2)
         nb = entry.A.nb
-        return from_dense(b2, nb=nb)
+        # mesh operators get a mesh-placed right-hand side (grid=None
+        # is the single-device no-op): the solve program then consumes
+        # sharded inputs end to end instead of all-gathering at entry
+        return from_dense(b2, nb=nb, grid=entry.grid)
 
-    def _dispatch(self, entry: _Operator, res: _Resident, B):
+    def _dispatch(self, entry: _Operator, res: _Resident, B,
+                  handle: Hashable = None):
         """Run the solve through a per-(op, opts) jitted function,
         preferring an AOT-compiled executable from warmup() when shapes
         match. opts is part of both cache keys: two operators of the
         same kind registered with different Options (precision, method
-        selection) must not share a closure."""
+        selection) must not share a closure.
+
+        Mesh entries NEVER take the plain-jit fallback: a shape warmup
+        missed is AOT-compiled here (one sharded program per (op,
+        shapes, dtype, mesh) — the mesh is part of the key via the
+        operand treedefs), so every served mesh solve executes an
+        analyzed program and credits its collective census."""
         fn = self._solve_fn(entry)
         key = self._aot_key(entry, res.payload, B)
         exe = self._compiled.get(key)
+        if exe is None and entry.grid is not None:
+            exe = self._aot_compile("solve", entry, handle, fn,
+                                    (res.payload, B), key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
         if exe is not None:
             self._compiled.move_to_end(key)
             self._credit_program(key, "serve.solve")

@@ -98,6 +98,101 @@ def test_failed_round_is_excluded_not_an_error(tmp_path):
     assert rec["ok"] is False and rec["metrics"] == {}
 
 
+def test_normalize_legacy_multichip_blob(tmp_path):
+    # rounds 1-5 dry-run wrapper: metrics buried in the tail text —
+    # residuals come out as informational series (round 11 satellite)
+    _write(tmp_path, "MULTICHIP_r05.json", {
+        "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+        "tail": "dryrun_multichip(8): mesh 2x4, posv+hemm OK (max "
+                "residual 4.77e-07), getrf OK (2.38e-07), gbsv OK "
+                "(2.38e-07)\n"})
+    (rec,) = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r05.json"))
+    assert rec["kind"] == "multichip_dryrun" and rec["round"] == 5
+    assert rec["platform"] == "cpu" and rec["n"] == 8
+    assert rec["metrics"]["residual_posv_hemm"] == pytest.approx(4.77e-7)
+    assert rec["metrics"]["residual_getrf"] == pytest.approx(2.38e-7)
+    # a failed round (the r01 blob) normalizes with no metrics
+    _write(tmp_path, "MULTICHIP_r01.json", {
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+        "tail": "Traceback ..."})
+    (rec,) = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r01.json"))
+    assert rec["ok"] is False and rec["metrics"] == {}
+
+
+def _multichip_artifact(solves=300.0, speedup=0.1):
+    return {
+        "bench": "multichip", "platform": "cpu",
+        "forced_host_devices": True, "mesh_shape": [2, 4],
+        "n_devices": 8, "ok": True,
+        "rows": [{
+            "op": "chol", "n": 128, "nb": 32, "dtype": "float32",
+            "requests": 32, "ok": True,
+            "serve": {"wall_s": 0.1, "solves_per_sec": solves},
+            "single_device": {"wall_s": 0.01,
+                              "solves_per_sec": solves / speedup},
+            "speedup": speedup,
+            "sharded_resident": True,
+            "solve_collective_census": {"all-gather": 10},
+        }],
+    }
+
+
+def test_normalize_structured_multichip_rows(tmp_path):
+    _write(tmp_path, "MULTICHIP_r06.json", _multichip_artifact())
+    (rec,) = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r06.json"))
+    assert rec["kind"] == "multichip_serve" and rec["round"] == 6
+    assert rec["op"] == "chol" and rec["n"] == 128
+    assert rec["mesh_shape"] == [2, 4]
+    assert rec["metrics"]["serve.solves_per_sec"] == 300.0
+    assert rec["metrics"]["speedup"] == 0.1
+    # single-object normalize() redirects to normalize_all
+    with pytest.raises(gate_mod.SchemaError, match="normalize_all"):
+        gate_mod.normalize(str(tmp_path / "MULTICHIP_r06.json"))
+    # missing row keys are schema errors, not silent drops
+    bad = _multichip_artifact()
+    del bad["rows"][0]["speedup"]
+    _write(tmp_path, "MULTICHIP_r07.json", bad)
+    with pytest.raises(gate_mod.SchemaError, match="speedup"):
+        gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r07.json"))
+
+
+def test_multichip_dtype_rows_are_separate_series(tmp_path):
+    # one artifact carries f32 AND f64 rows per (op, n); without the
+    # dtype series key the much-slower f64 point would gate against
+    # the f32 best-prior and fabricate a TPU regression
+    def two_dtype(path):
+        art = _multichip_artifact(3000.0)
+        art["platform"] = "tpu"
+        slow = dict(art["rows"][0], dtype="float64",
+                    serve={"wall_s": 1.0, "solves_per_sec": 300.0})
+        art["rows"].append(slow)
+        _write(tmp_path, path, art)
+    two_dtype("MULTICHIP_r06.json")
+    two_dtype("MULTICHIP_r07.json")
+    recs = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r06.json"))
+    assert [r["dtype"] for r in recs] == ["float32", "float64"]
+    assert gate_mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_multichip_series_gate_and_informational(tmp_path, capsys):
+    # CPU multichip rows never gate (informational, like every CPU
+    # smoke series); a TPU-platform regression in the same schema DOES
+    _write(tmp_path, "MULTICHIP_r06.json", _multichip_artifact(300.0))
+    _write(tmp_path, "MULTICHIP_r07.json", _multichip_artifact(30.0))
+    assert gate_mod.main(["--dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+    tpu6 = _multichip_artifact(300.0)
+    tpu7 = _multichip_artifact(30.0)
+    tpu6["platform"] = tpu7["platform"] = "tpu"
+    _write(tmp_path, "MULTICHIP_r06.json", tpu6)
+    _write(tmp_path, "MULTICHIP_r07.json", tpu7)
+    rc = gate_mod.main(["--dir", str(tmp_path)])
+    summary = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert rc == 1 and any(
+        r["metric"] == "serve.solves_per_sec"
+        for r in summary["regressions"])
+
+
 # -- the gate ---------------------------------------------------------------
 
 
@@ -166,6 +261,9 @@ def test_check_schema_flags_corrupt_artifact(tmp_path, capsys):
 def test_real_history_schema_clean():
     paths = gate_mod.discover(str(_REPO))
     assert len(paths) >= 8  # seven BENCH rounds + the serve smoke
+    # round 11: the MULTICHIP family is part of the checked trajectory
+    assert any("MULTICHIP_r06" in p for p in paths)
+    assert sum("MULTICHIP" in p for p in paths) >= 6
     assert gate_mod.check_schema(paths) == []
 
 

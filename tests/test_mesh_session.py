@@ -1,0 +1,354 @@
+"""Pod-scale mesh-native Session (round 11, ISSUE 8).
+
+The serving runtime on the forced 8-device CPU mesh: a dense operator
+registered with ``Session(mesh=...)`` keeps its factor RESIDENT AS A
+SHARDED ARRAY (asserted via the sharding spec), every served solve runs
+as one analyzed sharded AOT program whose collective census is nonzero
+and credits measured ICI bytes per execution, the LRU budget charges
+PER-CHIP bytes (max-per-shard resident + per-device program transient),
+and the Batcher dispatches sharded handles like any other. The
+numerical contract vs the single-device arm is equality at dtype
+tolerance — mesh collectives reorder reductions, so bit-identity is
+NOT claimed here (the drivers' own bit-identity assertions are
+fastpath-vs-legacy on a FIXED placement, tests/test_fastpaths.py).
+
+Compile budget: the module-scoped sessions amortize the mesh AOT
+compiles across tests; the c64 sweep is ``-m slow`` (its cheap f32/f64
+siblings stay tier-1 — ISSUE 8 tier-1 satellite).
+"""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.core.grid import ProcessGrid, as_grid
+from slate_tpu.linalg.band_packed import pb_pack
+from slate_tpu.runtime import Batcher, Session
+
+RNG = np.random.default_rng(23)
+N, NB = 64, 16
+
+
+def _spd(n=N, dtype=np.float64):
+    a = RNG.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * RNG.standard_normal((n, n)).astype(dtype)
+        return (a @ a.conj().T + n * np.eye(n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+SPD = _spd()
+DIAG_DOM = RNG.standard_normal((N, N)) + N * np.eye(N)
+
+
+def _chol_operand(dtype=np.float64, grid=None):
+    spd = SPD.astype(dtype) if dtype != np.float64 else SPD
+    return st.hermitian(np.tril(spd), nb=NB, uplo=st.Uplo.Lower,
+                        grid=grid), spd
+
+
+@pytest.fixture(scope="module")
+def mesh_sess(grid2x4):
+    """One warmed mesh session with a chol and an lu operator — the
+    expensive sharded AOT compiles are shared by every test below."""
+    sess = Session(mesh=grid2x4)
+    A, _ = _chol_operand()
+    hc = sess.register(A, op="chol")
+    hl = sess.register(st.from_dense(DIAG_DOM, nb=NB), op="lu")
+    sess.warmup(hc)
+    return sess, hc, hl
+
+
+@pytest.fixture(scope="module")
+def single_sess():
+    sess = Session()
+    A, _ = _chol_operand()
+    hc = sess.register(A, op="chol")
+    hl = sess.register(st.from_dense(DIAG_DOM, nb=NB), op="lu")
+    return sess, hc, hl
+
+
+# -- resident sharding (the tentpole claim) --------------------------------
+
+
+def test_factor_stays_resident_sharded(mesh_sess, grid2x4):
+    sess, hc, _ = mesh_sess
+    res = sess.factor(hc)
+    L = res.payload[0]
+    sharding = L.data.sharding
+    # the factor is mesh-placed storage, not a gathered copy: a real
+    # NamedSharding over BOTH grid axes, one shard per device
+    assert not sharding.is_fully_replicated
+    spec = tuple(sharding.spec)
+    assert "p" in spec and "q" in spec
+    assert len(sharding.device_set) == grid2x4.size == 8
+    # the registered operand itself is mesh-resident too
+    assert not sess._ops[hc].A.data.sharding.is_fully_replicated
+
+
+def test_per_chip_charge_is_max_per_shard(mesh_sess, grid2x4):
+    sess, hc, _ = mesh_sess
+    res = sess.factor(hc)
+    # evenly sharded payload: the per-chip budget charge is exactly
+    # the aggregate over the 8 devices' shards
+    assert res.nbytes * grid2x4.size == res.nbytes_total
+    assert res.nbytes_total == N * N * 8  # f64 padded dense factor
+    # gauges publish both views
+    assert sess.metrics.get_gauge("resident_bytes") < \
+        sess.metrics.get_gauge("resident_bytes_total")
+
+
+# -- one AOT program per shape, census per served solve --------------------
+
+
+def test_warmup_aot_compiles_sharded_programs(mesh_sess):
+    sess, _, _ = mesh_sess
+    assert sess.metrics.get("factor_aot_compiles") >= 1
+    whats = {(r["op"], r["what"]) for r in sess.cost_log}
+    assert ("chol", "factor") in whats and ("chol", "solve") in whats
+
+
+def test_served_solve_census_nonzero_and_credited_per_solve(mesh_sess):
+    sess, hc, _ = mesh_sess
+    solve_rows = [r for r in sess.cost_log
+                  if r["op"] == "chol" and r["what"] == "solve"]
+    assert solve_rows and all(r["collective_bytes"] > 0
+                              for r in solve_rows)
+    # scheduled-HLO census: real collective instructions in the solve
+    kinds = set()
+    for r in solve_rows:
+        kinds |= set(r["collectives"])
+    assert kinds & {"all-reduce", "all-gather", "collective-permute",
+                    "all-to-all"}
+    # same census through the ProgramCosts summary the artifact uses
+    assert any(sum(pc.collective_counts().values()) > 0
+               for pc in sess._program_costs.values())
+    b = RNG.standard_normal(N)
+    compiles0 = sess.metrics.get("aot_compiles")
+    c0 = sess.metrics.get("solve_collective_bytes_total")
+    x1 = sess.solve(hc, b)
+    c1 = sess.metrics.get("solve_collective_bytes_total")
+    x2 = sess.solve(hc, b)
+    c2 = sess.metrics.get("solve_collective_bytes_total")
+    # ICI bytes move once PER EXECUTED SOLVE (same program, so equal
+    # increments), with no new program compiled (one per shape)
+    assert c1 > c0 and (c2 - c1) == (c1 - c0) > 0
+    assert sess.metrics.get("aot_compiles") == compiles0
+    assert np.array_equal(x1, x2)
+
+
+def test_unwarmed_mesh_solve_compiles_aot_on_request_path(grid2x4):
+    # no warmup: the first solve must still go through the analyzed
+    # AOT seam (never the plain-jit fallback) so the census is
+    # credited from request one
+    sess = Session(mesh=grid2x4)
+    A, spd = _chol_operand()
+    h = sess.register(A, op="chol")
+    b = RNG.standard_normal(N)
+    x = sess.solve(h, b)
+    assert np.abs(spd @ x - b).max() < 1e-8
+    assert sess.metrics.get("factor_aot_compiles") == 1
+    assert sess.metrics.get("aot_compiles") == 1
+    assert sess.metrics.get("collective_bytes_total") > 0
+
+
+# -- sharded solve ≡ single-device solve -----------------------------------
+
+
+def test_sharded_solve_matches_single_device_f64(mesh_sess, single_sess):
+    msess, mhc, mhl = mesh_sess
+    ssess, shc, shl = single_sess
+    b = RNG.standard_normal((N, 2))
+    for mh, sh, a in ((mhc, shc, SPD), (mhl, shl, DIAG_DOM)):
+        xm = msess.solve(mh, b)
+        xs = ssess.solve(sh, b)
+        assert np.abs(a @ xm - b).max() < 1e-8
+        np.testing.assert_allclose(xm, xs, rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_solve_matches_single_device_f32(grid2x4):
+    Am, spd = _chol_operand(np.float32)
+    A1, _ = _chol_operand(np.float32)
+    msess = Session(mesh=grid2x4)
+    ssess = Session()
+    mh = msess.register(Am, op="chol")
+    sh = ssess.register(A1, op="chol")
+    b = RNG.standard_normal(N).astype(np.float32)
+    xm = msess.solve(mh, b)
+    xs = ssess.solve(sh, b)
+    assert np.abs(spd @ xm - b).max() / N < 1e-3
+    np.testing.assert_allclose(xm, xs, rtol=2e-4, atol=2e-4)
+    assert not msess.factor(mh).payload[0].data \
+        .sharding.is_fully_replicated
+
+
+@pytest.mark.slow  # c64 mesh AOT compile is the expensive arm; the
+# f32/f64 siblings above keep the cross-dtype claim pinned in tier-1
+def test_sharded_solve_matches_single_device_c64(grid2x4):
+    spd = _spd(dtype=np.complex64)
+    msess = Session(mesh=grid2x4)
+    ssess = Session()
+    mh = msess.register(st.hermitian(np.tril(spd), nb=NB,
+                                     uplo=st.Uplo.Lower), op="chol")
+    sh = ssess.register(st.hermitian(np.tril(spd), nb=NB,
+                                     uplo=st.Uplo.Lower), op="chol")
+    b = (RNG.standard_normal(N) + 1j * RNG.standard_normal(N)
+         ).astype(np.complex64)
+    xm = msess.solve(mh, b)
+    xs = ssess.solve(sh, b)
+    np.testing.assert_allclose(xm, xs, rtol=2e-3, atol=2e-3)
+
+
+# -- Batcher over a sharded handle -----------------------------------------
+
+
+def test_batcher_dispatches_sharded_handle(mesh_sess):
+    sess, hc, _ = mesh_sess
+    batches0 = sess.metrics.get("batches_total")
+    bt = Batcher(sess, max_batch=4, max_wait=60.0, pad_widths=True)
+    bs = [RNG.standard_normal(N) for _ in range(3)]
+    futs = [bt.submit(hc, b) for b in bs]
+    bt.flush()
+    xs = [f.result(timeout=60) for f in futs]
+    assert sess.metrics.get("batches_total") == batches0 + 1
+    for x, b in zip(xs, bs):
+        np.testing.assert_allclose(x, sess.solve(hc, b),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_batcher_pad_widths_single_device(single_sess):
+    # pow2 width quantization keeps per-request results intact (the
+    # solve verbs are column-independent); cheap single-device pin
+    sess, hc, _ = single_sess
+    bt = Batcher(sess, max_batch=8, max_wait=60.0, pad_widths=True)
+    bs = [RNG.standard_normal(N) for _ in range(3)]  # pads 3 -> 4
+    solves0 = sess.metrics.get("solves_total")
+    futs = [bt.submit(hc, b) for b in bs]
+    bt.flush()
+    # the padded zero column is executed work, NOT a served request:
+    # solves_total counts client columns only
+    assert sess.metrics.get("solves_total") == solves0 + 3
+    for f, b in zip(futs, bs):
+        np.testing.assert_allclose(f.result(timeout=60),
+                                   sess.solve(hc, b),
+                                   rtol=1e-12, atol=1e-12)
+
+
+# -- per-chip budget: eviction + OOM telemetry over sharded residents ------
+
+
+def test_per_chip_budget_eviction_sharded(grid2x4):
+    sess = Session(mesh=grid2x4)
+    mats = [_spd() for _ in range(3)]
+    hs = [sess.register(st.hermitian(np.tril(m), nb=NB,
+                                     uplo=st.Uplo.Lower), op="chol")
+          for m in mats]
+    res0 = sess.factor(hs[0])
+    per = res0.nbytes
+    assert per * grid2x4.size == res0.nbytes_total
+    sess.factor(hs[1])  # LRU order now [hs[0], hs[1]]
+    peak_two = sess.metrics.get_gauge("peak_hbm_bytes")
+    # budget below holding THREE sharded residents (but above two):
+    # inserting the third must evict the LRU one, per-chip accounted
+    sess.hbm_budget = int(peak_two + per - 1)
+    sess.factor(hs[2])
+    assert sess.metrics.get("evictions") == 1
+    assert sess.metrics.get("evicted_bytes") == per
+    assert sess.cached_handles() == [hs[1], hs[2]]
+    assert sess.hbm_headroom() is not None and sess.hbm_headroom() >= 0
+    # a budget below even ONE resident + program transient: the factor
+    # is kept (serving must continue) and the OOM telemetry fires
+    sess.clear_cache()
+    sess.hbm_budget = per - 1
+    sess.factor(hs[0])
+    assert sess.metrics.get("budget_overflows") >= 1
+    assert sess.metrics.get("oom_risk_warnings") >= 1
+    assert sess.hbm_headroom() < 0
+
+
+# -- registration surface ---------------------------------------------------
+
+
+def test_mesh_register_rejects_non_dense_ops(grid2x4):
+    sess = Session(mesh=grid2x4)
+    ab = np.eye(8) * 4 + np.diag(np.ones(7), -1) + np.diag(np.ones(7), 1)
+    with pytest.raises(SlateError, match="mesh serving"):
+        sess.register(pb_pack(ab, kd=1), op="band_chol")
+    with pytest.raises(SlateError, match="mesh serving"):
+        sess.register(np.asarray(SPD[:8, :8]), op="lu_small")
+
+
+def test_register_infers_mesh_from_sharded_operand(grid2x4):
+    # a pre-sharded operand (no mesh argument anywhere) is served
+    # mesh-native: the probe path users already had keeps working and
+    # now gets per-chip accounting + the AOT census discipline
+    sess = Session()
+    A, spd = _chol_operand(grid=grid2x4)
+    h = sess.register(A, op="chol")
+    assert sess._ops[h].grid is grid2x4
+    x = sess.solve(h, RNG.standard_normal(N))
+    assert sess.metrics.get("collective_bytes_total") > 0
+    res = sess.factor(h)
+    assert res.nbytes * grid2x4.size == res.nbytes_total
+
+
+def test_register_explicit_single_device_override(grid2x4):
+    # the per-operator mesh overrides the session mesh in BOTH
+    # directions: an explicit 1x1 grid means single-device placement
+    # even on a mesh session (it used to be silently re-meshed)
+    sess = Session(mesh=grid2x4)
+    A, spd = _chol_operand()
+    h = sess.register(A, op="chol", mesh=ProcessGrid.create(1, 1))
+    assert sess._ops[h].grid is None
+    b = RNG.standard_normal(N)
+    x = sess.solve(h, b)
+    assert np.abs(spd @ x - b).max() < 1e-8
+    assert sess.metrics.get("collective_bytes_total") == 0
+
+
+def test_as_grid_coercions(grid2x4):
+    from jax.sharding import Mesh
+    assert as_grid(None) is None
+    assert as_grid(grid2x4) is grid2x4
+    g = as_grid(grid2x4.mesh)
+    assert isinstance(g, ProcessGrid) and g.p == 2 and g.q == 4
+    assert as_grid(ProcessGrid.create(1, 1)) is None
+    with pytest.raises(TypeError):
+        as_grid("2x4")
+
+
+# -- satellite pins ---------------------------------------------------------
+
+
+def test_bf16_chol_tile_base_runs_and_rounds(grid1x1):
+    # round-11 fix: the lax.linalg.cholesky tile base has no bf16
+    # LAPACK kernel — the tile is now factored in f32 and rounded
+    # back, which is what posv_mixed(factor_dtype=bf16) needs to run
+    import jax.numpy as jnp
+    spd32 = _spd(n=32, dtype=np.float32)
+    A_lo = st.hermitian(jnp.tril(jnp.asarray(spd32, jnp.bfloat16)),
+                        nb=16, uplo=st.Uplo.Lower)
+    L, info = st.chol_factor(A_lo)
+    assert int(info) == 0
+    l = np.asarray(L.to_numpy(), np.float32)
+    ref = np.linalg.cholesky(spd32.astype(np.float64))
+    assert np.isfinite(l).all()
+    # bf16 has ~3 decimal digits; the factor must round-trip close
+    assert np.abs(np.tril(l) - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_mixed_verbs_join_intensity_in_gflops_report():
+    # ISSUE 8 satellite: once the bytes ledger knows the mixed verbs
+    # (bench.py --phases credits the composed component-program bytes
+    # under the verb name), gflops_report renders the intensity column
+    # beside the flop-ledger row the instrumented wrapper credits
+    from slate_tpu.obs.costs import BYTES
+    from slate_tpu.obs.flops import LEDGER
+    A, _ = _chol_operand(np.float32)
+    B = st.from_dense(np.ones((N, 1), np.float32), nb=NB)
+    x, info, iters = st.posv_mixed(A, B, factor_dtype=np.float16)
+    assert int(info) == 0
+    BYTES.record("posv_mixed", 12345.0)
+    row = LEDGER.gflops_report()["per_op"]["posv_mixed"]
+    assert row["intensity"] is not None and row["intensity"] > 0

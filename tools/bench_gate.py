@@ -22,7 +22,15 @@ regressions were invisible until a human reread PERF.md. This tool:
      "batched": {"reqs_per_sec", ...}, "per_request": {...},
      "speedup"}`` rows — one record per row, series additionally keyed
      by the batch size (a B=10⁴ bucket never gates against a B=10²
-     one).
+     one);
+   * the MULTICHIP family (round 11): rounds 1–5 are bare
+     ``{n_devices, rc, ok, tail}`` dry-run blobs whose per-driver
+     residuals hide in the tail text — parsed out as informational
+     series; round 6+ is the structured ``{"bench": "multichip",
+     "platform", "mesh_shape", "n_devices", "rows": [...]}`` artifact
+     (``bench_serve.py --multichip``) — one ``multichip_serve`` record
+     per row, series keyed by (op, n), gating
+     serve/single-device solves-per-sec and speedup on TPU platforms.
 
 2. **Gates**: for every tracked metric, series are keyed by
    ``(metric, platform, n)`` — numbers from different backends or
@@ -59,11 +67,20 @@ TRACKED_BENCH = ("value", "potrf_gflops", "getrf_gflops",
                  "getrf_calu_gflops", "geqrf_gflops", "gemm_high_gflops")
 TRACKED_SERVE = ("serve.solves_per_sec", "speedup")
 TRACKED_SERVE_BATCHED = ("batched.reqs_per_sec", "speedup")
+# the round-11 structured multichip rows (mesh-sharded serving A/B);
+# collective/census columns are structural evidence, not perf series
+TRACKED_MULTICHIP = ("serve.solves_per_sec",
+                     "single_device.solves_per_sec", "speedup")
 GATED_PLATFORMS = ("tpu", "axon")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
-_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
+# the r01–r05 multichip dry-run tails: "posv+hemm OK (max residual
+# 4.77e-07), getrf OK (2.38e-07), ..." — the only machine-readable
+# signal those rounds recorded (normalized as informational series)
+_TAIL_RESID_RE = re.compile(
+    r"([\w+]+) OK \((?:max residual )?([0-9.eE+-]+)\)")
 
 
 class SchemaError(ValueError):
@@ -111,13 +128,17 @@ def normalize(path: str) -> dict:
     name, obj = _load(path)
     if isinstance(obj, list):
         raise SchemaError(f"{name}: list artifact — use normalize_all")
+    if isinstance(obj, dict) and obj.get("bench") == "multichip":
+        raise SchemaError(f"{name}: multi-row multichip artifact — "
+                          "use normalize_all")
     m = _ROUND_RE.search(name)
     return _normalize_obj(name, obj, int(m.group(1)) if m else None)
 
 
 def normalize_all(path: str) -> List[dict]:
     """Every record in one artifact file: a single object yields one
-    record, a serve_batched row LIST yields one per row."""
+    record, a serve_batched row LIST (or a structured multichip
+    artifact's ``rows``) yields one per row."""
     name, obj = _load(path)
     m = _ROUND_RE.search(name)
     rnd = int(m.group(1)) if m else None
@@ -126,7 +147,42 @@ def normalize_all(path: str) -> List[dict]:
             raise SchemaError(f"{name}: empty artifact list")
         return [_normalize_obj(f"{name}[{i}]", row, rnd)
                 for i, row in enumerate(obj)]
+    if isinstance(obj, dict) and obj.get("bench") == "multichip":
+        return _normalize_multichip(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
+
+
+def _normalize_multichip(name: str, obj: dict,
+                         rnd: Optional[int]) -> List[dict]:
+    """The round-11 structured multichip artifact: {"bench":
+    "multichip", "platform", "mesh_shape", "n_devices", "rows": [...]}
+    — one ``multichip_serve`` record per row, series keyed by the
+    row's (op, n)."""
+    for k in ("platform", "mesh_shape", "n_devices", "rows"):
+        if k not in obj:
+            raise SchemaError(f"{name}: multichip artifact missing {k!r}")
+    if not isinstance(obj["rows"], list) or not obj["rows"]:
+        raise SchemaError(f"{name}: multichip artifact with empty rows")
+    out = []
+    for i, row in enumerate(obj["rows"]):
+        for k in ("op", "n", "serve", "single_device", "speedup"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[rows.{i}]: multichip row missing {k!r}")
+        out.append({
+            "round": rnd, "source": f"{name}[{i}]",
+            "kind": "multichip_serve",
+            "platform": str(obj["platform"]), "n": int(row["n"]),
+            "op": str(row["op"]),
+            # dtype is part of the series key: the artifact carries
+            # f32 AND f64 rows per (op, n), and comparing an f64 round
+            # against an f32 best-prior would fabricate a regression
+            "dtype": str(row.get("dtype", "")) or None,
+            "mesh_shape": list(obj["mesh_shape"]),
+            "ok": bool(row.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_MULTICHIP),
+        })
+    return out
 
 
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
@@ -154,6 +210,28 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
             "ok": True, "metrics": _flat_metrics(obj, TRACKED_SERVE),
+        }
+
+    if "n_devices" in obj and "rc" in obj and "bench" not in obj \
+            and "cmd" not in obj:
+        # rounds 1–5 multichip dry-run blob: {n_devices, rc, ok,
+        # skipped, tail} with the per-driver residuals buried in the
+        # tail string. Normalized as INFORMATIONAL series (the runs
+        # are CPU-forced virtual meshes, and residuals are
+        # lower-is-better — they never gate; they exist so the
+        # trajectory read covers every committed artifact).
+        tail = str(obj.get("tail", ""))
+        metrics = {}
+        if obj.get("ok"):
+            for mm in _TAIL_RESID_RE.finditer(tail):
+                key = mm.group(1).replace("+", "_")
+                metrics[f"residual_{key}"] = float(mm.group(2))
+        return {
+            "round": fname_round, "source": name,
+            "kind": "multichip_dryrun",
+            "platform": _infer_platform_from_tail(tail) or "cpu",
+            "n": int(obj["n_devices"]), "ok": bool(obj.get("ok")),
+            "metrics": metrics,
         }
 
     if "cmd" in obj and "rc" in obj:  # rounds 1-5 harness wrapper
@@ -194,7 +272,8 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
 
 def discover(root: str) -> List[str]:
     paths = (glob.glob(os.path.join(root, "BENCH_r*.json"))
-             + glob.glob(os.path.join(root, "BENCH_SERVE*.json")))
+             + glob.glob(os.path.join(root, "BENCH_SERVE*.json"))
+             + glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
     # fixtures beside the headline artifact — different schema, not
     # part of the trajectory
@@ -202,10 +281,11 @@ def discover(root: str) -> List[str]:
 
 
 def _series_key(rec: dict, metric: str):
-    # "batch"/"op" (serve_batched rows) keep batch-size buckets and
-    # operator classes in separate series — None for every other schema
+    # "batch"/"op" (serve_batched rows) and "dtype" (multichip rows)
+    # keep batch-size buckets, operator classes, and dtypes in
+    # separate series — None for every other schema
     return (rec["kind"], metric, rec["platform"], rec["n"],
-            rec.get("batch"), rec.get("op"))
+            rec.get("batch"), rec.get("op"), rec.get("dtype"))
 
 
 def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
@@ -236,6 +316,7 @@ def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
         row = {
             "kind": key[0], "metric": key[1], "platform": key[2],
             "n": key[3], "batch": key[4], "op": key[5],
+            "dtype": key[6],
             "best_prior": best, "last": last["value"],
             "drop_pct": round(100 * drop, 1),
             "last_source": last["source"],
