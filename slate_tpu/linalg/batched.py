@@ -37,8 +37,15 @@ compile seam (obs/costs.program_costs) and every execution credits the
 process BYTES ledger under the driver name — the round-9 per-execution
 discipline. Model flops are credited B×model by the api.py verbs
 (api.gesv_batched / posv_batched / geqrf_batched / gels_batched).
-Under an outer jax trace the drivers degrade to plain traced calls
-(composition into a larger program; whoever compiles it accounts it).
+Round 12: the padded lanes' share — (bucket − B)/bucket of the
+program's bytes, plus their per-item model flops — is split out to
+the ``padding.waste`` ledger op at this layer (the padding happens
+here, so it is accounted here; exactly zero at full pow2 occupancy).
+The fixed k' = max(k, 2) rhs-width quantum stays credited as the
+verb's own cost — it is a constant tile-shape floor, not bucket
+padding. Under an outer jax trace the drivers degrade to plain traced
+calls (composition into a larger program; whoever compiles it
+accounts it).
 """
 
 from __future__ import annotations
@@ -54,6 +61,7 @@ import numpy as np
 
 from ..core.exceptions import SlateError
 from ..obs import costs as _costs
+from ..obs import flops as _flops
 from ..ops import blocked
 
 Array = jax.Array
@@ -103,13 +111,20 @@ def suppress_accounting():
         _SUPPRESS.on = False
 
 
-def _run_bucket(name: str, fn, nb: int, *args):
+def _run_bucket(name: str, fn, nb: int, *args, live_batch=None):
     """Run ``fn(*args, nb)`` through the per-bucket program cache: the
     first call per (name, nb, arg shapes/dtypes) lowers + compiles ONE
     program (cost-analyzed at the seam), later calls reuse the
     executable; every execution credits the process bytes ledger under
     ``name``. Under an outer jax trace this degrades to a plain traced
-    call — the composition is compiled (and accounted) by the caller."""
+    call — the composition is compiled (and accounted) by the caller.
+
+    ``live_batch`` (round 12) is the caller's pre-padding batch size:
+    the padded lanes' share of the program's bytes — (bucket − live) /
+    bucket of every axis, the kernels being batch-uniform — is split
+    out to the ``padding.waste`` ledger op instead of ``name``, so the
+    bucket quantization's real-but-useless device traffic stops being
+    credited as served work. Exactly zero split at full occupancy."""
     global _COMPILES
     from ..obs import _jax_eager
     if not _jax_eager():
@@ -129,8 +144,35 @@ def _run_bucket(name: str, fn, nb: int, *args):
                 _PROGRAMS.popitem(last=False)
     exe, pc = hit
     if not getattr(_SUPPRESS, "on", False):
-        _costs.BYTES.record_costs(name, pc)
+        executed = int(getattr(args[0], "shape", (0,))[0]) or 1
+        if live_batch is not None and 0 < live_batch < executed:
+            frac = live_batch / executed
+            ba = pc.bytes_accessed or 0.0
+            _costs.BYTES.record(name, ba * frac,
+                                pc.collective_bytes * frac,
+                                pc.collectives)
+            _costs.BYTES.record("padding.waste", ba * (1.0 - frac),
+                                pc.collective_bytes * (1.0 - frac))
+        else:
+            _costs.BYTES.record_costs(name, pc)
     return exe(*args)
+
+
+def _credit_padding_flops(waste_items: int, per_item_flops: float):
+    """Model flops of the pow2-bucket padding lanes, credited to the
+    process ledger's ``padding.waste`` op (round 12): the padded
+    identities/zeros execute the SAME per-item arithmetic as live
+    lanes — real device work the round-8 ledger used to ignore.
+    Skipped under suppression (warmup probes) like the bytes ledger;
+    callers only invoke this on the eager path (_run_bucket already
+    degraded under an outer trace)."""
+    if waste_items <= 0 or getattr(_SUPPRESS, "on", False):
+        return
+    from ..obs import _jax_eager
+    from ..obs.flops import LEDGER
+    if not _jax_eager():
+        return
+    LEDGER.record("padding.waste", waste_items * per_item_flops)
 
 
 def bucket_stats() -> dict:
@@ -318,7 +360,9 @@ def getrf_batched(A, nb: Optional[int] = None):
         raise SlateError("getrf_batched: items must be square")
     nb = default_nb(n) if nb is None else nb
     ap = _pad_eye(a, batch_bucket(bsz))
-    lu, perm, info = _run_bucket("getrf_batched", _k_getrf, nb, ap)
+    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.getrf(n))
+    lu, perm, info = _run_bucket("getrf_batched", _k_getrf, nb, ap,
+                                 live_batch=bsz)
     return lu[:bsz], perm[:bsz], info[:bsz]
 
 
@@ -331,7 +375,9 @@ def potrf_batched(A, nb: Optional[int] = None):
         raise SlateError("potrf_batched: items must be square")
     nb = default_nb(n) if nb is None else nb
     ap = _pad_eye(a, batch_bucket(bsz))
-    l, info = _run_bucket("potrf_batched", _k_potrf, nb, ap)
+    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.potrf(n))
+    l, info = _run_bucket("potrf_batched", _k_potrf, nb, ap,
+                          live_batch=bsz)
     return l[:bsz], info[:bsz]
 
 
@@ -344,7 +390,9 @@ def geqrf_batched(A, nb: Optional[int] = None):
         raise SlateError("geqrf_batched: items must have m >= n")
     nb = default_nb(n) if nb is None else nb
     ap = _pad_eye(a, batch_bucket(bsz))
-    vr, taus, ts = _run_bucket("geqrf_batched", _k_geqrf, nb, ap)
+    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.geqrf(m, n))
+    vr, taus, ts = _run_bucket("geqrf_batched", _k_geqrf, nb, ap,
+                               live_batch=bsz)
     return vr[:bsz], taus[:bsz], ts[:bsz]
 
 
@@ -357,8 +405,11 @@ def getrs_batched(LU, perm, B):
     bsz, n, _ = lu.shape
     b, vector, k = _rhs_stack(B, bsz, n, lu.dtype, "getrs_batched")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(bb - bsz,
+                          _flops.solve_flops("lu", n, n, int(b.shape[2])))
     x = _run_bucket("getrs_batched", _k_getrs, 0, _pad_eye(lu, bb),
-                    _pad_arange(jnp.asarray(perm), bb), _pad_zeros(b, bb))
+                    _pad_arange(jnp.asarray(perm), bb), _pad_zeros(b, bb),
+                    live_batch=bsz)
     x = x[:bsz, :, :k]
     return x[:, :, 0] if vector else x
 
@@ -369,8 +420,11 @@ def potrs_batched(L, B):
     bsz, n, _ = l.shape
     b, vector, k = _rhs_stack(B, bsz, n, l.dtype, "potrs_batched")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(bb - bsz,
+                          _flops.solve_flops("chol", n, n,
+                                             int(b.shape[2])))
     x = _run_bucket("potrs_batched", _k_potrs, 0, _pad_eye(l, bb),
-                    _pad_zeros(b, bb))
+                    _pad_zeros(b, bb), live_batch=bsz)
     x = x[:bsz, :, :k]
     return x[:, :, 0] if vector else x
 
@@ -386,9 +440,13 @@ def gels_batched_using_factor(VR, taus, Ts, B, nb: Optional[int] = None):
     b, vector, k = _rhs_stack(B, bsz, m, vr.dtype,
                               "gels_batched_using_factor")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(bb - bsz,
+                          _flops.solve_flops("qr", m, n,
+                                             int(b.shape[2])))
     x = _run_bucket("gels_batched_using_factor", _k_gels_solve, nb,
                     _pad_eye(vr, bb), _pad_zeros(taus, bb),
-                    _pad_zeros(ts, bb), _pad_zeros(b, bb))
+                    _pad_zeros(ts, bb), _pad_zeros(b, bb),
+                    live_batch=bsz)
     x = x[:bsz, :, :k]
     return x[:, :, 0] if vector else x
 
@@ -406,8 +464,12 @@ def gesv_batched(A, B, nb: Optional[int] = None):
     nb = default_nb(n) if nb is None else nb
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "gesv_batched")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz,
+        _flops.getrf(n) + _flops.solve_flops("lu", n, n,
+                                             int(b.shape[2])))
     x, info = _run_bucket("gesv_batched", _k_gesv, nb, _pad_eye(a, bb),
-                          _pad_zeros(b, bb))
+                          _pad_zeros(b, bb), live_batch=bsz)
     x, info = x[:bsz, :, :k], info[:bsz]
     return (x[:, :, 0] if vector else x), info
 
@@ -422,8 +484,12 @@ def posv_batched(A, B, nb: Optional[int] = None):
     nb = default_nb(n) if nb is None else nb
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "posv_batched")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz,
+        _flops.potrf(n) + _flops.solve_flops("chol", n, n,
+                                             int(b.shape[2])))
     x, info = _run_bucket("posv_batched", _k_posv, nb, _pad_eye(a, bb),
-                          _pad_zeros(b, bb))
+                          _pad_zeros(b, bb), live_batch=bsz)
     x, info = x[:bsz, :, :k], info[:bsz]
     return (x[:, :, 0] if vector else x), info
 
@@ -439,8 +505,12 @@ def gels_batched(A, B, nb: Optional[int] = None):
     nb = default_nb(n) if nb is None else nb
     b, vector, k = _rhs_stack(B, bsz, m, a.dtype, "gels_batched")
     bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz,
+        _flops.geqrf(m, n) + _flops.solve_flops("qr", m, n,
+                                                int(b.shape[2])))
     x = _run_bucket("gels_batched", _k_gels, nb, _pad_eye(a, bb),
-                    _pad_zeros(b, bb))
+                    _pad_zeros(b, bb), live_batch=bsz)
     x = x[:bsz, :, :k]
     info = np.zeros((bsz,), np.int32)
     return (x[:, :, 0] if vector else x), info

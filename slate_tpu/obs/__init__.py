@@ -20,24 +20,37 @@ tools ingest:
 * :mod:`.merge`      — aligns host spans with ``jax.profiler`` device
   traces via the ``potrf_l{k}_*``/``geqrf_l{k}_*`` named scopes and
   computes the measured lookahead-overlap metric (PERF.md round 7's
-  modeled number, measured).
+  modeled number, measured); round 12 adds the multi-process trace
+  combine (``combine_process_traces``).
+* :mod:`.slo`        — declarative serving objectives evaluated over
+  rolling windows with multi-window burn rates; the ``/slo`` endpoint
+  payload (round 12).
+* :mod:`.watchdog`   — online regression detection: live serving
+  numbers vs the committed ``BASELINE_SERIES.json`` best-priors
+  (bench_gate's tolerance policy), anomalies into trace + /metrics.
+* :mod:`.aggregate`  — N processes' metric/ledger/trace snapshots
+  folded into one fleet view (counters summed exactly, histograms
+  merged, gauges host-labeled).
 
 See DESIGN.md "Observability (round 8)" for the reference mapping
 (Trace.hh Block/SVG -> span model + Chrome export; the global timers
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import costs, flops, roofline
+from . import aggregate, costs, flops, roofline, slo, watchdog
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
-from .merge import lookahead_overlap, merge_traces
+from .merge import combine_process_traces, lookahead_overlap, merge_traces
+from .slo import Objective, SloTracker
 from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
+from .watchdog import Watchdog
 
 __all__ = [
-    "NOOP_SPAN", "ObsServer", "Span", "Tracer", "chrome_trace", "costs",
-    "default_tracer", "flops", "lookahead_overlap", "merge_traces",
-    "render_prometheus", "roofline", "validate_chrome_trace",
-    "write_chrome_trace",
+    "NOOP_SPAN", "Objective", "ObsServer", "SloTracker", "Span", "Tracer",
+    "Watchdog", "aggregate", "chrome_trace", "combine_process_traces",
+    "costs", "default_tracer", "flops", "lookahead_overlap",
+    "merge_traces", "render_prometheus", "roofline", "slo",
+    "validate_chrome_trace", "watchdog", "write_chrome_trace",
 ]
 
 

@@ -1,0 +1,241 @@
+"""Pod-scale telemetry aggregation: N processes, one fleet view.
+
+The reference merges per-rank trace buffers and counter payloads
+post-hoc (each MPI rank writes its own Trace/counter stream; rank 0
+stitches the SVG and sums the counters). Our serving analog: every
+process exports a Metrics snapshot, flop/bytes ledger snapshots, and a
+Chrome trace; this module merges them —
+
+* **counters summed exactly** (plain float addition — merging two
+  copies of the same snapshot doubles every counter bit-exactly,
+  which is the aggregation acceptance test);
+* **histograms merged**: counts and sums add, min/max take the
+  extremes, the merged mean is recomputed, and the merged p50/p99 are
+  the count-weighted mean of the per-process quantiles (an
+  approximation — exact fleet quantiles need the raw samples, which
+  snapshots deliberately do not ship; documented in PERF.md Round 12);
+  the worst-valued exemplar survives;
+* **gauges labeled per host** (a fleet has one resident_bytes per
+  chip, not one sum; summable gauges are ALSO aggregated under
+  ``fleet_*`` names so capacity totals stay one query);
+* **derived headline rates recomputed** from the merged counters with
+  the same formulas ``runtime.Metrics._derive`` uses (pinned equal by
+  test — this module cannot import the runtime without dragging jax
+  into the obs layer, so the formulas are mirrored, not shared);
+* **traces combined keyed by trace-id** (obs.merge.
+  ``combine_process_traces``): per-process pid namespaces, span
+  identities prefixed with the host label so two processes' span id
+  counters cannot collide in one Perfetto load.
+
+Everything here is pure snapshot-in/snapshot-out (stdlib-only,
+jax-free): the processes can be 8 hosts of a pod or one host's
+bench + serve jobs — aggregation is the same fold either way.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from .merge import combine_process_traces  # re-export (fleet surface)
+
+__all__ = [
+    "combine_process_traces", "merge_bytes_snapshots",
+    "merge_flop_snapshots", "merge_histograms",
+    "merge_metrics_snapshots", "aggregate_processes",
+    "render_fleet_prometheus", "write_fleet",
+]
+
+# gauges that are meaningfully summable across processes (capacity
+# totals); everything else (headroom, per-chip charges, burn rates)
+# only makes sense per host
+_SUMMABLE_GAUGES = ("resident_bytes_total", "resident_bytes",
+                    "peak_hbm_bytes", "queue_depth", "inflight_batches")
+
+
+def _hosts(n: int, hosts: Optional[Sequence[str]]) -> List[str]:
+    if hosts is None:
+        return [f"proc{i}" for i in range(n)]
+    if len(hosts) != n:
+        raise ValueError(f"{n} snapshots but {len(hosts)} host labels")
+    return list(hosts)
+
+
+def merge_histograms(snaps: Sequence[dict]) -> dict:
+    """Merge per-process Histogram.snapshot() dicts (module
+    docstring); empty input -> empty-histogram shape."""
+    count = sum(int(s.get("count", 0)) for s in snaps)
+    total = sum(float(s.get("sum", 0.0)) for s in snaps)
+    mins = [s["min"] for s in snaps if s.get("min") is not None]
+    maxs = [s["max"] for s in snaps if s.get("max") is not None]
+    out = {
+        "count": count,
+        "sum": total,
+        "min": min(mins) if mins else None,
+        "max": max(maxs) if maxs else None,
+        "mean": (total / count) if count else None,
+    }
+    for q in ("p50", "p99"):
+        num = den = 0.0
+        for s in snaps:
+            c = int(s.get("count", 0))
+            if c and s.get(q) is not None:
+                num += c * float(s[q])
+                den += c
+        out[q] = (num / den) if den else 0.0
+    exemplars = [s.get("exemplar") for s in snaps if s.get("exemplar")]
+    out["exemplar"] = (max(exemplars, key=lambda e: e.get("value", 0.0))
+                       if exemplars else None)
+    return out
+
+
+def _derive(counters: dict, hists: dict) -> dict:
+    """Mirror of runtime.Metrics._derive over MERGED counters (see
+    module docstring for why it is mirrored, and the pin test)."""
+    hits = counters.get("cache_hits", 0.0)
+    misses = counters.get("cache_misses", 0.0)
+    total = hits + misses
+    solve_seconds = hists.get("solve_latency", {}).get("sum", 0.0)
+    solves = counters.get("solves_total", 0.0)
+    flops = counters.get("solve_flops_total", 0.0)
+    return {
+        "cache_hit_rate": hits / total if total else 0.0,
+        "solves_per_sec": (solves / solve_seconds
+                           if solve_seconds > 0 else 0.0),
+        "gflops": (flops / solve_seconds / 1e9
+                   if solve_seconds > 0 else 0.0),
+    }
+
+
+def merge_metrics_snapshots(snaps: Sequence[dict],
+                            hosts: Optional[Sequence[str]] = None) -> dict:
+    """N ``Metrics.snapshot()`` dicts -> one fleet snapshot (module
+    docstring). The result renders through
+    ``exposition.render_prometheus`` unchanged; per-host gauges ride in
+    ``gauges_per_host`` (``render_fleet_prometheus`` emits them with
+    ``host=`` labels)."""
+    snaps = list(snaps)
+    labels = _hosts(len(snaps), hosts)
+    counters: Dict[str, float] = {}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+    hist_names = sorted({k for s in snaps for k in s.get("histograms", {})})
+    hists = {name: merge_histograms(
+        [s["histograms"][name] for s in snaps
+         if name in s.get("histograms", {})]) for name in hist_names}
+    gauges_per_host = {label: dict(s.get("gauges", {}))
+                       for label, s in zip(labels, snaps)}
+    fleet_gauges = {}
+    for g in _SUMMABLE_GAUGES:
+        vals = [s["gauges"][g] for s in snaps if g in s.get("gauges", {})]
+        if vals:
+            fleet_gauges[f"fleet_{g}"] = sum(vals)
+    return {
+        "hosts": labels,
+        "processes": len(snaps),
+        "uptime_s": max((s.get("uptime_s", 0.0) for s in snaps),
+                        default=0.0),
+        "counters": counters,
+        "histograms": hists,
+        "gauges": fleet_gauges,
+        "gauges_per_host": gauges_per_host,
+        "derived": _derive(counters, hists),
+    }
+
+
+def _merge_keyed_sums(snaps: Sequence[dict], key: str) -> Dict[str, dict]:
+    """Union per-op/per-kind tables, summing every numeric field."""
+    out: Dict[str, dict] = {}
+    for s in snaps:
+        for op, row in s.get(key, {}).items():
+            dst = out.setdefault(op, {})
+            if isinstance(row, dict):
+                for k, v in row.items():
+                    dst[k] = dst.get(k, 0) + v
+            else:  # flop ledger per_op: bare floats
+                dst["value"] = dst.get("value", 0.0) + row
+    return out
+
+
+def merge_flop_snapshots(snaps: Sequence[dict]) -> dict:
+    """N ``FlopLedger.snapshot()`` dicts -> one (totals/per-op/calls
+    summed)."""
+    out = {"flops_total": sum(s.get("flops_total", 0.0) for s in snaps),
+           "per_op": {}, "calls": {}}
+    for s in snaps:
+        for op, v in s.get("per_op", {}).items():
+            out["per_op"][op] = out["per_op"].get(op, 0.0) + v
+        for op, c in s.get("calls", {}).items():
+            out["calls"][op] = out["calls"].get(op, 0) + c
+    return out
+
+
+def merge_bytes_snapshots(snaps: Sequence[dict]) -> dict:
+    """N ``BytesLedger.snapshot()`` dicts -> one."""
+    return {
+        "bytes_total": sum(s.get("bytes_total", 0.0) for s in snaps),
+        "collective_bytes_total": sum(
+            s.get("collective_bytes_total", 0.0) for s in snaps),
+        "per_op": _merge_keyed_sums(snaps, "per_op"),
+        "per_collective": _merge_keyed_sums(snaps, "per_collective"),
+    }
+
+
+def aggregate_processes(metric_snaps: Sequence[dict],
+                        flop_snaps: Optional[Sequence[dict]] = None,
+                        bytes_snaps: Optional[Sequence[dict]] = None,
+                        hosts: Optional[Sequence[str]] = None) -> dict:
+    """One fleet document: merged metrics (+ ledgers when given)."""
+    doc = {"fleet": True,
+           "metrics": merge_metrics_snapshots(metric_snaps, hosts)}
+    if flop_snaps is not None:
+        doc["flops"] = merge_flop_snapshots(flop_snaps)
+    if bytes_snaps is not None:
+        doc["bytes"] = merge_bytes_snapshots(bytes_snaps)
+    return doc
+
+
+def render_fleet_prometheus(fleet: dict, prefix: str = "slate_tpu") -> str:
+    """Prometheus text of an ``aggregate_processes`` document: the
+    merged counters/histograms/derived through the standard renderer
+    (process-local ledger sections disabled — the fleet ledgers are
+    rendered from the MERGED snapshots below), then per-host gauges
+    with ``host=`` labels."""
+    from .exposition import _num, _san, render_prometheus
+    merged = fleet["metrics"]
+    text = render_prometheus(merged, prefix=prefix, ledger=False,
+                             bytes_ledger=False)
+    lines = [text.rstrip("\n")]
+    for host in merged["hosts"]:
+        gauges = merged["gauges_per_host"].get(host, {})
+        for k in sorted(gauges):
+            name = f"{prefix}_{_san(k)}"
+            lines.append(f'{name}{{host="{_san(host)}"}} '
+                         f"{_num(gauges[k])}")
+    if "flops" in fleet:
+        lines.append(f"# TYPE {prefix}_fleet_driver_flops_total counter")
+        lines.append(f"{prefix}_fleet_driver_flops_total "
+                     f"{_num(fleet['flops']['flops_total'])}")
+    if "bytes" in fleet:
+        lines.append(f"# TYPE {prefix}_fleet_driver_bytes_total counter")
+        lines.append(f"{prefix}_fleet_driver_bytes_total "
+                     f"{_num(fleet['bytes']['bytes_total'])}")
+        lines.append(
+            f"# TYPE {prefix}_fleet_collective_bytes_total counter")
+        lines.append(f"{prefix}_fleet_collective_bytes_total "
+                     f"{_num(fleet['bytes']['collective_bytes_total'])}")
+    return "\n".join(lines) + "\n"
+
+
+def write_fleet(fleet: dict, json_path: Optional[str] = None,
+                prom_path: Optional[str] = None) -> dict:
+    """Persist one fleet view (JSON and/or Prometheus text)."""
+    if json_path is not None:
+        with open(json_path, "w") as f:
+            json.dump(fleet, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if prom_path is not None:
+        with open(prom_path, "w") as f:
+            f.write(render_fleet_prometheus(fleet))
+    return fleet

@@ -9,6 +9,8 @@ a stdlib-only (http.server) threaded listener with
 * ``GET /metrics``    — Prometheus text of the bound Metrics + ledger
 * ``GET /healthz``    — liveness JSON ({"status": "ok", uptime, ...})
 * ``GET /trace.json`` — Chrome-trace JSON of the bound Tracer's spans
+* ``GET /slo``        — SLO burn-rate payload (obs.slo.SloTracker
+  .evaluate; {"enabled": false} when no tracker is bound)
 
 No third-party dependency, daemon threads only, ephemeral port by
 default (``port=0``) so tests and co-located sessions never collide.
@@ -85,6 +87,13 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
             v = h.get(stat)
             if v is not None:
                 emit(f"{base}_{stat}", v, "gauge")
+        # round 12: the worst observation's exemplar trace-id (set by
+        # the lifecycle-stage histograms) as a plain gauge — the 0.0.4
+        # text format has no exemplar syntax, and a trace id is a
+        # join key, not a measurement
+        ex = h.get("exemplar")
+        if ex and ex.get("trace_id") is not None:
+            emit(f"{base}_exemplar_trace_id", ex["trace_id"], "gauge")
     for k in sorted(snapshot.get("gauges", {})):
         emit(f"{prefix}_{_san(k)}", snapshot["gauges"][k], "gauge")
     for k in sorted(snapshot.get("derived", {})):
@@ -157,6 +166,12 @@ class _Handler(BaseHTTPRequestHandler):
             spans = obs.tracer.spans() if obs.tracer is not None else []
             body = json.dumps(chrome_trace(spans)) + "\n"
             self._reply(200, body, "application/json")
+        elif path == "/slo":
+            tracker = obs.slo() if callable(obs.slo) else obs.slo
+            payload = (tracker.evaluate() if tracker is not None
+                       else {"enabled": False, "objectives": []})
+            body = json.dumps(payload) + "\n"
+            self._reply(200, body, "application/json")
         else:
             self._reply(404, "not found\n", "text/plain")
 
@@ -180,9 +195,13 @@ class ObsServer:
     shuts it down (also a context manager)."""
 
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
-                 port: int = 0, ledger=None):
+                 port: int = 0, ledger=None, slo=None):
         self.metrics = metrics
         self.tracer = tracer
+        # the /slo provider: an SloTracker, or a zero-arg callable
+        # resolved per request (Session.serve_obs passes a getter so a
+        # tracker enabled AFTER the server started is still served)
+        self.slo = slo
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

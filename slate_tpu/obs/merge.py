@@ -184,6 +184,52 @@ def lookahead_overlap(events: Iterable[dict], driver: str = "potrf") -> dict:
     }
 
 
+# -- multi-process combine (round 12: obs.aggregate's trace half) ------------
+
+# pid namespace stride per process: every process emits pids 0 (host
+# threads), 1 (phase lanes), 2 (re-based device lanes) — see
+# obs.export; 100 leaves room for any future lane class
+_PROC_PID_STRIDE = 100
+
+
+def combine_process_traces(traces: Iterable, labels: Optional[List[str]]
+                           = None) -> dict:
+    """N processes' Chrome traces -> ONE trace, keyed by trace-id.
+
+    The reference merges per-rank Trace buffers post-hoc; this is the
+    trace_event version: process i's events keep their relative
+    timestamps but move into a disjoint pid namespace
+    (``pid + i * 100``), every event's args gain a ``host`` label, and
+    span/trace identities are prefixed with it (two processes' span-id
+    counters both start at 1 — unprefixed they would alias in one
+    Perfetto load). Per-process ``process_name`` metadata is rewritten
+    to ``{label}:{original}`` so the lanes stay attributable."""
+    out: List[dict] = []
+    for i, tr in enumerate(traces):
+        label = (labels[i] if labels and i < len(labels) else f"proc{i}")
+        base = i * _PROC_PID_STRIDE
+        for e in events_of(tr):
+            e = dict(e)
+            e["pid"] = int(e.get("pid", 0)) + base
+            args = dict(e.get("args") or {})
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name" and "name" in args:
+                    args["name"] = f"{label}:{args['name']}"
+                e["args"] = args
+                out.append(e)
+                continue
+            for key in ("trace_id", "span_id", "parent_id"):
+                if args.get(key) is not None:
+                    args[key] = f"{label}/{args[key]}"
+            args["host"] = label
+            e["args"] = args
+            out.append(e)
+    # the chrome validator (and readers) expect "X" events in ts order;
+    # metadata first, as obs.export emits them
+    out.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
 # -- host/device merge -------------------------------------------------------
 
 

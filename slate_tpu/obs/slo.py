@@ -1,0 +1,296 @@
+"""Serving SLOs: declarative objectives, rolling windows, burn rates.
+
+Rounds 8–9 gave the service eyes (spans, ledgers, Prometheus); this
+module closes the loop by giving it an *objective*: a declarative
+:class:`Objective` states what fraction of events must be good
+("99 % of requests under 50 ms", "99.9 % of solves succeed", "90 % of
+factor lookups hit the cache", "99.9 % of budget checks stay inside
+HBM"), and an :class:`SloTracker` evaluates each objective over
+rolling time windows using the standard SRE **burn-rate** formula:
+
+    error budget = 1 − target
+    burn rate(window) = (bad / total over the window) / error budget
+
+Burn rate 1.0 means the service is consuming its error budget exactly
+at the allowed rate; 10 means the budget burns 10× too fast. An
+objective **breaches** when EVERY configured window (conventionally a
+short window for recency and a long one for significance — the
+multi-window multi-burn-rate alerting rule) has traffic and a burn
+rate above ``burn_threshold``; requiring all windows keeps one
+transient spike (short dirty, long clean) and one stale incident
+(long dirty, short clean) from paging.
+
+Event flow: the serving runtime feeds the tracker at the points where
+it already counts metrics — request/solve resolution (op, n, latency,
+ok), factor-cache hits/misses, HBM-budget checks — guarded by one
+``session.slo is not None`` test, so the disabled path allocates
+NOTHING (the round-8 acceptance, extended to this module by test).
+Breaches feed the existing warning path (the ``slate_tpu.obs`` logger
+the slow-request log uses), bump the ``slo_breaches_total`` counter,
+set per-objective burn-rate/breach gauges on the bound Metrics (hence
+Prometheus), and emit an anomaly event span when tracing is on. The
+``/slo`` endpoint on ``ObsServer`` serves :meth:`SloTracker.evaluate`
+as JSON.
+
+Stdlib-only and jax-free (the obs import rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Sequence, Tuple
+
+from .tracing import log
+
+# (short, long) rolling windows, seconds. Production SRE practice uses
+# e.g. (300, 3600); the default keeps the short window useful in tests
+# and smoke runs while the long window is the significance check.
+DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 3600.0)
+
+KINDS = ("latency", "error_rate", "cache_hit_rate", "oom_risk")
+
+
+def n_bucket(n: int) -> int:
+    """Pow2 size bucket of a problem dimension — the same quantization
+    the batch engine uses (linalg/batched.batch_bucket), duplicated
+    here without the jax import: SLO scopes speak the bucket
+    vocabulary so one objective covers every n the bucket serves."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative SLO.
+
+    ``target`` is the good-event fraction in (0, 1) — e.g. 0.99 means
+    "99 % of events must be good"; the error budget is 1 − target.
+    ``kind`` selects the event stream and the goodness predicate:
+
+    * ``latency``        — request/solve events; good = succeeded AND
+      ``latency_s <= threshold_s`` (``threshold_s`` required).
+    * ``error_rate``     — request/solve events; good = succeeded.
+    * ``cache_hit_rate`` — factor-cache accesses; good = hit.
+    * ``oom_risk``       — HBM budget checks; good = within budget.
+
+    ``op``/``n_bucket`` scope latency/error objectives to one operator
+    kind and/or one pow2 size bucket (None = all); ``source`` selects
+    the stream: "request" (Batcher resolution — queue wait included,
+    the client-visible number) or "solve" (Session device dispatch).
+    """
+
+    name: str
+    kind: str
+    target: float
+    threshold_s: Optional[float] = None
+    op: Optional[str] = None
+    n_bucket: Optional[int] = None
+    source: str = "request"
+    windows: Tuple[float, ...] = DEFAULT_WINDOWS
+    burn_threshold: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"Objective {self.name!r}: unknown kind "
+                             f"{self.kind!r} (one of {KINDS})")
+        if not (0.0 < self.target < 1.0):
+            raise ValueError(f"Objective {self.name!r}: target must be in "
+                             f"(0, 1), got {self.target}")
+        if self.kind == "latency" and not self.threshold_s:
+            raise ValueError(f"Objective {self.name!r}: latency objectives "
+                             "need threshold_s")
+        if not self.windows:
+            raise ValueError(f"Objective {self.name!r}: needs >= 1 window")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+
+def default_objectives(latency_threshold_s: float = 0.25,
+                       windows: Tuple[float, ...] = DEFAULT_WINDOWS
+                       ) -> Tuple[Objective, ...]:
+    """The serving defaults: request latency, request errors, factor
+    cache hit rate, HBM OOM risk — one of each kind, unscoped."""
+    return (
+        Objective("request_latency", "latency", 0.99,
+                  threshold_s=latency_threshold_s, windows=windows),
+        Objective("request_errors", "error_rate", 0.999, windows=windows),
+        Objective("factor_cache_hit_rate", "cache_hit_rate", 0.90,
+                  windows=windows),
+        Objective("hbm_oom_risk", "oom_risk", 0.999, windows=windows),
+    )
+
+
+# one recorded event: (t, latency_s, ok) for request streams,
+# (t, 0.0, ok) for the cache/oom streams
+_Event = Tuple[float, float, bool]
+
+
+class SloTracker:
+    """Rolling-window SLO evaluation over runtime-fed events.
+
+    Thread-safe; events arrive from the Executor worker and the Session
+    lock scope, evaluation from the ObsServer scrape thread. Streams
+    are bounded deques (oldest events fall off; the windows are what
+    give the numbers meaning anyway). ``clock`` is injectable and every
+    record method takes an explicit ``t`` so the burn-rate math is
+    pinnable without sleeping."""
+
+    def __init__(self, objectives: Optional[Sequence[Objective]] = None,
+                 metrics=None, tracer=None, max_events: int = 8192,
+                 clock=time.monotonic):
+        self.objectives: Tuple[Objective, ...] = tuple(
+            default_objectives() if objectives is None else objectives)
+        self.metrics = metrics
+        self.tracer = tracer
+        self._clock = clock
+        self._max = max_events
+        self._lock = threading.Lock()
+        # (source, op, n_bucket) -> events; scoped lookups filter keys
+        self._requests: Dict[Tuple[str, str, int], Deque[_Event]] = {}
+        self._cache: Deque[_Event] = deque(maxlen=max_events)
+        self._oom: Deque[_Event] = deque(maxlen=max_events)
+        self._breached: Dict[str, bool] = {}
+
+    # -- recording (the runtime's hot path: one lock, one append) ----------
+
+    def record_request(self, op: str, n: int, latency_s: float,
+                       ok: bool = True, source: str = "request",
+                       t: Optional[float] = None):
+        key = (source, op, n_bucket(n))
+        t = self._clock() if t is None else t
+        with self._lock:
+            q = self._requests.get(key)
+            if q is None:
+                q = self._requests[key] = deque(maxlen=self._max)
+            q.append((t, float(latency_s), bool(ok)))
+
+    def record_cache(self, hit: bool, t: Optional[float] = None):
+        t = self._clock() if t is None else t
+        with self._lock:
+            self._cache.append((t, 0.0, bool(hit)))
+
+    def record_oom(self, ok: bool, t: Optional[float] = None):
+        """One HBM budget check: ok = resident + transient fit."""
+        t = self._clock() if t is None else t
+        with self._lock:
+            self._oom.append((t, 0.0, bool(ok)))
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _events_for(self, obj: Objective) -> Tuple[_Event, ...]:
+        """Caller holds the lock."""
+        if obj.kind == "cache_hit_rate":
+            return tuple(self._cache)
+        if obj.kind == "oom_risk":
+            return tuple(self._oom)
+        out = []
+        for (source, op, nb), q in self._requests.items():
+            if source != obj.source:
+                continue
+            if obj.op is not None and op != obj.op:
+                continue
+            if obj.n_bucket is not None and nb != obj.n_bucket:
+                continue
+            out.extend(q)
+        return tuple(out)
+
+    @staticmethod
+    def _window_stats(obj: Objective, events, now: float,
+                      window_s: float) -> dict:
+        """One window's burn-rate row — THE formula (pinned by test):
+        burn = (bad/total) / (1 − target); None fields while empty."""
+        total = bad = 0
+        lat = []
+        lo = now - window_s
+        for t, latency, ok in events:
+            if t < lo or t > now:
+                continue
+            total += 1
+            good = ok
+            if obj.kind == "latency":
+                good = ok and latency <= obj.threshold_s
+                lat.append(latency)
+            if not good:
+                bad += 1
+        row = {
+            "window_s": window_s,
+            "total": total,
+            "bad": bad,
+            "good_fraction": (1.0 - bad / total) if total else None,
+            "burn_rate": (bad / total / obj.budget) if total else None,
+        }
+        if obj.kind == "latency" and lat:
+            # the observed latency at the target quantile — the number
+            # a threshold re-tune reads (nearest-rank)
+            s = sorted(lat)
+            idx = min(len(s) - 1, int(obj.target * len(s)))
+            row["latency_at_target_quantile_s"] = s[idx]
+        return row
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """The ``/slo`` payload: every objective's per-window burn
+        rates + breach state. A breach transition (ok -> breached)
+        warns on the slate_tpu.obs logger, bumps ``slo_breaches_total``,
+        and emits an ``slo.breach`` anomaly event span when tracing is
+        on; burn rates and breach flags land as gauges on the bound
+        Metrics either way (the Prometheus surface)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            snapshots = [(obj, self._events_for(obj))
+                         for obj in self.objectives]
+        rows = []
+        breaches = 0
+        for obj, events in snapshots:
+            windows = [self._window_stats(obj, events, now, w)
+                       for w in obj.windows]
+            burns = [w["burn_rate"] for w in windows]
+            breached = bool(burns) and all(
+                b is not None and b > obj.burn_threshold for b in burns)
+            worst = max((b for b in burns if b is not None), default=None)
+            row = {
+                "name": obj.name, "kind": obj.kind, "target": obj.target,
+                "threshold_s": obj.threshold_s, "op": obj.op,
+                "n_bucket": obj.n_bucket, "source": obj.source,
+                "burn_threshold": obj.burn_threshold,
+                "windows": windows, "worst_burn_rate": worst,
+                "breached": breached,
+            }
+            rows.append(row)
+            breaches += breached
+            self._publish(obj, windows, worst, breached)
+        return {"enabled": True, "now": now, "objectives": rows,
+                "breached_count": breaches}
+
+    def _publish(self, obj: Objective, windows, worst, breached: bool):
+        # transition detection under the lock: two concurrent /slo
+        # scrapes must not both observe ok->breached and double-count
+        # the breach (ThreadingHTTPServer serves scrapes in parallel)
+        with self._lock:
+            was = self._breached.get(obj.name, False)
+            self._breached[obj.name] = breached
+        m = self.metrics
+        if m is not None:
+            for w in windows:
+                if w["burn_rate"] is not None:
+                    m.set_gauge(
+                        f"slo_burn_rate:{obj.name}:w{int(w['window_s'])}",
+                        w["burn_rate"])
+            m.set_gauge(f"slo_breached:{obj.name}", 1.0 if breached else 0.0)
+            if breached and not was:
+                m.inc("slo_breaches_total")
+        if breached and not was:
+            log.warning(
+                "SLO breach: %s (%s, target %.4g) burn rate %.3g over %s",
+                obj.name, obj.kind, obj.target, worst,
+                [w["window_s"] for w in windows])
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tr.finish_span(tr.start_span(
+                    "slo.breach", kind="anomaly", objective=obj.name,
+                    slo_kind=obj.kind, target=obj.target,
+                    worst_burn_rate=worst))

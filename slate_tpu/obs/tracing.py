@@ -244,6 +244,17 @@ class Tracer:
                     span.name, total * 1e3, self.slow_threshold * 1e3,
                     span.attrs)
 
+    def event(self, name: str, kind: str = "event", **attrs
+              ) -> Optional[Span]:
+        """Record a zero-duration marker span (SLO breaches, watchdog
+        anomalies): opened and finished in one call, parented on the
+        current context. No-op (None, no allocation) when disabled."""
+        if not self.enabled:
+            return None
+        span = self.start_span(name, kind=kind, **attrs)
+        self.finish_span(span)
+        return span
+
     # -- introspection -----------------------------------------------------
 
     def spans(self) -> List[Span]:

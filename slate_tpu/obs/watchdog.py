@@ -1,0 +1,302 @@
+"""Online regression watchdog: live serving numbers vs committed bars.
+
+Eight rounds of BENCH/MULTICHIP artifacts form a performance
+trajectory that ``tools/bench_gate.py`` already normalizes and gates —
+but only when a human (or CI) reruns the bench. This module watches
+the *live* service: it loads the best-prior value of every committed
+series from ``BASELINE_SERIES.json`` (the artifact
+``tools/bench_gate.py --baseline-out`` exports — one source of truth,
+schema-checked with the other artifacts), accepts live observations
+per execution window (throughput, latency percentiles, roofline
+fraction), and flags any gated series whose best live value over the
+window falls beyond tolerance of the committed best — emitting
+anomaly events into the trace and counters/gauges into ``/metrics``.
+
+This is how the first on-chip session self-verifies the round-6/7
+standing bars (getrf >= 15,000 GFLOP/s, potrf >= 40 % of gemm-high)
+without a human rereading PERF.md: run the workload with the watchdog
+attached and alarm on ``watchdog_anomalies_total``.
+
+Tolerance policy is bench_gate's, reused verbatim (PERF.md Round 9):
+10 % vs best-prior, only the ``tpu``/``axon`` platforms gate — CPU
+smoke numbers are dispatch-noise-dominated and report as
+informational. Direction is per-series ("higher" for throughput,
+"lower" for latency/residual series), carried by the baseline
+artifact.
+
+Stdlib-only and jax-free (the obs import rule); the platform label is
+the caller's (``jax.default_backend()`` at the call site).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .tracing import log
+
+BASELINE_SCHEMA = "slate_tpu.baseline_series.v1"
+BASELINE_FILENAME = "BASELINE_SERIES.json"
+DEFAULT_TOLERANCE = 0.10
+GATED_PLATFORMS = ("tpu", "axon")
+DEFAULT_WINDOW_S = 60.0
+
+# key fields of one series, in artifact order — the same vocabulary
+# bench_gate._series_key speaks
+_KEY_FIELDS = ("kind", "metric", "platform", "n", "batch", "op", "dtype")
+
+_SeriesKey = Tuple
+
+
+def baseline_path() -> str:
+    """The committed artifact at the repo root."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, os.pardir, BASELINE_FILENAME)
+
+
+def validate_baseline(doc: dict) -> List[str]:
+    """Schema errors of a loaded baseline document (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["baseline: top level is not an object"]
+    if doc.get("schema") != BASELINE_SCHEMA:
+        errs.append(f"baseline: schema {doc.get('schema')!r} != "
+                    f"{BASELINE_SCHEMA!r}")
+    series = doc.get("series")
+    if not isinstance(series, list) or not series:
+        return errs + ["baseline: series missing or empty"]
+    for i, row in enumerate(series):
+        for k in ("metric", "platform", "best", "direction"):
+            if k not in row:
+                errs.append(f"baseline series[{i}]: missing {k!r}")
+                break
+        else:
+            if row["direction"] not in ("higher", "lower"):
+                errs.append(f"baseline series[{i}]: direction "
+                            f"{row['direction']!r}")
+            if not isinstance(row["best"], (int, float)) \
+                    or isinstance(row["best"], bool):
+                errs.append(f"baseline series[{i}]: non-numeric best")
+    return errs
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    """Load + validate ``BASELINE_SERIES.json`` (default: the committed
+    repo-root artifact). Raises ValueError on schema violations — a
+    watchdog running against a malformed baseline would be silently
+    blind, the worse failure mode."""
+    path = baseline_path() if path is None else path
+    with open(path) as f:
+        doc = json.load(f)
+    errs = validate_baseline(doc)
+    if errs:
+        raise ValueError(f"{os.path.basename(path)}: " + "; ".join(errs))
+    return doc
+
+
+def _series_key(row: dict) -> _SeriesKey:
+    return tuple(row.get(k) for k in _KEY_FIELDS)
+
+
+class Watchdog:
+    """Compares live per-window observations against the baseline.
+
+    ``baseline``: a loaded document, a path, or None (the committed
+    repo-root artifact). ``tolerance`` defaults to the baseline's own
+    (bench_gate's 10 %). Live series that match no baseline key are
+    counted (``unmatched``) but never flagged — the watchdog only
+    speaks where history exists."""
+
+    def __init__(self, baseline=None, metrics=None, tracer=None,
+                 tolerance: Optional[float] = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 gated_platforms: Tuple[str, ...] = GATED_PLATFORMS,
+                 max_events: int = 4096, clock=time.monotonic):
+        if baseline is None or isinstance(baseline, str):
+            baseline = load_baseline(baseline)
+        else:
+            errs = validate_baseline(baseline)
+            if errs:
+                raise ValueError("; ".join(errs))
+        self.tolerance = (baseline.get("tolerance", DEFAULT_TOLERANCE)
+                          if tolerance is None else tolerance)
+        self.gated_platforms = tuple(gated_platforms)
+        self.window_s = window_s
+        self.metrics = metrics
+        self.tracer = tracer
+        self._clock = clock
+        self._max = max_events
+        self._baseline: Dict[_SeriesKey, dict] = {
+            _series_key(row): row for row in baseline["series"]}
+        # producer (serving thread observes) / consumer (scrape thread
+        # checks) share the live map — same locking discipline as
+        # SloTracker
+        self._lock = threading.Lock()
+        self._live: Dict[_SeriesKey, Deque[Tuple[float, float]]] = {}
+        # series currently in the anomalous state: transition
+        # detection (ok -> anomalous emits; staying anomalous does
+        # not), so a scrape-driven check() loop counts REGRESSIONS in
+        # watchdog_anomalies_total, not scrapes — the SloTracker
+        # breach-transition discipline
+        self._flagged: set = set()
+        self.anomalies: List[dict] = []
+
+    @property
+    def series(self) -> Dict[_SeriesKey, dict]:
+        return dict(self._baseline)
+
+    # -- live feed ----------------------------------------------------------
+
+    def observe(self, metric: str, value: float, platform: str,
+                n: Optional[int] = None, op: Optional[str] = None,
+                batch: Optional[int] = None, dtype: Optional[str] = None,
+                kind: Optional[str] = None, t: Optional[float] = None):
+        """One live sample of a series (the bench_gate key vocabulary:
+        kind/metric/platform/n/batch/op/dtype)."""
+        key = (kind, metric, platform, n, batch, op, dtype)
+        t = self._clock() if t is None else t
+        with self._lock:
+            q = self._live.get(key)
+            if q is None:
+                q = self._live[key] = deque(maxlen=self._max)
+            q.append((t, float(value)))
+
+    def watch_session(self, session, platform: str, n: Optional[int] = None,
+                      op: Optional[str] = None, kind: Optional[str] = "serve",
+                      t: Optional[float] = None):
+        """Convenience: derive the serving headline series from a
+        Session's metrics — solves/sec and GFLOP/s over accumulated
+        device-solve time, the request-latency p99, and (when a
+        MachineModel is configured) the serve.solve roofline fraction —
+        and feed them as live observations under ``platform``/``n``."""
+        snap = session.metrics.snapshot()
+        derived = snap.get("derived", {})
+        common = dict(platform=platform, n=n, op=op, kind=kind, t=t)
+        if derived.get("solves_per_sec"):
+            self.observe("serve.solves_per_sec", derived["solves_per_sec"],
+                         **common)
+        if derived.get("gflops"):
+            self.observe("serve.gflops", derived["gflops"], **common)
+        h = snap.get("histograms", {}).get("request_latency")
+        if h and h.get("count"):
+            self.observe("request_latency_p99", h["p99"], **common)
+        frac = _serve_roof_fraction(snap)
+        if frac is not None:
+            self.observe("serve.roof_fraction", frac, **common)
+
+    # -- the check ----------------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> dict:
+        """Compare every live series with history against its committed
+        best. The live number is the window's BEST achieved value (max
+        for higher-is-better, min for lower) — charitable on purpose:
+        a warmup transient inside an otherwise healthy window is not a
+        regression. A gated-platform drop beyond tolerance is an
+        anomaly; other platforms report informationally (the
+        bench_gate policy). The report lists every CURRENT anomaly,
+        but the counter/log/trace-event emission fires only on the
+        ok -> anomalous TRANSITION of a series (a persistent
+        regression scraped every 15 s is one regression, not one per
+        scrape — a recovered series re-arms);
+        ``watchdog_anomaly_count`` gauges the current state."""
+        now = self._clock() if now is None else now
+        lo = now - self.window_s
+        anomalies: List[dict] = []
+        informational: List[dict] = []
+        matched = unmatched = 0
+        with self._lock:
+            live_map = {key: list(q) for key, q in self._live.items()}
+        for key, q in live_map.items():
+            base = self._baseline.get(key)
+            if base is None:
+                unmatched += 1
+                continue
+            vals = [v for (t, v) in q if lo <= t <= now]
+            if not vals:
+                continue
+            matched += 1
+            direction = base.get("direction", "higher")
+            best = float(base["best"])
+            live = max(vals) if direction == "higher" else min(vals)
+            if best == 0:
+                continue
+            if direction == "higher":
+                drop = (best - live) / best
+            else:
+                drop = (live - best) / abs(best)
+            if drop <= self.tolerance:
+                continue
+            platform = key[2]
+            row = dict(zip(_KEY_FIELDS, key))
+            row.update({
+                "baseline_best": best, "live": live,
+                "direction": direction,
+                "drop_pct": round(100 * drop, 1),
+                "gated": platform in self.gated_platforms,
+                "window_s": self.window_s,
+            })
+            (anomalies if row["gated"] else informational).append(row)
+        # transition detection over the gated set: emit (counter, log,
+        # trace event) only for series that were ok at the last check;
+        # a recovered series re-arms
+        now_flagged = {tuple(r.get(k) for k in _KEY_FIELDS)
+                       for r in anomalies}
+        with self._lock:
+            new_keys = now_flagged - self._flagged
+            self._flagged = now_flagged
+        self._emit([r for r in anomalies
+                    if tuple(r.get(k) for k in _KEY_FIELDS) in new_keys])
+        report = {
+            "now": now, "window_s": self.window_s,
+            "tolerance": self.tolerance,
+            "baseline_series": len(self._baseline),
+            "live_series": len(live_map),
+            "matched": matched, "unmatched": unmatched,
+            "anomalies": anomalies, "informational": informational,
+            "ok": not anomalies,
+        }
+        if self.metrics is not None:
+            self.metrics.set_gauge("watchdog_series_matched", matched)
+            self.metrics.set_gauge("watchdog_anomaly_count", len(anomalies))
+        return report
+
+    def _emit(self, anomalies: List[dict]):
+        self.anomalies.extend(anomalies)
+        del self.anomalies[:-256]  # bounded, newest kept
+        if not anomalies:
+            return
+        if self.metrics is not None:
+            self.metrics.inc("watchdog_anomalies_total", len(anomalies))
+        for row in anomalies:
+            log.warning(
+                "watchdog anomaly: %s [%s, n=%s] live %.4g vs committed "
+                "best %.4g (%s-is-better, %s%% worse)",
+                row["metric"], row["platform"], row["n"], row["live"],
+                row["baseline_best"], row["direction"], row["drop_pct"])
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                # the series' own "kind" field is renamed: the span
+                # model reserves kind= for the span class
+                attrs = {("series_kind" if k == "kind" else k): v
+                         for k, v in row.items() if v is not None}
+                tr.event("watchdog.anomaly", kind="anomaly", **attrs)
+
+
+def _serve_roof_fraction(snap: dict) -> Optional[float]:
+    """roof_fraction of the serve.solve roofline row, when a machine
+    model is configured (env) and the ledgers know the op."""
+    try:
+        from .roofline import MachineModel, roofline_report
+        if MachineModel.from_env() is None:
+            return None
+        rep = roofline_report()
+        for row in rep["rows"]:
+            if row["op"] == "serve.solve" and row["roof_fraction"]:
+                return row["roof_fraction"]
+    except Exception:
+        return None
+    return None

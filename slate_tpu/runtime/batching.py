@@ -90,6 +90,13 @@ class Batcher:
         self.pad_widths = pad_widths
         self._lock = threading.Lock()
         self._buckets: Dict[BucketKey, List[_Request]] = {}
+        # incrementally-maintained backpressure state (round 12): the
+        # submit hot path publishes gauges from these two counters
+        # instead of scanning every bucket while holding the lock;
+        # pop_ready recomputes them exactly from the queue
+        self._depth = 0
+        self._max_backlog = 0
+        self._oldest: Optional[float] = None  # head submit time
 
     # -- submission --------------------------------------------------------
 
@@ -111,12 +118,72 @@ class Batcher:
                        handle=handle)
         self.session.metrics.inc("requests_total")
         with self._lock:
-            self._buckets.setdefault(key, []).append(req)
+            bucket = self._buckets.setdefault(key, [])
+            bucket.append(req)
+            # cheap incremental gauge publish (one batched metrics-
+            # lock hold, no full-queue scan on the enqueue hot path);
+            # oldest_request_age_s is as of the last queue transition
+            # — pop_ready and backpressure() recompute it exactly
+            self._depth += 1
+            self._max_backlog = max(self._max_backlog, len(bucket))
+            if self._oldest is None:
+                self._oldest = req.t_submit  # only pops move it back
+            self.session.metrics.set_gauges({
+                "queue_depth": self._depth,
+                "queued_buckets": len(self._buckets),
+                "max_bucket_backlog": self._max_backlog,
+                "oldest_request_age_s": req.t_submit - self._oldest,
+            })
         return req.future
 
     def pending(self) -> int:
         with self._lock:
             return sum(len(v) for v in self._buckets.values())
+
+    # -- backpressure telemetry (round 12) ---------------------------------
+
+    def _update_backpressure_locked(self, now: Optional[float] = None):
+        """Caller holds the lock. Publish the queue's truth as gauges —
+        before this, the only queue signal was the indirect ``queue_s``
+        span attribute. Exact recompute, run on pops (the submit hot
+        path publishes from the incremental counters instead — module
+        state above), so a scrape between dispatches reads the state
+        as of the last queue transition. Also resyncs the incremental
+        counters."""
+        now = time.monotonic() if now is None else now
+        m = self.session.metrics
+        depths = [len(v) for v in self._buckets.values() if v]
+        self._depth = sum(depths)
+        self._max_backlog = max(depths, default=0)
+        self._oldest = min((reqs[0].t_submit
+                            for reqs in self._buckets.values() if reqs),
+                           default=None)
+        m.set_gauges({
+            "queue_depth": self._depth,
+            "queued_buckets": len(depths),
+            "max_bucket_backlog": self._max_backlog,
+            "oldest_request_age_s": (0.0 if self._oldest is None
+                                     else now - self._oldest),
+        })
+
+    def backpressure(self) -> dict:
+        """Point-in-time queue state, per bucket (JSON-friendly: the
+        /metrics gauges carry the aggregates; this is the labeled
+        breakdown a debugger wants)."""
+        now = time.monotonic()
+        with self._lock:
+            per_bucket = {
+                repr(key): {"backlog": len(reqs),
+                            "oldest_age_s": now - reqs[0].t_submit}
+                for key, reqs in self._buckets.items() if reqs}
+        return {
+            "queue_depth": sum(v["backlog"] for v in per_bucket.values()),
+            "queued_buckets": len(per_bucket),
+            "oldest_request_age_s": max(
+                (v["oldest_age_s"] for v in per_bucket.values()),
+                default=0.0),
+            "per_bucket": per_bucket,
+        }
 
     # -- readiness ---------------------------------------------------------
 
@@ -146,6 +213,8 @@ class Batcher:
                     self._buckets[key] = reqs = rest
                 if not reqs:
                     del self._buckets[key]
+            if out:
+                self._update_backpressure_locked(now)
         return out
 
     # -- dispatch ----------------------------------------------------------
@@ -176,7 +245,10 @@ class Batcher:
         bctx = (tr.span("serve.batch", handle=repr(handle),
                         batch_size=len(live), shape=list(key[1]),
                         dtype=key[2]) if tr.enabled else _NOOP_SPAN)
+        m = self.session.metrics
         with bctx as bspan:
+            # exemplar join key: the batch's trace id (NOOP -> None)
+            tid = getattr(bspan, "trace_id", None)
             for r in live:
                 # None unless this attempt re-runs a bucket whose spans
                 # the Executor already closed (errored attempt) — each
@@ -186,7 +258,11 @@ class Batcher:
                         "serve.request", parent=bspan, kind="request",
                         handle=repr(handle), shape=list(r.b.shape),
                         dtype=key[2], queue_s=now - r.t_submit)
+                # lifecycle stage 1 (round 12): submit -> dispatch start
+                m.observe("stage_queue_wait", now - r.t_submit,
+                          exemplar=tid)
             try:
+                t_form = time.monotonic()
                 stacked = np.concatenate([r.b for r in live], axis=1)
                 cols = stacked.shape[1]
                 if self.pad_widths:
@@ -201,9 +277,14 @@ class Batcher:
                             [stacked, np.zeros((stacked.shape[0],
                                                 w - cols),
                                                stacked.dtype)], axis=1)
+                # lifecycle stage 2: stack + width-pad the bucket (one
+                # observation per batch — formation is batch-scoped)
+                m.observe("stage_batch_form", time.monotonic() - t_form,
+                          exemplar=tid)
                 # served_cols: only the CLIENT columns count as solves
                 # — the padded zero columns are executed work (the
-                # ledgers see them) but not served requests. Passed
+                # ledgers see them, split out as padding_waste_flops/
+                # bytes — round 12) but not served requests. Passed
                 # only when padding actually happened, so the
                 # unpadded path keeps the bare solve(handle, b)
                 # signature.
@@ -220,10 +301,12 @@ class Batcher:
                 for r in live:
                     tr.finish_span(r.span, error=e)
                 raise
-            m = self.session.metrics
             m.inc("batches_total")
             m.observe("batch_size", float(len(live)))
             done = time.monotonic()
+            slo = self.session.slo
+            meta = (self.session.op_meta(handle)
+                    if slo is not None else None)
             col = 0
             for r in live:
                 w = r.b.shape[1]
@@ -237,10 +320,16 @@ class Batcher:
                     tr.finish_span(r.span, cancelled=True)
                     continue
                 lat = done - r.t_submit
-                m.observe("request_latency", lat)
+                m.observe("request_latency", lat, exemplar=tid)
+                if meta is not None:
+                    slo.record_request(meta[0], meta[1], lat, ok=True)
                 # total_s (submit -> resolve) is what the slow-request
                 # log thresholds on — the client-visible latency
                 tr.finish_span(r.span, total_s=lat)
+            # lifecycle stage 5: solve done -> futures resolved (the
+            # split/copy/notify reply cost, once per batch)
+            m.observe("stage_reply", time.monotonic() - done,
+                      exemplar=tid)
 
     def _run_small(self, key: BucketKey, reqs: List[_Request]):
         """Grouped small-problem dispatch: one bucket of DISTINCT-
@@ -259,13 +348,17 @@ class Batcher:
         bctx = (tr.span("serve.batch", op=op, n=n, grouped=True,
                         batch_size=len(live), shape=list(shape),
                         dtype=bdt) if tr.enabled else _NOOP_SPAN)
+        m = self.session.metrics
         with bctx as bspan:
+            tid = getattr(bspan, "trace_id", None)
             for r in live:
                 if r.span is None:
                     r.span = tr.start_span(
                         "serve.request", parent=bspan, kind="request",
                         handle=repr(r.handle), shape=list(r.b.shape),
                         dtype=bdt, queue_s=now - r.t_submit)
+                m.observe("stage_queue_wait", now - r.t_submit,
+                          exemplar=tid)
             try:
                 xs, infos = self.session.solve_small_batched(
                     [r.handle for r in live], [r.b for r in live])
@@ -273,10 +366,10 @@ class Batcher:
                 for r in live:
                     tr.finish_span(r.span, error=e)
                 raise
-            m = self.session.metrics
             m.inc("batches_total")
             m.observe("batch_size", float(len(live)))
             done = time.monotonic()
+            slo = self.session.slo
             for i, r in enumerate(live):
                 if infos[i] != 0:
                     err = SlateError(
@@ -286,6 +379,9 @@ class Batcher:
                         r.future.set_exception(err)
                     except InvalidStateError:
                         m.inc("cancelled_requests")
+                    if slo is not None:
+                        slo.record_request(op, n, done - r.t_submit,
+                                           ok=False)
                     tr.finish_span(r.span, error=err)
                     continue
                 xi = xs[i]
@@ -296,8 +392,12 @@ class Batcher:
                     tr.finish_span(r.span, cancelled=True)
                     continue
                 lat = done - r.t_submit
-                m.observe("request_latency", lat)
+                m.observe("request_latency", lat, exemplar=tid)
+                if slo is not None:
+                    slo.record_request(op, n, lat, ok=True)
                 tr.finish_span(r.span, total_s=lat)
+            m.observe("stage_reply", time.monotonic() - done,
+                      exemplar=tid)
 
     def flush(self):
         """Synchronously dispatch everything pending (caller's thread)."""

@@ -115,12 +115,17 @@ class Executor:
                 # between pop_ready and dispatch
                 batches = self.batcher.pop_ready(force=stopping)
                 self._inflight += len(batches)
+                if batches:
+                    self.session.metrics.set_gauge("inflight_batches",
+                                                   self._inflight)
             for key, reqs in batches:
                 try:
                     self._dispatch(key, reqs)
                 finally:
                     with self._cv:
                         self._inflight -= 1
+                        self.session.metrics.set_gauge("inflight_batches",
+                                                       self._inflight)
                         self._cv.notify_all()
             if stopping and not batches:
                 with self._cv:
@@ -168,9 +173,22 @@ class Executor:
                 if attempt < self.retries:
                     self.session.metrics.inc("retries")
         self.session.metrics.inc("failed_batches")
+        slo = self.session.slo
+        now = time.monotonic()
         for r in reqs:
+            # cancelled/already-resolved requests are NOT service
+            # failures — the success path skips them symmetrically
+            # (Batcher.run's cancelled `continue`), so the SLO error
+            # stream only counts requests this failure actually failed
+            was_done = r.future.done()
             try:
-                if not r.future.done():
+                if not was_done:
                     r.future.set_exception(err)
             except Exception:  # client cancelled concurrently — same
                 pass           # race Batcher.run guards on set_result
+            if slo is not None and not was_done:
+                # the final (post-retry) failure is the SLO error event
+                meta = self.session.op_meta(getattr(r, "handle", None))
+                if meta is not None:
+                    slo.record_request(meta[0], meta[1],
+                                       now - r.t_submit, ok=False)

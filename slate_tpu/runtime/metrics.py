@@ -33,7 +33,8 @@ class Histogram:
     p50/p99 should describe anyway (a day-old tail says nothing about
     current latency)."""
 
-    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_samples")
+    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_samples",
+                 "exemplar")
 
     def __init__(self, cap: int = 8192):
         self.cap = cap
@@ -42,12 +43,22 @@ class Histogram:
         self.vmin = float("inf")
         self.vmax = 0.0
         self._samples = collections.deque(maxlen=cap)
+        # exemplar of the worst TAGGED observation so far: a trace-id
+        # join key from histogram to trace (round 12 — the lifecycle
+        # stage histograms pass the live request's trace id). Tracked
+        # against the tagged maximum, not vmax: an untagged cold-start
+        # spike recorded before tracing was enabled must not block the
+        # join forever
+        self.exemplar: Optional[Dict[str, float]] = None
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar=None):
         self.count += 1
         self.total += value
         self.vmin = min(self.vmin, value)
         self.vmax = max(self.vmax, value)
+        if exemplar is not None and (self.exemplar is None
+                                     or value >= self.exemplar["value"]):
+            self.exemplar = {"trace_id": exemplar, "value": value}
         self._samples.append(value)
 
     def percentile(self, q: float) -> float:
@@ -72,6 +83,7 @@ class Histogram:
             "mean": None if empty else self.total / self.count,
             "p50": self.percentile(50),
             "p99": self.percentile(99),
+            "exemplar": dict(self.exemplar) if self.exemplar else None,
         }
 
 
@@ -85,12 +97,24 @@ class Metrics:
       factor_flops_total (the split — the derived gflops rate is
       solve_flops_total over solve_latency seconds, so amortized
       factorizations do not inflate it), budget_overflows,
-      oom_risk_warnings, bytes_accessed_total, collective_bytes_total
+      oom_risk_warnings, bytes_accessed_total, collective_bytes_total,
+      padding_waste_flops / padding_waste_bytes (round 12: executed
+      pow2-bucket/width padding split OUT of the useful-work counters),
+      slo_breaches_total, watchdog_anomalies_total
     Histograms (seconds, except batch_size):
-      solve_latency, factor_latency, request_latency, batch_size
+      solve_latency, factor_latency, request_latency, batch_size, and
+      the round-12 request lifecycle stages — stage_queue_wait,
+      stage_batch_form, stage_dispatch, stage_device_execute,
+      stage_reply — each carrying the worst sample's exemplar trace-id
     Gauges (point-in-time, set not incremented):
       resident_bytes, peak_hbm_bytes, hbm_headroom — the Session's HBM
-      truth (factor residency + largest program transient, round 9)
+      truth (factor residency + largest program transient, round 9);
+      round-12 backpressure: queue_depth, queued_buckets,
+      oldest_request_age_s, max_bucket_backlog (Batcher),
+      inflight_batches (Executor); bucket efficiency:
+      width_bucket_efficiency / batch_bucket_efficiency (served ÷
+      executed fraction of the last padded dispatch); slo_burn_rate:* /
+      slo_breached:* and watchdog_* (obs/slo.py, obs/watchdog.py)
     """
 
     def __init__(self):
@@ -114,16 +138,26 @@ class Metrics:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def set_gauges(self, values: Dict[str, float]):
+        """Batch gauge write: one lock acquisition for N gauges — the
+        Batcher's per-enqueue backpressure update uses this so the
+        request hot path pays one metrics-lock hold, not four."""
+        with self._lock:
+            for name, value in values.items():
+                self._gauges[name] = float(value)
+
     def get_gauge(self, name: str, default: float = 0.0) -> float:
         with self._lock:
             return self._gauges.get(name, default)
 
-    def observe(self, name: str, value: float):
+    def observe(self, name: str, value: float, exemplar=None):
+        """``exemplar`` (a trace id) tags the observation so the worst
+        sample in a histogram stays joinable to its trace."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram()
-            h.observe(value)
+            h.observe(value, exemplar=exemplar)
 
     def phase(self, name: str, hist: Optional[str] = None,
               tracer=None, **attrs):

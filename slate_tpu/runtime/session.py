@@ -173,7 +173,7 @@ class Session:
                  opts: Options = DEFAULT_OPTIONS,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
-                 mesh=None):
+                 mesh=None, slo=None):
         self.hbm_budget = hbm_budget
         self.opts = opts
         # serving mesh: a ProcessGrid or a jax Mesh with ("p", "q")
@@ -186,6 +186,15 @@ class Session:
         # default tracer starts off) — zero spans, no per-solve cost
         # beyond one enabled-flag check per phase
         self.tracer = tracer or default_tracer()
+        # SLO tracking (round 12): None = disabled, zero per-solve cost
+        # beyond one attribute check (the round-8 discipline); an
+        # obs.slo.SloTracker records request/cache/oom events here and
+        # through the Batcher, evaluated at /slo scrape time
+        self.slo = slo
+        if slo is not None and slo.metrics is None:
+            slo.metrics = self.metrics
+        if slo is not None and slo.tracer is None:
+            slo.tracer = self.tracer
         # per-shape compile observability (Session.warmup + refactor-on-
         # miss): [{op, what, shape, lower_s, compile_s}, ...]
         self.compile_log: List[dict] = []
@@ -211,6 +220,27 @@ class Session:
         self._jit_cap = 64
         self._compiled_cap = 128
         self._seq = 0
+
+    def enable_slo(self, objectives=None, **kw):
+        """Attach an :class:`~..obs.slo.SloTracker` (default
+        objectives unless given) bound to this session's metrics and
+        tracer; idempotent — a second call returns the running tracker.
+        The ``/slo`` route of :meth:`serve_obs` serves its payload."""
+        from ..obs.slo import SloTracker
+        with self._lock:
+            if self.slo is None:
+                self.slo = SloTracker(objectives, metrics=self.metrics,
+                                      tracer=self.tracer, **kw)
+            return self.slo
+
+    def op_meta(self, handle: Hashable) -> Optional[Tuple[str, int]]:
+        """Lock-free (op, n) of a registered handle, or None — the
+        Batcher/Executor SLO- and stage-attribution read (same
+        GIL-atomic dict-read discipline as ``small_group_key``: the
+        session lock is held across device executions, and an enqueue
+        must never wait on one)."""
+        entry = self._ops.get(handle)
+        return None if entry is None else (entry.op, entry.n)
 
     # -- registration ------------------------------------------------------
 
@@ -383,8 +413,12 @@ class Session:
             if res is not None:
                 self._cache.move_to_end(handle)
                 self.metrics.inc("cache_hits")
+                if self.slo is not None:
+                    self.slo.record_cache(True)
                 return res
             self.metrics.inc("cache_misses")
+            if self.slo is not None:
+                self.slo.record_cache(False)
             # attrs built only when tracing is on: the disabled path
             # must not allocate per solve (ISSUE 4 acceptance)
             fattrs = (self._span_attrs(entry, handle)
@@ -479,15 +513,36 @@ class Session:
                          _tree_nbytes(payload, per_chip=True),
                          _tree_nbytes(payload))
 
-    def _credit_program(self, key: Hashable, op: str):
+    def _credit_program(self, key: Hashable, op: str,
+                        waste_fraction: float = 0.0):
         """One execution of an analyzed AOT program: credit the process
         BYTES ledger (bytes-accessed + modeled collective traffic) and
         the session counters — the per-execution discipline the flop
-        ledger already follows (compile-time tracing credits nothing)."""
+        ledger already follows (compile-time tracing credits nothing).
+
+        ``waste_fraction`` (round 12) is the padded share of the
+        program's columns (the Batcher's pow2 width quantization): that
+        share of the bytes/ICI traffic moves to the ``padding.waste``
+        ledger op and the ``padding_waste_bytes`` counter instead of
+        ``op`` — executed totals preserved, useful-work attribution
+        honest. The per-kind collective census stays whole under the
+        useful record (instruction counts are structural, not
+        column-divisible)."""
         pc = self._program_costs.get(key)
         if pc is None:
             return
-        _costs.BYTES.record_costs(op, pc)
+        if waste_fraction > 0.0:
+            wf = min(max(waste_fraction, 0.0), 1.0)
+            ba = pc.bytes_accessed or 0.0
+            _costs.BYTES.record(op, ba * (1.0 - wf),
+                                pc.collective_bytes * (1.0 - wf),
+                                pc.collectives)
+            _costs.BYTES.record("padding.waste", ba * wf,
+                                pc.collective_bytes * wf)
+            if ba:
+                self.metrics.inc("padding_waste_bytes", ba * wf)
+        else:
+            _costs.BYTES.record_costs(op, pc)
         if pc.bytes_accessed:
             self.metrics.inc("bytes_accessed_total", pc.bytes_accessed)
         if pc.collective_bytes:
@@ -604,6 +659,9 @@ class Session:
                 "= %d bytes exceed hbm_budget=%d (transient=%d); serving "
                 "continues with negative headroom", used, self.hbm_budget,
                 transient)
+        if self.slo is not None:
+            # one budget check = one oom_risk SLO event (good = fits)
+            self.slo.record_oom(used <= self.hbm_budget)
         self._update_hbm_gauges()
 
     # -- solve -------------------------------------------------------------
@@ -648,31 +706,61 @@ class Session:
             hit = handle in self._cache  # before factor() counts it
             res = self.factor(handle)
             if res.info != 0:
+                if self.slo is not None:
+                    self.slo.record_request(entry.op, entry.n, 0.0,
+                                            ok=False, source="solve")
                 raise SlateError(
                     f"Session: operator {handle!r} factorization failed "
                     f"(info={res.info})")
             k = int(B.shape[1])
+            served = k if served_cols is None else int(served_cols)
             tr = self.tracer
             sattrs = (dict(self._span_attrs(entry, handle), k=k,
                            cache_hit=hit) if tr.enabled else {})
             with self.metrics.phase("serve.solve", "solve_latency",
-                                    tracer=tr, **sattrs):
+                                    tracer=tr, **sattrs) as ph:
                 # dispatch (trace/launch) and device-block are split
-                # sub-spans so a trace shows where the latency sits
+                # sub-spans so a trace shows where the latency sits —
+                # and stage histograms (round 12), so the split is
+                # visible in /metrics even with tracing off
+                t0 = time.perf_counter()
                 with tr.span("serve.dispatch"):
-                    X = self._dispatch(entry, res, B, handle)
+                    X = self._dispatch(entry, res, B, handle,
+                                       served_cols=served_cols)
+                t1 = time.perf_counter()
                 with tr.span("serve.block"):
                     X = jax.block_until_ready(X)
-            self.metrics.inc("solves_total",
-                             k if served_cols is None else served_cols)
+                t2 = time.perf_counter()
+            ex = getattr(ph.span, "trace_id", None)  # exemplar join key
+            self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
+            self.metrics.observe("stage_device_execute", t2 - t1,
+                                 exemplar=ex)
+            self.metrics.inc("solves_total", served)
             self.metrics.inc("dispatches_total")
-            fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
-            self.metrics.inc("flops_total", fl)
-            self.metrics.inc("solve_flops_total", fl)
+            # padding-waste split (round 12): the Batcher's pow2 width
+            # quantization executes k - served REAL zero columns —
+            # device work the fleet must see, but not useful work. The
+            # solve models are k-linear, so the split is exact:
+            # useful + waste = the executed total the old code credited.
+            fl = _solve_flops(entry.op, entry.m, entry.n, served,
+                              entry.band)
+            waste_fl = (_solve_flops(entry.op, entry.m, entry.n,
+                                     k - served, entry.band)
+                        if k > served else 0.0)
+            self.metrics.inc("flops_total", fl + waste_fl)  # executed
+            self.metrics.inc("solve_flops_total", fl)       # useful
             # executed work credits the PROCESS ledger here (the api.*
             # verbs inside the compiled solve program only run at trace
             # time and deliberately credit nothing — obs.driver)
             _LEDGER.record("serve.solve", fl)
+            if waste_fl:
+                self.metrics.inc("padding_waste_flops", waste_fl)
+                self.metrics.set_gauge("width_bucket_efficiency",
+                                       served / k)
+                _LEDGER.record("padding.waste", waste_fl)
+            if self.slo is not None:
+                self.slo.record_request(entry.op, entry.n, ph.elapsed,
+                                        ok=True, source="solve")
             return X
 
     def solve(self, handle: Hashable, b,
@@ -734,6 +822,9 @@ class Session:
         hit = handle in self._cache
         res = self.factor(handle)
         if res.info != 0:
+            if self.slo is not None:
+                self.slo.record_request(entry.op, entry.n, 0.0,
+                                        ok=False, source="solve")
             raise SlateError(
                 f"Session: operator {handle!r} factorization failed "
                 f"(info={res.info})")
@@ -743,7 +834,8 @@ class Session:
         sattrs = (dict(self._span_attrs(entry, handle), k=k,
                        cache_hit=hit) if tr.enabled else {})
         with self.metrics.phase("serve.solve", "solve_latency",
-                                tracer=tr, **sattrs):
+                                tracer=tr, **sattrs) as ph:
+            t0 = time.perf_counter()
             with tr.span("serve.dispatch"):
                 if entry.op == "lu_small":
                     lu, perm = res.payload
@@ -752,14 +844,22 @@ class Session:
                 else:
                     x = _batched.potrs_batched(res.payload[0][None],
                                                b2[None])
+            t1 = time.perf_counter()
             with tr.span("serve.block"):
                 x = jax.block_until_ready(x)
+            t2 = time.perf_counter()
+        ex = getattr(ph.span, "trace_id", None)
+        self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
+        self.metrics.observe("stage_device_execute", t2 - t1, exemplar=ex)
         self.metrics.inc("solves_total", k)
         self.metrics.inc("dispatches_total")
         fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
         self.metrics.inc("flops_total", fl)
         self.metrics.inc("solve_flops_total", fl)
         _LEDGER.record("serve.solve", fl)
+        if self.slo is not None:
+            self.slo.record_request(entry.op, entry.n, ph.elapsed,
+                                    ok=True, source="solve")
         return np.asarray(x[0])
 
     def solve_small_batched(self, handles: List[Hashable], bs: List
@@ -815,7 +915,7 @@ class Session:
             was_resident = {h: (h in self._cache) for h in set(handles)}
             with self.metrics.phase("serve.solve_batched",
                                     "solve_latency", tracer=tr,
-                                    **battrs):
+                                    **battrs) as ph:
                 miss_handles = []
                 for h in handles:
                     if not was_resident[h] and h not in miss_handles:
@@ -861,10 +961,14 @@ class Session:
                 for h in handles:
                     if was_resident[h] or h in counted_miss:
                         self.metrics.inc("cache_hits")
+                        if self.slo is not None:
+                            self.slo.record_cache(True)
                         if h in self._cache:
                             self._cache.move_to_end(h)
                     else:
                         self.metrics.inc("cache_misses")
+                        if self.slo is not None:
+                            self.slo.record_cache(False)
                         counted_miss.add(h)
                     res = self._cache.get(h)
                     if res is None:
@@ -875,6 +979,7 @@ class Session:
                 bstack = np.stack([
                     np.ascontiguousarray(np.asarray(b), dtype=dt)
                     for b in bs])
+                t0 = time.perf_counter()
                 with tr.span("serve.dispatch", batch=bsz):
                     if op == "lu_small":
                         x = _batched.getrs_batched(
@@ -885,20 +990,44 @@ class Session:
                         x = _batched.potrs_batched(
                             jnp.stack([r.payload[0] for r in res_list]),
                             bstack)
+                t1 = time.perf_counter()
                 with tr.span("serve.block"):
                     x = jax.block_until_ready(x)
+                t2 = time.perf_counter()
                 programs += 1
+            ex = getattr(ph.span, "trace_id", None)
+            self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
+            self.metrics.observe("stage_device_execute", t2 - t1,
+                                 exemplar=ex)
             k = bstack.shape[2]
+            bucket = _batched.batch_bucket(bsz)
             self.metrics.inc("solves_total", bsz * k)
             self.metrics.inc("dispatches_total")
             self.metrics.inc("batched_programs", programs)
-            self.metrics.observe(
-                "bucket_occupancy",
-                bsz / _batched.batch_bucket(bsz))
+            self.metrics.observe("bucket_occupancy", bsz / bucket)
             sfl = bsz * _solve_flops(op, n, n, k, 0)
             self.metrics.inc("flops_total", sfl)
             self.metrics.inc("solve_flops_total", sfl)
             _LEDGER.record("serve.solve", sfl)
+            # padding-waste counters (round 12): the pow2 batch bucket
+            # executes bucket − bsz REAL padded lanes (identity
+            # operands, zero rhs) in the solve program — and the miss
+            # factor program its own bucket's padding. The PROCESS
+            # ledger's padding.waste op is credited at the source
+            # (linalg/batched pads there); these are the session-level
+            # /metrics counters. Exactly 0 at full pow2 occupancy.
+            waste_fl = (bucket - bsz) * _solve_flops(op, n, n, k, 0)
+            if miss_handles:
+                fbucket = _batched.batch_bucket(len(miss_handles))
+                waste_fl += ((fbucket - len(miss_handles))
+                             * _factor_flops(op, n, n, 0))
+            if waste_fl:
+                self.metrics.inc("padding_waste_flops", waste_fl)
+            self.metrics.set_gauge("batch_bucket_efficiency", bsz / bucket)
+            if self.slo is not None:
+                for inf in infos_req:
+                    self.slo.record_request(op, n, ph.elapsed,
+                                            ok=(inf == 0), source="solve")
             return np.asarray(x), infos_req
 
     def _wrap_rhs(self, entry: _Operator, b2: np.ndarray):
@@ -914,7 +1043,8 @@ class Session:
         return from_dense(b2, nb=nb, grid=entry.grid)
 
     def _dispatch(self, entry: _Operator, res: _Resident, B,
-                  handle: Hashable = None):
+                  handle: Hashable = None,
+                  served_cols: Optional[int] = None):
         """Run the solve through a per-(op, opts) jitted function,
         preferring an AOT-compiled executable from warmup() when shapes
         match. opts is part of both cache keys: two operators of the
@@ -936,7 +1066,10 @@ class Session:
             self.metrics.inc("aot_compiles")
         if exe is not None:
             self._compiled.move_to_end(key)
-            self._credit_program(key, "serve.solve")
+            k = int(B.shape[1]) if getattr(B, "shape", None) else 0
+            wf = (0.0 if served_cols is None or not k
+                  else (k - served_cols) / k)
+            self._credit_program(key, "serve.solve", waste_fraction=wf)
             return exe(res.payload, B)
         return fn(res.payload, B)
 
@@ -1060,7 +1193,9 @@ class Session:
     def serve_obs(self, host: str = "127.0.0.1", port: int = 0):
         """Opt-in observability HTTP endpoint for THIS session
         (stdlib-only): /metrics (Prometheus text), /healthz,
-        /trace.json (Chrome trace of the session's tracer). Returns
+        /trace.json (Chrome trace of the session's tracer), /slo
+        (burn-rate payload once ``enable_slo`` ran — the provider is a
+        getter, so enabling AFTER serve_obs still works). Returns
         the ObsServer (``.url()`` gives the scrape target); idempotent
         — a second call returns the running server."""
         with self._lock:
@@ -1068,7 +1203,8 @@ class Session:
                 from ..obs.exposition import ObsServer
                 self._obs_server = ObsServer(self.metrics,
                                              tracer=self.tracer,
-                                             host=host, port=port)
+                                             host=host, port=port,
+                                             slo=lambda: self.slo)
             return self._obs_server
 
     def close_obs(self):

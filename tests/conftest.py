@@ -64,11 +64,31 @@ _fh_log = open(os.path.join(os.path.dirname(__file__),
                             ".faulthandler.log"), "w")
 faulthandler.enable(file=_fh_log, all_threads=True)
 
+# Round-12 stall forensics: the round-11 futex-stall class (XLA:CPU
+# collective rendezvous starved under host load) hangs the suite until
+# the tier-1 harness's `timeout -k` SIGKILLs it — leaving NO evidence
+# of where the threads sat. Arm a dump-traceback watchdog below the
+# 870 s tier-1 budget so a stalled run writes every thread's Python
+# stack into tests/.faulthandler.log BEFORE the kill (repeat=True: a
+# run that stalls twice dumps twice). Tunable/disable-able via env
+# (0 disables) for interactive long runs; cancelled on clean session
+# finish so post-suite teardown never dumps spuriously.
+_STALL_DUMP_S = float(os.environ.get("SLATE_TPU_TIER1_STALL_DUMP_S",
+                                     "780"))
+if _STALL_DUMP_S > 0:
+    faulthandler.dump_traceback_later(_STALL_DUMP_S, repeat=True,
+                                      file=_fh_log, exit=False)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: large/expensive cases excluded from the tier-1 "
         "budget (run explicitly with -m slow)")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # a finished (even failed) session is not a stall
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(scope="session")

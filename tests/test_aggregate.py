@@ -1,0 +1,199 @@
+"""Multi-process telemetry aggregation (slate_tpu.obs.aggregate +
+obs.merge.combine_process_traces).
+
+The acceptance contract: merging two copies of the SAME snapshot
+reproduces exactly double every counter (bit-exact float doubling —
+x + x is always exact in binary FP), histograms merge count/sum/
+min/max correctly, gauges come back labeled per host, the mirrored
+derived formulas agree with runtime.Metrics._derive, and the combined
+Chrome trace stays schema-valid with disjoint per-process pid
+namespaces.
+"""
+
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.obs import aggregate as agg
+from slate_tpu.runtime import Metrics
+
+
+def _snapshot():
+    m = Metrics()
+    m.inc("solves_total", 7)
+    m.inc("cache_hits", 3)
+    m.inc("cache_misses", 1)
+    m.inc("solve_flops_total", 0.1 + 0.2)  # a non-representable float
+    m.observe("solve_latency", 0.25)
+    m.observe("solve_latency", 0.75)
+    m.observe("request_latency", 0.5, exemplar=42)
+    m.set_gauge("resident_bytes", 1024.0)
+    m.set_gauge("hbm_headroom", 5.0)
+    return m.snapshot()
+
+
+# -- counters ----------------------------------------------------------------
+
+
+def test_same_snapshot_merge_doubles_counters_bit_exactly():
+    snap = _snapshot()
+    merged = agg.merge_metrics_snapshots([snap, snap])
+    for k, v in snap["counters"].items():
+        assert merged["counters"][k] == 2 * v  # exact equality, no approx
+    assert merged["processes"] == 2
+    assert merged["hosts"] == ["proc0", "proc1"]
+
+
+def test_distinct_snapshots_sum():
+    a, b = _snapshot(), _snapshot()
+    b["counters"]["solves_total"] = 13.0
+    b["counters"]["only_in_b"] = 2.0
+    merged = agg.merge_metrics_snapshots([a, b])
+    assert merged["counters"]["solves_total"] == 20.0
+    assert merged["counters"]["only_in_b"] == 2.0
+
+
+# -- histograms --------------------------------------------------------------
+
+
+def test_histogram_merge_counts_sums_extremes():
+    snap = _snapshot()
+    h = agg.merge_histograms([snap["histograms"]["solve_latency"],
+                              snap["histograms"]["solve_latency"]])
+    assert h["count"] == 4
+    assert h["sum"] == 2 * snap["histograms"]["solve_latency"]["sum"]
+    assert h["min"] == 0.25 and h["max"] == 0.75
+    assert h["mean"] == pytest.approx(0.5)
+    # weighted quantile of identical inputs is the input quantile
+    assert h["p99"] == snap["histograms"]["solve_latency"]["p99"]
+
+
+def test_histogram_merge_handles_empty_and_exemplar():
+    empty = Metrics().snapshot()  # no histograms at all
+    snap = _snapshot()
+    merged = agg.merge_metrics_snapshots([snap, empty])
+    assert merged["histograms"]["solve_latency"]["count"] == 2
+    ex = merged["histograms"]["request_latency"]["exemplar"]
+    assert ex["trace_id"] == 42
+    e = agg.merge_histograms([])
+    assert e["count"] == 0 and e["min"] is None and e["mean"] is None
+
+
+# -- gauges ------------------------------------------------------------------
+
+
+def test_gauges_labeled_per_host_and_summable_totals():
+    snap = _snapshot()
+    merged = agg.merge_metrics_snapshots([snap, snap], hosts=["h0", "h1"])
+    assert merged["gauges_per_host"]["h0"]["resident_bytes"] == 1024.0
+    assert merged["gauges_per_host"]["h1"]["hbm_headroom"] == 5.0
+    # summable capacity gauges aggregate under fleet_*
+    assert merged["gauges"]["fleet_resident_bytes"] == 2048.0
+    # headroom is per-chip truth — never summed
+    assert "fleet_hbm_headroom" not in merged["gauges"]
+    with pytest.raises(ValueError):
+        agg.merge_metrics_snapshots([snap, snap], hosts=["only-one"])
+
+
+# -- derived -----------------------------------------------------------------
+
+
+def test_merged_derived_matches_runtime_formula():
+    """The mirrored derive formulas (module docstring) pinned against
+    runtime.Metrics._derive on the merged inputs."""
+    snap = _snapshot()
+    merged = agg.merge_metrics_snapshots([snap, snap])
+    c, h = merged["counters"], merged["histograms"]
+    want = Metrics._derive(c["cache_hits"], c["cache_misses"],
+                           c["solves_total"], c["solve_flops_total"],
+                           h["solve_latency"]["sum"])
+    assert merged["derived"] == want
+
+
+# -- ledgers -----------------------------------------------------------------
+
+
+def test_flop_and_bytes_ledger_merge():
+    fsnap = {"flops_total": 100.0, "per_op": {"serve.solve": 90.0,
+                                              "padding.waste": 10.0},
+             "calls": {"serve.solve": 3, "padding.waste": 1}}
+    merged = agg.merge_flop_snapshots([fsnap, fsnap])
+    assert merged["flops_total"] == 200.0
+    assert merged["per_op"]["padding.waste"] == 20.0
+    assert merged["calls"]["serve.solve"] == 6
+    bsnap = {"bytes_total": 50.0, "collective_bytes_total": 8.0,
+             "per_op": {"x": {"bytes": 50.0, "collective_bytes": 8.0,
+                              "calls": 2}},
+             "per_collective": {"all-reduce": {"bytes": 8.0, "count": 4}}}
+    bm = agg.merge_bytes_snapshots([bsnap, bsnap])
+    assert bm["bytes_total"] == 100.0
+    assert bm["per_op"]["x"]["calls"] == 4
+    assert bm["per_collective"]["all-reduce"]["count"] == 8
+
+
+# -- fleet rendering ---------------------------------------------------------
+
+
+def test_fleet_prometheus_renders_host_labels_and_totals():
+    snap = _snapshot()
+    fleet = agg.aggregate_processes(
+        [snap, snap],
+        flop_snaps=[{"flops_total": 5.0, "per_op": {}, "calls": {}}] * 2,
+        bytes_snaps=[{"bytes_total": 7.0, "collective_bytes_total": 1.0,
+                      "per_op": {}, "per_collective": {}}] * 2,
+        hosts=["h0", "h1"])
+    text = agg.render_fleet_prometheus(fleet)
+    assert 'slate_tpu_resident_bytes{host="h0"} 1024.0' in text
+    assert 'slate_tpu_resident_bytes{host="h1"} 1024.0' in text
+    assert "slate_tpu_fleet_driver_flops_total 10.0" in text
+    assert "slate_tpu_fleet_driver_bytes_total 14.0" in text
+    assert "slate_tpu_solves_total 14.0" in text  # summed counter
+
+
+def test_write_fleet_round_trips(tmp_path):
+    import json
+    snap = _snapshot()
+    fleet = agg.aggregate_processes([snap, snap])
+    agg.write_fleet(fleet, json_path=str(tmp_path / "fleet.json"),
+                    prom_path=str(tmp_path / "fleet.prom"))
+    doc = json.loads((tmp_path / "fleet.json").read_text())
+    assert doc["metrics"]["counters"]["solves_total"] == 14.0
+    assert "slate_tpu_solves_total" in (tmp_path / "fleet.prom"
+                                        ).read_text()
+
+
+# -- trace combine -----------------------------------------------------------
+
+
+def _one_trace():
+    tracer = obs.Tracer().on()
+    with tracer.span("serve.batch", batch_size=2):
+        with tracer.span("serve.solve"):
+            pass
+    tracer.off()
+    return obs.chrome_trace(tracer.spans())
+
+
+def test_combine_process_traces_namespaces_pids_and_ids():
+    tr = _one_trace()
+    combined = obs.combine_process_traces([tr, tr], ["h0", "h1"])
+    assert obs.validate_chrome_trace(combined) == []
+    xev = [e for e in combined["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in xev}
+    assert pids & {0, 1} and pids & {100, 101}  # disjoint namespaces
+    hosts = {e["args"]["host"] for e in xev}
+    assert hosts == {"h0", "h1"}
+    # span identities are host-prefixed: no cross-process aliasing
+    ids = {(e["pid"], e["args"]["span_id"]) for e in xev}
+    assert len(ids) == len(xev) // 1  # all distinct per (pid, span)
+    assert all(str(e["args"]["span_id"]).startswith(("h0/", "h1/"))
+               for e in xev)
+    # parent links stay intra-process after prefixing
+    for e in xev:
+        p = e["args"].get("parent_id")
+        if p is not None:
+            assert p.split("/")[0] == e["args"]["host"]
+    # process_name metadata rewritten per host
+    names = [e["args"]["name"] for e in combined["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert any(n.startswith("h0:") for n in names)
+    assert any(n.startswith("h1:") for n in names)

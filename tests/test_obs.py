@@ -561,3 +561,167 @@ def test_errored_attempt_trace_stays_validly_nested():
     errored = {s.name for s in spans if s.status == "error"}
     assert "serve.batch" in errored and "serve.request" in errored
     assert obs.validate_chrome_trace(obs.chrome_trace(spans)) == []
+
+
+# -- round 12: request lifecycle stages, backpressure, padding waste --------
+
+
+def test_lifecycle_stage_histograms_with_exemplar_trace_ids():
+    """Tentpole (c): a served request decomposes into per-stage
+    histograms (queue wait, batch formation, dispatch, device execute,
+    reply), each carrying the worst sample's exemplar trace-id — the
+    join key from /metrics back into the trace."""
+    tracer = Tracer().on()
+    sess, h, a = _lu_session(tracer=tracer)
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=0)
+    snap = sess.metrics.snapshot()
+    hists = snap["histograms"]
+    assert hists["stage_queue_wait"]["count"] == 3   # one per request
+    assert hists["stage_batch_form"]["count"] == 1   # one per batch
+    assert hists["stage_dispatch"]["count"] == 1
+    assert hists["stage_device_execute"]["count"] == 1
+    assert hists["stage_reply"]["count"] == 1
+    batch = [s for s in tracer.spans() if s.name == "serve.batch"][0]
+    for stage in ("stage_queue_wait", "stage_batch_form", "stage_reply"):
+        assert hists[stage]["exemplar"]["trace_id"] == batch.trace_id
+    # dispatch/execute exemplars come from the solve span's trace —
+    # the same trace (solve nests under the batch)
+    assert hists["stage_dispatch"]["exemplar"]["trace_id"] == \
+        batch.trace_id
+    # the exemplar renders as a plain gauge in the exposition
+    prom = obs.render_prometheus(sess.metrics, ledger=False,
+                                 bytes_ledger=False)
+    assert "slate_tpu_stage_queue_wait_exemplar_trace_id" in prom
+    tracer.off()
+
+
+def test_stage_histograms_populate_with_tracing_off():
+    """The stage decomposition is metrics, not tracing: with the
+    tracer disabled the histograms still fill (exemplar absent)."""
+    sess, h, a = _lu_session()
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    batcher.submit(h, RNG.standard_normal(N))
+    batcher.flush()
+    hists = sess.metrics.snapshot()["histograms"]
+    assert hists["stage_dispatch"]["count"] == 1
+    assert hists["stage_dispatch"]["exemplar"] is None
+
+
+def test_backpressure_gauges_track_queue_state():
+    """Satellite: queue depth, queued buckets, oldest-request age and
+    max per-bucket backlog are /metrics gauges, updated on every
+    enqueue/pop — plus the labeled per-bucket breakdown."""
+    sess, h, a = _lu_session()
+    batcher = Batcher(sess, max_batch=8, max_wait=10.0)
+    for _ in range(3):
+        batcher.submit(h, RNG.standard_normal(N))
+    m = sess.metrics
+    assert m.get_gauge("queue_depth") == 3.0
+    assert m.get_gauge("queued_buckets") == 1.0
+    assert m.get_gauge("max_bucket_backlog") == 3.0
+    assert m.get_gauge("oldest_request_age_s") >= 0.0
+    bp = batcher.backpressure()
+    assert bp["queue_depth"] == 3 and len(bp["per_bucket"]) == 1
+    (bucket,) = bp["per_bucket"].values()
+    assert bucket["backlog"] == 3 and bucket["oldest_age_s"] >= 0.0
+    batcher.flush()
+    assert m.get_gauge("queue_depth") == 0.0
+    assert m.get_gauge("max_bucket_backlog") == 0.0
+    # and the Executor's in-flight gauge exists after a served batch
+    from slate_tpu.runtime import Executor
+    with Executor(sess, max_batch=4, max_wait=1e-3) as ex:
+        ex.submit(h, RNG.standard_normal(N)).result(timeout=120)
+        ex.flush()
+    assert m.get_gauge("inflight_batches") == 0.0
+
+
+def test_width_padding_waste_split_exactly():
+    """Tentpole (c): pad_widths quantizes 3 coalesced columns to 4 —
+    the executed fourth column's flops move to padding_waste_flops /
+    the ledger's padding.waste op, solve_flops_total keeps ONLY the
+    served columns, and their sum is the executed total."""
+    sess, h, a = _lu_session()
+    base = model_flops.LEDGER.snapshot()["per_op"].get("padding.waste",
+                                                       0.0)
+    batcher = Batcher(sess, max_batch=8, max_wait=10.0, pad_widths=True)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=0)
+    m = sess.metrics
+    per_col = model_flops.solve_flops("lu", N, N, 1)
+    assert m.get("padding_waste_flops") == pytest.approx(per_col)
+    assert m.get("solve_flops_total") == pytest.approx(3 * per_col)
+    assert m.get("flops_total") - m.get("factor_flops_total") == \
+        pytest.approx(4 * per_col)  # executed = useful + waste
+    assert m.get("solves_total") == 3.0  # client columns only
+    assert m.get_gauge("width_bucket_efficiency") == pytest.approx(0.75)
+    delta = model_flops.LEDGER.snapshot()["per_op"]["padding.waste"] - base
+    assert delta == pytest.approx(per_col)
+
+
+def test_width_padding_waste_zero_at_pow2_occupancy():
+    sess, h, a = _lu_session()
+    batcher = Batcher(sess, max_batch=8, max_wait=10.0, pad_widths=True)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=0)
+    assert sess.metrics.get("padding_waste_flops") == 0.0
+
+
+def test_batch_bucket_padding_waste_counters():
+    """The pow2 batch bucket of the small-problem engine: 3 distinct
+    operators -> bucket 4 -> one padded lane's factor+solve flops in
+    padding_waste_flops; a full 4-bucket credits exactly 0. The
+    process ledger's padding.waste op moves at the linalg/batched
+    layer (where the padding happens)."""
+    nn = 8
+    base = model_flops.LEDGER.snapshot()["per_op"].get("padding.waste",
+                                                       0.0)
+    sess = Session()
+    hs = [sess.register(RNG.standard_normal((nn, nn)) + nn * np.eye(nn),
+                        op="lu_small") for _ in range(3)]
+    xs, infos = sess.solve_small_batched(
+        hs, [RNG.standard_normal((nn, 1)) for _ in hs])
+    assert infos == [0, 0, 0]
+    waste = sess.metrics.get("padding_waste_flops")
+    # one padded lane: solve (client width model) + miss-factor share
+    assert waste == pytest.approx(model_flops.solve_flops("lu", nn, nn, 1)
+                                  + model_flops.getrf(nn))
+    assert sess.metrics.get_gauge("batch_bucket_efficiency") == \
+        pytest.approx(0.75)
+    assert model_flops.LEDGER.snapshot()["per_op"]["padding.waste"] > base
+    full = Session()
+    hf = [full.register(RNG.standard_normal((nn, nn)) + nn * np.eye(nn),
+                        op="lu_small") for _ in range(4)]
+    full.solve_small_batched(hf, [RNG.standard_normal((nn, 1))
+                                  for _ in hf])
+    assert full.metrics.get("padding_waste_flops") == 0.0
+    assert full.metrics.get_gauge("batch_bucket_efficiency") == 1.0
+
+
+def test_bucket_bytes_split_between_verb_and_padding_waste():
+    """_run_bucket splits the executed program's bytes by occupancy:
+    verb share + padding.waste share = the full program bytes the
+    round-9 crediting used to put on the verb alone."""
+    from slate_tpu.linalg import batched as batched_mod
+    from slate_tpu.obs import costs as costs_mod
+    nn = 8
+    a = np.stack([RNG.standard_normal((nn, nn)) + nn * np.eye(nn)
+                  for _ in range(3)])
+    b = np.stack([RNG.standard_normal((nn, 1)) for _ in range(3)])
+    batched_mod.gesv_batched(a, b)  # warm the bucket program
+    snap0 = costs_mod.BYTES.snapshot()
+    batched_mod.gesv_batched(a, b)
+    snap1 = costs_mod.BYTES.snapshot()
+    verb = (snap1["per_op"]["gesv_batched"]["bytes"]
+            - snap0["per_op"]["gesv_batched"]["bytes"])
+    waste = (snap1["per_op"].get("padding.waste", {"bytes": 0.0})["bytes"]
+             - snap0["per_op"].get("padding.waste", {"bytes": 0.0})["bytes"])
+    if verb + waste > 0:  # XLA:CPU may report no bytes — skip honestly
+        assert waste == pytest.approx((verb + waste) * 0.25)

@@ -1,0 +1,271 @@
+"""SLO objectives + rolling-window burn rates (slate_tpu.obs.slo).
+
+The burn-rate math is pinned by hand (events at explicit timestamps,
+hand-computed bad/total/budget ratios), the multi-window conjunctive
+breach rule is exercised in both directions (short-dirty/long-clean
+must NOT page), the Session/Batcher event feed is verified over the
+small-problem engine (cheap programs), and the round-8 acceptance —
+disabled path allocates nothing — is extended to this module.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import slate_tpu as st  # noqa: F401 — jax/platform init via conftest
+from slate_tpu import obs
+from slate_tpu.obs.slo import (DEFAULT_WINDOWS, Objective, SloTracker,
+                               default_objectives, n_bucket)
+from slate_tpu.runtime import Batcher, Metrics, Session
+
+RNG = np.random.default_rng(31)
+N = 8  # small-problem engine: tiny bucket programs, no dense compiles
+
+
+def _small_session(**kw):
+    sess = Session(**kw)
+    a = RNG.standard_normal((N, N)) + N * np.eye(N)
+    h = sess.register(np.asarray(a), op="lu_small")
+    return sess, h
+
+
+# -- burn-rate math (hand-pinned) -------------------------------------------
+
+
+def test_burn_rate_formula_pins():
+    """burn = (bad/total) / (1 - target), per window."""
+    obj = Objective("lat", "latency", 0.9, threshold_s=0.1,
+                    windows=(60.0,))
+    t = SloTracker([obj])
+    # 10 events at t=100: 4 over threshold -> error rate 0.4,
+    # budget 0.1 -> burn 4.0
+    for i in range(10):
+        t.record_request("lu", 8, 0.5 if i < 4 else 0.01, t=100.0)
+    row = t.evaluate(now=101.0)["objectives"][0]
+    w = row["windows"][0]
+    assert w["total"] == 10 and w["bad"] == 4
+    assert w["good_fraction"] == pytest.approx(0.6)
+    assert w["burn_rate"] == pytest.approx(4.0)
+    assert row["breached"]  # 4.0 > burn_threshold 1.0
+    # observed latency at the target quantile is reported
+    assert w["latency_at_target_quantile_s"] == pytest.approx(0.5)
+
+
+def test_error_rate_and_failed_requests_count_bad():
+    obj = Objective("err", "error_rate", 0.99, windows=(60.0,))
+    t = SloTracker([obj])
+    for i in range(4):
+        t.record_request("chol", 8, 0.01, ok=(i != 0), t=10.0)
+    w = t.evaluate(now=11.0)["objectives"][0]["windows"][0]
+    assert w["bad"] == 1 and w["total"] == 4
+    assert w["burn_rate"] == pytest.approx(0.25 / 0.01)
+
+
+def test_window_pruning_excludes_old_events():
+    obj = Objective("lat", "latency", 0.9, threshold_s=0.1,
+                    windows=(60.0,))
+    t = SloTracker([obj])
+    t.record_request("lu", 8, 9.9, t=10.0)    # bad, but ancient
+    t.record_request("lu", 8, 0.01, t=500.0)  # good, in window
+    w = t.evaluate(now=520.0)["objectives"][0]["windows"][0]
+    assert w["total"] == 1 and w["bad"] == 0
+    assert w["burn_rate"] == 0.0
+
+
+def test_multi_window_breach_requires_every_window():
+    """The conjunctive multi-window rule: a burst that is dirty over
+    the short window but diluted below threshold over the long one
+    must NOT breach; dirty over both must."""
+    obj = Objective("lat", "latency", 0.9, threshold_s=0.1,
+                    windows=(60.0, 3600.0))
+    t = SloTracker([obj])
+    # 200 good events spread over the past hour, 5 bad just now:
+    # short window: 5/5 bad -> burn 10; long: 5/205 -> burn ~0.24
+    for i in range(200):
+        t.record_request("lu", 8, 0.01, t=1000.0 + i * 10)
+    for _ in range(5):
+        t.record_request("lu", 8, 5.0, t=3590.0)
+    row = t.evaluate(now=3600.0)["objectives"][0]
+    short, long_ = row["windows"]
+    assert short["burn_rate"] > 1.0 > long_["burn_rate"]
+    assert not row["breached"]
+    # now make the long window dirty too
+    for _ in range(50):
+        t.record_request("lu", 8, 5.0, t=3595.0)
+    row = t.evaluate(now=3600.0)["objectives"][0]
+    assert all(w["burn_rate"] > 1.0 for w in row["windows"])
+    assert row["breached"]
+
+
+def test_empty_window_never_breaches():
+    obj = Objective("lat", "latency", 0.9, threshold_s=0.1,
+                    windows=(60.0,))
+    t = SloTracker([obj])
+    row = t.evaluate(now=100.0)["objectives"][0]
+    assert row["windows"][0]["total"] == 0
+    assert row["windows"][0]["burn_rate"] is None
+    assert not row["breached"]
+
+
+def test_scoped_objectives_filter_op_and_bucket():
+    scoped = Objective("lu_only", "error_rate", 0.9, op="lu",
+                       n_bucket=n_bucket(100), windows=(60.0,))
+    t = SloTracker([scoped])
+    t.record_request("lu", 100, 0.1, ok=False, t=10.0)   # matches
+    t.record_request("chol", 100, 0.1, ok=False, t=10.0)  # wrong op
+    t.record_request("lu", 9, 0.1, ok=False, t=10.0)      # wrong bucket
+    w = t.evaluate(now=11.0)["objectives"][0]["windows"][0]
+    assert w["total"] == 1
+    # bucket quantization: 65..128 -> 128
+    assert n_bucket(100) == 128 and n_bucket(128) == 128
+    assert n_bucket(129) == 256
+
+
+def test_cache_and_oom_kinds():
+    objs = [Objective("hits", "cache_hit_rate", 0.5, windows=(60.0,)),
+            Objective("oom", "oom_risk", 0.5, windows=(60.0,))]
+    t = SloTracker(objs)
+    t.record_cache(True, t=1.0)
+    t.record_cache(False, t=1.0)
+    t.record_oom(True, t=1.0)
+    rows = t.evaluate(now=2.0)["objectives"]
+    assert rows[0]["windows"][0]["bad"] == 1  # one miss
+    assert rows[0]["windows"][0]["burn_rate"] == pytest.approx(1.0)
+    assert rows[1]["windows"][0]["bad"] == 0
+
+
+def test_breach_transition_publishes_metrics_and_warns(caplog):
+    obj = Objective("lat", "latency", 0.9, threshold_s=0.1,
+                    windows=(60.0,))
+    m = Metrics()
+    t = SloTracker([obj], metrics=m)
+    t.record_request("lu", 8, 5.0, t=10.0)
+    with caplog.at_level("WARNING", logger="slate_tpu.obs"):
+        t.evaluate(now=11.0)
+    assert any("SLO breach" in r.message for r in caplog.records)
+    assert m.get("slo_breaches_total") == 1.0
+    assert m.get_gauge("slo_breached:lat") == 1.0
+    assert m.get_gauge("slo_burn_rate:lat:w60") == pytest.approx(10.0)
+    # still breached on re-evaluation: counter must NOT double-count
+    t.evaluate(now=12.0)
+    assert m.get("slo_breaches_total") == 1.0
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        Objective("x", "nope", 0.9)
+    with pytest.raises(ValueError):
+        Objective("x", "latency", 0.9)  # no threshold
+    with pytest.raises(ValueError):
+        Objective("x", "error_rate", 1.5)
+
+
+# -- runtime integration -----------------------------------------------------
+
+
+def test_session_feeds_request_cache_and_stage_events():
+    """A served small-problem workload populates the solve stream, the
+    cache stream, and the lifecycle stage histograms."""
+    sess, h = _small_session(hbm_budget=1 << 20)
+    slo = sess.enable_slo(default_objectives(windows=(60.0,)))
+    assert sess.enable_slo() is slo  # idempotent
+    for _ in range(3):
+        sess.solve(h, RNG.standard_normal(N))
+    payload = slo.evaluate()
+    rows = {o["name"]: o for o in payload["objectives"]}
+    # solve-source events are not in the default "request" source
+    # objectives; cache + oom streams ARE fed
+    hits = rows["factor_cache_hit_rate"]["windows"][0]
+    assert hits["total"] == 3 and hits["bad"] == 1  # 1 miss, 2 hits
+    oom = rows["hbm_oom_risk"]["windows"][0]
+    assert oom["total"] >= 1 and oom["bad"] == 0
+    snap = sess.metrics.snapshot()
+    for stage in ("stage_dispatch", "stage_device_execute"):
+        assert snap["histograms"][stage]["count"] == 3
+
+
+def test_batcher_feeds_request_stream_and_solve_objective():
+    sess, h = _small_session()
+    slo = sess.enable_slo([
+        Objective("req", "error_rate", 0.9, windows=(60.0,)),
+        Objective("solve", "error_rate", 0.9, source="solve",
+                  windows=(60.0,)),
+    ])
+    bt = Batcher(sess, max_batch=8, max_wait=60.0)
+    futs = [bt.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+    bt.flush()
+    for f in futs:
+        f.result(timeout=0)
+    rows = {o["name"]: o for o in slo.evaluate()["objectives"]}
+    assert rows["req"]["windows"][0]["total"] == 4      # Batcher feed
+    assert rows["req"]["windows"][0]["bad"] == 0
+    assert rows["solve"]["windows"][0]["total"] == 4    # Session feed
+
+
+def test_singular_item_records_error_event():
+    sess, h = _small_session()
+    bad = sess.register(np.zeros((N, N)), op="lu_small")
+    slo = sess.enable_slo([Objective("req", "error_rate", 0.9,
+                                     windows=(60.0,))])
+    bt = Batcher(sess, max_batch=8, max_wait=60.0)
+    f_ok = bt.submit(h, RNG.standard_normal(N))
+    f_bad = bt.submit(bad, RNG.standard_normal(N))
+    bt.flush()
+    f_ok.result(timeout=0)
+    with pytest.raises(Exception):
+        f_bad.result(timeout=0)
+    w = slo.evaluate()["objectives"][0]["windows"][0]
+    assert w["total"] == 2 and w["bad"] == 1
+
+
+def test_slo_endpoint_serves_payload_and_prometheus_gauges():
+    sess, h = _small_session()
+    sess.enable_slo(default_objectives(windows=(60.0,)))
+    sess.solve(h, RNG.standard_normal(N))
+    srv = sess.serve_obs()
+    try:
+        body = urllib.request.urlopen(srv.url("/slo"),
+                                      timeout=10).read().decode()
+        payload = json.loads(body)
+        assert payload["enabled"]
+        assert {o["name"] for o in payload["objectives"]} >= {
+            "request_latency", "factor_cache_hit_rate"}
+        prom = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=10).read().decode()
+        assert "slate_tpu_slo_burn_rate" in prom
+        assert "slate_tpu_slo_breached" in prom
+    finally:
+        sess.close_obs()
+
+
+def test_slo_endpoint_disabled_payload():
+    sess, h = _small_session()
+    srv = sess.serve_obs()
+    try:
+        body = urllib.request.urlopen(srv.url("/slo"),
+                                      timeout=10).read().decode()
+        assert json.loads(body) == {"enabled": False, "objectives": []}
+    finally:
+        sess.close_obs()
+
+
+def test_disabled_path_zero_allocation_extended():
+    """Round-8 acceptance extended to round 12: with tracing off and
+    NO SloTracker attached, a served workload records zero spans, zero
+    SLO gauges, and zero SLO/watchdog counters — the hot path's only
+    new cost is `session.slo is not None` checks."""
+    tracer = obs.Tracer()  # off
+    sess, h = _small_session(tracer=tracer)
+    assert sess.slo is None
+    bt = Batcher(sess, max_batch=4, max_wait=60.0)
+    futs = [bt.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+    bt.flush()
+    for f in futs:
+        f.result(timeout=0)
+    assert tracer.spans() == []
+    snap = sess.metrics.snapshot()
+    assert not any(k.startswith("slo_") for k in snap["gauges"])
+    assert not any(k.startswith("slo_") or k.startswith("watchdog")
+                   for k in snap["counters"])

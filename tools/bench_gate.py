@@ -49,6 +49,13 @@ regressions were invisible until a human reread PERF.md. This tool:
 the normalized schema and exits nonzero on any unparseable artifact
 (this is why the trajectory read as empty: nothing enforced the
 files). Wired into examples/run_tests.py beside tools/obs_dump.py.
+
+``--baseline-out PATH`` (round 12) exports the normalized best-prior
+series as a committed ``BASELINE_SERIES.json`` artifact — the single
+source of truth the live regression watchdog (slate_tpu/obs/
+watchdog.py) loads, so the serving runtime and this gate compare
+against literally the same numbers; ``--check-schema`` validates a
+committed baseline alongside the raw artifacts.
 """
 
 from __future__ import annotations
@@ -335,6 +342,95 @@ def gate(records: List[dict], tolerance: float = DEFAULT_TOLERANCE
     }
 
 
+# -- baseline export (round 12: the watchdog's single source of truth) ------
+
+# schema id shared with slate_tpu/obs/watchdog.py (the consumer); the
+# file lives at the repo root as BASELINE_SERIES.json
+BASELINE_SCHEMA = "slate_tpu.baseline_series.v1"
+BASELINE_FILENAME = "BASELINE_SERIES.json"
+
+
+def _direction(metric: str) -> str:
+    """Per-metric regression direction: every tracked series is
+    higher-is-better (GFLOP/s, solves/s, speedup) EXCEPT the
+    residual_* informational series parsed off the r01–r05 multichip
+    tails (smaller residual = healthier) and anything latency-shaped —
+    classified here so a future artifact exporting a latency series
+    cannot silently enter the baseline with an inverted direction
+    (the watchdog would then read a 10× p99 rise as an improvement)."""
+    if metric.startswith("residual_") or "latency" in metric:
+        return "lower"
+    return "higher"
+
+
+def baseline_series(records: List[dict],
+                    tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Normalized records -> the BASELINE_SERIES document: one row per
+    (kind, metric, platform, n, batch, op, dtype) series with its
+    best-prior value — what ``gate`` compares against, exported as a
+    committed artifact so ``obs.watchdog`` loads ONE source of truth
+    instead of re-deriving it from nine artifact schemas at runtime."""
+    series: dict = {}
+    for rec in sorted(records,
+                      key=lambda r: (r["round"] is None, r["round"] or 0)):
+        if not rec["ok"]:
+            continue
+        for metric, value in rec["metrics"].items():
+            series.setdefault(_series_key(rec, metric), []).append(
+                {"round": rec["round"], "source": rec["source"],
+                 "value": value})
+    rows = []
+    for key, points in series.items():
+        kind, metric, platform, n, batch, op, dtype = key
+        values = [p["value"] for p in points]
+        direction = _direction(metric)
+        best = max(values) if direction == "higher" else min(values)
+        rows.append({
+            "kind": kind, "metric": metric, "platform": platform,
+            "n": n, "batch": batch, "op": op, "dtype": dtype,
+            "direction": direction, "best": best,
+            "last": values[-1], "points": len(points),
+            "rounds": sorted({p["round"] for p in points
+                              if p["round"] is not None}),
+            "sources": sorted({p["source"] for p in points}),
+        })
+    rows.sort(key=lambda r: tuple("" if v is None else str(v)
+                                  for v in (r["metric"], r["platform"],
+                                            r["n"], r["batch"], r["op"],
+                                            r["dtype"])))
+    return {
+        "schema": BASELINE_SCHEMA,
+        "tolerance": tolerance,
+        "gated_platforms": list(GATED_PLATFORMS),
+        "rounds": sorted({r["round"] for r in records
+                          if r["round"] is not None}),
+        "series": rows,
+    }
+
+
+def validate_baseline_file(path: str):
+    """Schema-check a committed BASELINE_SERIES.json (raises
+    SchemaError) — ``--check-schema`` covers the baseline artifact like
+    every BENCH/MULTICHIP file, so a hand-edited or stale-schema
+    baseline fails CI instead of silently blinding the watchdog."""
+    name, obj = _load(path)
+    if not isinstance(obj, dict) or obj.get("schema") != BASELINE_SCHEMA:
+        raise SchemaError(f"{name}: schema != {BASELINE_SCHEMA!r}")
+    rows = obj.get("series")
+    if not isinstance(rows, list) or not rows:
+        raise SchemaError(f"{name}: series missing or empty")
+    for i, row in enumerate(rows):
+        for k in ("metric", "platform", "best", "direction"):
+            if k not in row:
+                raise SchemaError(f"{name}[series.{i}]: missing {k!r}")
+        if row["direction"] not in ("higher", "lower"):
+            raise SchemaError(f"{name}[series.{i}]: bad direction "
+                              f"{row['direction']!r}")
+        if not isinstance(row["best"], (int, float)) \
+                or isinstance(row["best"], bool):
+            raise SchemaError(f"{name}[series.{i}]: non-numeric best")
+
+
 def check_schema(paths: List[str]) -> List[str]:
     """Validate every artifact; returns error strings (empty = clean)."""
     errors = []
@@ -356,7 +452,13 @@ def main(argv=None) -> int:
                         f"(default {DEFAULT_TOLERANCE})")
     p.add_argument("--check-schema", action="store_true",
                    help="only validate artifact schemas (exit 1 on any "
-                        "unparseable BENCH_*.json)")
+                        "unparseable BENCH_*.json; a committed "
+                        "BASELINE_SERIES.json is validated too)")
+    p.add_argument("--baseline-out", default=None, metavar="PATH",
+                   help="export the normalized best-prior series as a "
+                        "BASELINE_SERIES.json artifact (the single "
+                        "source of truth obs/watchdog.py loads) and "
+                        "exit")
     args = p.parse_args(argv)
     root = args.dir or os.path.join(os.path.dirname(
         os.path.abspath(__file__)), os.pardir)
@@ -366,14 +468,34 @@ def main(argv=None) -> int:
                           "error": f"no BENCH_*.json under {root}"}))
         return 1
     errors = check_schema(paths)
+    baseline_file = os.path.join(root, BASELINE_FILENAME)
+    # an invalid committed baseline must not block --baseline-out:
+    # that flag is the only tool that can REGENERATE the file (a
+    # schema bump would otherwise chicken-and-egg the operator into
+    # hand-deleting the artifact)
+    if os.path.exists(baseline_file) and not args.baseline_out:
+        try:
+            validate_baseline_file(baseline_file)
+        except SchemaError as e:
+            errors.append(str(e))
     if args.check_schema:
-        print(json.dumps({"checked": len(paths),
+        print(json.dumps({"checked": len(paths)
+                          + int(os.path.exists(baseline_file)),
                           "schema_errors": errors, "ok": not errors}))
         return 0 if not errors else 1
     if errors:
         print(json.dumps({"ok": False, "schema_errors": errors}))
         return 1
     records = [rec for p_ in paths for rec in normalize_all(p_)]
+    if args.baseline_out:
+        doc = baseline_series(records, tolerance=args.tolerance)
+        with open(args.baseline_out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({"baseline_out": args.baseline_out,
+                          "series": len(doc["series"]),
+                          "rounds": doc["rounds"], "ok": True}))
+        return 0
     summary = gate(records, tolerance=args.tolerance)
     print(json.dumps(summary, sort_keys=True))
     for row in summary["regressions"]:

@@ -12,14 +12,27 @@ text exposition, and the HTTP endpoint — then writes the artifacts:
                           bytes-accessed, temp/peak HBM, collective
                           census) + bytes ledger + roofline join
   <out-dir>/trace.svg     legacy SVG timeline (utils.trace)
+  <out-dir>/slo.json      /slo burn-rate payload (round 12)
+  <out-dir>/watchdog.json live-vs-baseline reports: the real committed
+                          history (must be quiet) AND an injected-
+                          latency fixture (must flag)
+  <out-dir>/fleet.json    2-process aggregation of the run's snapshot
+  <out-dir>/fleet.prom    fleet-level Prometheus text (host labels)
+  <out-dir>/fleet_trace.json  2-process combined Chrome trace
 
 Exit status is nonzero if the Chrome JSON fails schema validation
 (obs.validate_chrome_trace: required keys, monotone ts, span nesting),
 if the span tree is disconnected, if the HTTP endpoint serves the
-wrong payloads, or if the round-9 cost exports are missing/incomplete
+wrong payloads, if the round-9 cost exports are missing/incomplete
 (empty cost_log, absent Prometheus bytes/HBM sections, or a mesh run
-that credited zero collective bytes) — wired into examples/run_tests.py
-as the obs smoke.
+that credited zero collective bytes), or if any round-12 section
+fails: /slo payload without computed burn rates, lifecycle-stage
+histograms or backpressure gauges missing, the watchdog flagging the
+real committed history (or NOT flagging the injected regression),
+``padding_waste_flops`` zero on a deliberately under-occupied bucket
+or nonzero at full occupancy, or a 2-process aggregation whose
+counters are not bit-exactly double the single-process snapshot —
+wired into examples/run_tests.py as the obs smoke.
 
 Usage: python tools/obs_dump.py [--smoke] [--out-dir DIR]
                                 [--n N] [--nb NB] [--requests R]
@@ -78,6 +91,10 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     A = st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower)
 
     sess = Session(tracer=tracer)
+    # round 12: SLO tracking on (default objectives) — the served
+    # workload below feeds the request/cache/oom streams the /slo
+    # payload evaluates
+    sess.enable_slo()
     h = sess.register(A, op="chol")
     srv = sess.serve_obs()  # opt-in HTTP endpoint, ephemeral port
     try:
@@ -179,10 +196,155 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         if svg is None:
             fails.append("SVG timeline empty (span bridge broken)")
 
+        # -- SLO payload (round 12) -----------------------------------
+        slo_payload = sess.slo.evaluate()
+        with open(os.path.join(out_dir, "slo.json"), "w") as f:
+            json.dump(slo_payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        objs = slo_payload.get("objectives", [])
+        if not objs:
+            fails.append("/slo payload has no objectives")
+        req_rows = [o for o in objs if o["kind"] in ("latency",
+                                                     "error_rate")]
+        if not any(w["burn_rate"] is not None
+                   for o in req_rows for w in o["windows"]):
+            fails.append("slo: no burn rate computed over the served "
+                         "traffic")
+        if "slate_tpu_slo_burn_rate" not in obs.render_prometheus(
+                sess.metrics, ledger=False, bytes_ledger=False):
+            fails.append("prometheus text missing slo burn-rate gauges")
+
+        # lifecycle stages + backpressure (tentpole c / satellite 1)
+        hists = sess.metrics.snapshot()["histograms"]
+        for stage in ("stage_queue_wait", "stage_batch_form",
+                      "stage_dispatch", "stage_device_execute",
+                      "stage_reply"):
+            if not hists.get(stage, {}).get("count"):
+                fails.append(f"lifecycle stage histogram {stage} empty")
+            elif not (hists[stage].get("exemplar") or {}).get("trace_id"):
+                fails.append(f"stage {stage}: no exemplar trace-id")
+        gsnap = sess.metrics.snapshot()["gauges"]
+        for g in ("queue_depth", "queued_buckets", "oldest_request_age_s",
+                  "max_bucket_backlog", "inflight_batches"):
+            if g not in gsnap:
+                fails.append(f"backpressure gauge {g} missing")
+
+        # -- watchdog: real history quiet, injected regression flagged -
+        wd = obs.Watchdog(metrics=sess.metrics, tracer=tracer)
+        wd.watch_session(sess, platform=jax.default_backend(), n=n)
+        # replay every committed series at its own best: on a CPU host
+        # the anomalies list is empty BY POLICY (cpu never gates), so
+        # the meaningful quiet-check is matched-every-series with zero
+        # informational drops — a drop would mean the baseline
+        # disagrees with itself
+        baseline_doc = obs.watchdog.load_baseline()
+        for row in baseline_doc["series"]:
+            wd.observe(row["metric"], row["best"], row["platform"],
+                       n=row["n"], op=row["op"], batch=row["batch"],
+                       dtype=row["dtype"], kind=row["kind"])
+        real_rep = wd.check()
+        if real_rep["anomalies"] or real_rep["informational"]:
+            fails.append("watchdog flagged the real committed history: "
+                         f"{(real_rep['anomalies'] or real_rep['informational'])[:2]}")
+        if real_rep["matched"] < len(baseline_doc["series"]):
+            fails.append(
+                f"watchdog matched only {real_rep['matched']} of "
+                f"{len(baseline_doc['series'])} committed series")
+        injected = {
+            "schema": "slate_tpu.baseline_series.v1", "tolerance": 0.10,
+            "series": [{"kind": "serve", "metric": "request_latency_p99",
+                        "platform": "tpu", "n": n, "batch": None,
+                        "op": None, "dtype": None, "direction": "lower",
+                        "best": 1e-6}],
+        }
+        wd2 = obs.Watchdog(baseline=injected, metrics=sess.metrics,
+                           tracer=tracer)
+        # the injected-latency fixture: live p99 orders of magnitude
+        # above the synthetic committed best MUST flag
+        lat = sess.metrics.snapshot()["histograms"]["request_latency"]
+        wd2.observe("request_latency_p99", max(lat["p99"], 1e-3), "tpu",
+                    n=n, kind="serve")
+        inj_rep = wd2.check()
+        if not inj_rep["anomalies"]:
+            fails.append("watchdog missed the injected latency "
+                         "regression")
+        if not any(s.name == "watchdog.anomaly" for s in tracer.spans()):
+            fails.append("no watchdog.anomaly trace event recorded")
+        with open(os.path.join(out_dir, "watchdog.json"), "w") as f:
+            json.dump({"real_history": real_rep, "injected": inj_rep},
+                      f, indent=2, sort_keys=True)
+            f.write("\n")
+
+        # -- padding-waste ledger (tentpole c acceptance) ---------------
+        # 3 distinct lu_small operators -> pow2 bucket 4 -> one padded
+        # lane of REAL flops; a 4-of-4 bucket must credit exactly 0
+        rng2 = np.random.default_rng(7)
+        under = Session()
+        hs = [under.register(rng2.standard_normal((16, 16))
+                             + 16 * np.eye(16), op="lu_small")
+              for _ in range(3)]
+        under.solve_small_batched(hs, [rng2.standard_normal((16, 1))
+                                       for _ in hs])
+        if not under.metrics.get("padding_waste_flops") > 0:
+            fails.append("padding_waste_flops == 0 on an under-occupied "
+                         "bucket")
+        full = Session()
+        hf = [full.register(rng2.standard_normal((16, 16))
+                            + 16 * np.eye(16), op="lu_small")
+              for _ in range(4)]
+        full.solve_small_batched(hf, [rng2.standard_normal((16, 1))
+                                      for _ in hf])
+        if full.metrics.get("padding_waste_flops") != 0:
+            fails.append("padding_waste_flops != 0 at full occupancy")
+        if "slate_tpu_padding_waste_flops" not in obs.render_prometheus(
+                under.metrics, ledger=False, bytes_ledger=False):
+            fails.append("prometheus text missing padding_waste_flops")
+        if obs.flops.LEDGER.snapshot()["per_op"].get(
+                "padding.waste", 0) <= 0:
+            fails.append("process ledger has no padding.waste op")
+
+        # -- 2-process aggregation (tentpole d) -------------------------
+        # same-snapshot fold: the acceptance's bit-exactness check —
+        # merging a snapshot with itself must exactly double every
+        # counter (and the combined trace must stay schema-valid)
+        snap = sess.metrics.snapshot()
+        fleet = obs.aggregate.aggregate_processes(
+            [snap, snap], flop_snaps=[obs.flops.LEDGER.snapshot()] * 2,
+            bytes_snaps=[obs.costs.BYTES.snapshot()] * 2,
+            hosts=["proc0", "proc1"])
+        merged = fleet["metrics"]["counters"]
+        for k2, v2 in snap["counters"].items():
+            if merged.get(k2) != 2 * v2:
+                fails.append(f"aggregation not bit-exact for {k2}: "
+                             f"{merged.get(k2)} != 2*{v2}")
+                break
+        obs.aggregate.write_fleet(
+            fleet, json_path=os.path.join(out_dir, "fleet.json"),
+            prom_path=os.path.join(out_dir, "fleet.prom"))
+        with open(os.path.join(out_dir, "fleet.prom")) as f:
+            fprom = f.read()
+        if 'host="proc1"' not in fprom:
+            fails.append("fleet prometheus missing host-labeled gauges")
+        with open(trace_path) as f:
+            one_trace = json.load(f)
+        combined = obs.combine_process_traces([one_trace, one_trace],
+                                              ["proc0", "proc1"])
+        cerrs = obs.validate_chrome_trace(combined)
+        if cerrs:
+            fails.append(f"combined fleet trace invalid: {cerrs[:2]}")
+        pids = {e.get("pid") for e in combined["traceEvents"]}
+        if not (pids & set(range(0, 3))) or not (pids & set(range(100,
+                                                                 103))):
+            fails.append("combined trace pids not namespaced per process")
+        with open(os.path.join(out_dir, "fleet_trace.json"), "w") as f:
+            json.dump(combined, f, indent=1)
+            f.write("\n")
+
         # -- HTTP endpoint --------------------------------------------
         for path, needle in (("/metrics", "slate_tpu_solves_total"),
                              ("/healthz", '"status": "ok"'),
-                             ("/trace.json", "traceEvents")):
+                             ("/trace.json", "traceEvents"),
+                             ("/slo", '"objectives"')):
             body = urllib.request.urlopen(srv.url(path),
                                           timeout=10).read().decode()
             if needle not in body:
