@@ -622,6 +622,79 @@ def _mixed_roofline_rows(n, nb, dtype=jnp.float32):
     return {"rows": rows, "gflops_report": mixed_report}
 
 
+def _served_mixed_roofline_rows(n, nb, dtype=jnp.float32,
+                                factor_dtype="bfloat16", requests=6):
+    """Round 13: the MEASURED successor of _mixed_roofline_rows'
+    composed lower bound — a serving Session with a refined resident
+    (register(..., refine=...)) serves a small workload, and the rows
+    come from the ANALYZED programs the refine/ engine actually
+    executed (Session.cost_log: the low-precision factor, the
+    refine_start initial solve, the refine_step residual+apply), with
+    the per-execution bytes the ledger credited and the measured
+    iteration count. No composition estimate: these are the programs
+    a production mixed serve runs, at their true bytes."""
+    import numpy as np
+
+    import slate_tpu as st
+    from slate_tpu.refine import RefinePolicy
+    from slate_tpu.runtime import Session
+
+    rng = np.random.default_rng(31)
+    rows = []
+    for op in ("chol", "lu"):
+        base = rng.standard_normal((n, n)).astype(np.dtype(dtype))
+        if op == "chol":
+            dense = base @ base.T + n * np.eye(n, dtype=np.dtype(dtype))
+            A = st.hermitian(np.tril(dense), nb=nb, uplo=st.Uplo.Lower)
+            model_factor = model_flops.potrf(n)
+        else:
+            dense = base + n * np.eye(n, dtype=np.dtype(dtype))
+            A = st.from_dense(dense, nb=nb)
+            model_factor = model_flops.getrf(n)
+        sess = Session()
+        h = sess.register(A, op=op,
+                          refine=RefinePolicy(factor_dtype=factor_dtype))
+        sess.warmup(h)
+        for i in range(requests):
+            sess.solve(h, rng.standard_normal(n).astype(np.dtype(dtype)))
+        snap = sess.metrics.snapshot()
+        hist = snap["histograms"].get("refine_iterations", {})
+        by_what = {}
+        for r in sess.cost_log:
+            by_what.setdefault(r["what"], r)
+        frow = by_what.get("factor", {})
+        srow = by_what.get("refine_step", {})
+        row = {
+            "op": op, "n": n, "nb": nb,
+            "working_dtype": str(jnp.dtype(dtype)),
+            "factor_dtype": factor_dtype,
+            "iters_mean": hist.get("mean") or 0.0,
+            "factor_bytes_measured": frow.get("bytes_accessed"),
+            "factor_intensity_measured": obs_roofline.intensity(
+                model_factor, frow.get("bytes_accessed")),
+            "step_bytes_measured": srow.get("bytes_accessed"),
+            "step_model_flops": srow.get("model_flops"),
+            "step_intensity_measured": obs_roofline.intensity(
+                srow.get("model_flops") or 0.0,
+                srow.get("bytes_accessed")),
+            # the serve-side ledger split (useful vs refinement) as a
+            # production scrape would read it
+            "serve_refine_flops": sess.metrics.get("refine_flops_total"),
+            "serve_solve_flops": sess.metrics.get("solve_flops_total"),
+            "refine_fallbacks": sess.metrics.get(
+                "refine_fallbacks_total"),
+        }
+        rows.append(row)
+        fi = row["factor_intensity_measured"]
+        print(f"# roofline served-mixed {op} n={n}: factor intensity "
+              + (f"{fi:.1f} flop/B" if fi is not None else "n/a")
+              + f" (measured), iters {row['iters_mean']:.1f}, "
+              f"refine/useful flops "
+              f"{row['serve_refine_flops']:.3g}/"
+              f"{row['serve_solve_flops']:.3g}", file=sys.stderr)
+    return rows
+
+
 def _roofline_rows(n, model_fl, seconds):
     """One roofline row per headline verb: model flops ÷ XLA
     bytes-accessed (single-call program) joined with the measured
@@ -811,6 +884,16 @@ def main():
             extra["roofline_mixed"] = _mixed_roofline_rows(pn, pnb)
         except Exception as e:
             print(f"# mixed roofline skipped: {e}", file=sys.stderr)
+        # round 13: the measured per-execution rows from a SERVED
+        # refined workload (the refine/ engine's analyzed programs) —
+        # the composed lower bound above, replaced by the programs a
+        # production mixed serve actually runs
+        try:
+            extra["roofline_mixed_served"] = _served_mixed_roofline_rows(
+                min(pn, 256), min(pnb, 64))
+        except Exception as e:
+            print(f"# served mixed roofline skipped: {e}",
+                  file=sys.stderr)
 
     out = {
         "metric": f"gemm_gflops_per_chip_fp32_n{n}",

@@ -449,6 +449,163 @@ def bench_multichip(n=128, nb=32, requests=32, max_batch=8,
     return artifact
 
 
+def bench_mixed(sizes=(128, 256), nb=32, requests=32,
+                dtype=np.float32, factor_dtype="bfloat16",
+                budget_residents=3, out_path="BENCH_MIXED_r01.json"):
+    """The mixed-precision serving A/B (round 13, ISSUE 10): a Session
+    holding a LOW-precision resident factor + iterative-refinement
+    solves (``register(..., refine=...)`` through slate_tpu/refine/)
+    vs the same operator served at full precision. Per (op, n) row:
+    both arms' solves/sec (warmed; factor paid off the timed window),
+    the refined arm's mean iteration count, each arm's RESIDENT FACTOR
+    BYTES (the structural claim: a bf16-from-f32 resident charges ~half
+    — pinned by the ``factor_bytes_ratio`` column), and a
+    residents-per-budget experiment: a budget sized for
+    ``budget_residents`` full-precision factors (plus the arm's own
+    analyzed-program transient, which the round-9 budget also charges)
+    is filled with 2·N+1 distinct operators — the mixed arm holds ~2×
+    as many residents before eviction (``residents_ratio``).
+
+    CPU-smoke honesty: wall-clock columns on this host are
+    informational — XLA:CPU materializes f32↔bf16 converts around
+    every gemm, so refined serving can read SLOWER; the structural
+    columns (factor bytes, residents, iters) are the portable claim
+    and the TPU series gate on solves/sec when the tunnel returns."""
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.refine import RefinePolicy
+    from slate_tpu.runtime import Session
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(29)
+    rows = []
+    for op in ("chol", "lu"):
+        for n in sizes:
+            base = rng.standard_normal((n, n)).astype(dtype)
+            if op == "chol":
+                dense = base @ base.T + n * np.eye(n, dtype=dtype)
+
+                def operand(shift=0.0):
+                    return st.hermitian(
+                        np.tril(dense) + shift * np.eye(n, dtype=dtype),
+                        nb=nb, uplo=st.Uplo.Lower)
+            else:
+                dense = base + n * np.eye(n, dtype=dtype)
+
+                def operand(shift=0.0):
+                    return st.from_dense(
+                        dense + shift * np.eye(n, dtype=dtype), nb=nb)
+            rhs = [rng.standard_normal(n).astype(dtype)
+                   for _ in range(requests)]
+
+            def run_arm(policy):
+                sess = Session()
+                h = sess.register(operand(), op=op, refine=policy)
+                sess.warmup(h)
+                sess.solve(h, rhs[0])  # warm every program (incl. step)
+                t0 = time.perf_counter()
+                for b in rhs:
+                    x = sess.solve(h, b)
+                wall = time.perf_counter() - t0
+                return sess, h, x, wall
+
+            pol = RefinePolicy(factor_dtype=factor_dtype)
+            ms, mh, mx, mwall = run_arm(pol)
+            fs, fh, fx, fwall = run_arm(None)
+            # correctness: refined serving must meet the same bound
+            # the full-precision arm does (a fast wrong answer is not
+            # a win)
+            for x in (mx, fx):
+                resid = float(np.abs(dense @ x - rhs[-1]).max()) / n
+                if not resid < 1e-2:
+                    raise RuntimeError(
+                        f"mixed bench {op} n={n}: residual {resid}")
+            mixed_bytes = ms.factor(mh).nbytes
+            full_bytes = fs.factor(fh).nbytes
+            hist = ms.metrics.snapshot()["histograms"].get(
+                "refine_iterations", {})
+
+            def residents(policy, probe_sess):
+                # budget sized for `budget_residents` FULL-precision
+                # factors + this arm's largest analyzed-program
+                # transient (the round-9 budget charges it too; the
+                # plain arm below runs unanalyzed programs, transient 0)
+                transient = max(
+                    (pc.transient_bytes
+                     for pc in probe_sess._program_costs.values()),
+                    default=0)
+                sess = Session(hbm_budget=budget_residents * full_bytes
+                               + transient)
+                hs = [sess.register(operand((i + 1) * 0.5), op=op,
+                                    refine=policy)
+                      for i in range(2 * budget_residents + 1)]
+                for h in hs:
+                    sess.solve(h, rhs[0])
+                return len(sess.cached_handles())
+
+            res_m = residents(pol, ms)
+            res_f = residents(None, Session())  # plain arm: no analyzed
+            row = {
+                "op": op, "n": n, "nb": nb, "requests": requests,
+                "dtype": np.dtype(dtype).name,
+                "factor_dtype": factor_dtype,
+                "mixed": {
+                    "wall_s": mwall,
+                    "solves_per_sec": requests / mwall,
+                    "iters_mean": hist.get("mean") or 0.0,
+                    "factor_bytes": mixed_bytes,
+                    "residents_within_budget": res_m,
+                },
+                "full": {
+                    "wall_s": fwall,
+                    "solves_per_sec": requests / fwall,
+                    "factor_bytes": full_bytes,
+                    "residents_within_budget": res_f,
+                },
+                "speedup": fwall / mwall,
+                "factor_bytes_ratio": mixed_bytes / full_bytes,
+                "residents_ratio": res_m / max(res_f, 1),
+                "refine_fallbacks": ms.metrics.get(
+                    "refine_fallbacks_total"),
+            }
+            # structural acceptance: half-bytes residents, ≥ ~2× of
+            # them per budget, and every timed solve actually refined
+            # (zero fallbacks on these well-conditioned operators)
+            row["ok"] = (row["factor_bytes_ratio"] < 0.6
+                         and res_f == budget_residents
+                         and res_m >= 2 * budget_residents - 1
+                         and row["refine_fallbacks"] == 0)
+            rows.append(row)
+            print(f"# mixed {op} n={n}: refined "
+                  f"{row['mixed']['solves_per_sec']:.1f} solves/s vs "
+                  f"full {row['full']['solves_per_sec']:.1f} "
+                  f"({row['speedup']:.2f}x), bytes ratio "
+                  f"{row['factor_bytes_ratio']:.2f}, residents "
+                  f"{res_m} vs {res_f}, iters "
+                  f"{row['mixed']['iters_mean']:.1f}", file=sys.stderr)
+    artifact = {
+        "bench": "serve_mixed",
+        "platform": platform,
+        "dtype": np.dtype(dtype).name,
+        "factor_dtype": factor_dtype,
+        "caveat": ("CPU smoke (TPU tunnel down since round 5): "
+                   "wall-clock columns are informational — XLA:CPU "
+                   "materializes f32<->bf16 converts around every "
+                   "gemm; the factor-bytes / residents-per-budget / "
+                   "iteration columns are the structural claim."
+                   if platform == "cpu" else None),
+        "rows": rows,
+        "ok": all(r["ok"] for r in rows),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"rows": len(rows), "out": out_path,
+                      "platform": platform, "ok": artifact["ok"]}))
+    return artifact
+
+
 def _probe_device_count(timeout=90):
     """Default-backend device count, probed in a subprocess with a
     hard timeout — with the TPU tunnel down, jax.devices() hangs
@@ -516,6 +673,14 @@ def main(argv=None):
                         "the structured MULTICHIP artifact; forces a "
                         "virtual 8-device CPU mesh when fewer devices "
                         "are visible")
+    p.add_argument("--mixed", action="store_true",
+                   help="run the mixed-precision serving A/B (refined-"
+                        "from-low-precision resident vs full-precision "
+                        "serve) and write the serve_mixed artifact; "
+                        "exit 0 iff every row's structural columns "
+                        "hold (half-byte residents, ~2x residents per "
+                        "budget, zero fallbacks)")
+    p.add_argument("--mixed-out", default="BENCH_MIXED_r01.json")
     p.add_argument("--multichip-out", default="MULTICHIP_r06.json")
     p.add_argument("--devices", type=int, default=8,
                    help="device count for the forced multichip mesh")
@@ -552,6 +717,13 @@ def main(argv=None):
         else:
             art = bench_multichip(n_devices=args.devices,
                                   out_path=args.multichip_out)
+        return 0 if art["ok"] else 1
+    if args.mixed:
+        if args.smoke:
+            art = bench_mixed(sizes=(96,), nb=32, requests=10,
+                              out_path=args.mixed_out)
+        else:
+            art = bench_mixed(out_path=args.mixed_out)
         return 0 if art["ok"] else 1
     if args.batched:
         if args.smoke:

@@ -109,6 +109,20 @@ def main(argv=None):
         if r.returncode != 0:
             fails += 1
             print("!!! bench_serve --multichip --smoke FAILED")
+    # mixed-precision serving smoke (round 13): refined-from-bf16 vs
+    # full-precision serve into a throwaway artifact; exits nonzero
+    # unless every row's structural columns hold (half-byte residents,
+    # ~2x residents per budget, zero fallbacks on well-conditioned
+    # operators)
+    print("=== bench_serve.py --mixed --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--mixed", "--smoke", "--mixed-out",
+         "/tmp/BENCH_MIXED_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --mixed --smoke FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure)

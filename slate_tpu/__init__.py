@@ -43,6 +43,9 @@ from .api import (multiply, rank_k_update, rank_2k_update,
                   # drivers — st.gesv_mixed must credit the flop ledger
                   # like every other public verb (round-10 satellite)
                   gesv_mixed, posv_mixed, gesv_mixed_gmres,
-                  posv_mixed_gmres)
+                  posv_mixed_gmres, gesv_mixed_batched,
+                  posv_mixed_batched)
+from . import refine
+from .refine import PolicyTable, RefinePolicy
 from . import runtime
 from . import obs

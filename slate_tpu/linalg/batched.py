@@ -51,6 +51,7 @@ accounts it).
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
 from collections import OrderedDict
 from typing import Hashable, Optional, Tuple
@@ -63,6 +64,21 @@ from ..core.exceptions import SlateError
 from ..obs import costs as _costs
 from ..obs import flops as _flops
 from ..ops import blocked
+from ..refine import engine as _refine
+from ..refine.policy import canonical_dtype_name as _dtype_name
+from ..refine.policy import check_cast_kinds as _check_cast_kinds
+from ..refine.policy import jax_dtype as _jax_dtype
+
+
+def _guard_mixed_dtype(work_dtype, lo: str, what: str) -> str:
+    """Real/complex kind agreement for the mixed drivers (a
+    complex→real astype silently discards the imaginary part — the
+    factor would be of Re(A) only, info=0, never convergent)."""
+    try:
+        _check_cast_kinds(work_dtype, lo, what)
+    except ValueError as e:
+        raise SlateError(str(e))
+    return lo
 
 Array = jax.Array
 
@@ -492,6 +508,247 @@ def posv_batched(A, B, nb: Optional[int] = None):
                           _pad_zeros(b, bb), live_batch=bsz)
     x, info = x[:bsz, :, :k], info[:bsz]
     return (x[:, :, 0] if vector else x), info
+
+
+# -- mixed-precision batched drivers (round 13: the refine/ subsystem) ------
+# Factor the stack in a LOWER precision, refine every item to the
+# working precision with the unified per-item-masked IR loop
+# (refine/engine.batched_ir_loop) — ONE program per pow2 bucket, end to
+# end (cast + batched factor + the whole refinement while-loop compile
+# into the bucket executable). Static knobs (factor dtype, iteration
+# budget, tolerance) are encoded into the bucket NAME so two policies
+# can never share a program. Per-item isolation carries over: a
+# non-convergent (or singular-in-low-precision) item flags only its own
+# lane — converged lanes freeze bit-exactly inside the masked loop, so
+# B=1 runs are bit-identical to any bucket lane (the linalg/batched
+# contract, pinned by tests/test_refine.py).
+
+
+def _k_getrf_mixed(a, nb, lo):
+    with jax.default_matmul_precision("highest"):
+        return blocked.getrf_batched(a.astype(lo), nb)
+
+
+def _k_potrf_mixed(a, nb, lo):
+    with jax.default_matmul_precision("highest"):
+        return blocked.potrf_batched(a.astype(lo), nb)
+
+
+def _lo_cast_up(v_lo, work):
+    """Cast a low-precision solve result back to the working dtype
+    behind an optimization barrier. WITHOUT the barrier XLA:CPU fuses
+    the upcast into the solve's final gemm and the fused kernel's
+    rounding becomes BATCH-SHAPE-DEPENDENT (measured: the identical
+    bf16 getrs lane differs bitwise between the B=1 and B=8 bucket
+    programs once an .astype(f32) consumer follows — the same fusion
+    class as the documented c64 caveat). The barrier pins the
+    low-precision rounding, restoring the cross-bucket bit-identity
+    contract; cost is one blocked fusion per cast-up."""
+    return jax.lax.optimization_barrier(v_lo).astype(work)
+
+
+def _k_getrs_refined(a, lu, perm, b, nb, max_iters, tol):
+    with jax.default_matmul_precision("highest"):
+        lo, work = lu.dtype, a.dtype
+
+        def apply_lo(r):
+            return _lo_cast_up(
+                blocked.getrs_batched(lu, perm, r.astype(lo)), work)
+
+        x0 = apply_lo(b)
+        cte = _refine.batched_cte(a, tol)
+        return _refine.batched_ir_loop(a, b, x0, apply_lo, cte, max_iters)
+
+
+def _herm_full(a):
+    """Reconstruct the full Hermitian stack from lower storage: the
+    refinement residual gemms read ALL of A (unlike potrf/potrs, which
+    only read the lower triangles), and the batched Hermitian
+    convention is lower-storage — so the kernel symmetrizes, making
+    full and tril-only operands equivalent."""
+    lo_tri = jnp.tril(a)
+    return lo_tri + jnp.conj(jnp.swapaxes(jnp.tril(a, -1), 1, 2))
+
+
+def _k_potrs_refined(a, l, b, nb, max_iters, tol):
+    with jax.default_matmul_precision("highest"):
+        lo, work = l.dtype, a.dtype
+        af = _herm_full(a)
+
+        def apply_lo(r):
+            return _lo_cast_up(blocked.potrs_batched(l, r.astype(lo)),
+                               work)
+
+        x0 = apply_lo(b)
+        cte = _refine.batched_cte(af, tol)
+        return _refine.batched_ir_loop(af, b, x0, apply_lo, cte,
+                                       max_iters)
+
+
+def _k_gesv_mixed(a, b, nb, lo, max_iters, tol):
+    with jax.default_matmul_precision("highest"):
+        lu, perm, info = blocked.getrf_batched(a.astype(lo), nb)
+        x, iters, conv = _k_getrs_refined(a, lu, perm, b, nb,
+                                          max_iters, tol)
+        return x, info, iters, conv
+
+
+def _k_posv_mixed(a, b, nb, lo, max_iters, tol):
+    with jax.default_matmul_precision("highest"):
+        l, info = blocked.potrf_batched(a.astype(lo), nb)
+        x, iters, conv = _k_potrs_refined(a, l, b, nb, max_iters, tol)
+        return x, info, iters, conv
+
+
+def getrf_mixed_batched(A, factor_dtype="bfloat16",
+                        nb: Optional[int] = None):
+    """Batched LOW-PRECISION LU of a working-precision [B, n, n] stack
+    → (LU_lo, perm, info[B]): the cast happens inside the bucket
+    program, so the factors come back in ``factor_dtype`` — the
+    half-HBM residents the serving Session caches for refined solves."""
+    a = _as_stack(A, "getrf_mixed_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("getrf_mixed_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
+                            "getrf_mixed_batched")
+    ap = _pad_eye(a, batch_bucket(bsz))
+    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.getrf(n))
+    lu, perm, info = _run_bucket(
+        f"getrf_mixed_batched[{lo}]",
+        functools.partial(_k_getrf_mixed, lo=_jax_dtype(lo)), nb, ap,
+        live_batch=bsz)
+    return lu[:bsz], perm[:bsz], info[:bsz]
+
+
+def potrf_mixed_batched(A, factor_dtype="bfloat16",
+                        nb: Optional[int] = None):
+    """Batched low-precision lower Cholesky → (L_lo, info[B])."""
+    a = _as_stack(A, "potrf_mixed_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("potrf_mixed_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
+                            "potrf_mixed_batched")
+    ap = _pad_eye(a, batch_bucket(bsz))
+    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.potrf(n))
+    l, info = _run_bucket(
+        f"potrf_mixed_batched[{lo}]",
+        functools.partial(_k_potrf_mixed, lo=_jax_dtype(lo)), nb, ap,
+        live_batch=bsz)
+    return l[:bsz], info[:bsz]
+
+
+def getrs_refined_batched(A, LU_lo, perm, B, max_iters: int = 30,
+                          tol: Optional[float] = None):
+    """Batched refined solve from resident LOW-precision LU factors:
+    the serving path — initial lo solve + the per-item-masked IR loop,
+    one program per bucket. ``A`` is the working-precision operand
+    stack (the residual gemms read it). Returns (x, iters[B],
+    converged[B]); iters counts residual checks per item."""
+    a = _as_stack(A, "getrs_refined_batched")
+    lu = _as_stack(LU_lo, "getrs_refined_batched")
+    bsz, n, _ = a.shape
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "getrs_refined_batched")
+    bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz, _flops.solve_flops("lu", n, n, int(b.shape[2])))
+    name = (f"getrs_refined_batched[{_dtype_name(lu.dtype)},"
+            f"{max_iters},{tol!r}]")
+    x, iters, conv = _run_bucket(
+        name,
+        functools.partial(_k_getrs_refined, max_iters=max_iters, tol=tol),
+        0, _pad_eye(a, bb), _pad_eye(lu, bb),
+        _pad_arange(jnp.asarray(perm), bb), _pad_zeros(b, bb),
+        live_batch=bsz)
+    x = x[:bsz, :, :k]
+    return (x[:, :, 0] if vector else x), iters[:bsz], conv[:bsz]
+
+
+def potrs_refined_batched(A, L_lo, B, max_iters: int = 30,
+                          tol: Optional[float] = None):
+    """Batched refined solve from resident low-precision Cholesky
+    factors → (x, iters[B], converged[B])."""
+    a = _as_stack(A, "potrs_refined_batched")
+    l = _as_stack(L_lo, "potrs_refined_batched")
+    bsz, n, _ = a.shape
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "potrs_refined_batched")
+    bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz, _flops.solve_flops("chol", n, n, int(b.shape[2])))
+    name = (f"potrs_refined_batched[{_dtype_name(l.dtype)},"
+            f"{max_iters},{tol!r}]")
+    x, iters, conv = _run_bucket(
+        name,
+        functools.partial(_k_potrs_refined, max_iters=max_iters, tol=tol),
+        0, _pad_eye(a, bb), _pad_eye(l, bb), _pad_zeros(b, bb),
+        live_batch=bsz)
+    x = x[:bsz, :, :k]
+    return (x[:, :, 0] if vector else x), iters[:bsz], conv[:bsz]
+
+
+def gesv_mixed_batched(A, B, nb: Optional[int] = None,
+                       factor_dtype="bfloat16", max_iters: int = 30,
+                       tol: Optional[float] = None):
+    """Batched mixed-precision A·X = B: low-precision LU + per-item
+    refinement as ONE program per bucket → (X, info[B], iters[B]);
+    iters[i] < 0 ⇒ item i did not converge (its X is the best iterate —
+    callers own the fallback, see api.gesv_mixed_batched)."""
+    a = _as_stack(A, "gesv_mixed_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("gesv_mixed_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
+                            "gesv_mixed_batched")
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "gesv_mixed_batched")
+    bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz,
+        _flops.getrf(n) + _flops.solve_flops("lu", n, n,
+                                             int(b.shape[2])))
+    x, info, iters, conv = _run_bucket(
+        f"gesv_mixed_batched[{lo},{max_iters},{tol!r}]",
+        functools.partial(_k_gesv_mixed, lo=_jax_dtype(lo),
+                          max_iters=max_iters, tol=tol),
+        nb, _pad_eye(a, bb), _pad_zeros(b, bb), live_batch=bsz)
+    x, info, iters, conv = (x[:bsz, :, :k], info[:bsz], iters[:bsz],
+                            conv[:bsz])
+    iters = jnp.where(conv, iters, -iters)
+    return (x[:, :, 0] if vector else x), info, iters
+
+
+def posv_mixed_batched(A, B, nb: Optional[int] = None,
+                       factor_dtype="bfloat16", max_iters: int = 30,
+                       tol: Optional[float] = None):
+    """Batched mixed-precision Hermitian-positive-definite solve (lower
+    storage): low-precision Cholesky + per-item refinement as ONE
+    program per bucket → (X, info[B], iters[B]); iters < 0 ⇒ not
+    converged."""
+    a = _as_stack(A, "posv_mixed_batched")
+    bsz, m, n = a.shape
+    if m != n:
+        raise SlateError("posv_mixed_batched: items must be square")
+    nb = default_nb(n) if nb is None else nb
+    lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
+                            "posv_mixed_batched")
+    b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "posv_mixed_batched")
+    bb = batch_bucket(bsz)
+    _credit_padding_flops(
+        bb - bsz,
+        _flops.potrf(n) + _flops.solve_flops("chol", n, n,
+                                             int(b.shape[2])))
+    x, info, iters, conv = _run_bucket(
+        f"posv_mixed_batched[{lo},{max_iters},{tol!r}]",
+        functools.partial(_k_posv_mixed, lo=_jax_dtype(lo),
+                          max_iters=max_iters, tol=tol),
+        nb, _pad_eye(a, bb), _pad_zeros(b, bb), live_batch=bsz)
+    x, info, iters, conv = (x[:bsz, :, :k], info[:bsz], iters[:bsz],
+                            conv[:bsz])
+    iters = jnp.where(conv, iters, -iters)
+    return (x[:, :, 0] if vector else x), info, iters
 
 
 def gels_batched(A, B, nb: Optional[int] = None):

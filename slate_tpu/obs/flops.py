@@ -189,6 +189,15 @@ TESTER_MODELS: Dict[str, Callable[[int, int], float]] = {
     "gesv_tntpiv": lambda m, n: getrf(n),
     "gesv_mixed": lambda m, n: getrf(n),
     "gesv_mixed_gmres": lambda m, n: getrf(n),
+    # round 13: the served mixed paths use the per-item factor model
+    # (refinement overhead is credited separately, as serve.refine —
+    # the useful-vs-refinement ledger split); the batched tester rows
+    # time a FIXED B=4 stack, so their model is 4x per-item — a row's
+    # GFLOP/s column must describe the work its body executes
+    "gesv_mixed_batched": lambda m, n: 4.0 * getrf(n),
+    "posv_mixed_batched": lambda m, n: 4.0 * potrf(n),
+    "gesv_mixed_served": lambda m, n: getrf(n),
+    "posv_mixed_served": lambda m, n: potrf(n),
     "getri": lambda m, n: getri(n),
     "geqrf": geqrf,
     "gelqf": gelqf,

@@ -1,0 +1,37 @@
+"""slate_tpu.refine — mixed-precision iterative-refinement subsystem.
+
+One engine behind everything that solves from a low-precision factor
+(ROADMAP item 2, the reference's gesv_mixed/posv_mixed/*_mixed_gmres
+driver family grown into a serving component):
+
+* :mod:`.policy` — :class:`RefinePolicy` (factor/residual dtype,
+  iteration budget, IR vs GMRES-IR strategy, fallback semantics) and
+  :class:`PolicyTable` (per-(op, n-bucket, dtype) resolution with the
+  one-tier-down dtype ladder as default);
+* :mod:`.engine` — the unified IR loop: factor/start/step program
+  factories the Session AOT-compiles (per-execution cost/census
+  crediting, mesh-sharded residual gemms), the host convergence
+  driver, the GMRES-IR strategy over linalg/gmres's cycle, and the
+  per-item-masked ``batched_ir_loop`` the pow2-bucket batched kernels
+  compile.
+
+The serving integration lives in runtime/session.py
+(``register(..., refine=policy)`` keeps the LOW-precision factor
+resident — half the HBM per resident for bf16-from-f32 — and refines
+every solve to growth-scaled working accuracy, falling back to a
+working-precision refactor on non-convergence, counted).
+"""
+
+from .engine import (REFINE_OPS, batched_cte, batched_ir_loop,
+                     convergence_threshold, drive, gmres_solve,
+                     make_factor_fn, make_start_fn, make_step_fn,
+                     solve_refined)
+from .policy import (PolicyTable, RefinePolicy, canonical_dtype_name,
+                     default_factor_dtype, jax_dtype)
+
+__all__ = [
+    "PolicyTable", "RefinePolicy", "REFINE_OPS", "batched_cte",
+    "batched_ir_loop", "canonical_dtype_name", "convergence_threshold",
+    "default_factor_dtype", "drive", "gmres_solve", "jax_dtype",
+    "make_factor_fn", "make_start_fn", "make_step_fn", "solve_refined",
+]

@@ -339,7 +339,12 @@ class Batcher:
         fails ITS OWN future with the per-item info (the SlateError the
         per-request path would have raised); its neighbors' solutions
         are bit-identical to what per-request dispatch produces."""
-        _, op, n, opdt, shape, bdt = key
+        # key = (_SMALL, op, n, op-dtype[, refine-policy], rhs-shape,
+        # rhs-dtype): mixed entries (round 13) carry their RefinePolicy
+        # in the group key so two policies never coalesce — read the
+        # fixed head and tail, tolerate the optional middle
+        op, n = key[1], key[2]
+        shape, bdt = key[-2], key[-1]
         live = [r for r in reqs if not r.future.done()]
         if not live:
             return

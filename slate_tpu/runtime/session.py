@@ -57,11 +57,14 @@ from ..core.types import MatrixKind, Options, DEFAULT_OPTIONS
 from ..linalg.band_packed import PackedBand
 # model-GFLOP formulas live in the ledger (obs/flops.py) — one home
 # shared with bench.py and tester.py instead of a private copy here
+from ..obs import flops as _flops_mod
 from ..obs.flops import LEDGER as _LEDGER
 from ..obs.flops import factor_flops as _factor_flops
 from ..obs.flops import solve_flops as _solve_flops
 from ..obs import costs as _costs
 from ..obs.tracing import Tracer, default_tracer, log as _obs_log
+from ..refine import engine as _refine_engine
+from ..refine.policy import PolicyTable, RefinePolicy
 from .metrics import Metrics
 
 # operator kinds a Session can keep resident. The *_small family
@@ -129,6 +132,15 @@ class _Operator:
     # multi-device grid are factored/solved as sharded AOT programs
     # and their residents charged per-chip; None = single-device
     grid: Optional[ProcessGrid] = None
+    # mixed-precision refinement (round 13, slate_tpu/refine/): the
+    # resident factor is computed/stored at policy.factor_dtype and
+    # every solve refines to working accuracy; None = full precision.
+    # Cleared (with the lo resident evicted) when refinement falls
+    # back — the counted, observable non-convergence path.
+    refine: Optional[RefinePolicy] = None
+    # ‖A‖_inf, computed once at first refined solve (the convergence
+    # constant's norm — gesv_mixed.cc:34-43)
+    anorm: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -173,9 +185,15 @@ class Session:
                  opts: Options = DEFAULT_OPTIONS,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
-                 mesh=None, slo=None):
+                 mesh=None, slo=None,
+                 refine_policies: Optional[PolicyTable] = None):
         self.hbm_budget = hbm_budget
         self.opts = opts
+        # mixed-precision policy table (round 13): register(...,
+        # refine=True) resolves its RefinePolicy here per
+        # (op, n, working dtype); the default table falls back to the
+        # one-tier-down dtype ladder (refine/policy.py)
+        self.refine_policies = refine_policies or PolicyTable()
         # serving mesh: a ProcessGrid or a jax Mesh with ("p", "q")
         # axes; every dense operator registered without an explicit
         # per-operator mesh is sharded over it (mesh docstring above).
@@ -247,7 +265,7 @@ class Session:
     def register(self, A, op: str = "auto",
                  handle: Optional[Hashable] = None,
                  opts: Optional[Options] = None,
-                 mesh=None) -> Hashable:
+                 mesh=None, refine=None) -> Hashable:
         """Register an operator; returns its handle (auto-allocated int
         when not given). ``op``: one of {lu, chol, qr, band_lu,
         band_chol} or "auto" (PackedBand → band_*, Hermitian/Symmetric
@@ -260,7 +278,19 @@ class Session:
         2D-block sharded over the grid at registration and its factor
         stays mesh-resident (module docstring). An operand that
         already carries a multi-device grid is served mesh-native
-        without any mesh argument."""
+        without any mesh argument.
+
+        ``refine`` (round 13): a :class:`~..refine.RefinePolicy`, or
+        ``True`` to resolve one from the session's policy table per
+        (op, n, working dtype). The resident factor is then computed
+        AND STORED at ``policy.factor_dtype`` (a bf16-from-f32
+        resident charges ~half the budget — ~2× residents per HBM
+        byte) and every solve refines to working-precision accuracy
+        through the ``refine/`` engine; non-convergence falls back to
+        a working-precision refactor, counted in
+        ``refine_fallbacks_total``. Covers lu/chol operators (dense —
+        single-device or mesh-sharded — and the *_small batched
+        engine); GMRES-IR strategy is single-device dense only."""
         if op == "auto":
             op = self._infer_op(A)
         if mesh is not None:
@@ -324,6 +354,47 @@ class Session:
                 "Session.register: wide (m < n) operators are not "
                 "servable via resident QR; use least_squares_solve "
                 "per call")
+        policy = None
+        if refine is not None and refine is not False:
+            if op not in ("lu", "chol", "lu_small", "chol_small"):
+                raise SlateError(
+                    f"Session.register: refine covers lu/chol operators "
+                    f"(dense or small), not {op!r}")
+            wd = A.dtype
+            if refine is True:
+                # table resolution keys off the dense op family — a
+                # small operator follows the same (op, n, dtype) rules.
+                # A MATCHED rule whose policy is None is an explicit
+                # full-precision carve-out (PolicyTable.add(None, ...)):
+                # the operator registers unrefined. Only a class no
+                # rule covers falls to the dtype ladder — and only
+                # ladder exhaustion (c64) is the error.
+                from ..refine.policy import default_factor_dtype
+                matched, policy = self.refine_policies.lookup(
+                    op.replace("_small", ""), n, wd)
+                if not matched:
+                    lo = default_factor_dtype(wd)
+                    if lo is None:
+                        raise SlateError(
+                            f"Session.register: no refine policy "
+                            f"resolves for (op={op!r}, n={n}, "
+                            f"dtype={wd}) — no lower factor precision "
+                            "exists on the dtype ladder")
+                    policy = RefinePolicy(factor_dtype=lo)
+            else:
+                policy = refine
+            if policy is not None:
+                try:
+                    policy.validate_for(wd)
+                except ValueError as e:
+                    raise SlateError(f"Session.register: {e}")
+                if policy.strategy == "gmres" and (op in SMALL_OPS
+                                                   or grid is not None):
+                    raise SlateError(
+                        "Session.register: GMRES-IR serving covers "
+                        "single-device dense operators; use "
+                        "strategy='ir' for mesh or small-problem "
+                        "operators")
         with self._lock:
             if handle is None:
                 self._seq += 1
@@ -334,7 +405,7 @@ class Session:
                 raise SlateError(f"Session.register: handle {handle!r} "
                                  "already registered (unregister first)")
             self._ops[handle] = _Operator(A, op, opts or self.opts, m, n,
-                                          band, grid=grid)
+                                          band, grid=grid, refine=policy)
         return handle
 
     @staticmethod
@@ -426,6 +497,25 @@ class Session:
             with self.metrics.phase("serve.factor", "factor_latency",
                                     tracer=self.tracer, **fattrs):
                 res = self._factor(entry, handle)
+                if res.info != 0 and entry.refine is not None:
+                    # the LOW-precision factorization itself failed
+                    # (e.g. SPD in f32, indefinite after bf16
+                    # rounding): a counted refinement fallback — the
+                    # working-precision refactor is the answer path,
+                    # never the garbage factor
+                    self.metrics.inc("refine_fallbacks_total")
+                    _obs_log.warning(
+                        "refine fallback: low-precision (%s) factor of "
+                        "%r failed (info=%d); refactoring at working "
+                        "precision", entry.refine.factor_dtype, handle,
+                        res.info)
+                    if not entry.refine.fallback:
+                        raise SlateError(
+                            f"Session: low-precision factor of "
+                            f"{handle!r} failed (info={res.info}) and "
+                            "the refine policy disables fallback")
+                    entry.refine = None
+                    res = self._factor(entry, handle)
             self.metrics.inc("factors_total")
             fl = _factor_flops(entry.op, entry.m, entry.n, entry.band)
             self.metrics.inc("flops_total", fl)
@@ -463,7 +553,21 @@ class Session:
             # program) — so a cached factor is bit-identical to the
             # slice a batched factor would have produced
             from ..linalg import batched as _batched
-            if op == "lu_small":
+            if entry.refine is not None:
+                # the mixed arm: cast+factor in the policy's dtype
+                # through the SAME bucket programs the grouped mixed
+                # dispatch runs at B=bucket — a cached lo factor is
+                # bit-identical to the slice a batched mixed factor
+                # would have produced (and charges factor-dtype bytes)
+                lo = entry.refine.factor_dtype
+                if op == "lu_small":
+                    lu, perm, info = _batched.getrf_mixed_batched(
+                        A[None], lo)
+                    payload = (lu[0], perm[0])
+                else:
+                    l, info = _batched.potrf_mixed_batched(A[None], lo)
+                    payload = (l[0],)
+            elif op == "lu_small":
                 lu, perm, info = _batched.getrf_batched(A[None])
                 payload = (lu[0], perm[0])
             else:
@@ -491,12 +595,17 @@ class Session:
             # first request (ISSUE 3 satellite).
             key = self._factor_key(entry)
             exe = self._compiled.get(key)
-            if exe is None and entry.grid is not None:
+            if exe is None and (entry.grid is not None
+                                or entry.refine is not None):
                 # mesh discipline: the factor ALWAYS runs as one
                 # analyzed sharded AOT program per shape — the census
                 # and per-chip transient accounting need the compiled
                 # seam, and warmup() may not have covered this shape
-                # (this is the on-request-path compile, counted)
+                # (this is the on-request-path compile, counted).
+                # Round 13 extends the discipline to REFINED entries:
+                # the low-precision factor program is analyzed so its
+                # bytes/census credit per execution (ISSUE 10 —
+                # "through the AOT seam as analyzed programs")
                 exe = self._aot_compile("factor", entry, handle,
                                         self._factor_fn(entry), (A,),
                                         key=key)
@@ -580,6 +689,14 @@ class Session:
             self._program_costs.pop(old, None)
 
     def _factor_fn(self, entry: _Operator):
+        if entry.refine is not None:
+            # the refine engine's cast+factor program (the policy is
+            # part of the key: two operators refined under different
+            # factor dtypes never share a closure)
+            return self._jit_cached(
+                ("factor", entry.op, entry.opts, entry.refine),
+                lambda: _refine_engine.make_factor_fn(
+                    entry.op, entry.opts, entry.refine))
         return self._jit_cached(
             ("factor", entry.op, entry.opts),
             lambda: _make_factor_fn(entry.op, entry.opts))
@@ -588,7 +705,8 @@ class Session:
     def _factor_key(entry: _Operator) -> Hashable:
         leaves, treedef = jax.tree_util.tree_flatten(entry.A)
         shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
-        return ("factor", entry.op, entry.opts, treedef, shapes)
+        return ("factor", entry.op, entry.opts, entry.refine, treedef,
+                shapes)
 
     def _largest_transient(self) -> int:
         """Caller holds the lock. Transient HBM (temp scratch + output
@@ -680,6 +798,9 @@ class Session:
         }
         if entry.grid is not None:
             attrs["mesh"] = f"{entry.grid.p}x{entry.grid.q}"
+        if entry.refine is not None:
+            attrs["factor_dtype"] = entry.refine.factor_dtype
+            attrs["refine_strategy"] = entry.refine.strategy
         return attrs
 
     def solve_matrix(self, handle: Hashable, B: TiledMatrix,
@@ -811,6 +932,13 @@ class Session:
         entry = self._ops.get(handle)
         if entry is None or entry.op not in SMALL_OPS:
             return None
+        if entry.refine is not None:
+            # mixed entries group only with same-policy mixed entries
+            # (the policy is part of the bucket program's identity);
+            # the plain key keeps its 3-tuple shape so existing
+            # consumers see no change
+            return (entry.op, entry.n, str(np.dtype(entry.A.dtype)),
+                    entry.refine)
         return (entry.op, entry.n, str(np.dtype(entry.A.dtype)))
 
     def _solve_small(self, handle: Hashable, entry: _Operator,
@@ -830,6 +958,19 @@ class Session:
                 f"(info={res.info})")
         b2 = np.ascontiguousarray(b2, dtype=np.dtype(entry.A.dtype))
         k = b2.shape[1]
+        if entry.refine is not None:
+            # mixed arm (round 13): one refined B=1 pass through the
+            # SAME bucket programs the grouped mixed dispatch runs at
+            # B=bucket; non-convergence falls back to the plain path
+            # below via a working-precision refactor (counted)
+            x = self._solve_small_refined(handle, entry, res, b2)
+            if x is not None:
+                return x
+            res = self.factor(handle)  # working-precision refactor
+            if res.info != 0:
+                raise SlateError(
+                    f"Session: operator {handle!r} working-precision "
+                    f"fallback factorization failed (info={res.info})")
         tr = self.tracer
         sattrs = (dict(self._span_attrs(entry, handle), k=k,
                        cache_hit=hit) if tr.enabled else {})
@@ -851,6 +992,79 @@ class Session:
         ex = getattr(ph.span, "trace_id", None)
         self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
         self.metrics.observe("stage_device_execute", t2 - t1, exemplar=ex)
+        self.metrics.inc("solves_total", k)
+        self.metrics.inc("dispatches_total")
+        fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
+        self.metrics.inc("flops_total", fl)
+        self.metrics.inc("solve_flops_total", fl)
+        _LEDGER.record("serve.solve", fl)
+        if self.slo is not None:
+            self.slo.record_request(entry.op, entry.n, ph.elapsed,
+                                    ok=True, source="solve")
+        return np.asarray(x[0])
+
+    def _solve_small_refined(self, handle: Hashable, entry: _Operator,
+                             res: _Resident, b2: np.ndarray
+                             ) -> Optional[np.ndarray]:
+        """Caller holds the lock. One refined B=1 solve from the
+        resident LOW-precision factor. Returns the solution, or None
+        after arming the fallback (refine deactivated, lo resident
+        evicted, ``refine_fallbacks_total`` counted) — the caller then
+        reruns the plain path against a working-precision refactor."""
+        from ..linalg import batched as _batched
+        policy = entry.refine
+        a = np.asarray(entry.A)
+        k = b2.shape[1]
+        tr = self.tracer
+        sattrs = (dict(self._span_attrs(entry, handle), k=k)
+                  if tr.enabled else {})
+        with self.metrics.phase("serve.solve", "solve_latency",
+                                tracer=tr, **sattrs) as ph:
+            t0 = time.perf_counter()
+            with tr.span("serve.dispatch"):
+                if entry.op == "lu_small":
+                    lu, perm = res.payload
+                    x, its, conv = _batched.getrs_refined_batched(
+                        a[None], lu[None], perm[None], b2[None],
+                        max_iters=policy.max_iters, tol=policy.tol)
+                else:
+                    x, its, conv = _batched.potrs_refined_batched(
+                        a[None], res.payload[0][None], b2[None],
+                        max_iters=policy.max_iters, tol=policy.tol)
+            t1 = time.perf_counter()
+            with tr.span("serve.block"):
+                x, its, conv = jax.block_until_ready((x, its, conv))
+            t2 = time.perf_counter()
+        iters = int(np.asarray(its)[0])
+        self.metrics.observe("refine_iterations", float(iters))
+        extra = iters * (_flops_mod.gemm(entry.n, k, entry.n)
+                         + _solve_flops(entry.op, entry.m, entry.n, k,
+                                        entry.band))
+        self.metrics.inc("refine_flops_total", extra)
+        self.metrics.inc("flops_total", extra)
+        _LEDGER.record("serve.refine", extra)
+        if not bool(np.asarray(conv)[0]):
+            self.metrics.inc("refine_fallbacks_total")
+            _obs_log.warning(
+                "refine fallback: small operator %r did not converge "
+                "in %d iterations (factor_dtype=%s)", handle,
+                policy.max_iters, policy.factor_dtype)
+            if not policy.fallback:
+                raise SlateError(
+                    f"Session: refined solve of {handle!r} did not "
+                    f"converge in {policy.max_iters} iterations and "
+                    "the refine policy disables fallback")
+            entry.refine = None
+            dropped = self._cache.pop(handle, None)
+            if dropped is not None:
+                self.metrics.inc("evictions")
+                self.metrics.inc("evicted_bytes", dropped.nbytes)
+            return None
+        self.metrics.inc("refine_converged_total")
+        ex = getattr(ph.span, "trace_id", None)
+        self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
+        self.metrics.observe("stage_device_execute", t2 - t1,
+                             exemplar=ex)
         self.metrics.inc("solves_total", k)
         self.metrics.inc("dispatches_total")
         fl = _solve_flops(entry.op, entry.m, entry.n, k, entry.band)
@@ -904,6 +1118,13 @@ class Session:
                     raise SlateError(
                         "solve_small_batched: mixed bucket (op/n/dtype "
                         "must agree across the batch)")
+            pol = entries[0].refine
+            if any(e.refine != pol for e in entries[1:]):
+                # a refine fallback deactivated one handle's policy
+                # between enqueue (lock-free grouping) and dispatch —
+                # rare race; serve the bucket per-request, correctness
+                # over coalescing
+                return self._serve_small_per_request(handles, bs)
             bsz = len(handles)
             tr = self.tracer
             battrs = ({"op": op, "n": n, "batch": bsz, "dtype": str(dt)}
@@ -925,7 +1146,21 @@ class Session:
                                       for h in miss_handles])
                     with tr.span("serve.factor_batched",
                                  batch=len(miss_handles)):
-                        if op == "lu_small":
+                        if pol is not None and op == "lu_small":
+                            lus, perms, infos = \
+                                _batched.getrf_mixed_batched(
+                                    amiss, pol.factor_dtype)
+                            lus, perms, infos = jax.block_until_ready(
+                                (lus, perms, infos))
+                            payloads = [(lus[i], perms[i])
+                                        for i in range(len(miss_handles))]
+                        elif pol is not None:
+                            ls, infos = _batched.potrf_mixed_batched(
+                                amiss, pol.factor_dtype)
+                            ls, infos = jax.block_until_ready((ls, infos))
+                            payloads = [(ls[i],)
+                                        for i in range(len(miss_handles))]
+                        elif op == "lu_small":
                             lus, perms, infos = _batched.getrf_batched(
                                 amiss)
                             lus, perms, infos = jax.block_until_ready(
@@ -937,6 +1172,17 @@ class Session:
                             ls, infos = jax.block_until_ready((ls, infos))
                             payloads = [(ls[i],)
                                         for i in range(len(miss_handles))]
+                    if pol is not None and any(int(v) != 0
+                                               for v in np.asarray(infos)):
+                        # a LOW-precision batched factor failed (e.g.
+                        # SPD goes indefinite under bf16 rounding): do
+                        # NOT cache the bad lo residents — serve the
+                        # bucket per-request, where Session.factor owns
+                        # the counted working-precision fallback (the
+                        # per-request parity contract: a recoverable
+                        # lo-factor failure must not fail futures or
+                        # poison the cache)
+                        return self._serve_small_per_request(handles, bs)
                     ffl = _factor_flops(op, n, n, 0)
                     for h, payload, inf in zip(miss_handles, payloads,
                                                infos):
@@ -979,9 +1225,34 @@ class Session:
                 bstack = np.stack([
                     np.ascontiguousarray(np.asarray(b), dtype=dt)
                     for b in bs])
+                its = conv = None
                 t0 = time.perf_counter()
                 with tr.span("serve.dispatch", batch=bsz):
-                    if op == "lu_small":
+                    if pol is not None:
+                        # mixed bucket: ONE batched refined solve over
+                        # the stacked LOW-precision residents, per-item
+                        # convergence masks (refine/engine); the
+                        # working-precision operands feed the residual
+                        # gemms
+                        astack = np.stack([np.asarray(e.A)
+                                           for e in entries])
+                        if op == "lu_small":
+                            x, its, conv = _batched.getrs_refined_batched(
+                                astack,
+                                jnp.stack([r.payload[0]
+                                           for r in res_list]),
+                                jnp.stack([r.payload[1]
+                                           for r in res_list]),
+                                bstack, max_iters=pol.max_iters,
+                                tol=pol.tol)
+                        else:
+                            x, its, conv = _batched.potrs_refined_batched(
+                                astack,
+                                jnp.stack([r.payload[0]
+                                           for r in res_list]),
+                                bstack, max_iters=pol.max_iters,
+                                tol=pol.tol)
+                    elif op == "lu_small":
                         x = _batched.getrs_batched(
                             jnp.stack([r.payload[0] for r in res_list]),
                             jnp.stack([r.payload[1] for r in res_list]),
@@ -995,11 +1266,67 @@ class Session:
                     x = jax.block_until_ready(x)
                 t2 = time.perf_counter()
                 programs += 1
+                if pol is not None:
+                    # np.array (writable copy), not asarray: the
+                    # per-item fallback below splices lanes in place
+                    x, its, conv = (np.array(x), np.asarray(its),
+                                    np.asarray(conv))
+                    for i in range(bsz):
+                        self.metrics.observe("refine_iterations",
+                                             float(its[i]))
+                    kk = bstack.shape[2] if bstack.ndim == 3 else 1
+                    extra = float(its.sum()) * (
+                        _flops_mod.gemm(n, kk, n)
+                        + _solve_flops(op, n, n, kk, 0))
+                    self.metrics.inc("refine_flops_total", extra)
+                    self.metrics.inc("flops_total", extra)
+                    _LEDGER.record("serve.refine", extra)
+                    self.metrics.inc(
+                        "refine_converged_total",
+                        int(conv.sum()))
+                    for i in range(bsz):
+                        if conv[i] or infos_req[i] != 0:
+                            continue
+                        # per-item fallback: deactivate refinement for
+                        # this handle, evict its lo resident, refactor
+                        # at working precision, re-solve item i alone —
+                        # its bucket neighbors' lanes are untouched
+                        h = handles[i]
+                        e = self._ops[h]
+                        self.metrics.inc("refine_fallbacks_total")
+                        _obs_log.warning(
+                            "refine fallback: grouped small operator %r "
+                            "did not converge in %d iterations", h,
+                            pol.max_iters)
+                        if not pol.fallback:
+                            raise SlateError(
+                                f"Session: refined solve of {h!r} did "
+                                "not converge and the refine policy "
+                                "disables fallback")
+                        if e.refine is not None:
+                            e.refine = None
+                            dropped = self._cache.pop(h, None)
+                            if dropped is not None:
+                                self.metrics.inc("evictions")
+                                self.metrics.inc("evicted_bytes",
+                                                 dropped.nbytes)
+                        res_i = self.factor(h)
+                        infos_req[i] = res_i.info
+                        if res_i.info != 0:
+                            continue
+                        if op == "lu_small":
+                            lu_i, perm_i = res_i.payload
+                            xi = _batched.getrs_batched(
+                                lu_i[None], perm_i[None], bstack[i][None])
+                        else:
+                            xi = _batched.potrs_batched(
+                                res_i.payload[0][None], bstack[i][None])
+                        x[i] = np.asarray(jax.block_until_ready(xi))[0]
             ex = getattr(ph.span, "trace_id", None)
             self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
             self.metrics.observe("stage_device_execute", t2 - t1,
                                  exemplar=ex)
-            k = bstack.shape[2]
+            k = bstack.shape[2] if bstack.ndim == 3 else 1
             bucket = _batched.batch_bucket(bsz)
             self.metrics.inc("solves_total", bsz * k)
             self.metrics.inc("dispatches_total")
@@ -1030,6 +1357,33 @@ class Session:
                                             ok=(inf == 0), source="solve")
             return np.asarray(x), infos_req
 
+    def _serve_small_per_request(self, handles: List[Hashable],
+                                 bs: List) -> Tuple[np.ndarray, List[int]]:
+        """Caller holds the lock. Degraded grouped dispatch: each
+        request through the per-request path — correctness over
+        coalescing, used when the one-program pass is unsafe (a
+        stale-policy race after a refine fallback, or a failed
+        low-precision batched factor whose lanes must take the
+        per-request fallback instead of being cached). Per-item
+        isolation: an item whose own solve fails carries its nonzero
+        info; neighbors are served normally."""
+        xs, infos = [], []
+        for h, b in zip(handles, bs):
+            e = self._ops[h]
+            b2 = np.ascontiguousarray(np.asarray(b),
+                                      dtype=np.dtype(e.A.dtype))
+            if b2.ndim == 1:
+                b2 = b2[:, None]
+            try:
+                xs.append(self._solve_small(h, e, b2))
+                infos.append(0)
+            except SlateError:
+                res = self._cache.get(h)
+                infos.append(int(res.info) if res is not None
+                             and res.info else 1)
+                xs.append(np.zeros_like(b2))
+        return np.stack(xs), infos
+
     def _wrap_rhs(self, entry: _Operator, b2: np.ndarray):
         dtype = (entry.A.dtype if not isinstance(entry.A, PackedBand)
                  else entry.A.ab.dtype)
@@ -1056,6 +1410,9 @@ class Session:
         shapes, dtype, mesh) — the mesh is part of the key via the
         operand treedefs), so every served mesh solve executes an
         analyzed program and credits its collective census."""
+        if entry.refine is not None:
+            return self._dispatch_refined(entry, res, B, handle,
+                                          served_cols=served_cols)
         fn = self._solve_fn(entry)
         key = self._aot_key(entry, res.payload, B)
         exe = self._compiled.get(key)
@@ -1077,6 +1434,131 @@ class Session:
         return self._jit_cached(
             (entry.op, entry.opts),
             lambda: _make_solve_fn(entry.op, entry.opts))
+
+    # -- mixed-precision refined dispatch (round 13, slate_tpu/refine/) ----
+
+    def _refine_exe(self, entry: _Operator, handle: Hashable, what: str,
+                    args: Tuple):
+        """AOT-compiled refine ``start``/``step`` program for these
+        argument shapes → (exe, key). ALWAYS through the ``_aot_compile``
+        seam (like mesh entries): every refined solve executes analyzed
+        programs, so bytes/census credit per execution and the budget
+        sees the programs' transients."""
+        policy = entry.refine
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = (f"refine.{what}", entry.op, entry.opts, policy, treedef,
+               shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            work = entry.A.dtype
+            make = (_refine_engine.make_start_fn if what == "start"
+                    else _refine_engine.make_step_fn)
+            fn = self._jit_cached(
+                (f"refine.{what}", entry.op, entry.opts, policy),
+                lambda: make(entry.op, entry.opts, policy, work))
+            exe = self._aot_compile(f"refine_{what}", entry, handle, fn,
+                                    args, key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        return exe, key
+
+    def _dispatch_refined(self, entry: _Operator, res: _Resident, B,
+                          handle: Hashable = None,
+                          served_cols: Optional[int] = None):
+        """Serve one solve from the LOW-precision resident: initial lo
+        solve + the refine engine's convergence loop over analyzed
+        start/step programs (classic IR) or the GMRES-IR cycle. Emits
+        ``refine.*`` spans nested under the solve span, observes the
+        per-solve iteration count, splits the ledger useful-vs-
+        refinement (``served_cols`` — the Batcher's pow2 width padding
+        — splits the programs' bytes to ``padding.waste`` exactly like
+        the plain dispatch), and turns non-convergence into the counted
+        fallback: evict the lo resident, refactor at working precision
+        through the normal path, re-dispatch — never a wrong answer."""
+        policy = entry.refine
+        tr = self.tracer
+        k = int(B.shape[1])
+        wf = (0.0 if served_cols is None or not k
+              else (k - int(served_cols)) / k)
+        if entry.anorm is None:
+            from ..core.types import Norm
+            from ..linalg.norms import norm as _norm
+            entry.anorm = float(_norm(entry.A, Norm.Inf))
+        if policy.strategy == "gmres":
+            with tr.span("refine.gmres", max_iters=policy.max_iters):
+                X, iters, converged = _refine_engine.gmres_solve(
+                    entry.A, B, res.payload, entry.op, policy,
+                    entry.opts)
+        else:
+            start_exe, start_key = self._refine_exe(
+                entry, handle, "start", (res.payload, B))
+            state = {}
+
+            def start_call(payload, B_):
+                with tr.span("refine.start"):
+                    X0 = start_exe(payload, B_)
+                self._credit_program(start_key, "serve.solve",
+                                     waste_fraction=wf)
+                return X0
+
+            def step_call(payload, A_, B_, X_):
+                exe = state.get("exe")
+                if exe is None:
+                    exe, skey = self._refine_exe(
+                        entry, handle, "step", (payload, A_, B_, X_))
+                    state["exe"], state["key"] = exe, skey
+                with tr.span("refine.step"):
+                    out = exe(payload, A_, B_, X_)
+                self._credit_program(state["key"], "serve.refine",
+                                     waste_fraction=wf)
+                return out
+
+            X, iters, converged = _refine_engine.drive(
+                start_call, step_call, res.payload, entry.A, B,
+                entry.anorm, policy, entry.A.dtype)
+        self.metrics.observe("refine_iterations", float(iters))
+        # refinement-overhead model flops: iters residual gemms plus
+        # iters factor applies (the useful one-solve model stays on
+        # serve.solve — ledger split, ISSUE 10 observability)
+        extra = iters * (_flops_mod.gemm(entry.n, k, entry.n)
+                         + _solve_flops(entry.op, entry.m, entry.n, k,
+                                        entry.band))
+        self.metrics.inc("refine_flops_total", extra)
+        self.metrics.inc("flops_total", extra)
+        _LEDGER.record("serve.refine", extra)
+        if converged:
+            self.metrics.inc("refine_converged_total")
+            return X
+        self.metrics.inc("refine_fallbacks_total")
+        _obs_log.warning(
+            "refine fallback: %r did not converge in %d iterations "
+            "(factor_dtype=%s, strategy=%s); refactoring at working "
+            "precision", handle, policy.max_iters, policy.factor_dtype,
+            policy.strategy)
+        if tr.enabled:
+            with tr.span("refine.fallback", handle=repr(handle),
+                         iters=iters):
+                pass
+        if not policy.fallback:
+            raise SlateError(
+                f"Session: refined solve of {handle!r} did not converge "
+                f"in {policy.max_iters} iterations and the refine "
+                "policy disables fallback")
+        entry.refine = None
+        dropped = self._cache.pop(handle, None)
+        if dropped is not None:
+            self.metrics.inc("evictions")
+            self.metrics.inc("evicted_bytes", dropped.nbytes)
+        res2 = self.factor(handle)
+        if res2.info != 0:
+            raise SlateError(
+                f"Session: operator {handle!r} working-precision "
+                f"fallback factorization failed (info={res2.info})")
+        return self._dispatch(entry, res2, B, handle,
+                              served_cols=served_cols)
 
     @staticmethod
     def _aot_key(entry: _Operator, payload, B) -> Hashable:
@@ -1113,7 +1595,21 @@ class Session:
                     b0 = np.zeros((entry.n, nrhs),
                                   dtype=np.dtype(entry.A.dtype))
                     with _batched.suppress_accounting():
-                        if entry.op == "lu_small":
+                        if entry.refine is not None:
+                            a0 = np.asarray(entry.A)
+                            pol = entry.refine
+                            if entry.op == "lu_small":
+                                lu, perm = res.payload
+                                _batched.getrs_refined_batched(
+                                    a0[None], lu[None], perm[None],
+                                    b0[None], max_iters=pol.max_iters,
+                                    tol=pol.tol)
+                            else:
+                                _batched.potrs_refined_batched(
+                                    a0[None], res.payload[0][None],
+                                    b0[None], max_iters=pol.max_iters,
+                                    tol=pol.tol)
+                        elif entry.op == "lu_small":
                             lu, perm = res.payload
                             _batched.getrs_batched(lu[None], perm[None],
                                                    b0[None])
@@ -1133,6 +1629,23 @@ class Session:
             res = self.factor(handle)
             B = self._wrap_rhs(
                 entry, np.zeros((entry.m, nrhs)))
+            if entry.refine is not None:
+                if entry.refine.strategy == "gmres":
+                    # the GMRES-IR cycle jit-caches itself
+                    # (linalg/gmres._fgmres_cycle); factoring above was
+                    # the warmup
+                    return
+                # refined entries serve through the start/step
+                # programs: compile both off the request path (the
+                # start's probe output supplies the step's X shapes;
+                # its execution credits nothing — only the explicit
+                # _credit_program calls on the serving path do)
+                start_exe, _ = self._refine_exe(entry, handle, "start",
+                                                (res.payload, B))
+                X0 = start_exe(res.payload, B)
+                self._refine_exe(entry, handle, "step",
+                                 (res.payload, entry.A, B, X0))
+                return
             key = self._aot_key(entry, res.payload, B)
             if key in self._compiled:
                 return
@@ -1176,11 +1689,19 @@ class Session:
         pc = _costs.program_costs(exe)
         if key is not None:
             self._program_costs[key] = pc
-        model_fl = (_factor_flops(entry.op, entry.m, entry.n, entry.band)
-                    if what == "factor" else
-                    _solve_flops(entry.op, entry.m, entry.n,
-                                 shapes[-1][1] if shapes and
-                                 len(shapes[-1]) > 1 else 1, entry.band))
+        kk = (shapes[-1][1] if shapes and len(shapes[-1]) > 1 else 1)
+        if what == "factor":
+            model_fl = _factor_flops(entry.op, entry.m, entry.n,
+                                     entry.band)
+        elif what == "refine_step":
+            # one refinement step: the working-precision residual gemm
+            # plus one low-precision factor apply
+            model_fl = (_flops_mod.gemm(entry.n, kk, entry.n)
+                        + _solve_flops(entry.op, entry.m, entry.n, kk,
+                                       entry.band))
+        else:
+            model_fl = _solve_flops(entry.op, entry.m, entry.n, kk,
+                                    entry.band)
         self.cost_log.append({
             "op": entry.op, "what": what, "shape": shapes,
             "model_flops": model_fl, **pc.to_dict(),

@@ -239,6 +239,43 @@ def _aasen_growth(LT, a):
                / max(np.linalg.norm(an, 1), 1e-300))
 
 
+def _chol_growth(L, a):
+    """‖L‖₁‖Lᴴ‖₁/‖A‖₁ growth of a (low-precision) Cholesky factor —
+    the mixed rows' bound normalization (round 13, ROADMAP item 2):
+    the refined solution's backward error is bounded through the
+    LOW-precision factor's realized norms, so the denominator must
+    carry them — a flat tol was blind to exactly the factor-precision
+    loss the refinement has to recover."""
+    l = np.tril(_np64(L.dense_canonical() if hasattr(L, "dense_canonical")
+                      else L))
+    an = _np64(a)
+    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(l.conj().T, 1)
+               / max(np.linalg.norm(an, 1), 1e-300))
+
+
+def _lu_growth_arr(lu, a):
+    """_lu_growth over a packed LU ARRAY (one item of a batched lo
+    factor stack)."""
+    lu = _np64(lu)
+    n = lu.shape[0]
+    l = np.tril(lu, -1) + np.eye(n)
+    u = np.triu(lu)
+    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(u, 1)
+               / max(np.linalg.norm(_np64(a), 1), 1e-300))
+
+
+def _mixed_factor_dtype(ctx):
+    """One tier below the sweep's working dtype (the refine/policy
+    ladder: f32→bf16, f64→f32, c128→c64) so the mixed rows exercise a
+    GENUINELY lower factor precision. None where no lower precision
+    exists (c64) — the eager rows then keep the drivers' historical
+    default, the batched rows pass the working dtype explicitly (the
+    trivial path), and the growth scale collapses to 1."""
+    from slate_tpu.refine import default_factor_dtype, jax_dtype
+    lo = default_factor_dtype(ctx.dtype)
+    return jax_dtype(lo) if lo is not None else None
+
+
 def _prod_err(ctx, got, ref, lhs, rhs):
     """LAPACK-style product bound ‖got−ref‖/(ε·k·‖lhs‖·‖rhs‖) — the
     test_gemm.cc-family denominator. Scaling by ‖ref‖ instead (the
@@ -496,28 +533,98 @@ def _t_potri(ctx):
     return secs, err
 
 
-@register("posv_mixed", flops=_fl("posv_mixed"), tol=30)
-def _t_posv_mixed(ctx):
+def _posv_mixed_case(ctx, solver, k=2):
+    """Shared mixed-Cholesky row body: factor one tier below the sweep
+    dtype (_mixed_factor_dtype), bound growth-scaled by the
+    LOW-precision factor's ‖L‖‖Lᴴ‖/‖A‖ (round 13 — the flat tol=30
+    bound kept the mixed rows blind to the factor-precision loss the
+    refinement must recover; now a refinement regression cannot hide
+    behind the denominator)."""
     import slate_tpu as st
     n = ctx.n
     a = ctx.spd(n)
     A = ctx.herm(a)
-    b = ctx.gen("randn", n, 2, 1)
+    b = ctx.gen("randn", n, k, 1)
     B = ctx.dense(b)
-    (X, info, iters), secs = ctx.timed(lambda: st.posv_mixed(A, B))
-    return secs, _solve_err(ctx, a, X.to_numpy(), b)
+    fd = _mixed_factor_dtype(ctx)
+    kw = {} if fd is None else {"factor_dtype": fd}
+    (X, info, iters), secs = ctx.timed(lambda: solver(st, A, B, **kw))
+    growth = 1.0
+    if fd is not None:
+        from slate_tpu.linalg import elementwise as _ew
+        L_lo, info_lo = st.potrf(_ew.copy(A, dtype=fd))
+        if int(info_lo) == 0:
+            growth = _chol_growth(L_lo, a)
+    return secs, _solve_err(ctx, a, X.to_numpy(), b) / growth
 
 
-@register("posv_mixed_gmres", flops=_fl("posv_mixed_gmres"), tol=30)
-def _t_posv_gmres(ctx):
+register("posv_mixed", flops=_fl("posv_mixed"), tol=30)(
+    lambda ctx: _posv_mixed_case(
+        ctx, lambda st, A, B, **kw: st.posv_mixed(A, B, **kw)))
+register("posv_mixed_gmres", flops=_fl("posv_mixed_gmres"), tol=30)(
+    lambda ctx: _posv_mixed_case(
+        ctx, lambda st, A, B, **kw: st.posv_mixed_gmres(A, B, **kw),
+        k=1))
+
+
+@register("posv_mixed_batched", flops=_fl("posv_mixed_batched"), tol=30)
+def _t_posv_mixed_batched(ctx):
+    """Round 13: the batched mixed engine — a B=4 SPD stack through
+    ONE bucket program (lo Cholesky + per-item-masked IR,
+    refine/engine.batched_ir_loop); worst per-item error, each
+    growth-scaled by its own low-precision factor."""
     import slate_tpu as st
+    from slate_tpu.linalg import batched as lb
+    n = ctx.n
+    bsz = 4
+    a = np.stack([np.asarray(ctx.spd(n, ds=i)) for i in range(bsz)])
+    b = np.stack([np.asarray(ctx.gen("randn", n, 2, 10 + i))
+                  for i in range(bsz)])
+    fd = _mixed_factor_dtype(ctx)
+    # no lower dtype on the ladder (c64/bf16 sweeps): pass the working
+    # dtype explicitly — the batched verbs' ladder default would raise
+    # by design, and lo == working is the exact trivial path
+    kw = {"factor_dtype": fd if fd is not None else ctx.dtype}
+    (X, info, iters), secs = ctx.timed(
+        lambda: st.posv_mixed_batched(a, b, **kw))
+    x = np.asarray(X)
+    l_lo, _ = lb.potrf_mixed_batched(a, fd if fd is not None
+                                     else ctx.dtype)
+    errs = []
+    for i in range(bsz):
+        growth = _chol_growth(np.asarray(l_lo[i]), a[i])
+        errs.append(_solve_err(ctx, a[i], x[i], b[i]) / growth)
+    return secs, max(errs)
+
+
+@register("posv_mixed_served", flops=_fl("posv_mixed_served"), tol=30)
+def _t_posv_mixed_served(ctx):
+    """Round 13: the mixed SERVING path — a Session keeps the
+    low-precision Cholesky resident (refine/) and refines each solve
+    to working accuracy; the timed call is one warm served solve.
+    Growth-scaled like every mixed row."""
+    import slate_tpu as st
+    from slate_tpu.refine import RefinePolicy, default_factor_dtype
+    from slate_tpu.runtime import Session
     n = ctx.n
     a = ctx.spd(n)
     A = ctx.herm(a)
-    b = ctx.gen("randn", n, 1, 1)
-    B = ctx.dense(b)
-    (X, info, iters), secs = ctx.timed(lambda: st.posv_mixed_gmres(A, B))
-    return secs, _solve_err(ctx, a, X.to_numpy(), b)
+    b = np.asarray(ctx.gen("randn", n, 2, 1))
+    lo = default_factor_dtype(ctx.dtype)
+    sess = Session()
+    h = sess.register(
+        A, op="chol",
+        refine=RefinePolicy(factor_dtype=lo) if lo else None)
+    sess.warmup(h, nrhs=2)
+    x, secs = ctx.timed(lambda: sess.solve(h, b))
+    growth = 1.0
+    if lo is not None:
+        from slate_tpu.linalg import elementwise as _ew
+        from slate_tpu.refine import jax_dtype
+        L_lo, info_lo = st.potrf(_ew.copy(A, dtype=jax_dtype(lo)))
+        if int(info_lo) == 0:
+            growth = _chol_growth(L_lo, a)
+    return secs, _solve_err(ctx, a, x, b) / growth
 
 
 # -- LU family --------------------------------------------------------------
@@ -591,12 +698,93 @@ def _gesv_calu(st, A, B):
 
 register("gesv_tntpiv", flops=_fl("gesv_tntpiv"))(
     lambda ctx: _lu_solver_case(ctx, _gesv_calu))
+def _gesv_mixed_case(ctx, solver):
+    """Shared mixed-LU row body: one-tier-down factor dtype, bound
+    growth-scaled by the LOW-precision factor's ‖L‖‖U‖/‖A‖ (round 13 —
+    see _posv_mixed_case)."""
+    import slate_tpu as st
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    A = ctx.dense(a)
+    b = ctx.gen("randn", n, 8, 1)
+    B = ctx.dense(b)
+    fd = _mixed_factor_dtype(ctx)
+    kw = {} if fd is None else {"factor_dtype": fd}
+    (X, info, iters), secs = ctx.timed(lambda: solver(st, A, B, **kw))
+    growth = 1.0
+    if fd is not None:
+        from slate_tpu.linalg import elementwise as _ew
+        LU_lo, _, info_lo = st.getrf(_ew.copy(A, dtype=fd))
+        if int(info_lo) == 0:
+            growth = _lu_growth(LU_lo, a)
+    return secs, _solve_err(ctx, a, X.to_numpy(), b) / growth
+
+
 register("gesv_mixed", flops=_fl("gesv_mixed"), tol=30)(
-    lambda ctx: _lu_solver_case(
-        ctx, lambda st, A, B: st.gesv_mixed(A, B)[0]))
+    lambda ctx: _gesv_mixed_case(
+        ctx, lambda st, A, B, **kw: st.gesv_mixed(A, B, **kw)))
 register("gesv_mixed_gmres", flops=_fl("gesv_mixed_gmres"), tol=30)(
-    lambda ctx: _lu_solver_case(
-        ctx, lambda st, A, B: st.gesv_mixed_gmres(A, B)[0]))
+    lambda ctx: _gesv_mixed_case(
+        ctx, lambda st, A, B, **kw: st.gesv_mixed_gmres(A, B, **kw)))
+
+
+@register("gesv_mixed_batched", flops=_fl("gesv_mixed_batched"), tol=30)
+def _t_gesv_mixed_batched(ctx):
+    """Round 13: batched mixed LU — a B=4 diagonally-boosted stack
+    through ONE bucket program (lo LU + per-item-masked IR); worst
+    per-item error, growth-scaled per item."""
+    import slate_tpu as st
+    from slate_tpu.linalg import batched as lb
+    n = ctx.n
+    bsz = 4
+    a = np.stack([np.asarray(ctx.gen("randn", n, n, i))
+                  for i in range(bsz)])
+    a = a + n * np.eye(n, dtype=a.dtype)
+    b = np.stack([np.asarray(ctx.gen("randn", n, 2, 10 + i))
+                  for i in range(bsz)])
+    fd = _mixed_factor_dtype(ctx)
+    # ladder-less sweeps (c64/bf16): explicit working-dtype factor —
+    # the verbs' ladder default raises by design (see _posv sibling)
+    kw = {"factor_dtype": fd if fd is not None else ctx.dtype}
+    (X, info, iters), secs = ctx.timed(
+        lambda: st.gesv_mixed_batched(a, b, **kw))
+    x = np.asarray(X)
+    lu_lo, _, _ = lb.getrf_mixed_batched(a, fd if fd is not None
+                                         else ctx.dtype)
+    errs = []
+    for i in range(bsz):
+        growth = _lu_growth_arr(np.asarray(lu_lo[i]), a[i])
+        errs.append(_solve_err(ctx, a[i], x[i], b[i]) / growth)
+    return secs, max(errs)
+
+
+@register("gesv_mixed_served", flops=_fl("gesv_mixed_served"), tol=30)
+def _t_gesv_mixed_served(ctx):
+    """Round 13: the mixed LU SERVING path (Session + refine/ — the
+    low-precision resident refines each solve; non-convergence takes
+    the counted working-precision fallback, so the row stays correct
+    either way)."""
+    import slate_tpu as st
+    from slate_tpu.refine import RefinePolicy, default_factor_dtype
+    from slate_tpu.runtime import Session
+    n = ctx.n
+    a = ctx.gen("randn", n, n)
+    A = ctx.dense(a)
+    b = np.asarray(ctx.gen("randn", n, 8, 1))
+    lo = default_factor_dtype(ctx.dtype)
+    sess = Session()
+    h = sess.register(
+        A, op="lu", refine=RefinePolicy(factor_dtype=lo) if lo else None)
+    sess.warmup(h, nrhs=8)
+    x, secs = ctx.timed(lambda: sess.solve(h, b))
+    growth = 1.0
+    if lo is not None:
+        from slate_tpu.linalg import elementwise as _ew
+        from slate_tpu.refine import jax_dtype
+        LU_lo, _, info_lo = st.getrf(_ew.copy(A, dtype=jax_dtype(lo)))
+        if int(info_lo) == 0:
+            growth = _lu_growth(LU_lo, a)
+    return secs, _solve_err(ctx, a, x, b) / growth
 
 
 @register("getri", flops=_fl("getri"))

@@ -137,6 +137,53 @@ def _multichip_artifact(solves=300.0, speedup=0.1):
     }
 
 
+def _serve_mixed_artifact(solves=100.0, speedup=0.2):
+    return {
+        "bench": "serve_mixed", "platform": "cpu",
+        "dtype": "float32", "factor_dtype": "bfloat16", "ok": True,
+        "rows": [{
+            "op": "chol", "n": 128, "nb": 32, "requests": 32,
+            "dtype": "float32", "factor_dtype": "bfloat16", "ok": True,
+            "mixed": {"wall_s": 0.3, "solves_per_sec": solves,
+                      "iters_mean": 3.0, "factor_bytes": 32768,
+                      "residents_within_budget": 6},
+            "full": {"wall_s": 0.06, "solves_per_sec": solves / speedup,
+                     "factor_bytes": 65536,
+                     "residents_within_budget": 3},
+            "speedup": speedup, "factor_bytes_ratio": 0.5,
+            "residents_ratio": 2.0, "refine_fallbacks": 0,
+        }],
+    }
+
+
+def test_normalize_serve_mixed_rows(tmp_path):
+    """Round 13: the BENCH_MIXED_r*.json mixed-serving A/B — one
+    serve_mixed record per row, series keyed (op, n, dtype); the
+    structural residents_ratio rides as a tracked metric beside the
+    solves/sec pair."""
+    _write(tmp_path, "BENCH_MIXED_r01.json", _serve_mixed_artifact())
+    (rec,) = gate_mod.normalize_all(
+        str(tmp_path / "BENCH_MIXED_r01.json"))
+    assert rec["kind"] == "serve_mixed" and rec["round"] == 1
+    assert rec["op"] == "chol" and rec["n"] == 128
+    assert rec["dtype"] == "float32"
+    assert rec["metrics"]["mixed.solves_per_sec"] == 100.0
+    assert rec["metrics"]["full.solves_per_sec"] == 500.0
+    assert rec["metrics"]["residents_ratio"] == 2.0
+    # single-object normalize() redirects to normalize_all
+    with pytest.raises(gate_mod.SchemaError, match="normalize_all"):
+        gate_mod.normalize(str(tmp_path / "BENCH_MIXED_r01.json"))
+    # a row missing the structural ratio fails schema validation
+    bad = _serve_mixed_artifact()
+    del bad["rows"][0]["factor_bytes_ratio"]
+    _write(tmp_path, "BENCH_MIXED_r02.json", bad)
+    assert gate_mod.check_schema(
+        [str(tmp_path / "BENCH_MIXED_r02.json")])
+    # discovery picks the family up beside the other artifacts
+    assert any(p.endswith("BENCH_MIXED_r01.json")
+               for p in gate_mod.discover(str(tmp_path)))
+
+
 def test_normalize_structured_multichip_rows(tmp_path):
     _write(tmp_path, "MULTICHIP_r06.json", _multichip_artifact())
     (rec,) = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r06.json"))

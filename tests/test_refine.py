@@ -1,0 +1,664 @@
+"""Mixed-precision resident factors + iterative-refinement serving
+(round 13, ISSUE 10 — slate_tpu/refine/).
+
+The acceptance surface: served mixed solves meet the growth-scaled
+working-precision bounds across f32/f64 (c128→c64 for the complex
+pair) on single-device AND the 8-device mesh; a forced non-convergent
+system falls back to a working-precision refactor, returns a correct
+solve, and increments ``refine_fallbacks_total``; a bf16-factored
+resident charges ~half the f32 factor bytes and a budget sized for N
+f32 residents holds ~2N bf16 residents; the batched mixed bucket at
+B=1 is bit-identical to the per-request mixed path.
+
+Compile budget: the mesh session is module-scoped (sharded AOT
+compiles amortized); the heavier convergence sweeps are ``-m slow``
+with a cheap tier-1 sibling pin per class (tier-1 satellite).
+"""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.linalg import batched as lb
+from slate_tpu.refine import (PolicyTable, RefinePolicy,
+                              default_factor_dtype, solve_refined)
+from slate_tpu.runtime import Batcher, Session
+
+RNG = np.random.default_rng(41)
+N, NB = 48, 16
+
+
+def _spd(n=N, dtype=np.float32, seed=None):
+    rng = RNG if seed is None else np.random.default_rng(seed)
+    a = rng.standard_normal((n, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+        return (a @ a.conj().T + n * np.eye(n)).astype(dtype)
+    return (a @ a.T + n * np.eye(n)).astype(dtype)
+
+
+def _diagdom(n=N, dtype=np.float32, seed=None):
+    rng = RNG if seed is None else np.random.default_rng(seed)
+    a = rng.standard_normal((n, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    return (a + n * np.eye(n)).astype(dtype)
+
+
+def _scaled_err(a, x, b):
+    """The tester's growth-agnostic scaled backward error
+    ‖b−Ax‖/(ε·n·‖A‖·‖x‖) in f64/c128 — served mixed solves must meet
+    the same ≤ 30 bound the tester's mixed rows register."""
+    a64 = np.asarray(a, dtype=np.complex128 if np.iscomplexobj(a)
+                     else np.float64)
+    x64 = np.asarray(x, dtype=a64.dtype)
+    b64 = np.asarray(b, dtype=a64.dtype)
+    eps = float(np.finfo(np.asarray(a).dtype).eps)
+    num = np.linalg.norm(b64 - a64 @ x64, 1)
+    den = eps * a64.shape[1] * np.linalg.norm(a64, 1) * max(
+        np.linalg.norm(x64, 1), 1e-300)
+    return float(num / max(den, 1e-300))
+
+
+# -- policy -----------------------------------------------------------------
+
+
+def test_dtype_ladder():
+    assert default_factor_dtype("float32") == "bfloat16"
+    assert default_factor_dtype("float64") == "float32"
+    assert default_factor_dtype("complex128") == "complex64"
+    assert default_factor_dtype("complex64") is None
+
+
+def test_policy_validation_and_hashability():
+    pol = RefinePolicy(factor_dtype="bfloat16")
+    assert hash(pol) == hash(RefinePolicy(factor_dtype="bfloat16"))
+    with pytest.raises(ValueError):  # factor dtype == working dtype
+        pol.validate_for("bfloat16")
+    with pytest.raises(ValueError):
+        RefinePolicy(factor_dtype="float32").validate_for("complex64")
+    with pytest.raises(ValueError):
+        RefinePolicy(strategy="nope")
+    with pytest.raises(ValueError):
+        RefinePolicy(max_iters=0)
+
+
+def test_policy_table_first_match_and_default():
+    t = PolicyTable()
+    t.add(None, op="lu", n_max=64)          # explicit full-precision hole
+    t.add(RefinePolicy(factor_dtype="bfloat16", max_iters=7), op="lu")
+    assert t.resolve("lu", 32, "float32") is None
+    assert t.resolve("lu", 128, "float32").max_iters == 7
+    # no rule -> ladder default; c64 has no ladder entry
+    assert t.resolve("chol", 32, "float32").factor_dtype == "bfloat16"
+    assert t.resolve("chol", 32, "complex64") is None
+
+
+# -- engine (eager) ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", ["ir", "gmres"])
+def test_engine_solve_refined_lu(strategy):
+    a = _diagdom(seed=1)
+    A = st.from_dense(a, nb=NB)
+    b = RNG.standard_normal((N, 2)).astype(np.float32)
+    B = st.from_dense(b, nb=NB)
+    X, info, iters, conv = solve_refined(
+        A, B, op="lu",
+        policy=RefinePolicy(factor_dtype="bfloat16", strategy=strategy))
+    assert info == 0 and conv and iters >= 1
+    assert _scaled_err(a, X.to_numpy(), b) < 30
+
+
+def test_engine_solve_refined_chol_f64():
+    spd = _spd(dtype=np.float64, seed=2)
+    A = st.hermitian(np.tril(spd), nb=NB, uplo=st.Uplo.Lower)
+    b = np.ones((N, 1))
+    X, info, iters, conv = solve_refined(
+        A, st.from_dense(b, nb=NB), op="chol",
+        policy=RefinePolicy(factor_dtype="float32"))
+    assert info == 0 and conv
+    assert _scaled_err(spd, X.to_numpy(), b) < 30
+
+
+# -- batched mixed drivers --------------------------------------------------
+
+
+# batched tests run at n=32 (= the single-panel small-problem regime,
+# default_nb): the fused mixed bucket kernels at multi-panel n compile
+# whole-IR-loop graphs that cost minutes of tier-1 budget on this
+# host; the multi-panel arm is covered by the slow cross-bucket sweep
+BN = 32
+
+
+def test_batched_mixed_correctness_and_per_item_info():
+    bsz = 5
+    a = np.stack([_diagdom(n=BN, seed=10 + i) for i in range(bsz)])
+    b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
+    a_bad = a.copy()
+    a_bad[3] = 0.0  # singular item: flags itself, neighbors untouched
+    x, info, iters = st.gesv_mixed_batched(a_bad, b, fallback=False)
+    info = np.asarray(info)
+    assert info[3] > 0 and (info[np.arange(bsz) != 3] == 0).all()
+    x, info, iters = st.gesv_mixed_batched(a, b)
+    assert (np.asarray(info) == 0).all()
+    assert (np.asarray(iters) > 0).all()
+    for i in range(bsz):
+        assert _scaled_err(a[i], np.asarray(x)[i], b[i]) < 30
+
+
+def test_batched_mixed_b1_bit_identical_to_lane():
+    """The linalg/batched contract extended to the mixed kernels: a
+    B=1 run is bit-identical to its lane of a bucket (the
+    optimization-barrier'd cast-up pins the low-precision rounding —
+    without it XLA:CPU fuses the upcast batch-shape-dependently). LU
+    arm tier-1; the chol arm and more bucket sizes ride the slow
+    sweeps (each fused mixed-kernel CONFIG is its own ~30 s XLA:CPU
+    compile)."""
+    bsz = 5
+    a = np.stack([_diagdom(n=BN, seed=20 + i) for i in range(bsz)])
+    b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
+    xs, _, _ = lb.gesv_mixed_batched(a, b)
+    x1, _, _ = lb.gesv_mixed_batched(a[2:3], b[2:3])
+    assert (np.asarray(xs[2]) == np.asarray(x1[0])).all()
+
+
+@pytest.mark.slow
+def test_batched_mixed_b1_bit_identical_chol_slow():
+    """Chol arm of the lane bit-identity (tier-1 sibling: the LU arm
+    above and the grouped ≡ per-request pin below, which exercises the
+    chol-class refined solve kernels at B=1 vs bucket)."""
+    bsz = 5
+    b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
+    spd = np.stack([_spd(n=BN, seed=30 + i) for i in range(bsz)])
+    ys, _, _ = lb.posv_mixed_batched(np.tril(spd), b)
+    y1, _, _ = lb.posv_mixed_batched(np.tril(spd)[1:2], b[1:2])
+    assert (np.asarray(ys[1]) == np.asarray(y1[0])).all()
+
+
+@pytest.mark.slow
+def test_batched_mixed_fallback_splices_working_precision_slow():
+    """A non-convergent item (impossible tolerance) is re-solved at
+    working precision by the api fallback and keeps its negative
+    iters marker. Slow: the (max_iters=1, tol=1e-14) config is its own
+    bucket-program compile; the tier-1 sibling for per-item fallback
+    isolation is test_grouped_mixed_per_item_fallback_isolates_neighbors."""
+    bsz = 3
+    a = np.stack([_diagdom(n=BN, seed=40 + i) for i in range(bsz)])
+    b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
+    x, info, iters = st.gesv_mixed_batched(a, b, max_iters=1, tol=1e-14)
+    iters = np.asarray(iters)
+    assert (iters < 0).all()  # nobody converges at tol=1e-14 in 1 iter
+    assert (np.asarray(info) == 0).all()
+    for i in range(bsz):
+        assert _scaled_err(a[i], np.asarray(x)[i], b[i]) < 30
+
+
+# -- served: single device --------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype,lo", [(np.float32, "bfloat16"),
+                                      (np.float64, "float32")])
+def test_served_mixed_chol_meets_bound(dtype, lo):
+    spd = _spd(dtype=dtype, seed=3)
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=RefinePolicy(factor_dtype=lo))
+    b = RNG.standard_normal(N).astype(dtype)
+    x = sess.solve(h, b)
+    assert _scaled_err(spd, x, b) < 30
+    snap = sess.metrics.snapshot()
+    assert snap["histograms"]["refine_iterations"]["count"] == 1
+    assert snap["counters"]["refine_converged_total"] == 1
+    assert snap["counters"].get("refine_fallbacks_total", 0) == 0
+    # the resident really is the low-precision factor
+    res = sess.factor(h)
+    assert str(res.payload[0].dtype) == lo
+
+
+def test_served_mixed_lu_f32_and_ledger_split():
+    a = _diagdom(seed=4)
+    sess = Session()
+    h = sess.register(st.from_dense(a, nb=NB), op="lu",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    from slate_tpu.obs.flops import LEDGER
+    before = LEDGER.snapshot()["per_op"].get("serve.refine", 0.0)
+    b = RNG.standard_normal((N, 2)).astype(np.float32)
+    x = sess.solve(h, b)
+    assert _scaled_err(a, x, b) < 30
+    # useful-vs-refinement split: both ledger ops moved
+    per_op = LEDGER.snapshot()["per_op"]
+    assert per_op.get("serve.refine", 0.0) > before
+    assert sess.metrics.get("refine_flops_total") > 0
+    assert sess.metrics.get("solve_flops_total") > 0
+
+
+def test_served_mixed_complex128_to_complex64():
+    spd = _spd(dtype=np.complex128, seed=5)
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol",
+                      refine=RefinePolicy(factor_dtype="complex64"))
+    b = (RNG.standard_normal(N) + 1j * RNG.standard_normal(N))
+    x = sess.solve(h, b)
+    assert _scaled_err(spd, x, b) < 30
+    assert str(sess.factor(h).payload[0].dtype) == "complex64"
+
+
+def test_served_gmres_strategy():
+    a = _diagdom(seed=6)
+    sess = Session()
+    h = sess.register(st.from_dense(a, nb=NB), op="lu",
+                      refine=RefinePolicy(factor_dtype="bfloat16",
+                                          strategy="gmres"))
+    b = RNG.standard_normal(N).astype(np.float32)
+    x = sess.solve(h, b)
+    assert _scaled_err(a, x, b) < 30
+    assert sess.metrics.snapshot()["histograms"][
+        "refine_iterations"]["count"] == 1
+
+
+def test_register_true_resolves_from_table():
+    spd = _spd(seed=7)
+    sess = Session(refine_policies=PolicyTable().add(
+        RefinePolicy(factor_dtype="bfloat16", max_iters=9), op="chol"))
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    assert sess._ops[h].refine.max_iters == 9
+    b = RNG.standard_normal(N).astype(np.float32)
+    assert _scaled_err(spd, sess.solve(h, b), b) < 30
+
+
+def test_register_refine_rejections():
+    sess = Session()
+    tall = st.from_dense(RNG.standard_normal((2 * N, N)).astype(
+        np.float32), nb=NB)
+    with pytest.raises(SlateError):  # qr not refinable
+        sess.register(tall, op="qr",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    spd = _spd()
+    with pytest.raises(SlateError):  # factor dtype == working dtype
+        sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol",
+                      refine=RefinePolicy(factor_dtype="float32"))
+    with pytest.raises(SlateError):  # c64 has no ladder entry
+        sess.register(
+            st.hermitian(np.tril(_spd(dtype=np.complex64)), nb=NB,
+                         uplo=st.Uplo.Lower), op="chol", refine=True)
+    with pytest.raises(SlateError):  # gmres is dense-single-device only
+        sess.register(_spd(), op="chol_small",
+                      refine=RefinePolicy(factor_dtype="bfloat16",
+                                          strategy="gmres"))
+
+
+# -- fallback (the acceptance pin) ------------------------------------------
+
+
+def test_forced_nonconvergence_falls_back_counted():
+    """Impossible tolerance ⇒ IR cannot converge ⇒ the Session evicts
+    the lo resident, refactors at working precision, serves a CORRECT
+    solve, and counts exactly one fallback; the handle serves
+    full-precision thereafter."""
+    spd = _spd(seed=8)
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol",
+                      refine=RefinePolicy(factor_dtype="bfloat16",
+                                          max_iters=2, tol=1e-14))
+    b = RNG.standard_normal(N).astype(np.float32)
+    x = sess.solve(h, b)
+    assert _scaled_err(spd, x, b) < 30
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    # the resident is now the working-precision factor and later
+    # solves do not re-count fallbacks
+    assert str(sess.factor(h).payload[0].dtype) == "float32"
+    sess.solve(h, b)
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+
+
+def test_fallback_disabled_raises():
+    spd = _spd(seed=9)
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol",
+                      refine=RefinePolicy(factor_dtype="bfloat16",
+                                          max_iters=1, tol=1e-14,
+                                          fallback=False))
+    with pytest.raises(SlateError):
+        sess.solve(h, RNG.standard_normal(N).astype(np.float32))
+
+
+def test_small_nonconvergence_falls_back_counted():
+    """The *_small arm of the same pin (tier-1 sibling of the grouped
+    sweep below)."""
+    a = _spd(n=24, seed=10)
+    sess = Session()
+    h = sess.register(a, op="chol_small",
+                      refine=RefinePolicy(factor_dtype="bfloat16",
+                                          max_iters=1, tol=1e-14))
+    b = RNG.standard_normal(24).astype(np.float32)
+    x = sess.solve(h, b)
+    assert _scaled_err(a, x, b) < 30
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    assert sess._ops[h].refine is None  # deactivated
+
+
+# -- HBM accounting (the acceptance pin) ------------------------------------
+
+
+def test_bf16_resident_charges_half():
+    spd = _spd(seed=11)
+    mixed, full = Session(), Session()
+    hm = mixed.register(st.hermitian(np.tril(spd), nb=NB,
+                                     uplo=st.Uplo.Lower), op="chol",
+                        refine=RefinePolicy(factor_dtype="bfloat16"))
+    hf = full.register(st.hermitian(np.tril(spd), nb=NB,
+                                    uplo=st.Uplo.Lower), op="chol")
+    assert mixed.factor(hm).nbytes * 2 == full.factor(hf).nbytes
+
+
+def test_budget_for_n_f32_residents_holds_2n_bf16():
+    """A budget sized for N f32 small residents holds 2N bf16-factored
+    ones before eviction (the *_small engine's residents carry no
+    analyzed-program transient, so the arithmetic is exact: the
+    bf16 LU payload is n²·2 + perm bytes vs n²·4 + perm)."""
+    n, count = 32, 4
+    mats = [_diagdom(n=n, seed=50 + i) for i in range(2 * count)]
+    b = RNG.standard_normal(n).astype(np.float32)
+
+    def fill(policy, budget):
+        sess = Session(hbm_budget=budget)
+        hs = [sess.register(m, op="lu_small", refine=policy)
+              for m in mats]
+        for h in hs:
+            sess.solve(h, b)
+        return sess
+
+    probe = Session()
+    hp = probe.register(mats[0], op="lu_small")
+    f32_bytes = probe.factor(hp).nbytes
+    budget = count * f32_bytes
+    full = fill(None, budget)
+    mixed = fill(RefinePolicy(factor_dtype="bfloat16"), budget)
+    assert len(full.cached_handles()) == count
+    assert len(mixed.cached_handles()) >= 2 * count - 1
+    assert mixed.metrics.get("refine_fallbacks_total") == 0
+
+
+# -- batched B=1 ≡ per-request (the acceptance pin) -------------------------
+
+
+def test_grouped_mixed_bit_identical_to_per_request():
+    """The Batcher's grouped mixed dispatch (ONE batched refined
+    program over stacked lo residents) returns bit-identical results
+    to the per-request mixed path (the same bucket programs at B=1)."""
+    n = 32
+    pol = RefinePolicy(factor_dtype="bfloat16")
+    mats = [_diagdom(n=n, seed=60 + i) for i in range(3)]
+    bs = [RNG.standard_normal(n).astype(np.float32) for _ in range(3)]
+
+    grouped = Session()
+    hs = [grouped.register(m, op="lu_small", refine=pol) for m in mats]
+    bat = Batcher(grouped, max_batch=8, max_wait=60.0)
+    futs = [bat.submit(h, b) for h, b in zip(hs, bs)]
+    bat.flush()
+    xs = [f.result() for f in futs]
+
+    for m, b, x in zip(mats, bs, xs):
+        per = Session()
+        hp = per.register(m, op="lu_small", refine=pol)
+        assert (per.solve(hp, b) == x).all()
+    snap = grouped.metrics.snapshot()
+    assert snap["counters"]["batched_programs"] == 2  # factor + solve
+    assert snap["histograms"]["refine_iterations"]["count"] == 3
+
+
+def test_grouped_mixed_does_not_coalesce_with_plain():
+    """Mixed and plain small entries never share a bucket (the policy
+    rides in the group key)."""
+    sess = Session()
+    a = _diagdom(n=32, seed=70)
+    hm = sess.register(a, op="lu_small",
+                       refine=RefinePolicy(factor_dtype="bfloat16"))
+    hp = sess.register(a.copy(), op="lu_small")
+    km, kp = sess.small_group_key(hm), sess.small_group_key(hp)
+    assert kp == ("lu_small", 32, "float32")  # round-10 pin unchanged
+    assert km != kp and km[:3] == kp
+
+
+def test_grouped_mixed_per_item_fallback_isolates_neighbors():
+    """One non-convergent item in a grouped mixed bucket takes the
+    working-precision fallback alone; its neighbors' solutions are the
+    refined ones, bit-identical to a clean grouped run."""
+    n = 32
+    pol = RefinePolicy(factor_dtype="bfloat16", max_iters=2, tol=1e-14)
+    ok_pol = RefinePolicy(factor_dtype="bfloat16")
+    mats = [_diagdom(n=n, seed=80 + i) for i in range(2)]
+    bs = [RNG.standard_normal(n).astype(np.float32) for _ in range(2)]
+    sess = Session()
+    hs = [sess.register(m, op="lu_small", refine=pol) for m in mats]
+    xs, infos = sess.solve_small_batched(hs, [b[:, None] for b in bs])
+    assert infos == [0, 0]
+    assert sess.metrics.get("refine_fallbacks_total") == 2
+    for m, b, x in zip(mats, bs, xs):
+        assert _scaled_err(m, x[:, 0], b) < 30
+    del ok_pol
+
+
+def _bf16_indefinite_spd(n=16):
+    """SPD in f32, exactly singular after bf16 rounding: J + 1e-3·I —
+    the bf16 cast rounds the diagonal's 1.001 to 1.0 (eps ≈ 7.8e-3),
+    so the low-precision Cholesky fails (info=2) while f32 succeeds."""
+    return np.ones((n, n), np.float32) + 1e-3 * np.eye(n,
+                                                       dtype=np.float32)
+
+
+def test_lo_factor_failure_falls_back_per_request():
+    """A lo factor that fails outright (bf16-indefinite SPD) takes the
+    counted working-precision fallback on the per-request path."""
+    a = _bf16_indefinite_spd()
+    sess = Session()
+    h = sess.register(a, op="chol_small",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    b = RNG.standard_normal(16).astype(np.float32)
+    x = sess.solve(h, b)
+    assert _scaled_err(a, x, b) < 30
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    assert sess._ops[h].refine is None
+    assert str(sess.factor(h).payload[0].dtype) == "float32"
+
+
+def test_grouped_lo_factor_failure_no_cache_poison():
+    """Review fix: a failed LOW-precision batched factor in a grouped
+    mixed bucket must NOT cache the bad resident or fail futures — the
+    bucket degrades to the per-request path, whose factor() owns the
+    counted fallback; later per-request solves against the same handle
+    serve normally (parity with pure per-request serving)."""
+    good = _spd(n=16, seed=90)
+    bad = _bf16_indefinite_spd()
+    pol = RefinePolicy(factor_dtype="bfloat16")
+    sess = Session()
+    hg = sess.register(good, op="chol_small", refine=pol)
+    hb = sess.register(bad, op="chol_small", refine=pol)
+    bs = [RNG.standard_normal((16, 1)).astype(np.float32)
+          for _ in range(2)]
+    xs, infos = sess.solve_small_batched([hg, hb], bs)
+    assert infos == [0, 0]
+    assert _scaled_err(good, xs[0][:, 0], bs[0][:, 0]) < 30
+    assert _scaled_err(bad, xs[1][:, 0], bs[1][:, 0]) < 30
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    # the bad handle's cached resident is the WORKING-precision factor
+    # (no poison) and keeps serving per-request
+    assert str(sess.factor(hb).payload[0].dtype) == "float32"
+    x2 = sess.solve(hb, bs[1][:, 0])
+    assert _scaled_err(bad, x2, bs[1][:, 0]) < 30
+    assert sess.metrics.get("refine_fallbacks_total") == 1  # no re-count
+
+
+def test_policy_table_hole_registers_unrefined():
+    """Review fix: PolicyTable.add(None, ...) is an explicit
+    full-precision carve-out — register(refine=True) against a matched
+    hole registers UNREFINED instead of raising the (wrong)
+    no-lower-precision error."""
+    spd = _spd(seed=91)
+    table = PolicyTable()
+    table.add(None, op="chol", n_max=1024)
+    table.add(RefinePolicy(factor_dtype="bfloat16"))
+    sess = Session(refine_policies=table)
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    assert sess._ops[h].refine is None
+    b = RNG.standard_normal(N).astype(np.float32)
+    assert _scaled_err(spd, sess.solve(h, b), b) < 30
+    assert sess.metrics.snapshot()["histograms"].get(
+        "refine_iterations", {}).get("count", 0) == 0
+    # lookup() exposes the distinction the register path relies on
+    assert table.lookup("chol", N, "float32") == (True, None)
+    assert PolicyTable().lookup("lu", N, "complex64")[0] is False
+
+
+def test_batched_mixed_complex_kind_guards():
+    """Review fix: the batched mixed verbs must never silently cast
+    complex to real (jax's astype drops the imaginary part). c64 has
+    no ladder entry — the default raises; an explicit real factor
+    dtype on a complex stack raises; c128 defaults to c64."""
+    from slate_tpu.api import _mixed_batched_factor_dtype
+    c64 = np.ones((2, 8, 8), np.complex64)
+    with pytest.raises(SlateError):
+        st.gesv_mixed_batched(c64, np.ones((2, 8, 1), np.complex64))
+    with pytest.raises(SlateError):
+        st.posv_mixed_batched(c64, np.ones((2, 8, 1), np.complex64),
+                              factor_dtype="bfloat16")
+    with pytest.raises(SlateError):  # linalg layer guards too
+        lb.getrf_mixed_batched(c64, "bfloat16")
+    assert _mixed_batched_factor_dtype(
+        np.ones((2, 8, 8), np.complex128), None, "t") == "complex64"
+    assert _mixed_batched_factor_dtype(
+        np.ones((2, 8, 8), np.float64), None, "t") == "float32"
+
+
+# -- warmup -----------------------------------------------------------------
+
+def test_warmup_covers_refined_programs():
+    spd = _spd(seed=12)
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=NB,
+                                   uplo=st.Uplo.Lower), op="chol",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    sess.warmup(h)
+    compiles = sess.metrics.get("aot_compiles") + sess.metrics.get(
+        "factor_aot_compiles")
+    b = RNG.standard_normal(N).astype(np.float32)
+    sess.solve(h, b)
+    sess.solve(h, b)
+    after = sess.metrics.get("aot_compiles") + sess.metrics.get(
+        "factor_aot_compiles")
+    assert after == compiles  # warmup covered start+step+factor
+
+
+# -- mesh (module-scoped session: sharded AOT compiles amortized) -----------
+
+
+@pytest.fixture(scope="module")
+def mesh_refined(grid2x4):
+    spd = _spd(dtype=np.float32, seed=13)
+    dd64 = _diagdom(dtype=np.float64, seed=14)
+    sess = Session(mesh=grid2x4)
+    hc = sess.register(
+        st.hermitian(np.tril(spd), nb=8, uplo=st.Uplo.Lower), op="chol",
+        refine=RefinePolicy(factor_dtype="bfloat16"))
+    hl = sess.register(
+        st.from_dense(dd64, nb=8), op="lu",
+        refine=RefinePolicy(factor_dtype="float32"))
+    return sess, hc, hl, spd, dd64
+
+
+def test_mesh_served_mixed_f32(mesh_refined):
+    sess, hc, _, spd, _ = mesh_refined
+    b = RNG.standard_normal(N).astype(np.float32)
+    x = sess.solve(hc, b)
+    assert _scaled_err(spd, x, b) < 30
+    res = sess.factor(hc)
+    leaf = res.payload[0].data
+    assert str(leaf.dtype) == "bfloat16"
+    assert not leaf.sharding.is_fully_replicated
+    # per-chip charge is the max shard: total/8 on the even 2x4 grid
+    assert res.nbytes == res.nbytes_total // 8
+
+
+def test_mesh_served_mixed_f64(mesh_refined):
+    sess, _, hl, _, dd64 = mesh_refined
+    b = RNG.standard_normal(N)
+    x = sess.solve(hl, b)
+    assert _scaled_err(dd64, x, b) < 30
+    assert str(sess.factor(hl).payload[0].data.dtype) == "float32"
+
+
+def test_mesh_refined_census_credits_per_execution(mesh_refined):
+    """Every refined mesh solve executes analyzed sharded programs:
+    the collective census moves per execution, with zero new
+    compiles between two identical solves (collective-aware residual
+    gemms — the ISSUE 10 mesh acceptance)."""
+    sess, hc, _, spd, _ = mesh_refined
+    b = RNG.standard_normal(N).astype(np.float32)
+    sess.solve(hc, b)
+    c0 = sess.metrics.get("collective_bytes_total")
+    n0 = sess.metrics.get("aot_compiles") + sess.metrics.get(
+        "factor_aot_compiles")
+    sess.solve(hc, b)
+    c1 = sess.metrics.get("collective_bytes_total")
+    n1 = sess.metrics.get("aot_compiles") + sess.metrics.get(
+        "factor_aot_compiles")
+    assert c1 > c0 and n1 == n0
+    steps = [r for r in sess.cost_log if r["what"] == "refine_step"]
+    assert steps and any(r["collective_bytes"] > 0 for r in steps)
+
+
+# -- heavier convergence sweeps (slow; cheap siblings above) ----------------
+
+
+@pytest.mark.slow
+def test_served_mixed_convergence_sweep_slow():
+    """Wider (n, dtype, op, strategy) convergence sweep — the cheap
+    tier-1 siblings are the parametrized f32/f64 chol test and the
+    single lu/gmres tests above."""
+    for n in (96, 160):
+        for dtype, lo in ((np.float32, "bfloat16"),
+                          (np.float64, "float32")):
+            spd = _spd(n=n, dtype=dtype, seed=100 + n)
+            dd = _diagdom(n=n, dtype=dtype, seed=200 + n)
+            for op, a in (("chol", spd), ("lu", dd)):
+                for strategy in ("ir", "gmres"):
+                    sess = Session()
+                    A = (st.hermitian(np.tril(a), nb=32,
+                                      uplo=st.Uplo.Lower)
+                         if op == "chol" else st.from_dense(a, nb=32))
+                    h = sess.register(
+                        A, op=op,
+                        refine=RefinePolicy(factor_dtype=lo,
+                                            strategy=strategy))
+                    b = RNG.standard_normal(n).astype(dtype)
+                    x = sess.solve(h, b)
+                    assert _scaled_err(a, x, b) < 30, (n, dtype, op,
+                                                      strategy)
+
+
+@pytest.mark.slow
+def test_batched_mixed_cross_bucket_sweep_slow():
+    """Cross-bucket bit-identity at more batch sizes (tier-1 sibling:
+    test_batched_mixed_b1_bit_identical_to_lane)."""
+    for bsz in (2, 7, 9):
+        a = np.stack([_diagdom(seed=300 + i) for i in range(bsz)])
+        b = RNG.standard_normal((bsz, N, 2)).astype(np.float32)
+        xs, _, _ = lb.gesv_mixed_batched(a, b)
+        for i in range(bsz):
+            x1, _, _ = lb.gesv_mixed_batched(a[i:i + 1], b[i:i + 1])
+            assert (np.asarray(xs[i]) == np.asarray(x1[0])).all()

@@ -78,11 +78,21 @@ TRACKED_SERVE_BATCHED = ("batched.reqs_per_sec", "speedup")
 # collective/census columns are structural evidence, not perf series
 TRACKED_MULTICHIP = ("serve.solves_per_sec",
                      "single_device.solves_per_sec", "speedup")
+# the round-13 mixed-precision serving A/B (bench_serve.py --mixed →
+# BENCH_MIXED_r*.json): refined-from-low-precision vs full-precision
+# serve. residents_ratio and factor-bytes columns are structural; the
+# solves/sec pair and speedup gate on TPU platforms like every serve
+# series (CPU rows are convert-materialization smoke — informational)
+TRACKED_SERVE_MIXED = ("mixed.solves_per_sec", "full.solves_per_sec",
+                       "speedup", "residents_ratio")
 GATED_PLATFORMS = ("tpu", "axon")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
-_ROUND_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
+# any committed artifact family named <FAMILY>_r<round>.json (BENCH_,
+# MULTICHIP_, BENCH_MIXED_); non-round files (BENCH_SERVE_smoke) get
+# round None
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
 # the r01–r05 multichip dry-run tails: "posv+hemm OK (max residual
 # 4.77e-07), getrf OK (2.38e-07), ..." — the only machine-readable
 # signal those rounds recorded (normalized as informational series)
@@ -135,9 +145,10 @@ def normalize(path: str) -> dict:
     name, obj = _load(path)
     if isinstance(obj, list):
         raise SchemaError(f"{name}: list artifact — use normalize_all")
-    if isinstance(obj, dict) and obj.get("bench") == "multichip":
-        raise SchemaError(f"{name}: multi-row multichip artifact — "
-                          "use normalize_all")
+    if isinstance(obj, dict) and obj.get("bench") in ("multichip",
+                                                      "serve_mixed"):
+        raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
+                          "— use normalize_all")
     m = _ROUND_RE.search(name)
     return _normalize_obj(name, obj, int(m.group(1)) if m else None)
 
@@ -156,7 +167,40 @@ def normalize_all(path: str) -> List[dict]:
                 for i, row in enumerate(obj)]
     if isinstance(obj, dict) and obj.get("bench") == "multichip":
         return _normalize_multichip(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_mixed":
+        return _normalize_serve_mixed(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
+
+
+def _normalize_serve_mixed(name: str, obj: dict,
+                           rnd: Optional[int]) -> List[dict]:
+    """The round-13 mixed-precision serving artifact: {"bench":
+    "serve_mixed", "platform", "factor_dtype", "rows": [...]} — one
+    ``serve_mixed`` record per row, series keyed by the row's
+    (op, n, dtype)."""
+    for k in ("platform", "factor_dtype", "rows"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_mixed artifact missing "
+                              f"{k!r}")
+    if not isinstance(obj["rows"], list) or not obj["rows"]:
+        raise SchemaError(f"{name}: serve_mixed artifact with empty rows")
+    out = []
+    for i, row in enumerate(obj["rows"]):
+        for k in ("op", "n", "mixed", "full", "speedup",
+                  "factor_bytes_ratio"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[rows.{i}]: serve_mixed row missing {k!r}")
+        out.append({
+            "round": rnd, "source": f"{name}[{i}]",
+            "kind": "serve_mixed",
+            "platform": str(obj["platform"]), "n": int(row["n"]),
+            "op": str(row["op"]),
+            "dtype": str(row.get("dtype", "")) or None,
+            "ok": bool(row.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_SERVE_MIXED),
+        })
+    return out
 
 
 def _normalize_multichip(name: str, obj: dict,
@@ -280,6 +324,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
 def discover(root: str) -> List[str]:
     paths = (glob.glob(os.path.join(root, "BENCH_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_SERVE*.json"))
+             + glob.glob(os.path.join(root, "BENCH_MIXED_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
     # fixtures beside the headline artifact — different schema, not

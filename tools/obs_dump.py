@@ -30,9 +30,15 @@ fails: /slo payload without computed burn rates, lifecycle-stage
 histograms or backpressure gauges missing, the watchdog flagging the
 real committed history (or NOT flagging the injected regression),
 ``padding_waste_flops`` zero on a deliberately under-occupied bucket
-or nonzero at full occupancy, or a 2-process aggregation whose
-counters are not bit-exactly double the single-process snapshot —
-wired into examples/run_tests.py as the obs smoke.
+or nonzero at full occupancy, any round-13 mixed-refinement assert
+(``refine_iterations``/``refine_converged_total``/
+``refine_fallbacks_total`` rows absent or zero where a served mixed
+workload must move them, the ledger missing the ``serve.refine``
+useful-vs-refinement split, or a forced non-convergent solve that
+fails to fall back to a correct working-precision answer), or a
+2-process aggregation whose counters are not bit-exactly double the
+single-process snapshot — wired into examples/run_tests.py as the obs
+smoke.
 
 Usage: python tools/obs_dump.py [--smoke] [--out-dir DIR]
                                 [--n N] [--nb NB] [--requests R]
@@ -302,6 +308,61 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         if obs.flops.LEDGER.snapshot()["per_op"].get(
                 "padding.waste", 0) <= 0:
             fails.append("process ledger has no padding.waste op")
+
+        # -- mixed-precision refinement telemetry (round 13) ------------
+        # a served refined workload must surface: the refine_iterations
+        # histogram, the converged counter, the useful-vs-refinement
+        # ledger split (serve.refine beside serve.solve), and — from a
+        # deliberately non-convergent operator — the counted fallback
+        # that still returns a correct solve
+        from slate_tpu.refine import RefinePolicy
+        rng3 = np.random.default_rng(9)
+        mbase = rng3.standard_normal((48, 48)).astype(np.float32)
+        mspd = mbase @ mbase.T + 48 * np.eye(48, dtype=np.float32)
+        msess = Session()
+        mh = msess.register(
+            st.hermitian(np.tril(mspd), nb=16, uplo=st.Uplo.Lower),
+            op="chol", refine=RefinePolicy(factor_dtype="bfloat16"))
+        mb = rng3.standard_normal(48).astype(np.float32)
+        mx = msess.solve(mh, mb)
+        if not float(np.abs(mspd @ mx - mb).max()) / 48 < 1e-2:
+            fails.append("served mixed solve residual too large")
+        msnap = msess.metrics.snapshot()
+        if not msnap["histograms"].get("refine_iterations",
+                                       {}).get("count"):
+            fails.append("refine_iterations histogram empty after a "
+                         "served mixed solve")
+        if not msnap["counters"].get("refine_converged_total"):
+            fails.append("refine_converged_total not incremented")
+        mprom = obs.render_prometheus(msess.metrics)
+        for needle in ("slate_tpu_refine_iterations",
+                       "slate_tpu_refine_converged_total",
+                       "slate_tpu_refine_flops_total"):
+            if needle not in mprom:
+                fails.append(f"prometheus text missing {needle}")
+        lsnap = obs.flops.LEDGER.snapshot()["per_op"]
+        if lsnap.get("serve.refine", 0) <= 0:
+            fails.append("process ledger has no serve.refine op (the "
+                         "useful-vs-refinement split)")
+        # forced non-convergence: an impossible tolerance -> counted
+        # fallback through a working-precision refactor, answer still
+        # correct
+        fh = msess.register(
+            st.hermitian(np.tril(mspd), nb=16, uplo=st.Uplo.Lower),
+            op="chol",
+            refine=RefinePolicy(factor_dtype="bfloat16", max_iters=2,
+                                tol=1e-14))
+        fx = msess.solve(fh, mb)
+        if not float(np.abs(mspd @ fx - mb).max()) / 48 < 1e-2:
+            fails.append("refine fallback returned a wrong solve")
+        if msess.metrics.get("refine_fallbacks_total") != 1:
+            fails.append("refine_fallbacks_total != 1 after a forced "
+                         "non-convergent solve")
+        if "slate_tpu_refine_fallbacks_total" not in \
+                obs.render_prometheus(msess.metrics, ledger=False,
+                                      bytes_ledger=False):
+            fails.append("prometheus text missing "
+                         "refine_fallbacks_total")
 
         # -- 2-process aggregation (tentpole d) -------------------------
         # same-snapshot fold: the acceptance's bit-exactness check —
