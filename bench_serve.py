@@ -52,6 +52,7 @@ the structural columns are the portable claim.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -60,6 +61,17 @@ import numpy as np
 from slate_tpu.compat.platform import apply_env_platforms
 
 apply_env_platforms()
+
+# Every top-level section the serve artifact currently carries — the
+# committed BENCH_SERVE_smoke.json fixture must have ALL of them
+# (rounds 12 and 13 both tripped on stale fixtures when the schema
+# grew a section). bench() asserts this at write time; tools/
+# bench_gate.py --check-schema asserts it on the committed files
+# (mirrored there to stay jax-free; tests pin the two tuples equal);
+# --regen-smoke is the guarded regeneration path.
+SERVE_ARTIFACT_SECTIONS = (
+    "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
+    "serve", "per_request", "speedup", "cost_log", "hbm", "slo")
 
 
 def _build_operator(n, nb, dtype):
@@ -150,6 +162,8 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
+    missing = [s for s in SERVE_ARTIFACT_SECTIONS if s not in artifact]
+    assert not missing, f"serve artifact missing sections {missing}"
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
@@ -606,6 +620,132 @@ def bench_mixed(sizes=(128, 256), nb=32, requests=32,
     return artifact
 
 
+def bench_overload(n=64, nb=32, service_ms=5.0, duration_s=1.5,
+                   overload=2.0, max_age_s=0.05, seed=1,
+                   out_path="BENCH_OVERLOAD_r01.json"):
+    """The round-14 shedding A/B: the SAME 2× sustained overload served
+    with and without admission control + load shedding.
+
+    Service time is pinned by an injected ``slow_device`` fault
+    (rate 1.0, ``service_ms`` per dispatch — the fault layer doubling
+    as a deterministic load model), ``max_batch=1`` so the service
+    rate is 1/service_ms, and requests arrive at ``overload×`` that
+    rate. The no-shed arm's queue — hence its completed-request p99
+    and ``oldest_request_age_s`` — grows for as long as the overload
+    lasts; the shed arm turns excess away at the door
+    (``max_queue_depth``) and sheds cheapest-first past ``max_age_s``,
+    so its p99 stays bounded near the age threshold. Wall-clock
+    numbers on CPU are honest smoke (PERF.md policy): the CLAIM is the
+    shape — bounded vs unbounded — which is dispatch-rate-independent.
+    """
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.runtime import (Executor, FaultPlan, FaultSpec,
+                                   Session, ShedPolicy)
+
+    platform = jax.devices()[0].platform
+    service_s = service_ms * 1e-3
+    interval = service_s / overload
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+    def run_arm(shed_policy):
+        sess = Session()
+        sess.enable_faults(FaultPlan(seed=seed, specs=(
+            FaultSpec("slow_device", rate=1.0, latency_s=service_s),)))
+        h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                       uplo=st.Uplo.Lower), op="chol")
+        sess.warmup(h)
+        age_series = []
+        futs = []
+        head = 0  # first possibly-unserved future (monotone scan)
+        t0 = time.perf_counter()
+        with Executor(sess, max_batch=1, max_wait=1e-4, retries=0,
+                      shed_policy=shed_policy) as ex:
+            next_sample = 0.0
+            while (now := time.perf_counter() - t0) < duration_s:
+                futs.append((time.perf_counter(), ex.submit(
+                    h, rng.standard_normal(n).astype(np.float32))))
+                if now >= next_sample:
+                    # the client-visible backlog signal: age of the
+                    # oldest UNSERVED request (queued OR detached-but-
+                    # undispatched — the /metrics gauge only sees the
+                    # queued share)
+                    while head < len(futs) and futs[head][1].done():
+                        head += 1
+                    age_series.append(round(
+                        time.perf_counter() - futs[head][0], 4)
+                        if head < len(futs) else 0.0)
+                    next_sample = now + 0.1
+                time.sleep(interval)
+            ex.flush()
+        wall = time.perf_counter() - t0
+        futs = [f for _, f in futs]
+        snap = sess.metrics.snapshot()
+        lat = snap["histograms"].get("request_latency", {})
+        g = snap["counters"].get
+        return {
+            "submitted": len(futs),
+            "completed": g("completed_requests", 0.0),
+            "shed": g("shed_requests_total", 0.0),
+            "admission_rejected": g("admission_rejected_total", 0.0),
+            "load_sheds": g("load_sheds_total", 0.0),
+            "p50_latency_s": lat.get("p50", 0.0),
+            "p99_latency_s": lat.get("p99", 0.0),
+            "oldest_age_series_s": age_series,
+            "max_oldest_age_s": max(age_series, default=0.0),
+            "wall_s": wall,
+        }
+
+    shed = run_arm(ShedPolicy(max_queue_depth=16, max_age_s=max_age_s,
+                              shed_fraction=0.5, min_queue_depth=4))
+    no_shed = run_arm(None)
+    # the claim: shedding BOUNDS the completed-request p99 and the
+    # queue age; without it both grow with the overload duration
+    series = no_shed["oldest_age_series_s"]
+    half = len(series) // 2 or 1
+    no_shed_grows = (len(series) >= 2
+                     and series[-1] > 1.5 * max(max(series[:half]), 1e-6)
+                     and no_shed["max_oldest_age_s"] > 2 * max_age_s)
+    ok = (shed["p99_latency_s"] < no_shed["p99_latency_s"] / 2
+          and shed["max_oldest_age_s"] < no_shed["max_oldest_age_s"] / 2
+          and (shed["shed"] > 0 or shed["admission_rejected"] > 0)
+          and no_shed_grows)
+    artifact = {
+        "bench": "serve_overload",
+        "platform": platform,
+        "n": n, "nb": nb,
+        "service_ms": service_ms,
+        "overload_factor": overload,
+        "duration_s": duration_s,
+        "max_age_s": max_age_s,
+        "arms": {"shed": shed, "no_shed": no_shed},
+        "no_shed_age_grows": no_shed_grows,
+        "caveat": ("CPU smoke (TPU tunnel down since round 5): service "
+                   "time is an injected slow-device fault, so the "
+                   "latency scale is synthetic; the bounded-vs-"
+                   "unbounded SHAPE under 2x overload is the claim."
+                   if platform == "cpu" else None),
+        "ok": ok,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"# overload 2x: shed p99 {shed['p99_latency_s']*1e3:.1f} ms "
+          f"(max age {shed['max_oldest_age_s']*1e3:.0f} ms, "
+          f"shed {shed['shed']:.0f} + rejected "
+          f"{shed['admission_rejected']:.0f}) vs no-shed p99 "
+          f"{no_shed['p99_latency_s']*1e3:.1f} ms (max age "
+          f"{no_shed['max_oldest_age_s']*1e3:.0f} ms, growing="
+          f"{no_shed_grows})", file=sys.stderr)
+    print(json.dumps({"out": out_path, "ok": ok,
+                      "shed_p99_ms": shed["p99_latency_s"] * 1e3,
+                      "no_shed_p99_ms": no_shed["p99_latency_s"] * 1e3}))
+    return artifact
+
+
 def _probe_device_count(timeout=90):
     """Default-backend device count, probed in a subprocess with a
     hard timeout — with the TPU tunnel down, jax.devices() hangs
@@ -680,6 +820,21 @@ def main(argv=None):
                         "exit 0 iff every row's structural columns "
                         "hold (half-byte residents, ~2x residents per "
                         "budget, zero fallbacks)")
+    p.add_argument("--overload", action="store_true",
+                   help="run the round-14 shedding A/B: the same 2x "
+                        "sustained overload with and without admission "
+                        "control + load shedding; exit 0 iff shedding "
+                        "bounds p99/queue age while the no-shed arm's "
+                        "grow (CPU smoke, honestly labeled)")
+    p.add_argument("--overload-out", default="BENCH_OVERLOAD_r01.json")
+    p.add_argument("--regen-smoke", action="store_true",
+                   help="GUARDED regeneration of the committed "
+                        "BENCH_SERVE_smoke.json fixture (+ .metrics."
+                        "json/.prom sidecars) in the repo root — run "
+                        "after any artifact-schema change; plain "
+                        "--smoke writes a /tmp throwaway so routine CI "
+                        "runs can no longer silently rewrite (or "
+                        "silently NOT rewrite) the committed fixture")
     p.add_argument("--mixed-out", default="BENCH_MIXED_r01.json")
     p.add_argument("--multichip-out", default="MULTICHIP_r06.json")
     p.add_argument("--devices", type=int, default=8,
@@ -695,8 +850,10 @@ def main(argv=None):
     p.add_argument("--sizes", type=int, nargs="+",
                    default=[32, 64, 128, 256])
     args = p.parse_args(argv)
+    if args.overload:
+        art = bench_overload(out_path=args.overload_out)
+        return 0 if art["ok"] else 1
     if args.multichip:
-        import os
         if "_SLATE_TPU_MULTICHIP_CHILD" not in os.environ \
                 and _probe_device_count() < args.devices:
             # fewer real devices than the mesh needs (or a dead
@@ -738,10 +895,21 @@ def main(argv=None):
                                  out_path=args.batched_out)
         ok = bool(rows) and all(r["hlo_one_program"] for r in rows)
         return 0 if ok else 1
-    if args.smoke:
+    if args.regen_smoke:
+        # the guarded fixture-regeneration path: smoke settings, the
+        # COMMITTED path (repo root), sections asserted by bench()
         args.n, args.nb, args.requests = 192, 64, 48
+        args.out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SERVE_smoke.json")
+        print(f"# regenerating committed smoke fixture -> {args.out}",
+              file=sys.stderr)
+    elif args.smoke:
+        args.n, args.nb, args.requests = 192, 64, 48
+        # a throwaway: routine smoke runs must not touch the committed
+        # fixture (regenerate it deliberately with --regen-smoke)
         args.out = (args.out if args.out != "BENCH_SERVE.json"
-                    else "BENCH_SERVE_smoke.json")
+                    else "/tmp/BENCH_SERVE_smoke.json")
     art = bench(n=args.n, nb=args.nb, requests=args.requests,
                 max_batch=args.max_batch, out_path=args.out)
     ok = art["speedup"] > 1.0

@@ -123,6 +123,29 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --mixed --smoke FAILED")
+    # chaos-soak smoke (round 14): the full serving stack under every
+    # injectable fault class at once; exits nonzero unless the
+    # invariants hold (zero wrong answers, zero lost futures, request
+    # conservation, SLO consistency, fleet fold under snapshot loss)
+    # AND the same seed reproduces the identical fault schedule
+    print("=== tools/chaos_serve.py --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "tools" / "chaos_serve.py"),
+         "--smoke"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! chaos_serve --smoke FAILED")
+    # overload shedding A/B smoke (round 14): shedding bounds p99 and
+    # queue age under 2x sustained overload; the no-shed arm grows
+    print("=== bench_serve.py --overload ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--overload", "--overload-out", "/tmp/BENCH_OVERLOAD_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --overload FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure)

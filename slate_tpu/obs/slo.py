@@ -180,6 +180,24 @@ class SloTracker:
         with self._lock:
             self._oom.append((t, 0.0, bool(ok)))
 
+    def worst_burn_rate(self, now: Optional[float] = None) -> float:
+        """Worst SHORT-window burn rate across objectives right now —
+        the cheap point read the round-14 load shedder polls (full
+        :meth:`evaluate` walks every window, publishes gauges, and
+        detects breach transitions; an overload check needs none of
+        that). Objectives with no traffic in their short window
+        contribute nothing. 0.0 when the service is clean."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            snapshots = [(obj, self._events_for(obj))
+                         for obj in self.objectives]
+        worst = 0.0
+        for obj, events in snapshots:
+            row = self._window_stats(obj, events, now, min(obj.windows))
+            if row["burn_rate"] is not None:
+                worst = max(worst, row["burn_rate"])
+        return worst
+
     # -- evaluation ---------------------------------------------------------
 
     def _events_for(self, obj: Objective) -> Tuple[_Event, ...]:

@@ -153,7 +153,9 @@ def convergence_threshold(anorm: float, n: int, work_dtype,
 def drive(start_fn: Callable, step_fn: Callable, payload, A, B,
           anorm: float, policy: RefinePolicy, work_dtype,
           on_start: Optional[Callable] = None,
-          on_step: Optional[Callable] = None) -> Tuple[object, int, bool]:
+          on_step: Optional[Callable] = None,
+          fault_hook: Optional[Callable] = None
+          ) -> Tuple[object, int, bool]:
     """The host convergence loop over compiled start/step programs.
 
     Returns (X, iters, converged). ``iters`` counts residual checks
@@ -163,11 +165,20 @@ def drive(start_fn: Callable, step_fn: Callable, payload, A, B,
     semantics. ``on_start()`` / ``on_step(it)`` fire after each program
     execution — the Session's per-execution crediting/span hooks.
     Non-convergence returns ``converged=False`` and the best X (the
-    caller owns fallback policy)."""
+    caller owns fallback policy).
+
+    ``fault_hook`` (round 14, deterministic fault injection at the
+    lo-factor seam): a zero-arg bool callable evaluated once after the
+    initial lo solve; True simulates a stagnating refinement — the
+    loop exits immediately with ``converged=False``, driving the SAME
+    counted working-precision fallback a genuinely non-convergent
+    operand takes. ``None`` (production) costs one is-None check."""
     cte = convergence_threshold(anorm, A.shape[0], work_dtype, policy)
     X = start_fn(payload, B)
     if on_start is not None:
         on_start()
+    if fault_hook is not None and fault_hook():
+        return X, 0, False
     iters = 0
     converged = False
     for it in range(1, policy.max_iters + 1):

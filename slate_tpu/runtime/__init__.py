@@ -2,10 +2,14 @@
 
 The serving layer over the simplified-API verbs: a Session keeps
 factored operators hot in an HBM-budget LRU cache, a Batcher coalesces
-same-shape solve requests into one stacked dispatch, an Executor gives
-an async submit/future front end with AOT warmup and bounded retry, and
+same-shape solve requests into one stacked dispatch (with per-request
+deadlines, admission control, and cost-ordered load shedding —
+ShedPolicy), an Executor gives an async submit/future front end with
+AOT warmup, exponential-backoff retry, and a circuit breaker walking
+the declared degradation ladder (faults.DEGRADATION_LADDER), and
 Metrics exports counters + latency percentiles as JSON and Prometheus
-text. Observability (slate_tpu.obs): enable ``session.tracer`` for a
+text. ``faults`` makes every failure path deterministically
+injectable (seeded FaultInjector; tools/chaos_serve.py soaks it). Observability (slate_tpu.obs): enable ``session.tracer`` for a
 request-scoped span tree per served solve (batch → request /
 solve → factor / dispatch / block) exportable as Chrome-trace JSON, and
 ``session.serve_obs()`` for the /metrics, /healthz, /trace.json HTTP
@@ -13,10 +17,16 @@ endpoint. See DESIGN.md ("Serving runtime", "Observability") and
 bench_serve.py for the measured win.
 """
 
-from .batching import Batcher
+from .batching import Batcher, ShedPolicy
 from .executor import Executor
+from .faults import (DEGRADATION_LADDER, DeadlineExceeded, FaultInjector,
+                     FaultPlan, FaultSpec, RequestShed,
+                     TransientDispatchError, default_plan)
 from .metrics import Histogram, Metrics
 from .session import Session, default_session
 
 __all__ = ["Batcher", "Executor", "Histogram", "Metrics", "Session",
-           "default_session"]
+           "ShedPolicy", "default_session",
+           "DEGRADATION_LADDER", "DeadlineExceeded", "FaultInjector",
+           "FaultPlan", "FaultSpec", "RequestShed",
+           "TransientDispatchError", "default_plan"]

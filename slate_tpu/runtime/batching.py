@@ -41,6 +41,7 @@ import numpy as np
 
 from ..core.exceptions import SlateError
 from ..obs.tracing import NOOP_SPAN as _NOOP_SPAN
+from .faults import DeadlineExceeded, RequestShed
 from .session import Session
 
 
@@ -57,6 +58,44 @@ class _Request:
     # obs span, opened at dispatch (parent: the batch span) and closed
     # at future resolution; None while tracing is off or pre-dispatch
     span: object = None
+    # absolute monotonic deadline (round 14): past it the request
+    # FAILS FAST (DeadlineExceeded, counted, span-annotated) instead
+    # of occupying a batch lane; None = no deadline
+    deadline: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShedPolicy:
+    """Admission-control + load-shedding knobs (round 14 reflexes).
+
+    ``max_queue_depth`` is the ADMISSION bound: a submit that would
+    push the queue past it is turned away at the door (its future
+    fails immediately with :class:`RequestShed`; the enqueue never
+    happens). The overload triggers govern SHEDDING of already-queued
+    requests: ``max_age_s`` fires when ``oldest_request_age_s``
+    (cancelled requests excluded) exceeds it, ``burn_threshold`` when
+    the SLO tracker's worst short-window burn rate does (checked at
+    most every ``check_interval_s`` — burn evaluation walks event
+    windows and must not run per wakeup). A shed event drops
+    ``shed_fraction`` of the queue, CHEAPEST-TO-RECOMPUTE FIRST
+    (``Session.recompute_cost`` — the round-9 cost-log ordering:
+    resident-factor solves are cheap to retry, cold factor+solve
+    requests are not), never below ``min_queue_depth``.
+
+    ``None`` fields disable their trigger; a Batcher with no policy
+    pays one is-None check per seam (the round-8 discipline)."""
+
+    max_queue_depth: Optional[int] = None
+    max_age_s: Optional[float] = None
+    burn_threshold: Optional[float] = None
+    shed_fraction: float = 0.5
+    min_queue_depth: int = 1
+    check_interval_s: float = 0.05
+
+    def __post_init__(self):
+        if not (0.0 < self.shed_fraction <= 1.0):
+            raise ValueError("ShedPolicy: shed_fraction must be in "
+                             f"(0, 1], got {self.shed_fraction}")
 
 
 BucketKey = Tuple[Hashable, Tuple[int, ...], str]
@@ -72,12 +111,17 @@ class Batcher:
     docstring). Thread-safe; dispatch runs on the caller of ``run``."""
 
     def __init__(self, session: Session, max_batch: int = 32,
-                 max_wait: float = 2e-3, pad_widths: bool = False):
+                 max_wait: float = 2e-3, pad_widths: bool = False,
+                 shed_policy: Optional[ShedPolicy] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.session = session
         self.max_batch = max_batch
         self.max_wait = max_wait
+        # admission control + load shedding (round 14): None = off,
+        # one is-None check per submit / worker wakeup
+        self.shed_policy = shed_policy
+        self._last_burn_check = 0.0
         # pow2 width quantization (round 11): pad the stacked
         # right-hand side out to the next power of two with zero
         # columns before dispatch, so a varying coalesced width lowers
@@ -100,11 +144,38 @@ class Batcher:
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, handle: Hashable, b) -> Future:
+    def submit(self, handle: Hashable, b, timeout_s: Optional[float]
+               = None) -> Future:
         """Enqueue one solve request; resolves to the solution array
         with the same rank as ``b``. Small-problem operators are
         grouped across handles (module docstring): their bucket key is
-        (op, n, dtype, rhs-shape), not the handle."""
+        (op, n, dtype, rhs-shape), not the handle.
+
+        ``timeout_s`` (round 14): a per-request deadline carried from
+        here through bucket formation to dispatch — once it passes the
+        future fails fast with :class:`~.faults.DeadlineExceeded`
+        (counted in ``deadline_expired_total``) instead of occupying a
+        batch lane. With a :class:`ShedPolicy` admission bound, a
+        submit against a full queue returns an ALREADY-FAILED future
+        (:class:`~.faults.RequestShed`; ``admission_rejected_total``)
+        without enqueueing."""
+        req, rejection = self.submit_deferred(handle, b,
+                                              timeout_s=timeout_s)
+        if rejection is not None:
+            self.reject_admission(req, rejection)
+        return req.future
+
+    def submit_deferred(self, handle: Hashable, b,
+                        timeout_s: Optional[float] = None
+                        ) -> Tuple[_Request, Optional[Exception]]:
+        """The enqueue half of :meth:`submit`: returns ``(request,
+        rejection)`` WITHOUT resolving an admission-rejected future —
+        for callers that hold their own lock across the enqueue (the
+        Executor's shutdown-atomic submit) and must run
+        :meth:`reject_admission` after releasing it: resolving a
+        future runs client done-callbacks, and a callback that
+        re-enters the Executor would deadlock on its non-reentrant
+        lock."""
         b = np.asarray(b)
         vector = b.ndim == 1
         b2 = b[:, None] if vector else b
@@ -116,14 +187,24 @@ class Batcher:
             key = (handle, tuple(b2.shape), str(b2.dtype))
         req = _Request(b2, vector, Future(), time.monotonic(),
                        handle=handle)
+        if timeout_s is not None:
+            req.deadline = req.t_submit + timeout_s
         self.session.metrics.inc("requests_total")
+        pol = self.shed_policy
         with self._lock:
+            if (pol is not None and pol.max_queue_depth is not None
+                    and self._depth >= pol.max_queue_depth):
+                return req, RequestShed(
+                    f"admission control: queue depth >= "
+                    f"{pol.max_queue_depth}; request rejected at the "
+                    "door (retry with backoff)")
             bucket = self._buckets.setdefault(key, [])
             bucket.append(req)
             # cheap incremental gauge publish (one batched metrics-
-            # lock hold, no full-queue scan on the enqueue hot path);
-            # oldest_request_age_s is as of the last queue transition
-            # — pop_ready and backpressure() recompute it exactly
+            # lock hold, no full-queue scan on the enqueue hot
+            # path); oldest_request_age_s is as of the last queue
+            # transition — pop_ready and backpressure() recompute
+            # it exactly
             self._depth += 1
             self._max_backlog = max(self._max_backlog, len(bucket))
             if self._oldest is None:
@@ -134,13 +215,32 @@ class Batcher:
                 "max_bucket_backlog": self._max_backlog,
                 "oldest_request_age_s": req.t_submit - self._oldest,
             })
-        return req.future
+        return req, None
+
+    def reject_admission(self, req: _Request, rejection: Exception):
+        """Resolve an admission-rejected request (call with NO locks
+        held — set_exception may run client callbacks)."""
+        self.session.metrics.inc("admission_rejected_total")
+        req.future.set_exception(rejection)
 
     def pending(self) -> int:
         with self._lock:
             return sum(len(v) for v in self._buckets.values())
 
     # -- backpressure telemetry (round 12) ---------------------------------
+
+    @staticmethod
+    def _head_submit(reqs) -> Optional[float]:
+        """Submit time of the oldest LIVE request in a bucket: a
+        cancelled-but-undetached request must not pin
+        ``oldest_request_age_s`` high (it costs nothing to leave
+        queued and nothing to skip at dispatch) — before this, one
+        abandoned future could hold the age gauge at its own age
+        forever and trigger spurious load shedding."""
+        for r in reqs:
+            if not r.future.cancelled():
+                return r.t_submit
+        return None
 
     def _update_backpressure_locked(self, now: Optional[float] = None):
         """Caller holds the lock. Publish the queue's truth as gauges —
@@ -155,8 +255,9 @@ class Batcher:
         depths = [len(v) for v in self._buckets.values() if v]
         self._depth = sum(depths)
         self._max_backlog = max(depths, default=0)
-        self._oldest = min((reqs[0].t_submit
-                            for reqs in self._buckets.values() if reqs),
+        heads = [self._head_submit(reqs)
+                 for reqs in self._buckets.values() if reqs]
+        self._oldest = min((h for h in heads if h is not None),
                            default=None)
         m.set_gauges({
             "queue_depth": self._depth,
@@ -172,10 +273,15 @@ class Batcher:
         breakdown a debugger wants)."""
         now = time.monotonic()
         with self._lock:
-            per_bucket = {
-                repr(key): {"backlog": len(reqs),
-                            "oldest_age_s": now - reqs[0].t_submit}
-                for key, reqs in self._buckets.items() if reqs}
+            per_bucket = {}
+            for key, reqs in self._buckets.items():
+                if not reqs:
+                    continue
+                head = self._head_submit(reqs)  # cancelled excluded
+                per_bucket[repr(key)] = {
+                    "backlog": len(reqs),
+                    "oldest_age_s": (0.0 if head is None
+                                     else now - head)}
         return {
             "queue_depth": sum(v["backlog"] for v in per_bucket.values()),
             "queued_buckets": len(per_bucket),
@@ -188,23 +294,50 @@ class Batcher:
     # -- readiness ---------------------------------------------------------
 
     def next_deadline(self) -> Optional[float]:
-        """Earliest monotonic time any bucket must dispatch, or None."""
+        """Earliest monotonic time the worker must act: a bucket's
+        max-wait dispatch deadline or a request's own deadline,
+        whichever is sooner — so an expiring request fails fast at its
+        deadline instead of at the next bucket flush (and an IDLE
+        worker sleeps untimed instead of polling)."""
         with self._lock:
-            oldest = [reqs[0].t_submit for reqs in self._buckets.values()
-                      if reqs]
-        if not oldest:
-            return None
-        return min(oldest) + self.max_wait
+            vals = []
+            for reqs in self._buckets.values():
+                if not reqs:
+                    continue
+                vals.append(reqs[0].t_submit + self.max_wait)
+                vals.extend(r.deadline for r in reqs
+                            if r.deadline is not None)
+        return min(vals) if vals else None
 
-    def pop_ready(self, now: Optional[float] = None, force: bool = False
+    def pop_ready(self, now: Optional[float] = None, force: bool = False,
+                  expired_out: Optional[List[_Request]] = None
                   ) -> List[Tuple[BucketKey, List[_Request]]]:
         """Detach buckets that are full or past deadline (all of them
-        when ``force``). Requests beyond max_batch stay queued."""
+        when ``force``). Requests beyond max_batch stay queued.
+        Requests past their OWN deadline leave the queue here and fail
+        fast (counted, span-annotated) — they never occupy a batch
+        lane, and a bucket holding only expired/cancelled requests
+        drains without dispatching. ``expired_out``: collect the
+        expired requests instead of failing them here — for callers
+        that hold a lock of their own (the Executor worker) and must
+        run :meth:`_fail_expired` after releasing it (resolving a
+        future runs client callbacks)."""
         now = time.monotonic() if now is None else now
         out: List[Tuple[BucketKey, List[_Request]]] = []
+        expired: List[_Request] = []
         with self._lock:
             for key in list(self._buckets):
                 reqs = self._buckets[key]
+                if any(r.deadline is not None and r.deadline <= now
+                       for r in reqs):
+                    live = []
+                    for r in reqs:
+                        if (r.deadline is not None and r.deadline <= now
+                                and not r.future.done()):
+                            expired.append(r)
+                        else:
+                            live.append(r)
+                    self._buckets[key] = reqs = live
                 while (len(reqs) >= self.max_batch
                        or (reqs and force)
                        or (reqs and now - reqs[0].t_submit >= self.max_wait)):
@@ -213,9 +346,133 @@ class Batcher:
                     self._buckets[key] = reqs = rest
                 if not reqs:
                     del self._buckets[key]
-            if out:
+            if out or expired:
                 self._update_backpressure_locked(now)
+        if expired_out is None:
+            self._fail_expired(expired, now)
+        else:
+            expired_out.extend(expired)
         return out
+
+    def _fail_expired(self, reqs: List[_Request], now: float):
+        """Fail deadline-expired requests fast (outside the queue
+        lock: resolving a future can run client callbacks). Counted
+        (``deadline_expired_total``), span-annotated, and recorded to
+        the SLO error stream — an expiry is a client-visible failure."""
+        if not reqs:
+            return
+        m = self.session.metrics
+        tr = self.session.tracer
+        slo = self.session.slo
+        for r in reqs:
+            err = DeadlineExceeded(
+                f"deadline exceeded after {now - r.t_submit:.4f}s in "
+                "queue (failed fast without occupying a batch lane)")
+            try:
+                r.future.set_exception(err)
+            except InvalidStateError:
+                continue  # client cancelled first; counted elsewhere
+            m.inc("deadline_expired_total")
+            if tr.enabled:
+                sp = r.span or tr.start_span(
+                    "serve.request", kind="request",
+                    handle=repr(r.handle), queue_s=now - r.t_submit)
+                tr.finish_span(sp, error=err, deadline_expired=True)
+                r.span = None
+            if slo is not None:
+                meta = self.session.op_meta(r.handle)
+                if meta is not None:
+                    slo.record_request(meta[0], meta[1],
+                                       now - r.t_submit, ok=False)
+
+    # -- admission control + load shedding (round 14) ----------------------
+
+    def maybe_shed(self, now: Optional[float] = None) -> int:
+        """The load-shedding reflex, driven by the Executor worker each
+        wakeup (one is-None check when no policy). When an overload
+        trigger fires — ``oldest_request_age_s`` past ``max_age_s``,
+        or the SLO tracker's worst short-window burn rate past
+        ``burn_threshold`` — drop ``shed_fraction`` of the queue,
+        CHEAPEST-TO-RECOMPUTE FIRST (``Session.recompute_cost``: a
+        request against a resident factor re-costs one solve; a cold
+        one re-costs factor + solve), failing the shed futures with
+        :class:`~.faults.RequestShed`. Returns the number shed."""
+        pol = self.shed_policy
+        if pol is None:
+            return 0
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            depth, oldest = self._depth, self._oldest
+        if depth < max(pol.min_queue_depth, 1):
+            self.session.metrics.set_gauge("shedding_active", 0.0)
+            return 0
+        trigger = None
+        if (pol.max_age_s is not None and oldest is not None
+                and now - oldest > pol.max_age_s):
+            trigger = f"oldest_request_age_s > {pol.max_age_s}"
+        if trigger is None and pol.burn_threshold is not None:
+            slo = self.session.slo
+            if (slo is not None
+                    and now - self._last_burn_check
+                    >= pol.check_interval_s):
+                self._last_burn_check = now
+                burn = slo.worst_burn_rate(now=now)
+                if burn > pol.burn_threshold:
+                    trigger = (f"slo burn rate {burn:.3g} > "
+                               f"{pol.burn_threshold}")
+        if trigger is None:
+            self.session.metrics.set_gauge("shedding_active", 0.0)
+            return 0
+        victims: List[_Request] = []
+        with self._lock:
+            queued = [(key, r) for key, reqs in self._buckets.items()
+                      for r in reqs if not r.future.done()]
+            # the floor: never shed below min_queue_depth live
+            # requests (the docstring contract)
+            n_shed = min(max(1, int(len(queued) * pol.shed_fraction)),
+                         len(queued) - max(pol.min_queue_depth, 1))
+            if n_shed <= 0:
+                self.session.metrics.set_gauge("shedding_active", 0.0)
+                return 0
+            # cheapest-to-recompute first; newest first among equals
+            # (the oldest requests are closest to being served)
+            queued.sort(key=lambda kr: (
+                self.session.recompute_cost(kr[1].handle,
+                                            kr[1].b.shape[1]),
+                -kr[1].t_submit))
+            chosen = queued[:n_shed]
+            drop = {id(r) for _, r in chosen}
+            for key in list(self._buckets):
+                kept = [r for r in self._buckets[key]
+                        if id(r) not in drop]
+                if kept:
+                    self._buckets[key] = kept
+                else:
+                    del self._buckets[key]
+            victims = [r for _, r in chosen]
+            self._update_backpressure_locked(now)
+        m = self.session.metrics
+        m.inc("load_sheds_total")
+        m.set_gauge("shedding_active", 1.0)
+        tr = self.session.tracer
+        shed = 0
+        for r in victims:
+            try:
+                r.future.set_exception(RequestShed(
+                    f"load shed ({trigger}); cheapest-to-recompute "
+                    "first per the session cost log — retry with "
+                    "backoff"))
+            except InvalidStateError:
+                continue  # cancelled concurrently
+            shed += 1
+            if tr.enabled:
+                sp = r.span or tr.start_span(
+                    "serve.request", kind="request",
+                    handle=repr(r.handle), queue_s=now - r.t_submit)
+                tr.finish_span(sp, shed=True)
+                r.span = None
+        m.inc("shed_requests_total", shed)
+        return shed
 
     # -- dispatch ----------------------------------------------------------
 
@@ -237,11 +494,11 @@ class Batcher:
         if key and key[0] is _SMALL:
             return self._run_small(key, reqs)
         handle = key[0]
-        live = [r for r in reqs if not r.future.done()]
+        now = time.monotonic()
+        live = self._live(reqs, now)
         if not live:
             return
         tr = self.session.tracer
-        now = time.monotonic()
         bctx = (tr.span("serve.batch", handle=repr(handle),
                         batch_size=len(live), shape=list(key[1]),
                         dtype=key[2]) if tr.enabled else _NOOP_SPAN)
@@ -320,6 +577,7 @@ class Batcher:
                     tr.finish_span(r.span, cancelled=True)
                     continue
                 lat = done - r.t_submit
+                m.inc("completed_requests")
                 m.observe("request_latency", lat, exemplar=tid)
                 if meta is not None:
                     slo.record_request(meta[0], meta[1], lat, ok=True)
@@ -330,6 +588,22 @@ class Batcher:
             # split/copy/notify reply cost, once per batch)
             m.observe("stage_reply", time.monotonic() - done,
                       exemplar=tid)
+
+    def _live(self, reqs: List[_Request], now: float) -> List[_Request]:
+        """Dispatch-start filter: drop already-resolved requests and
+        fail the deadline-expired ones fast (a request can expire
+        between detach and dispatch — e.g. while an earlier bucket
+        retried through backoff)."""
+        live, expired = [], []
+        for r in reqs:
+            if r.future.done():
+                continue
+            if r.deadline is not None and r.deadline <= now:
+                expired.append(r)
+            else:
+                live.append(r)
+        self._fail_expired(expired, now)
+        return live
 
     def _run_small(self, key: BucketKey, reqs: List[_Request]):
         """Grouped small-problem dispatch: one bucket of DISTINCT-
@@ -345,11 +619,11 @@ class Batcher:
         # fixed head and tail, tolerate the optional middle
         op, n = key[1], key[2]
         shape, bdt = key[-2], key[-1]
-        live = [r for r in reqs if not r.future.done()]
+        now = time.monotonic()
+        live = self._live(reqs, now)
         if not live:
             return
         tr = self.session.tracer
-        now = time.monotonic()
         bctx = (tr.span("serve.batch", op=op, n=n, grouped=True,
                         batch_size=len(live), shape=list(shape),
                         dtype=bdt) if tr.enabled else _NOOP_SPAN)
@@ -382,6 +656,7 @@ class Batcher:
                         f"failed (info={infos[i]})")
                     try:
                         r.future.set_exception(err)
+                        m.inc("failed_requests_total")
                     except InvalidStateError:
                         m.inc("cancelled_requests")
                     if slo is not None:
@@ -397,12 +672,71 @@ class Batcher:
                     tr.finish_span(r.span, cancelled=True)
                     continue
                 lat = done - r.t_submit
+                m.inc("completed_requests")
                 m.observe("request_latency", lat, exemplar=tid)
                 if slo is not None:
                     slo.record_request(op, n, lat, ok=True)
                 tr.finish_span(r.span, total_s=lat)
             m.observe("stage_reply", time.monotonic() - done,
                       exemplar=tid)
+
+    def run_degraded(self, key: BucketKey, reqs: List[_Request]):
+        """The per-request rung of the degradation ladder
+        (grouped→per_request, dense→per_request — faults.
+        DEGRADATION_LADDER), walked by the Executor when a bucket's
+        circuit breaker is open: every live request runs as its OWN
+        Session.solve, so one poisoned lane (or a failure mode the
+        coalesced program tickles) cannot fail its neighbors.
+        Per-item isolation: a request whose own solve raises fails its
+        own future; the rest are served. Futures resolve exactly once
+        (already-done requests skipped, the run() discipline)."""
+        m = self.session.metrics
+        tr = self.session.tracer
+        slo = self.session.slo
+        now = time.monotonic()
+        live = self._live(reqs, now)
+        if not live:
+            return
+        m.inc("degraded_dispatches_total")
+        bctx = (tr.span("serve.batch.degraded", batch_size=len(live),
+                        ladder="per_request")
+                if tr.enabled else _NOOP_SPAN)
+        with bctx as bspan:
+            tid = getattr(bspan, "trace_id", None)
+            for r in live:
+                if r.span is None:
+                    r.span = tr.start_span(
+                        "serve.request", parent=bspan, kind="request",
+                        handle=repr(r.handle), degraded=True,
+                        queue_s=now - r.t_submit)
+                meta = self.session.op_meta(r.handle)
+                try:
+                    x = self.session.solve(r.handle, r.b)
+                except Exception as e:  # noqa: BLE001 — per-item isolation
+                    try:
+                        r.future.set_exception(e)
+                        m.inc("failed_requests_total")
+                    except InvalidStateError:
+                        m.inc("cancelled_requests")
+                    if slo is not None and meta is not None:
+                        slo.record_request(
+                            meta[0], meta[1],
+                            time.monotonic() - r.t_submit, ok=False)
+                    tr.finish_span(r.span, error=e)
+                    continue
+                done = time.monotonic()
+                try:
+                    r.future.set_result(x[:, 0] if r.vector else x)
+                except InvalidStateError:
+                    m.inc("cancelled_requests")
+                    tr.finish_span(r.span, cancelled=True)
+                    continue
+                lat = done - r.t_submit
+                m.inc("completed_requests")
+                m.observe("request_latency", lat, exemplar=tid)
+                if slo is not None and meta is not None:
+                    slo.record_request(meta[0], meta[1], lat, ok=True)
+                tr.finish_span(r.span, total_s=lat)
 
     def flush(self):
         """Synchronously dispatch everything pending (caller's thread)."""

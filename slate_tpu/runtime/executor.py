@@ -3,10 +3,18 @@
 An ``Executor`` owns a background worker thread that drives the
 Batcher: callers ``submit(handle, b)`` and get a
 ``concurrent.futures.Future``; the worker sleeps until a bucket is full
-or its max-wait deadline expires, then dispatches it as one stacked
-Session solve. Transient dispatch failures (a flaky device tunnel, an
-interrupted transfer) are retried a bounded number of times before the
-batch's futures are failed.
+or its max-wait deadline expires (or a per-request deadline needs
+failing fast), then dispatches it as one stacked Session solve.
+
+Failure reflexes (round 14): transient dispatch failures (a flaky
+device tunnel, an interrupted transfer) are retried with EXPONENTIAL
+BACKOFF + JITTER; a per-(op, n) CIRCUIT BREAKER trips after repeated
+dispatch failures and walks the declared degradation ladder
+(``faults.DEGRADATION_LADDER``) instead of retry-storming a sick
+path — grouped/dense buckets replay per-request, mixed operators
+demote to working precision, mesh operators reject with a clear
+error. The worker also drives the Batcher's load-shedding reflex
+(one is-None check per wakeup when no ShedPolicy is set).
 
 ``warmup`` is the AOT path: for each registered shape bucket it factors
 the operator and ``jit(...).lower(...).compile()``s the solve off the
@@ -16,13 +24,65 @@ factorization nor compilation.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from concurrent.futures import Future
-from typing import Hashable, Iterable, Optional
+from concurrent.futures import Future, InvalidStateError
+from typing import Hashable, Iterable, Optional, Tuple
 
-from .batching import Batcher
+from ..core.exceptions import SlateError
+from .batching import Batcher, ShedPolicy, _SMALL
+from .faults import DEGRADATION_LADDER
 from .session import Session
+
+
+class _Breaker:
+    """Per-(op, n) circuit breaker. Touched ONLY by the Executor's
+    single worker thread (dispatch is serialized), so no lock.
+
+    closed → open after ``threshold`` consecutive final (post-retry)
+    transient dispatch failures; open → half_open after ``cooldown_s``
+    (one probe dispatch allowed through the normal path); the probe's
+    outcome closes or re-opens. While open, buckets walk the
+    degradation ladder instead of touching the failing path."""
+
+    __slots__ = ("threshold", "cooldown_s", "failures", "state",
+                 "opened_at")
+
+    def __init__(self, threshold: int, cooldown_s: float):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.failures = 0
+        self.state = "closed"
+        self.opened_at = 0.0
+
+    def allow(self, now: float) -> bool:
+        if self.state == "closed":
+            return True
+        if self.state == "open" and now - self.opened_at \
+                >= self.cooldown_s:
+            self.state = "half_open"
+            return True  # the probe
+        return False
+
+    def record_ok(self):
+        self.failures = 0
+        was = self.state
+        self.state = "closed"
+        return was != "closed"
+
+    def record_failure(self, now: float) -> bool:
+        """Returns True when this failure TRIPS the breaker open."""
+        self.failures += 1
+        if self.state == "half_open" or (self.state == "closed"
+                                         and self.failures
+                                         >= self.threshold):
+            self.state = "open"
+            self.opened_at = now
+            return True
+        if self.state == "open":
+            self.opened_at = now
+        return False
 
 
 class Executor:
@@ -36,15 +96,35 @@ class Executor:
             ex.warmup([h])
             futs = [ex.submit(h, b) for b in rhs_stream]
             xs = [f.result() for f in futs]
-    """
+
+    ``retries`` bounds the transient-failure retry count per bucket;
+    each retry sleeps ``backoff_base · 2^attempt`` (capped at
+    ``backoff_max``) with multiplicative jitter in [0.5, 1.0) —
+    deterministic when the session carries a FaultInjector, so chaos
+    runs replay bit-for-bit. ``breaker_threshold`` consecutive
+    exhausted-retry failures on one (op, n) trip its circuit breaker
+    for ``breaker_cooldown`` seconds (see module docstring).
+    ``shed_policy`` is handed to the Batcher (admission control +
+    load shedding); ``timeout_s`` on submit is the per-request
+    deadline."""
 
     def __init__(self, session: Session, max_batch: int = 32,
                  max_wait: float = 2e-3, retries: int = 2,
-                 pad_widths: bool = False):
+                 pad_widths: bool = False,
+                 backoff_base: float = 0.01, backoff_max: float = 0.5,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown: float = 1.0,
+                 shed_policy: Optional[ShedPolicy] = None):
         self.session = session
         self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self._breakers: dict = {}
         self.batcher = Batcher(session, max_batch=max_batch,
-                               max_wait=max_wait, pad_widths=pad_widths)
+                               max_wait=max_wait, pad_widths=pad_widths,
+                               shed_policy=shed_policy)
         self._cv = threading.Condition()
         self._stop = False
         self._inflight = 0  # batches detached from the Batcher, unsolved
@@ -54,17 +134,24 @@ class Executor:
 
     # -- client surface ----------------------------------------------------
 
-    def submit(self, handle: Hashable, b) -> Future:
+    def submit(self, handle: Hashable, b,
+               timeout_s: Optional[float] = None) -> Future:
         """Enqueue one solve request; never blocks on the device. The
         shutdown check and the enqueue are one atomic step under the
         lock, so a request can never land in a drained Batcher after
-        the worker has exited (its Future would hang forever)."""
+        the worker has exited (its Future would hang forever).
+        ``timeout_s``: per-request deadline (Batcher.submit)."""
         with self._cv:
             if self._stop:
                 raise RuntimeError("Executor is shut down")
-            fut = self.batcher.submit(handle, b)
+            req, rejection = self.batcher.submit_deferred(
+                handle, b, timeout_s=timeout_s)
             self._cv.notify_all()
-        return fut
+        if rejection is not None:
+            # resolve OUTSIDE the lock: a done-callback that re-enters
+            # submit() would deadlock on the non-reentrant _cv
+            self.batcher.reject_admission(req, rejection)
+        return req.future
 
     def warmup(self, handles: Iterable[Hashable], nrhs: int = 1):
         """AOT compile the solve for each handle's (rows, nrhs) bucket
@@ -75,11 +162,21 @@ class Executor:
 
     def flush(self):
         """Block until everything queued at call time has been solved
-        (queued buckets AND batches already detached to the worker)."""
+        (queued buckets AND batches already detached to the worker).
+        Waits on the true next Batcher deadline instead of a fixed
+        poll (the old 0.05 s timeout woke an idle caller 20×/s): the
+        worker notifies after every dispatch and every queue
+        transition notifies on submit, so the deadline wait is only
+        the backstop for the bucket/request deadlines themselves."""
         with self._cv:
             self._cv.notify_all()
             while self.batcher.pending() or self._inflight:
-                self._cv.wait(timeout=0.05)
+                deadline = self.batcher.next_deadline()
+                if deadline is None:
+                    self._cv.wait()
+                else:
+                    self._cv.wait(max(deadline - time.monotonic(), 0.0)
+                                  + 1e-3)
 
     def shutdown(self, wait: bool = True):
         """Stop the worker; pending requests are force-dispatched."""
@@ -112,13 +209,27 @@ class Executor:
                 stopping = self._stop
                 # detach + count in-flight under the SAME lock hold, so
                 # flush() never observes pending()==0 while a batch sits
-                # between pop_ready and dispatch
-                batches = self.batcher.pop_ready(force=stopping)
+                # between pop_ready and dispatch. Expired requests are
+                # COLLECTED here and failed after the lock drops:
+                # set_exception runs client done-callbacks, and one
+                # that re-enters submit() would deadlock on _cv
+                expired = []
+                batches = self.batcher.pop_ready(force=stopping,
+                                                 expired_out=expired)
                 self._inflight += len(batches)
                 if batches:
                     self.session.metrics.set_gauge("inflight_batches",
                                                    self._inflight)
+            if expired:
+                self.batcher._fail_expired(expired, time.monotonic())
+            # the load-shedding reflex: one is-None check per wakeup
+            # when no policy is configured (Batcher.maybe_shed) — and
+            # re-checked between dispatches, because requests that
+            # arrive while a long batch executes queue up behind it
+            # (the exact population an overload shed must reach)
+            self.batcher.maybe_shed()
             for key, reqs in batches:
+                self.batcher.maybe_shed()
                 try:
                     self._dispatch(key, reqs)
                 finally:
@@ -132,12 +243,50 @@ class Executor:
                     if not self.batcher.pending() and not self._inflight:
                         return
 
+    # -- dispatch: retry, breaker, degradation ladder ----------------------
+
+    def _breaker_key(self, key) -> Optional[Tuple[str, int]]:
+        """(op, n) identity of a bucket — the circuit breaker's grain:
+        a sick compiled program family is an (op, shape) property, not
+        a per-handle one."""
+        if key and key[0] is _SMALL:
+            return (key[1], key[2])
+        meta = self.session.op_meta(key[0])
+        return meta  # None for unknown handles (deterministic failure)
+
+    def _publish_breakers(self):
+        self.session.metrics.set_gauge(
+            "circuit_breakers_open",
+            sum(1 for b in self._breakers.values()
+                if b.state != "closed"))
+
+    def _backoff_sleep(self, attempt: int):
+        """Exponential backoff with jitter before a retry. Jitter is
+        multiplicative in [0.5, 1.0) — deterministic (injector-keyed)
+        when fault injection is attached, so a chaos soak's retry
+        timing replays."""
+        delay = min(self.backoff_base * (2.0 ** attempt),
+                    self.backoff_max)
+        inj = self.session.faults
+        u = inj.uniform("backoff") if inj is not None else random.random()
+        delay *= 0.5 + 0.5 * u
+        self.session.metrics.observe("retry_backoff_s", delay)
+        time.sleep(delay)
+
     def _dispatch(self, key, reqs):
-        """Run one bucket with bounded retry on TRANSIENT dispatch
-        failure (flaky tunnel, interrupted transfer). SlateError is
-        deterministic — unknown handle, factorization info≠0 — and
-        fails fast without retrying or touching the retries metric
-        (DESIGN.md: retry covers dispatch, not numerical failure).
+        """Run one bucket with exponential-backoff retry on TRANSIENT
+        dispatch failure (flaky tunnel, interrupted transfer).
+        SlateError is deterministic — unknown handle, factorization
+        info≠0 — and fails fast without retrying or touching the
+        retries metric (DESIGN.md: retry covers dispatch, not
+        numerical failure).
+
+        Circuit breaker: retry exhaustion records a failure against
+        the bucket's (op, n) breaker; when the breaker TRIPS (or is
+        already open) the bucket walks the degradation ladder
+        (``faults.DEGRADATION_LADDER``) instead of failing its
+        futures: grouped/dense → per-request replay, mixed →
+        working-precision demotion, mesh → reject with a clear error.
 
         Error capture (obs): a failed attempt's request spans are
         closed with the exception (status="error") by Batcher.run —
@@ -145,9 +294,20 @@ class Executor:
         properly nested — and each attempt opens fresh spans, so a
         retried request shows one errored span per failed attempt plus
         the final one."""
-        from ..core.exceptions import SlateError
-
+        m = self.session.metrics
         tr = self.session.tracer
+        now = time.monotonic()
+        bk = self._breaker_key(key)
+        br = self._breakers.get(bk) if bk is not None else None
+        if br is not None and not br.allow(now):
+            # open breaker: never touch the failing path — straight to
+            # the degraded lane (fail-fast for mesh)
+            m.inc("breaker_short_circuits")
+            self._dispatch_degraded(key, reqs, None)
+            return
+        probing = br is not None and br.state == "half_open"
+        if probing:
+            m.inc("breaker_probes_total")
 
         def _fail_spans(e, attempt):
             for r in reqs:
@@ -162,6 +322,9 @@ class Executor:
         for attempt in range(self.retries + 1):
             try:
                 self.batcher.run(key, reqs)
+                if br is not None and br.record_ok():
+                    m.inc("breaker_closes_total")
+                    self._publish_breakers()
                 return
             except SlateError as e:
                 err = e
@@ -171,21 +334,90 @@ class Executor:
                 err = e
                 _fail_spans(e, attempt)
                 if attempt < self.retries:
-                    self.session.metrics.inc("retries")
+                    m.inc("retries")
+                    self._backoff_sleep(attempt)
+        if err is not None and not isinstance(err, SlateError) \
+                and bk is not None:
+            # transient failure survived every retry: charge the breaker
+            if br is None:
+                br = self._breakers[bk] = _Breaker(
+                    self.breaker_threshold, self.breaker_cooldown)
+            if br.record_failure(time.monotonic()):
+                m.inc("breaker_trips_total")
+                self._publish_breakers()
+                from ..obs.tracing import log as _obs_log
+                _obs_log.warning(
+                    "circuit breaker OPEN for %s after %d consecutive "
+                    "dispatch failures; degrading per the ladder %s",
+                    bk, br.failures, DEGRADATION_LADDER)
+            if br.state == "open":
+                # the tripping bucket itself takes the degraded lane —
+                # its requests deserve the reflex, not the corpse of
+                # the retry loop
+                self._dispatch_degraded(key, reqs, err)
+                return
+        self._fail_batch(key, reqs, err)
+
+    def _degrade_family(self, key) -> Optional[str]:
+        """DEGRADATION_LADDER family of a bucket key (grouped buckets
+        classify themselves; handle buckets ask the Session)."""
+        if key and key[0] is _SMALL:
+            return "grouped"
+        return self.session.degrade_class(key[0])
+
+    def _dispatch_degraded(self, key, reqs, err):
+        """Walk one rung of faults.DEGRADATION_LADDER for a bucket
+        whose breaker is open. Counted per rung; futures resolve
+        exactly once either way."""
+        m = self.session.metrics
+        family = self._degrade_family(key)
+        rung = DEGRADATION_LADDER.get(family or "", None)
+        if rung == "per_request":
+            # grouped/dense → per-request: B independent solves with
+            # per-item isolation (Batcher.run_degraded)
+            self.batcher.run_degraded(key, reqs)
+            return
+        if rung == "working_precision":
+            # mixed → working precision: demote the operator (evict the
+            # lo resident, deactivate refine) and replay per-request at
+            # full precision
+            self.session.demote_to_working_precision(key[0])
+            self.batcher.run_degraded(key, reqs)
+            return
+        if rung == "reject":
+            # mesh → reject: a sharded program has no cheaper
+            # single-chip form of itself; fail fast with a clear error
+            # instead of retry-storming a sick mesh
+            m.inc("breaker_rejections_total")
+            self._fail_batch(key, reqs, SlateError(
+                f"circuit breaker open for mesh bucket {key!r}: "
+                "degradation ladder is mesh→reject (no single-device "
+                "degraded form of a sharded program) — re-register the "
+                "operator without a mesh or retry after the cooldown"))
+            return
+        # unknown family (unregistered handle mid-flight): fail with
+        # the original error — the deterministic path
+        self._fail_batch(key, reqs, err if err is not None else
+                         SlateError(f"Session: unknown bucket {key!r}"))
+
+    def _fail_batch(self, key, reqs, err):
+        """Final failure: fail every still-unresolved future with
+        ``err`` and record the SLO error events (the round-12
+        accounting: cancelled/already-resolved requests are NOT
+        service failures)."""
         self.session.metrics.inc("failed_batches")
         slo = self.session.slo
         now = time.monotonic()
         for r in reqs:
-            # cancelled/already-resolved requests are NOT service
-            # failures — the success path skips them symmetrically
-            # (Batcher.run's cancelled `continue`), so the SLO error
-            # stream only counts requests this failure actually failed
             was_done = r.future.done()
             try:
                 if not was_done:
                     r.future.set_exception(err)
-            except Exception:  # client cancelled concurrently — same
-                pass           # race Batcher.run guards on set_result
+                    self.session.metrics.inc("failed_requests_total")
+            except InvalidStateError:
+                pass  # client cancelled concurrently — same race
+            except Exception:   # pragma: no cover - legacy guard
+                pass            # (Batcher.run guards set_result alike)
             if slo is not None and not was_done:
                 # the final (post-retry) failure is the SLO error event
                 meta = self.session.op_meta(getattr(r, "handle", None))

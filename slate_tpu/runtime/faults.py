@@ -1,0 +1,303 @@
+"""Deterministic fault injection + the serving degradation ladder.
+
+SLATE inherits MPI's failure model: a failed rank aborts the job, so
+the reference never needs to *decide* anything when hardware misbehaves.
+A serving fleet does — and until now every failure path in the runtime
+(Executor retry, refine fallback, grouped-bucket degradation,
+eviction-under-pressure) could only be exercised by hand-crafted unit
+fixtures. This module makes failure a first-class, *reproducible* input:
+
+* :class:`FaultSpec` / :class:`FaultPlan` — a declarative, seeded
+  schedule of fault classes (transient dispatch failures, slow-device
+  latency, compile stalls, HBM-budget exhaustion, singular/
+  non-convergent low-precision operands, dropped fleet snapshots);
+* :class:`FaultInjector` — the runtime-side evaluator the Session
+  consults at its seams. Decisions are a PURE FUNCTION of
+  ``(seed, kind, per-site sequence number)`` (a keyed hash, not a
+  shared RNG stream), so two runs that present the same opportunity
+  sequence fire the same faults **regardless of thread interleaving**
+  — the property ``tools/chaos_serve.py`` exit-gates on
+  (``schedule_digest`` equality across same-seed runs);
+* the serving-reflex exceptions (:class:`TransientDispatchError`,
+  :class:`DeadlineExceeded`, :class:`RequestShed`) raised/failed-into
+  futures by the Batcher/Executor reflexes this round adds;
+* :data:`DEGRADATION_LADDER` — the declared next-rung-down per serving
+  path, promoted from the round-13 ad-hoc ``_serve_small_per_request``
+  escape hatch into policy the Executor's circuit breaker walks.
+
+Hot-path discipline (the round-8 tracer rule, extended here by test):
+``session.faults`` defaults to ``None`` and every seam guards with ONE
+``faults is None`` check — injection disabled costs nothing and calls
+nothing in this module.
+
+This module itself is stdlib-only (no jax, no numpy beyond the package
+``SlateError`` base): the injector adds no import weight to the
+runtime, and the decision math is portable to any driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from ..core.exceptions import SlateError
+
+# every fault class the injector can schedule; chaos_serve's acceptance
+# requires >= 4 of them enabled simultaneously
+KINDS = (
+    "dispatch_error",      # transient dispatch failure (flaky tunnel /
+                           # interrupted transfer) -> retryable raise
+    "slow_device",         # added dispatch latency (a contended or
+                           # thermally-throttled chip)
+    "compile_stall",       # added latency at the AOT compile seam
+    "hbm_exhaustion",      # budget collapses to 0 for one insert ->
+                           # eviction-under-pressure
+    "lo_factor_fail",      # the low-precision factor comes back
+                           # singular -> counted refine fallback
+    "refine_no_converge",  # iterative refinement stagnates -> counted
+                           # refine fallback
+    "snapshot_drop",       # a process snapshot never reaches the fleet
+                           # aggregator
+)
+
+# seam name -> fault kinds evaluated there. The Session/chaos runner
+# consult sites, not kinds, so one seam check covers every class that
+# can fire at it.
+SITES: Dict[str, Tuple[str, ...]] = {
+    "dispatch": ("dispatch_error", "slow_device"),
+    "compile": ("compile_stall",),
+    "hbm": ("hbm_exhaustion",),
+    "refine.lo_factor": ("lo_factor_fail",),
+    "refine.converge": ("refine_no_converge",),
+    "snapshot": ("snapshot_drop",),
+}
+
+# The declared degradation ladder (tentpole): when a serving path keeps
+# failing (circuit breaker open), this is the next rung down — never a
+# wrong answer, always a counted, observable decision. Promoted from
+# round 10/13's ad-hoc escapes (``Session._serve_small_per_request``,
+# the refine fallback) into policy the Executor walks:
+#
+#   grouped  -> per_request         one batched program per bucket
+#                                   degrades to B independent solves
+#                                   (per-item isolation; the round-10
+#                                   degraded lane, now breaker-driven)
+#   mixed    -> working_precision   refined-from-lo serving demotes to
+#                                   a working-precision refactor (the
+#                                   round-13 fallback, now also
+#                                   breaker-driven)
+#   dense    -> per_request         a coalesced dense bucket degrades
+#                                   to per-request solves
+#   mesh     -> reject              a sharded program has no cheaper
+#                                   single-chip form of itself — fail
+#                                   fast with a clear error instead of
+#                                   retry-storming a sick mesh
+DEGRADATION_LADDER: Dict[str, str] = {
+    "grouped": "per_request",
+    "mixed": "working_precision",
+    "dense": "per_request",
+    "mesh": "reject",
+}
+
+
+# -- serving-reflex exceptions ----------------------------------------------
+
+
+class TransientDispatchError(RuntimeError):
+    """A retryable dispatch failure (the class the Executor's
+    backoff+retry loop covers — deliberately NOT a SlateError, which
+    signals a deterministic failure and fails fast)."""
+
+
+class DeadlineExceeded(SlateError):
+    """The request's deadline passed before its solve dispatched; it
+    failed fast instead of occupying a batch lane. Deterministic from
+    the Executor's point of view: never retried."""
+
+
+class RequestShed(SlateError):
+    """The request was turned away (admission control) or dropped from
+    the queue (load shedding) to protect the SLO of the requests that
+    stay. Cheapest-to-recompute requests shed first — retrying is
+    expected to be cheap for the caller. Never retried server-side."""
+
+
+# -- the plan ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault class's schedule parameters.
+
+    ``rate`` is the per-opportunity firing probability (evaluated by
+    keyed hash — see module docstring). ``after`` skips the first N
+    opportunities at the kind's sites (lets a soak warm up cleanly);
+    ``count`` caps total firings (None = unlimited); ``latency_s`` is
+    the injected sleep for the latency-shaped kinds."""
+
+    kind: str
+    rate: float
+    latency_s: float = 0.0
+    after: int = 0
+    count: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"FaultSpec: unknown kind {self.kind!r} "
+                             f"(one of {KINDS})")
+        if not (0.0 <= self.rate <= 1.0):
+            raise ValueError(f"FaultSpec {self.kind}: rate must be in "
+                             f"[0, 1], got {self.rate}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A seed plus the fault classes to schedule under it. Immutable
+    and JSON-serializable, so a chaos artifact can embed the exact
+    plan that produced it and a rerun can replay it verbatim."""
+
+    seed: int
+    specs: Tuple[FaultSpec, ...]
+
+    def __post_init__(self):
+        kinds = [s.kind for s in self.specs]
+        if len(set(kinds)) != len(kinds):
+            raise ValueError(f"FaultPlan: duplicate kinds in {kinds}")
+
+    @property
+    def kinds(self) -> Tuple[str, ...]:
+        return tuple(s.kind for s in self.specs)
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "specs": [s.to_dict() for s in self.specs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls(seed=int(d["seed"]),
+                   specs=tuple(FaultSpec(**s) for s in d["specs"]))
+
+
+def _unit(seed: int, stream: str, seq: int) -> float:
+    """Deterministic uniform in [0, 1) keyed by (seed, stream, seq) —
+    a keyed hash, not an RNG stream, so one site's draw count never
+    shifts another site's decisions (the schedule-reproducibility
+    property chaos_serve gates on)."""
+    h = hashlib.blake2b(f"{seed}:{stream}:{seq}".encode(),
+                        digest_size=8).digest()
+    return int.from_bytes(h, "big") / 2.0 ** 64
+
+
+class FaultInjector:
+    """Runtime evaluator of a :class:`FaultPlan`.
+
+    The serving seams call :meth:`fire` with their site name; every
+    spec mapped to that site is evaluated against the site's own
+    monotone opportunity counter. Fired decisions are appended to
+    ``self.log`` — the deterministic fault schedule; two injectors
+    built from the same plan and presented the same per-site
+    opportunity sequences produce identical logs (pinned by test and
+    exit-gated by chaos_serve via :meth:`schedule_digest`).
+
+    Thread-safe: one lock around counter reads/bumps; decisions
+    themselves are pure functions of (seed, kind, seq).
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._by_site: Dict[str, Tuple[FaultSpec, ...]] = {
+            site: tuple(s for s in plan.specs if s.kind in kinds)
+            for site, kinds in SITES.items()}
+        self._lock = threading.Lock()
+        self._seq: Dict[str, int] = defaultdict(int)
+        self._fired: Dict[str, int] = defaultdict(int)
+        # the schedule: (site, kind, site-sequence) per firing, in
+        # firing order
+        self.log: List[Tuple[str, str, int]] = []
+
+    # -- the seam call ------------------------------------------------------
+
+    def fire(self, site: str) -> Tuple[FaultSpec, ...]:
+        """One opportunity at ``site``: bump the site counter and
+        return the specs that fire at this sequence number (possibly
+        empty). The caller applies the effects (sleep / raise / budget
+        collapse) — the injector only decides."""
+        specs = self._by_site.get(site)
+        if not specs:
+            with self._lock:
+                self._seq[site] += 1
+            return ()
+        fired = []
+        with self._lock:
+            seq = self._seq[site]
+            self._seq[site] = seq + 1
+            for spec in specs:
+                if seq < spec.after:
+                    continue
+                if spec.count is not None \
+                        and self._fired[spec.kind] >= spec.count:
+                    continue
+                if _unit(self.plan.seed, spec.kind, seq) < spec.rate:
+                    self._fired[spec.kind] += 1
+                    self.log.append((site, spec.kind, seq))
+                    fired.append(spec)
+        return tuple(fired)
+
+    def hook(self, site: str):
+        """A zero-arg bool callable for seams that take a plug-in hook
+        (refine/engine's ``drive(..., fault_hook=...)``)."""
+        return lambda: bool(self.fire(site))
+
+    def uniform(self, stream: str) -> float:
+        """Deterministic jitter draw (the Executor's backoff jitter
+        uses this when an injector is attached, so a chaos run's retry
+        timing is reproducible too)."""
+        with self._lock:
+            seq = self._seq[f"uniform:{stream}"]
+            self._seq[f"uniform:{stream}"] = seq + 1
+        return _unit(self.plan.seed, f"uniform:{stream}", seq)
+
+    # -- the schedule -------------------------------------------------------
+
+    def fired_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired)
+
+    def opportunity_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: v for k, v in self._seq.items()
+                    if not k.startswith("uniform:")}
+
+    def schedule(self) -> List[Tuple[str, str, int]]:
+        with self._lock:
+            return list(self.log)
+
+    def schedule_digest(self) -> str:
+        """Stable digest of the fault schedule — the reproducibility
+        token chaos_serve compares across same-seed runs and stamps
+        into the committed artifact."""
+        payload = json.dumps(self.schedule(), separators=(",", ":"))
+        return "sha256:" + hashlib.sha256(payload.encode()).hexdigest()
+
+
+def default_plan(seed: int = 1) -> FaultPlan:
+    """The chaos-soak default: every injectable class enabled at rates
+    tuned so a few-hundred-request soak exercises each reflex at least
+    once while most traffic still completes (the invariants need both
+    populations)."""
+    return FaultPlan(seed=seed, specs=(
+        FaultSpec("dispatch_error", rate=0.12),
+        FaultSpec("slow_device", rate=0.10, latency_s=2e-3),
+        FaultSpec("compile_stall", rate=0.5, latency_s=5e-3),
+        FaultSpec("hbm_exhaustion", rate=0.10),
+        FaultSpec("lo_factor_fail", rate=1.0, count=1),
+        FaultSpec("refine_no_converge", rate=1.0, count=1),
+        FaultSpec("snapshot_drop", rate=1.0, count=1),
+    ))

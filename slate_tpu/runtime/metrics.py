@@ -100,7 +100,19 @@ class Metrics:
       oom_risk_warnings, bytes_accessed_total, collective_bytes_total,
       padding_waste_flops / padding_waste_bytes (round 12: executed
       pow2-bucket/width padding split OUT of the useful-work counters),
-      slo_breaches_total, watchdog_anomalies_total
+      slo_breaches_total, watchdog_anomalies_total;
+      round-14 reflexes/conservation: completed_requests /
+      failed_requests_total / deadline_expired_total /
+      shed_requests_total / admission_rejected_total (+ the existing
+      cancelled_requests — together these partition requests_total,
+      the chaos-soak conservation invariant; the one deliberate gap
+      is a future the CLIENT cancelled while queued, which the
+      runtime skips without re-resolving or counting — the round-6
+      pinned convention), load_sheds_total,
+      degraded_dispatches_total, breaker_trips_total /
+      breaker_probes_total / breaker_closes_total /
+      breaker_short_circuits / breaker_rejections_total,
+      refine_demotions_total, faults_injected_total + fault:{kind}
     Histograms (seconds, except batch_size):
       solve_latency, factor_latency, request_latency, batch_size, and
       the round-12 request lifecycle stages — stage_queue_wait,
@@ -114,7 +126,8 @@ class Metrics:
       inflight_batches (Executor); bucket efficiency:
       width_bucket_efficiency / batch_bucket_efficiency (served ÷
       executed fraction of the last padded dispatch); slo_burn_rate:* /
-      slo_breached:* and watchdog_* (obs/slo.py, obs/watchdog.py)
+      slo_breached:* and watchdog_* (obs/slo.py, obs/watchdog.py);
+      round-14 reflexes: shedding_active, circuit_breakers_open
     """
 
     def __init__(self):

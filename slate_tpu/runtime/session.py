@@ -186,8 +186,16 @@ class Session:
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  mesh=None, slo=None,
-                 refine_policies: Optional[PolicyTable] = None):
+                 refine_policies: Optional[PolicyTable] = None,
+                 faults=None):
         self.hbm_budget = hbm_budget
+        # deterministic fault injection (round 14): None = disabled —
+        # every seam guards with ONE `faults is None` check, so the
+        # production hot path pays nothing (the round-8 tracer
+        # discipline, pinned by test). A runtime/faults.FaultInjector
+        # makes dispatch failures, slow devices, compile stalls, HBM
+        # exhaustion, and refine non-convergence reproducible inputs.
+        self.faults = faults
         self.opts = opts
         # mixed-precision policy table (round 13): register(...,
         # refine=True) resolves its RefinePolicy here per
@@ -220,6 +228,11 @@ class Session:
         # compiled program — model flops, XLA bytes-accessed, arg/out/
         # temp/peak HBM, collective census (obs/costs.py)
         self.cost_log: List[dict] = []
+        # (op, what) -> newest model_flops row, maintained as cost_log
+        # grows: the shed-ordering read (recompute_cost) runs under
+        # the Batcher's queue lock per queued request and must be O(1),
+        # not a cost_log scan
+        self._cost_index: Dict[Tuple[str, str], float] = {}
         # AOT-key -> ProgramCosts for resident executables; drives the
         # per-execution bytes crediting and the transient-footprint
         # term of the HBM budget (evicted in step with _compiled)
@@ -250,6 +263,109 @@ class Session:
                 self.slo = SloTracker(objectives, metrics=self.metrics,
                                       tracer=self.tracer, **kw)
             return self.slo
+
+    def enable_faults(self, plan=None, seed: int = 1):
+        """Attach a :class:`~.faults.FaultInjector` built from ``plan``
+        (default: :func:`~.faults.default_plan` under ``seed``) and
+        return it — the chaos runner's entry point. Idempotent in the
+        enable_slo sense: a second call replaces the injector (a new
+        soak wants fresh counters)."""
+        from .faults import FaultInjector, FaultPlan, default_plan
+        if plan is None:
+            plan = default_plan(seed)
+        elif isinstance(plan, dict):
+            plan = FaultPlan.from_dict(plan)
+        self.faults = FaultInjector(plan)
+        return self.faults
+
+    def _fault(self, site: str):
+        """Apply one fault opportunity at ``site`` (caller verified
+        ``self.faults is not None``): count what fired, sleep the
+        latency-shaped kinds first (a slow-and-then-failing device
+        sleeps before failing, like the real thing), then raise for
+        ``dispatch_error``. Returns the fired specs so boolean seams
+        (hbm, refine.lo_factor) can branch on truthiness."""
+        from .faults import TransientDispatchError
+        fired = self.faults.fire(site)
+        for spec in fired:
+            self.metrics.inc("faults_injected_total")
+            self.metrics.inc("fault:" + spec.kind)
+            if spec.latency_s:
+                time.sleep(spec.latency_s)
+        for spec in fired:
+            if spec.kind == "dispatch_error":
+                raise TransientDispatchError(
+                    f"injected transient dispatch failure at {site!r}")
+        return fired
+
+    def recompute_cost(self, handle: Hashable, ncols: int = 1) -> float:
+        """Model flops the fleet pays again if this request is SHED and
+        the client retries — the load shedder's cheapest-first ordering
+        key. Prefers the round-9 ``cost_log``'s per-program
+        ``model_flops`` rows (what the AOT seam actually measured for
+        this op); falls back to the ledger formulas for ops never
+        compiled through it. A request against a RESIDENT factor costs
+        one solve; a non-resident one costs factor + solve — so
+        shedding prefers requests whose operators are still hot.
+        Lock-free (GIL-atomic dict/list reads, the op_meta discipline):
+        the Batcher calls this under its own lock and must never wait
+        on a device execution."""
+        entry = self._ops.get(handle)
+        if entry is None:
+            return 0.0
+        cost = (self._logged_flops(entry.op, "solve")
+                or _solve_flops(entry.op, entry.m, entry.n, max(ncols, 1),
+                                entry.band))
+        if handle not in self._cache:
+            cost += (self._logged_flops(entry.op, "factor")
+                     or _factor_flops(entry.op, entry.m, entry.n,
+                                      entry.band))
+        return cost
+
+    def _logged_flops(self, op: str, what: str) -> float:
+        """Newest cost_log model_flops row for (op, what), 0.0 when the
+        op never compiled through the AOT seam. O(1): the index is
+        maintained as _aot_compile appends rows (GIL-atomic dict read —
+        this runs under the Batcher lock on the shed path)."""
+        return self._cost_index.get((op, what), 0.0)
+
+    def degrade_class(self, handle: Hashable) -> Optional[str]:
+        """Which DEGRADATION_LADDER family a handle's serving path
+        belongs to ("mesh" / "mixed" / "dense"), None for unknown
+        handles. Grouped small buckets classify themselves (the
+        Batcher's _SMALL key). Lock-free, op_meta discipline."""
+        entry = self._ops.get(handle)
+        if entry is None:
+            return None
+        if entry.grid is not None:
+            return "mesh"
+        if entry.refine is not None:
+            return "mixed"
+        return "dense"
+
+    def demote_to_working_precision(self, handle: Hashable) -> bool:
+        """The mixed→working_precision rung of the degradation ladder,
+        walked by the Executor's circuit breaker: deactivate the
+        refine policy and evict the low-precision resident so the next
+        solve refactors at working precision (the same observable
+        fallback refine non-convergence takes — counted separately in
+        ``refine_demotions_total`` so a breaker-driven demotion is
+        distinguishable from a numerical one)."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None or entry.refine is None:
+                return False
+            entry.refine = None
+            dropped = self._cache.pop(handle, None)
+            if dropped is not None:
+                self.metrics.inc("evictions")
+                self.metrics.inc("evicted_bytes", dropped.nbytes)
+            self.metrics.inc("refine_demotions_total")
+            self._update_hbm_gauges()
+        _obs_log.warning(
+            "degradation ladder: operator %r demoted to working "
+            "precision (circuit breaker)", handle)
+        return True
 
     def op_meta(self, handle: Hashable) -> Optional[Tuple[str, int]]:
         """Lock-free (op, n) of a registered handle, or None — the
@@ -497,6 +613,15 @@ class Session:
             with self.metrics.phase("serve.factor", "factor_latency",
                                     tracer=self.tracer, **fattrs):
                 res = self._factor(entry, handle)
+                if (self.faults is not None and entry.refine is not None
+                        and res.info == 0
+                        and self._fault("refine.lo_factor")):
+                    # injected singular low-precision operand: the lo
+                    # factor "fails", driving the SAME counted
+                    # working-precision fallback a real indefinite-
+                    # under-rounding operand takes
+                    res = _Resident(res.payload, 1, res.nbytes,
+                                    res.nbytes_total)
                 if res.info != 0 and entry.refine is not None:
                     # the LOW-precision factorization itself failed
                     # (e.g. SPD in f32, indefinite after bf16
@@ -752,13 +877,21 @@ class Session:
         transient footprint fit the budget (round 9: the budget used to
         be an honor-system sum of factor nbytes that ignored what the
         programs themselves allocate while running)."""
-        if self.hbm_budget is None:
+        budget = self.hbm_budget
+        if self.faults is not None and self._fault("hbm"):
+            # injected HBM exhaustion: for THIS insert the budget
+            # collapses to zero — eviction-under-pressure runs for
+            # real (everything but `keep` drops; `keep` then counts a
+            # budget overflow exactly like a genuinely over-budget
+            # factor). An unbounded session degrades the same way.
+            budget = 0
+        if budget is None:
             self._update_hbm_gauges()
             return
         transient = self._largest_transient()
         used = sum(r.nbytes for r in self._cache.values()) + transient
         for h in list(self._cache):
-            if used <= self.hbm_budget:
+            if used <= budget:
                 break
             if h == keep:
                 continue
@@ -766,7 +899,7 @@ class Session:
             used -= nbytes
             self.metrics.inc("evictions")
             self.metrics.inc("evicted_bytes", nbytes)
-        if used > self.hbm_budget:
+        if used > budget:
             # the kept factor (+ program transient) alone exceeds the
             # budget; serving must continue, but this is OOM risk —
             # record the overflow and warn on the slow-log path
@@ -775,11 +908,12 @@ class Session:
             _obs_log.warning(
                 "OOM risk: resident factors + largest program transient "
                 "= %d bytes exceed hbm_budget=%d (transient=%d); serving "
-                "continues with negative headroom", used, self.hbm_budget,
+                "continues with negative headroom", used, budget,
                 transient)
         if self.slo is not None:
-            # one budget check = one oom_risk SLO event (good = fits)
-            self.slo.record_oom(used <= self.hbm_budget)
+            # one budget check = one oom_risk SLO event (good = fits;
+            # an injected exhaustion records the bad event it simulates)
+            self.slo.record_oom(used <= budget)
         self._update_hbm_gauges()
 
     # -- solve -------------------------------------------------------------
@@ -838,6 +972,8 @@ class Session:
             tr = self.tracer
             sattrs = (dict(self._span_attrs(entry, handle), k=k,
                            cache_hit=hit) if tr.enabled else {})
+            if self.faults is not None:  # the whole disabled-path cost
+                self._fault("dispatch")
             with self.metrics.phase("serve.solve", "solve_latency",
                                     tracer=tr, **sattrs) as ph:
                 # dispatch (trace/launch) and device-block are split
@@ -958,6 +1094,8 @@ class Session:
                 f"(info={res.info})")
         b2 = np.ascontiguousarray(b2, dtype=np.dtype(entry.A.dtype))
         k = b2.shape[1]
+        if self.faults is not None:
+            self._fault("dispatch")
         if entry.refine is not None:
             # mixed arm (round 13): one refined B=1 pass through the
             # SAME bucket programs the grouped mixed dispatch runs at
@@ -1134,6 +1272,8 @@ class Session:
             # that was already resident counts a cache hit, everything
             # else a miss — the same tallies B per-request solves give
             was_resident = {h: (h in self._cache) for h in set(handles)}
+            if self.faults is not None:
+                self._fault("dispatch")
             with self.metrics.phase("serve.solve_batched",
                                     "solve_latency", tracer=tr,
                                     **battrs) as ph:
@@ -1518,7 +1658,10 @@ class Session:
 
             X, iters, converged = _refine_engine.drive(
                 start_call, step_call, res.payload, entry.A, B,
-                entry.anorm, policy, entry.A.dtype)
+                entry.anorm, policy, entry.A.dtype,
+                fault_hook=(None if self.faults is None else
+                            (lambda: bool(self._fault(
+                                "refine.converge")))))
         self.metrics.observe("refine_iterations", float(iters))
         # refinement-overhead model flops: iters residual gemms plus
         # iters factor applies (the useful one-solve model stays on
@@ -1670,6 +1813,8 @@ class Session:
         collective census — and keeps the ProgramCosts keyed under the
         executable's cache key so every execution credits the bytes
         ledger and the budget accounts the program's transient HBM."""
+        if self.faults is not None:
+            self._fault("compile")  # compile_stall: injected latency
         with self.metrics.phase("serve.warmup", tracer=self.tracer,
                                 stage=what,
                                 **self._span_attrs(entry, handle)):
@@ -1706,6 +1851,7 @@ class Session:
             "op": entry.op, "what": what, "shape": shapes,
             "model_flops": model_fl, **pc.to_dict(),
         })
+        self._cost_index[(entry.op, what)] = float(model_fl or 0.0)
         self._update_hbm_gauges()
         return exe
 

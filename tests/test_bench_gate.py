@@ -67,16 +67,28 @@ def test_normalize_all_three_schemas(tmp_path):
     assert rec["round"] == 6 and rec["platform"] == "cpu-fallback"
     assert rec["n"] == 512 and rec["metrics"]["potrf_gflops"] == 1.5
 
-    # bench_serve artifact (nested tracked metric via dotted path)
-    _write(tmp_path, "BENCH_SERVE_smoke.json", {
-        "bench": "serve", "backend": "cpu", "n": 192, "nb": 64,
-        "requests": 48, "max_batch": 16,
+    # bench_serve artifact (nested tracked metric via dotted path);
+    # must carry EVERY current section (round 14: --check-schema fails
+    # stale smoke fixtures)
+    serve_art = {
+        "bench": "serve", "backend": "cpu", "dtype": "float32",
+        "n": 192, "nb": 64, "requests": 48, "max_batch": 16,
         "serve": {"solves_per_sec": 120.0},
-        "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3})
+        "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
+        "cost_log": [], "hbm": {}, "slo": {}}
+    assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
+    _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
     assert rec["kind"] == "serve" and rec["platform"] == "cpu"
     assert rec["metrics"]["serve.solves_per_sec"] == 120.0
     assert rec["metrics"]["speedup"] == 13.3
+
+    # a STALE fixture — schema grew a section it lacks — fails
+    # loudly (the rounds-12/13 trip class)
+    stale = {k: v for k, v in serve_art.items() if k != "slo"}
+    _write(tmp_path, "BENCH_SERVE_stale.json", stale)
+    with pytest.raises(gate_mod.SchemaError, match="slo"):
+        gate_mod.normalize(str(tmp_path / "BENCH_SERVE_stale.json"))
 
 
 def test_normalize_rejects_unknown_schema(tmp_path):

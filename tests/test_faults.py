@@ -1,0 +1,766 @@
+"""Round 14: deterministic fault injection + serving reflexes.
+
+The tentpole contract under test: failure is a reproducible INPUT
+(seeded FaultInjector — identical seed, identical schedule), and every
+reflex it exercises — per-request deadlines, admission control + load
+shedding, exponential-backoff retry, the circuit breaker walking the
+declared degradation ladder — resolves every future exactly once,
+keeps the request-conservation identity, and never produces a wrong
+answer. Cancellation races (satellite): a client cancel between bucket
+detach and dispatch, during a backoff sleep, and during a degraded
+per-request replay must not double-count or double-resolve.
+
+All CPU-mesh, tier-1; shapes at the test-suite standard (n ≤ 64).
+"""
+
+import importlib.util
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.runtime import (DEGRADATION_LADDER, Batcher,
+                               DeadlineExceeded, Executor, FaultInjector,
+                               FaultPlan, FaultSpec, RequestShed,
+                               Session, ShedPolicy,
+                               TransientDispatchError)
+from slate_tpu.runtime import faults as faults_mod
+
+RNG = np.random.default_rng(14)
+N, NB = 64, 32
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _spd(n=N, dtype=np.float64):
+    a = RNG.standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+def _chol_handle(sess, n=N):
+    spd = _spd(n)
+    A = st.hermitian(np.tril(spd), nb=NB, uplo=st.Uplo.Lower)
+    return sess.register(A, op="chol"), spd
+
+
+def _small_handles(sess, k=3, n=16):
+    mats = [(RNG.standard_normal((n, n)) + n * np.eye(n))
+            for _ in range(k)]
+    return [sess.register(m, op="lu_small") for m in mats], mats
+
+
+def _conservation_holds(m):
+    return m.get("requests_total") == (
+        m.get("completed_requests") + m.get("failed_requests_total")
+        + m.get("shed_requests_total")
+        + m.get("admission_rejected_total")
+        + m.get("deadline_expired_total") + m.get("cancelled_requests"))
+
+
+# -- the injector: determinism --------------------------------------------
+
+
+def test_injector_schedule_is_pure_function_of_seed():
+    plan = FaultPlan(seed=42, specs=(
+        FaultSpec("dispatch_error", rate=0.3),
+        FaultSpec("slow_device", rate=0.2, latency_s=0.0),
+        FaultSpec("hbm_exhaustion", rate=0.5, after=2, count=3),
+    ))
+    runs = []
+    for _ in range(2):
+        inj = FaultInjector(plan)
+        for _ in range(50):
+            inj.fire("dispatch")
+        for _ in range(20):
+            inj.fire("hbm")
+        runs.append((inj.schedule(), inj.schedule_digest(),
+                     inj.fired_counts()))
+    assert runs[0] == runs[1]  # identical seed -> identical schedule
+    assert runs[0][0], "plan at these rates must fire at least once"
+    # `after` skips the first opportunities; `count` caps firings
+    hbm = [s for s in runs[0][0] if s[1] == "hbm_exhaustion"]
+    assert len(hbm) == 3 and all(seq >= 2 for _, _, seq in hbm)
+    # a different seed is a different schedule
+    inj2 = FaultInjector(FaultPlan(seed=43, specs=plan.specs))
+    for _ in range(50):
+        inj2.fire("dispatch")
+    for _ in range(20):
+        inj2.fire("hbm")
+    assert inj2.schedule() != runs[0][0]
+    # one site's draws never shift another's: dispatch-only replay
+    # reproduces the dispatch sub-schedule exactly
+    inj3 = FaultInjector(plan)
+    for _ in range(50):
+        inj3.fire("dispatch")
+    assert ([s for s in runs[0][0] if s[0] == "dispatch"]
+            == inj3.schedule())
+
+
+def test_fault_plan_validation_and_roundtrip():
+    with pytest.raises(ValueError):
+        FaultSpec("nope", rate=0.5)
+    with pytest.raises(ValueError):
+        FaultSpec("dispatch_error", rate=1.5)
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, specs=(FaultSpec("dispatch_error", 0.1),
+                                 FaultSpec("dispatch_error", 0.2)))
+    plan = FaultPlan(seed=9, specs=(
+        FaultSpec("compile_stall", rate=0.5, latency_s=1e-3),))
+    assert FaultPlan.from_dict(plan.to_dict()) == plan
+    # the ladder is DECLARED policy — pin it
+    assert DEGRADATION_LADDER == {
+        "grouped": "per_request", "mixed": "working_precision",
+        "dense": "per_request", "mesh": "reject"}
+
+
+def test_faults_disabled_is_never_consulted(monkeypatch):
+    """The zero-overhead acceptance: with ``session.faults is None``
+    the injector is NEVER called on the serving path — pinned by
+    making any call explode."""
+    def boom(*a, **k):
+        raise AssertionError("FaultInjector consulted with faults=None")
+    monkeypatch.setattr(FaultInjector, "fire", boom)
+    monkeypatch.setattr(FaultInjector, "uniform", boom)
+    sess = Session(hbm_budget=1 << 20)  # small budget: eviction path runs
+    assert sess.faults is None
+    h, spd = _chol_handle(sess)
+    hs, _ = _small_handles(sess, k=2)
+    sess.warmup(h)
+    with Executor(sess, max_batch=4, max_wait=1e-3) as ex:
+        futs = [ex.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+        futs += [ex.submit(hs[i % 2], RNG.standard_normal(16))
+                 for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+
+
+# -- injected dispatch failures: backoff retry ----------------------------
+
+
+def test_injected_dispatch_error_retried_with_deterministic_backoff():
+    def run():
+        sess = Session()
+        sess.enable_faults(FaultPlan(seed=7, specs=(
+            FaultSpec("dispatch_error", rate=1.0, count=2),)))
+        h, spd = _chol_handle(sess)
+        sess.warmup(h)
+        with Executor(sess, max_batch=4, max_wait=1e-3, retries=3,
+                      backoff_base=1e-3, backoff_max=8e-3) as ex:
+            b = RNG.standard_normal(N)
+            x = ex.submit(h, b).result(timeout=60)
+        assert np.abs(spd @ x - b).max() < 1e-8  # correct after retry
+        snap = sess.metrics.snapshot()
+        return (snap["counters"]["retries"],
+                snap["counters"]["fault:dispatch_error"],
+                snap["histograms"]["retry_backoff_s"]["count"],
+                snap["histograms"]["retry_backoff_s"]["sum"])
+    a, b = run(), run()
+    assert a[0] == 2 and a[1] == 2 and a[2] == 2
+    # injector-keyed jitter: the backoff schedule itself replays
+    assert a == b
+    # exponential: total sleep of 2 attempts stays within the caps
+    assert 1e-3 <= a[3] <= 8e-3 + 4e-3
+
+
+def test_transient_error_class_is_retryable_slate_error_is_not():
+    assert issubclass(TransientDispatchError, RuntimeError)
+    assert not issubclass(TransientDispatchError, SlateError)
+    assert issubclass(DeadlineExceeded, SlateError)
+    assert issubclass(RequestShed, SlateError)
+
+
+# -- per-request deadlines -------------------------------------------------
+
+
+def test_deadline_expired_fails_fast_without_occupying_a_lane():
+    sess = Session()
+    sess.enable_slo()
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    bat = Batcher(sess, max_batch=8, max_wait=60.0)
+    dead = bat.submit(h, RNG.standard_normal(N), timeout_s=0.0)
+    live = bat.submit(h, RNG.standard_normal(N))
+    # the expired request leaves the queue at pop time WITHOUT a
+    # dispatch — even though its bucket is neither full nor past
+    # max_wait
+    time.sleep(0.002)
+    popped = bat.pop_ready()
+    assert popped == []  # live bucket not ready; expired one drained
+    with pytest.raises(DeadlineExceeded):
+        dead.result(timeout=0)
+    assert not live.done()
+    assert sess.metrics.get("deadline_expired_total") == 1
+    assert sess.metrics.get("batches_total") == 0
+    bat.flush()
+    assert live.result(timeout=0).shape == (N,)
+    assert _conservation_holds(sess.metrics)
+    # the expiry is an SLO error event on the request stream
+    err = next(o for o in sess.slo.evaluate()["objectives"]
+               if o["name"] == "request_errors")
+    win = max(err["windows"], key=lambda w: w["window_s"])
+    assert win["bad"] == 1 and win["total"] == 2
+
+
+def test_deadline_wakes_idle_worker():
+    """The worker waits on min(bucket deadline, request deadline): an
+    expiring request fails fast even when its bucket would otherwise
+    sit for max_wait=60s."""
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    with Executor(sess, max_batch=64, max_wait=60.0) as ex:
+        t0 = time.monotonic()
+        f = ex.submit(h, RNG.standard_normal(N), timeout_s=0.05)
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+        assert time.monotonic() - t0 < 10.0  # not the 60 s bucket wait
+    assert sess.metrics.get("deadline_expired_total") == 1
+
+
+def test_batcher_next_deadline_includes_request_deadlines():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    bat = Batcher(sess, max_batch=8, max_wait=60.0)
+    assert bat.next_deadline() is None
+    bat.submit(h, RNG.standard_normal(N))
+    bucket_dl = bat.next_deadline()
+    assert bucket_dl is not None  # ~ t_submit + 60
+    bat.submit(h, RNG.standard_normal(N), timeout_s=0.5)
+    assert bat.next_deadline() < bucket_dl  # the request deadline wins
+    bat.flush()
+
+
+# -- admission control + load shedding ------------------------------------
+
+
+def test_admission_control_rejects_at_the_door():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    bat = Batcher(sess, max_batch=64, max_wait=60.0,
+                  shed_policy=ShedPolicy(max_queue_depth=3))
+    futs = [bat.submit(h, RNG.standard_normal(N)) for _ in range(5)]
+    rejected = [f for f in futs if f.done()]
+    assert len(rejected) == 2
+    for f in rejected:
+        assert isinstance(f.exception(), RequestShed)
+    assert sess.metrics.get("admission_rejected_total") == 2
+    bat.flush()
+    assert sum(1 for f in futs if f.exception() is None) == 3
+    assert _conservation_holds(sess.metrics)
+
+
+def test_load_shedding_drops_cheapest_to_recompute_first():
+    """Requests against a RESIDENT factor re-cost one solve; a cold
+    operator re-costs factor + solve — so under overload the resident
+    handle's requests shed first (the round-9 cost-log ordering)."""
+    sess = Session()
+    warm, _ = _chol_handle(sess)
+    cold, _ = _chol_handle(sess)
+    sess.warmup(warm)  # warm is resident; cold never factored
+    assert sess.recompute_cost(warm) < sess.recompute_cost(cold)
+    bat = Batcher(sess, max_batch=64, max_wait=60.0,
+                  shed_policy=ShedPolicy(max_age_s=0.01,
+                                         shed_fraction=0.5,
+                                         min_queue_depth=2))
+    warm_futs = [bat.submit(warm, RNG.standard_normal(N))
+                 for _ in range(4)]
+    cold_futs = [bat.submit(cold, RNG.standard_normal(N))
+                 for _ in range(4)]
+    time.sleep(0.05)
+    assert bat.maybe_shed() == 4
+    assert all(isinstance(f.exception(), RequestShed)
+               for f in warm_futs)          # cheapest shed first
+    assert not any(f.done() for f in cold_futs)
+    assert sess.metrics.get("shed_requests_total") == 4
+    assert sess.metrics.get("load_sheds_total") == 1
+    bat.flush()
+    assert all(f.result(timeout=0).shape == (N,) for f in cold_futs)
+    assert _conservation_holds(sess.metrics)
+
+
+def test_shed_no_trigger_is_free_and_inactive():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    bat = Batcher(sess, max_batch=64, max_wait=60.0)  # no policy
+    assert bat.maybe_shed() == 0  # one is-None check
+    bat2 = Batcher(sess, max_batch=64, max_wait=60.0,
+                   shed_policy=ShedPolicy(max_age_s=10.0))
+    bat2.submit(h, RNG.standard_normal(N))
+    bat2.submit(h, RNG.standard_normal(N))
+    assert bat2.maybe_shed() == 0  # young queue: no trigger
+    assert sess.metrics.get_gauge("shedding_active") == 0.0
+    bat2.flush()
+
+
+def test_slo_worst_burn_rate_signal():
+    from slate_tpu.obs.slo import Objective, SloTracker
+    clock = [1000.0]
+    tr = SloTracker([Objective("errs", "error_rate", 0.99,
+                               windows=(10.0, 100.0))],
+                    clock=lambda: clock[0])
+    assert tr.worst_burn_rate() == 0.0
+    for i in range(8):
+        tr.record_request("chol", 64, 0.01, ok=True)
+    tr.record_request("chol", 64, 0.01, ok=False)
+    tr.record_request("chol", 64, 0.01, ok=False)
+    # 2 bad / 10 over budget 0.01 -> burn 20
+    assert tr.worst_burn_rate() == pytest.approx(20.0)
+
+
+# -- cancelled requests must not pin backpressure (satellite) --------------
+
+
+def test_backpressure_excludes_cancelled_requests():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    bat = Batcher(sess, max_batch=8, max_wait=60.0)
+    f_old = bat.submit(h, RNG.standard_normal(N))
+    time.sleep(0.05)
+    f_new = bat.submit(h, RNG.standard_normal(N))
+    age_with = bat.backpressure()["oldest_request_age_s"]
+    assert age_with >= 0.05
+    assert f_old.cancel()
+    # the cancelled head no longer pins the age gauge high (it would
+    # otherwise trigger spurious shedding forever)
+    age_without = bat.backpressure()["oldest_request_age_s"]
+    assert age_without < 0.05
+    # the exact-recompute path agrees
+    bat._update_backpressure_locked()
+    assert (sess.metrics.get_gauge("oldest_request_age_s")
+            < 0.05 + 0.02)
+    assert not f_new.done()
+    bat.flush()
+    assert f_new.result(timeout=0).shape == (N,)
+
+
+# -- circuit breaker + degradation ladder ----------------------------------
+
+
+def test_breaker_trips_and_degrades_grouped_bucket_to_per_request():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=3, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=4),)))
+    hs, mats = _small_handles(sess, k=3, n=16)
+    with Executor(sess, max_batch=4, max_wait=1e-3, retries=0,
+                  breaker_threshold=2, breaker_cooldown=60.0) as ex:
+        futs, rhs = [], []
+        for i in range(12):
+            b = RNG.standard_normal(16)
+            rhs.append((hs[i % 3], mats[i % 3], b))
+            futs.append(ex.submit(hs[i % 3], b))
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", f.result(timeout=60)))
+            except Exception as e:  # noqa: BLE001
+                outcomes.append((type(e).__name__, None))
+    m = sess.metrics
+    assert m.get("breaker_trips_total") >= 1
+    assert m.get("degraded_dispatches_total") >= 1
+    # after the fault budget (4) is exhausted, the degraded lane serves
+    # correct per-request answers
+    served = [(x, a, b) for (o, x), (h, a, b) in zip(outcomes, rhs)
+              if o == "ok"]
+    assert served
+    for x, a, b in served:
+        assert np.abs(a @ x - b).max() < 1e-6
+    assert _conservation_holds(m)
+    assert m.get_gauge("circuit_breakers_open") >= 1
+
+
+def test_breaker_mixed_rung_demotes_to_working_precision():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=5, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=4),)))
+    n = 48
+    spd = _spd(n, np.float32)
+    h = sess.register(st.hermitian(np.tril(spd), nb=16,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    sess.warmup(h)
+    assert sess._ops[h].refine is not None
+    with Executor(sess, max_batch=4, max_wait=1e-3, retries=0,
+                  breaker_threshold=2, breaker_cooldown=60.0) as ex:
+        rhs = [RNG.standard_normal(n).astype(np.float32)
+               for _ in range(8)]
+        futs = [ex.submit(h, b) for b in rhs]
+        for f in futs:
+            f.exception(timeout=60)  # wait for resolution either way
+    m = sess.metrics
+    assert m.get("refine_demotions_total") == 1
+    assert sess._ops[h].refine is None  # demoted, stays demoted
+    assert m.get("breaker_trips_total") >= 1
+    assert _conservation_holds(m)
+    served = [(f.result(), b) for f, b in zip(futs, rhs)
+              if f.exception() is None]
+    assert served  # the working-precision lane served correct answers
+    for x, b in served:
+        assert np.abs(spd @ x - b).max() / n < 1e-3
+
+
+def test_breaker_mesh_rung_rejects_with_clear_error(monkeypatch):
+    """mesh→reject: a sharded program has no single-chip degraded form
+    — the breaker fails the bucket fast with a clear error instead of
+    retry-storming. (The mesh classification is monkeypatched onto a
+    dense session: the rung under test is Executor policy, and a real
+    mesh register costs multi-device AOT compiles tier-1 can't
+    afford.)"""
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=11, specs=(
+        FaultSpec("dispatch_error", rate=1.0),)))
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    monkeypatch.setattr(Session, "degrade_class",
+                        lambda self, handle: "mesh")
+    with Executor(sess, max_batch=4, max_wait=1e-3, retries=0,
+                  breaker_threshold=2, breaker_cooldown=60.0) as ex:
+        futs = [ex.submit(h, RNG.standard_normal(N)) for _ in range(8)]
+        errs = [f.exception(timeout=60) for f in futs]
+    # pre-trip buckets carry the transient error; once the breaker is
+    # open every bucket is REJECTED with the ladder-naming SlateError
+    assert all(e is not None for e in errs)  # nothing served, none lost
+    rejected = [e for e in errs if isinstance(e, SlateError)
+                and "mesh" in str(e) and "reject" in str(e)]
+    assert rejected, "breaker rejection must name the ladder rung"
+    assert sess.metrics.get("breaker_rejections_total") >= 1
+    assert _conservation_holds(sess.metrics)
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=3, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=2),)))
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    with Executor(sess, max_batch=2, max_wait=1e-3, retries=0,
+                  breaker_threshold=2, breaker_cooldown=0.05) as ex:
+        # two failing buckets trip the breaker (faults exhausted after)
+        for _ in range(2):
+            fs = [ex.submit(h, RNG.standard_normal(N))
+                  for _ in range(2)]
+            for f in fs:
+                f.exception(timeout=60)
+        assert sess.metrics.get("breaker_trips_total") == 1
+        time.sleep(0.08)  # past the cooldown -> next bucket is a probe
+        f = ex.submit(h, RNG.standard_normal(N))
+        assert f.result(timeout=60).shape == (N,)
+    m = sess.metrics
+    assert m.get("breaker_probes_total") >= 1
+    assert m.get("breaker_closes_total") == 1
+    assert m.get_gauge("circuit_breakers_open") == 0
+    assert _conservation_holds(m)
+
+
+def test_admission_reject_callback_may_reenter_submit():
+    """Futures must NEVER be resolved while the Executor's lock is
+    held: the reject message tells clients to retry, and the natural
+    implementation is a done-callback that calls submit() again —
+    which deadlocks on the non-reentrant lock if the rejection were
+    resolved inside it. The rejected future is already done when the
+    callback attaches, so the re-entry runs inline on the submitting
+    thread — inside submit()'s own call frame before the fix."""
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    resubmitted = []
+    with Executor(sess, max_batch=64, max_wait=1e-3,
+                  shed_policy=ShedPolicy(max_queue_depth=2)) as ex:
+        def retry_once(f):
+            if isinstance(f.exception(), RequestShed) and not resubmitted:
+                resubmitted.append(ex.submit(h, RNG.standard_normal(N)))
+        futs = [ex.submit(h, RNG.standard_normal(N)) for _ in range(2)]
+        rej = ex.submit(h, RNG.standard_normal(N))  # admission-rejected
+        rej.add_done_callback(retry_once)  # fires inline (already done)
+        with pytest.raises(RequestShed):
+            rej.result(timeout=30)
+        assert resubmitted  # the re-entrant retry ran, no deadlock
+        for f in futs:
+            assert f.result(timeout=30) is not None
+        resubmitted[0].exception(timeout=30)  # resolved either way
+        assert resubmitted[0].done()
+
+
+def test_expiry_callback_may_reenter_submit_on_worker_thread():
+    """The worker fails expired futures AFTER releasing its lock: a
+    deadline-expiry done-callback that re-enters submit() runs on the
+    worker thread and must not deadlock."""
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    resubmitted = []
+    with Executor(sess, max_batch=64, max_wait=0.2) as ex:
+        def retry_once(f):
+            if isinstance(f.exception(), DeadlineExceeded) \
+                    and not resubmitted:
+                resubmitted.append(ex.submit(h, RNG.standard_normal(N),
+                                             timeout_s=60.0))
+        exp = ex.submit(h, RNG.standard_normal((N, 2)), timeout_s=0.0)
+        exp.add_done_callback(retry_once)
+        with pytest.raises(DeadlineExceeded):
+            exp.result(timeout=30)
+        t0 = time.monotonic()
+        while not resubmitted and time.monotonic() - t0 < 30:
+            time.sleep(0.005)
+        assert resubmitted  # re-entered from the worker, no deadlock
+        assert resubmitted[0].result(timeout=30).shape == (N,)
+
+
+def test_shed_respects_min_queue_depth_floor():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    bat = Batcher(sess, max_batch=64, max_wait=60.0,
+                  shed_policy=ShedPolicy(max_age_s=0.01,
+                                         shed_fraction=1.0,
+                                         min_queue_depth=4))
+    futs = [bat.submit(h, RNG.standard_normal(N)) for _ in range(6)]
+    time.sleep(0.05)
+    # fraction 1.0 wants all 6; the floor keeps 4 live
+    assert bat.maybe_shed() == 2
+    assert sum(1 for f in futs if f.done()) == 2
+    # a drained-below-floor queue is no longer "shedding"
+    assert bat.maybe_shed() == 0
+    assert sess.metrics.get_gauge("shedding_active") == 0.0
+    bat.flush()
+    assert sum(1 for f in futs if f.exception() is None) == 4
+
+
+# -- cancellation races under injected faults (satellite) ------------------
+
+
+def test_cancel_between_detach_and_dispatch():
+    sess = Session()
+    h, _ = _chol_handle(sess)
+    sess.warmup(h)
+    bat = Batcher(sess, max_batch=4, max_wait=60.0)
+    futs = [bat.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+    popped = bat.pop_ready(force=True)
+    assert len(popped) == 1
+    assert futs[1].cancel()  # between detach and dispatch
+    bat.run(*popped[0])
+    assert futs[1].cancelled()
+    for i in (0, 2, 3):
+        assert futs[i].result(timeout=0).shape == (N,)
+    m = sess.metrics
+    # caught by the pre-dispatch done() filter: not a cancellation
+    # inside resolution, so cancelled_requests stays 0 (the round-6
+    # pinned convention) and the SLO stream records exactly the three
+    # served requests — the client-cancelled future is the one legal
+    # gap in the conservation identity (the client resolved it, not
+    # the runtime)
+    assert m.get("cancelled_requests") == 0
+    assert m.get("completed_requests") == 3
+    assert m.get("requests_total") == 4
+
+
+def test_cancel_during_backoff_sleep():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=7, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=1),)))
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    with Executor(sess, max_batch=2, max_wait=1e-3, retries=2,
+                  backoff_base=0.3, backoff_max=0.3) as ex:
+        f_cancel = ex.submit(h, RNG.standard_normal(N))
+        b = RNG.standard_normal(N)
+        f_live = ex.submit(h, b)
+        # wait for attempt 0 to fail (retries counter moves), i.e. the
+        # worker is inside its backoff sleep
+        t0 = time.monotonic()
+        while sess.metrics.get("retries") < 1:
+            assert time.monotonic() - t0 < 30
+            time.sleep(0.005)
+        assert f_cancel.cancel()
+        x = f_live.result(timeout=60)  # the retry serves the survivor
+        assert np.abs(spd @ x - b).max() < 1e-8
+    m = sess.metrics
+    assert f_cancel.cancelled()
+    assert m.get("completed_requests") == 1
+    assert m.get("retries") == 1
+    # resolved exactly once each: no InvalidStateError double-count
+    assert m.get("cancelled_requests") == 0
+    # SLO/metrics never saw the cancelled request as served or failed
+    assert m.get("failed_requests_total") == 0
+
+
+def test_cancel_during_degraded_per_request_replay():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=3, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=2),)))
+    hs, mats = _small_handles(sess, k=2, n=16)
+    bat = Batcher(sess, max_batch=4, max_wait=60.0)
+    futs = [bat.submit(hs[i % 2], RNG.standard_normal(16))
+            for i in range(4)]
+    popped = bat.pop_ready(force=True)
+    assert len(popped) == 1  # one grouped bucket
+    assert futs[2].cancel()  # cancel before the degraded replay
+    bat.run_degraded(*popped[0])
+    # exactly-once resolution: the cancelled future stays cancelled,
+    # the rest are resolved by the replay (some may carry the injected
+    # per-item dispatch fault — failed, not lost)
+    assert futs[2].cancelled()
+    m = sess.metrics
+    assert m.get("degraded_dispatches_total") == 1
+    for i in (0, 1, 3):
+        assert futs[i].done() and not futs[i].cancelled()
+    resolved = sum(1 for i in (0, 1, 3)
+                   if futs[i].exception() is None)
+    failed = m.get("failed_requests_total")
+    assert resolved == m.get("completed_requests")
+    assert resolved + failed == 3
+    assert m.get("cancelled_requests") == 0  # skipped pre-dispatch
+
+
+# -- conservation under a mixed soak ---------------------------------------
+
+
+def test_conservation_and_correctness_under_injected_soak():
+    """A miniature chaos soak inside tier-1: dispatch faults + slow
+    device + deadline lane; every future resolves, every completed
+    answer is right, and the conservation identity holds exactly."""
+    sess = Session()
+    sess.enable_slo()
+    sess.enable_faults(FaultPlan(seed=2, specs=(
+        FaultSpec("dispatch_error", rate=0.25, count=6),
+        FaultSpec("slow_device", rate=0.2, latency_s=1e-3),)))
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    futs = []
+    with Executor(sess, max_batch=4, max_wait=1e-3, retries=1,
+                  backoff_base=1e-3, breaker_threshold=3,
+                  breaker_cooldown=60.0) as ex:
+        for i in range(24):
+            b = RNG.standard_normal(N)
+            futs.append((ex.submit(h, b), b))
+        for _ in range(3):
+            futs.append((ex.submit(h, RNG.standard_normal((N, 2)),
+                                   timeout_s=0.0), None))
+        ex.flush()
+        assert all(f.done() for f, _ in futs)  # zero lost futures
+    wrong = sum(1 for f, b in futs
+                if b is not None and f.exception() is None
+                and np.abs(spd @ f.result() - b).max() >= 1e-8)
+    assert wrong == 0  # zero wrong answers
+    m = sess.metrics
+    assert m.get("deadline_expired_total") == 3
+    assert _conservation_holds(m)
+    # SLO request stream agrees with the conservation counters
+    err = next(o for o in sess.slo.evaluate()["objectives"]
+               if o["name"] == "request_errors")
+    win = max(err["windows"], key=lambda w: w["window_s"])
+    assert win["total"] == (m.get("completed_requests")
+                            + m.get("failed_requests_total")
+                            + m.get("deadline_expired_total"))
+    assert win["bad"] == (m.get("failed_requests_total")
+                          + m.get("deadline_expired_total"))
+
+
+# -- refine fault seams ----------------------------------------------------
+
+
+def test_injected_lo_factor_failure_takes_counted_fallback():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=1, specs=(
+        FaultSpec("lo_factor_fail", rate=1.0, count=1),)))
+    n = 48
+    spd = _spd(n, np.float32)
+    h = sess.register(st.hermitian(np.tril(spd), nb=16,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    b = RNG.standard_normal(n).astype(np.float32)
+    x = sess.solve(h, b)  # fallback refactors at working precision
+    assert np.abs(spd @ x - b).max() / n < 1e-3
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    assert sess.metrics.get("fault:lo_factor_fail") == 1
+    assert sess._ops[h].refine is None
+
+
+def test_injected_refine_non_convergence_takes_counted_fallback():
+    sess = Session()
+    sess.enable_faults(FaultPlan(seed=1, specs=(
+        FaultSpec("refine_no_converge", rate=1.0, count=1),)))
+    n = 48
+    spd = _spd(n, np.float32)
+    h = sess.register(st.hermitian(np.tril(spd), nb=16,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    sess.warmup(h)
+    b = RNG.standard_normal(n).astype(np.float32)
+    x = sess.solve(h, b)
+    assert np.abs(spd @ x - b).max() / n < 1e-3
+    assert sess.metrics.get("refine_fallbacks_total") == 1
+    assert sess.metrics.get("fault:refine_no_converge") == 1
+
+
+def test_injected_hbm_exhaustion_forces_eviction_under_pressure():
+    sess = Session()  # UNBOUNDED: only the injected pressure evicts
+    sess.enable_faults(FaultPlan(seed=1, specs=(
+        FaultSpec("hbm_exhaustion", rate=1.0, after=1, count=1),)))
+    h1, _ = _chol_handle(sess)
+    h2, _ = _chol_handle(sess)
+    sess.solve(h1, RNG.standard_normal(N))  # insert 0: clean
+    # h2's insert hits the injected exhaustion: h1 evicted, h2 kept,
+    # and the overflow counted exactly like a genuinely full budget
+    sess.solve(h2, RNG.standard_normal(N))
+    assert sess.cached_handles() == [h2]
+    assert sess.metrics.get("evictions") == 1
+    assert sess.metrics.get("budget_overflows") == 1
+    assert sess.metrics.get("fault:hbm_exhaustion") == 1
+
+
+# -- artifact-schema satellites --------------------------------------------
+
+
+def test_serve_artifact_sections_pinned_across_tools():
+    """bench_serve.SERVE_ARTIFACT_SECTIONS and the jax-free mirror in
+    tools/bench_gate.py must agree — the --check-schema fixture
+    assertion is only as strong as this equality."""
+    def load(path, name):
+        spec = importlib.util.spec_from_file_location(name, str(path))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    gate = load(_REPO / "tools" / "bench_gate.py", "bench_gate_pin")
+    serve = load(_REPO / "bench_serve.py", "bench_serve_pin")
+    assert (tuple(gate.SERVE_ARTIFACT_SECTIONS)
+            == tuple(serve.SERVE_ARTIFACT_SECTIONS))
+
+
+def test_committed_chaos_artifact_validates_and_holds():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_chaos", str(_REPO / "tools" / "bench_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    path = _REPO / "CHAOS_r01.json"
+    assert path.exists(), "committed chaos artifact missing"
+    recs = gate.normalize_all(str(path))
+    assert len(recs) == 1 and recs[0]["kind"] == "chaos"
+    assert recs[0]["ok"] is True
+    import json
+    doc = json.loads(path.read_text())
+    assert len(doc["fault_classes"]) >= 4  # the acceptance floor
+    assert doc["invariants"]["schedule_reproducible"] is True
+    assert doc["invariants"]["wrong_answers"] == 0
+    assert doc["invariants"]["lost_futures"] == 0
+
+
+def test_committed_overload_artifact_validates_and_holds():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_ovl", str(_REPO / "tools" / "bench_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    path = _REPO / "BENCH_OVERLOAD_r01.json"
+    assert path.exists(), "committed overload artifact missing"
+    recs = gate.normalize_all(str(path))
+    assert {r["op"] for r in recs} == {"shed", "no_shed"}
+    by_arm = {r["op"]: r for r in recs}
+    # the acceptance shape: shedding bounds p99; no-shed grows
+    assert (by_arm["shed"]["metrics"]["p99_latency_s"]
+            < by_arm["no_shed"]["metrics"]["p99_latency_s"] / 2)
+    import json
+    doc = json.loads(path.read_text())
+    assert doc["ok"] is True and doc["no_shed_age_grows"] is True

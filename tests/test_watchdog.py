@@ -50,10 +50,13 @@ def test_committed_baseline_loads_and_validates():
     assert doc["gated_platforms"] == ["tpu", "axon"]
     assert len(doc["series"]) > 20
     assert validate_baseline(doc) == []
-    # direction annotation: residual series are lower-is-better,
-    # everything else higher
+    # direction annotation: residual, latency, and queue-age series
+    # (round 14 overload columns) are lower-is-better, everything
+    # else higher
     for row in doc["series"]:
-        want = ("lower" if row["metric"].startswith("residual_")
+        want = ("lower" if (row["metric"].startswith("residual_")
+                            or "latency" in row["metric"]
+                            or "age_s" in row["metric"])
                 else "higher")
         assert row["direction"] == want, row["metric"]
     # real tpu history exists (rounds 1–5 on-chip runs) — the series

@@ -85,7 +85,20 @@ TRACKED_MULTICHIP = ("serve.solves_per_sec",
 # series (CPU rows are convert-materialization smoke — informational)
 TRACKED_SERVE_MIXED = ("mixed.solves_per_sec", "full.solves_per_sec",
                        "speedup", "residents_ratio")
+# the round-14 overload A/B (bench_serve.py --overload →
+# BENCH_OVERLOAD_r*.json): one record per arm (shed / no_shed);
+# p99_latency_s classifies as lower-is-better via _direction
+TRACKED_OVERLOAD = ("p99_latency_s", "max_oldest_age_s", "completed")
 GATED_PLATFORMS = ("tpu", "axon")
+
+# mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
+# jax-import-free; tests pin the two tuples equal): every section the
+# serve artifact currently carries. --check-schema fails a committed
+# fixture missing any of them — the round-12/13 stale-fixture class
+# (schema grew a section, fixture silently didn't).
+SERVE_ARTIFACT_SECTIONS = (
+    "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
+    "serve", "per_request", "speedup", "cost_log", "hbm", "slo")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -146,11 +159,15 @@ def normalize(path: str) -> dict:
     if isinstance(obj, list):
         raise SchemaError(f"{name}: list artifact — use normalize_all")
     if isinstance(obj, dict) and obj.get("bench") in ("multichip",
-                                                      "serve_mixed"):
+                                                      "serve_mixed",
+                                                      "serve_overload"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
-    return _normalize_obj(name, obj, int(m.group(1)) if m else None)
+    rnd = int(m.group(1)) if m else None
+    if isinstance(obj, dict) and obj.get("bench") == "chaos":
+        return _normalize_chaos(name, obj, rnd)[0]
+    return _normalize_obj(name, obj, rnd)
 
 
 def normalize_all(path: str) -> List[dict]:
@@ -169,7 +186,74 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_multichip(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_mixed":
         return _normalize_serve_mixed(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_overload":
+        return _normalize_serve_overload(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "chaos":
+        return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
+
+
+def _normalize_serve_overload(name: str, obj: dict,
+                              rnd: Optional[int]) -> List[dict]:
+    """The round-14 shedding A/B artifact: {"bench": "serve_overload",
+    "platform", "n", "arms": {"shed": {...}, "no_shed": {...}}, "ok"}
+    — one record per arm (the arm label rides the ``op`` series-key
+    slot so the two arms never gate against each other)."""
+    for k in ("platform", "n", "arms", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_overload artifact "
+                              f"missing {k!r}")
+    arms = obj["arms"]
+    if not isinstance(arms, dict) or set(arms) != {"shed", "no_shed"}:
+        raise SchemaError(f"{name}: serve_overload arms must be "
+                          "exactly {shed, no_shed}")
+    out = []
+    for arm, row in sorted(arms.items()):
+        for k in ("submitted", "completed", "p99_latency_s",
+                  "oldest_age_series_s"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[arms.{arm}]: serve_overload arm missing "
+                    f"{k!r}")
+        out.append({
+            "round": rnd, "source": f"{name}[{arm}]",
+            "kind": "serve_overload",
+            "platform": str(obj["platform"]), "n": int(obj["n"]),
+            "op": arm, "ok": bool(obj.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_OVERLOAD),
+        })
+    return out
+
+
+def _normalize_chaos(name: str, obj: dict,
+                     rnd: Optional[int]) -> List[dict]:
+    """The round-14 chaos-soak artifact (tools/chaos_serve.py →
+    CHAOS_r*.json): schema-validated so a soak whose invariant or
+    schedule sections go stale fails --check-schema; never a perf
+    series (the invariants are booleans, not trajectories)."""
+    for k in ("platform", "seed", "plan", "fault_classes", "phases",
+              "invariants", "schedule", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: chaos artifact missing {k!r}")
+    if not isinstance(obj["fault_classes"], list) \
+            or not obj["fault_classes"]:
+        raise SchemaError(f"{name}: chaos fault_classes missing/empty")
+    inv = obj["invariants"]
+    for k in ("wrong_answers", "lost_futures", "conservation_ok",
+              "slo_consistent", "fleet_fold_ok",
+              "schedule_reproducible"):
+        if k not in inv:
+            raise SchemaError(f"{name}: chaos invariants missing {k!r}")
+    if not isinstance(obj["schedule"], dict) \
+            or "digest" not in obj["schedule"]:
+        raise SchemaError(f"{name}: chaos schedule.digest missing")
+    if "soak" not in obj.get("phases", {}):
+        raise SchemaError(f"{name}: chaos phases.soak missing")
+    return [{
+        "round": rnd, "source": name, "kind": "chaos",
+        "platform": str(obj["platform"]), "n": None,
+        "ok": bool(obj["ok"]), "metrics": {},
+    }]
 
 
 def _normalize_serve_mixed(name: str, obj: dict,
@@ -254,9 +338,15 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         }
 
     if obj.get("bench") == "serve":
-        for k in ("backend", "n", "serve", "per_request", "speedup"):
+        # the FULL current section list, not just the gating keys: a
+        # committed fixture that predates a schema addition fails here
+        # (regenerate with bench_serve.py --regen-smoke)
+        for k in SERVE_ARTIFACT_SECTIONS:
             if k not in obj:
-                raise SchemaError(f"{name}: serve artifact missing {k!r}")
+                raise SchemaError(
+                    f"{name}: serve artifact missing section {k!r} "
+                    "(stale smoke fixture? regenerate with "
+                    "bench_serve.py --regen-smoke)")
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -325,7 +415,9 @@ def discover(root: str) -> List[str]:
     paths = (glob.glob(os.path.join(root, "BENCH_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_SERVE*.json"))
              + glob.glob(os.path.join(root, "BENCH_MIXED_r*.json"))
-             + glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+             + glob.glob(os.path.join(root, "BENCH_OVERLOAD_r*.json"))
+             + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
+             + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
     # fixtures beside the headline artifact — different schema, not
     # part of the trajectory
@@ -399,11 +491,13 @@ def _direction(metric: str) -> str:
     """Per-metric regression direction: every tracked series is
     higher-is-better (GFLOP/s, solves/s, speedup) EXCEPT the
     residual_* informational series parsed off the r01–r05 multichip
-    tails (smaller residual = healthier) and anything latency-shaped —
-    classified here so a future artifact exporting a latency series
-    cannot silently enter the baseline with an inverted direction
-    (the watchdog would then read a 10× p99 rise as an improvement)."""
-    if metric.startswith("residual_") or "latency" in metric:
+    tails (smaller residual = healthier) and anything latency- or
+    queue-age-shaped (the round-14 overload columns) — classified here
+    so a future artifact exporting a latency series cannot silently
+    enter the baseline with an inverted direction (the watchdog would
+    then read a 10× p99 rise as an improvement)."""
+    if metric.startswith("residual_") or "latency" in metric \
+            or "age_s" in metric:
         return "lower"
     return "higher"
 

@@ -1,0 +1,506 @@
+#!/usr/bin/env python
+"""Seeded chaos soak for the serving runtime (round 14).
+
+Serves a mixed workload — dense chol + lu, a grouped small-problem
+fleet, and mixed-precision refined operators — through the full
+Session/Batcher/Executor stack while a deterministic
+:class:`~slate_tpu.runtime.FaultInjector` fires every injectable fault
+class at once (transient dispatch failures, slow-device latency,
+compile stalls, HBM-budget exhaustion, singular low-precision
+operands, refinement non-convergence, dropped fleet snapshots), then
+EXIT-GATES on the invariants every robustness claim in CHANGES.md now
+rests on:
+
+* **zero wrong answers** — every completed future's solution meets the
+  residual bound of its operator (a fault may fail a request, never
+  corrupt one);
+* **zero lost/hung futures** — every submitted future is resolved
+  after the final flush (no request falls between the reflexes);
+* **conservation** — ``requests_total = completed + failed + shed +
+  admission_rejected + deadline_expired + cancelled`` on every phase's
+  metrics (no path resolves a future without counting it);
+* **SLO accounting consistent** — the request-source SLO event stream
+  agrees event-for-event with the conservation counters (total =
+  completed+failed+expired; bad = failed+expired);
+* **fleet fold under snapshot loss** — the aggregator folds the
+  surviving process snapshots bit-exactly when the injector drops one;
+* **schedule reproducibility** — the soak runs twice under the same
+  seed and the two fault schedules (site, kind, sequence) are
+  IDENTICAL (``schedule_digest`` equality): deterministic wave-locked
+  submission (full buckets only, expired requests in their own
+  bucket) makes the opportunity sequence, hence the schedule, a pure
+  function of the seed.
+
+Breaker/degradation drills run as separate deterministic phases (rate
+1.0, count-limited plans) so the circuit breaker, the
+grouped→per_request and mixed→working_precision ladder rungs, and
+admission control + load shedding are each exercised every run, not
+probabilistically.
+
+Writes the committed ``CHAOS_r*.json`` artifact (validated by
+``tools/bench_gate.py --check-schema``); ``--smoke`` is the
+run_tests.py wiring (fewer waves, same invariants). All shapes stay
+n ≤ 64 (CPU-smoke compile budget, ROADMAP housekeeping note).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import numpy as np  # noqa: E402
+
+from slate_tpu.compat.platform import apply_env_platforms  # noqa: E402
+
+apply_env_platforms()
+
+RESID_TOL = 1e-3  # f32 working precision, n<=64 (|Ax-b|_inf / n|x|_inf)
+
+
+def soak_plan(seed):
+    """Every injectable class at once. lo_factor_fail fires ``after=1``
+    so the FIRST refined operator survives factoring (its solve then
+    hits the injected non-convergence) and the SECOND takes the
+    singular-lo-factor fallback — both refine reflexes exercised
+    deterministically in one soak."""
+    from slate_tpu.runtime import FaultPlan, FaultSpec
+    return FaultPlan(seed=seed, specs=(
+        FaultSpec("dispatch_error", rate=0.12),
+        FaultSpec("slow_device", rate=0.15, latency_s=2e-3),
+        FaultSpec("compile_stall", rate=0.5, latency_s=5e-3),
+        FaultSpec("hbm_exhaustion", rate=0.2),
+        FaultSpec("lo_factor_fail", rate=1.0, after=1, count=1),
+        FaultSpec("refine_no_converge", rate=1.0, count=1),
+        FaultSpec("snapshot_drop", rate=1.0, count=1),
+    ))
+
+
+def _operators(rng, n_dense=48, nb=16, n_small=16, n_small_handles=4):
+    """The mixed workload's operators, all f32 (chaos runs without
+    forced x64). Returns (specs, dense_mats) where specs is
+    [(name, register-kwargs, dense matrix for residual checks)]."""
+    import slate_tpu as st
+
+    ops = []
+    a = rng.standard_normal((n_dense, n_dense)).astype(np.float32)
+    spd = (a @ a.T + n_dense * np.eye(n_dense)).astype(np.float32)
+    ops.append(("chol", dict(
+        A=st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower),
+        op="chol"), spd))
+    ge = (rng.standard_normal((n_dense, n_dense))
+          + n_dense * np.eye(n_dense)).astype(np.float32)
+    ops.append(("lu", dict(A=st.from_dense(ge, nb=nb), op="lu"), ge))
+    for i in range(n_small_handles):
+        s = (rng.standard_normal((n_small, n_small))
+             + n_small * np.eye(n_small)).astype(np.float32)
+        ops.append((f"small{i}", dict(A=s, op="lu_small"), s))
+    for i in range(2):
+        a2 = rng.standard_normal((n_dense, n_dense)).astype(np.float32)
+        spd2 = (a2 @ a2.T + n_dense * np.eye(n_dense)).astype(np.float32)
+        ops.append((f"refined{i}", dict(
+            A=st.hermitian(np.tril(spd2), nb=nb, uplo=st.Uplo.Lower),
+            op="chol", refine=True), spd2))
+    return ops
+
+
+def _conservation(metrics) -> dict:
+    """The conservation invariant over one Metrics instance."""
+    g = metrics.get
+    parts = {
+        "requests_total": g("requests_total"),
+        "completed": g("completed_requests"),
+        "failed": g("failed_requests_total"),
+        "shed": g("shed_requests_total"),
+        "admission_rejected": g("admission_rejected_total"),
+        "deadline_expired": g("deadline_expired_total"),
+        "cancelled": g("cancelled_requests"),
+    }
+    accounted = sum(v for k, v in parts.items()
+                    if k != "requests_total")
+    parts["accounted"] = accounted
+    parts["ok"] = parts["requests_total"] == accounted
+    return parts
+
+
+def _check_residual(dense, x, b) -> float:
+    x = np.asarray(x, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    denom = dense.shape[0] * max(float(np.abs(x).max()), 1.0)
+    return float(np.abs(dense.astype(np.float64) @ x - b).max()) / denom
+
+
+def run_soak(seed, waves, max_batch=8):
+    """The main soak phase: deterministic wave-locked serving under
+    the full fault plan. Returns (report, injector, session)."""
+    from slate_tpu.runtime import Executor, Session
+
+    rng = np.random.default_rng(seed)
+    sess = Session(hbm_budget=64 << 20)
+    sess.enable_slo()
+    inj = sess.enable_faults(soak_plan(seed))
+    ops = _operators(rng)
+    dense_by_handle = {}
+    handles = {}
+    for name, kw, dense in ops:
+        h = sess.register(handle=name, **kw)
+        handles[name] = h
+        dense_by_handle[h] = dense
+    t0 = time.perf_counter()
+    wrong = 0
+    lost = 0
+    outcomes = {"completed": 0, "failed": 0, "expired": 0}
+    with Executor(sess, max_batch=max_batch, max_wait=3600.0,
+                  retries=2, backoff_base=1e-3, backoff_max=4e-3,
+                  breaker_threshold=3, breaker_cooldown=30.0) as ex:
+        for name in handles:
+            ex.warmup([handles[name]])
+        n_dense = dense_by_handle[handles["chol"]].shape[0]
+        n_small = dense_by_handle[handles["small0"]].shape[0]
+        small_names = [n for n in handles if n.startswith("small")]
+        for wave in range(waves):
+            futs = []  # (future, handle, b)
+            # every live bucket gets EXACTLY max_batch requests per
+            # wave (full buckets only -> deterministic composition);
+            # the deadline-expired lane uses a different rhs width so
+            # its bucket never blocks the flush
+            for name in ("chol", "lu", "refined0", "refined1"):
+                for _ in range(max_batch):
+                    b = rng.standard_normal(n_dense).astype(np.float32)
+                    futs.append((ex.submit(handles[name], b),
+                                 handles[name], b))
+            for j in range(max_batch):
+                sm = small_names[j % len(small_names)]
+                b = rng.standard_normal(n_small).astype(np.float32)
+                futs.append((ex.submit(handles[sm], b), handles[sm], b))
+            for _ in range(2):
+                b = rng.standard_normal((n_dense, 2)).astype(np.float32)
+                futs.append((ex.submit(handles["chol"], b,
+                                       timeout_s=0.0),
+                             handles["chol"], b))
+            ex.flush()
+            for f, h, b in futs:
+                if not f.done():
+                    lost += 1
+                    continue
+                if f.exception() is not None:
+                    from slate_tpu.runtime import DeadlineExceeded
+                    if isinstance(f.exception(), DeadlineExceeded):
+                        outcomes["expired"] += 1
+                    else:
+                        outcomes["failed"] += 1
+                    continue
+                outcomes["completed"] += 1
+                if _check_residual(dense_by_handle[h], f.result(),
+                                   b) > RESID_TOL:
+                    wrong += 1
+    wall = time.perf_counter() - t0
+    snap = sess.metrics.snapshot()
+    cons = _conservation(sess.metrics)
+    # SLO accounting consistency: the request-source error-rate stream
+    # must agree event-for-event with the conservation counters
+    slo_rows = sess.slo.evaluate()["objectives"]
+    err_row = next(r for r in slo_rows if r["name"] == "request_errors")
+    long_win = max(err_row["windows"], key=lambda w: w["window_s"])
+    slo_total = long_win["total"]
+    slo_bad = long_win["bad"]
+    expect_total = (cons["completed"] + cons["failed"]
+                    + cons["deadline_expired"])
+    expect_bad = cons["failed"] + cons["deadline_expired"]
+    slo_ok = (slo_total == expect_total and slo_bad == expect_bad)
+    # fleet fold under snapshot loss: N pseudo-processes, the injector
+    # drops one, the aggregator folds the survivors bit-exactly
+    from slate_tpu.obs.aggregate import aggregate_processes
+    snaps, dropped = [], 0
+    for i in range(3):
+        if inj.fire("snapshot"):
+            dropped += 1
+            sess.metrics.inc("faults_injected_total")
+            sess.metrics.inc("fault:snapshot_drop")
+            continue
+        snaps.append(snap)
+    fleet = aggregate_processes(snaps, hosts=[f"p{i}"
+                                             for i in range(len(snaps))])
+    fleet_ok = (len(snaps) == 3 - dropped and dropped == 1
+                and fleet["metrics"]["counters"]["requests_total"]
+                == len(snaps) * snap["counters"]["requests_total"])
+    report = {
+        "wall_s": wall,
+        "waves": waves,
+        "outcomes": outcomes,
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "conservation": cons,
+        "slo": {"total": slo_total, "bad": slo_bad,
+                "expected_total": expect_total,
+                "expected_bad": expect_bad, "ok": slo_ok},
+        "fleet_fold": {"snapshots": 3, "dropped": dropped,
+                       "ok": fleet_ok},
+        "counters": {k: snap["counters"].get(k, 0.0) for k in (
+            "requests_total", "completed_requests",
+            "failed_requests_total", "deadline_expired_total",
+            "shed_requests_total", "admission_rejected_total",
+            "cancelled_requests", "retries", "failed_batches",
+            "faults_injected_total", "refine_fallbacks_total",
+            "evictions", "budget_overflows",
+            "breaker_trips_total", "degraded_dispatches_total")},
+        "fault_counters": {k: v for k, v in snap["counters"].items()
+                           if k.startswith("fault:")},
+        "ok": (wrong == 0 and lost == 0 and cons["ok"] and slo_ok
+               and fleet_ok and outcomes["expired"] > 0
+               and outcomes["completed"] > 0),
+    }
+    return report, inj, sess
+
+
+def run_breaker_drill(seed, max_batch=4):
+    """Deterministic breaker + grouped→per_request ladder drill: every
+    early dispatch fails (rate 1.0, count-limited), retries are off,
+    so the breaker trips on the Nth consecutive bucket failure and the
+    tripping bucket replays through the per-request degraded lane;
+    once the fault budget is exhausted the lane completes the rest."""
+    from slate_tpu.runtime import (Executor, FaultPlan, FaultSpec,
+                                   Session)
+
+    rng = np.random.default_rng(seed + 1)
+    sess = Session()
+    inj = sess.enable_faults(FaultPlan(seed=seed, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=6),)))
+    n = 16
+    mats = [(rng.standard_normal((n, n))
+             + n * np.eye(n)).astype(np.float32) for _ in range(4)]
+    hs = [sess.register(m, op="lu_small") for m in mats]
+    wrong = lost = 0
+    completed = 0
+    with Executor(sess, max_batch=max_batch, max_wait=3600.0,
+                  retries=0, breaker_threshold=2,
+                  breaker_cooldown=30.0) as ex:
+        futs = []
+        for wave in range(5):
+            for j in range(max_batch):
+                b = rng.standard_normal(n).astype(np.float32)
+                futs.append((ex.submit(hs[j % len(hs)], b),
+                             mats[j % len(hs)], b))
+            ex.flush()
+        for f, m, b in futs:
+            if not f.done():
+                lost += 1
+            elif f.exception() is None:
+                completed += 1
+                if _check_residual(m, f.result(), b) > RESID_TOL:
+                    wrong += 1
+    g = sess.metrics.get
+    cons = _conservation(sess.metrics)
+    return {
+        "conservation": cons,
+        "wrong_answers": wrong, "lost_futures": lost,
+        "completed": completed,
+        "breaker_trips": g("breaker_trips_total"),
+        "degraded_dispatches": g("degraded_dispatches_total"),
+        "breaker_short_circuits": g("breaker_short_circuits"),
+        "ok": (wrong == 0 and lost == 0 and cons["ok"]
+               and g("breaker_trips_total") >= 1
+               and g("degraded_dispatches_total") >= 1
+               and completed > 0),
+    }, inj
+
+
+def run_mixed_drill(seed):
+    """mixed→working_precision ladder drill: a refined operator's
+    bucket trips its breaker; the ladder demotes it (lo resident
+    evicted, refine off — counted in refine_demotions_total) and
+    replays per-request at working precision."""
+    from slate_tpu.runtime import (Executor, FaultPlan, FaultSpec,
+                                   Session)
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 2)
+    sess = Session()
+    inj = sess.enable_faults(FaultPlan(seed=seed, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=4),)))
+    n, nb = 32, 16
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", refine=True)
+    sess.warmup(h)
+    wrong = lost = completed = 0
+    with Executor(sess, max_batch=4, max_wait=3600.0, retries=0,
+                  breaker_threshold=2, breaker_cooldown=30.0) as ex:
+        futs = []
+        for wave in range(4):
+            for _ in range(4):
+                b = rng.standard_normal(n).astype(np.float32)
+                futs.append((ex.submit(h, b), b))
+            ex.flush()
+        for f, b in futs:
+            if not f.done():
+                lost += 1
+            elif f.exception() is None:
+                completed += 1
+                if _check_residual(spd, f.result(), b) > RESID_TOL:
+                    wrong += 1
+    g = sess.metrics.get
+    cons = _conservation(sess.metrics)
+    return {
+        "conservation": cons,
+        "wrong_answers": wrong, "lost_futures": lost,
+        "completed": completed,
+        "breaker_trips": g("breaker_trips_total"),
+        "refine_demotions": g("refine_demotions_total"),
+        "degraded_dispatches": g("degraded_dispatches_total"),
+        "ok": (wrong == 0 and lost == 0 and cons["ok"]
+               and g("refine_demotions_total") >= 1
+               and completed > 0),
+    }, inj
+
+
+def run_shed_drill(seed):
+    """Admission control + load shedding, deterministically (driving
+    the Batcher directly, no worker races): a bounded queue turns
+    excess submits away at the door; an age-triggered shed then drops
+    the cheapest-to-recompute half of what's queued; the survivors are
+    served and every future is accounted."""
+    from slate_tpu.runtime import (Batcher, RequestShed, Session,
+                                   ShedPolicy)
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 3)
+    sess = Session()
+    n, nb = 32, 16
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                   uplo=st.Uplo.Lower), op="chol")
+    sess.warmup(h)
+    bat = Batcher(sess, max_batch=64, max_wait=3600.0,
+                  shed_policy=ShedPolicy(max_queue_depth=8,
+                                         max_age_s=0.01,
+                                         shed_fraction=0.5,
+                                         min_queue_depth=2))
+    futs = [bat.submit(h, rng.standard_normal(n).astype(np.float32))
+            for _ in range(12)]
+    time.sleep(0.05)  # age past max_age_s
+    shed = bat.maybe_shed()
+    bat.flush()
+    lost = sum(1 for f in futs if not f.done())
+    rejected = sum(1 for f in futs
+                   if f.exception() is not None
+                   and isinstance(f.exception(), RequestShed))
+    completed = sum(1 for f in futs if f.exception() is None)
+    cons = _conservation(sess.metrics)
+    g = sess.metrics.get
+    return {
+        "conservation": cons,
+        "lost_futures": lost,
+        "admission_rejected": g("admission_rejected_total"),
+        "shed": shed, "completed": completed,
+        "ok": (lost == 0 and cons["ok"]
+               and g("admission_rejected_total") == 4  # 12 vs depth 8
+               and shed == 4                           # half of 8
+               and completed == 4),
+    }
+
+
+def run_all(seed, waves):
+    """One full chaos pass; returns (phase reports, schedule record)."""
+    soak, inj, _sess = run_soak(seed, waves)
+    drill, inj_b = run_breaker_drill(seed)
+    mixed, inj_m = run_mixed_drill(seed)
+    shed = run_shed_drill(seed)
+    schedule = {
+        "digest": "+".join(i.schedule_digest()
+                           for i in (inj, inj_b, inj_m)),
+        "events": sum(len(i.schedule()) for i in (inj, inj_b, inj_m)),
+        "fired_counts": inj.fired_counts(),
+        "opportunities": inj.opportunity_counts(),
+    }
+    return {"soak": soak, "breaker_drill": drill,
+            "mixed_drill": mixed, "shed_drill": shed}, schedule
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--waves", type=int, default=8,
+                   help="soak waves (each: 5 full buckets + an "
+                        "expired lane)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run_tests wiring: fewer waves, same "
+                        "invariants and determinism gate")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default CHAOS_r01.json; "
+                        "--smoke defaults to a /tmp throwaway)")
+    p.add_argument("--no-repeat", action="store_true",
+                   help="skip the second same-seed pass (the "
+                        "reproducibility gate) — debugging only; the "
+                        "artifact records schedule_reproducible=null")
+    args = p.parse_args(argv)
+    waves = 3 if args.smoke else args.waves
+    out = args.out or ("/tmp/CHAOS_smoke.json" if args.smoke
+                       else "CHAOS_r01.json")
+    import jax
+    platform = jax.devices()[0].platform
+
+    phases, schedule = run_all(args.seed, waves)
+    if args.no_repeat:
+        reproducible = None
+    else:
+        print("# chaos: second same-seed pass (reproducibility gate)",
+              file=sys.stderr)
+        phases2, schedule2 = run_all(args.seed, waves)
+        reproducible = (schedule["digest"] == schedule2["digest"]
+                        and phases2["soak"]["ok"])
+    plan = soak_plan(args.seed)
+    enabled = [s.kind for s in plan.specs if s.rate > 0]
+    invariants = {
+        "wrong_answers": sum(ph.get("wrong_answers", 0)
+                             for ph in phases.values()),
+        "lost_futures": sum(ph.get("lost_futures", 0)
+                            for ph in phases.values()),
+        "conservation_ok": all(ph["conservation"]["ok"]
+                               for ph in phases.values()),
+        "slo_consistent": phases["soak"]["slo"]["ok"],
+        "fleet_fold_ok": phases["soak"]["fleet_fold"]["ok"],
+        "schedule_reproducible": reproducible,
+    }
+    ok = (all(ph["ok"] for ph in phases.values())
+          and invariants["wrong_answers"] == 0
+          and invariants["lost_futures"] == 0
+          and invariants["conservation_ok"]
+          and invariants["slo_consistent"]
+          and invariants["fleet_fold_ok"]
+          and (reproducible is None or reproducible)
+          and len(enabled) >= 4)
+    artifact = {
+        "bench": "chaos",
+        "platform": platform,
+        "seed": args.seed,
+        "waves": waves,
+        "plan": plan.to_dict(),
+        "fault_classes": enabled,
+        "phases": phases,
+        "invariants": invariants,
+        "schedule": schedule,
+        "caveat": ("CPU smoke (TPU tunnel down since round 5): "
+                   "latencies are host-dispatch-bound; the invariant "
+                   "and determinism columns are the claim."
+                   if platform == "cpu" else None),
+        "ok": ok,
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"out": out, "ok": ok,
+                      "fault_classes": len(enabled),
+                      "fired": schedule["fired_counts"],
+                      "invariants": invariants}, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
