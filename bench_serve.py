@@ -71,7 +71,35 @@ apply_env_platforms()
 # --regen-smoke is the guarded regeneration path.
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
-    "serve", "per_request", "speedup", "cost_log", "hbm", "slo")
+    "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
+    "tenants")
+
+
+def _tenants_section(sess):
+    """The serve artifact's round-15 ``tenants`` section: per-tenant
+    totals + placement rows + the conservation verdict (exit-gated —
+    a bench run whose attribution stopped summing to the globals is a
+    broken ledger, not a slow one)."""
+    from slate_tpu.obs.attribution import CLASSES
+
+    snap = sess.attribution.snapshot()
+    conservation = {
+        cls: {"per_tenant_sum": snap["totals"].get(cls, 0.0),
+              "global": sess.metrics.get(counter),
+              "ok": snap["totals"].get(cls, 0.0)
+              == sess.metrics.get(counter)}
+        for cls, counter in CLASSES.items()
+    }
+    placement = sess.placement_snapshot(host="bench")
+    return {
+        "enabled": True,
+        "halflife_s": snap["halflife_s"],
+        "per_tenant": {t: row["totals"]
+                       for t, row in snap["tenants"].items()},
+        "conservation": conservation,
+        "conservation_ok": all(c["ok"] for c in conservation.values()),
+        "placement": placement,
+    }
 
 
 def _build_operator(n, nb, dtype):
@@ -112,11 +140,18 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # records what a production scrape of /slo would have said about
     # this exact workload (burn rates per objective, breach states)
     sess.enable_slo()
-    h = sess.register(A, op="chol")
+    # round 15: tenant attribution through the bench — the artifact's
+    # "tenants" section records the per-tenant ledger view of this
+    # exact workload (two tenants split the request stream) plus the
+    # placement snapshot and the conservation check, exit-gated below
+    sess.enable_attribution()
+    h = sess.register(A, op="chol", tenant="bench-a")
     with Executor(sess, max_batch=max_batch, max_wait=max_wait) as ex:
         ex.warmup([h])  # factor + AOT compile off the request path
         t0 = time.perf_counter()
-        futs = [ex.submit(h, b) for b in rhs]
+        futs = [ex.submit(h, b, tenant=("bench-b" if i % 4 == 3
+                                        else None))
+                for i, b in enumerate(rhs)]
         xs = [f.result(timeout=600) for f in futs]
         serve_wall = time.perf_counter() - t0
 
@@ -159,6 +194,13 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
                         "breached": o["breached"]}
             for o in sess.slo.evaluate()["objectives"]
         },
+        # round 15: the tenant attribution view of the bench workload —
+        # per-tenant counter totals, the placement snapshot (schema-
+        # validated by the Session producer AND by bench_gate
+        # --check-schema on the committed fixture), and the
+        # conservation check: per-tenant rows sum bit-exactly to the
+        # global counters (obs/attribution.py dyadic-grid invariant)
+        "tenants": _tenants_section(sess),
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -912,7 +954,9 @@ def main(argv=None):
                     else "/tmp/BENCH_SERVE_smoke.json")
     art = bench(n=args.n, nb=args.nb, requests=args.requests,
                 max_batch=args.max_batch, out_path=args.out)
-    ok = art["speedup"] > 1.0
+    # round 15: the tenants section exit-gates too — a run whose
+    # per-tenant ledger stopped summing to the globals is broken
+    ok = art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

@@ -148,7 +148,10 @@ def main(argv=None):
         print("!!! bench_serve --overload FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
-    # endpoint (tools/obs_dump.py exits nonzero on any export failure)
+    # endpoint (tools/obs_dump.py exits nonzero on any export failure —
+    # incl. the round-15 tenant/placement sections: attribution
+    # conservation, placement-snapshot schema, the /tenants route,
+    # tenant_* prom rows, and the 2-process attribution/placement fold)
     print("=== tools/obs_dump.py --smoke ===")
     r = subprocess.run(
         [sys.executable, str(here.parent / "tools" / "obs_dump.py"),

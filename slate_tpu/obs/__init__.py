@@ -31,13 +31,19 @@ tools ingest:
 * :mod:`.aggregate`  — N processes' metric/ledger/trace snapshots
   folded into one fleet view (counters summed exactly, histograms
   merged, gauges host-labeled).
+* :mod:`.attribution` — per-(tenant, handle) attribution of every
+  counter class (flops/bytes/ICI/seconds/residency/outcomes) on exact
+  dyadic grids, EWMA handle heat, and the placement-snapshot schema
+  the fleet fold turns into ROADMAP item 1's placement input
+  (round 15).
 
 See DESIGN.md "Observability (round 8)" for the reference mapping
 (Trace.hh Block/SVG -> span model + Chrome export; the global timers
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import aggregate, costs, flops, roofline, slo, watchdog
+from . import aggregate, attribution, costs, flops, roofline, slo, watchdog
+from .attribution import AttributionLedger
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
 from .merge import combine_process_traces, lookahead_overlap, merge_traces
@@ -46,8 +52,10 @@ from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
 from .watchdog import Watchdog
 
 __all__ = [
-    "NOOP_SPAN", "Objective", "ObsServer", "SloTracker", "Span", "Tracer",
-    "Watchdog", "aggregate", "chrome_trace", "combine_process_traces",
+    "AttributionLedger", "NOOP_SPAN", "Objective", "ObsServer",
+    "SloTracker", "Span", "Tracer",
+    "Watchdog", "aggregate", "attribution", "chrome_trace",
+    "combine_process_traces",
     "costs", "default_tracer", "flops", "lookahead_overlap",
     "merge_traces", "render_prometheus", "roofline", "slo",
     "validate_chrome_trace", "watchdog", "write_chrome_trace",

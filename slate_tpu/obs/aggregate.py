@@ -40,9 +40,11 @@ from typing import Dict, List, Optional, Sequence
 from .merge import combine_process_traces  # re-export (fleet surface)
 
 __all__ = [
-    "combine_process_traces", "merge_bytes_snapshots",
+    "combine_process_traces", "merge_attribution_snapshots",
+    "merge_bytes_snapshots",
     "merge_flop_snapshots", "merge_histograms",
-    "merge_metrics_snapshots", "aggregate_processes",
+    "merge_metrics_snapshots", "merge_placement_snapshots",
+    "aggregate_processes",
     "render_fleet_prometheus", "write_fleet",
 ]
 
@@ -182,17 +184,113 @@ def merge_bytes_snapshots(snaps: Sequence[dict]) -> dict:
     }
 
 
+def merge_attribution_snapshots(snaps: Sequence[dict]) -> dict:
+    """N ``AttributionLedger.snapshot()`` dicts -> one fleet
+    attribution view: per-(tenant, handle) cells summed per counter
+    class, tenant and global totals recomputed from the merged cells
+    (sorted order). Every increment lives on the dyadic grid
+    (obs/attribution.py), so these sums are exact and the fleet's
+    per-tenant rows still sum bit-exactly to the fleet's folded
+    global counters — the conservation invariant survives the fold,
+    including under a round-14 ``snapshot_drop`` (a dropped process
+    loses its metrics AND attribution snapshots together, so both
+    sides of the invariant shrink consistently). ``heat`` is summed
+    across processes (a replicated handle's fleet heat is its total
+    access rate — the replication signal); ``last_access`` takes the
+    newest."""
+    snaps = list(snaps)  # a generator must not be consumed before the
+    tenants: Dict[str, dict] = {}  # "processes" count below
+    halflife = None
+    for s in snaps:
+        if halflife is None:
+            halflife = s.get("halflife_s")
+        for tenant, trow in s.get("tenants", {}).items():
+            dst = tenants.setdefault(tenant, {"totals": {},
+                                              "handles": {}})
+            for h, hrow in trow.get("handles", {}).items():
+                cell = dst["handles"].setdefault(h, {})
+                for cls, v in hrow.items():
+                    if cls == "last_access":
+                        prev = cell.get("last_access")
+                        if v is not None and (prev is None or v > prev):
+                            cell["last_access"] = v
+                    else:
+                        cell[cls] = cell.get(cls, 0.0) + v
+    totals: Dict[str, float] = {}
+    for tenant in sorted(tenants):
+        trow = tenants[tenant]
+        for h in sorted(trow["handles"]):
+            for cls, v in trow["handles"][h].items():
+                if cls in ("last_access", "heat"):
+                    continue
+                trow["totals"][cls] = trow["totals"].get(cls, 0.0) + v
+                totals[cls] = totals.get(cls, 0.0) + v
+    return {
+        "schema": "slate_tpu.attribution.v1",
+        "fleet": True,
+        "processes": len(snaps),
+        "halflife_s": halflife,
+        "tenants": tenants,
+        "totals": totals,
+    }
+
+
+def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
+    """N ``Session.placement_snapshot()`` documents -> the fleet
+    placement input (ROADMAP item 1): every host's resident rows in
+    one row set (each row already carries its host label) plus a
+    per-tenant rollup — resident bytes, total heat, handle count per
+    tenant across the fleet — the numbers a quota/placement policy
+    reads first. Rows sort by (tenant, heat desc) so the hottest
+    handles lead each tenant's slice."""
+    docs = list(docs)
+    rows = []
+    hosts = []
+    for doc in docs:
+        hosts.append(doc.get("host", f"proc{len(hosts)}"))
+        rows.extend(dict(r) for r in doc.get("rows", []))
+    rows.sort(key=lambda r: (str(r.get("tenant", "")),
+                             -float(r.get("heat", 0.0) or 0.0),
+                             str(r.get("handle", ""))))
+    per_tenant: Dict[str, dict] = {}
+    for r in rows:
+        t = per_tenant.setdefault(str(r.get("tenant", "")), {
+            "resident_bytes": 0.0, "heat": 0.0, "handles": 0,
+            "hosts": set()})
+        t["resident_bytes"] += float(r.get("bytes_per_chip", 0.0) or 0.0)
+        t["heat"] += float(r.get("heat", 0.0) or 0.0)
+        t["handles"] += 1
+        t["hosts"].add(str(r.get("host", "")))
+    for t in per_tenant.values():
+        t["hosts"] = sorted(t["hosts"])
+    return {
+        "schema": "slate_tpu.fleet_placement.v1",
+        "hosts": hosts,
+        "processes": len(docs),
+        "rows": rows,
+        "per_tenant": per_tenant,
+    }
+
+
 def aggregate_processes(metric_snaps: Sequence[dict],
                         flop_snaps: Optional[Sequence[dict]] = None,
                         bytes_snaps: Optional[Sequence[dict]] = None,
-                        hosts: Optional[Sequence[str]] = None) -> dict:
-    """One fleet document: merged metrics (+ ledgers when given)."""
+                        hosts: Optional[Sequence[str]] = None,
+                        attribution_snaps: Optional[Sequence[dict]] = None,
+                        placement_docs: Optional[Sequence[dict]] = None
+                        ) -> dict:
+    """One fleet document: merged metrics (+ ledgers, tenant
+    attribution, and placement snapshots when given)."""
     doc = {"fleet": True,
            "metrics": merge_metrics_snapshots(metric_snaps, hosts)}
     if flop_snaps is not None:
         doc["flops"] = merge_flop_snapshots(flop_snaps)
     if bytes_snaps is not None:
         doc["bytes"] = merge_bytes_snapshots(bytes_snaps)
+    if attribution_snaps is not None:
+        doc["attribution"] = merge_attribution_snapshots(attribution_snaps)
+    if placement_docs is not None:
+        doc["placement"] = merge_placement_snapshots(placement_docs)
     return doc
 
 
@@ -225,6 +323,24 @@ def render_fleet_prometheus(fleet: dict, prefix: str = "slate_tpu") -> str:
             f"# TYPE {prefix}_fleet_collective_bytes_total counter")
         lines.append(f"{prefix}_fleet_collective_bytes_total "
                      f"{_num(fleet['bytes']['collective_bytes_total'])}")
+    if "attribution" in fleet:
+        # round 15: the fleet's per-tenant rollup, through the SAME
+        # renderer the single-process /metrics route uses
+        from .exposition import render_tenant_sections
+        lines.extend(render_tenant_sections(fleet["attribution"],
+                                            prefix=f"{prefix}_fleet"))
+    if "placement" in fleet:
+        lines.append(f"# TYPE {prefix}_fleet_tenant_resident_bytes gauge")
+        lines.append(f"# TYPE {prefix}_fleet_tenant_heat gauge")
+        pt = fleet["placement"].get("per_tenant", {})
+        for tenant in sorted(pt):
+            lines.append(
+                f'{prefix}_fleet_tenant_resident_bytes'
+                f'{{tenant="{_san(tenant)}"}} '
+                f"{_num(pt[tenant]['resident_bytes'])}")
+            lines.append(
+                f'{prefix}_fleet_tenant_heat{{tenant="{_san(tenant)}"}} '
+                f"{_num(pt[tenant]['heat'])}")
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,410 @@
+"""Tenant/handle attribution: who caused every flop, byte, and second.
+
+Rounds 8–14 built an *aggregate* observability stack — ledgers,
+Prometheus counters, SLO burn rates, fleet folds — that can say "this
+host executed 3.2 TFLOP and shed 12 requests" but not **for whom**.
+ROADMAP item 1 (per-tenant quotas, weighted-fair scheduling, a
+placement policy fed by the fleet fold) needs the serving analog of
+the reference's per-rank trace counters (each MPI rank owns its
+counter payload; rank 0 folds them): attribute every counter class to
+the ``(tenant, handle)`` that caused it, so the round-12 fleet
+aggregation inverts from a descriptive dashboard into a *placement
+input*.
+
+:class:`AttributionLedger` keeps one cell per ``(tenant, handle)``
+accumulating the counter classes in :data:`CLASSES` — factor / solve /
+refine model flops, XLA bytes-accessed, modeled ICI (collective)
+bytes, device- and queue-seconds, HBM residency byte-seconds, cache
+hits/misses, and the round-14 request-outcome partition
+(completed / failed / shed / expired). The serving runtime credits it
+at the SAME seams, with the SAME values, as the existing global
+Metrics counters (``Session._credit_program`` and the
+``metrics.inc`` sites), so per-tenant rows sum to the globals.
+
+**The conservation invariant is bit-exact, by arithmetic, not luck.**
+Float addition only rounds when a partial sum needs more than 53
+mantissa bits; values on a fixed dyadic grid below that limit add
+exactly, and exact addition is associative — so *any* grouping of the
+same increments (per-tenant cells on one host, a fleet fold across N
+hosts, the arrival-order global counter) produces the identical
+float. Every increment is therefore snapped to a grid before it is
+credited anywhere:
+
+* flop / byte / byte-second / count classes: whole numbers
+  (:func:`fl_grid` — model "counts" rounded to integers; exact to
+  2^53);
+* second classes: multiples of 2^-20 s ≈ 0.95 µs (:func:`s_grid`;
+  exact to 2^33 s of accumulated time — ~272 years).
+
+The Session snaps at the seam and hands the snapped value to BOTH
+``metrics.inc`` and the ledger, so enabling attribution never changes
+a global counter, and ``sum(per-tenant rows) == global`` holds with
+``==`` on one host and after ``obs.aggregate``'s fleet fold (the
+acceptance pin in tests/test_attribution.py).
+
+**Handle heat** is a per-resident exponentially-decayed access rate:
+on every cache hit or miss ``heat <- heat * 2^(-dt/halflife) + 1``,
+on evict it only decays — so heat ~= accesses per halflife window,
+the signal a placement policy ranks replication candidates by.
+Exported as ``handle_heat:{tenant}:{handle}`` gauges and in the
+placement snapshot.
+
+**Placement snapshot** (:data:`PLACEMENT_SCHEMA`): one schema-
+validated JSON row per resident factor — {host, tenant, handle, op,
+n, dtype, bytes_per_chip, heat, last_access} — which
+``obs/aggregate.py`` folds across N processes into the fleet-level
+placement input ROADMAP item 1 names (consistent-hash placement,
+hot-handle replication, migration-on-eviction all read exactly this
+row set).
+
+Disabled (``Session(attribution=None)``, the default) every seam is
+one ``attr is None`` check and allocates nothing — the round-8
+discipline, extended here by test. Stdlib-only and jax-free (the obs
+import rule).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Hashable, List, Optional, Tuple
+
+# the tenant every existing caller lands on: register()/solve() without
+# a tenant= kwarg attribute here, so single-tenant deployments get the
+# full ledger without touching a line of client code
+DEFAULT_TENANT = "default"
+
+# every counter class a cell accumulates -> the global Metrics counter
+# its per-tenant rows must sum to (the conservation invariant). The
+# seconds/byte-seconds globals are NEW counters credited only while
+# attribution is enabled (beside the ledger, same snapped values); the
+# rest are the pre-existing serving counters.
+CLASSES: Dict[str, str] = {
+    "factor_flops": "factor_flops_total",
+    "solve_flops": "solve_flops_total",
+    "refine_flops": "refine_flops_total",
+    "bytes": "bytes_accessed_total",
+    "ici_bytes": "collective_bytes_total",
+    "device_seconds": "device_seconds_total",
+    "queue_seconds": "queue_seconds_total",
+    "residency_byte_seconds": "residency_byte_seconds_total",
+    "cache_hits": "cache_hits",
+    "cache_misses": "cache_misses",
+    "completed": "completed_requests",
+    "failed": "failed_requests_total",
+    "shed": "shed_requests_total",
+    "expired": "deadline_expired_total",
+}
+
+# request-outcome classes (the round-14 conservation partition of
+# requests_total, minus client cancellations — the pinned convention)
+OUTCOMES = ("completed", "failed", "shed", "expired")
+
+# seconds grid: 2^-20 s (~0.95 us). Dyadic so sums stay exact (module
+# docstring); fine enough that quantization error per observation is
+# below timer resolution anyway.
+_S_GRID = float(1 << 20)
+
+PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v1"
+FLEET_PLACEMENT_SCHEMA = "slate_tpu.fleet_placement.v1"
+# one row per resident factor. Mirrored (deliberately, the
+# bench_gate/watchdog duplication pattern: tools/bench_gate.py stays
+# importable without package context) as
+# bench_gate.PLACEMENT_ROW_KEYS; tests pin the two tuples equal.
+PLACEMENT_ROW_KEYS = ("host", "tenant", "handle", "op", "n", "dtype",
+                      "bytes_per_chip", "heat", "last_access")
+
+
+def fl_grid(v: float) -> float:
+    """Snap a flop/byte/byte-second increment to the integer grid.
+    Model flops are *counts*; rounding to a whole number changes a
+    GFLOP/s headline by <1e-13 relative and buys exact (hence
+    associative, hence grouping-independent) accumulation."""
+    return float(round(v))
+
+
+def s_grid(v: float) -> float:
+    """Snap a seconds increment to the 2^-20 s dyadic grid."""
+    return round(v * _S_GRID) / _S_GRID
+
+
+def _tname(tenant) -> str:
+    return DEFAULT_TENANT if tenant is None else str(tenant)
+
+
+class AttributionLedger:
+    """Per-(tenant, handle) attribution cells + handle heat + residency.
+
+    Thread-safe (one lock; the runtime calls it under the Session or
+    Batcher lock anyway, but /tenants scrapes arrive from the
+    ObsServer's threads). ``clock`` (monotonic, drives heat decay and
+    residency accrual) and ``wall`` (epoch, stamps ``last_access`` so
+    rows are comparable across hosts) are injectable so the EWMA math
+    and byte-second accounting are pinnable without sleeping.
+    ``metrics``: when bound, heat is published as
+    ``handle_heat:{tenant}:{handle}`` gauges on every access/evict.
+    """
+
+    def __init__(self, halflife_s: float = 300.0, metrics=None,
+                 clock=time.monotonic, wall=time.time):
+        if not halflife_s > 0.0:
+            raise ValueError("AttributionLedger: halflife_s must be > 0")
+        self.halflife_s = float(halflife_s)
+        self.metrics = metrics
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        # (tenant, handle-repr) -> {class: value}; handle keys are
+        # repr()-stringified at the door so cells survive JSON round
+        # trips and fleet folds unchanged
+        self._cells: Dict[Tuple[str, str], Dict[str, float]] = {}
+        # handle-repr -> (tenant, heat, last_mono, last_wall)
+        self._heat: Dict[str, Tuple[str, float, float, float]] = {}
+        # handle-repr -> (tenant, nbytes, since_mono): open residency
+        # intervals, accrued into the cells on every touch
+        self._res: Dict[str, Tuple[str, float, float]] = {}
+
+    # -- recording (called under the runtime's locks) ----------------------
+
+    def _cell(self, tenant: str, handle: str) -> Dict[str, float]:
+        key = (tenant, handle)
+        c = self._cells.get(key)
+        if c is None:
+            c = self._cells[key] = {}
+        return c
+
+    def record(self, cls: str, tenant, handle: Hashable, value: float):
+        """Accumulate one ALREADY-SNAPPED increment (the caller snapped
+        with fl_grid/s_grid before crediting the global counter with
+        the same value — one snap, two consumers, zero drift)."""
+        if cls not in CLASSES:
+            raise ValueError(f"AttributionLedger: unknown class {cls!r}")
+        tenant = _tname(tenant)
+        h = repr(handle)
+        with self._lock:
+            c = self._cell(tenant, h)
+            c[cls] = c.get(cls, 0.0) + value
+
+    def record_outcome(self, tenant, handle: Hashable, outcome: str):
+        if outcome not in OUTCOMES:
+            raise ValueError(
+                f"AttributionLedger: unknown outcome {outcome!r}")
+        self.record(outcome, tenant, handle, 1.0)
+
+    # -- handle heat (EWMA access rate) ------------------------------------
+
+    def _decayed(self, heat: float, dt: float) -> float:
+        return heat * 2.0 ** (-max(dt, 0.0) / self.halflife_s)
+
+    def access(self, tenant, handle: Hashable, hit: bool,
+               now: Optional[float] = None):
+        """One factor-cache access: count the hit/miss in the cell and
+        advance the handle's heat (decay to now, +1)."""
+        tenant = _tname(tenant)
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            c = self._cell(tenant, h)
+            cls = "cache_hits" if hit else "cache_misses"
+            c[cls] = c.get(cls, 0.0) + 1.0
+            prev = self._heat.get(h)
+            heat = 1.0 if prev is None else (
+                self._decayed(prev[1], now - prev[2]) + 1.0)
+            self._heat[h] = (tenant, heat, now, self._wall())
+        self._publish_heat(tenant, h, heat)
+
+    def touch_eviction(self, handle: Hashable,
+                       now: Optional[float] = None):
+        """Advance a handle's heat on eviction (decay only — an
+        eviction observes the clock, it is not an access) and DROP its
+        gauge: per-handle heat gauges exist only while the handle is
+        resident, so handle churn cannot grow /metrics cardinality
+        without bound (the heat STATE is kept for re-access decay;
+        :meth:`forget_handle` clears it on unregister)."""
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            prev = self._heat.get(h)
+            if prev is None:
+                return
+            tenant, heat, last, wall = prev
+            heat = self._decayed(heat, now - last)
+            self._heat[h] = (tenant, heat, now, wall)
+        if self.metrics is not None:
+            self.metrics.drop_gauge(f"handle_heat:{tenant}:{h}")
+
+    def forget_handle(self, handle: Hashable):
+        """Drop a handle's heat/residency STATE (unregister: the
+        handle can never be accessed again — keeping its clocks would
+        leak per-handle memory under churn). The accounting CELLS are
+        deliberately kept: the ledger is the billing history."""
+        h = repr(handle)
+        with self._lock:
+            prev = self._heat.pop(h, None)
+            self._res.pop(h, None)
+        if prev is not None and self.metrics is not None:
+            self.metrics.drop_gauge(f"handle_heat:{prev[0]}:{h}")
+
+    def _publish_heat(self, tenant: str, h: str, heat: float):
+        if self.metrics is not None:
+            self.metrics.set_gauge(f"handle_heat:{tenant}:{h}", heat)
+
+    def heat(self, handle: Hashable, now: Optional[float] = None
+             ) -> float:
+        """Current (decayed-to-now) heat of a handle; 0.0 if never
+        accessed."""
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            prev = self._heat.get(h)
+            if prev is None:
+                return 0.0
+            return self._decayed(prev[1], now - prev[2])
+
+    def last_access(self, handle: Hashable) -> Optional[float]:
+        with self._lock:
+            prev = self._heat.get(repr(handle))
+            return None if prev is None else prev[3]
+
+    def heat_rows(self, now: Optional[float] = None
+                  ) -> Dict[str, Tuple[float, Optional[float]]]:
+        """One locked pass over every handle's heat state:
+        handle-repr -> (decayed-to-now heat, last_access wall time).
+        The placement-snapshot read — N resident rows cost one lock
+        acquisition, not 2N."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            rows = dict(self._heat)
+        return {h: (self._decayed(heat, now - last), wall)
+                for h, (tenant, heat, last, wall) in rows.items()}
+
+    # -- HBM residency byte-seconds ----------------------------------------
+
+    def touch_residency(self, tenant, handle: Hashable, nbytes: float,
+                        now: Optional[float] = None) -> float:
+        """Open (or re-touch) a handle's residency interval: accrue
+        ``elapsed * bytes`` since the last touch into the cell — as a
+        whole number of byte-seconds (grid) — and restart the clock
+        with ``nbytes`` as the new resident charge. Returns the
+        accrued increment so the caller credits the global counter
+        with the identical value."""
+        tenant = _tname(tenant)
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            accrued = self._accrue_locked(h, now)
+            self._res[h] = (tenant, float(nbytes), now)
+        return accrued
+
+    def end_residency(self, handle: Hashable,
+                      now: Optional[float] = None) -> float:
+        """Close a handle's residency interval (eviction/unregister):
+        final accrual, clock stopped. Returns the accrued increment
+        (0.0 when no interval was open)."""
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            accrued = self._accrue_locked(h, now)
+            self._res.pop(h, None)
+        return accrued
+
+    def accrue_residency(self, now: Optional[float] = None) -> float:
+        """Accrue every open interval up to ``now`` (snapshot time, so
+        exported byte-seconds are current). Returns the total
+        increment for the caller's global credit."""
+        now = self._clock() if now is None else now
+        total = 0.0
+        with self._lock:
+            for h in list(self._res):
+                total += self._accrue_locked(h, now)
+        return total
+
+    def _accrue_locked(self, h: str, now: float) -> float:
+        open_ = self._res.get(h)
+        if open_ is None:
+            return 0.0
+        tenant, nbytes, since = open_
+        inc = fl_grid(nbytes * max(now - since, 0.0))
+        if inc:
+            c = self._cell(tenant, h)
+            c["residency_byte_seconds"] = (
+                c.get("residency_byte_seconds", 0.0) + inc)
+        self._res[h] = (tenant, nbytes, now)
+        return inc
+
+    # -- snapshot / export -------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-friendly cells + derived tenant and global totals.
+        Totals are computed by summing the cells (sorted order) — on
+        the dyadic grid that sum equals the arrival-order global
+        counter bit-exactly (module docstring), so the snapshot itself
+        states the conservation invariant it is pinned by."""
+        with self._lock:
+            cells = {k: dict(v) for k, v in self._cells.items()}
+            heat = dict(self._heat)
+        now = self._clock()
+        tenants: Dict[str, dict] = {}
+        totals: Dict[str, float] = {}
+        for (tenant, h) in sorted(cells):
+            row = cells[(tenant, h)]
+            t = tenants.setdefault(tenant,
+                                   {"totals": {}, "handles": {}})
+            hrow = dict(row)
+            hv = heat.get(h)
+            if hv is not None and hv[0] == tenant:
+                hrow["heat"] = self._decayed(hv[1], now - hv[2])
+                hrow["last_access"] = hv[3]
+            t["handles"][h] = hrow
+            for cls, v in row.items():
+                t["totals"][cls] = t["totals"].get(cls, 0.0) + v
+                totals[cls] = totals.get(cls, 0.0) + v
+        return {
+            "schema": "slate_tpu.attribution.v1",
+            "halflife_s": self.halflife_s,
+            "tenants": tenants,
+            "totals": totals,
+        }
+
+
+# -- placement snapshot validation ------------------------------------------
+
+
+def validate_placement_snapshot(doc) -> List[str]:
+    """Schema errors for a ``Session.placement_snapshot()`` document
+    (empty list = valid). The committed schema every consumer —
+    obs_dump, bench_gate's jax-free mirror, the aggregate fold — holds
+    the producer to."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["placement snapshot is not an object"]
+    if doc.get("schema") != PLACEMENT_SCHEMA:
+        errs.append(f"schema != {PLACEMENT_SCHEMA!r}")
+    if not isinstance(doc.get("host"), str) or not doc.get("host"):
+        errs.append("host missing/not a string")
+    rows = doc.get("rows")
+    if not isinstance(rows, list):
+        return errs + ["rows missing/not a list"]
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errs.append(f"rows[{i}]: not an object")
+            continue
+        for k in PLACEMENT_ROW_KEYS:
+            if k not in row:
+                errs.append(f"rows[{i}]: missing {k!r}")
+        for k in ("host", "tenant", "handle", "op", "dtype"):
+            if k in row and not isinstance(row[k], str):
+                errs.append(f"rows[{i}].{k}: not a string")
+        if "n" in row and (not isinstance(row["n"], int)
+                           or isinstance(row["n"], bool)):
+            errs.append(f"rows[{i}].n: not an int")
+        for k in ("bytes_per_chip", "heat"):
+            if k in row and (not isinstance(row[k], (int, float))
+                             or isinstance(row[k], bool)
+                             or row[k] < 0):
+                errs.append(f"rows[{i}].{k}: not a number >= 0")
+        la = row.get("last_access")
+        if la is not None and (not isinstance(la, (int, float))
+                               or isinstance(la, bool)):
+            errs.append(f"rows[{i}].last_access: not a number or null")
+    return errs

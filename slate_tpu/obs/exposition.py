@@ -11,6 +11,9 @@ a stdlib-only (http.server) threaded listener with
 * ``GET /trace.json`` — Chrome-trace JSON of the bound Tracer's spans
 * ``GET /slo``        — SLO burn-rate payload (obs.slo.SloTracker
   .evaluate; {"enabled": false} when no tracker is bound)
+* ``GET /tenants``    — tenant attribution + placement payload
+  (round 15: per-(tenant, handle) counter cells, handle heat, the
+  placement snapshot; {"enabled": false} when no ledger is bound)
 
 No third-party dependency, daemon threads only, ephemeral port by
 default (``port=0``) so tests and co-located sessions never collide.
@@ -36,7 +39,7 @@ def _san(name: str) -> str:
 
 def render_prometheus(snapshot, prefix: str = "slate_tpu",
                       ledger: Optional["flops_mod.FlopLedger"] = None,
-                      bytes_ledger=None) -> str:
+                      bytes_ledger=None, attribution=None) -> str:
     """Metrics snapshot (or a Metrics instance) -> Prometheus text.
 
     Counters render as ``counter``; histograms as ``summary`` (count,
@@ -51,7 +54,18 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
     counters split the served ICI traffic per verb. ``ledger=None`` binds the process flop
     ledger and ``bytes_ledger=None`` the process bytes ledger
     (``driver_bytes_total`` / ``collective_bytes_total`` — round 9);
-    pass either ``False`` to disable its section."""
+    pass either ``False`` to disable its section.
+
+    ``attribution`` (round 15): an
+    :class:`~.attribution.AttributionLedger` or its ``snapshot()``
+    dict — renders the ``tenant_*`` sections (one
+    ``{prefix}_tenant_<class>_total{{tenant="..."}}`` counter row per
+    tenant per counter class, plus a ``tenant_handles`` gauge); the
+    per-(tenant, handle) cells stay in the JSON payload (/tenants) —
+    handle-level Prometheus label cardinality is the scrape-killer
+    the per-tenant rollup exists to avoid. None = no section (the
+    default: a session without attribution renders exactly what it
+    rendered before)."""
     if hasattr(snapshot, "snapshot"):
         snapshot = snapshot.snapshot()
     if ledger is None:
@@ -131,7 +145,40 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
                 lines.append(
                     f'{prefix}_collective_ops_total{{kind="{_san(kind)}"}}'
                     f' {_num(row["count"])}')
+    if attribution is not None:
+        lines.extend(render_tenant_sections(attribution, prefix=prefix))
     return "\n".join(lines) + "\n"
+
+
+def render_tenant_sections(attribution, prefix: str = "slate_tpu"
+                           ) -> list:
+    """The ``tenant_*`` Prometheus lines of an attribution snapshot
+    (or ledger): per-tenant counter rollups per class, one
+    ``tenant_handles`` gauge per tenant. Shared by the single-process
+    /metrics route and the fleet renderer (aggregate.py), so the two
+    surfaces cannot drift."""
+    if hasattr(attribution, "snapshot"):
+        attribution = attribution.snapshot()
+    lines = []
+    tenants = attribution.get("tenants", {})
+    if not tenants:
+        return lines
+    classes = sorted({cls for t in tenants.values()
+                      for cls in t.get("totals", {})})
+    for cls in classes:
+        name = f"{prefix}_tenant_{_san(cls)}_total"
+        lines.append(f"# TYPE {name} counter")
+        for tenant in sorted(tenants):
+            v = tenants[tenant].get("totals", {}).get(cls)
+            if v is not None:
+                lines.append(
+                    f'{name}{{tenant="{_san(tenant)}"}} {_num(v)}')
+    lines.append(f"# TYPE {prefix}_tenant_handles gauge")
+    for tenant in sorted(tenants):
+        lines.append(
+            f'{prefix}_tenant_handles{{tenant="{_san(tenant)}"}} '
+            f'{_num(len(tenants[tenant].get("handles", {})))}')
+    return lines
 
 
 def _num(v) -> str:
@@ -149,7 +196,10 @@ class _Handler(BaseHTTPRequestHandler):
         obs: "ObsServer" = self.server.obs  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
-            body = render_prometheus(obs.metrics, ledger=obs.ledger)
+            attr = (obs.attribution() if callable(obs.attribution)
+                    else obs.attribution)
+            body = render_prometheus(obs.metrics, ledger=obs.ledger,
+                                     attribution=attr)
             self._reply(200, body, "text/plain; version=0.0.4")
         elif path == "/healthz":
             snap = obs.metrics.snapshot()
@@ -171,6 +221,17 @@ class _Handler(BaseHTTPRequestHandler):
             payload = (tracker.evaluate() if tracker is not None
                        else {"enabled": False, "objectives": []})
             body = json.dumps(payload) + "\n"
+            self._reply(200, body, "application/json")
+        elif path == "/tenants":
+            # round 15: the tenant attribution + placement payload
+            # (Session.serve_obs binds a getter so attribution enabled
+            # AFTER the server started is still served — the /slo
+            # provider discipline)
+            payload = (obs.tenants() if callable(obs.tenants)
+                       else obs.tenants)
+            if payload is None:
+                payload = {"enabled": False, "tenants": {}}
+            body = json.dumps(payload, sort_keys=True) + "\n"
             self._reply(200, body, "application/json")
         else:
             self._reply(404, "not found\n", "text/plain")
@@ -195,13 +256,19 @@ class ObsServer:
     shuts it down (also a context manager)."""
 
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
-                 port: int = 0, ledger=None, slo=None):
+                 port: int = 0, ledger=None, slo=None, tenants=None,
+                 attribution=None):
         self.metrics = metrics
         self.tracer = tracer
         # the /slo provider: an SloTracker, or a zero-arg callable
         # resolved per request (Session.serve_obs passes a getter so a
         # tracker enabled AFTER the server started is still served)
         self.slo = slo
+        # round 15: the /tenants payload provider and the attribution
+        # ledger (or getters — same late-enable discipline as /slo);
+        # attribution feeds the tenant_* sections of /metrics
+        self.tenants = tenants
+        self.attribution = attribution
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

@@ -89,6 +89,12 @@ class Objective:
     op: Optional[str] = None
     n_bucket: Optional[int] = None
     source: str = "request"
+    # round 15: scope latency/error objectives to one tenant's traffic
+    # (the runtime labels request events with the resolved tenant when
+    # attribution or an explicit tenant= override is in play; events
+    # without a tenant label carry None and only match unscoped
+    # objectives)
+    tenant: Optional[str] = None
     windows: Tuple[float, ...] = DEFAULT_WINDOWS
     burn_threshold: float = 1.0
 
@@ -150,8 +156,10 @@ class SloTracker:
         self._clock = clock
         self._max = max_events
         self._lock = threading.Lock()
-        # (source, op, n_bucket) -> events; scoped lookups filter keys
-        self._requests: Dict[Tuple[str, str, int], Deque[_Event]] = {}
+        # (source, op, n_bucket, tenant) -> events; scoped lookups
+        # filter keys (tenant None = unlabeled, round-15 scoping)
+        self._requests: Dict[Tuple[str, str, int, Optional[str]],
+                             Deque[_Event]] = {}
         self._cache: Deque[_Event] = deque(maxlen=max_events)
         self._oom: Deque[_Event] = deque(maxlen=max_events)
         self._breached: Dict[str, bool] = {}
@@ -160,8 +168,9 @@ class SloTracker:
 
     def record_request(self, op: str, n: int, latency_s: float,
                        ok: bool = True, source: str = "request",
-                       t: Optional[float] = None):
-        key = (source, op, n_bucket(n))
+                       t: Optional[float] = None,
+                       tenant: Optional[str] = None):
+        key = (source, op, n_bucket(n), tenant)
         t = self._clock() if t is None else t
         with self._lock:
             q = self._requests.get(key)
@@ -207,12 +216,14 @@ class SloTracker:
         if obj.kind == "oom_risk":
             return tuple(self._oom)
         out = []
-        for (source, op, nb), q in self._requests.items():
+        for (source, op, nb, tenant), q in self._requests.items():
             if source != obj.source:
                 continue
             if obj.op is not None and op != obj.op:
                 continue
             if obj.n_bucket is not None and nb != obj.n_bucket:
+                continue
+            if obj.tenant is not None and tenant != obj.tenant:
                 continue
             out.extend(q)
         return tuple(out)
@@ -274,6 +285,7 @@ class SloTracker:
                 "name": obj.name, "kind": obj.kind, "target": obj.target,
                 "threshold_s": obj.threshold_s, "op": obj.op,
                 "n_bucket": obj.n_bucket, "source": obj.source,
+                "tenant": obj.tenant,
                 "burn_threshold": obj.burn_threshold,
                 "windows": windows, "worst_burn_rate": worst,
                 "breached": breached,

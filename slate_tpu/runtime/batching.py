@@ -40,6 +40,7 @@ from typing import Dict, Hashable, List, Optional, Tuple
 import numpy as np
 
 from ..core.exceptions import SlateError
+from ..obs.attribution import s_grid as _s_grid
 from ..obs.tracing import NOOP_SPAN as _NOOP_SPAN
 from .faults import DeadlineExceeded, RequestShed
 from .session import Session
@@ -62,6 +63,12 @@ class _Request:
     # FAILS FAST (DeadlineExceeded, counted, span-annotated) instead
     # of occupying a batch lane; None = no deadline
     deadline: Optional[float] = None
+    # explicit per-request tenant override (round 15): None = the
+    # operator's registered tenant (resolved lazily at the attribution
+    # seams — the disabled path never resolves). An explicit tenant
+    # joins the bucket key, so one dispatched bucket is one tenant and
+    # the Session-side work attribution stays exact.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,7 +152,7 @@ class Batcher:
     # -- submission --------------------------------------------------------
 
     def submit(self, handle: Hashable, b, timeout_s: Optional[float]
-               = None) -> Future:
+               = None, tenant: Optional[str] = None) -> Future:
         """Enqueue one solve request; resolves to the solution array
         with the same rank as ``b``. Small-problem operators are
         grouped across handles (module docstring): their bucket key is
@@ -158,15 +165,26 @@ class Batcher:
         batch lane. With a :class:`ShedPolicy` admission bound, a
         submit against a full queue returns an ALREADY-FAILED future
         (:class:`~.faults.RequestShed`; ``admission_rejected_total``)
-        without enqueueing."""
+        without enqueueing.
+
+        ``tenant`` (round 15): per-request attribution override. An
+        EXPLICIT tenant joins the bucket key (requests with different
+        explicit tenants never coalesce — one dispatched program is
+        one tenant's work, which keeps the attribution exact and is
+        the grain the item-1 weighted-fair scheduler will schedule
+        at); ``None`` — every existing caller — keeps today's keys
+        byte-identical and attributes to the operator's registered
+        tenant."""
         req, rejection = self.submit_deferred(handle, b,
-                                              timeout_s=timeout_s)
+                                              timeout_s=timeout_s,
+                                              tenant=tenant)
         if rejection is not None:
             self.reject_admission(req, rejection)
         return req.future
 
     def submit_deferred(self, handle: Hashable, b,
-                        timeout_s: Optional[float] = None
+                        timeout_s: Optional[float] = None,
+                        tenant: Optional[str] = None
                         ) -> Tuple[_Request, Optional[Exception]]:
         """The enqueue half of :meth:`submit`: returns ``(request,
         rejection)`` WITHOUT resolving an admission-rejected future —
@@ -180,13 +198,20 @@ class Batcher:
         vector = b.ndim == 1
         b2 = b[:, None] if vector else b
         skey = self.session.small_group_key(handle)
+        # an explicit tenant splits the bucket (one program = one
+        # tenant); spliced BEFORE the (shape, dtype) tail so grouped
+        # dispatch keeps reading op=key[1], n=key[2], shape=key[-2],
+        # dtype=key[-1] — and None (every existing caller) keeps the
+        # key tuples byte-identical to round 14
+        tsplit = () if tenant is None else (str(tenant),)
         if skey is not None:
-            key: BucketKey = (_SMALL,) + skey + (tuple(b2.shape),
-                                                 str(b2.dtype))
+            key: BucketKey = (_SMALL,) + skey + tsplit + (
+                tuple(b2.shape), str(b2.dtype))
         else:
-            key = (handle, tuple(b2.shape), str(b2.dtype))
+            key = (handle,) + tsplit + (tuple(b2.shape), str(b2.dtype))
         req = _Request(b2, vector, Future(), time.monotonic(),
-                       handle=handle)
+                       handle=handle,
+                       tenant=None if tenant is None else str(tenant))
         if timeout_s is not None:
             req.deadline = req.t_submit + timeout_s
         self.session.metrics.inc("requests_total")
@@ -364,6 +389,7 @@ class Batcher:
         m = self.session.metrics
         tr = self.session.tracer
         slo = self.session.slo
+        attr = self.session.attribution
         for r in reqs:
             err = DeadlineExceeded(
                 f"deadline exceeded after {now - r.t_submit:.4f}s in "
@@ -373,6 +399,9 @@ class Batcher:
             except InvalidStateError:
                 continue  # client cancelled first; counted elsewhere
             m.inc("deadline_expired_total")
+            if attr is not None:
+                attr.record_outcome(self._rtenant(r), r.handle,
+                                    "expired")
             if tr.enabled:
                 sp = r.span or tr.start_span(
                     "serve.request", kind="request",
@@ -383,7 +412,8 @@ class Batcher:
                 meta = self.session.op_meta(r.handle)
                 if meta is not None:
                     slo.record_request(meta[0], meta[1],
-                                       now - r.t_submit, ok=False)
+                                       now - r.t_submit, ok=False,
+                                       tenant=self._rtenant(r))
 
     # -- admission control + load shedding (round 14) ----------------------
 
@@ -455,6 +485,7 @@ class Batcher:
         m.inc("load_sheds_total")
         m.set_gauge("shedding_active", 1.0)
         tr = self.session.tracer
+        attr = self.session.attribution
         shed = 0
         for r in victims:
             try:
@@ -465,6 +496,8 @@ class Batcher:
             except InvalidStateError:
                 continue  # cancelled concurrently
             shed += 1
+            if attr is not None:
+                attr.record_outcome(self._rtenant(r), r.handle, "shed")
             if tr.enabled:
                 sp = r.span or tr.start_span(
                     "serve.request", kind="request",
@@ -475,6 +508,21 @@ class Batcher:
         return shed
 
     # -- dispatch ----------------------------------------------------------
+
+    def _rtenant(self, r: _Request) -> str:
+        """Resolved tenant of one request (explicit override ->
+        operator tenant -> default). Only called from seams that
+        already verified the attribution/SLO consumer exists."""
+        return self.session.request_tenant(r.handle, r.tenant)
+
+    def _attr_queue_wait(self, attr, r: _Request, now: float):
+        """Caller verified ``attr is not None``: queue-wait seconds on
+        the dyadic grid, same snapped value to the per-tenant cell and
+        the ``queue_seconds_total`` global (the conservation seam)."""
+        qs = _s_grid(now - r.t_submit)
+        if qs:
+            self.session.metrics.inc("queue_seconds_total", qs)
+            attr.record("queue_seconds", self._rtenant(r), r.handle, qs)
 
     def run(self, key: BucketKey, reqs: List[_Request]):
         """Solve one detached bucket: stack → one Session solve → split.
@@ -493,16 +541,20 @@ class Batcher:
         span via the contextvar scope."""
         if key and key[0] is _SMALL:
             return self._run_small(key, reqs)
+        # key = (handle[, tenant], shape, dtype): the optional round-15
+        # tenant splice sits between the handle and the fixed tail
         handle = key[0]
+        kshape, kdtype = key[-2], key[-1]
         now = time.monotonic()
         live = self._live(reqs, now)
         if not live:
             return
         tr = self.session.tracer
         bctx = (tr.span("serve.batch", handle=repr(handle),
-                        batch_size=len(live), shape=list(key[1]),
-                        dtype=key[2]) if tr.enabled else _NOOP_SPAN)
+                        batch_size=len(live), shape=list(kshape),
+                        dtype=kdtype) if tr.enabled else _NOOP_SPAN)
         m = self.session.metrics
+        attr = self.session.attribution
         with bctx as bspan:
             # exemplar join key: the batch's trace id (NOOP -> None)
             tid = getattr(bspan, "trace_id", None)
@@ -514,10 +566,12 @@ class Batcher:
                     r.span = tr.start_span(
                         "serve.request", parent=bspan, kind="request",
                         handle=repr(handle), shape=list(r.b.shape),
-                        dtype=key[2], queue_s=now - r.t_submit)
+                        dtype=kdtype, queue_s=now - r.t_submit)
                 # lifecycle stage 1 (round 12): submit -> dispatch start
                 m.observe("stage_queue_wait", now - r.t_submit,
                           exemplar=tid)
+                if attr is not None:
+                    self._attr_queue_wait(attr, r, now)
             try:
                 t_form = time.monotonic()
                 stacked = np.concatenate([r.b for r in live], axis=1)
@@ -542,14 +596,17 @@ class Batcher:
                 # — the padded zero columns are executed work (the
                 # ledgers see them, split out as padding_waste_flops/
                 # bytes — round 12) but not served requests. Passed
-                # only when padding actually happened, so the
-                # unpadded path keeps the bare solve(handle, b)
-                # signature.
+                # only when padding actually happened — and the
+                # round-15 tenant only when a request carried an
+                # explicit override (the key split guarantees the
+                # bucket is single-tenant) — so the common path keeps
+                # the bare solve(handle, b) signature.
+                kw = {}
                 if stacked.shape[1] != cols:
-                    x = self.session.solve(handle, stacked,
-                                           served_cols=cols)
-                else:
-                    x = self.session.solve(handle, stacked)
+                    kw["served_cols"] = cols
+                if live[0].tenant is not None:
+                    kw["tenant"] = live[0].tenant
+                x = self.session.solve(handle, stacked, **kw)
             except Exception as e:
                 # close this attempt's request spans INSIDE the batch
                 # scope: the exception is about to close the batch span
@@ -578,9 +635,13 @@ class Batcher:
                     continue
                 lat = done - r.t_submit
                 m.inc("completed_requests")
+                if attr is not None:
+                    attr.record_outcome(self._rtenant(r), r.handle,
+                                        "completed")
                 m.observe("request_latency", lat, exemplar=tid)
                 if meta is not None:
-                    slo.record_request(meta[0], meta[1], lat, ok=True)
+                    slo.record_request(meta[0], meta[1], lat, ok=True,
+                                       tenant=self._rtenant(r))
                 # total_s (submit -> resolve) is what the slow-request
                 # log thresholds on — the client-visible latency
                 tr.finish_span(r.span, total_s=lat)
@@ -628,6 +689,7 @@ class Batcher:
                         batch_size=len(live), shape=list(shape),
                         dtype=bdt) if tr.enabled else _NOOP_SPAN)
         m = self.session.metrics
+        attr = self.session.attribution
         with bctx as bspan:
             tid = getattr(bspan, "trace_id", None)
             for r in live:
@@ -638,9 +700,18 @@ class Batcher:
                         dtype=bdt, queue_s=now - r.t_submit)
                 m.observe("stage_queue_wait", now - r.t_submit,
                           exemplar=tid)
+                if attr is not None:
+                    self._attr_queue_wait(attr, r, now)
             try:
+                # explicit tenant overrides ride the bucket key (one
+                # bucket = one explicit tenant), so the per-item
+                # tenants list is uniform; None lets the Session
+                # resolve each item's operator tenant
+                tenants = ([r.tenant for r in live]
+                           if live[0].tenant is not None else None)
                 xs, infos = self.session.solve_small_batched(
-                    [r.handle for r in live], [r.b for r in live])
+                    [r.handle for r in live], [r.b for r in live],
+                    tenants=tenants)
             except Exception as e:
                 for r in live:
                     tr.finish_span(r.span, error=e)
@@ -657,11 +728,15 @@ class Batcher:
                     try:
                         r.future.set_exception(err)
                         m.inc("failed_requests_total")
+                        if attr is not None:
+                            attr.record_outcome(self._rtenant(r),
+                                                r.handle, "failed")
                     except InvalidStateError:
                         m.inc("cancelled_requests")
                     if slo is not None:
                         slo.record_request(op, n, done - r.t_submit,
-                                           ok=False)
+                                           ok=False,
+                                           tenant=self._rtenant(r))
                     tr.finish_span(r.span, error=err)
                     continue
                 xi = xs[i]
@@ -673,9 +748,13 @@ class Batcher:
                     continue
                 lat = done - r.t_submit
                 m.inc("completed_requests")
+                if attr is not None:
+                    attr.record_outcome(self._rtenant(r), r.handle,
+                                        "completed")
                 m.observe("request_latency", lat, exemplar=tid)
                 if slo is not None:
-                    slo.record_request(op, n, lat, ok=True)
+                    slo.record_request(op, n, lat, ok=True,
+                                       tenant=self._rtenant(r))
                 tr.finish_span(r.span, total_s=lat)
             m.observe("stage_reply", time.monotonic() - done,
                       exemplar=tid)
@@ -693,6 +772,7 @@ class Batcher:
         m = self.session.metrics
         tr = self.session.tracer
         slo = self.session.slo
+        attr = self.session.attribution
         now = time.monotonic()
         live = self._live(reqs, now)
         if not live:
@@ -709,19 +789,29 @@ class Batcher:
                         "serve.request", parent=bspan, kind="request",
                         handle=repr(r.handle), degraded=True,
                         queue_s=now - r.t_submit)
+                if attr is not None:
+                    self._attr_queue_wait(attr, r, now)
                 meta = self.session.op_meta(r.handle)
                 try:
-                    x = self.session.solve(r.handle, r.b)
+                    if r.tenant is not None:
+                        x = self.session.solve(r.handle, r.b,
+                                               tenant=r.tenant)
+                    else:
+                        x = self.session.solve(r.handle, r.b)
                 except Exception as e:  # noqa: BLE001 — per-item isolation
                     try:
                         r.future.set_exception(e)
                         m.inc("failed_requests_total")
+                        if attr is not None:
+                            attr.record_outcome(self._rtenant(r),
+                                                r.handle, "failed")
                     except InvalidStateError:
                         m.inc("cancelled_requests")
                     if slo is not None and meta is not None:
                         slo.record_request(
                             meta[0], meta[1],
-                            time.monotonic() - r.t_submit, ok=False)
+                            time.monotonic() - r.t_submit, ok=False,
+                            tenant=self._rtenant(r))
                     tr.finish_span(r.span, error=e)
                     continue
                 done = time.monotonic()
@@ -733,9 +823,13 @@ class Batcher:
                     continue
                 lat = done - r.t_submit
                 m.inc("completed_requests")
+                if attr is not None:
+                    attr.record_outcome(self._rtenant(r), r.handle,
+                                        "completed")
                 m.observe("request_latency", lat, exemplar=tid)
                 if slo is not None and meta is not None:
-                    slo.record_request(meta[0], meta[1], lat, ok=True)
+                    slo.record_request(meta[0], meta[1], lat, ok=True,
+                                       tenant=self._rtenant(r))
                 tr.finish_span(r.span, total_s=lat)
 
     def flush(self):

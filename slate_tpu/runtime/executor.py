@@ -135,17 +135,20 @@ class Executor:
     # -- client surface ----------------------------------------------------
 
     def submit(self, handle: Hashable, b,
-               timeout_s: Optional[float] = None) -> Future:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one solve request; never blocks on the device. The
         shutdown check and the enqueue are one atomic step under the
         lock, so a request can never land in a drained Batcher after
         the worker has exited (its Future would hang forever).
-        ``timeout_s``: per-request deadline (Batcher.submit)."""
+        ``timeout_s``: per-request deadline (Batcher.submit).
+        ``tenant``: per-request attribution override (round 15;
+        Batcher.submit — an explicit tenant splits the bucket)."""
         with self._cv:
             if self._stop:
                 raise RuntimeError("Executor is shut down")
             req, rejection = self.batcher.submit_deferred(
-                handle, b, timeout_s=timeout_s)
+                handle, b, timeout_s=timeout_s, tenant=tenant)
             self._cv.notify_all()
         if rejection is not None:
             # resolve OUTSIDE the lock: a done-callback that re-enters
@@ -407,6 +410,7 @@ class Executor:
         service failures)."""
         self.session.metrics.inc("failed_batches")
         slo = self.session.slo
+        attr = self.session.attribution
         now = time.monotonic()
         for r in reqs:
             was_done = r.future.done()
@@ -414,6 +418,12 @@ class Executor:
                 if not was_done:
                     r.future.set_exception(err)
                     self.session.metrics.inc("failed_requests_total")
+                    if attr is not None:
+                        attr.record_outcome(
+                            self.session.request_tenant(
+                                getattr(r, "handle", None),
+                                getattr(r, "tenant", None)),
+                            getattr(r, "handle", None), "failed")
             except InvalidStateError:
                 pass  # client cancelled concurrently — same race
             except Exception:   # pragma: no cover - legacy guard
@@ -422,5 +432,8 @@ class Executor:
                 # the final (post-retry) failure is the SLO error event
                 meta = self.session.op_meta(getattr(r, "handle", None))
                 if meta is not None:
-                    slo.record_request(meta[0], meta[1],
-                                       now - r.t_submit, ok=False)
+                    slo.record_request(
+                        meta[0], meta[1], now - r.t_submit, ok=False,
+                        tenant=self.session.request_tenant(
+                            getattr(r, "handle", None),
+                            getattr(r, "tenant", None)))

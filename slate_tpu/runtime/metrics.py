@@ -112,7 +112,12 @@ class Metrics:
       degraded_dispatches_total, breaker_trips_total /
       breaker_probes_total / breaker_closes_total /
       breaker_short_circuits / breaker_rejections_total,
-      refine_demotions_total, faults_injected_total + fault:{kind}
+      refine_demotions_total, faults_injected_total + fault:{kind};
+      round-15 attribution (credited only while a Session carries an
+      AttributionLedger, with grid-snapped values so the per-tenant
+      cells sum to them bit-exactly — obs/attribution.py):
+      device_seconds_total, queue_seconds_total,
+      residency_byte_seconds_total
     Histograms (seconds, except batch_size):
       solve_latency, factor_latency, request_latency, batch_size, and
       the round-12 request lifecycle stages — stage_queue_wait,
@@ -127,7 +132,9 @@ class Metrics:
       width_bucket_efficiency / batch_bucket_efficiency (served ÷
       executed fraction of the last padded dispatch); slo_burn_rate:* /
       slo_breached:* and watchdog_* (obs/slo.py, obs/watchdog.py);
-      round-14 reflexes: shedding_active, circuit_breakers_open
+      round-14 reflexes: shedding_active, circuit_breakers_open;
+      round-15 handle heat: handle_heat:{tenant}:{handle} — the
+      EWMA access rate the placement snapshot ranks residents by
     """
 
     def __init__(self):
@@ -162,6 +169,14 @@ class Metrics:
     def get_gauge(self, name: str, default: float = 0.0) -> float:
         with self._lock:
             return self._gauges.get(name, default)
+
+    def drop_gauge(self, name: str):
+        """Remove a gauge from the scrape surface (no error if absent).
+        Round 15: per-handle heat gauges exist only while the handle is
+        resident — eviction drops the gauge so handle churn cannot grow
+        /metrics cardinality without bound."""
+        with self._lock:
+            self._gauges.pop(name, None)
 
     def observe(self, name: str, value: float, exemplar=None):
         """``exemplar`` (a trace id) tags the observation so the worst

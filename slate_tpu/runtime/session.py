@@ -59,13 +59,35 @@ from ..linalg.band_packed import PackedBand
 # shared with bench.py and tester.py instead of a private copy here
 from ..obs import flops as _flops_mod
 from ..obs.flops import LEDGER as _LEDGER
-from ..obs.flops import factor_flops as _factor_flops
-from ..obs.flops import solve_flops as _solve_flops
+from ..obs.flops import factor_flops as _ff_raw
+from ..obs.flops import solve_flops as _sf_raw
 from ..obs import costs as _costs
+# tenant/handle attribution (round 15): the grid snappers run
+# UNCONDITIONALLY at the metric seams — model-flop counters land on
+# the integer grid whether or not a ledger is attached, so enabling
+# attribution never changes a global counter and the per-tenant rows
+# sum to the globals bit-exactly (obs/attribution.py module docstring)
+from ..obs.attribution import (DEFAULT_TENANT, PLACEMENT_SCHEMA,
+                               fl_grid as _fl_grid, s_grid as _s_grid,
+                               validate_placement_snapshot)
 from ..obs.tracing import Tracer, default_tracer, log as _obs_log
 from ..refine import engine as _refine_engine
 from ..refine.policy import PolicyTable, RefinePolicy
 from .metrics import Metrics
+
+
+def _factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
+    """Model factor flops snapped to the integer grid (obs/attribution:
+    exact float accumulation -> the per-tenant conservation invariant
+    is bit-exact by arithmetic). <1e-13 relative change vs the raw
+    lawn41 formula; every serving counter seam uses this wrapper."""
+    return _fl_grid(_ff_raw(op, m, n, band))
+
+
+def _solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
+    """Model solve flops on the integer grid (see _factor_flops)."""
+    return _fl_grid(_sf_raw(op, m, n, k, band))
+
 
 # operator kinds a Session can keep resident. The *_small family
 # (round 10) is the many-small-problems engine: dense [n, n] ARRAY
@@ -141,6 +163,10 @@ class _Operator:
     # ‖A‖_inf, computed once at first refined solve (the convergence
     # constant's norm — gesv_mixed.cc:34-43)
     anorm: Optional[float] = None
+    # attribution tenant (round 15): who this operator belongs to.
+    # None = the DEFAULT_TENANT — every existing caller lands there,
+    # so single-tenant deployments get the ledger without changes
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -187,8 +213,16 @@ class Session:
                  tracer: Optional[Tracer] = None,
                  mesh=None, slo=None,
                  refine_policies: Optional[PolicyTable] = None,
-                 faults=None):
+                 faults=None, attribution=None):
         self.hbm_budget = hbm_budget
+        # tenant/handle attribution (round 15): None = disabled — every
+        # seam guards with ONE `attr is None` check and allocates
+        # nothing (the round-8 tracer discipline, pinned by test). An
+        # obs.attribution.AttributionLedger accounts flops, bytes, ICI
+        # bytes, device/queue seconds, HBM residency byte-seconds,
+        # cache hits/misses, and request outcomes per (tenant, handle),
+        # plus EWMA handle heat — the placement/quota sensing substrate
+        self.attribution = attribution
         # deterministic fault injection (round 14): None = disabled —
         # every seam guards with ONE `faults is None` check, so the
         # production hot path pays nothing (the round-8 tracer
@@ -208,6 +242,8 @@ class Session:
         # With a mesh, hbm_budget bounds PER-CHIP bytes.
         self.grid = as_grid(mesh)
         self.metrics = metrics or Metrics()
+        if attribution is not None and attribution.metrics is None:
+            attribution.metrics = self.metrics  # heat gauges land here
         # request-scoped tracing: disabled by default (the shared
         # default tracer starts off) — zero spans, no per-solve cost
         # beyond one enabled-flag check per phase
@@ -263,6 +299,43 @@ class Session:
                 self.slo = SloTracker(objectives, metrics=self.metrics,
                                       tracer=self.tracer, **kw)
             return self.slo
+
+    def enable_attribution(self, halflife_s: float = 300.0, **kw):
+        """Attach an :class:`~..obs.attribution.AttributionLedger`
+        (heat halflife ``halflife_s``) bound to this session's metrics
+        and return it; idempotent — a second call returns the running
+        ledger. The ``/tenants`` route of :meth:`serve_obs` serves its
+        payload and ``/metrics`` grows the ``tenant_*`` sections."""
+        from ..obs.attribution import AttributionLedger
+        with self._lock:
+            if self.attribution is None:
+                self.attribution = AttributionLedger(
+                    halflife_s=halflife_s, metrics=self.metrics, **kw)
+            return self.attribution
+
+    def request_tenant(self, handle: Hashable,
+                       override: Optional[str] = None) -> str:
+        """Resolved tenant of one request: the explicit per-request
+        override, else the operator's registered tenant, else the
+        DEFAULT_TENANT. Lock-free (the op_meta discipline: called from
+        the Batcher's outcome paths, which must never wait on a device
+        execution)."""
+        if override is not None:
+            return str(override)
+        entry = self._ops.get(handle)
+        t = None if entry is None else entry.tenant
+        return DEFAULT_TENANT if t is None else t
+
+    def _attr_evicted(self, handle: Hashable):
+        """Caller verified ``self.attribution is not None``. Close the
+        handle's residency interval (final byte-second accrual credits
+        the same value to the cell and the global counter) and advance
+        its heat (decay only — an eviction is not an access)."""
+        attr = self.attribution
+        inc = attr.end_residency(handle)
+        if inc:
+            self.metrics.inc("residency_byte_seconds_total", inc)
+        attr.touch_eviction(handle)
 
     def enable_faults(self, plan=None, seed: int = 1):
         """Attach a :class:`~.faults.FaultInjector` built from ``plan``
@@ -360,6 +433,8 @@ class Session:
             if dropped is not None:
                 self.metrics.inc("evictions")
                 self.metrics.inc("evicted_bytes", dropped.nbytes)
+                if self.attribution is not None:
+                    self._attr_evicted(handle)
             self.metrics.inc("refine_demotions_total")
             self._update_hbm_gauges()
         _obs_log.warning(
@@ -381,7 +456,8 @@ class Session:
     def register(self, A, op: str = "auto",
                  handle: Optional[Hashable] = None,
                  opts: Optional[Options] = None,
-                 mesh=None, refine=None) -> Hashable:
+                 mesh=None, refine=None,
+                 tenant: Optional[str] = None) -> Hashable:
         """Register an operator; returns its handle (auto-allocated int
         when not given). ``op``: one of {lu, chol, qr, band_lu,
         band_chol} or "auto" (PackedBand → band_*, Hermitian/Symmetric
@@ -395,6 +471,13 @@ class Session:
         stays mesh-resident (module docstring). An operand that
         already carries a multi-device grid is served mesh-native
         without any mesh argument.
+
+        ``tenant`` (round 15): who this operator belongs to — every
+        counter class the attribution ledger accounts (flops, bytes,
+        seconds, residency byte-seconds, outcomes) and the operator's
+        handle heat attribute here. ``None`` (every existing caller)
+        lands on the DEFAULT_TENANT; per-request overrides ride the
+        ``tenant=`` kwarg of solve/Batcher.submit/Executor.submit.
 
         ``refine`` (round 13): a :class:`~..refine.RefinePolicy`, or
         ``True`` to resolve one from the session's policy table per
@@ -520,8 +603,10 @@ class Session:
             if handle in self._ops:
                 raise SlateError(f"Session.register: handle {handle!r} "
                                  "already registered (unregister first)")
-            self._ops[handle] = _Operator(A, op, opts or self.opts, m, n,
-                                          band, grid=grid, refine=policy)
+            self._ops[handle] = _Operator(
+                A, op, opts or self.opts, m, n, band, grid=grid,
+                refine=policy,
+                tenant=None if tenant is None else str(tenant))
         return handle
 
     @staticmethod
@@ -548,6 +633,14 @@ class Session:
             if res is not None:
                 self.metrics.inc("evictions")
                 self.metrics.inc("evicted_bytes", res.nbytes)
+                if self.attribution is not None:
+                    self._attr_evicted(handle)
+            if self.attribution is not None:
+                # the handle can never be accessed again: drop its
+                # heat/residency clocks (and gauge) so handle churn
+                # cannot leak ledger state — the cells stay (billing
+                # history)
+                self.attribution.forget_handle(handle)
             self._update_hbm_gauges()
 
     def __contains__(self, handle: Hashable) -> bool:
@@ -577,6 +670,8 @@ class Session:
             if res is not None:
                 self.metrics.inc("evictions")
                 self.metrics.inc("evicted_bytes", res.nbytes)
+                if self.attribution is not None:
+                    self._attr_evicted(handle)
             self._update_hbm_gauges()
         return res is not None
 
@@ -584,6 +679,9 @@ class Session:
         with self._lock:
             n = len(self._cache)
             nbytes = sum(r.nbytes for r in self._cache.values())
+            if self.attribution is not None:
+                for h in self._cache:
+                    self._attr_evicted(h)
             self._cache.clear()
             self._update_hbm_gauges()
         self.metrics.inc("evictions", n)
@@ -596,14 +694,27 @@ class Session:
             entry = self._ops.get(handle)
             if entry is None:
                 raise SlateError(f"Session: unknown handle {handle!r}")
+            attr = self.attribution
             res = self._cache.get(handle)
             if res is not None:
                 self._cache.move_to_end(handle)
                 self.metrics.inc("cache_hits")
+                if attr is not None:
+                    # hit: count + heat advance, and re-touch the
+                    # residency clock (accrued byte-seconds credit the
+                    # cell and the global counter with the same value)
+                    attr.access(entry.tenant, handle, True)
+                    inc = attr.touch_residency(entry.tenant, handle,
+                                               res.nbytes)
+                    if inc:
+                        self.metrics.inc("residency_byte_seconds_total",
+                                         inc)
                 if self.slo is not None:
                     self.slo.record_cache(True)
                 return res
             self.metrics.inc("cache_misses")
+            if attr is not None:
+                attr.access(entry.tenant, handle, False)
             if self.slo is not None:
                 self.slo.record_cache(False)
             # attrs built only when tracing is on: the disabled path
@@ -653,7 +764,22 @@ class Session:
             # the ledger — crediting serve.factor too would double-count
             if entry.op not in ("band_lu", "band_chol"):
                 _LEDGER.record("serve.factor", fl)
+            if attr is not None:
+                # the factor work belongs to the operator's tenant;
+                # same grid-snapped value as the counters above
+                attr.record("factor_flops", entry.tenant, handle, fl)
             self._cache[handle] = res
+            if attr is not None:
+                # open the residency interval: byte-seconds accrue
+                # from this insert until eviction/unregister. A
+                # factor-on-miss implies no interval is open (inc=0),
+                # but crediting the return keeps the seam conserving
+                # by construction like every other residency seam
+                inc = attr.touch_residency(entry.tenant, handle,
+                                           res.nbytes)
+                if inc:
+                    self.metrics.inc("residency_byte_seconds_total",
+                                     inc)
             self._evict_to_budget(keep=handle)
             return res
 
@@ -739,7 +865,8 @@ class Session:
             if exe is not None:
                 self._compiled.move_to_end(key)
                 payload, info = exe(A)
-                self._credit_program(key, "serve.factor")
+                self._credit_program(key, "serve.factor",
+                                     tenant=entry.tenant, handle=handle)
             else:
                 payload, info = self._factor_fn(entry)(A)
         payload = jax.block_until_ready(payload)
@@ -748,7 +875,9 @@ class Session:
                          _tree_nbytes(payload))
 
     def _credit_program(self, key: Hashable, op: str,
-                        waste_fraction: float = 0.0):
+                        waste_fraction: float = 0.0,
+                        tenant: Optional[str] = None,
+                        handle: Optional[Hashable] = None):
         """One execution of an analyzed AOT program: credit the process
         BYTES ledger (bytes-accessed + modeled collective traffic) and
         the session counters — the per-execution discipline the flop
@@ -777,17 +906,29 @@ class Session:
                 self.metrics.inc("padding_waste_bytes", ba * wf)
         else:
             _costs.BYTES.record_costs(op, pc)
+        # the session counters (and round-15 attribution cells) take
+        # the GRID-SNAPPED program bytes — XLA byte counts are whole
+        # numbers anyway, and the snap is what makes the per-tenant
+        # conservation sums exact (obs/attribution.py); the process
+        # BYTES ledger above keeps the raw analysis values
+        attr = self.attribution
         if pc.bytes_accessed:
-            self.metrics.inc("bytes_accessed_total", pc.bytes_accessed)
+            ba = _fl_grid(pc.bytes_accessed)
+            self.metrics.inc("bytes_accessed_total", ba)
+            if attr is not None and handle is not None:
+                attr.record("bytes", tenant, handle, ba)
         if pc.collective_bytes:
-            self.metrics.inc("collective_bytes_total", pc.collective_bytes)
+            cb = _fl_grid(pc.collective_bytes)
+            self.metrics.inc("collective_bytes_total", cb)
+            if attr is not None and handle is not None:
+                attr.record("ici_bytes", tenant, handle, cb)
             # per-verb ICI split (round 11): a capacity planner needs
             # the steady-state (solve) traffic separate from the
             # amortized factor traffic — both move per EXECUTION
             self.metrics.inc(
                 ("solve_collective_bytes_total" if op == "serve.solve"
                  else "factor_collective_bytes_total"),
-                pc.collective_bytes)
+                cb)
 
     def _jit_cached(self, jkey: Hashable, make):
         """LRU-jit-cache shared by the solve and factor programs. A
@@ -899,6 +1040,8 @@ class Session:
             used -= nbytes
             self.metrics.inc("evictions")
             self.metrics.inc("evicted_bytes", nbytes)
+            if self.attribution is not None:
+                self._attr_evicted(h)
         if used > budget:
             # the kept factor (+ program transient) alone exceeds the
             # budget; serving must continue, but this is OOM risk —
@@ -938,7 +1081,8 @@ class Session:
         return attrs
 
     def solve_matrix(self, handle: Hashable, B: TiledMatrix,
-                     served_cols: Optional[int] = None) -> TiledMatrix:
+                     served_cols: Optional[int] = None,
+                     tenant: Optional[str] = None) -> TiledMatrix:
         """Solve with the resident factor; B is a TiledMatrix (dense
         ops) or a padded dense array (band ops). Returns the TiledMatrix
         (or array) solution. Raises on factorization failure (info>0).
@@ -958,12 +1102,19 @@ class Session:
                 raise SlateError(
                     "Session.solve_matrix: small-problem operators take "
                     "plain arrays — use Session.solve")
+            # the request's tenant (round 15): explicit override ->
+            # operator tenant -> default; resolved only when someone
+            # consumes it (the attr/slo disabled path allocates nothing)
+            attr = self.attribution
+            rt = (self.request_tenant(handle, tenant)
+                  if (attr is not None or self.slo is not None) else None)
             hit = handle in self._cache  # before factor() counts it
             res = self.factor(handle)
             if res.info != 0:
                 if self.slo is not None:
                     self.slo.record_request(entry.op, entry.n, 0.0,
-                                            ok=False, source="solve")
+                                            ok=False, source="solve",
+                                            tenant=rt)
                 raise SlateError(
                     f"Session: operator {handle!r} factorization failed "
                     f"(info={res.info})")
@@ -983,7 +1134,8 @@ class Session:
                 t0 = time.perf_counter()
                 with tr.span("serve.dispatch"):
                     X = self._dispatch(entry, res, B, handle,
-                                       served_cols=served_cols)
+                                       served_cols=served_cols,
+                                       tenant=rt)
                 t1 = time.perf_counter()
                 with tr.span("serve.block"):
                     X = jax.block_until_ready(X)
@@ -992,6 +1144,12 @@ class Session:
             self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
             self.metrics.observe("stage_device_execute", t2 - t1,
                                  exemplar=ex)
+            if attr is not None:
+                # device-execute seconds on the dyadic grid — the same
+                # snapped value lands in the cell and the global
+                ds = _s_grid(t2 - t1)
+                self.metrics.inc("device_seconds_total", ds)
+                attr.record("device_seconds", rt, handle, ds)
             self.metrics.inc("solves_total", served)
             self.metrics.inc("dispatches_total")
             # padding-waste split (round 12): the Batcher's pow2 width
@@ -1010,6 +1168,8 @@ class Session:
             # verbs inside the compiled solve program only run at trace
             # time and deliberately credit nothing — obs.driver)
             _LEDGER.record("serve.solve", fl)
+            if attr is not None:
+                attr.record("solve_flops", rt, handle, fl)
             if waste_fl:
                 self.metrics.inc("padding_waste_flops", waste_fl)
                 self.metrics.set_gauge("width_bucket_efficiency",
@@ -1017,16 +1177,20 @@ class Session:
                 _LEDGER.record("padding.waste", waste_fl)
             if self.slo is not None:
                 self.slo.record_request(entry.op, entry.n, ph.elapsed,
-                                        ok=True, source="solve")
+                                        ok=True, source="solve",
+                                        tenant=rt)
             return X
 
     def solve(self, handle: Hashable, b,
-              served_cols: Optional[int] = None) -> np.ndarray:
+              served_cols: Optional[int] = None,
+              tenant: Optional[str] = None) -> np.ndarray:
         """Array-in/array-out solve (the serving entry point): ``b`` is
         a host/device array of shape (rows,) or (rows, k); returns the
         solution with the matching rank (QR operators return n-row
         least-squares solutions for m-row right-hand sides).
-        ``served_cols``: see solve_matrix (Batcher width padding)."""
+        ``served_cols``: see solve_matrix (Batcher width padding).
+        ``tenant``: per-request attribution override (round 15) —
+        default is the operator's registered tenant."""
         with self._lock:
             entry = self._ops.get(handle)
             if entry is None:
@@ -1035,15 +1199,18 @@ class Session:
             vector = b.ndim == 1
             b2 = b[:, None] if vector else b
             if entry.op in SMALL_OPS:
-                x = self._solve_small(handle, entry, b2)
+                x = self._solve_small(handle, entry, b2, tenant=tenant)
                 return x[:, 0] if vector else x
             B = self._wrap_rhs(entry, b2)
-            # forward served_cols only when set: solve_matrix keeps
-            # its bare (handle, B) call shape on the common path
+            # forward served_cols/tenant only when set: solve_matrix
+            # keeps its bare (handle, B) call shape on the common path
             # (test doubles and subclasses depend on it)
-            X = (self.solve_matrix(handle, B)
-                 if served_cols is None else
-                 self.solve_matrix(handle, B, served_cols=served_cols))
+            kw = {}
+            if served_cols is not None:
+                kw["served_cols"] = served_cols
+            if tenant is not None:
+                kw["tenant"] = tenant
+            X = self.solve_matrix(handle, B, **kw)
             x = (X.to_numpy() if isinstance(X, TiledMatrix)
                  else np.asarray(X)[: entry.n])
             return x[:, 0] if vector else x
@@ -1078,17 +1245,22 @@ class Session:
         return (entry.op, entry.n, str(np.dtype(entry.A.dtype)))
 
     def _solve_small(self, handle: Hashable, entry: _Operator,
-                     b2: np.ndarray) -> np.ndarray:
+                     b2: np.ndarray,
+                     tenant: Optional[str] = None) -> np.ndarray:
         """Caller holds the lock. Per-request arm: the B=1 run of the
         same batched kernels the grouped dispatch uses (the bit-identity
         reference for the Batcher's batched path)."""
         from ..linalg import batched as _batched
+        attr = self.attribution
+        rt = (self.request_tenant(handle, tenant)
+              if (attr is not None or self.slo is not None) else None)
         hit = handle in self._cache
         res = self.factor(handle)
         if res.info != 0:
             if self.slo is not None:
                 self.slo.record_request(entry.op, entry.n, 0.0,
-                                        ok=False, source="solve")
+                                        ok=False, source="solve",
+                                        tenant=rt)
             raise SlateError(
                 f"Session: operator {handle!r} factorization failed "
                 f"(info={res.info})")
@@ -1101,7 +1273,8 @@ class Session:
             # SAME bucket programs the grouped mixed dispatch runs at
             # B=bucket; non-convergence falls back to the plain path
             # below via a working-precision refactor (counted)
-            x = self._solve_small_refined(handle, entry, res, b2)
+            x = self._solve_small_refined(handle, entry, res, b2,
+                                          tenant=rt)
             if x is not None:
                 return x
             res = self.factor(handle)  # working-precision refactor
@@ -1136,13 +1309,19 @@ class Session:
         self.metrics.inc("flops_total", fl)
         self.metrics.inc("solve_flops_total", fl)
         _LEDGER.record("serve.solve", fl)
+        if attr is not None:
+            attr.record("solve_flops", rt, handle, fl)
+            ds = _s_grid(t2 - t1)
+            self.metrics.inc("device_seconds_total", ds)
+            attr.record("device_seconds", rt, handle, ds)
         if self.slo is not None:
             self.slo.record_request(entry.op, entry.n, ph.elapsed,
-                                    ok=True, source="solve")
+                                    ok=True, source="solve", tenant=rt)
         return np.asarray(x[0])
 
     def _solve_small_refined(self, handle: Hashable, entry: _Operator,
-                             res: _Resident, b2: np.ndarray
+                             res: _Resident, b2: np.ndarray,
+                             tenant: Optional[str] = None
                              ) -> Optional[np.ndarray]:
         """Caller holds the lock. One refined B=1 solve from the
         resident LOW-precision factor. Returns the solution, or None
@@ -1173,6 +1352,7 @@ class Session:
             with tr.span("serve.block"):
                 x, its, conv = jax.block_until_ready((x, its, conv))
             t2 = time.perf_counter()
+        attr = self.attribution
         iters = int(np.asarray(its)[0])
         self.metrics.observe("refine_iterations", float(iters))
         extra = iters * (_flops_mod.gemm(entry.n, k, entry.n)
@@ -1181,6 +1361,8 @@ class Session:
         self.metrics.inc("refine_flops_total", extra)
         self.metrics.inc("flops_total", extra)
         _LEDGER.record("serve.refine", extra)
+        if attr is not None:
+            attr.record("refine_flops", tenant, handle, extra)
         if not bool(np.asarray(conv)[0]):
             self.metrics.inc("refine_fallbacks_total")
             _obs_log.warning(
@@ -1197,6 +1379,8 @@ class Session:
             if dropped is not None:
                 self.metrics.inc("evictions")
                 self.metrics.inc("evicted_bytes", dropped.nbytes)
+                if self.attribution is not None:
+                    self._attr_evicted(handle)
             return None
         self.metrics.inc("refine_converged_total")
         ex = getattr(ph.span, "trace_id", None)
@@ -1209,12 +1393,19 @@ class Session:
         self.metrics.inc("flops_total", fl)
         self.metrics.inc("solve_flops_total", fl)
         _LEDGER.record("serve.solve", fl)
+        if attr is not None:
+            attr.record("solve_flops", tenant, handle, fl)
+            ds = _s_grid(t2 - t1)
+            self.metrics.inc("device_seconds_total", ds)
+            attr.record("device_seconds", tenant, handle, ds)
         if self.slo is not None:
             self.slo.record_request(entry.op, entry.n, ph.elapsed,
-                                    ok=True, source="solve")
+                                    ok=True, source="solve",
+                                    tenant=tenant)
         return np.asarray(x[0])
 
-    def solve_small_batched(self, handles: List[Hashable], bs: List
+    def solve_small_batched(self, handles: List[Hashable], bs: List,
+                            tenants: Optional[List] = None
                             ) -> Tuple[np.ndarray, List[int]]:
         """ONE batched pass for a shape bucket of DISTINCT-operator
         small requests (the Batcher's grouped dispatch). Cache-miss
@@ -1238,6 +1429,9 @@ class Session:
         if not handles or len(handles) != len(bs):
             raise SlateError("solve_small_batched: handles and bs must "
                              "be equal-length and nonempty")
+        if tenants is not None and len(tenants) != len(handles):
+            raise SlateError("solve_small_batched: tenants must match "
+                             "handles in length")
         with self._lock:
             entries = []
             for h in handles:
@@ -1262,8 +1456,19 @@ class Session:
                 # between enqueue (lock-free grouping) and dispatch —
                 # rare race; serve the bucket per-request, correctness
                 # over coalescing
-                return self._serve_small_per_request(handles, bs)
+                return self._serve_small_per_request(handles, bs,
+                                                     tenants=tenants)
             bsz = len(handles)
+            # round 15: per-item request tenants (override -> operator
+            # tenant -> default), resolved once — the grouped dispatch
+            # must produce the SAME tenant-labeled tallies B
+            # per-request solves would (the satellite-1 parity pin)
+            attr = self.attribution
+            rts = None
+            if attr is not None or self.slo is not None:
+                rts = [self.request_tenant(
+                    h, None if tenants is None else tenants[i])
+                    for i, h in enumerate(handles)]
             tr = self.tracer
             battrs = ({"op": op, "n": n, "batch": bsz, "dtype": str(dt)}
                       if tr.enabled else {})
@@ -1322,16 +1527,32 @@ class Session:
                         # per-request parity contract: a recoverable
                         # lo-factor failure must not fail futures or
                         # poison the cache)
-                        return self._serve_small_per_request(handles, bs)
+                        return self._serve_small_per_request(
+                            handles, bs, tenants=tenants)
                     ffl = _factor_flops(op, n, n, 0)
                     for h, payload, inf in zip(miss_handles, payloads,
                                                infos):
-                        self._cache[h] = _Resident(
-                            payload, int(inf), _tree_nbytes(payload))
+                        res_h = _Resident(payload, int(inf),
+                                          _tree_nbytes(payload))
+                        self._cache[h] = res_h
                         self.metrics.inc("factors_total")
                         self.metrics.inc("flops_total", ffl)
                         self.metrics.inc("factor_flops_total", ffl)
                         _LEDGER.record("serve.factor", ffl)
+                        if attr is not None:
+                            # factor work belongs to the operator's
+                            # tenant (the per-request path's factor()
+                            # convention — tenant-labeled parity);
+                            # the accrual return conserves the seam
+                            # by construction (0 on a true miss)
+                            ot = self._ops[h].tenant
+                            attr.record("factor_flops", ot, h, ffl)
+                            inc = attr.touch_residency(ot, h,
+                                                       res_h.nbytes)
+                            if inc:
+                                self.metrics.inc(
+                                    "residency_byte_seconds_total",
+                                    inc)
                         self._evict_to_budget(keep=h)
                     programs += 1
                 # per-request residents, in request order (the budget
@@ -1347,12 +1568,29 @@ class Session:
                 for h in handles:
                     if was_resident[h] or h in counted_miss:
                         self.metrics.inc("cache_hits")
+                        if attr is not None:
+                            # same tenant-labeled hit tally (and heat
+                            # advance / residency touch) B per-request
+                            # solves would record — 1 miss + B−1 hits
+                            # per cold duplicate handle, pinned
+                            ot = self._ops[h].tenant
+                            attr.access(ot, h, True)
+                            res_t = self._cache.get(h)
+                            if res_t is not None:
+                                inc = attr.touch_residency(
+                                    ot, h, res_t.nbytes)
+                                if inc:
+                                    self.metrics.inc(
+                                        "residency_byte_seconds_total",
+                                        inc)
                         if self.slo is not None:
                             self.slo.record_cache(True)
                         if h in self._cache:
                             self._cache.move_to_end(h)
                     else:
                         self.metrics.inc("cache_misses")
+                        if attr is not None:
+                            attr.access(self._ops[h].tenant, h, False)
                         if self.slo is not None:
                             self.slo.record_cache(False)
                         counted_miss.add(h)
@@ -1415,12 +1653,24 @@ class Session:
                         self.metrics.observe("refine_iterations",
                                              float(its[i]))
                     kk = bstack.shape[2] if bstack.ndim == 3 else 1
-                    extra = float(its.sum()) * (
-                        _flops_mod.gemm(n, kk, n)
-                        + _solve_flops(op, n, n, kk, 0))
+                    # per-item refinement flops (iters_i × one step's
+                    # residual gemm + factor apply, integer grid), so
+                    # the global credit below is EXACTLY the sum of
+                    # the tenant-attributed per-item values — the
+                    # mixed-lane arm of the satellite-1 parity pin
+                    per_step = (_flops_mod.gemm(n, kk, n)
+                                + _solve_flops(op, n, n, kk, 0))
+                    extra_i = [float(int(its[i])) * per_step
+                               for i in range(bsz)]
+                    extra = float(sum(extra_i))
                     self.metrics.inc("refine_flops_total", extra)
                     self.metrics.inc("flops_total", extra)
                     _LEDGER.record("serve.refine", extra)
+                    if attr is not None:
+                        for i in range(bsz):
+                            if extra_i[i]:
+                                attr.record("refine_flops", rts[i],
+                                            handles[i], extra_i[i])
                     self.metrics.inc(
                         "refine_converged_total",
                         int(conv.sum()))
@@ -1450,6 +1700,8 @@ class Session:
                                 self.metrics.inc("evictions")
                                 self.metrics.inc("evicted_bytes",
                                                  dropped.nbytes)
+                                if self.attribution is not None:
+                                    self._attr_evicted(h)
                         res_i = self.factor(h)
                         infos_req[i] = res_i.info
                         if res_i.info != 0:
@@ -1472,10 +1724,30 @@ class Session:
             self.metrics.inc("dispatches_total")
             self.metrics.inc("batched_programs", programs)
             self.metrics.observe("bucket_occupancy", bsz / bucket)
-            sfl = bsz * _solve_flops(op, n, n, k, 0)
+            per_sfl = _solve_flops(op, n, n, k, 0)
+            sfl = bsz * per_sfl
             self.metrics.inc("flops_total", sfl)
             self.metrics.inc("solve_flops_total", sfl)
             _LEDGER.record("serve.solve", sfl)
+            if attr is not None:
+                # per-item solve flops (global sfl = bsz × per_sfl is
+                # exactly their sum on the integer grid) and the
+                # batch's device-execute seconds split across items in
+                # 2^-20 s grid units — integer division, remainder to
+                # the first item, so the per-tenant shares sum
+                # BIT-EXACTLY to the global credit
+                units = round((t2 - t1) * float(1 << 20))
+                share, rem = divmod(int(units), bsz)
+                self.metrics.inc("device_seconds_total",
+                                 units / float(1 << 20))
+                for i in range(bsz):
+                    attr.record("solve_flops", rts[i], handles[i],
+                                per_sfl)
+                    ds_i = (share + (rem if i == 0 else 0)) \
+                        / float(1 << 20)
+                    if ds_i:
+                        attr.record("device_seconds", rts[i],
+                                    handles[i], ds_i)
             # padding-waste counters (round 12): the pow2 batch bucket
             # executes bucket − bsz REAL padded lanes (identity
             # operands, zero rhs) in the solve program — and the miss
@@ -1492,13 +1764,17 @@ class Session:
                 self.metrics.inc("padding_waste_flops", waste_fl)
             self.metrics.set_gauge("batch_bucket_efficiency", bsz / bucket)
             if self.slo is not None:
-                for inf in infos_req:
+                for i, inf in enumerate(infos_req):
                     self.slo.record_request(op, n, ph.elapsed,
-                                            ok=(inf == 0), source="solve")
+                                            ok=(inf == 0), source="solve",
+                                            tenant=(None if rts is None
+                                                    else rts[i]))
             return np.asarray(x), infos_req
 
     def _serve_small_per_request(self, handles: List[Hashable],
-                                 bs: List) -> Tuple[np.ndarray, List[int]]:
+                                 bs: List,
+                                 tenants: Optional[List] = None
+                                 ) -> Tuple[np.ndarray, List[int]]:
         """Caller holds the lock. Degraded grouped dispatch: each
         request through the per-request path — correctness over
         coalescing, used when the one-program pass is unsafe (a
@@ -1508,14 +1784,16 @@ class Session:
         isolation: an item whose own solve fails carries its nonzero
         info; neighbors are served normally."""
         xs, infos = [], []
-        for h, b in zip(handles, bs):
+        for i, (h, b) in enumerate(zip(handles, bs)):
             e = self._ops[h]
             b2 = np.ascontiguousarray(np.asarray(b),
                                       dtype=np.dtype(e.A.dtype))
             if b2.ndim == 1:
                 b2 = b2[:, None]
             try:
-                xs.append(self._solve_small(h, e, b2))
+                xs.append(self._solve_small(
+                    h, e, b2,
+                    tenant=None if tenants is None else tenants[i]))
                 infos.append(0)
             except SlateError:
                 res = self._cache.get(h)
@@ -1538,7 +1816,8 @@ class Session:
 
     def _dispatch(self, entry: _Operator, res: _Resident, B,
                   handle: Hashable = None,
-                  served_cols: Optional[int] = None):
+                  served_cols: Optional[int] = None,
+                  tenant: Optional[str] = None):
         """Run the solve through a per-(op, opts) jitted function,
         preferring an AOT-compiled executable from warmup() when shapes
         match. opts is part of both cache keys: two operators of the
@@ -1552,7 +1831,8 @@ class Session:
         analyzed program and credits its collective census."""
         if entry.refine is not None:
             return self._dispatch_refined(entry, res, B, handle,
-                                          served_cols=served_cols)
+                                          served_cols=served_cols,
+                                          tenant=tenant)
         fn = self._solve_fn(entry)
         key = self._aot_key(entry, res.payload, B)
         exe = self._compiled.get(key)
@@ -1566,7 +1846,8 @@ class Session:
             k = int(B.shape[1]) if getattr(B, "shape", None) else 0
             wf = (0.0 if served_cols is None or not k
                   else (k - served_cols) / k)
-            self._credit_program(key, "serve.solve", waste_fraction=wf)
+            self._credit_program(key, "serve.solve", waste_fraction=wf,
+                                 tenant=tenant, handle=handle)
             return exe(res.payload, B)
         return fn(res.payload, B)
 
@@ -1607,7 +1888,8 @@ class Session:
 
     def _dispatch_refined(self, entry: _Operator, res: _Resident, B,
                           handle: Hashable = None,
-                          served_cols: Optional[int] = None):
+                          served_cols: Optional[int] = None,
+                          tenant: Optional[str] = None):
         """Serve one solve from the LOW-precision resident: initial lo
         solve + the refine engine's convergence loop over analyzed
         start/step programs (classic IR) or the GMRES-IR cycle. Emits
@@ -1641,7 +1923,8 @@ class Session:
                 with tr.span("refine.start"):
                     X0 = start_exe(payload, B_)
                 self._credit_program(start_key, "serve.solve",
-                                     waste_fraction=wf)
+                                     waste_fraction=wf,
+                                     tenant=tenant, handle=handle)
                 return X0
 
             def step_call(payload, A_, B_, X_):
@@ -1653,7 +1936,8 @@ class Session:
                 with tr.span("refine.step"):
                     out = exe(payload, A_, B_, X_)
                 self._credit_program(state["key"], "serve.refine",
-                                     waste_fraction=wf)
+                                     waste_fraction=wf,
+                                     tenant=tenant, handle=handle)
                 return out
 
             X, iters, converged = _refine_engine.drive(
@@ -1672,6 +1956,9 @@ class Session:
         self.metrics.inc("refine_flops_total", extra)
         self.metrics.inc("flops_total", extra)
         _LEDGER.record("serve.refine", extra)
+        if self.attribution is not None and extra:
+            self.attribution.record("refine_flops", tenant, handle,
+                                    extra)
         if converged:
             self.metrics.inc("refine_converged_total")
             return X
@@ -1695,13 +1982,15 @@ class Session:
         if dropped is not None:
             self.metrics.inc("evictions")
             self.metrics.inc("evicted_bytes", dropped.nbytes)
+            if self.attribution is not None:
+                self._attr_evicted(handle)
         res2 = self.factor(handle)
         if res2.info != 0:
             raise SlateError(
                 f"Session: operator {handle!r} working-precision "
                 f"fallback factorization failed (info={res2.info})")
         return self._dispatch(entry, res2, B, handle,
-                              served_cols=served_cols)
+                              served_cols=served_cols, tenant=tenant)
 
     @staticmethod
     def _aot_key(entry: _Operator, payload, B) -> Hashable:
@@ -1855,23 +2144,110 @@ class Session:
         self._update_hbm_gauges()
         return exe
 
+    # -- placement snapshot (round 15: the fleet-fold placement input) -----
+
+    def placement_snapshot(self, host: Optional[str] = None) -> dict:
+        """One schema-validated row per RESIDENT factor — {host,
+        tenant, handle, op, n, dtype, bytes_per_chip, heat,
+        last_access} — the per-process half of the fleet placement
+        input (``obs.aggregate.merge_placement_snapshots`` folds N of
+        these into the row set ROADMAP item 1's cache tier and quota
+        scheduler consume). ``bytes_per_chip`` is the resident's
+        PER-CHIP budget charge (max-per-shard for mesh residents — the
+        round-11 convention); heat/last_access come from the
+        attribution ledger (0.0/null without one). The producer
+        validates its own output against the committed schema
+        (obs.attribution.validate_placement_snapshot) so a drifted row
+        shape fails HERE, not in a consumer three hops away."""
+        if host is None:
+            import os as _os
+            import socket as _socket
+            host = f"{_socket.gethostname()}:{_os.getpid()}"
+        attr = self.attribution
+        # LOCK-FREE on purpose (the op_meta/small_group_key
+        # discipline): the session lock is held across whole device
+        # executions, and a /tenants scrape must not stall behind an
+        # in-flight solve. list(dict.items()) is one GIL-atomic C
+        # call, _Resident/_Operator fields are immutable after
+        # insert, and a raced unregister just skips its row — a
+        # scrape reads the cache as of one instant, which is all a
+        # snapshot ever promises.
+        if attr is not None:
+            # bring residency byte-seconds current so the snapshot
+            # and the counters describe the same instant (the ledger
+            # has its own lock)
+            inc = attr.accrue_residency()
+            if inc:
+                self.metrics.inc("residency_byte_seconds_total", inc)
+        heat_rows = attr.heat_rows() if attr is not None else {}
+        rows = []
+        for h, res in list(self._cache.items()):
+            entry = self._ops.get(h)
+            if entry is None:
+                continue  # unregister raced the scrape
+            A = entry.A
+            dtype = (A.ab.dtype if isinstance(A, PackedBand)
+                     else A.dtype)
+            hr = repr(h)
+            heat, last = heat_rows.get(hr, (0.0, None))
+            rows.append({
+                "host": host,
+                "tenant": self.request_tenant(h),
+                "handle": hr,
+                "op": entry.op,
+                "n": int(entry.n),
+                "dtype": str(dtype),
+                "bytes_per_chip": int(res.nbytes),
+                "heat": heat,
+                "last_access": last,
+            })
+        doc = {
+            "schema": PLACEMENT_SCHEMA,
+            "host": host,
+            "generated_at": time.time(),
+            "rows": rows,
+        }
+        errs = validate_placement_snapshot(doc)
+        if errs:
+            raise SlateError(
+                f"Session.placement_snapshot: schema self-check failed "
+                f"({errs[:3]})")
+        return doc
+
+    def tenants_payload(self) -> dict:
+        """The ``/tenants`` route payload: the attribution ledger's
+        per-(tenant, handle) cells + tenant/global totals (residency
+        accrued to now via the placement pass) and the placement
+        snapshot. ``{"enabled": false}`` without a ledger."""
+        if self.attribution is None:
+            return {"enabled": False, "tenants": {}}
+        placement = self.placement_snapshot()  # accrues residency
+        payload = self.attribution.snapshot()
+        payload["enabled"] = True
+        payload["placement"] = placement
+        return payload
+
     # -- observability endpoint --------------------------------------------
 
     def serve_obs(self, host: str = "127.0.0.1", port: int = 0):
         """Opt-in observability HTTP endpoint for THIS session
-        (stdlib-only): /metrics (Prometheus text), /healthz,
+        (stdlib-only): /metrics (Prometheus text, plus the tenant_*
+        sections once ``enable_attribution`` ran), /healthz,
         /trace.json (Chrome trace of the session's tracer), /slo
-        (burn-rate payload once ``enable_slo`` ran — the provider is a
-        getter, so enabling AFTER serve_obs still works). Returns
+        (burn-rate payload once ``enable_slo`` ran), /tenants (the
+        attribution + placement payload) — every provider is a
+        getter, so enabling AFTER serve_obs still works. Returns
         the ObsServer (``.url()`` gives the scrape target); idempotent
         — a second call returns the running server."""
         with self._lock:
             if self._obs_server is None:
                 from ..obs.exposition import ObsServer
-                self._obs_server = ObsServer(self.metrics,
-                                             tracer=self.tracer,
-                                             host=host, port=port,
-                                             slo=lambda: self.slo)
+                self._obs_server = ObsServer(
+                    self.metrics, tracer=self.tracer,
+                    host=host, port=port,
+                    slo=lambda: self.slo,
+                    tenants=lambda: self.tenants_payload(),
+                    attribution=lambda: self.attribution)
             return self._obs_server
 
     def close_obs(self):

@@ -75,7 +75,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "n": 192, "nb": 64, "requests": 48, "max_batch": 16,
         "serve": {"solves_per_sec": 120.0},
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
-        "cost_log": [], "hbm": {}, "slo": {}}
+        "cost_log": [], "hbm": {}, "slo": {},
+        "tenants": _tenants_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -129,6 +130,78 @@ def test_normalize_legacy_multichip_blob(tmp_path):
         "tail": "Traceback ..."})
     (rec,) = gate_mod.normalize_all(str(tmp_path / "MULTICHIP_r01.json"))
     assert rec["ok"] is False and rec["metrics"] == {}
+
+
+def _tenants_section(conservation_ok=True, rows=None):
+    """A minimal round-15 serve-artifact tenants section that passes
+    gate_mod._check_tenants_section."""
+    if rows is None:
+        rows = [{
+            "host": "bench", "tenant": "bench-a", "handle": "1",
+            "op": "chol", "n": 192, "dtype": "float32",
+            "bytes_per_chip": 147456, "heat": 2.5,
+            "last_access": 1700000000.0}]
+    return {
+        "enabled": True, "halflife_s": 300.0,
+        "per_tenant": {"bench-a": {"solve_flops": 1.0}},
+        "conservation": {"solve_flops": {
+            "per_tenant_sum": 1.0, "global": 1.0,
+            "ok": conservation_ok}},
+        "conservation_ok": conservation_ok,
+        "placement": {"schema": gate_mod.PLACEMENT_SCHEMA,
+                      "host": "bench", "rows": rows},
+    }
+
+
+def test_serve_tenants_section_schema(tmp_path):
+    """Round 15: --check-schema holds the serve artifact's tenants
+    section to the placement row schema — a row missing a key, or a
+    placement block with the wrong schema id, fails loudly (the
+    stale-fixture class)."""
+    base = {
+        "bench": "serve", "backend": "cpu", "dtype": "float32",
+        "n": 192, "nb": 64, "requests": 48, "max_batch": 16,
+        "serve": {"solves_per_sec": 120.0},
+        "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
+        "cost_log": [], "hbm": {}, "slo": {}}
+    # a placement row lacking "heat" fails
+    bad_row = _tenants_section()
+    del bad_row["placement"]["rows"][0]["heat"]
+    _write(tmp_path, "BENCH_SERVE_badrow.json",
+           dict(base, tenants=bad_row))
+    with pytest.raises(gate_mod.SchemaError, match="heat"):
+        gate_mod.normalize(str(tmp_path / "BENCH_SERVE_badrow.json"))
+    # a wrong placement schema id fails
+    bad_schema = _tenants_section()
+    bad_schema["placement"]["schema"] = "nope.v0"
+    _write(tmp_path, "BENCH_SERVE_badschema.json",
+           dict(base, tenants=bad_schema))
+    with pytest.raises(gate_mod.SchemaError, match="placement schema"):
+        gate_mod.normalize(str(tmp_path / "BENCH_SERVE_badschema.json"))
+    # a tenants section without the conservation verdict fails
+    no_cons = _tenants_section()
+    del no_cons["conservation"]
+    _write(tmp_path, "BENCH_SERVE_nocons.json",
+           dict(base, tenants=no_cons))
+    with pytest.raises(gate_mod.SchemaError, match="conservation"):
+        gate_mod.normalize(str(tmp_path / "BENCH_SERVE_nocons.json"))
+    # the well-formed section parses
+    _write(tmp_path, "BENCH_SERVE_ok.json",
+           dict(base, tenants=_tenants_section()))
+    rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_ok.json"))
+    assert rec["kind"] == "serve"
+
+
+def test_placement_row_keys_mirror_pinned():
+    """The jax-free mirror discipline (bench_gate stays standalone,
+    the baseline-validator precedent): bench_gate's placement row
+    keys and schema id must equal the obs.attribution originals —
+    the tenants-section check is only as strong as this equality.
+    (The SERVE_ARTIFACT_SECTIONS twin pin lives in test_faults.py.)"""
+    from slate_tpu.obs import attribution as attr_mod
+    assert tuple(gate_mod.PLACEMENT_ROW_KEYS) == \
+        tuple(attr_mod.PLACEMENT_ROW_KEYS)
+    assert gate_mod.PLACEMENT_SCHEMA == attr_mod.PLACEMENT_SCHEMA
 
 
 def _multichip_artifact(solves=300.0, speedup=0.1):

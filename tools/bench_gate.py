@@ -98,7 +98,16 @@ GATED_PLATFORMS = ("tpu", "axon")
 # (schema grew a section, fixture silently didn't).
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
-    "serve", "per_request", "speedup", "cost_log", "hbm", "slo")
+    "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
+    "tenants")
+# mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
+# (same jax-free duplication discipline as the sections tuple above
+# and the baseline validators; tests pin the mirrors equal): the
+# round-15 placement-snapshot row shape --check-schema holds the
+# committed serve fixture's tenants section to
+PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v1"
+PLACEMENT_ROW_KEYS = ("host", "tenant", "handle", "op", "n", "dtype",
+                      "bytes_per_chip", "heat", "last_access")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -320,6 +329,44 @@ def _normalize_multichip(name: str, obj: dict,
     return out
 
 
+def _check_tenants_section(name: str, section) -> None:
+    """Validate the round-15 serve-artifact ``tenants`` section:
+    per-tenant totals, the conservation verdict, and the embedded
+    placement snapshot against the committed row schema (the jax-free
+    mirror of obs.attribution.validate_placement_snapshot)."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: tenants section is not an object")
+    for k in ("enabled", "per_tenant", "conservation",
+              "conservation_ok", "placement"):
+        if k not in section:
+            raise SchemaError(f"{name}: tenants section missing {k!r}")
+    if not isinstance(section["per_tenant"], dict):
+        raise SchemaError(f"{name}: tenants.per_tenant not an object")
+    cons = section["conservation"]
+    if not isinstance(cons, dict) or not cons:
+        raise SchemaError(f"{name}: tenants.conservation missing/empty")
+    for cls, row in cons.items():
+        if not isinstance(row, dict) or "ok" not in row:
+            raise SchemaError(
+                f"{name}: tenants.conservation[{cls!r}] missing 'ok'")
+    placement = section["placement"]
+    if not isinstance(placement, dict) \
+            or placement.get("schema") != PLACEMENT_SCHEMA:
+        raise SchemaError(
+            f"{name}: tenants.placement schema != {PLACEMENT_SCHEMA!r}")
+    rows = placement.get("rows")
+    if not isinstance(rows, list):
+        raise SchemaError(f"{name}: tenants.placement.rows not a list")
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            raise SchemaError(
+                f"{name}: tenants.placement.rows[{i}] not an object")
+        for k in PLACEMENT_ROW_KEYS:
+            if k not in row:
+                raise SchemaError(
+                    f"{name}: tenants.placement.rows[{i}] missing {k!r}")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -347,6 +394,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
                     f"{name}: serve artifact missing section {k!r} "
                     "(stale smoke fixture? regenerate with "
                     "bench_serve.py --regen-smoke)")
+        _check_tenants_section(name, obj["tenants"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),

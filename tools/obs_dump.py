@@ -101,7 +101,12 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     # workload below feeds the request/cache/oom streams the /slo
     # payload evaluates
     sess.enable_slo()
-    h = sess.register(A, op="chol")
+    # round 15: tenant attribution on BEFORE any traffic (the
+    # conservation check below compares per-tenant sums against the
+    # session-lifetime global counters, so every credited event must
+    # be attributed)
+    sess.enable_attribution()
+    h = sess.register(A, op="chol", tenant="tenant-a")
     srv = sess.serve_obs()  # opt-in HTTP endpoint, ephemeral port
     try:
         bs = [rng.standard_normal(n) for _ in range(requests)]
@@ -363,6 +368,71 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
                                       bytes_ledger=False):
             fails.append("prometheus text missing "
                          "refine_fallbacks_total")
+
+        # -- tenant attribution + placement (round 15) ------------------
+        # a second tenant's small operator joins the session so the
+        # ledger has ≥2 tenants; then: bit-exact conservation per
+        # counter class, a schema-valid placement snapshot, the
+        # /tenants route, tenant_* prom sections — all exit-gating
+        from slate_tpu.obs.attribution import (
+            CLASSES, validate_placement_snapshot)
+        hb = sess.register(rng.standard_normal((16, 16))
+                           + 16 * np.eye(16), op="lu_small",
+                           tenant="tenant-b")
+        for _ in range(3):
+            sess.solve(hb, rng.standard_normal(16))
+        att_snap = sess.attribution.snapshot()
+        if set(att_snap["tenants"]) < {"tenant-a", "tenant-b"}:
+            fails.append("attribution missing a registered tenant: "
+                         f"{sorted(att_snap['tenants'])}")
+        for cls, counter in CLASSES.items():
+            cells = att_snap["totals"].get(cls, 0.0)
+            glob = sess.metrics.get(counter)
+            if cells != glob:
+                fails.append(
+                    f"attribution conservation broken for {cls}: "
+                    f"per-tenant sum {cells!r} != global {glob!r}")
+        placement = sess.placement_snapshot()
+        perrs = validate_placement_snapshot(placement)
+        if perrs:
+            fails.append(f"placement snapshot schema: {perrs[:3]}")
+        if not placement["rows"]:
+            fails.append("placement snapshot has no resident rows")
+        if not any(r["heat"] > 0 for r in placement["rows"]):
+            fails.append("placement snapshot rows carry no heat")
+        with open(os.path.join(out_dir, "placement.json"), "w") as f:
+            json.dump(placement, f, indent=2, sort_keys=True)
+            f.write("\n")
+        with open(os.path.join(out_dir, "tenants.json"), "w") as f:
+            json.dump(sess.tenants_payload(), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        tprom = obs.render_prometheus(sess.metrics, ledger=False,
+                                      bytes_ledger=False,
+                                      attribution=sess.attribution)
+        for needle in ("slate_tpu_tenant_solve_flops_total",
+                       'tenant="tenant_b"', "slate_tpu_handle_heat"):
+            if needle not in tprom:
+                fails.append(f"prometheus text missing {needle}")
+        # 2-process fold of the attribution cells + placement rows:
+        # counters double bit-exactly, the folded per-tenant rows sum
+        # to the folded globals, per-host placement rows survive
+        att_fleet = obs.aggregate.merge_attribution_snapshots(
+            [att_snap := sess.attribution.snapshot(), att_snap])
+        msnap0 = sess.metrics.snapshot()
+        for cls, counter in CLASSES.items():
+            folded = att_fleet["totals"].get(cls, 0.0)
+            want = 2 * msnap0["counters"].get(counter, 0.0)
+            if folded != want:
+                fails.append(
+                    f"fleet attribution conservation broken for {cls}:"
+                    f" {folded!r} != 2x global {want!r}")
+        pl_fleet = obs.aggregate.merge_placement_snapshots(
+            [placement, dict(placement, host="other")])
+        if len(pl_fleet["rows"]) != 2 * len(placement["rows"]):
+            fails.append("fleet placement fold lost rows")
+        if "tenant-a" not in pl_fleet["per_tenant"]:
+            fails.append("fleet placement rollup missing tenant-a")
 
         # -- 2-process aggregation (tentpole d) -------------------------
         # same-snapshot fold: the acceptance's bit-exactness check —
