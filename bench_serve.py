@@ -72,7 +72,7 @@ apply_env_platforms()
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants")
+    "tenants", "numerics")
 
 
 def _tenants_section(sess):
@@ -99,6 +99,37 @@ def _tenants_section(sess):
         "conservation": conservation,
         "conservation_ok": all(c["ok"] for c in conservation.values()),
         "placement": placement,
+    }
+
+
+def _numerics_section(sess):
+    """The serve artifact's round-16 ``numerics`` section: the
+    per-handle health rows (condest / growth / sampled-residual EWMA /
+    state), the probe counters, and the exit-gated verdict — the bench
+    operand is a well-conditioned SPD matrix, so every handle must
+    classify healthy, the condest must be a finite positive estimate,
+    and the sampled probes must have fired (deterministic sampler, so
+    a zero count means the seam went dead, not bad luck)."""
+    payload = sess.numerics_payload()
+    handles = payload.get("handles", {})
+    counters = payload.get("counters", {})
+    conds = [row.get("condest") for row in handles.values()
+             if row.get("condest") is not None]
+    ok = (bool(handles)
+          and all(row["state"] == "healthy" for row in handles.values())
+          and bool(conds)
+          and all(0.0 < c < float("inf") for c in conds)
+          and counters.get("residual_probes_total", 0) > 0
+          and counters.get("condest_runs_total", 0) > 0
+          and counters.get("numerics_nonfinite_total", 0) == 0)
+    return {
+        "enabled": True,
+        "handles": handles,
+        "counts": payload.get("counts", {}),
+        "counters": counters,
+        "sample_fraction": payload.get("config", {}).get(
+            "sample_fraction"),
+        "ok": ok,
     }
 
 
@@ -145,6 +176,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # exact workload (two tenants split the request stream) plus the
     # placement snapshot and the conservation check, exit-gated below
     sess.enable_attribution()
+    # round 16: numerical-health telemetry through the bench — a high
+    # deterministic sample fraction so the smoke run exercises the
+    # probed-solve path; the artifact's "numerics" section records the
+    # per-handle health view of this exact workload, exit-gated below
+    sess.enable_numerics(sample_fraction=0.25, sample_seed=16)
     h = sess.register(A, op="chol", tenant="bench-a")
     with Executor(sess, max_batch=max_batch, max_wait=max_wait) as ex:
         ex.warmup([h])  # factor + AOT compile off the request path
@@ -201,6 +237,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # conservation check: per-tenant rows sum bit-exactly to the
         # global counters (obs/attribution.py dyadic-grid invariant)
         "tenants": _tenants_section(sess),
+        # round 16: the numerical-health view — per-handle condest/
+        # growth/residual signals and states, probe counters, and the
+        # healthy-verdict exit gate (a serving bench that cannot tell
+        # its operand is healthy cannot be trusted to flag a sick one)
+        "numerics": _numerics_section(sess),
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -956,7 +997,10 @@ def main(argv=None):
                 max_batch=args.max_batch, out_path=args.out)
     # round 15: the tenants section exit-gates too — a run whose
     # per-tenant ledger stopped summing to the globals is broken
-    ok = art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
+    # round 16: the numerics section exit-gates too — a healthy
+    # operand misclassified (or dead probe seams) is a broken monitor
+    ok = (art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
+          and art["numerics"]["ok"])
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

@@ -41,32 +41,23 @@ def _norm1est(solve: Callable, solve_h: Callable, n: int, dtype,
     y/|y| and iterates stay complex — casting to float64 would zero
     purely-imaginary solves and report a singular matrix. ``solve_h``
     must be the CONJUGATE-transpose solve (wrap a transpose solve with
-    _conj_solve), per LAPACK gecon/Higham."""
-    cplx = np.issubdtype(np.dtype(jnp.zeros((), dtype).dtype), np.complexfloating)
-    work = np.complex128 if cplx else np.float64
-    x = np.full((n, 1), 1.0 / n, dtype=work)
-    est = 0.0
-    prev_sign = np.zeros((n, 1), dtype=work)
-    for _ in range(max_iter):
-        y = np.asarray(solve(jnp.asarray(x, dtype))).astype(work)[:n]
-        est = float(np.abs(y).sum())
-        absy = np.abs(y)
-        sign = np.where(absy == 0, 1.0, y / np.where(absy == 0, 1.0, absy))
-        if (np.abs(sign - prev_sign) < 1e-12).all():
-            break
-        prev_sign = sign
-        z = np.asarray(solve_h(jnp.asarray(sign, dtype))).astype(work)[:n]
-        j = int(np.argmax(np.abs(z)))
-        if np.abs(z[j]).item() <= np.abs(np.conj(z).T @ x).item():
-            break
-        x = np.zeros((n, 1), dtype=work)
-        x[j] = 1.0
-    # alternative lower bound from a ramp vector (Higham's refinement)
-    v = np.array([(-1.0) ** i * (1.0 + i / max(n - 1, 1))
-                  for i in range(n)]).reshape(n, 1).astype(work)
-    yv = np.asarray(solve(jnp.asarray(v, dtype))).astype(work)[:n]
-    alt = 2.0 * float(np.abs(yv).sum()) / (3.0 * n)
-    return float(max(est, alt))
+    _conj_solve), per LAPACK gecon/Higham.
+
+    Round 16: the estimator LOOP itself lives in obs/numerics.py
+    (:func:`~..obs.numerics.norm1est`) — one Hager/Higham
+    implementation shared with the serving Session's resident-factor
+    condest; this adapter only casts host vectors into the driver
+    dtype."""
+    from ..obs import numerics as _num
+    cplx = np.issubdtype(np.dtype(jnp.zeros((), dtype).dtype),
+                         np.complexfloating)
+
+    def wrap(f: Callable) -> Callable:
+        return lambda x: np.asarray(f(jnp.asarray(x, dtype)))
+
+    est, _solves = _num.norm1est(wrap(solve), wrap(solve_h), n,
+                                 complex_=cplx, max_iter=max_iter)
+    return est
 
 
 def _rhs(n: int, nb: int, x) -> TiledMatrix:

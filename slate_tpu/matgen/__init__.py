@@ -1,2 +1,2 @@
-from .generate import generate_matrix, random_spd
+from .generate import cond_targeted, generate_matrix, random_spd
 from . import random

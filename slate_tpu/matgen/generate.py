@@ -375,6 +375,22 @@ def _generate_base(base, spec, m, n, k, dtype, seed, cond, sigma):
     raise SlateError(f"unknown matrix kind '{base}'")
 
 
+def cond_targeted(n: int, cond: float, dtype=jnp.float32, seed: int = 42,
+                  spd: bool = False, spectrum: str = "geo") -> jax.Array:
+    """Condition-targeted dense test operand (round 16): σ₁ = 1,
+    σₙ = 1/cond with a latms-style geometric spectrum by default
+    (LAPACK ``?latms`` MODE 3 / the reference's ``geo`` profile) —
+    ``spd=True`` builds Q·Σ·Qᴴ (Hermitian positive definite, the
+    pocondest/chol operand), ``spd=False`` builds U·Σ·Vᴴ (general, the
+    gecondest/LU operand). κ₂ is ``cond`` BY CONSTRUCTION, which is
+    what the numerics tests and the chaos suspect-demotion drill
+    calibrate condest against; any profile from :func:`_spectrum`
+    (arith, cluster0, logrand, ...) is accepted."""
+    base = "spd" if spd else "svd"
+    return generate_matrix(f"{base}_{spectrum}", n, dtype=dtype,
+                           seed=seed, cond=float(cond))
+
+
 def random_spd(m: int, nb_unused: int = 0, dtype=jnp.float32, seed: int = 0,
                ) -> jax.Array:
     """Well-conditioned SPD/HPD matrix: A = G·Gᴴ/m + I (the standard posv

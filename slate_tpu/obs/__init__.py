@@ -36,28 +36,37 @@ tools ingest:
   dyadic grids, EWMA handle heat, and the placement-snapshot schema
   the fleet fold turns into ROADMAP item 1's placement input
   (round 15).
+* :mod:`.numerics`   — numerical-health telemetry (round 16): the
+  growth-bound machinery (one source of truth with the tester), the
+  Hager/Higham condest loop the Session drives with resident-factor
+  solve applies, the deterministic residual-probe sampler, and the
+  per-handle healthy/degraded/suspect monitor with counted demotion
+  and eviction reflexes.
 
 See DESIGN.md "Observability (round 8)" for the reference mapping
 (Trace.hh Block/SVG -> span model + Chrome export; the global timers
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import aggregate, attribution, costs, flops, roofline, slo, watchdog
+from . import (aggregate, attribution, costs, flops, numerics, roofline,
+               slo, watchdog)
 from .attribution import AttributionLedger
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
 from .merge import combine_process_traces, lookahead_overlap, merge_traces
+from .numerics import NumericsConfig, NumericsMonitor
 from .slo import Objective, SloTracker
 from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
 from .watchdog import Watchdog
 
 __all__ = [
-    "AttributionLedger", "NOOP_SPAN", "Objective", "ObsServer",
+    "AttributionLedger", "NOOP_SPAN", "NumericsConfig",
+    "NumericsMonitor", "Objective", "ObsServer",
     "SloTracker", "Span", "Tracer",
     "Watchdog", "aggregate", "attribution", "chrome_trace",
     "combine_process_traces",
     "costs", "default_tracer", "flops", "lookahead_overlap",
-    "merge_traces", "render_prometheus", "roofline", "slo",
+    "merge_traces", "numerics", "render_prometheus", "roofline", "slo",
     "validate_chrome_trace", "watchdog", "write_chrome_trace",
 ]
 

@@ -256,10 +256,14 @@ def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
     for r in rows:
         t = per_tenant.setdefault(str(r.get("tenant", "")), {
             "resident_bytes": 0.0, "heat": 0.0, "handles": 0,
-            "hosts": set()})
+            "suspect_handles": 0, "hosts": set()})
         t["resident_bytes"] += float(r.get("bytes_per_chip", 0.0) or 0.0)
         t["heat"] += float(r.get("heat", 0.0) or 0.0)
         t["handles"] += 1
+        if r.get("health") == "suspect":
+            # round 16: health-aware placement — a suspect resident is
+            # never a replication candidate however hot it runs
+            t["suspect_handles"] += 1
         t["hosts"].add(str(r.get("host", "")))
     for t in per_tenant.values():
         t["hosts"] = sorted(t["hosts"])

@@ -105,14 +105,25 @@ OUTCOMES = ("completed", "failed", "shed", "expired")
 # below timer resolution anyway.
 _S_GRID = float(1 << 20)
 
-PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v1"
+PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v2"
 FLEET_PLACEMENT_SCHEMA = "slate_tpu.fleet_placement.v1"
 # one row per resident factor. Mirrored (deliberately, the
 # bench_gate/watchdog duplication pattern: tools/bench_gate.py stays
 # importable without package context) as
 # bench_gate.PLACEMENT_ROW_KEYS; tests pin the two tuples equal.
+# v2 (round 16) adds the numerical-health columns — health (one of
+# obs.numerics.HEALTH_STATES, null without a monitor), condest (κ̂₁
+# from the resident factor, null until probed), growth (the realized
+# factor growth bound, null for mesh residents) — so the fleet
+# placement fold can rank replication candidates by health, not just
+# heat.
 PLACEMENT_ROW_KEYS = ("host", "tenant", "handle", "op", "n", "dtype",
-                      "bytes_per_chip", "heat", "last_access")
+                      "bytes_per_chip", "heat", "last_access",
+                      "health", "condest", "growth")
+# mirror of obs/numerics.HEALTH_STATES, duplicated (not imported) so
+# this module stays stdlib-only (numerics carries numpy for the
+# growth/estimator math); tests/test_numerics.py pins the two equal
+_HEALTH_STATES = ("healthy", "degraded", "suspect")
 
 
 def fl_grid(v: float) -> float:
@@ -407,4 +418,14 @@ def validate_placement_snapshot(doc) -> List[str]:
         if la is not None and (not isinstance(la, (int, float))
                                or isinstance(la, bool)):
             errs.append(f"rows[{i}].last_access: not a number or null")
+        # v2 health columns (round 16): null = no monitor / not probed
+        hv = row.get("health")
+        if hv is not None and hv not in _HEALTH_STATES:
+            errs.append(f"rows[{i}].health: not one of "
+                        f"{_HEALTH_STATES} or null")
+        for k in ("condest", "growth"):
+            v = row.get(k)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or isinstance(v, bool)):
+                errs.append(f"rows[{i}].{k}: not a number or null")
     return errs

@@ -14,6 +14,10 @@ a stdlib-only (http.server) threaded listener with
 * ``GET /tenants``    — tenant attribution + placement payload
   (round 15: per-(tenant, handle) counter cells, handle heat, the
   placement snapshot; {"enabled": false} when no ledger is bound)
+* ``GET /numerics``   — numerical-health payload (round 16:
+  per-handle condest/growth/residual signals and the
+  healthy/degraded/suspect states; {"enabled": false} when no
+  monitor is bound)
 
 No third-party dependency, daemon threads only, ephemeral port by
 default (``port=0``) so tests and co-located sessions never collide.
@@ -222,6 +226,16 @@ class _Handler(BaseHTTPRequestHandler):
                        else {"enabled": False, "objectives": []})
             body = json.dumps(payload) + "\n"
             self._reply(200, body, "application/json")
+        elif path == "/numerics":
+            # round 16: the numerical-health payload (getter-bound so
+            # a monitor enabled AFTER the server started is served —
+            # the /slo provider discipline)
+            payload = (obs.numerics() if callable(obs.numerics)
+                       else obs.numerics)
+            if payload is None:
+                payload = {"enabled": False, "handles": {}}
+            body = json.dumps(payload, sort_keys=True) + "\n"
+            self._reply(200, body, "application/json")
         elif path == "/tenants":
             # round 15: the tenant attribution + placement payload
             # (Session.serve_obs binds a getter so attribution enabled
@@ -257,7 +271,7 @@ class ObsServer:
 
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
                  port: int = 0, ledger=None, slo=None, tenants=None,
-                 attribution=None):
+                 attribution=None, numerics=None):
         self.metrics = metrics
         self.tracer = tracer
         # the /slo provider: an SloTracker, or a zero-arg callable
@@ -269,6 +283,9 @@ class ObsServer:
         # attribution feeds the tenant_* sections of /metrics
         self.tenants = tenants
         self.attribution = attribution
+        # round 16: the /numerics payload provider (or getter — same
+        # late-enable discipline as /slo and /tenants)
+        self.numerics = numerics
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

@@ -1,0 +1,571 @@
+"""Numerical-health telemetry for served solves (round 16).
+
+Rounds 8/12/14/15 taught the serving stack to watch its *performance*
+(spans, SLO burn rates, fault reflexes, tenant attribution); nothing
+watched *numerical quality* in production — the "never a wrong answer"
+guarantee was exercised only by tests and chaos drills, and the
+mixed-precision residents (refine/, PR 9's Carson & Higham ladder)
+silently assume operands stay well-conditioned. This module is the
+sensing layer (ROADMAP item 2 needs exactly these signals to decide
+update-vs-refactor):
+
+* **Growth bounds** — the realized element-growth factors the tester
+  grew for its residual normalizations (``_chol_growth`` /
+  ``_lu_growth`` / ``_aasen_growth``), promoted HERE as the one source
+  of truth; ``tester.py`` imports them back. ‖L‖‖U‖/‖A‖ is the factor
+  the LAPACK backward bound scales by — unbounded growth is the first
+  factor-time symptom of a numerically hostile operand.
+* **:func:`norm1est`** — Hager/Higham's 1-norm estimator (the
+  SLICOT-style power iteration on sign vectors; LAPACK ``?gecon``,
+  SLATE ``gecondest``/``pocondest`` via ``internal_norm1est``) as a
+  HOST loop over caller-supplied solve callables. The serving Session
+  drives it with a handful of extra ``*_solve_using_factor`` applies
+  against the RESIDENT factor (runtime/session.condest), so a live
+  condition estimate costs ~2·max_iter solves and zero refactors;
+  ``linalg/condest`` adapts the same loop for the eager drivers.
+* **:class:`ResidualSampler`** — a deterministic seeded sampler (Weyl
+  sequence) deciding which served solves pay the fused
+  ‖b−Ax‖/(‖A‖·‖x‖+‖b‖) residual probe; the decision stream is a pure
+  function of (seed, request index), so probe schedules are
+  reproducible inputs exactly like round-14 fault schedules.
+* **:class:`NumericsMonitor`** — per-handle health state: condest /
+  growth / sampled-residual EWMA / refine-iteration drift / NaN-Inf
+  sentinels rolled into a ``healthy`` / ``degraded`` / ``suspect``
+  classification, exported as ``handle_health:{tenant}:{handle}``
+  gauges (dropped on forget — the round-15 cardinality discipline)
+  and new columns on placement-snapshot rows. State transitions are
+  counted (``health_transitions_total``) and logged; the Session's
+  reflex hooks demote suspect handles off the refine ladder and
+  deprioritize them at eviction tie-breaks — counted, never silent.
+
+Thresholds are *dimensionless* multiples of the handle's unit
+roundoff: the conditioning signal is u·κ(A) (u of the FACTOR dtype for
+refined residents — the quantity Carson & Higham's convergence theory
+bounds), the residual signal is ρ/eps(working). So one config covers
+every dtype without per-dtype tables.
+
+jax-free (the obs import rule); numpy only — the growth/estimator math
+runs on host-gathered factors and host probe vectors, exactly like the
+tester always did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Callable, Dict, Hashable, Optional, Tuple
+
+import numpy as np
+
+from .tracing import log
+
+HEALTH_STATES = ("healthy", "degraded", "suspect")
+_LEVEL = {s: i for i, s in enumerate(HEALTH_STATES)}
+
+# unit roundoff per canonical dtype name; bfloat16 is not a numpy
+# dtype, so the ladder entry is hardcoded (2^-8 — np.finfo semantics:
+# eps is the gap above 1.0, 2^-7; half of it is the rounding unit.
+# We store eps to match np.finfo(dtype).eps for the numpy dtypes.)
+_EPS = {
+    "float64": float(np.finfo(np.float64).eps),
+    "float32": float(np.finfo(np.float32).eps),
+    "float16": float(np.finfo(np.float16).eps),
+    "bfloat16": 2.0 ** -7,
+    "complex128": float(np.finfo(np.float64).eps),
+    "complex64": float(np.finfo(np.float32).eps),
+}
+
+
+def dtype_eps(name) -> float:
+    """eps of a canonical dtype name (refine/policy vocabulary);
+    unknown names fall back to float64's eps (conservative: flags
+    earlier, never later)."""
+    return _EPS.get(str(name), _EPS["float64"])
+
+
+# -- growth bounds (promoted from tester.py — one source of truth) ----------
+
+
+def _np64(v) -> np.ndarray:
+    """Dense float64/complex128 host copy of an array or a
+    TiledMatrix-like (anything with ``dense_canonical``)."""
+    if hasattr(v, "dense_canonical"):
+        v = v.dense_canonical()
+    v = np.asarray(v)
+    return v.astype(np.complex128 if np.iscomplexobj(v) else np.float64)
+
+
+def lu_growth(LU, a) -> float:
+    """Realized element-growth factor ‖L‖₁‖U‖₁/‖A‖₁ (clamped ≥ 1) of a
+    packed LU factor — the LAPACK residual normalization the pivoted LU
+    tester rows use (‖b−Ax‖ ≲ ε·n·‖L‖‖U‖·‖x‖, test_gesv.cc). Accepts a
+    TiledMatrix factor or a plain packed array (one item of a batched
+    factor stack)."""
+    lu = _np64(LU)
+    npad = lu.shape[0]
+    l = np.tril(lu, -1) + np.eye(npad)
+    u = np.triu(lu)
+    an = _np64(a)
+    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(u, 1)
+               / max(np.linalg.norm(an, 1), 1e-300))
+
+
+# the batched-stack alias tester.py round 13 grew; same formula, kept
+# as a name so call sites read as "one item of a lo factor stack"
+lu_growth_arr = lu_growth
+
+
+def chol_growth(L, a) -> float:
+    """‖L‖₁‖Lᴴ‖₁/‖A‖₁ growth of a (low-precision) Cholesky factor —
+    the mixed rows' bound normalization (round 13, ROADMAP item 2):
+    the refined solution's backward error is bounded through the
+    LOW-precision factor's realized norms, so the denominator must
+    carry them — a flat tol was blind to exactly the factor-precision
+    loss the refinement has to recover."""
+    l = np.tril(_np64(L))
+    an = _np64(a)
+    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(l.conj().T, 1)
+               / max(np.linalg.norm(an, 1), 1e-300))
+
+
+def aasen_growth(LT, a) -> float:
+    """‖L‖₁‖T‖₁‖L‖₁/‖A‖₁ growth of an Aasen LTLᴴ factor (T tridiagonal
+    on the diag/subdiag, L multipliers shifted one column — the hetrs
+    unpacking). Same role as :func:`lu_growth` for the hetrf/hesv rows
+    (the round-5 on-chip sweep saw scaled error 7.62 at n=4096 pass
+    only because tol was a flat 100)."""
+    lt = _np64(LT)
+    npad = lt.shape[0]
+    strict = np.tril(lt, -2)
+    lmat = np.pad(strict[:, :-1], ((0, 0), (1, 0))) + np.eye(npad)
+    d = np.real(np.diagonal(lt))
+    e = np.diagonal(lt, -1)
+    t = np.diag(d.astype(lt.dtype)) + np.diag(e, -1) + np.diag(e.conj(), 1)
+    an = _np64(a)
+    nl = np.linalg.norm(lmat, 1)
+    return max(1.0, nl * np.linalg.norm(t, 1) * nl
+               / max(np.linalg.norm(an, 1), 1e-300))
+
+
+# -- Hager/Higham 1-norm estimation (the ?gecon / norm1est lineage) ---------
+
+
+def norm1est(solve: Callable, solve_h: Callable, n: int,
+             complex_: bool = False, max_iter: int = 5
+             ) -> Tuple[float, int]:
+    """Estimate ‖A⁻¹‖₁ given x ↦ A⁻¹x and x ↦ A⁻ᴴx as HOST callables
+    (np [n, 1] in → np [n, 1]-compatible out; extra padded rows are
+    sliced off). Returns ``(estimate, solves)`` — the solve count is
+    what the Session's cost crediting charges.
+
+    Complex-safe (Higham's complex variant): the 'sign' vector is
+    y/|y| and iterates stay complex; ``solve_h`` must be the
+    CONJUGATE-transpose solve (for Hermitian positive-definite
+    operators A⁻ᴴ = A⁻¹, so one callable serves both — the pocondest
+    convention). Finishes with Higham's alternating-ramp lower bound,
+    exactly like linalg/condest (which adapts this loop for the eager
+    drivers — one estimator, two seams)."""
+    work = np.complex128 if complex_ else np.float64
+    x = np.full((n, 1), 1.0 / n, dtype=work)
+    est = 0.0
+    solves = 0
+    prev_sign = np.zeros((n, 1), dtype=work)
+    for _ in range(max_iter):
+        y = np.asarray(solve(x)).astype(work).reshape(-1, 1)[:n]
+        solves += 1
+        est = float(np.abs(y).sum())
+        absy = np.abs(y)
+        sign = np.where(absy == 0, 1.0, y / np.where(absy == 0, 1.0, absy))
+        if (np.abs(sign - prev_sign) < 1e-12).all():
+            break
+        prev_sign = sign
+        z = np.asarray(solve_h(sign)).astype(work).reshape(-1, 1)[:n]
+        solves += 1
+        j = int(np.argmax(np.abs(z)))
+        if np.abs(z[j]).item() <= np.abs(np.conj(z).T @ x).item():
+            break
+        x = np.zeros((n, 1), dtype=work)
+        x[j] = 1.0
+    # alternative lower bound from a ramp vector (Higham's refinement)
+    v = np.array([(-1.0) ** i * (1.0 + i / max(n - 1, 1))
+                  for i in range(n)]).reshape(n, 1).astype(work)
+    yv = np.asarray(solve(v)).astype(work).reshape(-1, 1)[:n]
+    solves += 1
+    alt = 2.0 * float(np.abs(yv).sum()) / (3.0 * n)
+    return float(max(est, alt)), solves
+
+
+def scaled_residual(rnorm: float, xnorm: float, bnorm: float,
+                    anorm: float) -> float:
+    """The probe's dimensionless backward-error proxy
+    ‖b−Ax‖/(‖A‖·‖x‖+‖b‖) (max-norms; LAPACK's normwise relative
+    residual family). NaN/Inf in any input propagates — the monitor's
+    non-finite sentinel catches it."""
+    den = float(anorm) * float(xnorm) + float(bnorm)
+    if den == 0.0:
+        return 0.0 if rnorm == 0.0 else float("inf")
+    return float(rnorm) / den
+
+
+# -- deterministic probe sampling -------------------------------------------
+
+_PHI = (math.sqrt(5.0) - 1.0) / 2.0  # golden-ratio Weyl increment
+
+
+class ResidualSampler:
+    """Which served solves pay the residual probe: request i is probed
+    iff frac(u₀ + i·φ) < fraction — a low-discrepancy Weyl sequence,
+    so the probed share converges to ``fraction`` fast and the
+    decision stream is a pure function of (seed, i) (the round-14
+    reproducible-schedule discipline, applied to probing). ``decide``
+    consumes the next index under a lock; ``peek(i)`` is the pure
+    read tests pin determinism with."""
+
+    def __init__(self, fraction: float = 0.0625, seed: int = 0):
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("ResidualSampler: fraction must be in [0, 1]")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        # Knuth multiplicative hash of the seed -> u0 in [0, 1)
+        self._u0 = ((self.seed * 2654435761) % (1 << 32)) / float(1 << 32)
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def peek(self, i: int) -> bool:
+        return ((self._u0 + i * _PHI) % 1.0) < self.fraction
+
+    def decide(self) -> bool:
+        with self._lock:
+            i = self._i
+            self._i += 1
+        return self.peek(i)
+
+    @property
+    def consumed(self) -> int:
+        with self._lock:
+            return self._i
+
+
+# -- per-handle health state ------------------------------------------------
+
+
+@dataclasses.dataclass
+class NumericsConfig:
+    """Thresholds and knobs for one monitor (all dimensionless — see
+    module docstring).
+
+    sample_fraction/seed  residual-probe sampling (ResidualSampler)
+    condest_on_factor     run the condest probe after every (re)factor
+                          of a supported operator (amortized like the
+                          factor itself)
+    growth_on_factor      growth bound from each fresh single-device
+                          factor (host gather; mesh residents skip it —
+                          condest is their factor-time signal)
+    condest_max_iter      Hager iteration budget (LAPACK uses 5)
+    ewma_alpha            residual / refine-iteration EWMA weight
+    cond_*                u·κ̂ thresholds (u of the factor dtype for
+                          refined residents): 0.1 means "κ within 10×
+                          of the precision's breakdown point"
+    resid_*               ρ/eps(working) thresholds
+    growth_*              realized growth-factor thresholds
+    refine_drift_degraded EWMA iters / best-seen-EWMA ratio that flags
+                          conditioning drift on a refined handle
+    """
+
+    sample_fraction: float = 0.0625
+    sample_seed: int = 0
+    condest_on_factor: bool = True
+    growth_on_factor: bool = True
+    condest_max_iter: int = 5
+    ewma_alpha: float = 0.25
+    cond_degraded: float = 0.01
+    cond_suspect: float = 0.1
+    resid_degraded: float = 100.0
+    resid_suspect: float = 1e5
+    growth_degraded: float = 1e4
+    growth_suspect: float = 1e8
+    refine_drift_degraded: float = 4.0
+
+
+class _HandleStats:
+    __slots__ = ("op", "work_dtype", "factor_dtype", "tenant",
+                 "condest", "growth", "nonfinite",
+                 "resid_ewma", "resid_last", "resid_max", "resid_count",
+                 "refine_ewma", "refine_floor", "refine_count", "state",
+                 "gauge")
+
+    def __init__(self):
+        self.gauge = None  # last-published handle_health gauge name
+        self.op = None
+        self.work_dtype = None
+        self.factor_dtype = None
+        self.tenant = None
+        self.condest = None
+        self.growth = None
+        self.nonfinite = 0
+        self.resid_ewma = None
+        self.resid_last = None
+        self.resid_max = None
+        self.resid_count = 0
+        self.refine_ewma = None
+        self.refine_floor = None
+        self.refine_count = 0
+        self.state = "healthy"
+
+
+class NumericsMonitor:
+    """Per-handle numerical-health state for one Session.
+
+    The Session records signals at its existing seams (factor-time
+    growth/condest, sampled solve-time residuals, per-solve refine
+    iteration counts) guarded by ONE ``session.numerics is not None``
+    check — the disabled path allocates nothing (the round-8
+    discipline, extended here by test). Every record method returns
+    ``(old_state, new_state)`` so the caller can run its reflex hooks
+    on the transition; the monitor itself owns the gauges
+    (``handle_health:{tenant}:{handle}`` — level 0/1/2 — plus the
+    ``handles_degraded``/``handles_suspect`` aggregates) and the
+    ``health_transitions_total`` counter on the bound Metrics.
+    Thread-safe; jax-free."""
+
+    def __init__(self, config: Optional[NumericsConfig] = None,
+                 metrics=None, **kw):
+        if config is not None and kw:
+            # loud, not last-wins: silently dropping the kwargs would
+            # let a drill believe it runs probe-every-solve while the
+            # config object's default fraction actually applies
+            raise ValueError(
+                "NumericsMonitor: pass either a NumericsConfig or "
+                f"field kwargs, not both (got config and {sorted(kw)})")
+        self.config = config or NumericsConfig(**kw)
+        self.metrics = metrics
+        self.sampler = ResidualSampler(self.config.sample_fraction,
+                                       self.config.sample_seed)
+        self._lock = threading.Lock()
+        self._handles: Dict[str, _HandleStats] = {}
+
+    # -- recording seams ----------------------------------------------------
+
+    def _stats(self, handle: Hashable) -> _HandleStats:
+        h = repr(handle)
+        s = self._handles.get(h)
+        if s is None:
+            s = self._handles[h] = _HandleStats()
+        return s
+
+    def record_factor(self, handle: Hashable, op: str, work_dtype: str,
+                      factor_dtype: Optional[str] = None,
+                      tenant: Optional[str] = None,
+                      growth: Optional[float] = None,
+                      finite: bool = True) -> Tuple[str, str]:
+        """One fresh factor's signals: identity (op/dtypes/tenant — the
+        eps the thresholds scale by), its realized growth bound (None =
+        not computed, e.g. mesh residents), and the NaN/Inf sentinel."""
+        with self._lock:
+            s = self._stats(handle)
+            s.op, s.work_dtype, s.tenant = op, str(work_dtype), tenant
+            s.factor_dtype = (None if factor_dtype is None
+                              else str(factor_dtype))
+            bad = not finite
+            if growth is not None:
+                g = float(growth)
+                s.growth = g
+                bad = bad or not math.isfinite(g)
+            if bad:
+                # ONE event however it was reported (a non-finite
+                # growth usually arrives with finite=False too) — the
+                # per-handle count must agree with the session's
+                # numerics_nonfinite_total event counter
+                s.nonfinite += 1
+            return self._reclassify(handle, s)
+
+    def record_condest(self, handle: Hashable, cond: float
+                       ) -> Tuple[str, str]:
+        with self._lock:
+            s = self._stats(handle)
+            c = float(cond)
+            s.condest = c
+            if not math.isfinite(c):
+                s.nonfinite += 1
+            return self._reclassify(handle, s)
+
+    def record_residual(self, handle: Hashable, rho: float,
+                        work_dtype: Optional[str] = None
+                        ) -> Tuple[str, str]:
+        """One sampled probe's scaled residual ρ. ``work_dtype`` seeds
+        the eps the thresholds scale by when the probe precedes the
+        first record_factor (the late-enable warm-cache path —
+        without it the float64-eps fallback would flag an f32
+        handle's perfectly healthy residuals suspect)."""
+        with self._lock:
+            s = self._stats(handle)
+            if s.work_dtype is None and work_dtype is not None:
+                s.work_dtype = str(work_dtype)
+            r = float(rho)
+            s.resid_last = r
+            s.resid_count += 1
+            if not math.isfinite(r):
+                s.nonfinite += 1
+            else:
+                a = self.config.ewma_alpha
+                s.resid_ewma = (r if s.resid_ewma is None
+                                else (1.0 - a) * s.resid_ewma + a * r)
+                s.resid_max = (r if s.resid_max is None
+                               else max(s.resid_max, r))
+            return self._reclassify(handle, s)
+
+    def record_refine(self, handle: Hashable, iters: int
+                      ) -> Tuple[str, str]:
+        """One refined solve's iteration count — drift of the EWMA
+        above its best-seen floor is the conditioning-degradation
+        proxy (more iterations to reach the same tolerance means
+        u_f·κ grew, Carson & Higham's contraction factor)."""
+        with self._lock:
+            s = self._stats(handle)
+            it = float(iters)
+            s.refine_count += 1
+            a = self.config.ewma_alpha
+            s.refine_ewma = (it if s.refine_ewma is None
+                             else (1.0 - a) * s.refine_ewma + a * it)
+            s.refine_floor = (s.refine_ewma if s.refine_floor is None
+                              else min(s.refine_floor, s.refine_ewma))
+            return self._reclassify(handle, s)
+
+    # -- classification -----------------------------------------------------
+
+    def _classify(self, s: _HandleStats) -> str:
+        cfg = self.config
+        if s.nonfinite:
+            return "suspect"
+        level = 0
+        if s.condest is not None:
+            # u of the factor dtype for refined residents — the
+            # precision the resident actually lives in
+            u = dtype_eps(s.factor_dtype or s.work_dtype)
+            ucond = s.condest * u
+            if ucond > cfg.cond_suspect:
+                level = max(level, 2)
+            elif ucond > cfg.cond_degraded:
+                level = max(level, 1)
+        if s.growth is not None:
+            if s.growth > cfg.growth_suspect:
+                level = max(level, 2)
+            elif s.growth > cfg.growth_degraded:
+                level = max(level, 1)
+        if s.resid_ewma is not None:
+            eps = dtype_eps(s.work_dtype)
+            if s.resid_ewma > cfg.resid_suspect * eps:
+                level = max(level, 2)
+            elif s.resid_ewma > cfg.resid_degraded * eps:
+                level = max(level, 1)
+        if (s.refine_ewma is not None and s.refine_floor
+                and s.refine_ewma
+                > cfg.refine_drift_degraded * s.refine_floor):
+            level = max(level, 1)
+        return HEALTH_STATES[level]
+
+    def _reclassify(self, handle: Hashable, s: _HandleStats
+                    ) -> Tuple[str, str]:
+        """Caller holds the lock. Recompute the state, publish the
+        gauge, count/log the transition."""
+        old, new = s.state, self._classify(s)
+        s.state = new
+        m = self.metrics
+        if m is not None:
+            tname = s.tenant if s.tenant is not None else "default"
+            gname = f"handle_health:{tname}:{repr(handle)}"
+            if s.gauge is not None and s.gauge != gname:
+                # the tenant was learned after the first record (a
+                # warm-cache probe precedes record_factor on the
+                # late-enable path): drop the provisional gauge so
+                # relabeling cannot leak a stale /metrics row
+                m.drop_gauge(s.gauge)
+            s.gauge = gname
+            m.set_gauge(gname, float(_LEVEL[new]))
+        if new != old:
+            counts = self._counts_locked()
+            if m is not None:
+                m.inc("health_transitions_total")
+                m.set_gauge("handles_degraded",
+                            float(counts.get("degraded", 0)))
+                m.set_gauge("handles_suspect",
+                            float(counts.get("suspect", 0)))
+            (log.warning if _LEVEL[new] > _LEVEL[old] else log.info)(
+                "numerics: handle %r health %s -> %s (condest=%s, "
+                "growth=%s, resid_ewma=%s, nonfinite=%d)", handle, old,
+                new, s.condest, s.growth, s.resid_ewma, s.nonfinite)
+        return old, new
+
+    # -- reads --------------------------------------------------------------
+
+    def health(self, handle: Hashable) -> Optional[str]:
+        with self._lock:
+            s = self._handles.get(repr(handle))
+            return None if s is None else s.state
+
+    def placement_info(self, handle: Hashable
+                       ) -> Tuple[Optional[str], Optional[float],
+                                  Optional[float]]:
+        """(health, condest, growth) for one placement-snapshot row —
+        (None, None, None) for untracked handles (the disabled-path
+        columns)."""
+        with self._lock:
+            s = self._handles.get(repr(handle))
+            if s is None:
+                return None, None, None
+            return s.state, s.condest, s.growth
+
+    def _counts_locked(self) -> Dict[str, int]:
+        counts = {s: 0 for s in HEALTH_STATES}
+        for st in self._handles.values():
+            counts[st.state] += 1
+        return counts
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return self._counts_locked()
+
+    def snapshot(self) -> dict:
+        """The ``/numerics`` payload: per-handle signal rows + the
+        state histogram + the config (so a scrape is self-describing)."""
+        with self._lock:
+            handles = {
+                h: {
+                    "op": s.op, "work_dtype": s.work_dtype,
+                    "factor_dtype": s.factor_dtype, "tenant": s.tenant,
+                    "condest": s.condest, "growth": s.growth,
+                    "nonfinite": s.nonfinite,
+                    "resid_ewma": s.resid_ewma,
+                    "resid_last": s.resid_last,
+                    "resid_max": s.resid_max,
+                    "resid_count": s.resid_count,
+                    "refine_ewma": s.refine_ewma,
+                    "refine_count": s.refine_count,
+                    "state": s.state,
+                }
+                for h, s in self._handles.items()
+            }
+            counts = self._counts_locked()
+            probes = self.sampler.consumed
+        return {
+            "schema": "slate_tpu.numerics.v1",
+            "handles": handles,
+            "counts": counts,
+            "sampler_decisions": probes,
+            "config": dataclasses.asdict(self.config),
+        }
+
+    def forget(self, handle: Hashable):
+        """Drop a handle's row and gauge (unregister — the round-15
+        churn-cardinality discipline); counters keep their history."""
+        with self._lock:
+            s = self._handles.pop(repr(handle), None)
+            if s is not None and self.metrics is not None:
+                if s.gauge is not None:
+                    self.metrics.drop_gauge(s.gauge)
+                counts = self._counts_locked()
+                self.metrics.set_gauge(
+                    "handles_degraded", float(counts.get("degraded", 0)))
+                self.metrics.set_gauge(
+                    "handles_suspect", float(counts.get("suspect", 0)))

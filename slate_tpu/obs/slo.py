@@ -50,7 +50,8 @@ from .tracing import log
 # and smoke runs while the long window is the significance check.
 DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 3600.0)
 
-KINDS = ("latency", "error_rate", "cache_hit_rate", "oom_risk")
+KINDS = ("latency", "error_rate", "cache_hit_rate", "oom_risk",
+         "residual")
 
 
 def n_bucket(n: int) -> int:
@@ -75,6 +76,11 @@ class Objective:
     * ``error_rate``     — request/solve events; good = succeeded.
     * ``cache_hit_rate`` — factor-cache accesses; good = hit.
     * ``oom_risk``       — HBM budget checks; good = within budget.
+    * ``residual``       — sampled residual probes (round 16,
+      obs/numerics); good = the probe's scaled residual
+      ρ = ‖b−Ax‖/(‖A‖·‖x‖+‖b‖) ≤ ``threshold_s`` (the field is
+      reused as the dimensionless ρ bound — one threshold slot, two
+      value-vs-bound kinds).
 
     ``op``/``n_bucket`` scope latency/error objectives to one operator
     kind and/or one pow2 size bucket (None = all); ``source`` selects
@@ -105,9 +111,9 @@ class Objective:
         if not (0.0 < self.target < 1.0):
             raise ValueError(f"Objective {self.name!r}: target must be in "
                              f"(0, 1), got {self.target}")
-        if self.kind == "latency" and not self.threshold_s:
-            raise ValueError(f"Objective {self.name!r}: latency objectives "
-                             "need threshold_s")
+        if self.kind in ("latency", "residual") and not self.threshold_s:
+            raise ValueError(f"Objective {self.name!r}: {self.kind} "
+                             "objectives need threshold_s")
         if not self.windows:
             raise ValueError(f"Objective {self.name!r}: needs >= 1 window")
 
@@ -162,6 +168,9 @@ class SloTracker:
                              Deque[_Event]] = {}
         self._cache: Deque[_Event] = deque(maxlen=max_events)
         self._oom: Deque[_Event] = deque(maxlen=max_events)
+        # round 16: sampled-residual probe events (t, rho, True) — the
+        # "value" slot carries the dimensionless scaled residual
+        self._resid: Deque[_Event] = deque(maxlen=max_events)
         self._breached: Dict[str, bool] = {}
 
     # -- recording (the runtime's hot path: one lock, one append) ----------
@@ -189,6 +198,14 @@ class SloTracker:
         with self._lock:
             self._oom.append((t, 0.0, bool(ok)))
 
+    def record_residual(self, rho: float, t: Optional[float] = None):
+        """One sampled residual probe (round 16): the scaled residual
+        ρ rides the value slot; goodness is judged against each
+        residual objective's threshold at evaluation time."""
+        t = self._clock() if t is None else t
+        with self._lock:
+            self._resid.append((t, float(rho), True))
+
     def worst_burn_rate(self, now: Optional[float] = None) -> float:
         """Worst SHORT-window burn rate across objectives right now —
         the cheap point read the round-14 load shedder polls (full
@@ -215,6 +232,8 @@ class SloTracker:
             return tuple(self._cache)
         if obj.kind == "oom_risk":
             return tuple(self._oom)
+        if obj.kind == "residual":
+            return tuple(self._resid)
         out = []
         for (source, op, nb, tenant), q in self._requests.items():
             if source != obj.source:
@@ -241,7 +260,10 @@ class SloTracker:
                 continue
             total += 1
             good = ok
-            if obj.kind == "latency":
+            if obj.kind in ("latency", "residual"):
+                # one value-vs-threshold predicate: seconds for
+                # latency, the dimensionless scaled residual for
+                # residual probes (round 16)
                 good = ok and latency <= obj.threshold_s
                 lat.append(latency)
             if not good:

@@ -184,6 +184,13 @@ class Watchdog:
         h = snap.get("histograms", {}).get("request_latency")
         if h and h.get("count"):
             self.observe("request_latency_p99", h["p99"], **common)
+        # round 16: the numerical-health series — sampled-residual p99
+        # (lower-is-better once a baseline row commits it; until then
+        # the observation is counted unmatched, never flagged — the
+        # first on-chip session owns committing its best)
+        r = snap.get("histograms", {}).get("sampled_residual")
+        if r and r.get("count"):
+            self.observe("sampled_residual_p99", r["p99"], **common)
         frac = _serve_roof_fraction(snap)
         if frac is not None:
             self.observe("serve.roof_fraction", frac, **common)

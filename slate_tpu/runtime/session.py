@@ -71,6 +71,11 @@ from ..obs.attribution import (DEFAULT_TENANT, PLACEMENT_SCHEMA,
                                fl_grid as _fl_grid, s_grid as _s_grid,
                                validate_placement_snapshot)
 from ..obs.tracing import Tracer, default_tracer, log as _obs_log
+# numerical-health telemetry (round 16): growth bounds, the
+# Hager-Higham condest loop, the deterministic residual sampler, and
+# the per-handle health monitor — jax-free; the Session drives it with
+# resident-factor solve applies at its existing program seams
+from ..obs import numerics as _num
 from ..refine import engine as _refine_engine
 from ..refine.policy import PolicyTable, RefinePolicy
 from .metrics import Metrics
@@ -99,6 +104,27 @@ def _solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
 OPS = ("lu", "chol", "qr", "band_lu", "band_chol",
        "lu_small", "chol_small")
 SMALL_OPS = ("lu_small", "chol_small")
+# operators the round-16 condest probe covers (the gecondest/pocondest
+# driver families; QR serves least-squares — trcondest on R is a
+# different estimate — and band factors stay on the eager verbs)
+CONDEST_OPS = ("lu", "chol", "lu_small", "chol_small")
+# operators the sampled residual probe covers: b − A·x is an error
+# signal only where x solves A·x = b (a least-squares minimizer's
+# residual is data, not error)
+PROBE_OPS = ("lu", "chol")
+
+
+def _work_dtype_name(entry) -> str:
+    """Canonical working-dtype name of a registered operator (the
+    refine/policy vocabulary the numerics thresholds scale by) — as
+    the DEVICE computes it: without jax x64, a float64-registered
+    small operand truly solves in float32, and scaling the residual
+    thresholds by float64's eps would flag every healthy handle
+    suspect (found by the obs_dump smoke, which runs without x64)."""
+    from ..refine.policy import canonical_dtype_name
+    A = entry.A
+    dt = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+    return canonical_dtype_name(jax.dtypes.canonicalize_dtype(dt))
 
 
 def _tree_nbytes(payload, per_chip: bool = False) -> int:
@@ -163,6 +189,9 @@ class _Operator:
     # ‖A‖_inf, computed once at first refined solve (the convergence
     # constant's norm — gesv_mixed.cc:34-43)
     anorm: Optional[float] = None
+    # ‖A‖_1, computed once at the first condest probe (round 16 —
+    # Hager's estimator reports ‖A⁻¹‖_1, so κ̂₁ needs the 1-norm)
+    anorm1: Optional[float] = None
     # attribution tenant (round 15): who this operator belongs to.
     # None = the DEFAULT_TENANT — every existing caller lands there,
     # so single-tenant deployments get the ledger without changes
@@ -213,8 +242,17 @@ class Session:
                  tracer: Optional[Tracer] = None,
                  mesh=None, slo=None,
                  refine_policies: Optional[PolicyTable] = None,
-                 faults=None, attribution=None):
+                 faults=None, attribution=None, numerics=None):
         self.hbm_budget = hbm_budget
+        # numerical-health telemetry (round 16): None = disabled —
+        # every seam guards with ONE `numerics is None` check and
+        # allocates nothing (the round-8 tracer discipline, pinned by
+        # test). An obs.numerics.NumericsMonitor tracks per-handle
+        # condest / growth / sampled-residual / refine-drift signals
+        # into a healthy/degraded/suspect state with counted reflexes
+        # (suspect handles are demoted off the refine ladder and lose
+        # eviction tie-breaks — never silently).
+        self.numerics = numerics
         # tenant/handle attribution (round 15): None = disabled — every
         # seam guards with ONE `attr is None` check and allocates
         # nothing (the round-8 tracer discipline, pinned by test). An
@@ -244,6 +282,8 @@ class Session:
         self.metrics = metrics or Metrics()
         if attribution is not None and attribution.metrics is None:
             attribution.metrics = self.metrics  # heat gauges land here
+        if numerics is not None and numerics.metrics is None:
+            numerics.metrics = self.metrics  # health gauges land here
         # request-scoped tracing: disabled by default (the shared
         # default tracer starts off) — zero spans, no per-solve cost
         # beyond one enabled-flag check per phase
@@ -312,6 +352,21 @@ class Session:
                 self.attribution = AttributionLedger(
                     halflife_s=halflife_s, metrics=self.metrics, **kw)
             return self.attribution
+
+    def enable_numerics(self, config=None, **kw):
+        """Attach an :class:`~..obs.numerics.NumericsMonitor` (round
+        16) bound to this session's metrics and return it; idempotent
+        — a second call returns the running monitor. ``config`` is a
+        :class:`~..obs.numerics.NumericsConfig` (or kwargs for one:
+        ``sample_fraction=``, thresholds, ...). The ``/numerics``
+        route of :meth:`serve_obs` serves its payload and ``/metrics``
+        grows the ``handle_health`` gauges."""
+        from ..obs.numerics import NumericsMonitor
+        with self._lock:
+            if self.numerics is None:
+                self.numerics = NumericsMonitor(
+                    config, metrics=self.metrics, **kw)
+            return self.numerics
 
     def request_tenant(self, handle: Hashable,
                        override: Optional[str] = None) -> str:
@@ -418,12 +473,14 @@ class Session:
 
     def demote_to_working_precision(self, handle: Hashable) -> bool:
         """The mixed→working_precision rung of the degradation ladder,
-        walked by the Executor's circuit breaker: deactivate the
-        refine policy and evict the low-precision resident so the next
-        solve refactors at working precision (the same observable
-        fallback refine non-convergence takes — counted separately in
-        ``refine_demotions_total`` so a breaker-driven demotion is
-        distinguishable from a numerical one)."""
+        walked by the Executor's circuit breaker AND (round 16) the
+        numerics suspect reflex: deactivate the refine policy and
+        evict the low-precision resident so the next solve refactors
+        at working precision (the same observable fallback refine
+        non-convergence takes — counted separately in
+        ``refine_demotions_total``; a numerics-driven demotion
+        additionally counts ``health_demotions_total``, so the three
+        causes stay distinguishable)."""
         with self._lock:
             entry = self._ops.get(handle)
             if entry is None or entry.refine is None:
@@ -439,8 +496,291 @@ class Session:
             self._update_hbm_gauges()
         _obs_log.warning(
             "degradation ladder: operator %r demoted to working "
-            "precision (circuit breaker)", handle)
+            "precision", handle)
         return True
+
+    # -- numerical health (round 16, obs/numerics.py) ----------------------
+
+    def _health_reflex(self, entry: _Operator, handle: Hashable,
+                       old: str, new: str):
+        """Caller verified ``self.numerics is not None``. The counted
+        reflexes on a health-state transition: a handle that turns
+        SUSPECT while serving from a low-precision resident is demoted
+        off the refine ladder (the round-14
+        ``demote_to_working_precision`` rung — ``refine_demotions_total``
+        moves, plus ``health_demotions_total`` so a numerics-driven
+        demotion is distinguishable from a breaker-driven one). Suspect
+        handles also lose eviction tie-breaks (:meth:`_eviction_order`).
+        Never silent: the monitor already logged/counted the
+        transition."""
+        if new == old:
+            return
+        if new == "suspect" and entry.refine is not None:
+            self.metrics.inc("health_demotions_total")
+            _obs_log.warning(
+                "numerics reflex: suspect operator %r demoted off the "
+                "refine ladder", handle)
+            self.demote_to_working_precision(handle)
+
+    def condest(self, handle: Hashable) -> float:
+        """Hager-Higham 1-norm condition estimate κ̂₁(A) ≈ ‖A‖₁‖A⁻¹‖₁
+        from the RESIDENT factor (factoring on miss) — the serving
+        analog of slate::gecondest/pocondest (LAPACK ``?gecon``): a
+        handful of extra ``*_solve_using_factor`` applies driven by
+        :func:`~..obs.numerics.norm1est`, each executing the SAME
+        analyzed AOT solve programs the serving path runs (mesh
+        residents included — zero new compiles after :meth:`warmup`),
+        credited per execution to the cost/attribution ledgers under
+        the ``numerics.condest`` op. Covers lu/chol operators (dense —
+        single-device or mesh-sharded — and the *_small engine).
+        Records into the attached NumericsMonitor (if any) and runs
+        the health reflexes on the resulting transition."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op not in CONDEST_OPS:
+                raise SlateError(
+                    f"Session.condest: covers {CONDEST_OPS}, not "
+                    f"{entry.op!r}")
+            nm = self.numerics
+            hit = handle in self._cache
+            res = self.factor(handle)
+            if res.info != 0:
+                raise SlateError(
+                    f"Session.condest: operator {handle!r} factorization "
+                    f"failed (info={res.info})")
+            if (not hit and nm is not None
+                    and nm.config.condest_on_factor):
+                # the factor-on-miss just ran the estimator at its own
+                # seam (_numerics_after_factor) — return that estimate
+                # instead of paying the probe solves twice for one
+                # logical question
+                ce = nm.placement_info(handle)[1]
+                if ce is not None:
+                    return ce
+            # a factor-time health reflex may have demoted + refactored
+            # (the returned res IS the serving resident either way)
+            return self._condest_locked(entry, handle, res)
+
+    def _condest_locked(self, entry: _Operator, handle: Hashable,
+                        res: _Resident) -> float:
+        """Caller holds the lock; ``res`` is a successful resident."""
+        nm = self.numerics
+        cfg = nm.config if nm is not None else _num.NumericsConfig()
+        n = entry.n
+        if entry.anorm1 is None:
+            if entry.op in SMALL_OPS:
+                a = np.asarray(entry.A)
+                entry.anorm1 = float(
+                    np.abs(a.astype(np.complex128 if np.iscomplexobj(a)
+                                    else np.float64)).sum(axis=0).max())
+            else:
+                from ..core.types import Norm
+                from ..linalg.norms import norm as _norm
+                entry.anorm1 = float(_norm(entry.A, Norm.One))
+        wd = _work_dtype_name(entry)
+        cplx = wd.startswith("complex")
+        solve, solve_h = self._condest_applies(entry, handle, res, cplx)
+        est, solves = _num.norm1est(solve, solve_h, n, complex_=cplx,
+                                    max_iter=cfg.condest_max_iter)
+        cond = (float("inf") if est <= 0.0 or entry.anorm1 <= 0.0
+                else entry.anorm1 * est)
+        if not np.isfinite(cond):
+            # the session-level sentinel counter must agree with the
+            # per-handle nonfinite field record_condest bumps below
+            self.metrics.inc("numerics_nonfinite_total")
+        # probe-work crediting: `solves` factor applies of one column
+        # each — the model-flop seam every serving counter uses, on a
+        # dedicated counter/ledger op so client-attributed solve work
+        # stays conserving (numerics probes are system work)
+        fl = solves * _solve_flops(entry.op, entry.m, entry.n, 1,
+                                   entry.band)
+        self.metrics.inc("condest_runs_total")
+        self.metrics.inc("condest_solves_total", solves)
+        self.metrics.inc("numerics_flops_total", fl)
+        self.metrics.inc("flops_total", fl)
+        _LEDGER.record("numerics.condest", fl)
+        if nm is not None:
+            old, new = nm.record_condest(handle, cond)
+            self._health_reflex(entry, handle, old, new)
+        return cond
+
+    def _condest_applies(self, entry: _Operator, handle: Hashable,
+                         res: _Resident, cplx: bool):
+        """Caller holds the lock. (x ↦ A⁻¹x, x ↦ A⁻ᴴx) host callables
+        over the resident factor for :func:`~..obs.numerics.norm1est`
+        (np [n, 1] float64/complex128 in and out).
+
+        Dense operators run the SAME solve programs the serving path
+        uses (warmup-compiled AOT executables when shapes match — the
+        mesh zero-new-compiles claim; refined residents apply through
+        the refine ``start`` program, i.e. cast-down → lo factor apply
+        → cast-up, so the estimate describes the factor that actually
+        serves). LU adds one conjugate-transpose-solve program
+        (``condest_t``), compiled through the analyzed AOT seam.
+        Small operators run their B=1 bucket programs
+        (accounting-suppressed — the condest seam credits explicitly);
+        the lu_small transpose solve runs host-side from a one-time
+        factor gather (triangular solves at small n)."""
+        op = entry.op
+        payload = res.payload
+        tenant = entry.tenant
+
+        if op in SMALL_OPS:
+            from ..linalg import batched as _batched
+            if op == "chol_small":
+                lfac = payload[0]
+
+                def apply(x):
+                    with _batched.suppress_accounting():
+                        y = _batched.potrs_batched(
+                            lfac[None], np.ascontiguousarray(x)[None])
+                    return np.asarray(jax.block_until_ready(y))[0]
+
+                # A⁻ᴴ = A⁻¹ for an HPD operator (pocondest: one solver)
+                return apply, apply
+            lu_d, perm_d = payload
+
+            def apply(x):
+                with _batched.suppress_accounting():
+                    y = _batched.getrs_batched(
+                        lu_d[None], perm_d[None],
+                        np.ascontiguousarray(x)[None])
+                return np.asarray(jax.block_until_ready(y))[0]
+
+            # host conjugate-transpose solve from the gathered factor:
+            # a[perm] = L·U (gather semantics, linalg/batched), so
+            # A⁻ᴴx = Pᵀ·L⁻ᴴ·U⁻ᴴ·x — scatter rows back through perm
+            work = np.complex128 if cplx else np.float64
+            lu_h = np.asarray(lu_d).astype(work)
+            perm_h = np.asarray(perm_d).astype(np.int64)
+            nloc = lu_h.shape[0]
+            l_h = np.tril(lu_h, -1) + np.eye(nloc)
+            u_h = np.triu(lu_h)
+
+            def apply_h(x):
+                w = np.linalg.solve(u_h.conj().T, x)
+                v = np.linalg.solve(l_h.conj().T, w)
+                y = np.zeros_like(v)
+                y[perm_h] = v
+                return y
+
+            return apply, apply_h
+
+        # dense lu/chol (single-device, mesh-sharded, or refined)
+        def host(X):
+            return (X.to_numpy() if isinstance(X, TiledMatrix)
+                    else np.asarray(X))
+
+        if entry.refine is not None:
+            def fwd(x):
+                B = self._wrap_rhs(entry, np.ascontiguousarray(x))
+                exe, key = self._refine_exe(entry, handle, "start",
+                                            (payload, B))
+                X = exe(payload, B)
+                self._credit_program(key, "numerics.condest",
+                                     tenant=tenant, handle=handle)
+                return host(X)
+        else:
+            solve_fn = self._solve_fn(entry)
+
+            def fwd(x):
+                B = self._wrap_rhs(entry, np.ascontiguousarray(x))
+                key = self._aot_key(entry, payload, B)
+                exe = self._compiled.get(key)
+                if exe is None and entry.grid is not None:
+                    exe = self._aot_compile("solve", entry, handle,
+                                            solve_fn, (payload, B),
+                                            key=key)
+                    self._compiled_put(key, exe)
+                    self.metrics.inc("aot_compiles")
+                if exe is not None:
+                    self._compiled.move_to_end(key)
+                    self._credit_program(key, "numerics.condest",
+                                         tenant=tenant, handle=handle)
+                    return host(exe(payload, B))
+                return host(solve_fn(payload, B))
+
+        if op == "chol":
+            # A⁻ᴴ = A⁻¹ (HPD resident) — the pocondest convention
+            return fwd, fwd
+
+        def tsolve(x):
+            xq = np.conj(x) if cplx else x
+            B = self._wrap_rhs(entry, np.ascontiguousarray(xq))
+            exe, key = self._condest_texe(entry, handle, payload, B)
+            Y = exe(payload, B)
+            if key is not None:
+                self._credit_program(key, "numerics.condest",
+                                     tenant=tenant, handle=handle)
+            y = host(Y)
+            return np.conj(y) if cplx else y
+
+        return fwd, tsolve
+
+    def _condest_tfn(self, entry: _Operator):
+        """The LU conjugate-transpose-solve closure (x ↦ A⁻ᵀx via
+        ``getrs(..., trans=True)``; the host wrapper conjugates around
+        it for complex dtypes). Refined residents cast the rhs down to
+        the factor dtype and the result back up, mirroring the refine
+        ``start`` program — the estimate must describe the factor that
+        serves."""
+        opts = entry.opts
+        if entry.refine is not None:
+            policy = entry.refine
+            work = entry.A.dtype
+
+            def make():
+                from ..linalg import elementwise as ew
+                from ..linalg.lu import getrs as _getrs
+                from ..refine.policy import jax_dtype as _jd
+                lo = _jd(policy.factor_dtype)
+
+                def tsolve(payload, B):
+                    LU, perm = payload
+                    Y = _getrs(LU, perm, ew.copy(B, dtype=lo), opts,
+                               trans=True)
+                    return ew.copy(Y, dtype=work)
+                tsolve.__name__ = "serve_lu_condest_t_refined"
+                return tsolve
+
+            return self._jit_cached(
+                ("condest_t", entry.op, opts, policy,
+                 str(np.dtype(entry.A.dtype))), make)
+
+        def make():
+            from ..linalg.lu import getrs as _getrs
+
+            def tsolve(payload, B):
+                LU, perm = payload
+                return _getrs(LU, perm, B, opts, trans=True)
+            tsolve.__name__ = "serve_lu_condest_t"
+            return tsolve
+
+        return self._jit_cached(("condest_t", entry.op, opts), make)
+
+    def _condest_texe(self, entry: _Operator, handle: Hashable,
+                      payload, B):
+        """AOT-compiled ``condest_t`` program for these shapes →
+        (exe, key) — always through the analyzed ``_aot_compile`` seam
+        (the _refine_exe discipline: per-execution bytes/census
+        crediting; warmup precompiles it so a warmed operator's
+        condest adds zero compiles)."""
+        leaves, treedef = jax.tree_util.tree_flatten((payload, B))
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = ("condest_t", entry.op, entry.opts, entry.refine, treedef,
+               shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            fn = self._condest_tfn(entry)
+            exe = self._aot_compile("condest_t", entry, handle, fn,
+                                    (payload, B), key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        return exe, key
 
     def op_meta(self, handle: Hashable) -> Optional[Tuple[str, int]]:
         """Lock-free (op, n) of a registered handle, or None — the
@@ -641,6 +981,10 @@ class Session:
                 # cannot leak ledger state — the cells stay (billing
                 # history)
                 self.attribution.forget_handle(handle)
+            if self.numerics is not None:
+                # same churn-cardinality discipline for the health row
+                # and its handle_health gauge
+                self.numerics.forget(handle)
             self._update_hbm_gauges()
 
     def __contains__(self, handle: Hashable) -> bool:
@@ -781,7 +1125,47 @@ class Session:
                     self.metrics.inc("residency_byte_seconds_total",
                                      inc)
             self._evict_to_budget(keep=handle)
+            if self.numerics is not None and res.info == 0:
+                res = self._numerics_after_factor(entry, handle, res)
             return res
+
+    def _numerics_after_factor(self, entry: _Operator, handle: Hashable,
+                               res: _Resident) -> _Resident:
+        """Caller holds the lock and verified ``self.numerics``.
+        Factor-time health signals on a fresh resident: the realized
+        growth bound (host read of the factor; skipped for mesh
+        residents — their factor-time signal is the condest, which
+        runs sharded) with its NaN/Inf sentinel, then the condest
+        probe (config-gated). Returns the SERVING resident: a reflex
+        demotion mid-signal evicts the lo factor, so this refactors at
+        working precision before returning (bounded recursion — the
+        demoted entry has ``refine=None`` and cannot demote again)."""
+        nm = self.numerics
+        cfg = nm.config
+        growth = None
+        finite = True
+        if (cfg.growth_on_factor and entry.grid is None
+                and entry.op in CONDEST_OPS):
+            growth = (_num.chol_growth if "chol" in entry.op
+                      else _num.lu_growth)(res.payload[0], entry.A)
+            if not np.isfinite(growth):
+                finite = False
+                self.metrics.inc("numerics_nonfinite_total")
+        old, new = nm.record_factor(
+            handle, entry.op, _work_dtype_name(entry),
+            factor_dtype=(None if entry.refine is None
+                          else entry.refine.factor_dtype),
+            tenant=entry.tenant, growth=growth, finite=finite)
+        self._health_reflex(entry, handle, old, new)
+        if (cfg.condest_on_factor and entry.op in CONDEST_OPS
+                and handle in self._cache):
+            self._condest_locked(entry, handle, res)
+        if handle not in self._cache:
+            # a reflex demoted this handle off the refine ladder and
+            # evicted its lo resident: serve from a working-precision
+            # refactor, never from the factor the reflex just rejected
+            return self.factor(handle)
+        return res
 
     def factor_info(self, handle: Hashable) -> int:
         """info of the resident factor (factoring on miss). A cached
@@ -1012,6 +1396,25 @@ class Session:
                 sum(r.nbytes for r in self._cache.values())
                 + self._largest_transient())
 
+    def _eviction_order(self):
+        """Caller holds the lock. The LRU walk order, except SUSPECT
+        handles lose eviction tie-breaks (round 16): a resident the
+        numerics monitor distrusts is the cheapest thing to give back
+        — its next touch refactors anyway if the operand really
+        degraded, and keeping it pins HBM a healthy handle could use.
+        LRU order is preserved within each health class; with numerics
+        disabled this is exactly ``list(self._cache)`` (one None
+        check)."""
+        keys = list(self._cache)
+        nm = self.numerics
+        if nm is None:
+            return keys
+        sus = [h for h in keys if nm.health(h) == "suspect"]
+        if not sus:
+            return keys
+        smark = set(sus)
+        return sus + [h for h in keys if h not in smark]
+
     def _evict_to_budget(self, keep: Hashable):
         """Caller holds the lock. Drop LRU entries (never ``keep``)
         until resident factors PLUS the largest resident program's
@@ -1031,7 +1434,7 @@ class Session:
             return
         transient = self._largest_transient()
         used = sum(r.nbytes for r in self._cache.values()) + transient
-        for h in list(self._cache):
+        for h in self._eviction_order():
             if used <= budget:
                 break
             if h == keep:
@@ -1118,6 +1521,19 @@ class Session:
                 raise SlateError(
                     f"Session: operator {handle!r} factorization failed "
                     f"(info={res.info})")
+            # sampled residual probe (round 16): the deterministic
+            # sampler decides BEFORE dispatch whether this solve runs
+            # the fused solve+residual program instead of the plain
+            # one — one extra gemm in-program, one host sync, zero
+            # extra programs for unprobed solves. Refined entries skip
+            # it (their per-iteration residuals already feed the
+            # refine-drift signal). AFTER the info raise on purpose: a
+            # failed solve never consumes a decision, on any path —
+            # the probe schedule stays a pure function of the
+            # SUCCESSFUL request stream (grouped-parity pin).
+            nm = self.numerics
+            probe = (nm is not None and entry.refine is None
+                     and entry.op in PROBE_OPS and nm.sampler.decide())
             k = int(B.shape[1])
             served = k if served_cols is None else int(served_cols)
             tr = self.tracer
@@ -1132,13 +1548,24 @@ class Session:
                 # and stage histograms (round 12), so the split is
                 # visible in /metrics even with tracing off
                 t0 = time.perf_counter()
+                pstats = None
                 with tr.span("serve.dispatch"):
-                    X = self._dispatch(entry, res, B, handle,
-                                       served_cols=served_cols,
-                                       tenant=rt)
+                    if probe:
+                        X, pstats = self._dispatch_probed(
+                            entry, res, B, handle,
+                            served_cols=served_cols, tenant=rt)
+                    else:
+                        X = self._dispatch(entry, res, B, handle,
+                                           served_cols=served_cols,
+                                           tenant=rt)
                 t1 = time.perf_counter()
                 with tr.span("serve.block"):
                     X = jax.block_until_ready(X)
+                    if pstats is not None:
+                        # same program, already executed with X — the
+                        # fetch rides the one existing host sync
+                        pstats = np.asarray(
+                            jax.block_until_ready(pstats))
                 t2 = time.perf_counter()
             ex = getattr(ph.span, "trace_id", None)  # exemplar join key
             self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
@@ -1179,7 +1606,74 @@ class Session:
                 self.slo.record_request(entry.op, entry.n, ph.elapsed,
                                         ok=True, source="solve",
                                         tenant=rt)
+            if pstats is not None:
+                rnorm, xnorm, bnorm = (float(v) for v in pstats)
+                if entry.anorm is None:
+                    from ..core.types import Norm
+                    from ..linalg.norms import norm as _norm
+                    entry.anorm = float(_norm(entry.A, Norm.Inf))
+                self._record_rho(
+                    entry, handle,
+                    _num.scaled_residual(rnorm, xnorm, bnorm,
+                                         entry.anorm), served)
             return X
+
+    def _record_rho(self, entry: _Operator, handle: Hashable,
+                    rho: float, k: int):
+        """Caller holds the lock and verified ``self.numerics``. One
+        sampled probe's scaled residual ρ = ‖b−Ax‖/(‖A‖·‖x‖+‖b‖):
+        histogram + counter + the probe gemm's model flops (a
+        dedicated ``numerics.probe`` ledger op and counter — probe
+        work is system work, so the tenant-conserving solve counters
+        never move), the ``residual``-kind SLO event, the monitor
+        record, and the health reflex on its transition."""
+        self.metrics.inc("residual_probes_total")
+        if np.isfinite(rho):
+            self.metrics.observe("sampled_residual", rho)
+        else:
+            # count, don't observe: one NaN in the histogram poisons
+            # sum/p99 forever and blinds the watchdog series (NaN
+            # compares false against any baseline) — the monitor's
+            # suspect sentinel is the alarm for this case
+            self.metrics.inc("numerics_nonfinite_total")
+        fl = _fl_grid(_flops_mod.gemm(entry.n, max(int(k), 1), entry.n))
+        self.metrics.inc("numerics_flops_total", fl)
+        self.metrics.inc("flops_total", fl)
+        _LEDGER.record("numerics.probe", fl)
+        if self.slo is not None:
+            self.slo.record_residual(rho)
+        old, new = self.numerics.record_residual(
+            handle, rho, work_dtype=_work_dtype_name(entry))
+        self._health_reflex(entry, handle, old, new)
+
+    def _record_small_probe(self, entry: _Operator, handle: Hashable,
+                            x: np.ndarray, b2: np.ndarray):
+        """Caller holds the lock and verified ``self.numerics``. The
+        small-op arm of the sampled probe: the operand is already
+        host-resident (the engine's [n, n] array) and n is small by
+        definition, so the residual is one host gemm — zero extra
+        device programs, bit-identical between the per-request and
+        grouped paths (both read the same solution bits, the
+        linalg/batched contract — the health-parity pin)."""
+        a = np.asarray(entry.A)
+        work = np.complex128 if np.iscomplexobj(a) else np.float64
+        aw = a.astype(work)
+        xw = np.asarray(x).astype(work)
+        bw = np.asarray(b2).astype(work)
+        if bw.ndim == 1:
+            # grouped 1-D rhs items arrive unsqueezed (and their
+            # solutions with them); the per-request twin records the
+            # (n, 1) view — same bits, same rho
+            bw = bw[:, None]
+        if xw.ndim == 1:
+            xw = xw[:, None]
+        r = bw - aw @ xw
+        if entry.anorm is None:
+            entry.anorm = float(np.abs(aw).sum(axis=1).max())
+        rho = _num.scaled_residual(
+            float(np.abs(r).max()), float(np.abs(xw).max()),
+            float(np.abs(bw).max()), entry.anorm)
+        self._record_rho(entry, handle, rho, bw.shape[1])
 
     def solve(self, handle: Hashable, b,
               served_cols: Optional[int] = None,
@@ -1317,7 +1811,14 @@ class Session:
         if self.slo is not None:
             self.slo.record_request(entry.op, entry.n, ph.elapsed,
                                     ok=True, source="solve", tenant=rt)
-        return np.asarray(x[0])
+        x0 = np.asarray(x[0])
+        # sampled probe, per-request small arm: one sampler decision
+        # per solve, in request order — the SAME stream the grouped
+        # dispatch consumes per item (health-parity pin)
+        if (self.numerics is not None and entry.refine is None
+                and self.numerics.sampler.decide()):
+            self._record_small_probe(entry, handle, x0, b2)
+        return x0
 
     def _solve_small_refined(self, handle: Hashable, entry: _Operator,
                              res: _Resident, b2: np.ndarray,
@@ -1355,6 +1856,9 @@ class Session:
         attr = self.attribution
         iters = int(np.asarray(its)[0])
         self.metrics.observe("refine_iterations", float(iters))
+        if self.numerics is not None:
+            o16, n16 = self.numerics.record_refine(handle, iters)
+            self._health_reflex(entry, handle, o16, n16)
         extra = iters * (_flops_mod.gemm(entry.n, k, entry.n)
                          + _solve_flops(entry.op, entry.m, entry.n, k,
                                         entry.band))
@@ -1652,6 +2156,15 @@ class Session:
                     for i in range(bsz):
                         self.metrics.observe("refine_iterations",
                                              float(its[i]))
+                        if self.numerics is not None:
+                            # per-item refine drift (round 16): the
+                            # grouped mixed bucket records the SAME
+                            # per-handle iteration stream B per-request
+                            # refined solves would
+                            o16, n16 = self.numerics.record_refine(
+                                handles[i], int(its[i]))
+                            self._health_reflex(entries[i], handles[i],
+                                                o16, n16)
                     kk = bstack.shape[2] if bstack.ndim == 3 else 1
                     # per-item refinement flops (iters_i × one step's
                     # residual gemm + factor apply, integer grid), so
@@ -1714,6 +2227,23 @@ class Session:
                             xi = _batched.potrs_batched(
                                 res_i.payload[0][None], bstack[i][None])
                         x[i] = np.asarray(jax.block_until_ready(xi))[0]
+            if self.numerics is not None and pol is None:
+                # sampled probe, grouped arm: one sampler decision per
+                # SUCCESSFUL item in request order (a failed item's
+                # per-request twin raises at the info check before its
+                # probe, consuming nothing — so the grouped arm must
+                # skip it too or every later decision shifts), the
+                # residual from the same host gemm the per-request
+                # probe runs on the same solution bits — parity pinned
+                xs_np = None
+                for i in range(bsz):
+                    if infos_req[i] != 0:
+                        continue
+                    if self.numerics.sampler.decide():
+                        if xs_np is None:
+                            xs_np = np.asarray(x)
+                        self._record_small_probe(entries[i], handles[i],
+                                                 xs_np[i], bstack[i])
             ex = getattr(ph.span, "trace_id", None)
             self.metrics.observe("stage_dispatch", t1 - t0, exemplar=ex)
             self.metrics.observe("stage_device_execute", t2 - t1,
@@ -1856,6 +2386,52 @@ class Session:
             (entry.op, entry.opts),
             lambda: _make_solve_fn(entry.op, entry.opts))
 
+    # -- sampled residual probe (round 16, obs/numerics.py) ----------------
+
+    def _probe_exe(self, entry: _Operator, handle: Hashable,
+                   args: Tuple):
+        """AOT-compiled fused solve+residual program for these shapes
+        → (exe, key) — the _refine_exe discipline: always analyzed, so
+        probed solves credit bytes/census per execution and the budget
+        sees the program's transient. Warmup precompiles the
+        (m, nrhs) shape; other logical rhs widths compile on their
+        first probed use (counted in ``aot_compiles`` — the fused
+        norms read the logical extent, so the program is genuinely
+        per-width, unlike the plain solve's jit fallback)."""
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = ("probe", entry.op, entry.opts, treedef, shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            fn = self._jit_cached(
+                ("probe", entry.op, entry.opts),
+                lambda: _make_probe_fn(entry.op, entry.opts))
+            exe = self._aot_compile("probe", entry, handle, fn, args,
+                                    key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        return exe, key
+
+    def _dispatch_probed(self, entry: _Operator, res: _Resident, B,
+                         handle: Hashable = None,
+                         served_cols: Optional[int] = None,
+                         tenant: Optional[str] = None):
+        """One PROBED dispatch: the serving solve fused with the
+        residual gemm and the (‖b−Ax‖, ‖x‖, ‖b‖) max-norm triple in
+        ONE program — exactly one gemm more than the plain solve
+        program (HLO-pinned by test), executed and credited like every
+        other served program. Returns (X, stats)."""
+        args = (res.payload, entry.A, B)
+        exe, key = self._probe_exe(entry, handle, args)
+        k = int(B.shape[1]) if getattr(B, "shape", None) else 0
+        wf = (0.0 if served_cols is None or not k
+              else (k - served_cols) / k)
+        self._credit_program(key, "serve.solve", waste_fraction=wf,
+                             tenant=tenant, handle=handle)
+        return exe(*args)
+
     # -- mixed-precision refined dispatch (round 13, slate_tpu/refine/) ----
 
     def _refine_exe(self, entry: _Operator, handle: Hashable, what: str,
@@ -1947,6 +2523,12 @@ class Session:
                             (lambda: bool(self._fault(
                                 "refine.converge")))))
         self.metrics.observe("refine_iterations", float(iters))
+        if self.numerics is not None:
+            # refine-iteration drift (round 16): rising iteration
+            # counts at fixed tolerance = u_f·κ grew — the
+            # conditioning-degradation proxy per handle
+            o16, n16 = self.numerics.record_refine(handle, iters)
+            self._health_reflex(entry, handle, o16, n16)
         # refinement-overhead model flops: iters residual gemms plus
         # iters factor applies (the useful one-solve model stays on
         # serve.solve — ledger split, ISSUE 10 observability)
@@ -2077,15 +2659,34 @@ class Session:
                 X0 = start_exe(res.payload, B)
                 self._refine_exe(entry, handle, "step",
                                  (res.payload, entry.A, B, X0))
+                if self.numerics is not None and entry.op == "lu":
+                    # the condest conjugate-transpose program at the
+                    # (n, 1) probe shape (nrhs=1 warmup covers it) —
+                    # so a warmed refined LU's condest adds no
+                    # request-path compiles
+                    self._condest_texe(entry, handle, res.payload, B)
                 return
             key = self._aot_key(entry, res.payload, B)
-            if key in self._compiled:
-                return
-            fn = self._solve_fn(entry)
-            self._compiled_put(
-                key, self._aot_compile("solve", entry, handle, fn,
-                                       (res.payload, B), key=key))
-            self.metrics.inc("aot_compiles")
+            if key not in self._compiled:
+                fn = self._solve_fn(entry)
+                self._compiled_put(
+                    key, self._aot_compile("solve", entry, handle, fn,
+                                           (res.payload, B), key=key))
+                self.metrics.inc("aot_compiles")
+            if self.numerics is not None:
+                # round 16: precompile the numerics programs off the
+                # request path — the fused solve+residual probe at
+                # THIS nrhs (the probe's fused norms read the logical
+                # width, so other widths compile, counted, on first
+                # probed use) and LU's condest transpose solve.
+                # Condest's forward applies reuse the solve executable
+                # compiled above (same shapes), so a warmed operator's
+                # condest adds ZERO compiles (mesh acceptance pin).
+                if entry.op in PROBE_OPS:
+                    self._probe_exe(entry, handle,
+                                    (res.payload, entry.A, B))
+                if entry.op == "lu":
+                    self._condest_texe(entry, handle, res.payload, B)
 
     def _aot_compile(self, what: str, entry: _Operator, handle: Hashable,
                      fn, args: Tuple, key: Optional[Hashable] = None):
@@ -2180,6 +2781,7 @@ class Session:
             if inc:
                 self.metrics.inc("residency_byte_seconds_total", inc)
         heat_rows = attr.heat_rows() if attr is not None else {}
+        nm = self.numerics
         rows = []
         for h, res in list(self._cache.items()):
             entry = self._ops.get(h)
@@ -2190,6 +2792,12 @@ class Session:
                      else A.dtype)
             hr = repr(h)
             heat, last = heat_rows.get(hr, (0.0, None))
+            # round-16 health columns: a placement policy must see
+            # what the numerics monitor sees (a hot-but-suspect
+            # resident is a replication candidate NOBODY should copy);
+            # null without a monitor — the disabled-path row shape
+            health, ce, gr = (nm.placement_info(h) if nm is not None
+                              else (None, None, None))
             rows.append({
                 "host": host,
                 "tenant": self.request_tenant(h),
@@ -2200,6 +2808,9 @@ class Session:
                 "bytes_per_chip": int(res.nbytes),
                 "heat": heat,
                 "last_access": last,
+                "health": health,
+                "condest": ce,
+                "growth": gr,
             })
         doc = {
             "schema": PLACEMENT_SCHEMA,
@@ -2227,6 +2838,21 @@ class Session:
         payload["placement"] = placement
         return payload
 
+    def numerics_payload(self) -> dict:
+        """The ``/numerics`` route payload: the monitor's per-handle
+        signal rows + state histogram + config, plus the session's
+        probe counters. ``{"enabled": false}`` without a monitor."""
+        if self.numerics is None:
+            return {"enabled": False, "handles": {}}
+        payload = self.numerics.snapshot()
+        payload["enabled"] = True
+        payload["counters"] = {k: self.metrics.get(k) for k in (
+            "condest_runs_total", "condest_solves_total",
+            "residual_probes_total", "numerics_flops_total",
+            "numerics_nonfinite_total", "health_transitions_total",
+            "health_demotions_total", "refine_demotions_total")}
+        return payload
+
     # -- observability endpoint --------------------------------------------
 
     def serve_obs(self, host: str = "127.0.0.1", port: int = 0):
@@ -2247,7 +2873,8 @@ class Session:
                     host=host, port=port,
                     slo=lambda: self.slo,
                     tenants=lambda: self.tenants_payload(),
-                    attribution=lambda: self.attribution)
+                    attribution=lambda: self.attribution,
+                    numerics=lambda: self.numerics_payload())
             return self._obs_server
 
     def close_obs(self):
@@ -2277,6 +2904,31 @@ def _make_factor_fn(op: str, opts: Options):
             return (api.qr_factor(A, opts),), jnp.zeros((), jnp.int32)
     factor.__name__ = f"serve_{op}_factor"
     return factor
+
+
+def _make_probe_fn(op: str, opts: Options):
+    """The fused solve+residual program (round 16): the op's
+    *_solve_using_factor verb PLUS one residual gemm (``api.multiply``
+    — hemm for Hermitian operands, gemm otherwise; under GSPMD a
+    sharded A partitions it with its collectives, so mesh probes stay
+    sharded end to end) and the stacked (‖b−Ax‖_max, ‖x‖_max,
+    ‖b‖_max) triple — so the host convergence read costs the one sync
+    the solve already pays (the refine-engine norm discipline)."""
+    import jax.numpy as jnp
+    solve = _make_solve_fn(op, opts)
+
+    def probe(payload, A, B):
+        X = solve(payload, B)
+        R = api.multiply(-1.0, A, X, 1.0, B, opts)
+        stats = jnp.stack([
+            jnp.max(jnp.abs(R.dense_canonical())),
+            jnp.max(jnp.abs(X.dense_canonical())),
+            jnp.max(jnp.abs(B.dense_canonical())),
+        ])
+        return X, stats
+
+    probe.__name__ = f"serve_{op}_probe"
+    return probe
 
 
 def _make_solve_fn(op: str, opts: Options):

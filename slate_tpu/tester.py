@@ -204,64 +204,13 @@ def _solve_err(ctx, a, x, b):
     return _rel(num, den)
 
 
-def _lu_growth(LU, a):
-    """Realized element-growth factor ‖L‖₁‖U‖₁/‖A‖₁ (clamped ≥ 1) of a
-    packed LU factor — the LAPACK residual normalization the pivoted LU
-    rows already use (‖b−Ax‖ ≲ ε·n·‖L‖‖U‖·‖x‖, test_gesv.cc). Round 6:
-    replaces the flat tol=1e4 escapes on the no-pivot rows — unbounded
-    growth scales the DENOMINATOR now, so a genuine solver regression
-    can no longer hide inside four orders of magnitude of slack."""
-    lu = _np64(LU.dense_canonical())
-    npad = lu.shape[0]
-    l = np.tril(lu, -1) + np.eye(npad)
-    u = np.triu(lu)
-    an = _np64(a)
-    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(u, 1)
-               / max(np.linalg.norm(an, 1), 1e-300))
-
-
-def _aasen_growth(LT, a):
-    """‖L‖₁‖T‖₁‖L‖₁/‖A‖₁ growth of an Aasen LTLᴴ factor (T tridiagonal
-    on the diag/subdiag, L multipliers shifted one column — the hetrs
-    unpacking). Same role as _lu_growth for the hetrf/hesv rows (the
-    round-5 on-chip sweep saw scaled error 7.62 at n=4096 pass only
-    because tol was a flat 100)."""
-    lt = _np64(LT.dense_canonical())
-    npad = lt.shape[0]
-    strict = np.tril(lt, -2)
-    lmat = np.pad(strict[:, :-1], ((0, 0), (1, 0))) + np.eye(npad)
-    d = np.real(np.diagonal(lt))
-    e = np.diagonal(lt, -1)
-    t = np.diag(d.astype(lt.dtype)) + np.diag(e, -1) + np.diag(e.conj(), 1)
-    an = _np64(a)
-    nl = np.linalg.norm(lmat, 1)
-    return max(1.0, nl * np.linalg.norm(t, 1) * nl
-               / max(np.linalg.norm(an, 1), 1e-300))
-
-
-def _chol_growth(L, a):
-    """‖L‖₁‖Lᴴ‖₁/‖A‖₁ growth of a (low-precision) Cholesky factor —
-    the mixed rows' bound normalization (round 13, ROADMAP item 2):
-    the refined solution's backward error is bounded through the
-    LOW-precision factor's realized norms, so the denominator must
-    carry them — a flat tol was blind to exactly the factor-precision
-    loss the refinement has to recover."""
-    l = np.tril(_np64(L.dense_canonical() if hasattr(L, "dense_canonical")
-                      else L))
-    an = _np64(a)
-    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(l.conj().T, 1)
-               / max(np.linalg.norm(an, 1), 1e-300))
-
-
-def _lu_growth_arr(lu, a):
-    """_lu_growth over a packed LU ARRAY (one item of a batched lo
-    factor stack)."""
-    lu = _np64(lu)
-    n = lu.shape[0]
-    l = np.tril(lu, -1) + np.eye(n)
-    u = np.triu(lu)
-    return max(1.0, np.linalg.norm(l, 1) * np.linalg.norm(u, 1)
-               / max(np.linalg.norm(_np64(a), 1), 1e-300))
+# growth-bound machinery: promoted to obs/numerics.py (round 16 —
+# the serving runtime's factor-time health signals and ROADMAP item
+# 2's update-vs-refactor bound read the SAME formulas), re-imported
+# here so the ~30 tester call sites keep their historical names.
+from slate_tpu.obs.numerics import (  # noqa: E402
+    aasen_growth as _aasen_growth, chol_growth as _chol_growth,
+    lu_growth as _lu_growth, lu_growth_arr as _lu_growth_arr)
 
 
 def _mixed_factor_dtype(ctx):

@@ -76,7 +76,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "serve": {"solves_per_sec": 120.0},
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
         "cost_log": [], "hbm": {}, "slo": {},
-        "tenants": _tenants_section()}
+        "tenants": _tenants_section(),
+        "numerics": _numerics_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -132,6 +133,22 @@ def test_normalize_legacy_multichip_blob(tmp_path):
     assert rec["ok"] is False and rec["metrics"] == {}
 
 
+def _numerics_section(state="healthy"):
+    """A minimal round-16 serve-artifact numerics section that passes
+    gate_mod._check_numerics_section."""
+    return {
+        "enabled": True,
+        "handles": {"1": {"op": "chol", "condest": 350.0,
+                          "growth": 1.4, "resid_ewma": 2.1e-7,
+                          "state": state}},
+        "counts": {"healthy": 1, "degraded": 0, "suspect": 0},
+        "counters": {"residual_probes_total": 12.0,
+                     "condest_runs_total": 1.0},
+        "sample_fraction": 0.25,
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -140,7 +157,8 @@ def _tenants_section(conservation_ok=True, rows=None):
             "host": "bench", "tenant": "bench-a", "handle": "1",
             "op": "chol", "n": 192, "dtype": "float32",
             "bytes_per_chip": 147456, "heat": 2.5,
-            "last_access": 1700000000.0}]
+            "last_access": 1700000000.0,
+            "health": "healthy", "condest": 350.0, "growth": 1.4}]
     return {
         "enabled": True, "halflife_s": 300.0,
         "per_tenant": {"bench-a": {"solve_flops": 1.0}},
@@ -163,7 +181,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "n": 192, "nb": 64, "requests": 48, "max_batch": 16,
         "serve": {"solves_per_sec": 120.0},
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
-        "cost_log": [], "hbm": {}, "slo": {}}
+        "cost_log": [], "hbm": {}, "slo": {},
+        "numerics": _numerics_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

@@ -1,0 +1,502 @@
+"""Numerical-health telemetry (round 16, obs/numerics.py + Session).
+
+The sensing layer for "never a wrong answer" in production: growth
+bounds promoted out of the tester (one source of truth), the
+Hager-Higham condest driven through the RESIDENT factor's own solve
+programs, deterministic sampled-residual probes, refine-iteration
+drift, and the healthy/degraded/suspect classification with counted
+reflexes (suspect handles demote off the refine ladder and lose
+eviction tie-breaks).
+
+Pinned here: condest within 10× of the true κ₁ on known-cond matgen
+operands across dtypes (in practice it lands within ~1%); the probed
+solve program carries EXACTLY one more gemm than the plain one (HLO)
+and an unprobed workload compiles zero probe programs; sampler
+determinism under a seed; grouped/batched ≡ per-request health parity;
+mesh condest with zero new compiles after warmup; the disabled path
+(numerics=None) allocating nothing — the round-8 assertion extended.
+Compile budget: everything at n ≤ 48 / single-panel nb (the standing
+tier-1 caveat); the mesh case rides the module-scoped conftest grid.
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.matgen import cond_targeted
+from slate_tpu.obs import numerics as num
+from slate_tpu.obs.attribution import (PLACEMENT_ROW_KEYS,
+                                       validate_placement_snapshot)
+from slate_tpu.refine import RefinePolicy
+from slate_tpu.runtime import Session
+
+RNG = np.random.default_rng(16)
+
+
+def _spd(n=32, dtype=np.float64, seed=1):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    return (a @ a.T + n * np.eye(n)).astype(dtype)
+
+
+# -- growth dedup (satellite: one source of truth) --------------------------
+
+
+def test_growth_machinery_single_source_of_truth():
+    """tester.py's growth functions ARE obs.numerics' (import
+    identity, not copies) — ROADMAP item 2's update-vs-refactor bound
+    and the serving health signals read the same formulas."""
+    from slate_tpu import tester
+    assert tester._chol_growth is num.chol_growth
+    assert tester._lu_growth is num.lu_growth
+    assert tester._lu_growth_arr is num.lu_growth_arr
+    assert tester._aasen_growth is num.aasen_growth
+
+
+def test_growth_values():
+    a = _spd(16)
+    l = np.linalg.cholesky(a)
+    g = num.chol_growth(l, a)
+    assert 1.0 <= g < 10.0  # SPD Cholesky: growth ~ 1
+    # identity factor of the identity: exactly the clamp
+    assert num.lu_growth(np.eye(8), np.eye(8)) == 1.0
+
+
+# -- the estimator loop -----------------------------------------------------
+
+
+def test_norm1est_exact_on_diagonal():
+    """For D = diag(1..n), ‖D⁻¹‖₁ = 1 and Hager finds it exactly."""
+    d = np.arange(1.0, 9.0)
+    solve = lambda x: x / d[:, None]
+    est, solves = num.norm1est(solve, solve, 8)
+    assert est == pytest.approx(1.0)
+    assert solves >= 2  # the crediting contract: solves are counted
+
+
+def test_scaled_residual_formula():
+    assert num.scaled_residual(0.0, 1.0, 1.0, 1.0) == 0.0
+    assert num.scaled_residual(2.0, 1.0, 1.0, 3.0) == pytest.approx(0.5)
+    assert num.scaled_residual(1.0, 0.0, 0.0, 1.0) == float("inf")
+
+
+# -- sampler determinism ----------------------------------------------------
+
+
+def test_sampler_deterministic_and_calibrated():
+    s1 = num.ResidualSampler(0.25, seed=7)
+    s2 = num.ResidualSampler(0.25, seed=7)
+    seq1 = [s1.decide() for _ in range(400)]
+    seq2 = [s2.peek(i) for i in range(400)]
+    assert seq1 == seq2  # decide() IS peek(i) in consumption order
+    frac = sum(seq1) / len(seq1)
+    assert 0.2 < frac < 0.3  # low-discrepancy: converges fast
+    assert num.ResidualSampler(1.0).decide() is True
+    assert num.ResidualSampler(0.0).decide() is False
+    # a different seed probes a different schedule
+    assert [num.ResidualSampler(0.25, seed=8).peek(i)
+            for i in range(400)] != seq2
+
+
+# -- condest through the resident factor ------------------------------------
+
+
+@pytest.mark.parametrize("op,dtype,cond", [
+    ("chol", np.float64, 1e8),
+    ("lu", np.float64, 1e8),
+    ("lu", np.float32, 1e4),
+])
+def test_condest_within_10x_of_truth(op, dtype, cond):
+    """The acceptance pin: condest on a known-cond matgen operand
+    reports within 10× of the true κ₁, via the resident factor,
+    credited per execution to the ledgers."""
+    from slate_tpu.obs.flops import LEDGER
+    n, nb = 32, 16
+    a = np.asarray(cond_targeted(n, cond, dtype=dtype, seed=3,
+                                 spd=(op == "chol")))
+    truth = float(np.linalg.cond(a.astype(np.float64), 1))
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0, condest_on_factor=False)
+    A = (st.hermitian(np.tril(a), nb=nb, uplo=st.Uplo.Lower)
+         if op == "chol" else st.from_dense(a, nb=nb))
+    h = sess.register(A, op=op)
+    led0 = LEDGER.snapshot()["per_op"].get("numerics.condest", 0.0)
+    est = sess.condest(h)
+    assert 0.1 * truth <= est <= 10.0 * truth
+    # probe work is credited: counters + the dedicated ledger op
+    assert sess.metrics.get("condest_runs_total") == 1
+    assert sess.metrics.get("condest_solves_total") >= 2
+    assert sess.metrics.get("numerics_flops_total") > 0
+    assert LEDGER.snapshot()["per_op"]["numerics.condest"] > led0
+    # recorded into the monitor + exported as a health gauge
+    assert sess.numerics.health(h) is not None
+    snap = sess.metrics.snapshot()
+    assert any(k.startswith("handle_health:") for k in snap["gauges"])
+
+
+def test_condest_small_ops():
+    """The *_small engine arm: chol_small through its B=1 bucket
+    program, lu_small's transpose solve host-side from the gathered
+    factor — both within 10× of the true κ₁."""
+    n = 16
+    for op, spd in (("chol_small", True), ("lu_small", False)):
+        a = np.asarray(cond_targeted(n, 1e6, dtype=np.float64, seed=5,
+                                     spd=spd))
+        truth = float(np.linalg.cond(a, 1))
+        sess = Session()
+        h = sess.register(np.ascontiguousarray(a), op=op)
+        est = sess.condest(h)
+        assert 0.1 * truth <= est <= 10.0 * truth, (op, est, truth)
+
+
+def test_condest_rejects_unsupported_ops():
+    sess = Session()
+    a = RNG.standard_normal((24, 12))
+    h = sess.register(st.from_dense(a, nb=12), op="qr")
+    with pytest.raises(SlateError, match="condest"):
+        sess.condest(h)
+
+
+# -- factor-time signals + health classification ----------------------------
+
+
+def test_factor_time_signals_healthy_operand():
+    a = _spd(32)
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0)
+    h = sess.register(st.hermitian(np.tril(a), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    sess.factor(h)  # growth + condest ride the factor (config default)
+    row = sess.numerics.snapshot()["handles"][repr(h)]
+    assert row["state"] == "healthy"
+    assert row["growth"] is not None and row["growth"] >= 1.0
+    assert row["condest"] is not None and row["condest"] > 0
+    assert sess.metrics.get("condest_runs_total") == 1
+
+
+def test_suspect_classification_and_placement_columns():
+    """A κ≈1e12 operand in f32: u·κ̂ is orders past the breakdown
+    point — suspect, and the state/condest/growth land on the
+    placement-snapshot row (schema v2)."""
+    a = np.asarray(cond_targeted(32, 1e12, dtype=np.float32, seed=5))
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0)
+    h = sess.register(st.from_dense(a, nb=16), op="lu")
+    sess.factor(h)
+    assert sess.numerics.health(h) == "suspect"
+    doc = sess.placement_snapshot(host="t")
+    assert validate_placement_snapshot(doc) == []
+    (row,) = doc["rows"]
+    assert set(PLACEMENT_ROW_KEYS) <= set(row)
+    assert row["health"] == "suspect"
+    assert row["condest"] > 0 and row["growth"] >= 1.0
+    # a bogus health value fails the committed validator
+    bad = json.loads(json.dumps(doc))
+    bad["rows"][0]["health"] = "fine"
+    assert any("health" in e for e in validate_placement_snapshot(bad))
+
+
+def test_suspect_demotion_reflex():
+    """The counted reflex: a suspect refined handle is demoted off the
+    refine ladder (refine_demotions_total AND health_demotions_total)
+    and the demoted solve still returns a residual-correct answer —
+    never silent, never wrong."""
+    a = np.asarray(cond_targeted(32, 1e12, dtype=np.float32, seed=5))
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0)
+    h = sess.register(st.from_dense(a, nb=16), op="lu",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    b = RNG.standard_normal(32).astype(np.float32)
+    x = sess.solve(h, b)
+    assert sess.numerics.health(h) == "suspect"
+    assert sess.metrics.get("refine_demotions_total") >= 1
+    assert sess.metrics.get("health_demotions_total") >= 1
+    assert sess._ops[h].refine is None  # off the ladder
+    resid = float(np.abs(a.astype(np.float64) @ x - b).max())
+    assert resid / (32 * max(1.0, float(np.abs(x).max()))) < 1e-3
+
+
+def test_eviction_prefers_suspect_handles():
+    """Suspect residents lose eviction tie-breaks: with both factors
+    resident and the suspect one MOST recently used, a budget squeeze
+    still evicts the suspect factor first."""
+    good = _spd(32, np.float32, seed=2).astype(np.float32)
+    badm = np.asarray(cond_targeted(32, 1e12, dtype=np.float32, seed=5))
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0)
+    hg = sess.register(st.hermitian(np.tril(good), nb=16,
+                                    uplo=st.Uplo.Lower), op="chol")
+    hb = sess.register(st.from_dense(badm, nb=16), op="lu")
+    sess.factor(hg)
+    sess.factor(hb)  # suspect AND most-recently-used
+    assert sess.numerics.health(hb) == "suspect"
+    assert set(sess.cached_handles()) == {hg, hb}
+    sess.hbm_budget = sess._cache[hg].nbytes + 1  # room for one
+    sess._evict_to_budget(keep=hg)
+    assert sess.cached_handles() == [hg]  # LRU alone would keep hb
+
+
+# -- sampled residual probes ------------------------------------------------
+
+
+def test_probe_program_adds_exactly_one_gemm_hlo():
+    """The acceptance pin, structurally: the probed solve program's
+    optimized HLO carries EXACTLY one more dot than the plain solve
+    program (the residual gemm — the norms are reductions, not
+    contractions), for both lu and chol."""
+    n = nb = 32
+    ge = RNG.standard_normal((n, n)) + n * np.eye(n)
+    spd = _spd(n)
+    for op, A in (("lu", st.from_dense(ge, nb=nb)),
+                  ("chol", st.hermitian(np.tril(spd), nb=nb,
+                                        uplo=st.Uplo.Lower))):
+        sess = Session()
+        sess.enable_numerics(sample_fraction=1.0)
+        h = sess.register(A, op=op)
+        sess.warmup(h)  # compiles factor + solve + probe (+ condest_t)
+        probe = solve = None
+        for key, exe in sess._compiled.items():
+            if key[0] == "probe":
+                probe = exe
+            elif key[0] not in ("factor", "condest_t"):
+                solve = exe
+        assert probe is not None and solve is not None
+        pd = probe.as_text().count("dot(")
+        sd = solve.as_text().count("dot(")
+        assert pd == sd + 1, (op, pd, sd)
+
+
+def test_unprobed_workload_compiles_zero_probe_programs():
+    """fraction=0.0: the sampler consumes decisions but every solve
+    runs the PLAIN program — no probe compile, no probe counters."""
+    a = _spd(32)
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0, condest_on_factor=False)
+    h = sess.register(st.hermitian(np.tril(a), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    for _ in range(4):
+        sess.solve(h, RNG.standard_normal(32))
+    assert sess.metrics.get("residual_probes_total") == 0
+    assert not any(k[0] == "probe" for k in sess._compiled)
+    assert not any(r["what"] == "probe" for r in sess.compile_log)
+    assert sess.numerics.sampler.consumed == 4  # stream still advances
+
+
+def test_probe_records_residual_and_slo():
+    from slate_tpu.obs.slo import Objective
+    a = _spd(32)
+    sess = Session()
+    sess.enable_slo((Objective("resid", "residual", 0.9,
+                               threshold_s=1e-2),))
+    sess.enable_numerics(sample_fraction=1.0, condest_on_factor=False)
+    h = sess.register(st.hermitian(np.tril(a), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    for _ in range(3):
+        sess.solve(h, RNG.standard_normal(32))
+    assert sess.metrics.get("residual_probes_total") == 3
+    row = sess.numerics.snapshot()["handles"][repr(h)]
+    assert row["resid_count"] == 3
+    assert 0 <= row["resid_ewma"] < 1e-10  # f64 SPD: ~eps
+    assert row["state"] == "healthy"
+    hist = sess.metrics.snapshot()["histograms"]["sampled_residual"]
+    assert hist["count"] == 3
+    # the residual SLO stream computed a burn rate (all good here)
+    (obj,) = sess.slo.evaluate()["objectives"]
+    assert obj["kind"] == "residual"
+    assert any(w["burn_rate"] == 0.0 for w in obj["windows"])
+
+
+def test_residual_slo_objective_burns_on_bad_probes():
+    from slate_tpu.obs.slo import Objective, SloTracker
+    t = [0.0]
+    tr = SloTracker((Objective("resid", "residual", 0.9,
+                               threshold_s=1e-6, windows=(60.0,)),),
+                    clock=lambda: t[0])
+    for rho in (1e-9, 1e-9, 1e-3, 1e-3):  # 2 good, 2 over threshold
+        tr.record_residual(rho)
+    (row,) = tr.evaluate()["objectives"]
+    (w,) = row["windows"]
+    assert w["total"] == 4 and w["bad"] == 2
+    assert w["burn_rate"] == pytest.approx(0.5 / 0.1)
+
+
+def test_residual_objective_requires_threshold():
+    from slate_tpu.obs.slo import Objective
+    with pytest.raises(ValueError, match="threshold"):
+        Objective("r", "residual", 0.9)
+
+
+# -- grouped/batched ≡ per-request parity -----------------------------------
+
+
+def test_grouped_vs_per_request_health_parity():
+    """The same operands, the same request stream, the same sampler
+    seed: the grouped dispatch must record bit-identical residual
+    signals (same solution bits — the linalg/batched contract — and
+    the same host gemm) and land every handle in the same state."""
+    n = 16
+    mats = [np.ascontiguousarray(
+        RNG.standard_normal((n, n)) + n * np.eye(n))
+        for _ in range(4)]
+    rhs = [np.ascontiguousarray(RNG.standard_normal((n, 1)))
+           for _ in range(4)]
+
+    def build():
+        sess = Session()
+        sess.enable_numerics(sample_fraction=1.0, sample_seed=9,
+                             condest_on_factor=False)
+        hs = [sess.register(m, op="lu_small") for m in mats]
+        for h in hs:
+            sess.factor(h)  # identical factor-time signals both sides
+        return sess, hs
+
+    s1, h1 = build()
+    for h, b in zip(h1, rhs):
+        s1.solve(h, b)
+    s2, h2 = build()
+    s2.solve_small_batched(h2, rhs)
+    r1 = s1.numerics.snapshot()["handles"]
+    r2 = s2.numerics.snapshot()["handles"]
+    assert list(r1) == list(r2)
+    for k in r1:
+        assert r1[k]["resid_last"] == r2[k]["resid_last"], k  # bit-equal
+        assert r1[k]["resid_count"] == r2[k]["resid_count"] == 1
+        assert r1[k]["state"] == r2[k]["state"]
+    assert (s1.metrics.get("residual_probes_total")
+            == s2.metrics.get("residual_probes_total") == 4)
+
+
+# -- mesh: zero new compiles after warmup -----------------------------------
+
+
+def test_mesh_condest_zero_new_compiles_after_warmup(grid2x2):
+    """Mesh acceptance pin: the condest probe drives the SAME analyzed
+    sharded solve program the serving path warmed up — a warmed mesh
+    operator's condest adds zero compiles and credits the collective
+    census per apply. n=32 single-panel scale (the standing tier-1
+    compile-budget caveat)."""
+    from slate_tpu.obs import costs as costs_mod
+    n, nb = 32, 16
+    spd = _spd(n)
+    sess = Session(mesh=grid2x2)
+    sess.enable_numerics(sample_fraction=0.0, condest_on_factor=False)
+    h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                   uplo=st.Uplo.Lower), op="chol")
+    sess.warmup(h)
+    compiles0 = (sess.metrics.get("aot_compiles")
+                 + sess.metrics.get("factor_aot_compiles"))
+    log0 = len(sess.compile_log)
+    bytes0 = costs_mod.BYTES.snapshot()["per_op"].get(
+        "numerics.condest", {}).get("bytes", 0.0)
+    est = sess.condest(h)
+    truth = float(np.linalg.cond(spd, 1))
+    assert 0.1 * truth <= est <= 10.0 * truth
+    assert (sess.metrics.get("aot_compiles")
+            + sess.metrics.get("factor_aot_compiles")) == compiles0
+    assert len(sess.compile_log) == log0
+    # per-execution crediting: the probe applies moved the bytes
+    # ledger under the numerics.condest op
+    assert costs_mod.BYTES.snapshot()["per_op"].get(
+        "numerics.condest", {}).get("bytes", 0.0) >= bytes0
+
+
+# -- disabled path: the round-8 zero-allocation pin, extended ---------------
+
+
+def test_disabled_path_zero_allocation_extended():
+    """Session without numerics: zero numerics counters, gauges,
+    histograms, compile-log rows, and no monitor state — the hot
+    path's only new cost is `numerics is None` checks."""
+    a = _spd(32)
+    sess = Session()
+    assert sess.numerics is None
+    h = sess.register(st.hermitian(np.tril(a), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    for _ in range(3):
+        sess.solve(h, RNG.standard_normal(32))
+    snap = sess.metrics.snapshot()
+    for k in snap["counters"]:
+        assert not k.startswith(("condest_", "residual_probes",
+                                 "numerics_", "health_")), k
+    assert not any(k.startswith(("handle_health", "handles_su",
+                                 "handles_de")) for k in snap["gauges"])
+    assert "sampled_residual" not in snap["histograms"]
+    assert not any(k[0] in ("probe", "condest_t") for k in sess._compiled)
+    assert sess.numerics_payload() == {"enabled": False, "handles": {}}
+
+
+def test_unregister_forgets_health_row_and_gauge():
+    a = _spd(32)
+    sess = Session()
+    sess.enable_numerics(sample_fraction=0.0)
+    h = sess.register(st.hermitian(np.tril(a), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    sess.factor(h)
+    assert any(k.startswith("handle_health:")
+               for k in sess.metrics.snapshot()["gauges"])
+    sess.unregister(h)
+    assert not any(k.startswith("handle_health:")
+                   for k in sess.metrics.snapshot()["gauges"])
+    assert sess.numerics.snapshot()["handles"] == {}
+
+
+# -- refine drift -----------------------------------------------------------
+
+
+def test_refine_drift_flags_degraded():
+    m = num.NumericsMonitor(num.NumericsConfig(
+        ewma_alpha=1.0, refine_drift_degraded=4.0))
+    h = "h"
+    m.record_factor(h, "chol", "float32", factor_dtype="bfloat16")
+    for _ in range(3):
+        old, new = m.record_refine(h, 2)  # floor = 2
+    assert new == "healthy"
+    old, new = m.record_refine(h, 9)  # 9 > 4 x floor
+    assert new == "degraded"
+    assert old == "healthy"
+
+
+def test_nonfinite_is_suspect():
+    m = num.NumericsMonitor()
+    _, new = m.record_factor("h", "lu", "float32",
+                             growth=float("inf"))
+    assert new == "suspect"
+    m2 = num.NumericsMonitor()
+    _, new2 = m2.record_residual("h", float("nan"))
+    assert new2 == "suspect"
+
+
+# -- matgen satellite -------------------------------------------------------
+
+
+def test_cond_targeted_matgen():
+    for spd in (True, False):
+        a = np.asarray(cond_targeted(24, 1e6, dtype=np.float64,
+                                     seed=7, spd=spd))
+        k2 = float(np.linalg.cond(a, 2))
+        assert 0.5e6 < k2 < 2e6, (spd, k2)
+        if spd:
+            assert np.allclose(a, a.T)
+            assert np.linalg.eigvalsh(a).min() > 0
+
+
+# -- mirrors ----------------------------------------------------------------
+
+
+def test_health_states_mirror_pinned():
+    """bench_gate's jax-free HEALTH_STATES mirror must equal the
+    obs.numerics vocabulary (the PLACEMENT_ROW_KEYS pin discipline)."""
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).resolve().parents[1] / "tools"
+            / "bench_gate.py")
+    spec = importlib.util.spec_from_file_location("_bg", str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert tuple(mod.HEALTH_STATES) == tuple(num.HEALTH_STATES)
+    from slate_tpu.obs.attribution import _HEALTH_STATES
+    assert tuple(_HEALTH_STATES) == tuple(num.HEALTH_STATES)

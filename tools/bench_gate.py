@@ -99,15 +99,20 @@ GATED_PLATFORMS = ("tpu", "axon")
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants")
+    "tenants", "numerics")
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
 # round-15 placement-snapshot row shape --check-schema holds the
-# committed serve fixture's tenants section to
-PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v1"
+# committed serve fixture's tenants section to. v2 (round 16) adds
+# the numerical-health columns (health/condest/growth, nullable).
+PLACEMENT_SCHEMA = "slate_tpu.placement_snapshot.v2"
 PLACEMENT_ROW_KEYS = ("host", "tenant", "handle", "op", "n", "dtype",
-                      "bytes_per_chip", "heat", "last_access")
+                      "bytes_per_chip", "heat", "last_access",
+                      "health", "condest", "growth")
+# mirror of obs/numerics.py HEALTH_STATES (tests pin them equal): the
+# vocabulary the round-16 numerics section's states must come from
+HEALTH_STATES = ("healthy", "degraded", "suspect")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -367,6 +372,30 @@ def _check_tenants_section(name: str, section) -> None:
                     f"{name}: tenants.placement.rows[{i}] missing {k!r}")
 
 
+def _check_numerics_section(name: str, section) -> None:
+    """Validate the round-16 serve-artifact ``numerics`` section:
+    per-handle health rows whose states come from the committed
+    vocabulary, the probe counters, and the exit-gated verdict."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: numerics section is not an object")
+    for k in ("enabled", "handles", "counters", "ok"):
+        if k not in section:
+            raise SchemaError(f"{name}: numerics section missing {k!r}")
+    handles = section["handles"]
+    if not isinstance(handles, dict):
+        raise SchemaError(f"{name}: numerics.handles not an object")
+    for h, row in handles.items():
+        if not isinstance(row, dict) or "state" not in row:
+            raise SchemaError(
+                f"{name}: numerics.handles[{h!r}] missing 'state'")
+        if row["state"] not in HEALTH_STATES:
+            raise SchemaError(
+                f"{name}: numerics.handles[{h!r}].state "
+                f"{row['state']!r} not one of {HEALTH_STATES}")
+    if not isinstance(section["counters"], dict):
+        raise SchemaError(f"{name}: numerics.counters not an object")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -395,6 +424,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
                     "(stale smoke fixture? regenerate with "
                     "bench_serve.py --regen-smoke)")
         _check_tenants_section(name, obj["tenants"])
+        _check_numerics_section(name, obj["numerics"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),

@@ -35,7 +35,10 @@ Breaker/degradation drills run as separate deterministic phases (rate
 1.0, count-limited plans) so the circuit breaker, the
 grouped→per_request and mixed→working_precision ladder rungs, and
 admission control + load shedding are each exercised every run, not
-probabilistically.
+probabilistically. Round 16 adds the numerics drill: a cond≈1e12
+matgen operand under a bf16 refine policy must be flagged SUSPECT by
+the resident-factor condest, demoted to working precision (counted),
+and still serve a residual-correct answer.
 
 Writes the committed ``CHAOS_r*.json`` artifact (validated by
 ``tools/bench_gate.py --check-schema``); ``--smoke`` is the
@@ -406,12 +409,74 @@ def run_shed_drill(seed):
     }
 
 
+def run_numerics_drill(seed):
+    """Numerical-health reflex drill (round 16): a matgen operand with
+    κ₂ ≈ 1e12 — four orders past f32's breakdown point, six past
+    bf16's — registers under a bf16 refine policy with the numerics
+    monitor on. The factor-time condest probe (driven through the
+    RESIDENT bf16 factor) must flag the handle SUSPECT, the health
+    reflex must demote it off the refine ladder (counted in BOTH
+    ``refine_demotions_total`` and ``health_demotions_total``), the
+    demoted solve must run at working precision and return a
+    residual-correct answer (backward error is what a stable LU owes
+    regardless of conditioning — forward error at κ=1e12 in f32 is
+    physics, not a bug), and the suspect state must survive into the
+    placement snapshot's round-16 health column. Deterministic: the
+    operand is seeded matgen, the sampler is seeded, and the condest
+    estimate is a pure function of the factor bits."""
+    from slate_tpu.matgen import cond_targeted
+    from slate_tpu.refine import RefinePolicy
+    from slate_tpu.runtime import Session
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 4)
+    n, nb = 32, 16
+    a = np.asarray(cond_targeted(n, 1e12, dtype=np.float32,
+                                 seed=seed + 4, spd=False))
+    sess = Session()
+    sess.enable_numerics(sample_fraction=1.0, sample_seed=seed)
+    h = sess.register(st.from_dense(a, nb=nb), op="lu",
+                      refine=RefinePolicy(factor_dtype="bfloat16"))
+    wrong = completed = 0
+    for _ in range(3):
+        b = rng.standard_normal(n).astype(np.float32)
+        x = sess.solve(h, b)
+        completed += 1
+        if _check_residual(a, x, b) > RESID_TOL:
+            wrong += 1
+    g = sess.metrics.get
+    health = sess.numerics.health(h)
+    rows = sess.placement_snapshot(host="drill")["rows"]
+    placement_health = rows[0]["health"] if rows else None
+    entry_refine_off = sess._ops[h].refine is None
+    cons = _conservation(sess.metrics)
+    return {
+        "conservation": cons,
+        "wrong_answers": wrong, "lost_futures": 0,
+        "completed": completed,
+        "health": health,
+        "placement_health": placement_health,
+        "condest": sess.numerics.snapshot()["handles"][repr(h)]["condest"],
+        "refine_demotions": g("refine_demotions_total"),
+        "health_demotions": g("health_demotions_total"),
+        "residual_probes": g("residual_probes_total"),
+        "ok": (wrong == 0 and completed == 3 and cons["ok"]
+               and health == "suspect"
+               and placement_health == "suspect"
+               and entry_refine_off
+               and g("refine_demotions_total") >= 1
+               and g("health_demotions_total") >= 1
+               and g("residual_probes_total") >= 1),
+    }
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
     drill, inj_b = run_breaker_drill(seed)
     mixed, inj_m = run_mixed_drill(seed)
     shed = run_shed_drill(seed)
+    numerics = run_numerics_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
                            for i in (inj, inj_b, inj_m)),
@@ -420,7 +485,8 @@ def run_all(seed, waves):
         "opportunities": inj.opportunity_counts(),
     }
     return {"soak": soak, "breaker_drill": drill,
-            "mixed_drill": mixed, "shed_drill": shed}, schedule
+            "mixed_drill": mixed, "shed_drill": shed,
+            "numerics_drill": numerics}, schedule
 
 
 def main(argv=None):
@@ -467,6 +533,9 @@ def main(argv=None):
         "slo_consistent": phases["soak"]["slo"]["ok"],
         "fleet_fold_ok": phases["soak"]["fleet_fold"]["ok"],
         "schedule_reproducible": reproducible,
+        # round 16: the cond~1e12 operand was flagged suspect, demoted
+        # off the refine ladder (counted), and still answered correctly
+        "numerics_suspect_demoted": phases["numerics_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0

@@ -97,10 +97,17 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     A = st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower)
 
     sess = Session(tracer=tracer)
-    # round 12: SLO tracking on (default objectives) — the served
-    # workload below feeds the request/cache/oom streams the /slo
-    # payload evaluates
-    sess.enable_slo()
+    # round 12: SLO tracking on — default objectives PLUS the round-16
+    # residual objective, so the sampled probes below feed a
+    # residual-kind burn rate the /slo payload must evaluate
+    from slate_tpu.obs.slo import Objective, default_objectives
+    sess.enable_slo(default_objectives() + (
+        Objective("sampled_residual", "residual", 0.99,
+                  threshold_s=1e-2),))
+    # round 16: numerical-health telemetry with a probe-every-solve
+    # sampler (deterministic) — the handle_health gauges, /numerics
+    # payload, and probe counters below are exit-gated
+    sess.enable_numerics(sample_fraction=1.0, sample_seed=12)
     # round 15: tenant attribution on BEFORE any traffic (the
     # conservation check below compares per-tenant sums against the
     # session-lifetime global counters, so every credited event must
@@ -434,6 +441,57 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         if "tenant-a" not in pl_fleet["per_tenant"]:
             fails.append("fleet placement rollup missing tenant-a")
 
+        # -- numerical-health telemetry (round 16) ----------------------
+        # the served SPD workload above ran with a probe-every-solve
+        # sampler and factor-time condest: the handle_health gauge
+        # rows must be in the Prometheus text, the /numerics payload
+        # must carry the handle's signals (healthy — the operand is
+        # well-conditioned by construction), the probe/condest
+        # counters must have moved, and the residual SLO objective
+        # must have computed a burn rate over the probe stream
+        npay = sess.numerics_payload()
+        with open(os.path.join(out_dir, "numerics.json"), "w") as f:
+            json.dump(npay, f, indent=2, sort_keys=True)
+            f.write("\n")
+        if not npay.get("enabled") or not npay.get("handles"):
+            fails.append("numerics payload empty after a served probed "
+                         "workload")
+        else:
+            hrow = next(iter(npay["handles"].values()))
+            if hrow["state"] != "healthy":
+                fails.append("well-conditioned operand classified "
+                             f"{hrow['state']!r}, not healthy")
+            if not hrow.get("condest"):
+                fails.append("numerics payload missing the factor-time "
+                             "condest")
+            if not hrow.get("resid_count"):
+                fails.append("numerics payload recorded no sampled "
+                             "residuals")
+        ncnt = npay.get("counters", {})
+        for c in ("residual_probes_total", "condest_runs_total",
+                  "condest_solves_total"):
+            if not ncnt.get(c):
+                fails.append(f"numerics counter {c} did not move")
+        nprom = obs.render_prometheus(sess.metrics, ledger=False,
+                                      bytes_ledger=False)
+        for needle in ("slate_tpu_handle_health",
+                       "slate_tpu_sampled_residual",
+                       "slate_tpu_residual_probes_total"):
+            if needle not in nprom:
+                fails.append(f"prometheus text missing {needle}")
+        if obs.flops.LEDGER.snapshot()["per_op"].get(
+                "numerics.condest", 0) <= 0:
+            fails.append("process ledger has no numerics.condest op "
+                         "(probe work must be credited, not free)")
+        slo_rows2 = sess.slo.evaluate()["objectives"]
+        rrow = next((o for o in slo_rows2
+                     if o["kind"] == "residual"), None)
+        if rrow is None:
+            fails.append("/slo payload missing the residual objective")
+        elif not any(w["burn_rate"] is not None for w in rrow["windows"]):
+            fails.append("residual SLO objective computed no burn rate "
+                         "over the probe stream")
+
         # -- 2-process aggregation (tentpole d) -------------------------
         # same-snapshot fold: the acceptance's bit-exactness check —
         # merging a snapshot with itself must exactly double every
@@ -475,7 +533,8 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         for path, needle in (("/metrics", "slate_tpu_solves_total"),
                              ("/healthz", '"status": "ok"'),
                              ("/trace.json", "traceEvents"),
-                             ("/slo", '"objectives"')):
+                             ("/slo", '"objectives"'),
+                             ("/numerics", '"handles"')):
             body = urllib.request.urlopen(srv.url(path),
                                           timeout=10).read().decode()
             if needle not in body:
