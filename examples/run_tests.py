@@ -146,6 +146,20 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --overload FAILED")
+    # failover A/B smoke (round 17): kill a fleet member and recover —
+    # replication+checkpoint must recover every affected handle with
+    # zero refactors while the cold arm pays one per handle (the
+    # chaos recovery drill above already exit-gates the fault-injected
+    # ladder; this gates the measured A/B artifact)
+    print("=== bench_serve.py --failover --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--failover", "--smoke",
+         "--failover-out", "/tmp/BENCH_FAILOVER_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --failover FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure —

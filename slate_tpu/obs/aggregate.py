@@ -44,7 +44,7 @@ __all__ = [
     "merge_bytes_snapshots",
     "merge_flop_snapshots", "merge_histograms",
     "merge_metrics_snapshots", "merge_placement_snapshots",
-    "aggregate_processes",
+    "aggregate_processes", "placement_from_checkpoint",
     "render_fleet_prometheus", "write_fleet",
 ]
 
@@ -197,9 +197,19 @@ def merge_attribution_snapshots(snaps: Sequence[dict]) -> dict:
     sides of the invariant shrink consistently). ``heat`` is summed
     across processes (a replicated handle's fleet heat is its total
     access rate — the replication signal); ``last_access`` takes the
-    newest."""
-    snaps = list(snaps)  # a generator must not be consumed before the
-    tenants: Dict[str, dict] = {}  # "processes" count below
+    newest.
+
+    **Partial hosts (round 17):** ``None`` entries — a host inside the
+    crash window whose live attribution snapshot is gone while its
+    checkpoint survives — are tolerated: they are skipped (their cells
+    died with the process, exactly like their global counters did, so
+    conservation over the SURVIVING snapshots still holds) and counted
+    in ``partial_processes``. Before this, only the all-or-nothing
+    ``snapshot_drop`` case (both sides absent) was pinned."""
+    raw = list(snaps)  # a generator must not be consumed before the
+    snaps = [s for s in raw if s]  # "processes" count below
+    partial = len(raw) - len(snaps)
+    tenants: Dict[str, dict] = {}
     halflife = None
     for s in snaps:
         if halflife is None:
@@ -229,10 +239,71 @@ def merge_attribution_snapshots(snaps: Sequence[dict]) -> dict:
         "schema": "slate_tpu.attribution.v1",
         "fleet": True,
         "processes": len(snaps),
+        "partial_processes": partial,
         "halflife_s": halflife,
         "tenants": tenants,
         "totals": totals,
     }
+
+
+def placement_from_checkpoint(manifest: dict,
+                              host: Optional[str] = None) -> dict:
+    """A checkpoint manifest (runtime/checkpoint.py,
+    ``slate_tpu.checkpoint.v1``) -> a placement-snapshot-SHAPED doc for
+    the fleet fold: the crash-window bridge. When a process dies its
+    live ``placement_snapshot()`` is gone, but its last checkpoint
+    records the same per-resident rows (op/n/dtype/bytes/heat/health),
+    so the fold need not go blind on that host — the derived doc is
+    marked ``"partial": True`` and ``merge_placement_snapshots``
+    surfaces it under ``partial_hosts``. ``bytes_per_chip`` for a mesh
+    resident is the checkpoint's TOTAL gathered bytes (the checkpoint
+    is placement-independent); live rows stay the per-chip truth."""
+    host = host or str(manifest.get("host", "checkpoint"))
+    rows = []
+    for rec in manifest.get("records", []):
+        if not isinstance(rec, dict):
+            continue
+        payload_bytes = _node_nbytes(rec.get("payload"))
+        health = rec.get("health") or {}
+        hrep = (repr(str(rec.get("handle")))
+                if rec.get("handle_type") == "str"
+                else str(rec.get("handle")))
+        rows.append({
+            "host": host,
+            "tenant": str(rec.get("tenant") or "default"),
+            "handle": hrep,
+            "op": str(rec.get("op", "")),
+            "n": int(rec.get("n", 0)),
+            "dtype": str(rec.get("dtype", "")),
+            "bytes_per_chip": int(payload_bytes),
+            "heat": float(rec.get("heat") or 0.0),
+            "last_access": rec.get("last_access"),
+            "health": health.get("state"),
+            "condest": health.get("condest"),
+            "growth": health.get("growth"),
+        })
+    return {
+        "schema": "slate_tpu.placement_snapshot.v2",
+        "host": host,
+        "generated_at": manifest.get("generated_at"),
+        "partial": True,
+        "rows": rows,
+    }
+
+
+def _node_nbytes(desc) -> int:
+    """Total blob bytes under one checkpoint node descriptor (pure
+    manifest walk — this module is stdlib-only, so the byte count
+    comes from the recorded ``nbytes`` fields, not numpy)."""
+    if not isinstance(desc, dict):
+        return 0
+    if desc.get("type") == "tuple":
+        return sum(_node_nbytes(d) for d in desc.get("items", []))
+    total = 0
+    for v in desc.values():
+        if isinstance(v, dict) and "nbytes" in v:
+            total += int(v.get("nbytes", 0) or 0)
+    return total
 
 
 def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
@@ -243,11 +314,19 @@ def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
     tenant across the fleet — the numbers a quota/placement policy
     reads first. Rows sort by (tenant, heat desc) so the hottest
     handles lead each tenant's slice."""
-    docs = list(docs)
+    docs = [d for d in docs if d]  # round 17: tolerate absent hosts
     rows = []
     hosts = []
+    partial_hosts = []
     for doc in docs:
-        hosts.append(doc.get("host", f"proc{len(hosts)}"))
+        h = doc.get("host", f"proc{len(hosts)}")
+        hosts.append(h)
+        if doc.get("partial"):
+            # round 17: a checkpoint-derived doc for a host inside the
+            # crash window (live snapshot gone, checkpoint survives) —
+            # its rows join the fold, labeled so a placement policy
+            # can discount their staleness
+            partial_hosts.append(h)
         rows.extend(dict(r) for r in doc.get("rows", []))
     rows.sort(key=lambda r: (str(r.get("tenant", "")),
                              -float(r.get("heat", 0.0) or 0.0),
@@ -271,6 +350,7 @@ def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
         "schema": "slate_tpu.fleet_placement.v1",
         "hosts": hosts,
         "processes": len(docs),
+        "partial_hosts": partial_hosts,
         "rows": rows,
         "per_tenant": per_tenant,
     }

@@ -277,6 +277,39 @@ class AttributionLedger:
             prev = self._heat.get(repr(handle))
             return None if prev is None else prev[3]
 
+    def export_heat(self, handle: Hashable,
+                    now: Optional[float] = None) -> Optional[dict]:
+        """One handle's heat state for a checkpoint record (round 17):
+        ``{"tenant", "heat", "last_access"}`` with the heat decayed to
+        now — the wall-clock ``last_access`` makes the row portable
+        across processes. None if the handle was never accessed."""
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        with self._lock:
+            prev = self._heat.get(h)
+            if prev is None:
+                return None
+            tenant, heat, last, wall = prev
+            return {"tenant": tenant,
+                    "heat": self._decayed(heat, now - last),
+                    "last_access": wall}
+
+    def import_heat(self, handle: Hashable, heat: float,
+                    tenant=None, last_access: Optional[float] = None,
+                    now: Optional[float] = None):
+        """Seed a handle's heat state from a checkpoint record (round
+        17 restore): the imported value starts decaying from ``now``
+        on this process's monotonic clock, and the recorded wall-clock
+        ``last_access`` is kept so fleet placement rows stay
+        comparable across the restart."""
+        tenant = _tname(tenant)
+        h = repr(handle)
+        now = self._clock() if now is None else now
+        wall = self._wall() if last_access is None else float(last_access)
+        with self._lock:
+            self._heat[h] = (tenant, float(heat), now, wall)
+        self._publish_heat(tenant, h, float(heat))
+
     def heat_rows(self, now: Optional[float] = None
                   ) -> Dict[str, Tuple[float, Optional[float]]]:
         """One locked pass over every handle's heat state:

@@ -556,6 +556,45 @@ class NumericsMonitor:
             "config": dataclasses.asdict(self.config),
         }
 
+    # -- checkpoint carryover (round 17) ------------------------------------
+
+    # every _HandleStats field a checkpoint record round-trips (gauge
+    # is rebuilt at import; state is re-derived and pinned equal)
+    _EXPORT_FIELDS = ("op", "work_dtype", "factor_dtype", "tenant",
+                      "condest", "growth", "nonfinite", "resid_ewma",
+                      "resid_last", "resid_max", "resid_count",
+                      "refine_ewma", "refine_floor", "refine_count",
+                      "state")
+
+    def export_state(self, handle: Hashable) -> Optional[dict]:
+        """One handle's full signal state for a checkpoint record —
+        classification is a pure function of these fields, so a
+        restored handle re-derives the SAME health state (a suspect
+        handle stays suspect across the restart, the round-17
+        carryover pin). None for untracked handles."""
+        with self._lock:
+            s = self._handles.get(repr(handle))
+            if s is None:
+                return None
+            return {k: getattr(s, k) for k in self._EXPORT_FIELDS}
+
+    def import_state(self, handle: Hashable, d: dict) -> Tuple[str, str]:
+        """Seed a handle's signal state from a checkpoint record
+        (round-17 restore). The state is re-derived from the imported
+        signals through the normal classifier — when it agrees with
+        the recorded state (it always does for an unedited record; the
+        classifier is pure) no transition is counted; a hand-edited or
+        schema-drifted record that disagrees logs the transition like
+        any live signal would. Publishes the handle_health gauge."""
+        with self._lock:
+            s = self._stats(handle)
+            for k in self._EXPORT_FIELDS:
+                if k in d and d[k] is not None:
+                    setattr(s, k, d[k])
+            s.nonfinite = int(d.get("nonfinite", 0) or 0)
+            s.state = str(d.get("state", "healthy"))
+            return self._reclassify(handle, s)
+
     def forget(self, handle: Hashable):
         """Drop a handle's row and gauge (unregister — the round-15
         churn-cardinality discipline); counters keep their history."""

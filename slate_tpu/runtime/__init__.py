@@ -18,15 +18,21 @@ bench_serve.py for the measured win.
 """
 
 from .batching import Batcher, ShedPolicy
+from .checkpoint import (CHECKPOINT_SCHEMA, CheckpointCorrupt,
+                         load_manifest, restore_session, save_session,
+                         validate_manifest)
 from .executor import Executor
 from .faults import (DEGRADATION_LADDER, DeadlineExceeded, FaultInjector,
                      FaultPlan, FaultSpec, RequestShed,
                      TransientDispatchError, default_plan)
+from .fleet import Fleet
 from .metrics import Histogram, Metrics
 from .session import Session, default_session
 
-__all__ = ["Batcher", "Executor", "Histogram", "Metrics", "Session",
-           "ShedPolicy", "default_session",
+__all__ = ["Batcher", "Executor", "Fleet", "Histogram", "Metrics",
+           "Session", "ShedPolicy", "default_session",
+           "CHECKPOINT_SCHEMA", "CheckpointCorrupt", "load_manifest",
+           "restore_session", "save_session", "validate_manifest",
            "DEGRADATION_LADDER", "DeadlineExceeded", "FaultInjector",
            "FaultPlan", "FaultSpec", "RequestShed",
            "TransientDispatchError", "default_plan"]

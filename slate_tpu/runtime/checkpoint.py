@@ -1,0 +1,571 @@
+"""Versioned checkpoint/restore of a Session's resident state.
+
+SLATE inherits MPI's abort-on-failure semantics: the reference runtime
+has no rank-loss recovery — a lost rank kills the job and every
+factorization it held. A serving fleet cannot afford that: a crashed
+Session process must not silently lose every resident factor (hours of
+amortized factorization work) and force a refactor storm onto the
+survivors. This module makes the resident state a durable, portable
+artifact:
+
+* ``save_session(session, path)`` writes a **versioned checkpoint
+  directory**: a stdlib-readable ``manifest.json``
+  (:data:`CHECKPOINT_SCHEMA`) plus one raw-bytes blob per array leaf,
+  each with its own sha256 **checksum** — one record per RESIDENT
+  factor carrying the factor tree AND the full operator metadata (op,
+  m/n, working dtype, nb, band, refine policy, tenant, mesh spec,
+  factorization info, handle heat, numerical-health state);
+* ``restore_session(session, path)`` **re-registers** each record's
+  operator and re-inserts its factor WITHOUT refactoring (warm
+  restart): the restored payload is the byte-identical factor tree, so
+  a restored handle's solve is bit-identical to the pre-checkpoint
+  resident's solve (pinned for dense, small-bucket, and refined-bf16
+  entries; mesh residents restore **re-sharded onto the current
+  grid** — bit-identity is not claimed across placements, the round-11
+  rule). Heat, health, and tenant attribution carry over.
+
+**Corruption is detected, never served.** Every blob read verifies
+length + sha256; a mismatched payload blob degrades that record to
+refactor-on-miss (counted in ``restore_corrupt_total``, warned) — the
+operator still registers, so serving continues with a refactor instead
+of a wrong answer. The ``restore_corrupt`` fault class
+(runtime/faults.py) injects exactly this at the ``"restore"`` seam so
+``tools/chaos_serve.py`` can exit-gate the reflex deterministically.
+
+The manifest is deliberately **jax-free JSON**: ``tools/bench_gate.py``
+carries a mirror validator (``validate_checkpoint_manifest``, the
+placement-schema duplication discipline — tests pin the mirrors equal)
+so CI can hold a committed or drill-produced checkpoint to the schema
+without importing the runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.exceptions import SlateError
+from ..core.grid import ProcessGrid
+from ..core.tiled_matrix import TiledMatrix
+from ..core.types import Diag, MatrixKind, Op, Uplo
+from ..linalg.band_packed import PackedBand
+from ..linalg.qr import QRFactors
+from ..obs.tracing import log as _obs_log
+from ..refine.policy import RefinePolicy
+
+CHECKPOINT_SCHEMA = "slate_tpu.checkpoint.v1"
+# every key a checkpoint record carries. Mirrored (deliberately, the
+# bench_gate/placement duplication pattern: tools/bench_gate.py stays
+# importable without package context) as
+# bench_gate.CHECKPOINT_RECORD_KEYS; tests pin the two tuples equal.
+CHECKPOINT_RECORD_KEYS = (
+    "handle", "handle_type", "op", "m", "n", "band", "dtype", "nb",
+    "tenant", "refine", "mesh", "info", "heat", "last_access",
+    "health", "operator", "payload")
+# every key a blob descriptor carries (mirrored alongside)
+CHECKPOINT_BLOB_KEYS = ("blob", "shape", "dtype", "nbytes", "sha256")
+MANIFEST_NAME = "manifest.json"
+BLOBS_DIR = "blobs"
+
+
+class CheckpointCorrupt(SlateError):
+    """A blob failed its length/sha256 check — the record's factor is
+    not trustworthy and must not serve (degrade to refactor)."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Canonical dtype name -> numpy dtype; bfloat16 resolves through
+    ml_dtypes (``np.dtype("bfloat16")`` raises TypeError)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class _BlobWriter:
+    """Writes array leaves as raw-bytes blob files + checksum descs."""
+
+    def __init__(self, blob_dir: str):
+        self.blob_dir = blob_dir
+        self.count = 0
+
+    def add(self, arr) -> dict:
+        # np.asarray gathers a sharded jax array to the host — the
+        # checkpoint is placement-independent by construction (restore
+        # re-shards onto the CURRENT grid)
+        a = np.ascontiguousarray(np.asarray(arr))
+        raw = a.tobytes()
+        bid = f"b{self.count:05d}.bin"
+        self.count += 1
+        with open(os.path.join(self.blob_dir, bid), "wb") as f:
+            f.write(raw)
+        return {
+            "blob": bid,
+            "shape": [int(d) for d in a.shape],
+            "dtype": str(a.dtype.name),
+            "nbytes": len(raw),
+            "sha256": hashlib.sha256(raw).hexdigest(),
+        }
+
+
+class _BlobReader:
+    """Reads blob files back, verifying length + sha256 per blob.
+
+    ``corrupt_next``: the deterministic ``restore_corrupt`` fault hook —
+    the NEXT read's bytes are flipped before verification, so the
+    checksum must catch the injected corruption exactly like a real
+    torn write would be caught."""
+
+    def __init__(self, blob_dir: str):
+        self.blob_dir = blob_dir
+        self.corrupt_next = False
+
+    def read(self, desc: dict) -> np.ndarray:
+        path = os.path.join(self.blob_dir, str(desc["blob"]))
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorrupt(f"checkpoint blob {desc['blob']!r} "
+                                    f"unreadable: {e}")
+        if self.corrupt_next:
+            self.corrupt_next = False
+            raw = (bytes([raw[0] ^ 0xFF]) + raw[1:]) if raw else b"\xff"
+        if len(raw) != int(desc["nbytes"]) \
+                or hashlib.sha256(raw).hexdigest() != desc["sha256"]:
+            raise CheckpointCorrupt(
+                f"checkpoint blob {desc['blob']!r} failed its checksum "
+                "(corrupt or truncated)")
+        a = np.frombuffer(raw, dtype=_np_dtype(str(desc["dtype"])))
+        return a.reshape([int(d) for d in desc["shape"]]).copy()
+
+
+# -- factor-tree (de)serialization -------------------------------------------
+
+
+def _encode_node(node, w: _BlobWriter) -> dict:
+    """One payload/operator tree node -> a JSON descriptor + blobs.
+    Covers every type a Session resident can hold: TiledMatrix,
+    PackedBand, QRFactors, plain arrays, and nested tuples/lists."""
+    if isinstance(node, TiledMatrix):
+        return {
+            "type": "tiled", "m": int(node.m), "n": int(node.n),
+            "nb": int(node.nb), "kind": node.kind.name,
+            "uplo": node.uplo.name, "op": node.op.name,
+            "diag": node.diag.name, "kl": int(node.kl),
+            "ku": int(node.ku), "cyclic": bool(node.cyclic),
+            "packing": str(node.packing), "data": w.add(node.data),
+        }
+    if isinstance(node, PackedBand):
+        return {"type": "packed_band", "n": int(node.n),
+                "kl": int(node.kl), "ku": int(node.ku),
+                "hermitian": bool(node.hermitian), "ab": w.add(node.ab)}
+    if isinstance(node, QRFactors):
+        return {"type": "qr_factors", "m": int(node.m), "n": int(node.n),
+                "nb": int(node.nb), "vr": w.add(node.vr),
+                "t": w.add(node.t)}
+    if isinstance(node, (tuple, list)):
+        return {"type": "tuple",
+                "items": [_encode_node(x, w) for x in node]}
+    if hasattr(node, "shape") and hasattr(node, "dtype"):
+        return {"type": "array", "a": w.add(node)}
+    raise SlateError(f"checkpoint: unsupported payload node type "
+                     f"{type(node).__name__}")
+
+
+def _decode_node(desc: dict, r: _BlobReader, device: bool = True):
+    """Inverse of :func:`_encode_node`. ``device=False`` keeps plain
+    arrays host-side (small-op operators are stored as np arrays)."""
+    import jax.numpy as jnp
+    t = desc["type"]
+    if t == "tuple":
+        return tuple(_decode_node(d, r, device) for d in desc["items"])
+    if t == "array":
+        a = r.read(desc["a"])
+        return jnp.asarray(a) if device else a
+    if t == "tiled":
+        data = jnp.asarray(r.read(desc["data"]))
+        return TiledMatrix(
+            data, int(desc["m"]), int(desc["n"]), int(desc["nb"]),
+            MatrixKind[desc["kind"]], Uplo[desc["uplo"]],
+            Op[desc["op"]], Diag[desc["diag"]], int(desc["kl"]),
+            int(desc["ku"]), grid=None, cyclic=bool(desc["cyclic"]),
+            packing=str(desc["packing"]))
+    if t == "packed_band":
+        return PackedBand(jnp.asarray(r.read(desc["ab"])),
+                          int(desc["n"]), int(desc["kl"]),
+                          int(desc["ku"]), bool(desc["hermitian"]))
+    if t == "qr_factors":
+        return QRFactors(jnp.asarray(r.read(desc["vr"])),
+                         jnp.asarray(r.read(desc["t"])),
+                         int(desc["m"]), int(desc["n"]), int(desc["nb"]))
+    raise CheckpointCorrupt(f"checkpoint: unknown node type {t!r}")
+
+
+def _reshard_node(node, grid: ProcessGrid):
+    """Re-shard a restored payload's TiledMatrix leaves onto ``grid``
+    (the restoring session's mesh — the round-11 rule: a mesh resident
+    restores onto the CURRENT placement; bit-identity is not claimed
+    across placements)."""
+    if isinstance(node, TiledMatrix):
+        return node.shard(grid)
+    if isinstance(node, tuple):
+        return tuple(_reshard_node(x, grid) for x in node)
+    return node
+
+
+# -- manifest validation ------------------------------------------------------
+
+
+def validate_manifest(doc) -> List[str]:
+    """Schema errors for a checkpoint manifest (empty list = valid).
+    The producer self-checks its own output (the placement-snapshot
+    discipline); ``tools/bench_gate.py`` mirrors this jax-free so CI
+    can validate a manifest without the runtime (mirror-pinned)."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["checkpoint manifest is not an object"]
+    if doc.get("schema") != CHECKPOINT_SCHEMA:
+        errs.append(f"schema != {CHECKPOINT_SCHEMA!r}")
+    if not isinstance(doc.get("host"), str) or not doc.get("host"):
+        errs.append("host missing/not a string")
+    ga = doc.get("generated_at")
+    if not isinstance(ga, (int, float)) or isinstance(ga, bool):
+        errs.append("generated_at missing/not a number")
+    records = doc.get("records")
+    if not isinstance(records, list):
+        return errs + ["records missing/not a list"]
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict):
+            errs.append(f"records[{i}]: not an object")
+            continue
+        for k in CHECKPOINT_RECORD_KEYS:
+            if k not in rec:
+                errs.append(f"records[{i}]: missing {k!r}")
+        if rec.get("handle_type") not in ("str", "int"):
+            errs.append(f"records[{i}].handle_type: not 'str'/'int'")
+        for k in ("op", "dtype"):
+            if k in rec and not isinstance(rec[k], str):
+                errs.append(f"records[{i}].{k}: not a string")
+        for k in ("m", "n", "band", "nb", "info"):
+            v = rec.get(k)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool)):
+                errs.append(f"records[{i}].{k}: not an int")
+        mesh = rec.get("mesh")
+        if mesh is not None and (not isinstance(mesh, list)
+                                 or len(mesh) != 2):
+            errs.append(f"records[{i}].mesh: not [p, q] or null")
+        for k in ("operator", "payload"):
+            errs.extend(_validate_node(rec.get(k), f"records[{i}].{k}"))
+    return errs
+
+
+def _validate_node(desc, where: str) -> List[str]:
+    if not isinstance(desc, dict) or "type" not in desc:
+        return [f"{where}: not a node descriptor"]
+    t = desc["type"]
+    if t == "tuple":
+        items = desc.get("items")
+        if not isinstance(items, list):
+            return [f"{where}.items: missing/not a list"]
+        errs = []
+        for j, d in enumerate(items):
+            errs.extend(_validate_node(d, f"{where}[{j}]"))
+        return errs
+    blob_fields = {"array": ("a",), "tiled": ("data",),
+                   "packed_band": ("ab",), "qr_factors": ("vr", "t")}
+    if t not in blob_fields:
+        return [f"{where}.type: unknown {t!r}"]
+    errs = []
+    for field in blob_fields[t]:
+        b = desc.get(field)
+        if not isinstance(b, dict):
+            errs.append(f"{where}.{field}: missing blob descriptor")
+            continue
+        for k in CHECKPOINT_BLOB_KEYS:
+            if k not in b:
+                errs.append(f"{where}.{field}: blob missing {k!r}")
+    return errs
+
+
+# -- save / restore -----------------------------------------------------------
+
+
+def save_session(session, path: str,
+                 only: Optional[List[Hashable]] = None,
+                 host: Optional[str] = None) -> dict:
+    """Write ``session``'s resident state to checkpoint directory
+    ``path`` (created; an existing checkpoint there is overwritten).
+    One record per RESIDENT factor — registered-but-uncached operators
+    carry no expensive state and are deliberately not checkpointed
+    (the fleet retains their registration specs; refactor-on-miss is
+    their recovery path). ``only`` filters to a handle subset (the
+    fleet's replication transfer). Returns the manifest."""
+    if host is None:
+        import socket as _socket
+        host = f"{_socket.gethostname()}:{os.getpid()}"
+    # crash-safety: blobs go into a FRESH generation directory, and the
+    # manifest (replaced atomically, last) is what points at it — a
+    # death mid-save leaves the previous manifest still naming the
+    # previous generation's intact blobs, so the crash a checkpoint
+    # exists to survive can never corrupt the only durable copy.
+    # Superseded generations are pruned only after the new manifest
+    # lands.
+    os.makedirs(path, exist_ok=True)
+    prior = [d for d in os.listdir(path)
+             if d == BLOBS_DIR or d.startswith(BLOBS_DIR + "-")]
+    gen = 0
+    for d in prior:
+        try:
+            gen = max(gen, int(d.rsplit("-", 1)[1]) + 1)
+        except (IndexError, ValueError):
+            gen = max(gen, 1)  # legacy unsuffixed "blobs"
+    blobs_name = f"{BLOBS_DIR}-{gen:05d}"
+    blob_dir = os.path.join(path, blobs_name)
+    os.makedirs(blob_dir, exist_ok=True)
+    writer = _BlobWriter(blob_dir)
+    keep = None if only is None else set(only)
+    records = []
+    skipped = 0
+    # snapshot the resident references under the lock, then gather/
+    # hash/write OUTSIDE it — a checkpoint of hundreds of MB must not
+    # stop-the-world the serving threads for its disk I/O. Entries and
+    # payload trees are immutable once cached; a concurrent evict just
+    # means the checkpoint keeps a resident the cache no longer does
+    # (a snapshot, not a transaction).
+    with session._lock:
+        attr = session.attribution
+        nm = session.numerics
+        items = [(h, session._ops[h], res)
+                 for h, res in session._cache.items()
+                 if (keep is None or h in keep)
+                 and session._ops.get(h) is not None]
+    for h, entry, res in items:
+        if not isinstance(h, (str, int)) or isinstance(h, bool):
+            # restorable handles must round-trip through JSON; an
+            # arbitrary hashable cannot — counted, never silent
+            skipped += 1
+            _obs_log.warning(
+                "checkpoint: handle %r is not JSON-representable "
+                "(str/int); its resident is skipped", h)
+            continue
+        try:
+            oper = _encode_node(entry.A, writer)
+            payload = _encode_node(res.payload, writer)
+        except SlateError as e:
+            skipped += 1
+            _obs_log.warning("checkpoint: handle %r skipped (%s)",
+                             h, e)
+            continue
+        heat, last = 0.0, None
+        if attr is not None:
+            hrow = attr.export_heat(h)
+            if hrow is not None:
+                heat, last = hrow["heat"], hrow["last_access"]
+        A = entry.A
+        dtype = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+        records.append({
+            "handle": h,
+            "handle_type": "int" if isinstance(h, int) else "str",
+            "op": entry.op, "m": int(entry.m), "n": int(entry.n),
+            "band": int(entry.band),
+            "dtype": str(np.dtype(dtype).name)
+            if not _is_bf16(dtype) else "bfloat16",
+            "nb": int(getattr(A, "nb", 0) or 0),
+            "tenant": entry.tenant,
+            "refine": (None if entry.refine is None
+                       else dataclasses.asdict(entry.refine)),
+            "mesh": (None if entry.grid is None
+                     else [int(entry.grid.p), int(entry.grid.q)]),
+            "info": int(res.info),
+            "heat": float(heat),
+            "last_access": last,
+            "health": (None if nm is None
+                       else nm.export_state(h)),
+            "operator": oper,
+            "payload": payload,
+        })
+    manifest = {
+        "schema": CHECKPOINT_SCHEMA,
+        "host": host,
+        "generated_at": time.time(),
+        "blobs": blobs_name,
+        "records": records,
+    }
+    errs = validate_manifest(manifest)
+    if errs:
+        raise SlateError(f"checkpoint: manifest self-check failed "
+                         f"({errs[:3]})")
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    for d in prior:  # superseded generations, pruned post-publish
+        if d != blobs_name:
+            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    session.metrics.inc("checkpoints_written_total")
+    session.metrics.inc("checkpoint_records_total", len(records))
+    if skipped:
+        session.metrics.inc("checkpoint_skipped_handles", skipped)
+    return manifest
+
+
+def _is_bf16(dtype) -> bool:
+    return str(dtype) == "bfloat16"
+
+
+def load_manifest(path: str) -> dict:
+    """Read + schema-validate a checkpoint directory's manifest."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SlateError(f"checkpoint: manifest unreadable at "
+                         f"{mpath!r} ({e})")
+    errs = validate_manifest(manifest)
+    if errs:
+        raise SlateError(f"checkpoint: invalid manifest at {mpath!r} "
+                         f"({errs[:3]})")
+    return manifest
+
+
+def restore_session(session, path: str,
+                    only: Optional[List[Hashable]] = None,
+                    manifest: Optional[dict] = None) -> dict:
+    """Restore a checkpoint into ``session``: re-register each record's
+    operator and re-insert its factor WITHOUT refactoring. Returns a
+    summary ``{"registered": [...], "restored": [...], "corrupt":
+    [...], "conflicts": [...], "skipped": [...]}``.
+
+    Degradation rules (never a wrong answer):
+    * payload blob fails its checksum -> the operator still registers
+      but the factor is NOT cached (refactor-on-miss; counted in
+      ``restore_corrupt_total``);
+    * operator blob fails its checksum -> the record cannot serve at
+      all and is skipped (counted, warned);
+    * handle already registered -> the record is skipped as a conflict
+      (the live operator wins — a restore must never clobber serving
+      state).
+
+    Mesh records re-shard onto the restoring session's grid (or a
+    fresh grid of the recorded [p, q] shape when the session has
+    none). Heat/health/tenant carry over when the restoring session
+    has an attribution ledger / numerics monitor attached.
+
+    ``manifest``: an already-loaded (validated) manifest for ``path``
+    — the fleet's failover loads it ONCE and threads it through its
+    per-handle restores instead of re-parsing per handle."""
+    from .session import SMALL_OPS, _Resident, _tree_nbytes
+    if manifest is None:
+        manifest = load_manifest(path)
+    blob_dir = os.path.join(path, str(manifest.get("blobs", BLOBS_DIR)))
+    keep = None if only is None else set(only)
+    summary = {"registered": [], "restored": [], "corrupt": [],
+               "conflicts": [], "skipped": []}
+    for rec in manifest["records"]:
+        h = int(rec["handle"]) if rec["handle_type"] == "int" \
+            else str(rec["handle"])
+        if keep is not None and h not in keep:
+            continue
+        session.metrics.inc("restore_records_total")
+        if h in session:
+            session.metrics.inc("restore_conflicts_total")
+            summary["conflicts"].append(h)
+            continue
+        # one fault opportunity per processed record — the injected
+        # restore_corrupt flips a payload byte BEFORE verification, so
+        # the checksum must catch it (the chaos exit gate)
+        corrupt_injected = False
+        if session.faults is not None:
+            fired = session._fault("restore")
+            corrupt_injected = any(s.kind == "restore_corrupt"
+                                   for s in fired)
+        reader = _BlobReader(blob_dir)
+        small = rec["op"] in SMALL_OPS  # host-side operators
+        try:
+            A = _decode_node(rec["operator"], reader, device=not small)
+        except CheckpointCorrupt as e:
+            session.metrics.inc("restore_corrupt_total")
+            _obs_log.warning(
+                "restore: operator of %r is corrupt (%s); record "
+                "skipped — the handle cannot serve from this "
+                "checkpoint", h, e)
+            summary["skipped"].append(h)
+            continue
+        mesh = None
+        if rec["mesh"] is not None:
+            mesh = session.grid
+            if mesh is None:
+                try:
+                    mesh = ProcessGrid.create(int(rec["mesh"][0]),
+                                              int(rec["mesh"][1]))
+                except ValueError as e:
+                    _obs_log.warning(
+                        "restore: mesh record %r needs a %sx%s grid "
+                        "this process cannot build (%s); skipped", h,
+                        rec["mesh"][0], rec["mesh"][1], e)
+                    summary["skipped"].append(h)
+                    continue
+        policy = (None if rec["refine"] is None
+                  else RefinePolicy(**rec["refine"]))
+        try:
+            session.register(A, op=rec["op"], handle=h, refine=policy,
+                             tenant=rec["tenant"], mesh=mesh)
+        except SlateError as e:
+            _obs_log.warning("restore: register of %r failed (%s); "
+                             "record skipped", h, e)
+            summary["skipped"].append(h)
+            continue
+        summary["registered"].append(h)
+        reader.corrupt_next = corrupt_injected
+        try:
+            payload = _decode_node(rec["payload"], reader)
+        except CheckpointCorrupt as e:
+            # THE degradation rule: checksum caught it, the factor is
+            # not served — the operator stays registered and the next
+            # solve refactors (counted refactor-on-miss), never a
+            # wrong answer from corrupt bits
+            session.metrics.inc("restore_corrupt_total")
+            _obs_log.warning(
+                "restore: factor of %r is corrupt (%s); degrading to "
+                "refactor-on-miss", h, e)
+            summary["corrupt"].append(h)
+            continue
+        with session._lock:
+            entry = session._ops.get(h)
+            if entry is None:  # raced unregister
+                summary["skipped"].append(h)
+                continue
+            if entry.grid is not None:
+                payload = _reshard_node(payload, entry.grid)
+            res = _Resident(payload, int(rec["info"]),
+                            _tree_nbytes(payload, per_chip=True),
+                            _tree_nbytes(payload))
+            session._cache[h] = res
+            session.metrics.inc("restored_residents_total")
+            attr = session.attribution
+            if attr is not None:
+                if rec["heat"]:
+                    attr.import_heat(h, rec["heat"],
+                                     tenant=entry.tenant,
+                                     last_access=rec["last_access"])
+                inc = attr.touch_residency(entry.tenant, h, res.nbytes)
+                if inc:
+                    session.metrics.inc("residency_byte_seconds_total",
+                                        inc)
+            if session.numerics is not None and rec["health"]:
+                session.numerics.import_state(h, rec["health"])
+            session._evict_to_budget(keep=h)
+        summary["restored"].append(h)
+    return summary

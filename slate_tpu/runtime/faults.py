@@ -62,6 +62,16 @@ KINDS = (
                            # refine fallback
     "snapshot_drop",       # a process snapshot never reaches the fleet
                            # aggregator
+    # -- round 17: crash chaos (checkpoint/restore + fleet failover) --
+    "process_crash",       # a Session process dies mid-soak -> the
+                           # fleet coordinator's failover reflex
+    "restore_corrupt",     # a checkpoint blob is corrupted in flight ->
+                           # the per-record checksum must catch it and
+                           # restore degrades to a counted refactor
+                           # (never a wrong answer)
+    "replica_stale",       # a replica's resident predates the primary's
+                           # state -> counted refresh (evict + refactor
+                           # from the registered operand), never served
 )
 
 # seam name -> fault kinds evaluated there. The Session/chaos runner
@@ -74,6 +84,13 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "refine.lo_factor": ("lo_factor_fail",),
     "refine.converge": ("refine_no_converge",),
     "snapshot": ("snapshot_drop",),
+    # round 17: the crash-chaos seams — Session.restore consults
+    # "restore" once per checkpoint record; the Fleet coordinator
+    # consults "fleet.process" once per soak wave and "fleet.replica"
+    # once per replica-served failover handle
+    "restore": ("restore_corrupt",),
+    "fleet.process": ("process_crash",),
+    "fleet.replica": ("replica_stale",),
 }
 
 # The declared degradation ladder (tentpole): when a serving path keeps

@@ -1,0 +1,610 @@
+"""Fleet failover coordinator: N Session processes, one serving surface.
+
+ROADMAP item 1's *reflex* half. Rounds 12–16 gave the fleet its senses
+(placement snapshots, handle heat, numerical health, SLO burn rates);
+this module is the coordinator that ACTS on them so a process death
+costs bounded unavailability and zero wrong answers — the serving
+answer to the reference's MPI abort-on-failure model (a lost rank
+kills a SLATE job; a lost Session process here loses one replica):
+
+* **Consistent-hash placement**: every handle lands on a member chosen
+  by a blake2b hash ring (virtual nodes for balance) — deterministic,
+  so any coordinator instance derives the same placement from the same
+  member set, and a member's death moves only ITS handles (the
+  classic consistent-hashing property). The fleet retains each
+  registration's operand spec: re-registering on a survivor is always
+  possible (counted refactor-on-miss — the recovery floor).
+* **Heat-driven replication**: :meth:`replicate_hot` reads the merged
+  round-15 placement snapshot (``merge_placement_snapshots`` of every
+  member's ``placement_snapshot()`` rows — heat-sorted), and
+  replicates the top-K hottest handles onto their next ring member via
+  a **checkpoint transfer** (runtime/checkpoint.py), so the replica's
+  resident factor is byte-identical to the primary's, heat and health
+  included.
+* **Failover**: :meth:`kill` declares a process death. Its queued
+  (in-flight) requests re-route to survivors (counted — zero lost
+  futures); its handles walk the recovery ladder: a surviving replica
+  serves IMMEDIATELY with no refactor → else the dead member's last
+  checkpoint restores a warm resident onto the next ring member → else
+  the retained spec re-registers cold (counted refactor-on-miss). A
+  ``replica_stale`` fault (or real staleness) refreshes instead of
+  serving stale bits; a corrupt checkpoint record is caught by its
+  checksum and degrades to refactor — never a wrong answer. The
+  round-14 :class:`~.batching.ShedPolicy` rides every member's
+  Batcher, so the recovery surge is admission-controlled on the
+  survivors instead of melting them.
+
+The coordinator owns **no threads**: members are driven by
+:meth:`pump`/:meth:`flush` on the caller's thread (the chaos-drill
+determinism discipline — ``tools/chaos_serve.py`` exit-gates same-seed
+schedule reproducibility across the crash). An Executor-fronted fleet
+is composable later; the failover logic is thread-agnostic.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import os
+import shutil
+import tempfile
+import threading
+from collections import defaultdict
+from concurrent.futures import Future, InvalidStateError
+from typing import Dict, Hashable, List, Optional
+
+from ..core.exceptions import SlateError
+from ..obs.tracing import log as _obs_log
+from .batching import Batcher, ShedPolicy
+from .checkpoint import MANIFEST_NAME
+from .metrics import Metrics
+from .session import Session
+
+
+def _hval(s: str) -> int:
+    """Deterministic 64-bit ring position (blake2b — the faults.py
+    keyed-hash discipline: stable across processes and runs)."""
+    return int.from_bytes(hashlib.blake2b(s.encode(),
+                                          digest_size=8).digest(), "big")
+
+
+@dataclasses.dataclass
+class _Member:
+    name: str
+    session: Session
+    batcher: Batcher
+    alive: bool = True
+    # newest checkpoint directory checkpoint_all() flushed for this
+    # member; _checkpoint_of falls back to the derivable
+    # <base>/checkpoint path (Session.close's flush, or a prior
+    # coordinator's) when this is unset — what failover restores from
+    checkpoint_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Spec:
+    """Retained registration spec: operands are client-supplied and
+    durable (the control plane can always re-supply them); FACTORS are
+    the expensive state checkpoints protect. Re-registering this spec
+    on a survivor is the recovery floor — counted refactor-on-miss."""
+
+    A: object
+    op: str
+    kwargs: dict
+
+
+class _FleetRequest:
+    __slots__ = ("handle", "b", "kwargs", "future", "member", "mfut")
+
+    def __init__(self, handle, b, kwargs):
+        self.handle = handle
+        self.b = b
+        self.kwargs = kwargs
+        self.future = Future()
+        self.member: Optional[str] = None
+        self.mfut: Optional[Future] = None
+
+
+class Fleet:
+    """Coordinator over named Session members (module docstring).
+
+    ``sessions``: ``{name: Session}``. ``checkpoint_root``: per-member
+    checkpoint directories default to ``<root>/<name>`` for members
+    whose Session has no ``checkpoint_dir`` of its own. ``shed_policy``
+    rides every member's Batcher (admission control + load shedding —
+    the survivors' protection during a recovery surge). ``faults``: a
+    :class:`~.faults.FaultInjector` consulted at the fleet seams
+    (``fleet.process`` is fired by the chaos driver; ``fleet.replica``
+    here per replica-served failover handle)."""
+
+    def __init__(self, sessions: Dict[str, Session], *,
+                 max_batch: int = 8, max_wait: float = 3600.0,
+                 shed_policy: Optional[ShedPolicy] = None,
+                 checkpoint_root: Optional[str] = None,
+                 vnodes: int = 16, faults=None,
+                 metrics: Optional[Metrics] = None):
+        if not sessions:
+            raise SlateError("Fleet: at least one member session")
+        self.metrics = metrics or Metrics()
+        self.faults = faults
+        self.checkpoint_root = checkpoint_root
+        self._members: Dict[str, _Member] = {}
+        for name, sess in sessions.items():
+            self._members[str(name)] = _Member(
+                str(name), sess,
+                Batcher(sess, max_batch=max_batch, max_wait=max_wait,
+                        shed_policy=shed_policy))
+        ring = []
+        for name in self._members:
+            for v in range(vnodes):
+                ring.append((_hval(f"{name}#{v}"), name))
+        ring.sort()
+        self._ring_keys = [k for k, _ in ring]
+        self._ring_names = [n for _, n in ring]
+        self._lock = threading.RLock()
+        self._specs: Dict[Hashable, _Spec] = {}
+        # handle -> member names currently REGISTERED to serve it
+        # (placement[0] is the routing preference; replicas follow)
+        self._placement: Dict[Hashable, List[str]] = {}
+        self._by_repr: Dict[str, Hashable] = {}
+        self._inflight: Dict[str, List[_FleetRequest]] = defaultdict(list)
+        self._seq = 0
+        self.metrics.set_gauge("fleet_alive_members",
+                               len(self._members))
+
+    # -- placement ----------------------------------------------------------
+
+    def ring_order(self, handle: Hashable) -> List[str]:
+        """Member names in consistent-hash preference order for one
+        handle: walk the ring clockwise from the handle's position,
+        collecting distinct members. Pure function of (member set,
+        handle) — every coordinator derives the same answer."""
+        start = bisect.bisect_left(self._ring_keys,
+                                   _hval(repr(handle)))
+        order, seen = [], set()
+        n = len(self._ring_names)
+        for i in range(n):
+            name = self._ring_names[(start + i) % n]
+            if name not in seen:
+                seen.add(name)
+                order.append(name)
+            if len(order) == len(self._members):
+                break
+        return order
+
+    def _first_alive(self, order: List[str],
+                     exclude=()) -> Optional[_Member]:
+        for name in order:
+            mem = self._members[name]
+            if mem.alive and name not in exclude:
+                return mem
+        return None
+
+    def _route(self, handle: Hashable) -> Optional[_Member]:
+        """The member that serves ``handle`` right now: first ALIVE
+        member in ring order that has it registered; None when no
+        survivor serves it."""
+        for name in self.ring_order(handle):
+            mem = self._members[name]
+            if mem.alive and handle in mem.session:
+                return mem
+        for mem in self._members.values():  # placement drifted off-ring
+            if mem.alive and handle in mem.session:
+                return mem
+        return None
+
+    def alive(self) -> List[str]:
+        return [n for n, m in self._members.items() if m.alive]
+
+    def member(self, name: str) -> Session:
+        return self._members[name].session
+
+    def placement_of(self, handle: Hashable) -> List[str]:
+        with self._lock:
+            return list(self._placement.get(handle, ()))
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, A, op: str = "auto",
+                 handle: Optional[Hashable] = None,
+                 member: Optional[str] = None, **kwargs) -> Hashable:
+        """Register an operator fleet-wide: consistent-hash placement
+        picks the owning member (``member=`` pins it — the drill/ops
+        escape hatch), the spec is retained for failover re-register.
+        Handles must be str/int (the checkpoint-restorable set)."""
+        with self._lock:
+            if handle is None:
+                self._seq += 1
+                handle = f"h{self._seq}"
+            if not isinstance(handle, (str, int)) \
+                    or isinstance(handle, bool):
+                raise SlateError(
+                    "Fleet.register: handles must be str/int (the "
+                    f"checkpoint-restorable set), got {type(handle)}")
+            if handle in self._specs:
+                raise SlateError(f"Fleet.register: handle {handle!r} "
+                                 "already registered")
+            target = (self._members[member] if member is not None
+                      else self._first_alive(self.ring_order(handle)))
+            if target is None or not target.alive:
+                raise SlateError("Fleet.register: no alive member")
+            target.session.register(A, op=op, handle=handle, **kwargs)
+            resolved_op = target.session.op_meta(handle)[0]
+            self._specs[handle] = _Spec(A, resolved_op, dict(kwargs))
+            self._placement[handle] = [target.name]
+            self._by_repr[repr(handle)] = handle
+            self.metrics.inc("fleet_handles_registered")
+        return handle
+
+    def warmup(self, handles=None):
+        """AOT warmup on every member currently serving each handle."""
+        with self._lock:
+            todo = list(self._placement.items() if handles is None
+                        else ((h, self._placement.get(h, []))
+                              for h in handles))
+        for h, places in todo:
+            for name in places:
+                mem = self._members[name]
+                if mem.alive:
+                    mem.session.warmup(h)
+
+    # -- replication (heat-driven) ------------------------------------------
+
+    def replicate(self, handle: Hashable) -> Optional[str]:
+        """Replicate one handle onto its next ring member via a
+        checkpoint transfer (byte-identical resident, heat/health
+        included); falls back to register+warm when the primary holds
+        no resident yet. Returns the replica member name (None when
+        every alive member already serves the handle)."""
+        with self._lock:
+            places = self._placement.get(handle)
+            spec = self._specs.get(handle)
+            if not places or spec is None:
+                return None
+            primary = self._members[places[0]]
+            target = self._first_alive(self.ring_order(handle),
+                                       exclude=set(places))
+            if target is None:
+                return None
+        if handle in primary.session.cached_handles():
+            xfer = tempfile.mkdtemp(prefix="slate_xfer_")
+            try:
+                primary.session.checkpoint(xfer, only=[handle],
+                                           host=primary.name)
+                target.session.restore(xfer, only=[handle])
+            finally:
+                shutil.rmtree(xfer, ignore_errors=True)
+        else:
+            target.session.register(spec.A, op=spec.op, handle=handle,
+                                    **spec.kwargs)
+            target.session.warmup(handle)
+        with self._lock:
+            self._placement[handle].append(target.name)
+        self.metrics.inc("fleet_replicas_created")
+        return target.name
+
+    def replicate_hot(self, top_k: int = 1) -> List[Hashable]:
+        """Replicate the fleet's top-K hottest handles (the merged
+        round-15 placement rows, heat-sorted, are the input — ROADMAP
+        item 1's 'invert the fold into a placement input')."""
+        doc = self.placement()
+        rows = sorted(doc.get("rows", []),
+                      key=lambda r: (-(float(r.get("heat") or 0.0)),
+                                     str(r.get("handle", ""))))
+        made, seen = [], set()
+        for row in rows:
+            h = self._by_repr.get(str(row.get("handle", "")))
+            if h is None or h in seen:
+                continue
+            seen.add(h)
+            if self.replicate(h) is not None:
+                made.append(h)
+            if len(made) >= top_k:
+                break
+        return made
+
+    # -- checkpoints --------------------------------------------------------
+
+    def _checkpoint_base(self, mem: _Member) -> Optional[str]:
+        if mem.session.checkpoint_dir is not None:
+            return mem.session.checkpoint_dir
+        if self.checkpoint_root is not None:
+            return os.path.join(self.checkpoint_root, mem.name)
+        return None
+
+    def _checkpoint_of(self, mem: _Member) -> Optional[str]:
+        """The newest on-disk checkpoint this member left, or None.
+        Falls back from the coordinator-recorded path to the derivable
+        ``<base>/checkpoint`` location, so a checkpoint flushed by a
+        prior coordinator incarnation or by ``Session.close()`` (the
+        orderly-shutdown flush) is still found by failover."""
+        path = mem.checkpoint_path
+        if path is None:
+            base = self._checkpoint_base(mem)
+            if base is not None:
+                path = os.path.join(base, "checkpoint")
+        if path is not None \
+                and os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            return path
+        return None
+
+    def checkpoint_all(self) -> Dict[str, Optional[str]]:
+        """Flush every alive member's checkpoint (to its session's
+        ``checkpoint_dir`` or ``<checkpoint_root>/<name>``); returns
+        {member: path or None}. The paths are what :meth:`kill`'s
+        failover restores from."""
+        out: Dict[str, Optional[str]] = {}
+        for mem in self._members.values():
+            if not mem.alive:
+                continue
+            base = self._checkpoint_base(mem)
+            if base is None:
+                out[mem.name] = None
+                continue
+            path = os.path.join(base, "checkpoint")
+            mem.session.checkpoint(path, host=mem.name)
+            mem.checkpoint_path = path
+            out[mem.name] = path
+        return out
+
+    # -- serving ------------------------------------------------------------
+
+    def submit(self, handle: Hashable, b, timeout_s=None,
+               tenant=None) -> Future:
+        """Enqueue one solve, routed by placement. Returns a FLEET
+        future: it survives the serving member's death (re-routed to a
+        survivor, counted) — it resolves with the answer, or with the
+        survivor's counted rejection (shed/deadline), never silently
+        hangs (the zero-lost-futures contract chaos exit-gates)."""
+        rec = _FleetRequest(handle, b, {
+            k: v for k, v in (("timeout_s", timeout_s),
+                              ("tenant", tenant)) if v is not None})
+        target = self._route(handle)
+        if target is None:
+            rec.future.set_exception(SlateError(
+                f"Fleet: no alive member serves handle {handle!r}"))
+            return rec.future
+        self._send(rec, target)
+        return rec.future
+
+    def _send(self, rec: _FleetRequest, mem: _Member):
+        mfut = mem.batcher.submit(rec.handle, rec.b, **rec.kwargs)
+        rec.member, rec.mfut = mem.name, mfut
+        with self._lock:
+            self._inflight[mem.name].append(rec)
+        mfut.add_done_callback(
+            lambda mf, r=rec: self._complete(r, mf))
+
+    @staticmethod
+    def _complete(rec: _FleetRequest, mf: Future):
+        if mf.cancelled():
+            return  # re-routed after a member death: a successor owns it
+        try:
+            e = mf.exception()
+            if e is not None:
+                rec.future.set_exception(e)
+            else:
+                rec.future.set_result(mf.result())
+        except InvalidStateError:
+            pass  # client cancelled the fleet future concurrently
+
+    def pump(self, force: bool = False):
+        """Drive every alive member's Batcher one step (shed check +
+        ready-bucket dispatch) on the caller's thread. A bucket whose
+        dispatch raises fails its still-unresolved futures (counted) —
+        the no-thread analog of the Executor's final-failure path."""
+        for mem in self._members.values():
+            if not mem.alive:
+                continue
+            mem.batcher.maybe_shed()
+            for key, reqs in mem.batcher.pop_ready(force=force):
+                try:
+                    mem.batcher.run(key, reqs)
+                except Exception as e:  # noqa: BLE001 — futures carry it
+                    for r in reqs:
+                        if not r.future.done():
+                            try:
+                                r.future.set_exception(e)
+                                mem.session.metrics.inc(
+                                    "failed_requests_total")
+                            except InvalidStateError:
+                                pass
+        with self._lock:  # prune resolved in-flight records
+            for name in list(self._inflight):
+                live = [r for r in self._inflight[name]
+                        if not r.future.done()]
+                if live:
+                    self._inflight[name] = live
+                else:
+                    del self._inflight[name]
+
+    def flush(self):
+        """Dispatch everything queued on alive members until drained."""
+        self.pump(force=True)
+        while any(m.batcher.pending() for m in self._members.values()
+                  if m.alive):
+            self.pump(force=True)
+
+    # -- failover -----------------------------------------------------------
+
+    def kill(self, name: str):
+        """Declare member ``name`` dead (the crash reflex): its queued
+        requests are orphaned and re-routed to survivors, its handles
+        walk the recovery ladder (replica → checkpoint restore → cold
+        re-register), all counted. Idempotent."""
+        with self._lock:
+            mem = self._members[name]
+            if not mem.alive:
+                return
+            mem.alive = False
+            self.metrics.inc("fleet_process_deaths_total")
+            self.metrics.set_gauge("fleet_alive_members",
+                                   len(self.alive()))
+            orphans = [r for r in self._inflight.pop(name, [])
+                       if not r.future.done()]
+            for r in orphans:
+                if r.mfut is not None:
+                    r.mfut.cancel()  # detach: the dead queue never runs
+            affected = sorted(
+                (h for h, places in self._placement.items()
+                 if name in places), key=repr)
+            # the ladder applies only where the dead member was the
+            # ROUTING PRIMARY (places[0]); a dead replica never served,
+            # so losing it is a durability decrement, not a failover
+            was_primary = {h for h in affected
+                           if self._placement[h][0] == name}
+            for h in affected:
+                self._placement[h] = [p for p in self._placement[h]
+                                      if p != name]
+        _obs_log.warning(
+            "fleet: member %r declared dead (%d orphaned requests, "
+            "%d affected handles); running failover", name,
+            len(orphans), len(affected))
+        self._failover_handles(mem, affected, was_primary)
+        # re-route the orphans AFTER the handles recovered (a replica
+        # or restored resident serves them without refactor); resolving
+        # futures runs client callbacks, so this stays outside the lock
+        for r in orphans:
+            self.metrics.inc("fleet_failover_requests_total")
+            target = self._route(r.handle)
+            if target is None:
+                try:
+                    r.future.set_exception(SlateError(
+                        f"Fleet: handle {r.handle!r} lost with member "
+                        f"{name!r} and no survivor serves it"))
+                except InvalidStateError:
+                    pass
+                continue
+            self._send(r, target)
+
+    def _failover_handles(self, dead: _Member, affected, was_primary):
+        """The recovery ladder for each handle the dead member served
+        (sorted order — deterministic under a seeded injector).
+        ``was_primary``: the subset of ``affected`` the dead member
+        actually ROUTED for — only those walk the ladder; a handle
+        that merely lost its replica here keeps serving from its
+        untouched primary (counted ``fleet_replicas_lost``)."""
+        from .checkpoint import load_manifest
+        ckpt = self._checkpoint_of(dead)
+        manifest = None
+        if ckpt is not None:
+            try:  # parsed+validated ONCE; per-handle restores reuse it
+                manifest = load_manifest(ckpt)
+            except SlateError as e:
+                _obs_log.warning(
+                    "fleet: checkpoint of dead member %r is unreadable "
+                    "(%s); falling through to cold re-register",
+                    dead.name, e)
+                ckpt = None
+        for h in affected:
+            if h not in was_primary:
+                # only a replica died — the primary never stopped
+                # serving; no ladder, no stale check, just a counted
+                # durability decrement
+                self.metrics.inc("fleet_replicas_lost")
+                continue
+            self.metrics.inc("fleet_failover_handles_total")
+            with self._lock:
+                places = list(self._placement.get(h, ()))
+            if places:
+                # rung 1: a surviving replica serves immediately, no
+                # refactor — unless it is (injected-)stale, in which
+                # case the counted refresh evicts the stale resident
+                # so the next touch refactors from the registered
+                # operand (never serve stale bits)
+                stale = (self.faults is not None
+                         and any(s.kind == "replica_stale" for s in
+                                 self.faults.fire("fleet.replica")))
+                if not stale:
+                    self.metrics.inc("fleet_failover_replica_served")
+                    continue
+                self.metrics.inc("fleet_replica_stale_refreshes")
+                _obs_log.warning(
+                    "fleet: replica of %r is stale; refreshing "
+                    "(evict + refactor-on-miss)", h)
+                for pname in places:
+                    self._members[pname].session.evict(h)
+                continue
+            target = self._first_alive(self.ring_order(h))
+            if target is None:
+                _obs_log.warning("fleet: no survivor for handle %r", h)
+                continue
+            registered = False
+            if ckpt is not None:
+                # rung 2: warm-restart from the dead member's last
+                # checkpoint (no refactor; a corrupt record is caught
+                # by its checksum inside restore and degrades to
+                # refactor-on-miss, counted there)
+                summary = target.session.restore(ckpt, only=[h],
+                                                 manifest=manifest)
+                if h in summary["registered"]:
+                    registered = True
+                    if h in summary["restored"]:
+                        self.metrics.inc("fleet_failover_restored")
+                    else:
+                        self.metrics.inc("fleet_failover_refactor")
+            if not registered:
+                # rung 3 (the floor): re-register the retained spec
+                # cold — counted refactor-on-miss on first touch
+                spec = self._specs.get(h)
+                if spec is None:
+                    continue
+                try:
+                    target.session.register(spec.A, op=spec.op,
+                                            handle=h, **spec.kwargs)
+                except SlateError as e:
+                    _obs_log.warning(
+                        "fleet: cold re-register of %r failed (%s)",
+                        h, e)
+                    continue
+                self.metrics.inc("fleet_failover_cold")
+            with self._lock:
+                self._placement[h] = [target.name]
+
+    # -- fleet views --------------------------------------------------------
+
+    def placement(self) -> dict:
+        """The merged fleet placement doc: alive members' live
+        placement snapshots plus checkpoint-derived PARTIAL docs for
+        dead members that left one (the crash-window fold — satellite:
+        a host whose live snapshot is gone but whose checkpoint
+        survives still contributes rows, marked partial)."""
+        from ..obs.aggregate import (merge_placement_snapshots,
+                                     placement_from_checkpoint)
+        from .checkpoint import load_manifest
+        docs = []
+        for mem in self._members.values():
+            if mem.alive:
+                docs.append(mem.session.placement_snapshot(
+                    host=mem.name))
+            else:
+                ckpt = self._checkpoint_of(mem)
+                if ckpt is None:
+                    continue
+                try:
+                    manifest = load_manifest(ckpt)
+                except SlateError:
+                    continue
+                docs.append(placement_from_checkpoint(manifest,
+                                                      host=mem.name))
+        return merge_placement_snapshots(docs)
+
+    def snapshot(self) -> dict:
+        """JSON view of the coordinator: members, placement, ring
+        assignment, and the fleet counters — the bench/chaos artifact
+        section."""
+        with self._lock:
+            placement = {repr(h): list(p)
+                         for h, p in sorted(self._placement.items(),
+                                            key=lambda kv: repr(kv[0]))}
+        snap = self.metrics.snapshot()
+        return {
+            "schema": "slate_tpu.fleet.v1",
+            "members": {n: {"alive": m.alive,
+                            "checkpoint": m.checkpoint_path}
+                        for n, m in self._members.items()},
+            "placement": placement,
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+        }

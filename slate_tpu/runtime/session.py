@@ -41,6 +41,7 @@ SPMD module), so ``hbm_budget`` bounds what the worst chip holds.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -242,8 +243,15 @@ class Session:
                  tracer: Optional[Tracer] = None,
                  mesh=None, slo=None,
                  refine_policies: Optional[PolicyTable] = None,
-                 faults=None, attribution=None, numerics=None):
+                 faults=None, attribution=None, numerics=None,
+                 checkpoint_dir: Optional[str] = None):
         self.hbm_budget = hbm_budget
+        # durable-state directory (round 17): when set, close() flushes
+        # a final checkpoint (runtime/checkpoint.py) + placement
+        # snapshot there — the artifact the fleet coordinator's
+        # failover restores from after this process dies. None = the
+        # pre-round-17 behavior (close drops resident state).
+        self.checkpoint_dir = checkpoint_dir
         # numerical-health telemetry (round 16): None = disabled —
         # every seam guards with ONE `numerics is None` check and
         # allocates nothing (the round-8 tracer discipline, pinned by
@@ -2852,6 +2860,63 @@ class Session:
             "numerics_nonfinite_total", "health_transitions_total",
             "health_demotions_total", "refine_demotions_total")}
         return payload
+
+    # -- checkpoint/restore (round 17: runtime/checkpoint.py) --------------
+
+    def checkpoint(self, path: str, only: Optional[List[Hashable]] = None,
+                   host: Optional[str] = None) -> dict:
+        """Write this session's RESIDENT state (factor trees + full
+        operator metadata, per-blob checksums) to checkpoint directory
+        ``path`` — the durable artifact :meth:`restore` warm-restarts
+        from without refactoring. ``only`` filters to a handle subset
+        (the fleet's replication transfer). Returns the manifest
+        (schema ``slate_tpu.checkpoint.v1``, producer-validated)."""
+        from .checkpoint import save_session
+        return save_session(self, path, only=only, host=host)
+
+    def restore(self, path: str,
+                only: Optional[List[Hashable]] = None,
+                manifest: Optional[dict] = None) -> dict:
+        """Warm-restart from a checkpoint directory: re-register each
+        record's operator and re-insert its factor WITHOUT refactoring
+        — a restored handle's solve is bit-identical to the
+        pre-checkpoint resident's (dense/small/refined entries; mesh
+        residents re-shard onto the current grid, round-11 rule).
+        Heat/health/tenant carry over when the matching obs components
+        are attached. A payload whose checksum fails degrades to
+        refactor-on-miss, counted in ``restore_corrupt_total`` — never
+        a wrong answer. Returns the restore summary. ``manifest``: an
+        already-loaded manifest for ``path`` (skips the re-parse — the
+        fleet's per-handle failover restores)."""
+        from .checkpoint import restore_session
+        return restore_session(self, path, only=only, manifest=manifest)
+
+    def close(self):
+        """Orderly shutdown: when a ``checkpoint_dir`` is configured,
+        flush a final checkpoint plus a placement snapshot there (the
+        state a fleet failover needs to recover this process's
+        residents — before round 17, close dropped both on the floor),
+        then stop the observability endpoint. Idempotent."""
+        if self.checkpoint_dir is not None:
+            import json as _json
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            self.checkpoint(os.path.join(self.checkpoint_dir,
+                                         "checkpoint"))
+            doc = self.placement_snapshot()
+            tmp = os.path.join(self.checkpoint_dir, "placement.json.tmp")
+            with open(tmp, "w") as f:
+                _json.dump(doc, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, os.path.join(self.checkpoint_dir,
+                                         "placement.json"))
+        self.close_obs()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     # -- observability endpoint --------------------------------------------
 

@@ -197,3 +197,94 @@ def test_combine_process_traces_namespaces_pids_and_ids():
              if e.get("ph") == "M" and e.get("name") == "process_name"]
     assert any(n.startswith("h0:") for n in names)
     assert any(n.startswith("h1:") for n in names)
+
+
+# -- partial-host folds (round 17: the crash window) ------------------------
+
+
+def _attr_snap():
+    """A minimal attribution snapshot with one cell."""
+    return {
+        "schema": "slate_tpu.attribution.v1", "halflife_s": 300.0,
+        "tenants": {"t": {"totals": {"solve_flops": 8.0},
+                          "handles": {"'h'": {"solve_flops": 8.0}}}},
+        "totals": {"solve_flops": 8.0},
+    }
+
+
+def test_attribution_fold_tolerates_partial_host():
+    """Satellite pin: a host inside the crash window (live attribution
+    snapshot gone, checkpoint survives) folds as a SKIPPED partial
+    process — conservation over the surviving snapshots is untouched
+    and the partial count is surfaced. Before round 17 only the
+    all-or-nothing snapshot_drop case (both sides absent) was pinned."""
+    full = agg.merge_attribution_snapshots([_attr_snap(), _attr_snap()])
+    part = agg.merge_attribution_snapshots([_attr_snap(), None,
+                                            _attr_snap()])
+    assert part["partial_processes"] == 1
+    assert part["processes"] == 2
+    # the fold over the survivors is bit-identical to the no-partial one
+    assert part["totals"] == full["totals"]
+    assert part["tenants"] == full["tenants"]
+
+
+def _placement_doc(host, partial=False, heat=1.0):
+    doc = {
+        "schema": "slate_tpu.placement_snapshot.v2", "host": host,
+        "generated_at": 1.0,
+        "rows": [{"host": host, "tenant": "t", "handle": "'h'",
+                  "op": "chol", "n": 32, "dtype": "float32",
+                  "bytes_per_chip": 128, "heat": heat,
+                  "last_access": 1.0, "health": "healthy",
+                  "condest": None, "growth": None}],
+    }
+    if partial:
+        doc["partial"] = True
+    return doc
+
+
+def test_placement_fold_marks_partial_hosts_and_keeps_rows():
+    merged = agg.merge_placement_snapshots(
+        [_placement_doc("live0"), _placement_doc("dead0", partial=True),
+         None])
+    assert merged["partial_hosts"] == ["dead0"]
+    assert merged["processes"] == 2  # None tolerated, not counted
+    assert {r["host"] for r in merged["rows"]} == {"live0", "dead0"}
+    # partial rows still roll up per tenant (labeled, not dropped)
+    assert merged["per_tenant"]["t"]["handles"] == 2
+
+
+def test_placement_from_checkpoint_is_fold_compatible():
+    """A checkpoint manifest becomes a schema-shaped partial placement
+    doc: handle reprs, heat, health, and blob byte totals carry into
+    the fold exactly where live rows put them."""
+    manifest = {
+        "schema": "slate_tpu.checkpoint.v1", "host": "pX",
+        "generated_at": 2.0, "blobs": "blobs",
+        "records": [{
+            "handle": "d0", "handle_type": "str", "op": "chol",
+            "m": 32, "n": 32, "band": 0, "dtype": "float32", "nb": 16,
+            "tenant": "t", "refine": None, "mesh": None, "info": 0,
+            "heat": 3.5, "last_access": 2.0,
+            "health": {"state": "suspect", "condest": 1e9,
+                       "growth": None},
+            "operator": {"type": "tiled", "data": {
+                "blob": "b0.bin", "shape": [32, 32],
+                "dtype": "float32", "nbytes": 4096, "sha256": "x"}},
+            "payload": {"type": "tuple", "items": [
+                {"type": "tiled", "data": {
+                    "blob": "b1.bin", "shape": [32, 32],
+                    "dtype": "float32", "nbytes": 4096,
+                    "sha256": "y"}}]},
+        }],
+    }
+    doc = agg.placement_from_checkpoint(manifest, host="dead1")
+    assert doc["partial"] is True and doc["host"] == "dead1"
+    row = doc["rows"][0]
+    assert row["handle"] == repr("d0")
+    assert row["bytes_per_chip"] == 4096  # payload blobs only
+    assert row["health"] == "suspect" and row["condest"] == 1e9
+    merged = agg.merge_placement_snapshots(
+        [_placement_doc("live0"), doc])
+    assert merged["partial_hosts"] == ["dead1"]
+    assert merged["per_tenant"]["t"]["suspect_handles"] == 1

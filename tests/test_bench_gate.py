@@ -427,3 +427,71 @@ def test_real_history_passes_gate(capsys):
     # platform split actually separated the series
     assert all(r["platform"] not in gate_mod.GATED_PLATFORMS
                for r in summary["informational_drops"])
+
+
+# -- serve_failover artifact (round 17) -------------------------------------
+
+
+def _failover_arm(recovery=0.01, refactors=0.0):
+    return {"affected_handles": 2, "failover_s": 0.002,
+            "recovery_s_max": recovery, "recovery_s_mean": recovery,
+            "refactors_after_crash": refactors, "replica_served": 1.0,
+            "restored": 1.0, "cold_registered": 0.0,
+            "availability": 1.0, "completed": 16,
+            "wrong_answers": 0}
+
+
+def test_normalize_serve_failover_arms(tmp_path):
+    art = {"bench": "serve_failover", "platform": "cpu", "n": 32,
+           "nb": 16, "handles": 4, "members": 3,
+           "arms": {"protected": _failover_arm(),
+                    "cold": _failover_arm(0.05, 2.0)},
+           "ok": True}
+    _write(tmp_path, "BENCH_FAILOVER_r01.json", art)
+    recs = gate_mod.normalize_all(
+        str(tmp_path / "BENCH_FAILOVER_r01.json"))
+    assert [r["op"] for r in recs] == ["cold", "protected"]
+    assert all(r["kind"] == "serve_failover" for r in recs)
+    cold = next(r for r in recs if r["op"] == "cold")
+    assert cold["metrics"]["refactors_after_crash"] == 2.0
+    assert cold["metrics"]["recovery_s_max"] == 0.05
+    # single-object normalize refuses the multi-row artifact
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize(str(tmp_path / "BENCH_FAILOVER_r01.json"))
+
+
+def test_serve_failover_missing_arm_rejected(tmp_path):
+    art = {"bench": "serve_failover", "platform": "cpu", "n": 32,
+           "arms": {"protected": _failover_arm()}, "ok": True}
+    _write(tmp_path, "BENCH_FAILOVER_r02.json", art)
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize_all(
+            str(tmp_path / "BENCH_FAILOVER_r02.json"))
+
+
+def test_failover_metrics_classify_lower_is_better():
+    """The recovery/failover/refactor columns must enter the baseline
+    lower-is-better (a 10x recovery-time rise read as an improvement
+    would blind the watchdog — the round-12 _direction discipline)."""
+    for m in ("recovery_s_max", "failover_s", "refactors_after_crash"):
+        assert gate_mod._direction(m) == "lower"
+    assert gate_mod._direction("availability") == "higher"
+
+
+def test_checkpoint_manifest_validator_paths(tmp_path):
+    """The jax-free validator accepts a dict, a manifest path, or a
+    checkpoint directory — and flags unreadable/invalid ones."""
+    good = {"schema": gate_mod.CHECKPOINT_SCHEMA, "host": "x",
+            "generated_at": 1.0, "records": []}
+    assert gate_mod.validate_checkpoint_manifest(good) == []
+    d = tmp_path / "ck"
+    d.mkdir()
+    with open(d / "manifest.json", "w") as f:
+        json.dump(good, f)
+    assert gate_mod.validate_checkpoint_manifest(str(d)) == []
+    assert gate_mod.validate_checkpoint_manifest(
+        str(d / "manifest.json")) == []
+    assert gate_mod.validate_checkpoint_manifest(
+        str(tmp_path / "missing")) != []
+    assert gate_mod.validate_checkpoint_manifest(
+        dict(good, schema="nope")) != []

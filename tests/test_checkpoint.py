@@ -1,0 +1,401 @@
+"""Checkpoint/restore of resident factors (round 17, ISSUE 14).
+
+Pins the warm-restart contract: a restored handle's solve is
+BIT-IDENTICAL to the pre-checkpoint resident's solve with ZERO
+refactors (dense, small-bucket, and refined-bf16 entries), mesh
+residents restore re-sharded onto the current grid (bit-identity not
+claimed across placements — the round-11 rule), heat/health/tenant
+carry over, corruption is caught by the per-blob checksum and degrades
+to refactor-on-miss (never a wrong answer), and the manifest schema is
+mirror-pinned against the jax-free tools/bench_gate.py validator.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.refine import RefinePolicy
+from slate_tpu.runtime import (FaultInjector, FaultPlan, FaultSpec,
+                               Session)
+from slate_tpu.runtime import checkpoint as ckpt
+
+
+def _bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "_bg_for_ckpt", os.path.join(os.path.dirname(__file__),
+                                     os.pardir, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spd(rng, n, dtype=np.float32):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    return (a @ a.T + n * np.eye(n)).astype(dtype)
+
+
+def _diag_dom(rng, n, dtype=np.float32):
+    return (rng.standard_normal((n, n))
+            + n * np.eye(n)).astype(dtype)
+
+
+def _residual(a, x, b):
+    x = np.asarray(x, dtype=np.float64)
+    return float(np.abs(a.astype(np.float64) @ x
+                        - np.asarray(b, np.float64)).max()) \
+        / (a.shape[0] * max(float(np.abs(x).max()), 1.0))
+
+
+class TestManifestSchema:
+    def test_mirror_pinned_against_bench_gate(self):
+        """The jax-free bench_gate validator and the runtime validator
+        share schema id, record keys, and blob keys — the placement-
+        schema duplication discipline."""
+        bg = _bench_gate()
+        assert bg.CHECKPOINT_SCHEMA == ckpt.CHECKPOINT_SCHEMA
+        assert bg.CHECKPOINT_RECORD_KEYS == ckpt.CHECKPOINT_RECORD_KEYS
+        assert bg.CHECKPOINT_BLOB_KEYS == ckpt.CHECKPOINT_BLOB_KEYS
+
+    def test_both_validators_reject_same_malformed_docs(self):
+        bg = _bench_gate()
+        good_rec = {k: None for k in ckpt.CHECKPOINT_RECORD_KEYS}
+        good_rec.update(handle="h", handle_type="str", op="chol",
+                        m=4, n=4, band=0, dtype="float32", nb=2,
+                        info=0, heat=0.0,
+                        operator={"type": "tuple", "items": []},
+                        payload={"type": "tuple", "items": []})
+        bad_docs = [
+            {"schema": "wrong.schema", "host": "x",
+             "generated_at": 0.0, "records": []},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "",
+             "generated_at": 0.0, "records": []},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+             "generated_at": 0.0, "records": [{"handle": "h"}]},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+             "generated_at": 0.0,
+             "records": [dict(good_rec, handle_type="float")]},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+             "generated_at": 0.0,
+             "records": [dict(good_rec, mesh=[2])]},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+             "generated_at": 0.0,
+             "records": [dict(good_rec, op=5)]},
+            {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+             "generated_at": 0.0,
+             "records": [dict(good_rec, dtype=32)]},
+        ]
+        for doc in bad_docs:
+            assert ckpt.validate_manifest(doc), doc
+            assert bg.validate_checkpoint_manifest(doc), doc
+        good = {"schema": ckpt.CHECKPOINT_SCHEMA, "host": "x",
+                "generated_at": 0.0, "records": [good_rec]}
+        assert ckpt.validate_manifest(good) == []
+        assert bg.validate_checkpoint_manifest(good) == []
+
+
+class TestWarmRestart:
+    def test_dense_restore_bit_identical_no_refactor(self, tmp_path):
+        rng = np.random.default_rng(0)
+        n, nb = 32, 16
+        spd = _spd(rng, n)
+        sess = Session()
+        h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                       uplo=st.Uplo.Lower),
+                          op="chol", handle="d0")
+        b = rng.standard_normal(n).astype(np.float32)
+        x1 = sess.solve(h, b)
+        manifest = sess.checkpoint(str(tmp_path / "ck"))
+        assert ckpt.validate_manifest(manifest) == []
+        sess2 = Session()
+        summary = sess2.restore(str(tmp_path / "ck"))
+        assert summary["restored"] == ["d0"]
+        assert sess2.metrics.get("restored_residents_total") == 1
+        x2 = sess2.solve(h, b)
+        # warm restart: bit-identical AND zero refactors
+        assert np.asarray(x1).tobytes() == np.asarray(x2).tobytes()
+        assert sess2.metrics.get("factors_total") == 0
+        assert sess2.metrics.get("cache_hits") == 1
+
+    def test_crash_mid_save_keeps_prior_checkpoint(self, tmp_path,
+                                                   monkeypatch):
+        """A save that dies before its manifest lands must not corrupt
+        the previous checkpoint: blobs go into a fresh generation dir
+        and the old manifest keeps naming the old, intact blobs (the
+        crash a checkpoint exists to survive cannot destroy the only
+        durable copy)."""
+        rng = np.random.default_rng(11)
+        a = _diag_dom(rng, 16)
+        sess = Session()
+        h = sess.register(a, op="lu_small", handle="g0")
+        b = rng.standard_normal(16).astype(np.float32)
+        x1 = sess.solve(h, b)
+        path = str(tmp_path / "ck")
+        man1 = sess.checkpoint(path)
+        # crash mid-save #2: every blob written, manifest replace dies
+        real_replace = os.replace
+
+        def boom(src, dst, *a_, **k_):
+            if str(dst).endswith("manifest.json"):
+                raise OSError("simulated crash before manifest publish")
+            return real_replace(src, dst, *a_, **k_)
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            sess.checkpoint(path)
+        monkeypatch.undo()
+        # the surviving manifest is generation 1, fully restorable
+        sess2 = Session()
+        summary = sess2.restore(path)
+        assert summary["restored"] == ["g0"]
+        assert summary["corrupt"] == []
+        x2 = sess2.solve(h, b)
+        assert np.asarray(x1).tobytes() == np.asarray(x2).tobytes()
+        # a completed re-save prunes the superseded generation
+        man3 = sess.checkpoint(path)
+        assert man3["blobs"] != man1["blobs"]
+        dirs = [d for d in os.listdir(path) if d.startswith("blobs")]
+        assert dirs == [man3["blobs"]]
+
+    def test_small_restore_bit_identical_no_refactor(self, tmp_path):
+        rng = np.random.default_rng(1)
+        n = 16
+        a = _diag_dom(rng, n)
+        sess = Session()
+        h = sess.register(a, op="lu_small", handle="s0")
+        b = rng.standard_normal(n).astype(np.float32)
+        x1 = sess.solve(h, b)
+        sess.checkpoint(str(tmp_path / "ck"))
+        sess2 = Session()
+        sess2.restore(str(tmp_path / "ck"))
+        x2 = sess2.solve(h, b)
+        assert np.asarray(x1).tobytes() == np.asarray(x2).tobytes()
+        assert sess2.metrics.get("factors_total") == 0
+
+    def test_refined_bf16_restore_policy_and_charge(self, tmp_path):
+        """Satellite pin: a refined-bf16 resident restores with its
+        policy active AND its half-HBM budget charge intact — and the
+        refined solve is bit-identical with zero refactors."""
+        rng = np.random.default_rng(2)
+        n, nb = 32, 16
+        spd = _spd(rng, n)
+        pol = RefinePolicy(factor_dtype="bfloat16")
+        sess = Session()
+        h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                       uplo=st.Uplo.Lower),
+                          op="chol", handle="r0", refine=pol)
+        b = rng.standard_normal(n).astype(np.float32)
+        x1 = sess.solve(h, b)
+        res1 = sess._cache[h]
+        # the lo resident charges HALF the full-precision bytes
+        full = Session()
+        hf = full.register(st.hermitian(np.tril(spd), nb=nb,
+                                        uplo=st.Uplo.Lower),
+                           op="chol", handle="f0")
+        full.factor(hf)
+        assert res1.nbytes * 2 == full._cache[hf].nbytes
+        sess.checkpoint(str(tmp_path / "ck"))
+        sess2 = Session()
+        sess2.restore(str(tmp_path / "ck"))
+        entry = sess2._ops[h]
+        assert entry.refine == pol          # policy survived
+        res2 = sess2._cache[h]
+        assert res2.nbytes == res1.nbytes   # half-charge survived
+        x2 = sess2.solve(h, b)
+        assert np.asarray(x1).tobytes() == np.asarray(x2).tobytes()
+        assert sess2.metrics.get("factors_total") == 0
+        assert sess2.metrics.get("refine_converged_total") >= 1
+
+    def test_mesh_restore_resharded_on_current_grid(self, tmp_path):
+        """Mesh residents restore RE-SHARDED onto the restoring
+        session's grid with zero refactors; correctness (not
+        bit-identity) is the cross-placement claim (round-11 rule)."""
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >= 4 devices")
+        from slate_tpu.core.grid import ProcessGrid
+        rng = np.random.default_rng(3)
+        n, nb = 32, 8
+        ge = _diag_dom(rng, n)
+        grid = ProcessGrid.create(2, 2)
+        sess = Session(mesh=grid)
+        h = sess.register(st.from_dense(ge, nb=nb), op="lu",
+                          handle="m0")
+        sess.warmup(h)
+        b = rng.standard_normal(n).astype(np.float32)
+        sess.solve(h, b)
+        manifest = sess.checkpoint(str(tmp_path / "ck"))
+        assert manifest["records"][0]["mesh"] == [2, 2]
+        sess2 = Session(mesh=grid)
+        summary = sess2.restore(str(tmp_path / "ck"))
+        assert summary["restored"] == ["m0"]
+        entry = sess2._ops[h]
+        assert entry.grid is not None and (entry.grid.p,
+                                           entry.grid.q) == (2, 2)
+        lu = sess2._cache[h].payload[0]
+        # the restored factor is genuinely mesh-resident again
+        assert len(lu.data.sharding.device_set) == 4
+        x2 = sess2.solve(h, b)
+        assert _residual(ge, x2, b) < 1e-3
+        assert sess2.metrics.get("factors_total") == 0
+
+    def test_only_filter_and_conflict(self, tmp_path):
+        rng = np.random.default_rng(4)
+        a0, a1 = _diag_dom(rng, 16), _diag_dom(rng, 16)
+        sess = Session()
+        h0 = sess.register(a0, op="lu_small", handle="k0")
+        h1 = sess.register(a1, op="lu_small", handle="k1")
+        sess.factor(h0)
+        sess.factor(h1)
+        manifest = sess.checkpoint(str(tmp_path / "ck"), only=[h0])
+        assert [r["handle"] for r in manifest["records"]] == ["k0"]
+        # restoring into a session that already serves the handle is a
+        # counted conflict — the live operator wins
+        sess2 = Session()
+        sess2.register(a1, op="lu_small", handle="k0")
+        summary = sess2.restore(str(tmp_path / "ck"))
+        assert summary["conflicts"] == ["k0"]
+        assert sess2.metrics.get("restore_conflicts_total") == 1
+
+
+class TestCarryover:
+    def test_heat_and_tenant_carry_over(self, tmp_path):
+        rng = np.random.default_rng(5)
+        a = _diag_dom(rng, 16)
+        sess = Session()
+        sess.enable_attribution()
+        h = sess.register(a, op="lu_small", handle="t0",
+                          tenant="tenant-x")
+        for _ in range(3):
+            sess.solve(h, rng.standard_normal(16).astype(np.float32))
+        heat_pre = sess.attribution.heat(h)
+        assert heat_pre > 0
+        sess.checkpoint(str(tmp_path / "ck"))
+        sess2 = Session()
+        sess2.enable_attribution()
+        sess2.restore(str(tmp_path / "ck"))
+        assert sess2._ops[h].tenant == "tenant-x"
+        # imported heat starts at the decayed-to-checkpoint value
+        assert sess2.attribution.heat(h) == pytest.approx(heat_pre,
+                                                          rel=0.05)
+        row = sess2.placement_snapshot(host="x")["rows"][0]
+        assert row["tenant"] == "tenant-x" and row["heat"] > 0
+
+    def test_suspect_health_carries_and_loses_tiebreak(self, tmp_path):
+        """Satellite pin: a suspect handle STAYS suspect across
+        restore and keeps losing eviction tie-breaks."""
+        rng = np.random.default_rng(6)
+        a0, a1 = _diag_dom(rng, 16), _diag_dom(rng, 16)
+        sess = Session()
+        sess.enable_numerics(sample_fraction=0.0,
+                             condest_on_factor=False)
+        h0 = sess.register(a0, op="lu_small", handle="u0")
+        h1 = sess.register(a1, op="lu_small", handle="u1")
+        sess.factor(h0)
+        sess.factor(h1)
+        # drive u0 suspect through the monitor's own seam (a condest
+        # far past f32's breakdown point)
+        sess.numerics.record_factor(h0, "lu_small", "float32")
+        sess.numerics.record_condest(h0, 1e30)
+        assert sess.numerics.health(h0) == "suspect"
+        sess.checkpoint(str(tmp_path / "ck"))
+        sess2 = Session()
+        sess2.enable_numerics(sample_fraction=0.0,
+                              condest_on_factor=False)
+        sess2.restore(str(tmp_path / "ck"))
+        assert sess2.numerics.health(h0) == "suspect"
+        assert sess2.numerics.health(h1) == "healthy"
+        # suspect handles lose eviction tie-breaks after restore too:
+        # u0 leads the eviction order although u1 is older in LRU
+        order = sess2._eviction_order()
+        assert order[0] == h0
+        # and the restored placement row reports the suspect state
+        rows = {r["handle"]: r for r in
+                sess2.placement_snapshot(host="x")["rows"]}
+        assert rows[repr(h0)]["health"] == "suspect"
+
+
+class TestCorruption:
+    def test_tampered_blob_degrades_to_refactor(self, tmp_path):
+        rng = np.random.default_rng(7)
+        a = _diag_dom(rng, 16)
+        sess = Session()
+        h = sess.register(a, op="lu_small", handle="c0")
+        sess.factor(h)
+        manifest = sess.checkpoint(str(tmp_path / "ck"))
+        # tamper with the PAYLOAD's first blob on disk (the factor)
+        blob = manifest["records"][0]["payload"]["items"][0]["a"]["blob"]
+        bpath = tmp_path / "ck" / manifest["blobs"] / blob
+        raw = bytearray(bpath.read_bytes())
+        raw[0] ^= 0xFF
+        bpath.write_bytes(bytes(raw))
+        sess2 = Session()
+        summary = sess2.restore(str(tmp_path / "ck"))
+        assert summary["corrupt"] == ["c0"]
+        assert summary["registered"] == ["c0"]
+        assert sess2.metrics.get("restore_corrupt_total") == 1
+        # the handle still serves — via a refactor, never corrupt bits
+        b = rng.standard_normal(16).astype(np.float32)
+        x = sess2.solve(h, b)
+        assert _residual(a, x, b) < 1e-3
+        assert sess2.metrics.get("factors_total") == 1
+
+    def test_injected_restore_corrupt_fault(self, tmp_path):
+        """The restore_corrupt fault class fires at the "restore" seam
+        and the checksum must catch it — deterministic under the
+        seeded plan (the chaos drill's gate, pinned at unit level)."""
+        rng = np.random.default_rng(8)
+        a = _diag_dom(rng, 16)
+        sess = Session()
+        h = sess.register(a, op="lu_small", handle="c1")
+        sess.factor(h)
+        sess.checkpoint(str(tmp_path / "ck"))
+        sess2 = Session()
+        sess2.faults = FaultInjector(FaultPlan(seed=1, specs=(
+            FaultSpec("restore_corrupt", rate=1.0, count=1),)))
+        summary = sess2.restore(str(tmp_path / "ck"))
+        assert summary["corrupt"] == ["c1"]
+        assert sess2.metrics.get("restore_corrupt_total") == 1
+        assert sess2.metrics.get("fault:restore_corrupt") == 1
+        # a second restore into a THIRD session under the same plan but
+        # exhausted count restores cleanly (count=1 spent above is per
+        # injector; a fresh injector with after=1 skips record 0)
+        sess3 = Session()
+        sess3.faults = FaultInjector(FaultPlan(seed=1, specs=(
+            FaultSpec("restore_corrupt", rate=1.0, after=1,
+                      count=1),)))
+        summary3 = sess3.restore(str(tmp_path / "ck"))
+        assert summary3["restored"] == ["c1"]
+
+
+class TestClose:
+    def test_close_flushes_checkpoint_and_placement(self, tmp_path):
+        """Satellite pin: Session.close() with a configured
+        checkpoint_dir flushes a final checkpoint + placement snapshot
+        (before round 17, close dropped both on the floor)."""
+        rng = np.random.default_rng(9)
+        a = _diag_dom(rng, 16)
+        cdir = str(tmp_path / "state")
+        with Session(checkpoint_dir=cdir) as sess:
+            h = sess.register(a, op="lu_small", handle="z0")
+            sess.solve(h, rng.standard_normal(16).astype(np.float32))
+        # the context-manager exit called close(): both artifacts exist
+        manifest = ckpt.load_manifest(os.path.join(cdir, "checkpoint"))
+        assert [r["handle"] for r in manifest["records"]] == ["z0"]
+        with open(os.path.join(cdir, "placement.json")) as f:
+            placement = json.load(f)
+        from slate_tpu.obs.attribution import (
+            validate_placement_snapshot)
+        assert validate_placement_snapshot(placement) == []
+        # and a fresh session warm-restarts from the flushed state
+        sess2 = Session()
+        assert sess2.restore(
+            os.path.join(cdir, "checkpoint"))["restored"] == ["z0"]
+        assert sess2.metrics.get("factors_total") == 0
+
+    def test_close_without_dir_is_noop(self):
+        sess = Session()
+        sess.close()  # no checkpoint_dir: nothing to flush, no error

@@ -1,0 +1,309 @@
+"""Fleet failover coordinator (round 17, ISSUE 14 tentpole b).
+
+Pins consistent-hash placement determinism, checkpoint-transfer
+replication (bit-identical replica), and the failover ladder walked by
+a declared process death: replica serves immediately with NO refactor
+→ checkpoint restores warm → cold re-register pays a counted
+refactor-on-miss; orphaned in-flight requests re-route (zero lost
+futures); a stale replica is refreshed, never served; the round-14
+shed policy admission-controls the recovery surge; the partial-host
+placement fold keeps the dead member's checkpointed rows visible.
+
+Small-op operators throughout (the global linalg/batched bucket
+program cache keeps compiles shared across tests — tier-1 budget).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from slate_tpu.runtime import (FaultInjector, FaultPlan, FaultSpec,
+                               Fleet, RequestShed, Session, ShedPolicy)
+
+
+def _diag_dom(rng, n=16):
+    return (rng.standard_normal((n, n)) + n * np.eye(n)).astype(
+        np.float32)
+
+
+def _residual(a, x, b):
+    x = np.asarray(x, dtype=np.float64)
+    return float(np.abs(a.astype(np.float64) @ x
+                        - np.asarray(b, np.float64)).max()) \
+        / (a.shape[0] * max(float(np.abs(x).max()), 1.0))
+
+
+def _fleet(tmp_path=None, n_members=3, shed=None, faults=None,
+           with_ckpt=True, attribution=False):
+    root = None if tmp_path is None else str(tmp_path / "ckpt")
+    sessions = {}
+    for i in range(n_members):
+        cdir = (os.path.join(root, f"p{i}")
+                if (root is not None and with_ckpt) else None)
+        s = Session(checkpoint_dir=cdir)
+        if attribution:
+            s.enable_attribution()
+        if faults is not None:
+            s.faults = faults
+        sessions[f"p{i}"] = s
+    return Fleet(sessions, max_batch=4, max_wait=3600.0,
+                 checkpoint_root=root if with_ckpt else None,
+                 shed_policy=shed, faults=faults)
+
+
+class TestPlacement:
+    def test_ring_order_deterministic_across_instances(self):
+        f1 = _fleet()
+        f2 = _fleet()
+        for h in ("a", "b", "c", 7, 42):
+            assert f1.ring_order(h) == f2.ring_order(h)
+            assert sorted(f1.ring_order(h)) == ["p0", "p1", "p2"]
+
+    def test_register_routes_and_serves(self):
+        rng = np.random.default_rng(0)
+        fleet = _fleet()
+        mats = {}
+        for i in range(4):
+            m = _diag_dom(rng)
+            h = fleet.register(m, op="lu_small", handle=f"q{i}")
+            mats[h] = m
+            assert fleet.placement_of(h) == [fleet.ring_order(h)[0]]
+        futs = []
+        for h in mats:
+            b = rng.standard_normal(16).astype(np.float32)
+            futs.append((fleet.submit(h, b), h, b))
+        fleet.flush()
+        for f, h, b in futs:
+            assert f.exception() is None
+            assert _residual(mats[h], f.result(), b) < 1e-3
+
+    def test_handles_must_be_checkpointable(self):
+        from slate_tpu.core.exceptions import SlateError
+        fleet = _fleet()
+        with pytest.raises(SlateError):
+            fleet.register(np.eye(4, dtype=np.float32),
+                           op="lu_small", handle=("tuple", "handle"))
+
+
+class TestReplication:
+    def test_replica_bit_identical_to_primary(self, tmp_path):
+        rng = np.random.default_rng(1)
+        fleet = _fleet(tmp_path)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="r0", member="p0")
+        fleet.member("p0").factor(h)
+        replica = fleet.replicate(h)
+        assert replica in ("p1", "p2")
+        assert fleet.placement_of(h) == ["p0", replica]
+        # checkpoint transfer: the replica's resident factor is the
+        # SAME bytes, so its solve is bit-identical to the primary's
+        b = rng.standard_normal(16).astype(np.float32)
+        x_primary = fleet.member("p0").solve(h, b)
+        x_replica = fleet.member(replica).solve(h, b)
+        assert np.asarray(x_primary).tobytes() \
+            == np.asarray(x_replica).tobytes()
+        # and the replica did NOT refactor to get there
+        assert fleet.member(replica).metrics.get("factors_total") == 0
+
+    def test_replicate_hot_picks_hottest(self, tmp_path):
+        rng = np.random.default_rng(2)
+        fleet = _fleet(tmp_path, attribution=True)
+        hs = [fleet.register(_diag_dom(rng), op="lu_small",
+                             handle=f"w{i}", member=f"p{i % 3}")
+              for i in range(3)]
+        for h in hs:
+            fleet.member(fleet.placement_of(h)[0]).solve(
+                h, rng.standard_normal(16).astype(np.float32))
+        hot = hs[1]
+        for _ in range(4):  # drive w1 hottest
+            fleet.member(fleet.placement_of(hot)[0]).solve(
+                hot, rng.standard_normal(16).astype(np.float32))
+        made = fleet.replicate_hot(1)
+        assert made == [hot]
+        assert len(fleet.placement_of(hot)) == 2
+
+
+class TestFailover:
+    def test_replica_serves_with_no_refactor(self, tmp_path):
+        rng = np.random.default_rng(3)
+        fleet = _fleet(tmp_path)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f0", member="p0")
+        fleet.member("p0").factor(h)
+        replica = fleet.replicate(h)
+        pre = fleet.member(replica).metrics.get("factors_total")
+        fleet.kill("p0")
+        assert fleet.metrics.get("fleet_failover_replica_served") == 1
+        assert fleet.placement_of(h) == [replica]
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        # rung 1: served from the replica's resident — zero refactors
+        assert fleet.member(replica).metrics.get(
+            "factors_total") == pre
+
+    def test_checkpoint_restores_warm(self, tmp_path):
+        rng = np.random.default_rng(4)
+        fleet = _fleet(tmp_path)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f1", member="p0")
+        fleet.member("p0").factor(h)
+        fleet.checkpoint_all()
+        fleet.kill("p0")
+        assert fleet.metrics.get("fleet_failover_restored") == 1
+        target = fleet.placement_of(h)[0]
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        # rung 2: warm restore — the survivor never refactored
+        assert fleet.member(target).metrics.get("factors_total") == 0
+        assert fleet.member(target).metrics.get(
+            "restored_residents_total") == 1
+
+    def test_replica_death_is_not_a_failover(self, tmp_path):
+        """Killing the member that held only a handle's REPLICA must
+        not walk the ladder: the primary never stopped serving, no
+        replica_served/stale accounting fires (a stale injection must
+        not evict the healthy primary), just a counted
+        fleet_replicas_lost durability decrement."""
+        rng = np.random.default_rng(10)
+        stale_inj = FaultInjector(FaultPlan(seed=3, specs=(
+            FaultSpec("replica_stale", rate=1.0),)))
+        fleet = _fleet(tmp_path, faults=stale_inj)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f10", member="p1")
+        fleet.member("p1").factor(h)
+        replica = fleet.replicate(h)
+        assert replica != "p1"
+        fleet.kill(replica)
+        assert fleet.metrics.get("fleet_replicas_lost") == 1
+        assert fleet.metrics.get("fleet_failover_handles_total") == 0
+        assert fleet.metrics.get("fleet_failover_replica_served") == 0
+        assert fleet.metrics.get("fleet_replica_stale_refreshes") == 0
+        assert fleet.placement_of(h) == ["p1"]
+        # the primary's resident survived untouched: serving continues
+        # with zero additional refactors
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        assert fleet.member("p1").metrics.get("factors_total") == 1
+
+    def test_close_flushed_checkpoint_found_by_failover(self, tmp_path):
+        """A checkpoint flushed by Session.close() (or any prior
+        coordinator incarnation) — never recorded by THIS
+        coordinator's checkpoint_all — is still found at the derivable
+        <base>/checkpoint path and restores warm."""
+        rng = np.random.default_rng(9)
+        fleet = _fleet(tmp_path)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f9", member="p0")
+        fleet.member("p0").factor(h)
+        # the member's own orderly-shutdown flush, not checkpoint_all
+        fleet.member("p0").close()
+        fleet.kill("p0")
+        assert fleet.metrics.get("fleet_failover_restored") == 1
+        target = fleet.placement_of(h)[0]
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        assert fleet.member(target).metrics.get("factors_total") == 0
+
+    def test_cold_reregister_refactors_counted(self, tmp_path):
+        rng = np.random.default_rng(5)
+        fleet = _fleet(tmp_path, with_ckpt=False)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f2", member="p0")
+        fleet.member("p0").factor(h)
+        fleet.kill("p0")  # no replica, no checkpoint
+        assert fleet.metrics.get("fleet_failover_cold") == 1
+        target = fleet.placement_of(h)[0]
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        # rung 3 (the floor): one counted refactor-on-miss
+        assert fleet.member(target).metrics.get("factors_total") == 1
+
+    def test_orphaned_requests_reroute_zero_lost(self, tmp_path):
+        rng = np.random.default_rng(6)
+        fleet = _fleet(tmp_path)
+        m = _diag_dom(rng)
+        # ring placement (no member= pin): the primary is the ring's
+        # first preference, so submits genuinely queue on the victim
+        h = fleet.register(m, op="lu_small", handle="f3")
+        primary = fleet.placement_of(h)[0]
+        fleet.member(primary).factor(h)
+        fleet.replicate(h)
+        # queue requests on the doomed member, then crash BEFORE any
+        # dispatch: the fleet futures must still resolve (re-routed)
+        futs = [(fleet.submit(h, b), b) for b in
+                (rng.standard_normal(16).astype(np.float32)
+                 for _ in range(3))]
+        fleet.kill(primary)
+        fleet.flush()
+        assert fleet.metrics.get("fleet_failover_requests_total") == 3
+        for f, b in futs:
+            assert f.done() and f.exception() is None
+            assert _residual(m, f.result(), b) < 1e-3
+
+    def test_stale_replica_refreshed_not_served(self, tmp_path):
+        rng = np.random.default_rng(7)
+        inj = FaultInjector(FaultPlan(seed=1, specs=(
+            FaultSpec("replica_stale", rate=1.0, count=1),)))
+        fleet = _fleet(tmp_path, faults=inj)
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f4", member="p0")
+        fleet.member("p0").factor(h)
+        replica = fleet.replicate(h)
+        fleet.kill("p0")
+        assert fleet.metrics.get("fleet_replica_stale_refreshes") == 1
+        assert fleet.metrics.get("fleet_failover_replica_served") == 0
+        # the stale resident was evicted: the next touch refactors from
+        # the registered operand and the answer is correct
+        b = rng.standard_normal(16).astype(np.float32)
+        f = fleet.submit(h, b)
+        fleet.flush()
+        assert _residual(m, f.result(), b) < 1e-3
+        assert fleet.member(replica).metrics.get("cache_misses") >= 1
+
+    def test_shed_policy_protects_recovery_surge(self, tmp_path):
+        rng = np.random.default_rng(8)
+        fleet = _fleet(tmp_path, shed=ShedPolicy(max_queue_depth=4,
+                                                 min_queue_depth=1))
+        m = _diag_dom(rng)
+        h = fleet.register(m, op="lu_small", handle="f5", member="p0")
+        fleet.member("p0").factor(h)
+        fleet.checkpoint_all()
+        fleet.kill("p0")
+        surge = [fleet.submit(h, rng.standard_normal(16)
+                              .astype(np.float32)) for _ in range(12)]
+        fleet.flush()
+        rejected = [f for f in surge if f.done()
+                    and isinstance(f.exception(), RequestShed)]
+        served = [f for f in surge if f.done()
+                  and f.exception() is None]
+        # admission control turned the excess away COUNTED; nothing
+        # hung — zero lost futures either way
+        assert len(rejected) == 8 and len(served) == 4
+        assert all(f.done() for f in surge)
+
+    def test_partial_placement_fold_after_crash(self, tmp_path):
+        rng = np.random.default_rng(9)
+        fleet = _fleet(tmp_path, attribution=True)
+        h = fleet.register(_diag_dom(rng), op="lu_small",
+                           handle="f6", member="p0")
+        fleet.member("p0").solve(
+            h, rng.standard_normal(16).astype(np.float32))
+        fleet.checkpoint_all()
+        fleet.kill("p0")
+        doc = fleet.placement()
+        # the dead member's checkpoint keeps it in the fold, marked
+        assert doc["partial_hosts"] == ["p0"]
+        dead_rows = [r for r in doc["rows"] if r["host"] == "p0"]
+        assert dead_rows and dead_rows[0]["handle"] == repr("f6")
+        assert dead_rows[0]["heat"] > 0

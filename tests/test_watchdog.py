@@ -50,13 +50,16 @@ def test_committed_baseline_loads_and_validates():
     assert doc["gated_platforms"] == ["tpu", "axon"]
     assert len(doc["series"]) > 20
     assert validate_baseline(doc) == []
-    # direction annotation: residual, latency, and queue-age series
-    # (round 14 overload columns) are lower-is-better, everything
-    # else higher
+    # direction annotation: residual, latency, queue-age (round 14
+    # overload columns), and recovery/failover/refactor series (round
+    # 17 failover columns) are lower-is-better, everything else higher
     for row in doc["series"]:
         want = ("lower" if (row["metric"].startswith("residual_")
                             or "latency" in row["metric"]
-                            or "age_s" in row["metric"])
+                            or "age_s" in row["metric"]
+                            or "recovery" in row["metric"]
+                            or "failover" in row["metric"]
+                            or "refactor" in row["metric"])
                 else "higher")
         assert row["direction"] == want, row["metric"]
     # real tpu history exists (rounds 1–5 on-chip runs) — the series

@@ -89,6 +89,12 @@ TRACKED_SERVE_MIXED = ("mixed.solves_per_sec", "full.solves_per_sec",
 # BENCH_OVERLOAD_r*.json): one record per arm (shed / no_shed);
 # p99_latency_s classifies as lower-is-better via _direction
 TRACKED_OVERLOAD = ("p99_latency_s", "max_oldest_age_s", "completed")
+# the round-17 failover A/B (bench_serve.py --failover →
+# BENCH_FAILOVER_r*.json): one record per arm (protected / cold);
+# the recovery/failover/refactor columns classify lower-is-better via
+# _direction, availability higher
+TRACKED_FAILOVER = ("failover_s", "recovery_s_max",
+                    "refactors_after_crash", "availability")
 GATED_PLATFORMS = ("tpu", "axon")
 
 # mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
@@ -113,6 +119,17 @@ PLACEMENT_ROW_KEYS = ("host", "tenant", "handle", "op", "n", "dtype",
 # mirror of obs/numerics.py HEALTH_STATES (tests pin them equal): the
 # vocabulary the round-16 numerics section's states must come from
 HEALTH_STATES = ("healthy", "degraded", "suspect")
+# mirror of slate_tpu/runtime/checkpoint.py (round 17; same jax-free
+# duplication discipline as the placement schema — tests pin the
+# mirrors equal and feed both validators the same malformed docs): the
+# checkpoint manifest a dead member's failover restores from, held to
+# its schema by CI without importing the runtime
+CHECKPOINT_SCHEMA = "slate_tpu.checkpoint.v1"
+CHECKPOINT_RECORD_KEYS = (
+    "handle", "handle_type", "op", "m", "n", "band", "dtype", "nb",
+    "tenant", "refine", "mesh", "info", "heat", "last_access",
+    "health", "operator", "payload")
+CHECKPOINT_BLOB_KEYS = ("blob", "shape", "dtype", "nbytes", "sha256")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -174,7 +191,8 @@ def normalize(path: str) -> dict:
         raise SchemaError(f"{name}: list artifact — use normalize_all")
     if isinstance(obj, dict) and obj.get("bench") in ("multichip",
                                                       "serve_mixed",
-                                                      "serve_overload"):
+                                                      "serve_overload",
+                                                      "serve_failover"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
@@ -202,6 +220,8 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_serve_mixed(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_overload":
         return _normalize_serve_overload(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_failover":
+        return _normalize_serve_failover(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "chaos":
         return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
@@ -237,6 +257,124 @@ def _normalize_serve_overload(name: str, obj: dict,
             "metrics": _flat_metrics(row, TRACKED_OVERLOAD),
         })
     return out
+
+
+def _normalize_serve_failover(name: str, obj: dict,
+                              rnd: Optional[int]) -> List[dict]:
+    """The round-17 failover A/B artifact: {"bench": "serve_failover",
+    "platform", "n", "arms": {"protected": {...}, "cold": {...}},
+    "ok"} — one record per arm (arm label in the ``op`` series-key
+    slot, the serve_overload convention)."""
+    for k in ("platform", "n", "arms", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_failover artifact "
+                              f"missing {k!r}")
+    arms = obj["arms"]
+    if not isinstance(arms, dict) or set(arms) != {"protected", "cold"}:
+        raise SchemaError(f"{name}: serve_failover arms must be "
+                          "exactly {protected, cold}")
+    out = []
+    for arm, row in sorted(arms.items()):
+        for k in ("affected_handles", "failover_s", "recovery_s_max",
+                  "refactors_after_crash", "availability",
+                  "wrong_answers"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[arms.{arm}]: serve_failover arm missing "
+                    f"{k!r}")
+        out.append({
+            "round": rnd, "source": f"{name}[{arm}]",
+            "kind": "serve_failover",
+            "platform": str(obj["platform"]), "n": int(obj["n"]),
+            "op": arm, "ok": bool(obj.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_FAILOVER),
+        })
+    return out
+
+
+def validate_checkpoint_manifest(doc) -> List[str]:
+    """Jax-free mirror of slate_tpu/runtime/checkpoint.py's
+    ``validate_manifest`` (the placement-schema duplication pattern;
+    tests pin the two against the same malformed docs): schema errors
+    for one checkpoint manifest, empty list = valid. Accepts a parsed
+    dict or a path to a manifest.json / checkpoint directory."""
+    if isinstance(doc, str):
+        path = doc
+        if os.path.isdir(path):
+            path = os.path.join(path, "manifest.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"manifest unreadable ({e})"]
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["checkpoint manifest is not an object"]
+    if doc.get("schema") != CHECKPOINT_SCHEMA:
+        errs.append(f"schema != {CHECKPOINT_SCHEMA!r}")
+    if not isinstance(doc.get("host"), str) or not doc.get("host"):
+        errs.append("host missing/not a string")
+    ga = doc.get("generated_at")
+    if not isinstance(ga, (int, float)) or isinstance(ga, bool):
+        errs.append("generated_at missing/not a number")
+    records = doc.get("records")
+    if not isinstance(records, list):
+        return errs + ["records missing/not a list"]
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict):
+            errs.append(f"records[{i}]: not an object")
+            continue
+        for k in CHECKPOINT_RECORD_KEYS:
+            if k not in rec:
+                errs.append(f"records[{i}]: missing {k!r}")
+        if rec.get("handle_type") not in ("str", "int"):
+            errs.append(f"records[{i}].handle_type: not 'str'/'int'")
+        for k in ("op", "dtype"):
+            if k in rec and not isinstance(rec[k], str):
+                errs.append(f"records[{i}].{k}: not a string")
+        for k in ("m", "n", "band", "nb", "info"):
+            v = rec.get(k)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool)):
+                errs.append(f"records[{i}].{k}: not an int")
+        mesh = rec.get("mesh")
+        if mesh is not None and (not isinstance(mesh, list)
+                                 or len(mesh) != 2):
+            errs.append(f"records[{i}].mesh: not [p, q] or null")
+        for k in ("operator", "payload"):
+            errs.extend(_validate_ckpt_node(rec.get(k),
+                                            f"records[{i}].{k}"))
+    return errs
+
+
+def _validate_ckpt_node(desc, where: str) -> List[str]:
+    """Mirror of checkpoint._validate_node (see
+    validate_checkpoint_manifest)."""
+    if not isinstance(desc, dict) or "type" not in desc:
+        return [f"{where}: not a node descriptor"]
+    t = desc["type"]
+    if t == "tuple":
+        items = desc.get("items")
+        if not isinstance(items, list):
+            return [f"{where}.items: missing/not a list"]
+        errs = []
+        for j, d in enumerate(items):
+            errs.extend(_validate_ckpt_node(d, f"{where}[{j}]"))
+        return errs
+    blob_fields = {"array": ("a",), "tiled": ("data",),
+                   "packed_band": ("ab",), "qr_factors": ("vr", "t")}
+    if t not in blob_fields:
+        return [f"{where}.type: unknown {t!r}"]
+    errs = []
+    for field in blob_fields[t]:
+        b = desc.get(field)
+        if not isinstance(b, dict):
+            errs.append(f"{where}.{field}: missing blob descriptor")
+            continue
+        for k in CHECKPOINT_BLOB_KEYS:
+            if k not in b:
+                errs.append(f"{where}.{field}: blob missing {k!r}")
+    return errs
 
 
 def _normalize_chaos(name: str, obj: dict,
@@ -494,6 +632,7 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_SERVE*.json"))
              + glob.glob(os.path.join(root, "BENCH_MIXED_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_OVERLOAD_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_FAILOVER_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
@@ -569,13 +708,15 @@ def _direction(metric: str) -> str:
     """Per-metric regression direction: every tracked series is
     higher-is-better (GFLOP/s, solves/s, speedup) EXCEPT the
     residual_* informational series parsed off the r01–r05 multichip
-    tails (smaller residual = healthier) and anything latency- or
-    queue-age-shaped (the round-14 overload columns) — classified here
-    so a future artifact exporting a latency series cannot silently
-    enter the baseline with an inverted direction (the watchdog would
-    then read a 10× p99 rise as an improvement)."""
+    tails (smaller residual = healthier) and anything latency-,
+    queue-age-, or recovery-shaped (the round-14 overload and round-17
+    failover columns) — classified here so a future artifact exporting
+    a latency series cannot silently enter the baseline with an
+    inverted direction (the watchdog would then read a 10× p99 rise as
+    an improvement)."""
     if metric.startswith("residual_") or "latency" in metric \
-            or "age_s" in metric:
+            or "age_s" in metric or "recovery" in metric \
+            or "failover" in metric or "refactor" in metric:
         return "lower"
     return "higher"
 

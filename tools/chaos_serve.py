@@ -470,6 +470,211 @@ def run_numerics_drill(seed):
     }
 
 
+def recovery_plan(seed):
+    """The crash-chaos plan (round 17): the process crash fires at the
+    SECOND wave boundary (after=1) so one clean wave runs first;
+    ``restore_corrupt`` skips the two replication-transfer restores
+    (after=2) and corrupts the FIRST failover restore; ``replica_stale``
+    hits the first replica-served failover handle. All count-limited —
+    every rung of the recovery ladder is exercised exactly once."""
+    from slate_tpu.runtime import FaultPlan, FaultSpec
+    return FaultPlan(seed=seed, specs=(
+        FaultSpec("process_crash", rate=1.0, after=1, count=1),
+        FaultSpec("restore_corrupt", rate=1.0, after=2, count=1),
+        FaultSpec("replica_stale", rate=1.0, count=1),
+    ))
+
+
+def run_recovery_drill(seed, waves=3):
+    """Crash-recovery drill (round 17, the fleet-reflex half of the
+    robustness story): a 3-member Fleet serves a mixed workload
+    (dense chol, grouped small lu, refined bf16) with heat-driven
+    replication + checkpoints; a deterministic ``process_crash`` kills
+    the member holding the hottest handles MID-WAVE (its queued
+    requests orphan and re-route), and the failover ladder is walked
+    with every rung observed: the first replica-served handle is
+    injected STALE (counted refresh, refactor — never stale bits), the
+    second serves from its replica with NO refactor, the first
+    checkpoint restore is injected CORRUPT (checksum catches it,
+    counted degrade to refactor), the second restores warm. A
+    post-crash admission surge exercises the round-14 shed policy on
+    the survivors. Exit gates: zero wrong answers, zero lost futures
+    (every fleet future resolves — failed-over or counted-shed),
+    survivor conservation, attribution-fold consistency across the
+    crash, the partial-host placement fold (the dead member's
+    checkpoint keeps it in the fold), and an exact post-crash refactor
+    count (stale refresh + corrupt degrade = 2; the replica and the
+    clean restore refactor nothing)."""
+    import shutil
+    import tempfile
+
+    from slate_tpu.obs.aggregate import (merge_attribution_snapshots,
+                                         merge_metrics_snapshots)
+    from slate_tpu.refine import RefinePolicy
+    from slate_tpu.runtime import (FaultInjector, Fleet, RequestShed,
+                                   Session, ShedPolicy)
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 5)
+    root = tempfile.mkdtemp(prefix="slate_chaos_ckpt_")
+    inj = FaultInjector(recovery_plan(seed))
+    sessions = {}
+    for i in range(3):
+        s = Session(hbm_budget=64 << 20,
+                    checkpoint_dir=os.path.join(root, f"p{i}"))
+        s.enable_attribution()
+        s.faults = inj  # ONE shared schedule across the fleet
+        sessions[f"p{i}"] = s
+    fleet = Fleet(sessions, max_batch=4, max_wait=3600.0,
+                  checkpoint_root=root, faults=inj,
+                  shed_policy=ShedPolicy(max_queue_depth=16,
+                                         min_queue_depth=2))
+    n_dense, n_small, nb = 32, 16, 16
+    dense = {}
+    # the victim hosts the hottest dense pair (replication targets) AND
+    # two small operators (the restore paths); survivors hold the rest
+    for name, member in (("d0", "p0"), ("d1", "p0")):
+        a = rng.standard_normal((n_dense, n_dense)).astype(np.float32)
+        spd = (a @ a.T + n_dense * np.eye(n_dense)).astype(np.float32)
+        fleet.register(st.hermitian(np.tril(spd), nb=nb,
+                                    uplo=st.Uplo.Lower),
+                       op="chol", handle=name, member=member)
+        dense[name] = spd
+    for name, member in (("s0", "p0"), ("s1", "p0"), ("s2", "p1"),
+                         ("s3", "p2")):
+        m = (rng.standard_normal((n_small, n_small))
+             + n_small * np.eye(n_small)).astype(np.float32)
+        fleet.register(m, op="lu_small", handle=name, member=member)
+        dense[name] = m
+    a2 = rng.standard_normal((n_dense, n_dense)).astype(np.float32)
+    spd2 = (a2 @ a2.T + n_dense * np.eye(n_dense)).astype(np.float32)
+    fleet.register(st.hermitian(np.tril(spd2), nb=nb,
+                                uplo=st.Uplo.Lower),
+                   op="chol", handle="r0", member="p1",
+                   refine=RefinePolicy(factor_dtype="bfloat16"))
+    dense["r0"] = spd2
+    fleet.warmup()
+    victim = "p0"
+
+    futs = []  # (future, handle, b)
+
+    def submit_all():
+        for h in sorted(dense):
+            nn = dense[h].shape[0]
+            b = rng.standard_normal(nn).astype(np.float32)
+            futs.append((fleet.submit(h, b), h, b))
+
+    # wave 0: serve + drive d0/d1 hottest (3 extra accesses each), then
+    # replicate the top-2 hottest and flush every member's checkpoint
+    submit_all()
+    inj.fire("fleet.process")  # wave-0 opportunity (after=1 skips it)
+    fleet.flush()
+    for _ in range(3):
+        for h in ("d0", "d1"):
+            b = rng.standard_normal(n_dense).astype(np.float32)
+            futs.append((fleet.submit(h, b), h, b))
+        fleet.flush()
+    replicated = fleet.replicate_hot(2)
+    fleet.checkpoint_all()
+    t_crash = None
+    pre_factors = 0.0
+    for wave in range(1, waves):
+        submit_all()
+        if inj.fire("fleet.process"):  # fires at wave 1 exactly once
+            pre_factors = sum(
+                fleet.member(m).metrics.get("factors_total")
+                for m in fleet.alive() if m != victim)
+            t0 = time.perf_counter()
+            fleet.kill(victim)
+            t_crash = time.perf_counter() - t0
+        fleet.flush()
+    # post-crash admission surge: the round-14 shed policy protects the
+    # survivors — excess requests are turned away COUNTED, never lost
+    surge = [fleet.submit("s2", rng.standard_normal(n_small)
+                          .astype(np.float32)) for _ in range(40)]
+    fleet.flush()
+    surge_rejected = sum(1 for f in surge if f.done()
+                         and isinstance(f.exception(), RequestShed))
+    surge_lost = sum(1 for f in surge if not f.done())
+    post_factors = sum(fleet.member(m).metrics.get("factors_total")
+                       for m in fleet.alive())
+
+    wrong = lost = 0
+    outcomes = {"completed": 0, "failed": 0}
+    for f, h, b in futs:
+        if not f.done():
+            lost += 1
+            continue
+        if f.exception() is not None:
+            outcomes["failed"] += 1
+            continue
+        outcomes["completed"] += 1
+        if _check_residual(dense[h], f.result(), b) > RESID_TOL:
+            wrong += 1
+    survivors = fleet.alive()
+    cons = {m: _conservation(fleet.member(m).metrics)
+            for m in survivors}
+    # attribution-fold consistency ACROSS the crash: the survivors'
+    # per-tenant cells still sum bit-exactly to their folded globals
+    # (the dead member lost both sides together — consistent)
+    attr_fold = merge_attribution_snapshots(
+        [fleet.member(m).attribution.snapshot() for m in survivors])
+    metrics_fold = merge_metrics_snapshots(
+        [fleet.member(m).metrics.snapshot() for m in survivors],
+        hosts=survivors)
+    from slate_tpu.obs.attribution import CLASSES
+    attr_ok = all(
+        attr_fold["totals"].get(cls, 0.0)
+        == metrics_fold["counters"].get(counter, 0.0)
+        for cls, counter in CLASSES.items())
+    # the partial-host placement fold: the dead member's checkpoint
+    # keeps its rows in the fleet placement input, marked partial
+    pdoc = fleet.placement()
+    partial_ok = (pdoc["partial_hosts"] == [victim]
+                  and any(r["host"] == victim for r in pdoc["rows"]))
+    g = fleet.metrics.get
+    refactors_after_crash = post_factors - pre_factors
+    report = {
+        "waves": waves,
+        "outcomes": outcomes,
+        "wrong_answers": wrong,
+        "lost_futures": lost + surge_lost,
+        "replicated": [str(h) for h in replicated],
+        "failover_ms": None if t_crash is None else t_crash * 1e3,
+        "refactors_after_crash": refactors_after_crash,
+        "surge": {"submitted": len(surge),
+                  "admission_rejected": surge_rejected},
+        "conservation": {
+            "per_member": cons,
+            "ok": all(c["ok"] for c in cons.values())},
+        "attribution_fold_ok": attr_ok,
+        "partial_placement_fold_ok": partial_ok,
+        "fleet_counters": {k: v for k, v in
+                           fleet.metrics.snapshot()["counters"].items()},
+        "restore_corrupt_total": sum(
+            fleet.member(m).metrics.get("restore_corrupt_total")
+            for m in survivors),
+        "ok": (wrong == 0 and lost == 0 and surge_lost == 0
+               and outcomes["completed"] > 0
+               and all(c["ok"] for c in cons.values())
+               and attr_ok and partial_ok
+               and g("fleet_failover_replica_served") >= 1
+               and g("fleet_replica_stale_refreshes") >= 1
+               and g("fleet_failover_restored") >= 1
+               and g("fleet_failover_requests_total") >= 1
+               and sum(fleet.member(m).metrics
+                       .get("restore_corrupt_total")
+                       for m in survivors) >= 1
+               # replica-served and clean-restored handles refactor
+               # NOTHING; only the stale refresh + the corrupt degrade
+               # pay a refactor — bounded recovery, exactly 2
+               and refactors_after_crash == 2
+               and surge_rejected > 0),
+    }
+    shutil.rmtree(root, ignore_errors=True)
+    return report, inj
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -477,16 +682,19 @@ def run_all(seed, waves):
     mixed, inj_m = run_mixed_drill(seed)
     shed = run_shed_drill(seed)
     numerics = run_numerics_drill(seed)
+    recovery, inj_r = run_recovery_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
-                           for i in (inj, inj_b, inj_m)),
-        "events": sum(len(i.schedule()) for i in (inj, inj_b, inj_m)),
+                           for i in (inj, inj_b, inj_m, inj_r)),
+        "events": sum(len(i.schedule())
+                      for i in (inj, inj_b, inj_m, inj_r)),
         "fired_counts": inj.fired_counts(),
         "opportunities": inj.opportunity_counts(),
     }
     return {"soak": soak, "breaker_drill": drill,
             "mixed_drill": mixed, "shed_drill": shed,
-            "numerics_drill": numerics}, schedule
+            "numerics_drill": numerics,
+            "recovery_drill": recovery}, schedule
 
 
 def main(argv=None):
@@ -523,6 +731,8 @@ def main(argv=None):
                         and phases2["soak"]["ok"])
     plan = soak_plan(args.seed)
     enabled = [s.kind for s in plan.specs if s.rate > 0]
+    enabled += [s.kind for s in recovery_plan(args.seed).specs
+                if s.rate > 0 and s.kind not in enabled]
     invariants = {
         "wrong_answers": sum(ph.get("wrong_answers", 0)
                              for ph in phases.values()),
@@ -536,6 +746,12 @@ def main(argv=None):
         # round 16: the cond~1e12 operand was flagged suspect, demoted
         # off the refine ladder (counted), and still answered correctly
         "numerics_suspect_demoted": phases["numerics_drill"]["ok"],
+        # round 17: process killed mid-soak -> replicas served with no
+        # refactor, corrupt checkpoint caught by checksum and degraded
+        # to a counted refactor, stale replica refreshed, orphaned
+        # requests failed over, attribution + partial-placement folds
+        # consistent across the crash — and never a wrong answer
+        "failover_recovered": phases["recovery_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0

@@ -529,6 +529,74 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
             json.dump(combined, f, indent=1)
             f.write("\n")
 
+        # -- checkpoint round trip (round 17) ---------------------------
+        # the served session's resident state checkpoints to disk, the
+        # manifest validates under BOTH the runtime validator and the
+        # jax-free bench_gate mirror, a fresh session restores it with
+        # ZERO refactors and a bit-identical solve, the restored heat/
+        # health carry over, and the checkpoint-derived placement doc
+        # folds as a partial host — all exit-gating
+        from slate_tpu.runtime import Session as _Session
+        from slate_tpu.runtime.checkpoint import validate_manifest
+        ckpt_dir = os.path.join(out_dir, "checkpoint")
+        manifest = sess.checkpoint(ckpt_dir)
+        if not manifest["records"]:
+            fails.append("checkpoint wrote no resident records")
+        import importlib.util as _ilu
+        _bg_spec = _ilu.spec_from_file_location(
+            "_bench_gate", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench_gate.py"))
+        _bg = _ilu.module_from_spec(_bg_spec)
+        _bg_spec.loader.exec_module(_bg)
+        for which, errs2 in (
+                ("runtime", validate_manifest(manifest)),
+                ("bench_gate mirror",
+                 _bg.validate_checkpoint_manifest(ckpt_dir))):
+            if errs2:
+                fails.append(f"checkpoint manifest failed the {which} "
+                             f"validator: {errs2[:2]}")
+        b_ck = rng.standard_normal(n).astype(np.float64)
+        # both comparison solves run UNPROBED (numerics off for the
+        # reference, sample_fraction=0 for the restored twin): the
+        # fused probe program is a different executable than the plain
+        # solve, and the bit-identity claim is plain-vs-plain
+        saved_nm, sess.numerics = sess.numerics, None
+        x_pre = sess.solve(h, b_ck)
+        sess.numerics = saved_nm
+        rsess = _Session()
+        rsess.enable_attribution()
+        rsess.enable_numerics(sample_fraction=0.0)
+        rsumm = rsess.restore(ckpt_dir)
+        if set(rsumm["restored"]) != {r2["handle"] for r2
+                                      in manifest["records"]}:
+            fails.append(f"restore summary incomplete: {rsumm}")
+        x_post = rsess.solve(h, b_ck)
+        if np.asarray(x_pre).tobytes() != np.asarray(x_post).tobytes():
+            fails.append("restored resident's solve is not "
+                         "bit-identical to the pre-checkpoint solve")
+        if rsess.metrics.get("factors_total") != 0:
+            fails.append("restore refactored (warm restart must not)")
+        if not rsess.attribution.heat(h) > 0:
+            fails.append("restored handle carried no heat")
+        if rsess.numerics.health(h) is None:
+            fails.append("restored handle carried no health state")
+        # partial-host fold: the checkpoint stands in for a crashed
+        # host whose live snapshot is gone
+        part = obs.aggregate.placement_from_checkpoint(manifest,
+                                                       host="dead0")
+        pl_part = obs.aggregate.merge_placement_snapshots(
+            [placement, part])
+        if pl_part.get("partial_hosts") != ["dead0"]:
+            fails.append("partial-host placement fold did not mark "
+                         f"the checkpoint host: {pl_part.get('partial_hosts')}")
+        if not any(r2["host"] == "dead0" for r2 in pl_part["rows"]):
+            fails.append("partial-host fold lost the checkpoint rows")
+        att_part = obs.aggregate.merge_attribution_snapshots(
+            [sess.attribution.snapshot(), None])
+        if att_part.get("partial_processes") != 1:
+            fails.append("attribution fold did not count the partial "
+                         "host")
+
         # -- HTTP endpoint --------------------------------------------
         for path, needle in (("/metrics", "slate_tpu_solves_total"),
                              ("/healthz", '"status": "ok"'),
