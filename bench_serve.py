@@ -72,7 +72,7 @@ apply_env_platforms()
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics")
+    "tenants", "numerics", "quotas")
 
 
 def _tenants_section(sess):
@@ -166,7 +166,15 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     per_request_wall = time.perf_counter() - t0
 
     # -- serving runtime: resident factor + batched dispatch --------------
-    sess = Session(hbm_budget=1 << 30)
+    # round 18: a declared tenant table through the bench — the
+    # artifact's "quotas" section records the policy view (weights,
+    # sub-budgets, live resident bytes) of this exact workload and the
+    # quota counters (all zero here: the bench runs inside its limits
+    # — the A/B that exercises enforcement is --tenants-fair)
+    from slate_tpu.runtime import TenantPolicy
+    sess = Session(hbm_budget=1 << 30, tenant_policies={
+        "bench-a": TenantPolicy(weight=2.0),
+        "bench-b": TenantPolicy(weight=1.0)})
     # round 12: SLO tracking through the bench — the artifact then
     # records what a production scrape of /slo would have said about
     # this exact workload (burn rates per objective, breach states)
@@ -242,6 +250,12 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # healthy-verdict exit gate (a serving bench that cannot tell
         # its operand is healthy cannot be trusted to flag a sick one)
         "numerics": _numerics_section(sess),
+        # round 18: the quota view — the declared tenant policies,
+        # each tenant's live resident bytes vs its sub-budget, and the
+        # quota counters (exit-gated enabled: a bench session whose
+        # tenant table went missing would silently stop exercising the
+        # round-18 seams)
+        "quotas": sess.quotas_payload(),
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -829,6 +843,184 @@ def bench_overload(n=64, nb=32, service_ms=5.0, duration_s=1.5,
     return artifact
 
 
+def bench_tenants_fair(n=48, nb=16, service_ms=10.0, waves=4,
+                       max_batch=4, seed=1,
+                       out_path="BENCH_FAIR_r01.json"):
+    """The round-18 tenant-isolation A/B: the SAME 2× sustained
+    overload — an aggressor tenant arriving at 3× the victim's rate —
+    served FIFO with no quotas (the pre-round-18 runtime) vs with
+    weighted-fair dispatch + tenant quotas ON.
+
+    Service time is pinned by an injected ``slow_device`` fault (the
+    bench_overload recipe: the fault layer doubling as a deterministic
+    load model) and the workload is WAVE-LOCKED on the caller's thread
+    (the chaos_serve determinism discipline — each wave submits the
+    aggressor's 2×-overload backlog plus the victim's modest share,
+    then pumps the Batcher one bucket at a time): the latency story is
+    dispatch ORDER times the pinned service time, not host scheduler
+    noise. Requests carry explicit ``tenant=`` labels so tenant
+    buckets never coalesce (the round-15 key split). In the FAIR arm
+    the victim (weight 4, arriving under its share) keeps a bounded
+    p99 — its buckets dispatch within the DRR starvation bound — and
+    the aggressor's excess is quota-rejected at its in-flight cap,
+    counted per tenant. In the FIFO arm the same seed starves the
+    victim: its p99 tracks the aggressor's whole backlog. Both arms:
+    zero lost futures (every future resolves — completed or
+    counted-rejected), zero wrong answers. Wall-clock numbers on CPU
+    are honest smoke (PERF.md policy): the CLAIM is the shape —
+    bounded vs starved victim p99 under the same overload — which is
+    dispatch-rate-independent."""
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.runtime import (Batcher, FaultPlan, FaultSpec,
+                                   QuotaExceeded, Session, TenantPolicy)
+
+    platform = jax.devices()[0].platform
+    service_s = service_ms * 1e-3
+    rng0 = np.random.default_rng(seed)
+    a = rng0.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    agg_per_wave, victim_per_wave = 10 * max_batch, max_batch
+
+    def run_arm(fair):
+        policies = None
+        if fair:
+            policies = {
+                "victim": TenantPolicy(weight=4.0),
+                "aggressor": TenantPolicy(weight=1.0,
+                                          max_in_flight=4 * max_batch),
+            }
+        rng = np.random.default_rng(seed + 1)
+        sess = Session(tenant_policies=policies)
+        sess.enable_attribution()
+        sess.enable_faults(FaultPlan(seed=seed, specs=(
+            FaultSpec("slow_device", rate=1.0, latency_s=service_s),)))
+        h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                       uplo=st.Uplo.Lower), op="chol",
+                          tenant="victim")
+        sess.warmup(h)
+        bat = Batcher(sess, max_batch=max_batch, max_wait=3600.0)
+        stats = {t: {"submitted": 0, "lat": [], "rejected": 0}
+                 for t in ("victim", "aggressor")}
+        wrong = lost = 0
+        t_start = time.perf_counter()
+        for wave in range(waves + 1):
+            recorded = wave > 0  # wave 0 pays the one-time compiles
+            futs = []
+            for _ in range(agg_per_wave):
+                b = rng.standard_normal(n).astype(np.float32)
+                stats["aggressor"]["submitted"] += recorded
+                futs.append(("aggressor",
+                             bat.submit(h, b, tenant="aggressor"), b))
+            for _ in range(victim_per_wave):
+                b = rng.standard_normal(n).astype(np.float32)
+                stats["victim"]["submitted"] += recorded
+                futs.append(("victim",
+                             bat.submit(h, b, tenant="victim"), b))
+            t0 = time.perf_counter()
+            done_at = {}
+            for key, reqs in bat.pop_ready(force=True):
+                bat.run(key, reqs)
+                now = time.perf_counter() - t0
+                for r in reqs:
+                    done_at[id(r.future)] = now
+            for tenant, f, b in futs:
+                if not f.done():
+                    lost += 1
+                    continue
+                err = f.exception()
+                if err is not None:
+                    if isinstance(err, QuotaExceeded):
+                        stats[tenant]["rejected"] += recorded
+                    else:
+                        lost += 1
+                    continue
+                if recorded:
+                    stats[tenant]["lat"].append(done_at.get(id(f), 0.0))
+                x = f.result()
+                if float(np.abs(spd.astype(np.float64)
+                                @ np.asarray(x, np.float64)
+                                - b).max()) \
+                        / (n * max(float(np.abs(x).max()), 1.0)) > 1e-3:
+                    wrong += 1
+        wall = time.perf_counter() - t_start
+        g = sess.metrics.snapshot()["counters"].get
+
+        def p99(xs):
+            return (sorted(xs)[max(int(0.99 * len(xs)) - 1, 0)]
+                    if xs else 0.0)
+
+        tenants = {}
+        for t, s in stats.items():
+            tenants[t] = {
+                "submitted": s["submitted"],
+                "completed": len(s["lat"]),
+                "quota_rejected": s["rejected"],
+                "reqs_per_sec": (len(s["lat"]) / wall
+                                 if wall > 0 else 0.0),
+                "p50_latency_s": (sorted(s["lat"])[len(s["lat"]) // 2]
+                                  if s["lat"] else 0.0),
+                "p99_latency_s": p99(s["lat"]),
+            }
+        return {
+            "wall_s": wall,
+            "waves": waves,
+            "tenants": tenants,
+            "quota_rejections_total": g("quota_rejections_total", 0.0),
+            "wrong_answers": wrong,
+            "lost_futures": lost,
+        }
+
+    fair = run_arm(True)
+    fifo = run_arm(False)
+    v_fair, v_fifo = fair["tenants"]["victim"], fifo["tenants"]["victim"]
+    ok = (fair["wrong_answers"] == 0 and fifo["wrong_answers"] == 0
+          and fair["lost_futures"] == 0 and fifo["lost_futures"] == 0
+          # the victim arrives under its share: with isolation ON it
+          # completes everything it asked for with a bounded p99;
+          # the SAME overload FIFO starves it
+          and v_fair["completed"] >= 0.8 * v_fair["submitted"]
+          and v_fair["p99_latency_s"] < v_fifo["p99_latency_s"] / 2
+          # the aggressor pays for its own overload: counted quota
+          # rejections ON, none OFF
+          and fair["tenants"]["aggressor"]["quota_rejected"] > 0
+          and fifo["tenants"]["aggressor"]["quota_rejected"] == 0)
+    artifact = {
+        "bench": "serve_fair",
+        "platform": platform,
+        "n": n, "nb": nb,
+        "service_ms": service_ms,
+        "waves": waves,
+        "max_batch": max_batch,
+        "arms": {"fair": fair, "fifo": fifo},
+        "victim_p99_ratio_fifo_over_fair": (
+            v_fifo["p99_latency_s"] / v_fair["p99_latency_s"]
+            if v_fair["p99_latency_s"] > 0 else None),
+        "caveat": ("CPU smoke (TPU tunnel down since round 5): service "
+                   "time is an injected slow-device fault, so the "
+                   "latency scale is synthetic; the bounded-vs-starved "
+                   "victim-p99 SHAPE under the same 2x overload is the "
+                   "claim." if platform == "cpu" else None),
+        "ok": ok,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"# tenants-fair 2x overload: victim p99 "
+          f"{v_fair['p99_latency_s']*1e3:.1f} ms fair vs "
+          f"{v_fifo['p99_latency_s']*1e3:.1f} ms fifo; aggressor "
+          f"rejected {fair['tenants']['aggressor']['quota_rejected']}"
+          f" (fair) vs {fifo['tenants']['aggressor']['quota_rejected']}"
+          f" (fifo)", file=sys.stderr)
+    print(json.dumps({"out": out_path, "ok": ok,
+                      "victim_p99_ms_fair":
+                          v_fair["p99_latency_s"] * 1e3,
+                      "victim_p99_ms_fifo":
+                          v_fifo["p99_latency_s"] * 1e3}))
+    return artifact
+
+
 def bench_failover(n=48, nb=16, n_handles=6, seed=1,
                    out_path="BENCH_FAILOVER_r01.json"):
     """The round-17 failover A/B: the SAME member death recovered with
@@ -1073,6 +1265,15 @@ def main(argv=None):
                         "bounds p99/queue age while the no-shed arm's "
                         "grow (CPU smoke, honestly labeled)")
     p.add_argument("--overload-out", default="BENCH_OVERLOAD_r01.json")
+    p.add_argument("--tenants-fair", action="store_true",
+                   help="run the round-18 tenant-isolation A/B: the "
+                        "same 2x overload (aggressor at 3x the victim's "
+                        "rate) served FIFO/no-quotas vs weighted-fair + "
+                        "quotas; exit 0 iff isolation bounds the victim "
+                        "p99 and quota-rejects the aggressor's excess "
+                        "while FIFO starves the victim (CPU smoke, "
+                        "honestly labeled)")
+    p.add_argument("--fair-out", default="BENCH_FAIR_r01.json")
     p.add_argument("--failover", action="store_true",
                    help="run the round-17 failover A/B: kill a fleet "
                         "member and recover with replication+checkpoint "
@@ -1104,6 +1305,13 @@ def main(argv=None):
     p.add_argument("--sizes", type=int, nargs="+",
                    default=[32, 64, 128, 256])
     args = p.parse_args(argv)
+    if args.tenants_fair:
+        if args.smoke:
+            art = bench_tenants_fair(n=32, nb=16, waves=3,
+                                     out_path=args.fair_out)
+        else:
+            art = bench_tenants_fair(out_path=args.fair_out)
+        return 0 if art["ok"] else 1
     if args.failover:
         if args.smoke:
             art = bench_failover(n=32, nb=16, n_handles=4,

@@ -146,6 +146,19 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --overload FAILED")
+    # tenant-isolation A/B smoke (round 18): the same 2x overload FIFO
+    # vs weighted-fair + quotas — isolation must bound the victim
+    # tenant's p99 and quota-reject the aggressor's excess while FIFO
+    # starves the victim (bench_serve.py exits nonzero otherwise)
+    print("=== bench_serve.py --tenants-fair --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--tenants-fair", "--smoke",
+         "--fair-out", "/tmp/BENCH_FAIR_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --tenants-fair FAILED")
     # failover A/B smoke (round 17): kill a fleet member and recover —
     # replication+checkpoint must recover every affected handle with
     # zero refactors while the cold arm pays one per handle (the

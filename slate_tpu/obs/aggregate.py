@@ -44,6 +44,7 @@ __all__ = [
     "merge_bytes_snapshots",
     "merge_flop_snapshots", "merge_histograms",
     "merge_metrics_snapshots", "merge_placement_snapshots",
+    "merge_quota_payloads",
     "aggregate_processes", "placement_from_checkpoint",
     "render_fleet_prometheus", "write_fleet",
 ]
@@ -356,15 +357,51 @@ def merge_placement_snapshots(docs: Sequence[dict]) -> dict:
     }
 
 
+def merge_quota_payloads(snaps: Sequence[dict]) -> dict:
+    """N ``Session.quotas_payload()`` dicts -> one fleet quota view
+    (round 18): per-tenant resident bytes/counts summed across hosts
+    (the fleet-wide share a capacity planner bills against) and the
+    quota counters folded — ``None``/disabled entries tolerated (a
+    host without a tenant table simply contributes nothing, the
+    partial-host discipline)."""
+    snaps = [s for s in snaps if s and s.get("enabled")]
+    tenants: Dict[str, dict] = {}
+    counters: Dict[str, float] = {}
+    for s in snaps:
+        for t, row in s.get("tenants", {}).items():
+            dst = tenants.setdefault(t, {"resident_bytes": 0.0,
+                                         "residents": 0,
+                                         "max_resident_bytes": None})
+            dst["resident_bytes"] += float(row.get("resident_bytes",
+                                                   0.0) or 0.0)
+            dst["residents"] += int(row.get("residents", 0) or 0)
+            sub = row.get("max_resident_bytes")
+            if sub is not None:
+                # the fleet-wide sub-budget is the per-host budget
+                # summed (each host enforces its own share)
+                dst["max_resident_bytes"] = (
+                    (dst["max_resident_bytes"] or 0) + sub)
+        for k, v in s.get("counters", {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+    return {
+        "enabled": bool(snaps),
+        "processes": len(snaps),
+        "tenants": tenants,
+        "counters": counters,
+    }
+
+
 def aggregate_processes(metric_snaps: Sequence[dict],
                         flop_snaps: Optional[Sequence[dict]] = None,
                         bytes_snaps: Optional[Sequence[dict]] = None,
                         hosts: Optional[Sequence[str]] = None,
                         attribution_snaps: Optional[Sequence[dict]] = None,
-                        placement_docs: Optional[Sequence[dict]] = None
+                        placement_docs: Optional[Sequence[dict]] = None,
+                        quota_payloads: Optional[Sequence[dict]] = None
                         ) -> dict:
     """One fleet document: merged metrics (+ ledgers, tenant
-    attribution, and placement snapshots when given)."""
+    attribution, placement snapshots, and quota payloads when
+    given)."""
     doc = {"fleet": True,
            "metrics": merge_metrics_snapshots(metric_snaps, hosts)}
     if flop_snaps is not None:
@@ -375,6 +412,8 @@ def aggregate_processes(metric_snaps: Sequence[dict],
         doc["attribution"] = merge_attribution_snapshots(attribution_snaps)
     if placement_docs is not None:
         doc["placement"] = merge_placement_snapshots(placement_docs)
+    if quota_payloads is not None:
+        doc["quotas"] = merge_quota_payloads(quota_payloads)
     return doc
 
 
@@ -425,6 +464,29 @@ def render_fleet_prometheus(fleet: dict, prefix: str = "slate_tpu") -> str:
             lines.append(
                 f'{prefix}_fleet_tenant_heat{{tenant="{_san(tenant)}"}} '
                 f"{_num(pt[tenant]['heat'])}")
+    if fleet.get("quotas", {}).get("enabled"):
+        # round 18: the fleet quota rollup — per-tenant resident bytes
+        # against the summed sub-budgets plus the folded quota
+        # counters (rollups only; handle cardinality stays in JSON —
+        # the round-15 discipline)
+        q = fleet["quotas"]
+        lines.append(
+            f"# TYPE {prefix}_fleet_tenant_quota_resident_bytes gauge")
+        for tenant in sorted(q.get("tenants", {})):
+            row = q["tenants"][tenant]
+            lines.append(
+                f'{prefix}_fleet_tenant_quota_resident_bytes'
+                f'{{tenant="{_san(tenant)}"}} '
+                f"{_num(row['resident_bytes'])}")
+            if row.get("max_resident_bytes") is not None:
+                lines.append(
+                    f'{prefix}_fleet_tenant_quota_max_resident_bytes'
+                    f'{{tenant="{_san(tenant)}"}} '
+                    f"{_num(row['max_resident_bytes'])}")
+        for k in sorted(q.get("counters", {})):
+            lines.append(f"# TYPE {prefix}_fleet_{_san(k)} counter")
+            lines.append(
+                f"{prefix}_fleet_{_san(k)} {_num(q['counters'][k])}")
     return "\n".join(lines) + "\n"
 
 

@@ -94,11 +94,15 @@ CLASSES: Dict[str, str] = {
     "failed": "failed_requests_total",
     "shed": "shed_requests_total",
     "expired": "deadline_expired_total",
+    "quota_rejected": "quota_rejections_total",
 }
 
 # request-outcome classes (the round-14 conservation partition of
-# requests_total, minus client cancellations — the pinned convention)
-OUTCOMES = ("completed", "failed", "shed", "expired")
+# requests_total, minus client cancellations — the pinned convention;
+# round 18 grows quota_rejected: a tenant turned away at its OWN
+# declared limit, counted per tenant so the noisy neighbor's
+# rejections never blur into its victims' rows)
+OUTCOMES = ("completed", "failed", "shed", "expired", "quota_rejected")
 
 # seconds grid: 2^-20 s (~0.95 us). Dyadic so sums stay exact (module
 # docstring); fine enough that quantization error per observation is

@@ -43,7 +43,8 @@ def _san(name: str) -> str:
 
 def render_prometheus(snapshot, prefix: str = "slate_tpu",
                       ledger: Optional["flops_mod.FlopLedger"] = None,
-                      bytes_ledger=None, attribution=None) -> str:
+                      bytes_ledger=None, attribution=None,
+                      quotas=None) -> str:
     """Metrics snapshot (or a Metrics instance) -> Prometheus text.
 
     Counters render as ``counter``; histograms as ``summary`` (count,
@@ -69,7 +70,14 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
     handle-level Prometheus label cardinality is the scrape-killer
     the per-tenant rollup exists to avoid. None = no section (the
     default: a session without attribution renders exactly what it
-    rendered before)."""
+    rendered before).
+
+    ``quotas`` (round 18): a ``Session.quotas_payload()`` dict —
+    renders tenant-LABELED quota rows
+    (``{prefix}_tenant_quota_resident_bytes{{tenant="..."}}`` and the
+    declared sub-budget) beside the name-mangled per-tenant gauges
+    the Session already publishes; same rollup-only cardinality
+    discipline. None/disabled = no section."""
     if hasattr(snapshot, "snapshot"):
         snapshot = snapshot.snapshot()
     if ledger is None:
@@ -151,7 +159,37 @@ def render_prometheus(snapshot, prefix: str = "slate_tpu",
                     f' {_num(row["count"])}')
     if attribution is not None:
         lines.extend(render_tenant_sections(attribution, prefix=prefix))
+    if quotas:
+        lines.extend(render_quota_sections(quotas, prefix=prefix))
     return "\n".join(lines) + "\n"
+
+
+def render_quota_sections(quotas: dict, prefix: str = "slate_tpu"
+                          ) -> list:
+    """The tenant-labeled quota rows of a ``quotas_payload()`` dict
+    (round 18): live resident bytes and (where declared) the
+    sub-budget per tenant. Shared shape with the fleet renderer's
+    ``fleet_tenant_quota_*`` rows so the two surfaces cannot drift.
+    Empty when the payload is absent/disabled."""
+    if not isinstance(quotas, dict) or not quotas.get("enabled"):
+        return []
+    lines = []
+    tenants = quotas.get("tenants", {})
+    if tenants:
+        lines.append(
+            f"# TYPE {prefix}_tenant_quota_resident_bytes gauge")
+        for tenant in sorted(tenants):
+            row = tenants[tenant]
+            lines.append(
+                f'{prefix}_tenant_quota_resident_bytes'
+                f'{{tenant="{_san(tenant)}"}} '
+                f"{_num(row.get('resident_bytes', 0))}")
+            if row.get("max_resident_bytes") is not None:
+                lines.append(
+                    f'{prefix}_tenant_quota_max_resident_bytes'
+                    f'{{tenant="{_san(tenant)}"}} '
+                    f"{_num(row['max_resident_bytes'])}")
+    return lines
 
 
 def render_tenant_sections(attribution, prefix: str = "slate_tpu"
@@ -202,8 +240,10 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/metrics":
             attr = (obs.attribution() if callable(obs.attribution)
                     else obs.attribution)
+            quotas = (obs.quotas() if callable(obs.quotas)
+                      else obs.quotas)
             body = render_prometheus(obs.metrics, ledger=obs.ledger,
-                                     attribution=attr)
+                                     attribution=attr, quotas=quotas)
             self._reply(200, body, "text/plain; version=0.0.4")
         elif path == "/healthz":
             snap = obs.metrics.snapshot()
@@ -271,7 +311,7 @@ class ObsServer:
 
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
                  port: int = 0, ledger=None, slo=None, tenants=None,
-                 attribution=None, numerics=None):
+                 attribution=None, numerics=None, quotas=None):
         self.metrics = metrics
         self.tracer = tracer
         # the /slo provider: an SloTracker, or a zero-arg callable
@@ -286,6 +326,9 @@ class ObsServer:
         # round 16: the /numerics payload provider (or getter — same
         # late-enable discipline as /slo and /tenants)
         self.numerics = numerics
+        # round 18: the quotas-payload provider for the /metrics
+        # tenant-labeled quota rows (or getter — same discipline)
+        self.quotas = quotas
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

@@ -224,6 +224,29 @@ class SloTracker:
                 worst = max(worst, row["burn_rate"])
         return worst
 
+    def tenant_burn_rates(self, now: Optional[float] = None
+                          ) -> Dict[str, float]:
+        """Worst SHORT-window burn rate per tenant, over the
+        TENANT-SCOPED objectives only (``Objective(tenant=...)``) —
+        the round-18 tenant-scoped shedding read: a Batcher with a
+        tenant table polls this so a burning tenant sheds ITS OWN
+        cheapest requests first instead of tripping the global
+        trigger. Tenants whose scoped objectives have no short-window
+        traffic contribute nothing; {} when no objective is
+        tenant-scoped."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            snapshots = [(obj, self._events_for(obj))
+                         for obj in self.objectives
+                         if obj.tenant is not None]
+        out: Dict[str, float] = {}
+        for obj, events in snapshots:
+            row = self._window_stats(obj, events, now, min(obj.windows))
+            if row["burn_rate"] is not None:
+                out[obj.tenant] = max(out.get(obj.tenant, 0.0),
+                                      row["burn_rate"])
+        return out
+
     # -- evaluation ---------------------------------------------------------
 
     def _events_for(self, obj: Objective) -> Tuple[_Event, ...]:

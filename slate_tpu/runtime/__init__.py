@@ -23,16 +23,20 @@ from .checkpoint import (CHECKPOINT_SCHEMA, CheckpointCorrupt,
                          validate_manifest)
 from .executor import Executor
 from .faults import (DEGRADATION_LADDER, DeadlineExceeded, FaultInjector,
-                     FaultPlan, FaultSpec, RequestShed,
+                     FaultPlan, FaultSpec, QuotaExceeded, RequestShed,
                      TransientDispatchError, default_plan)
 from .fleet import Fleet
 from .metrics import Histogram, Metrics
 from .session import Session, default_session
+from .tenancy import (DeficitScheduler, TenantPolicy, TenantTable,
+                      TokenBucket)
 
 __all__ = ["Batcher", "Executor", "Fleet", "Histogram", "Metrics",
            "Session", "ShedPolicy", "default_session",
            "CHECKPOINT_SCHEMA", "CheckpointCorrupt", "load_manifest",
            "restore_session", "save_session", "validate_manifest",
            "DEGRADATION_LADDER", "DeadlineExceeded", "FaultInjector",
-           "FaultPlan", "FaultSpec", "RequestShed",
-           "TransientDispatchError", "default_plan"]
+           "FaultPlan", "FaultSpec", "QuotaExceeded", "RequestShed",
+           "TransientDispatchError", "default_plan",
+           "DeficitScheduler", "TenantPolicy", "TenantTable",
+           "TokenBucket"]

@@ -27,6 +27,20 @@ request has waited ``max_wait`` seconds (the serving deadline knob:
 latency floor vs launch amortization). The Batcher itself owns no
 thread — the Executor drives ``pop_ready``/``run``; ``flush`` exists
 for synchronous callers and tests.
+
+**Tenant isolation (round 18).** With a
+:class:`~.tenancy.TenantTable` attached (its own ``tenant_policies=``
+or the Session's), ``submit`` enforces per-tenant quotas at the door
+(in-flight cap, optional flops/s rate — a counted
+:class:`~.faults.QuotaExceeded`, never a silent drop) and
+``pop_ready`` replaces FIFO bucket order with deficit-weighted
+round-robin over per-tenant ready buckets (same buckets, same
+programs, different ORDER — bit-parity pinned; the starvation bound
+is the :class:`~.tenancy.DeficitScheduler` docstring's hand-pinned
+argument). Tenant-scoped SLO objectives shed the burning tenant's own
+cheapest requests first (:meth:`maybe_shed`). ``None`` (the default)
+is the pre-round-18 behavior: one is-None check per seam, zero
+allocation.
 """
 
 from __future__ import annotations
@@ -42,8 +56,9 @@ import numpy as np
 from ..core.exceptions import SlateError
 from ..obs.attribution import s_grid as _s_grid
 from ..obs.tracing import NOOP_SPAN as _NOOP_SPAN
-from .faults import DeadlineExceeded, RequestShed
+from .faults import DeadlineExceeded, QuotaExceeded, RequestShed
 from .session import Session
+from .tenancy import DeficitScheduler, TokenBucket, as_table
 
 
 @dataclasses.dataclass
@@ -119,7 +134,8 @@ class Batcher:
 
     def __init__(self, session: Session, max_batch: int = 32,
                  max_wait: float = 2e-3, pad_widths: bool = False,
-                 shed_policy: Optional[ShedPolicy] = None):
+                 shed_policy: Optional[ShedPolicy] = None,
+                 tenant_policies=None, clock=time.monotonic):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.session = session
@@ -129,6 +145,30 @@ class Batcher:
         # one is-None check per submit / worker wakeup
         self.shed_policy = shed_policy
         self._last_burn_check = 0.0
+        # tenant isolation (round 18, runtime/tenancy.py): quotas at
+        # the submit seam (in-flight cap / flops-rate -> counted
+        # QuotaExceeded, never a silent drop) and deficit-weighted
+        # round-robin dispatch order in pop_ready. Defaults to the
+        # SESSION's table so one declaration covers both seams; None =
+        # the pre-round-18 FIFO behavior, one is-None check per seam,
+        # zero allocation (the round-8 discipline, pinned by test)
+        self.tenants = (as_table(tenant_policies)
+                        if tenant_policies is not None
+                        else getattr(session, "tenant_policies", None))
+        self._clock = clock
+        if self.tenants is not None:
+            self._sched = DeficitScheduler(self.tenants)
+            self._deficit_gauges: set = set()
+            self._tenant_inflight: Dict[str, int] = {}
+            # LRU-capped (tenant strings are client input — arbitrary
+            # cardinality must not leak memory; a pruned tenant's
+            # bucket restarts full, which is the permissive-but-
+            # bounded direction)
+            from collections import OrderedDict as _OD
+            self._tenant_tokens: "_OD[str, TokenBucket]" = _OD()
+            self._tenant_tokens_cap = 1024
+        else:
+            self._sched = None
         # pow2 width quantization (round 11): pad the stacked
         # right-hand side out to the next power of two with zero
         # columns before dispatch, so a varying coalesced width lowers
@@ -205,6 +245,17 @@ class Batcher:
         # key tuples byte-identical to round 14
         tsplit = () if tenant is None else (str(tenant),)
         if skey is not None:
+            if not tsplit and self.tenants is not None:
+                # round 18: with a tenant table attached, implicit-
+                # tenant SMALL groups split by the OPERATOR tenant too
+                # — otherwise two tenants' same-(op, n, dtype)
+                # operators would coalesce into one bucket and the
+                # aggressor's backlog would ride the victim's weight
+                # through the DRR scheduler (review finding, pinned).
+                # Per-handle dense buckets are single-operator-tenant
+                # by construction; without a table the keys stay
+                # byte-identical to round 14 (the round-15 pin).
+                tsplit = (self.session.request_tenant(handle, None),)
             key: BucketKey = (_SMALL,) + skey + tsplit + (
                 tuple(b2.shape), str(b2.dtype))
         else:
@@ -216,13 +267,54 @@ class Batcher:
             req.deadline = req.t_submit + timeout_s
         self.session.metrics.inc("requests_total")
         pol = self.shed_policy
+        table = self.tenants
+        rt = tpol = None
         with self._lock:
+            if table is not None:
+                # tenant quota gate (round 18): the tenant's OWN
+                # limits, checked before the global admission bound —
+                # a QuotaExceeded is counted (quota_rejections_total +
+                # the quota_rejected outcome) by reject_admission,
+                # never a silent drop
+                rt = self.session.request_tenant(handle, req.tenant)
+                tpol = table.policy(rt)
+                if tpol is not None:
+                    if (tpol.max_in_flight is not None
+                            and self._tenant_inflight.get(rt, 0)
+                            >= tpol.max_in_flight):
+                        return req, QuotaExceeded(
+                            f"tenant {rt!r} is over its in-flight cap "
+                            f"({tpol.max_in_flight}); retry with "
+                            "backoff — other tenants are unaffected")
             if (pol is not None and pol.max_queue_depth is not None
                     and self._depth >= pol.max_queue_depth):
                 return req, RequestShed(
                     f"admission control: queue depth >= "
                     f"{pol.max_queue_depth}; request rejected at the "
                     "door (retry with backoff)")
+            if table is not None and tpol is not None \
+                    and tpol.flops_per_s is not None:
+                # the rate DEBIT runs last — after every reject-only
+                # check — so a request turned away at the admission
+                # bound never consumes the tenant's rate budget
+                tb = self._tenant_tokens.get(rt)
+                if tb is None:
+                    tb = self._tenant_tokens[rt] = TokenBucket(
+                        tpol.flops_per_s,
+                        tpol.flops_per_s * tpol.burst_s,
+                        clock=self._clock)
+                    while len(self._tenant_tokens) > \
+                            self._tenant_tokens_cap:
+                        self._tenant_tokens.popitem(last=False)
+                else:
+                    self._tenant_tokens.move_to_end(rt)
+                cost = self.session.recompute_cost(handle, b2.shape[1])
+                if not tb.admit(cost):
+                    return req, QuotaExceeded(
+                        f"tenant {rt!r} is over its "
+                        f"{tpol.flops_per_s:.3g} model-flops/s rate; "
+                        "retry with backoff — other tenants are "
+                        "unaffected")
             bucket = self._buckets.setdefault(key, [])
             bucket.append(req)
             # cheap incremental gauge publish (one batched metrics-
@@ -234,18 +326,65 @@ class Batcher:
             self._max_backlog = max(self._max_backlog, len(bucket))
             if self._oldest is None:
                 self._oldest = req.t_submit  # only pops move it back
-            self.session.metrics.set_gauges({
+            gauges = {
                 "queue_depth": self._depth,
                 "queued_buckets": len(self._buckets),
                 "max_bucket_backlog": self._max_backlog,
                 "oldest_request_age_s": req.t_submit - self._oldest,
-            })
+            }
+            if rt is not None:
+                # in-flight = submitted and unresolved: the cap's
+                # denominator. The done-callback decrements on ANY
+                # resolution path (completed/failed/shed/expired/
+                # cancelled) — registered while the future is pending,
+                # so no client code runs under this lock
+                n_inf = self._tenant_inflight.get(rt, 0) + 1
+                self._tenant_inflight[rt] = n_inf
+                req.future.add_done_callback(
+                    lambda f, t=rt: self._dec_inflight(t))
+                gauges[f"tenant_quota_inflight:{rt}"] = n_inf
+            self.session.metrics.set_gauges(gauges)
         return req, None
 
+    def _dec_inflight(self, tenant: str):
+        """Future-resolution callback: one tenant's in-flight count
+        down (any resolution path — the cap meters live requests). A
+        drained tenant's entry AND gauge are dropped — tenant-string
+        churn must not grow state or scrape cardinality without bound
+        (the round-15 drop_gauge discipline)."""
+        with self._lock:
+            n = self._tenant_inflight.get(tenant, 0) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = n
+        if n <= 0:
+            self.session.metrics.drop_gauge(
+                f"tenant_quota_inflight:{tenant}")
+        else:
+            self.session.metrics.set_gauge(
+                f"tenant_quota_inflight:{tenant}", n)
+
+    def tenant_inflight(self, tenant: str) -> int:
+        with self._lock:
+            return (0 if self._sched is None
+                    else self._tenant_inflight.get(str(tenant), 0))
+
     def reject_admission(self, req: _Request, rejection: Exception):
-        """Resolve an admission-rejected request (call with NO locks
-        held — set_exception may run client callbacks)."""
-        self.session.metrics.inc("admission_rejected_total")
+        """Resolve an admission- or quota-rejected request (call with
+        NO locks held — set_exception may run client callbacks). A
+        :class:`~.faults.QuotaExceeded` counts the round-18 partition
+        (``quota_rejections_total`` + the tenant-labeled
+        ``quota_rejected`` outcome); everything else is the round-14
+        admission bound."""
+        if isinstance(rejection, QuotaExceeded):
+            self.session.metrics.inc("quota_rejections_total")
+            attr = self.session.attribution
+            if attr is not None:
+                attr.record_outcome(self._rtenant(req), req.handle,
+                                    "quota_rejected")
+        else:
+            self.session.metrics.inc("admission_rejected_total")
         req.future.set_exception(rejection)
 
     def pending(self) -> int:
@@ -371,6 +510,33 @@ class Batcher:
                     self._buckets[key] = reqs = rest
                 if not reqs:
                     del self._buckets[key]
+            if self._sched is not None and len(out) > 1:
+                # round 18: deficit-weighted round-robin dispatch
+                # order over per-tenant ready buckets instead of FIFO
+                # dict order — same buckets, same programs, different
+                # ORDER (bit-parity pinned), so a noisy tenant's
+                # backlog cannot push every other tenant's bucket to
+                # the back of the dispatch line. The starvation bound
+                # is the DeficitScheduler docstring's hand-pinned
+                # argument. Bucket tenant: the explicit tenant rides
+                # the key (one bucket = one tenant, the round-15
+                # invariant), else the first request's operator tenant
+                # (request_tenant is lock-free).
+                out = self._sched.order([
+                    (self.session.request_tenant(reqs[0].handle,
+                                                 reqs[0].tenant),
+                     len(reqs), (key, reqs))
+                    for key, reqs in out])
+                deficits = self._sched.deficits()
+                self.session.metrics.set_gauges({
+                    f"fair_share_deficit:{t}": d
+                    for t, d in deficits.items()})
+                # gauges for tenants the scheduler pruned are dropped
+                # (tenant churn must not grow scrape cardinality)
+                for t in self._deficit_gauges - set(deficits):
+                    self.session.metrics.drop_gauge(
+                        f"fair_share_deficit:{t}")
+                self._deficit_gauges = set(deficits)
             if out or expired:
                 self._update_backpressure_locked(now)
         if expired_out is None:
@@ -437,6 +603,8 @@ class Batcher:
             self.session.metrics.set_gauge("shedding_active", 0.0)
             return 0
         trigger = None
+        global_trigger = None
+        shed_tenant: Optional[str] = None
         if (pol.max_age_s is not None and oldest is not None
                 and now - oldest > pol.max_age_s):
             trigger = f"oldest_request_age_s > {pol.max_age_s}"
@@ -446,10 +614,30 @@ class Batcher:
                     and now - self._last_burn_check
                     >= pol.check_interval_s):
                 self._last_burn_check = now
+                # round 18: tenant-scoped objectives shed FIRST and
+                # shed ONLY the burning tenant's requests — a noisy
+                # tenant pays for its own overload before any global
+                # trigger touches its victims' traffic. The GLOBAL
+                # burn check still runs (worst_burn_rate walks every
+                # objective, tenant-scoped included) so that a burning
+                # tenant with nothing left queued cannot suppress the
+                # round-14 overload reflex for everyone else.
+                if self.tenants is not None:
+                    rates = slo.tenant_burn_rates(now=now)
+                    over = {t: b for t, b in rates.items()
+                            if b > pol.burn_threshold}
+                    if over:
+                        shed_tenant = max(over, key=lambda t: over[t])
+                        trigger = (f"tenant {shed_tenant!r} slo burn "
+                                   f"rate {over[shed_tenant]:.3g} > "
+                                   f"{pol.burn_threshold}")
                 burn = slo.worst_burn_rate(now=now)
                 if burn > pol.burn_threshold:
-                    trigger = (f"slo burn rate {burn:.3g} > "
-                               f"{pol.burn_threshold}")
+                    global_trigger = (f"slo burn rate {burn:.3g} > "
+                                      f"{pol.burn_threshold}")
+                    if trigger is None:
+                        trigger = global_trigger
+                        shed_tenant = None
         if trigger is None:
             self.session.metrics.set_gauge("shedding_active", 0.0)
             return 0
@@ -457,20 +645,32 @@ class Batcher:
         with self._lock:
             queued = [(key, r) for key, reqs in self._buckets.items()
                       for r in reqs if not r.future.done()]
+            pool = (queued if shed_tenant is None else
+                    [kr for kr in queued
+                     if self._rtenant(kr[1]) == shed_tenant])
+            if not pool and shed_tenant is not None \
+                    and global_trigger is not None:
+                # the burning tenant has nothing queued: fall back to
+                # the global overload reflex instead of skipping the
+                # whole interval (review finding, pinned)
+                trigger, shed_tenant = global_trigger, None
+                pool = queued
             # the floor: never shed below min_queue_depth live
-            # requests (the docstring contract)
-            n_shed = min(max(1, int(len(queued) * pol.shed_fraction)),
-                         len(queued) - max(pol.min_queue_depth, 1))
+            # requests (the docstring contract); a tenant-scoped shed
+            # draws only from that tenant's pool
+            n_shed = min(max(1, int(len(pool) * pol.shed_fraction)),
+                         len(queued) - max(pol.min_queue_depth, 1),
+                         len(pool))
             if n_shed <= 0:
                 self.session.metrics.set_gauge("shedding_active", 0.0)
                 return 0
             # cheapest-to-recompute first; newest first among equals
             # (the oldest requests are closest to being served)
-            queued.sort(key=lambda kr: (
+            pool.sort(key=lambda kr: (
                 self.session.recompute_cost(kr[1].handle,
                                             kr[1].b.shape[1]),
                 -kr[1].t_submit))
-            chosen = queued[:n_shed]
+            chosen = pool[:n_shed]
             drop = {id(r) for _, r in chosen}
             for key in list(self._buckets):
                 kept = [r for r in self._buckets[key]
@@ -483,6 +683,8 @@ class Batcher:
             self._update_backpressure_locked(now)
         m = self.session.metrics
         m.inc("load_sheds_total")
+        if shed_tenant is not None:
+            m.inc("tenant_sheds_total")
         m.set_gauge("shedding_active", 1.0)
         tr = self.session.tracer
         attr = self.session.attribution

@@ -248,14 +248,24 @@ class Executor:
 
     # -- dispatch: retry, breaker, degradation ladder ----------------------
 
-    def _breaker_key(self, key) -> Optional[Tuple[str, int]]:
+    def _breaker_key(self, key, reqs=None) -> Optional[Tuple]:
         """(op, n) identity of a bucket — the circuit breaker's grain:
         a sick compiled program family is an (op, shape) property, not
-        a per-handle one."""
+        a per-handle one. Round 18: a bucket carrying an EXPLICIT
+        tenant (the tenant rides the bucket key, so one bucket is one
+        tenant) scopes its breaker to (op, n, tenant) — a noisy
+        tenant's failing traffic trips ITS OWN breaker and walks the
+        ladder alone instead of degrading every tenant's same-shape
+        buckets with it."""
         if key and key[0] is _SMALL:
-            return (key[1], key[2])
-        meta = self.session.op_meta(key[0])
-        return meta  # None for unknown handles (deterministic failure)
+            bk = (key[1], key[2])
+        else:
+            bk = self.session.op_meta(key[0])
+        if bk is not None and reqs:
+            t = getattr(reqs[0], "tenant", None)
+            if t is not None:
+                bk = bk + (t,)
+        return bk  # None for unknown handles (deterministic failure)
 
     def _publish_breakers(self):
         self.session.metrics.set_gauge(
@@ -300,7 +310,7 @@ class Executor:
         m = self.session.metrics
         tr = self.session.tracer
         now = time.monotonic()
-        bk = self._breaker_key(key)
+        bk = self._breaker_key(key, reqs)
         br = self._breakers.get(bk) if bk is not None else None
         if br is not None and not br.allow(now):
             # open breaker: never touch the failing path — straight to

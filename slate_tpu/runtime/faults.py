@@ -72,6 +72,11 @@ KINDS = (
     "replica_stale",       # a replica's resident predates the primary's
                            # state -> counted refresh (evict + refactor
                            # from the registered operand), never served
+    # -- round 18: tenant isolation (quotas, fairness, migration) --
+    "migration_abort",     # a migration transfer dies mid-flight ->
+                           # the source keeps serving untouched and the
+                           # coordinator retries, counted — never a
+                           # half-resident on the target
 )
 
 # seam name -> fault kinds evaluated there. The Session/chaos runner
@@ -91,6 +96,10 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "restore": ("restore_corrupt",),
     "fleet.process": ("process_crash",),
     "fleet.replica": ("replica_stale",),
+    # round 18: the Fleet coordinator consults "fleet.migrate" once
+    # per migration transfer attempt (HBM-pressure migration — a fired
+    # migration_abort kills that attempt mid-flight)
+    "fleet.migrate": ("migration_abort",),
 }
 
 # The declared degradation ladder (tentpole): when a serving path keeps
@@ -141,6 +150,18 @@ class RequestShed(SlateError):
     the queue (load shedding) to protect the SLO of the requests that
     stay. Cheapest-to-recompute requests shed first — retrying is
     expected to be cheap for the caller. Never retried server-side."""
+
+
+class QuotaExceeded(SlateError):
+    """The request was turned away at the door because ITS TENANT is
+    over one of its declared limits (in-flight cap or flops/s rate —
+    runtime/tenancy.TenantPolicy): the round-18 isolation reflex.
+    Unlike :class:`RequestShed` (a fleet-health decision that can hit
+    anyone), this is the tenant's own quota — other tenants' traffic
+    is unaffected and the caller should back off or negotiate a bigger
+    quota. Counted in ``quota_rejections_total`` and the conservation
+    partition's ``quota_rejected`` outcome — never a silent drop.
+    Never retried server-side."""
 
 
 # -- the plan ----------------------------------------------------------------

@@ -21,6 +21,17 @@ kills a SLATE job; a lost Session process here loses one replica):
   a **checkpoint transfer** (runtime/checkpoint.py), so the replica's
   resident factor is byte-identical to the primary's, heat and health
   included.
+* **Migration-on-eviction** (round 18): :meth:`migrate_pressured`
+  moves an HBM-pressured member's COLDEST residents (heat rows
+  ascending — the inverse of :meth:`replicate_hot`) to the
+  least-loaded member via the same checkpoint-transfer path, instead
+  of evicting them into refactor-on-miss: byte-identical resident on
+  arrival, routed requests follow the move (queued source requests
+  drain against the still-resident factor first — zero lost
+  futures), 0 refactors vs 1/handle for plain eviction. A seeded
+  ``migration_abort`` kills a transfer attempt mid-flight: the source
+  keeps serving untouched and the coordinator retries once, counted
+  — never a half-resident on the target.
 * **Failover**: :meth:`kill` declares a process death. Its queued
   (in-flight) requests re-route to survivors (counted — zero lost
   futures); its handles walk the recovery ladder: a surviving replica
@@ -303,6 +314,188 @@ class Fleet:
             if len(made) >= top_k:
                 break
         return made
+
+    # -- migration-on-eviction (round 18: HBM-pressure rebalancing) ---------
+
+    def _least_loaded(self, exclude=()) -> Optional[_Member]:
+        """The alive member with the most per-chip HBM headroom (an
+        unbounded member counts its resident bytes as negative load) —
+        the migration TARGET choice the merged placement rows imply."""
+        best, best_key = None, None
+        for name, mem in sorted(self._members.items()):
+            if not mem.alive or name in exclude:
+                continue
+            head = mem.session.hbm_headroom()
+            # sort by (bounded-headroom desc, resident bytes asc):
+            # an unbounded session beats any pressured bounded one
+            key = ((-head if head is not None else float("-inf")),
+                   mem.session.cached_bytes)
+            if best_key is None or key < best_key:
+                best, best_key = mem, key
+        return best
+
+    def _drain_member(self, mem: _Member):
+        """Dispatch everything queued on one member (caller's thread,
+        the pump discipline) so a migration can unregister the source
+        handle with zero lost futures — every queued request against
+        it resolves from the still-resident source factor first."""
+        while True:
+            batches = mem.batcher.pop_ready(force=True)
+            if not batches:
+                break
+            for key, reqs in batches:
+                try:
+                    mem.batcher.run(key, reqs)
+                except Exception as e:  # noqa: BLE001 — futures carry it
+                    for r in reqs:
+                        if not r.future.done():
+                            try:
+                                r.future.set_exception(e)
+                                mem.session.metrics.inc(
+                                    "failed_requests_total")
+                            except InvalidStateError:
+                                pass
+
+    def migrate(self, handle: Hashable,
+                target: Optional[str] = None) -> Optional[str]:
+        """Move one handle's primary residency to another member via
+        the round-17 checkpoint-transfer path — the resident factor
+        arrives BYTE-IDENTICAL (no refactor on the target, pinned) and
+        routed requests follow the move (new submits route to the
+        target; requests already queued on the source drain against
+        the still-resident source factor before it is released — zero
+        lost futures, zero wrong answers). Target defaults to the
+        least-loaded alive member.
+
+        A ``migration_abort`` fault (site ``fleet.migrate``, consulted
+        once per transfer attempt) kills the attempt mid-flight: the
+        source keeps serving untouched, the coordinator retries ONCE
+        (``fleet_migration_retries_total``) — the per-record checksum
+        + register-then-insert restore order mean a half-resident can
+        never exist on the target. Returns the target member name, or
+        None when the migration could not run (no target, cold handle
+        with no spec, or both attempts aborted)."""
+        with self._lock:
+            places = list(self._placement.get(handle, ()))
+            spec = self._specs.get(handle)
+        if not places or spec is None:
+            return None
+        source = self._members[places[0]]
+        if not source.alive:
+            return None  # kill() owns dead-member recovery
+        if target is not None:
+            tmem = self._members[target]
+            if not tmem.alive or target in places:
+                return None
+        else:
+            tmem = self._least_loaded(exclude=set(places))
+            if tmem is None:
+                return None
+        resident = handle in source.session.cached_handles()
+        moved = False
+        for attempt in range(2):
+            if self.faults is not None and any(
+                    s.kind == "migration_abort"
+                    for s in self.faults.fire("fleet.migrate")):
+                # mid-transfer death: the target saw nothing durable
+                # (restore registers only checksum-verified records),
+                # the source is untouched and KEEPS SERVING; counted,
+                # and the second pass is the counted retry
+                self.metrics.inc("fleet_migration_aborts_total")
+                if attempt == 0:
+                    self.metrics.inc("fleet_migration_retries_total")
+                    continue
+                _obs_log.warning(
+                    "fleet: migration of %r aborted twice; source %r "
+                    "keeps serving", handle, source.name)
+                return None
+            if resident:
+                xfer = tempfile.mkdtemp(prefix="slate_migrate_")
+                try:
+                    source.session.checkpoint(xfer, only=[handle],
+                                              host=source.name)
+                    summary = tmem.session.restore(xfer, only=[handle])
+                finally:
+                    shutil.rmtree(xfer, ignore_errors=True)
+                if handle not in summary["registered"]:
+                    return None
+                moved = handle in summary["restored"]
+            else:
+                # cold handle: nothing resident to move — re-register
+                # the retained spec (the target refactors on first
+                # touch, same as the recovery floor)
+                tmem.session.register(spec.A, op=spec.op, handle=handle,
+                                      **spec.kwargs)
+            break
+        # route new traffic to the target BEFORE releasing the source
+        with self._lock:
+            self._placement[handle] = [tmem.name] + [
+                p for p in self._placement.get(handle, ())
+                if p not in (source.name, tmem.name)]
+        # drain requests already queued on the source against its
+        # still-resident factor, then release the source's copy
+        self._drain_member(source)
+        src_res = source.session._cache.get(handle)
+        if src_res is not None:
+            self.metrics.inc("fleet_migrated_bytes", src_res.nbytes)
+        source.session.unregister(handle)
+        self.metrics.inc("fleet_migrations_total")
+        if moved:
+            self.metrics.inc("fleet_migrations_warm")
+        _obs_log.warning(
+            "fleet: migrated %r from %r to %r (%s)", handle,
+            source.name, tmem.name,
+            "byte-identical resident" if moved else "cold re-register")
+        return tmem.name
+
+    def migrate_coldest(self, source: str, k: int = 1,
+                        target: Optional[str] = None) -> List[Hashable]:
+        """Migrate the ``k`` COLDEST residents of one member (the
+        round-15 heat rows rank them — migration evicts the source's
+        least valuable HBM first, the inverse of replicate_hot's
+        hottest-first) to ``target`` (default least-loaded). Returns
+        the handles that moved."""
+        mem = self._members[source]
+        rows = mem.session.placement_snapshot(host=source)["rows"]
+        rows.sort(key=lambda r: (float(r.get("heat") or 0.0),
+                                 str(r.get("handle", ""))))
+        moved = []
+        for row in rows:
+            if len(moved) >= k:
+                break
+            h = self._by_repr.get(str(row.get("handle", "")))
+            if h is None:
+                continue
+            with self._lock:
+                places = self._placement.get(h, ())
+                if not places or places[0] != source:
+                    continue  # this member is only a replica holder
+            if self.migrate(h, target=target) is not None:
+                moved.append(h)
+        return moved
+
+    def migrate_pressured(self, headroom_floor: int = 0,
+                          k: int = 1) -> Dict[str, List[Hashable]]:
+        """The migration-on-eviction reflex: every alive member whose
+        per-chip HBM headroom (resident factors + largest program
+        transient vs its budget) is at or below ``headroom_floor``
+        migrates its ``k`` coldest residents to the least-loaded
+        member — instead of evicting them into refactor-on-miss, the
+        pre-round-18 failure mode. Heat + placement snapshots drive
+        the source/coldest/target choices; the checkpoint-transfer
+        path keeps every moved resident byte-identical. Returns
+        {member: [migrated handles]}."""
+        out: Dict[str, List[Hashable]] = {}
+        for name, mem in sorted(self._members.items()):
+            if not mem.alive:
+                continue
+            head = mem.session.hbm_headroom()
+            if head is None or head > headroom_floor:
+                continue
+            moved = self.migrate_coldest(name, k=k)
+            if moved:
+                out[name] = moved
+        return out
 
     # -- checkpoints --------------------------------------------------------
 

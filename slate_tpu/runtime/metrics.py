@@ -117,7 +117,17 @@ class Metrics:
       AttributionLedger, with grid-snapped values so the per-tenant
       cells sum to them bit-exactly — obs/attribution.py):
       device_seconds_total, queue_seconds_total,
-      residency_byte_seconds_total
+      residency_byte_seconds_total;
+      round-18 tenant isolation (runtime/tenancy.py):
+      quota_rejections_total (a tenant over its own in-flight cap or
+      flops/s rate, turned away counted — joins the conservation
+      partition as the quota_rejected outcome),
+      tenant_quota_evictions_total / tenant_quota_overflows (the
+      per-tenant HBM sub-budget's LRU reflex), tenant_sheds_total
+      (tenant-scoped burn-rate sheds), and the Fleet coordinator's
+      fleet_migrations_total / fleet_migrations_warm /
+      fleet_migrated_bytes / fleet_migration_aborts_total /
+      fleet_migration_retries_total
     Histograms (seconds, except batch_size):
       solve_latency, factor_latency, request_latency, batch_size, and
       the round-12 request lifecycle stages — stage_queue_wait,
@@ -134,7 +144,13 @@ class Metrics:
       slo_breached:* and watchdog_* (obs/slo.py, obs/watchdog.py);
       round-14 reflexes: shedding_active, circuit_breakers_open;
       round-15 handle heat: handle_heat:{tenant}:{handle} — the
-      EWMA access rate the placement snapshot ranks residents by
+      EWMA access rate the placement snapshot ranks residents by;
+      round-18 tenant isolation: tenant_quota_inflight:{tenant}
+      (submitted-and-unresolved, the in-flight cap's live value),
+      tenant_quota_resident_bytes:{tenant} /
+      tenant_quota_hbm_headroom:{tenant} (sub-budget truth), and
+      fair_share_deficit:{tenant} (the DRR scheduler's carried
+      deficit — bounded by one quantum)
     """
 
     def __init__(self):

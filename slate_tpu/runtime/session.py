@@ -80,6 +80,7 @@ from ..obs import numerics as _num
 from ..refine import engine as _refine_engine
 from ..refine.policy import PolicyTable, RefinePolicy
 from .metrics import Metrics
+from .tenancy import as_table as _as_tenant_table
 
 
 def _factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
@@ -244,8 +245,20 @@ class Session:
                  mesh=None, slo=None,
                  refine_policies: Optional[PolicyTable] = None,
                  faults=None, attribution=None, numerics=None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 tenant_policies=None):
         self.hbm_budget = hbm_budget
+        # tenant isolation (round 18, runtime/tenancy.py): a
+        # TenantTable (or {tenant: TenantPolicy} dict) declaring
+        # per-tenant HBM sub-budgets (enforced here at the
+        # factor-insert seam with per-tenant LRU eviction — tenant A's
+        # pressure can NEVER evict tenant B's resident, pinned),
+        # in-flight caps and flops/s rates (enforced at
+        # Batcher.submit), and fair-share weights (the Batcher's
+        # deficit-weighted dispatch). None = disabled: every seam is
+        # one is-None check, zero allocation (the round-8 discipline,
+        # pinned by test).
+        self.tenant_policies = _as_tenant_table(tenant_policies)
         # durable-state directory (round 17): when set, close() flushes
         # a final checkpoint (runtime/checkpoint.py) + placement
         # snapshot there — the artifact the fleet coordinator's
@@ -1133,6 +1146,10 @@ class Session:
                     self.metrics.inc("residency_byte_seconds_total",
                                      inc)
             self._evict_to_budget(keep=handle)
+            if self.tenant_policies is not None:
+                # round 18: the tenant's own sub-budget, after the
+                # global pass (per-tenant LRU, isolation pinned)
+                self._evict_tenant_to_budget(entry.tenant, keep=handle)
             if self.numerics is not None and res.info == 0:
                 res = self._numerics_after_factor(entry, handle, res)
             return res
@@ -1469,6 +1486,111 @@ class Session:
             # an injected exhaustion records the bad event it simulates)
             self.slo.record_oom(used <= budget)
         self._update_hbm_gauges()
+
+    # -- per-tenant HBM sub-budgets (round 18, runtime/tenancy.py) ---------
+
+    @staticmethod
+    def _tname(tenant) -> str:
+        return DEFAULT_TENANT if tenant is None else str(tenant)
+
+    def tenant_resident_bytes(self, tenant=None) -> int:
+        """Per-chip resident factor bytes charged to one tenant (the
+        sub-budget's numerator). Lock-free (GIL-atomic dict walks over
+        immutable fields — the op_meta discipline): scrapes and the
+        fleet's migration-source scan must not wait on an in-flight
+        solve."""
+        t = self._tname(tenant)
+        total = 0
+        for h, res in list(self._cache.items()):
+            e = self._ops.get(h)
+            if e is not None and self._tname(e.tenant) == t:
+                total += res.nbytes
+        return total
+
+    def _evict_tenant_to_budget(self, tenant, keep: Hashable):
+        """Caller holds the lock and verified ``self.tenant_policies``.
+        The per-tenant HBM sub-budget, enforced at the factor-insert
+        seam: when THIS tenant's resident bytes exceed its declared
+        ``max_resident_bytes``, evict ITS residents in LRU order
+        (never ``keep``, never another tenant's — the isolation pin:
+        tenant A's pressure cannot evict tenant B's resident; the
+        GLOBAL budget in _evict_to_budget remains the only
+        cross-tenant eviction authority). A kept factor alone over the
+        sub-budget counts ``tenant_quota_overflows`` — serving
+        continues, the tenant is over its declared share, and the
+        gauge pair says so."""
+        t = self._tname(tenant)
+        pol = self.tenant_policies.policy(t)
+        sub = None if pol is None else pol.max_resident_bytes
+        used = 0
+        for h, res in self._cache.items():
+            e = self._ops.get(h)
+            if e is not None and self._tname(e.tenant) == t:
+                used += res.nbytes
+        if sub is not None:
+            # the SAME walk order the global budget uses
+            # (_eviction_order: round-16 suspect residents lose
+            # tie-breaks, then LRU), filtered to this tenant — one
+            # eviction policy, two budget scopes
+            mine = [h for h in self._eviction_order()
+                    if (e := self._ops.get(h)) is not None
+                    and self._tname(e.tenant) == t]
+            for h in mine:
+                if used <= sub:
+                    break
+                if h == keep:
+                    continue
+                nbytes = self._cache.pop(h).nbytes
+                used -= nbytes
+                self.metrics.inc("evictions")
+                self.metrics.inc("evicted_bytes", nbytes)
+                self.metrics.inc("tenant_quota_evictions_total")
+                if self.attribution is not None:
+                    self._attr_evicted(h)
+            if used > sub:
+                self.metrics.inc("tenant_quota_overflows")
+                _obs_log.warning(
+                    "tenant quota: %r resident bytes %d exceed the "
+                    "declared sub-budget %d (the kept factor alone is "
+                    "over it); serving continues over-share", t, used,
+                    sub)
+            self._update_hbm_gauges()
+        self.metrics.set_gauge(f"tenant_quota_resident_bytes:{t}", used)
+        if sub is not None:
+            self.metrics.set_gauge(f"tenant_quota_hbm_headroom:{t}",
+                                   sub - used)
+
+    def quotas_payload(self) -> dict:
+        """The quota view of the ``/tenants`` route (round 18): the
+        declared policy table, each tenant's live resident bytes
+        against its sub-budget, and the quota counters.
+        ``{"enabled": false}`` without a table."""
+        if self.tenant_policies is None:
+            return {"enabled": False, "tenants": {}}
+        per: Dict[str, dict] = {}
+        for h, res in list(self._cache.items()):
+            e = self._ops.get(h)
+            if e is None:
+                continue
+            t = self._tname(e.tenant)
+            row = per.setdefault(t, {"resident_bytes": 0,
+                                     "residents": 0})
+            row["resident_bytes"] += res.nbytes
+            row["residents"] += 1
+        for t in list(per):
+            pol = self.tenant_policies.policy(t)
+            per[t]["max_resident_bytes"] = (
+                None if pol is None else pol.max_resident_bytes)
+            per[t]["weight"] = self.tenant_policies.weight(t)
+        return {
+            "enabled": True,
+            "policies": self.tenant_policies.to_dict(),
+            "tenants": per,
+            "counters": {k: self.metrics.get(k) for k in (
+                "quota_rejections_total",
+                "tenant_quota_evictions_total",
+                "tenant_quota_overflows", "tenant_sheds_total")},
+        }
 
     # -- solve -------------------------------------------------------------
 
@@ -2066,6 +2188,9 @@ class Session:
                                     "residency_byte_seconds_total",
                                     inc)
                         self._evict_to_budget(keep=h)
+                        if self.tenant_policies is not None:
+                            self._evict_tenant_to_budget(
+                                self._ops[h].tenant, keep=h)
                     programs += 1
                 # per-request residents, in request order (the budget
                 # can in principle evict a just-inserted factor while
@@ -2839,11 +2964,15 @@ class Session:
         accrued to now via the placement pass) and the placement
         snapshot. ``{"enabled": false}`` without a ledger."""
         if self.attribution is None:
-            return {"enabled": False, "tenants": {}}
+            return {"enabled": False, "tenants": {},
+                    "quotas": self.quotas_payload()}
         placement = self.placement_snapshot()  # accrues residency
         payload = self.attribution.snapshot()
         payload["enabled"] = True
         payload["placement"] = placement
+        # round 18: the quota view rides the same route (policies,
+        # per-tenant resident bytes vs sub-budget, quota counters)
+        payload["quotas"] = self.quotas_payload()
         return payload
 
     def numerics_payload(self) -> dict:
@@ -2939,7 +3068,8 @@ class Session:
                     slo=lambda: self.slo,
                     tenants=lambda: self.tenants_payload(),
                     attribution=lambda: self.attribution,
-                    numerics=lambda: self.numerics_payload())
+                    numerics=lambda: self.numerics_payload(),
+                    quotas=lambda: self.quotas_payload())
             return self._obs_server
 
     def close_obs(self):

@@ -1,0 +1,273 @@
+"""Multi-tenant isolation: declarative quotas + deficit-weighted fairness.
+
+ROADMAP item 1's last gap: rounds 12–17 gave the fleet *senses*
+(per-tenant attribution cells, handle heat, placement snapshots),
+global *reflexes* (shedding, breakers, deadlines), and *failover*
+(checkpoint/restore, replication) — but nothing stopped one tenant
+from starving every other: Batcher dispatch was FIFO, the HBM budget
+one global pool, and ShedPolicy shed by cost, never by who was
+overloading the system. SLATE never needed this layer (an MPI job owns
+its allocation; the reference's 2D-block-cyclic world has one user); a
+"millions of users" serving fleet cannot live without it.
+
+* :class:`TenantPolicy` — one tenant's declarative limits: a per-tenant
+  HBM sub-budget over RESIDENT factors (enforced at the Session's
+  factor-insert seam with per-tenant LRU eviction, so tenant A's
+  pressure can never evict tenant B's resident), an in-flight request
+  cap and an optional model-flops/s rate (both enforced at
+  ``Batcher.submit`` — a counted :class:`~.faults.QuotaExceeded`
+  rejection, never a silent drop; the round-14 conservation partition
+  grows a ``quota_rejected`` outcome), and the fair-share ``weight``
+  the scheduler serves it at.
+* :class:`TenantTable` — the tenant -> policy map a Session/Batcher
+  consults (``default`` covers unlisted tenants; ``None`` default =
+  unlisted tenants are unconstrained at weight 1.0).
+* :class:`DeficitScheduler` — deficit-weighted round-robin over
+  per-tenant ready queues, replacing the Batcher's FIFO bucket pop.
+  Pure counter math (no clock), so the starvation bound is
+  hand-pinnable: see :meth:`DeficitScheduler.order`.
+* :class:`TokenBucket` — the optional flops/s rate limiter (injectable
+  clock, so refill math is pinnable without sleeping).
+
+Disabled (``tenant_policies=None``, the default) every seam is one
+``is None`` check and allocates nothing — the round-8 discipline,
+extended here by test. Stdlib-only and jax-free (the faults.py import
+rule: the decision math adds no import weight to the runtime)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's declarative isolation limits.
+
+    ``max_resident_bytes``: per-tenant HBM sub-budget over this
+    tenant's RESIDENT factors (per-chip charge, the round-11
+    convention) — enforced with per-tenant LRU eviction at the
+    Session's factor-insert seam; ``None`` = only the global budget
+    bounds it. ``max_in_flight``: cap on submitted-but-unresolved
+    requests — the (B+1)-th submit is turned away at the door with a
+    counted :class:`~.faults.QuotaExceeded` (``quota_rejections_total``
+    moves, the conservation partition's ``quota_rejected`` outcome
+    records it; never a silent drop). ``weight``: the deficit-round-
+    robin share — a weight-2 tenant gets twice the dispatch slots of a
+    weight-1 tenant under contention (idle capacity always flows to
+    whoever has traffic — DRR is work-conserving). ``flops_per_s``:
+    optional admission rate in model flops (the round-9 recompute-cost
+    vocabulary) metered by a :class:`TokenBucket` with ``burst_s``
+    seconds of rate as depth."""
+
+    max_resident_bytes: Optional[int] = None
+    max_in_flight: Optional[int] = None
+    weight: float = 1.0
+    flops_per_s: Optional[float] = None
+    burst_s: float = 1.0
+
+    def __post_init__(self):
+        if not self.weight > 0.0:
+            raise ValueError(
+                f"TenantPolicy: weight must be > 0, got {self.weight}")
+        if self.max_in_flight is not None and self.max_in_flight < 1:
+            raise ValueError("TenantPolicy: max_in_flight must be >= 1, "
+                             f"got {self.max_in_flight}")
+        if self.max_resident_bytes is not None \
+                and self.max_resident_bytes < 0:
+            raise ValueError("TenantPolicy: max_resident_bytes must be "
+                             f">= 0, got {self.max_resident_bytes}")
+        if self.flops_per_s is not None and not self.flops_per_s > 0.0:
+            raise ValueError("TenantPolicy: flops_per_s must be > 0, "
+                             f"got {self.flops_per_s}")
+        if not self.burst_s > 0.0:
+            raise ValueError(f"TenantPolicy: burst_s must be > 0, "
+                             f"got {self.burst_s}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class TenantTable:
+    """tenant -> :class:`TenantPolicy` with an optional default for
+    unlisted tenants. Immutable after construction (the Session and
+    Batcher read it lock-free, the ``_Operator``-fields discipline)."""
+
+    def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None,
+                 default: Optional[TenantPolicy] = None):
+        self._policies = {str(t): p for t, p in (policies or {}).items()}
+        for t, p in self._policies.items():
+            if not isinstance(p, TenantPolicy):
+                raise TypeError(f"TenantTable: policy for {t!r} is "
+                                f"{type(p).__name__}, not TenantPolicy")
+        if default is not None and not isinstance(default, TenantPolicy):
+            raise TypeError("TenantTable: default must be a TenantPolicy")
+        self.default = default
+
+    def policy(self, tenant: str) -> Optional[TenantPolicy]:
+        return self._policies.get(str(tenant), self.default)
+
+    def weight(self, tenant: str) -> float:
+        pol = self.policy(tenant)
+        return 1.0 if pol is None else pol.weight
+
+    def tenants(self) -> List[str]:
+        return sorted(self._policies)
+
+    def to_dict(self) -> dict:
+        return {
+            "policies": {t: p.to_dict()
+                         for t, p in sorted(self._policies.items())},
+            "default": (None if self.default is None
+                        else self.default.to_dict()),
+        }
+
+
+def as_table(policies) -> Optional[TenantTable]:
+    """Coerce the ``tenant_policies=`` argument: None passes through
+    (the disabled path), a TenantTable is taken as-is, a plain dict of
+    policies builds one."""
+    if policies is None or isinstance(policies, TenantTable):
+        return policies
+    if isinstance(policies, dict):
+        return TenantTable(policies)
+    raise TypeError("tenant_policies must be None, a TenantTable, or a "
+                    f"{{tenant: TenantPolicy}} dict, got "
+                    f"{type(policies).__name__}")
+
+
+class TokenBucket:
+    """Model-flops admission meter (one per rate-limited tenant).
+
+    Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    depth; :meth:`admit` debits ``cost`` tokens or refuses. The clock
+    is injectable so refill math is pinnable without sleeping. NOT
+    thread-safe on its own — the Batcher calls it under its queue
+    lock (the quota seam's lock)."""
+
+    __slots__ = ("rate", "burst", "tokens", "_last", "_clock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)  # start full: a fresh tenant bursts
+        self._clock = clock
+        self._last = clock()
+
+    def admit(self, cost: float, now: Optional[float] = None) -> bool:
+        now = self._clock() if now is None else now
+        self.tokens = min(self.burst,
+                          self.tokens + max(now - self._last, 0.0)
+                          * self.rate)
+        self._last = now
+        if cost > self.tokens:
+            return False
+        self.tokens -= cost
+        return True
+
+
+class DeficitScheduler:
+    """Deficit-weighted round-robin over per-tenant ready buckets.
+
+    The Batcher hands :meth:`order` the buckets one ``pop_ready`` call
+    detached (each tagged with its tenant and its cost = live request
+    count) and dispatches in the returned order. Deficit counters
+    persist ACROSS calls, so long-run dispatch shares converge to the
+    weights even though each call only reorders its own snapshot.
+
+    **Starvation bound (hand-pinned by tests/test_tenancy.py).**
+    Classic DRR: each round every backlogged tenant's deficit grows by
+    ``quantum * weight`` and it emits head buckets while the deficit
+    covers their cost. The quantum is the snapshot's max bucket cost,
+    so a weight-w tenant emits its head bucket after at most
+    ``ceil(cost_head / (quantum * w))`` rounds, and in each round any
+    OTHER tenant j emits at most ``quantum * w_j / cost_min + 1``
+    buckets — so the victim's head bucket is dispatched after a number
+    of foreign buckets bounded by the weights, INDEPENDENT of the
+    aggressor's backlog depth. FIFO has no such bound: the victim
+    waits behind the aggressor's entire arrival history.
+
+    A tenant's carried deficit is bounded by the snapshot quantum (it
+    only grows while the tenant is backlogged, and the growth round
+    immediately spends it down below the head cost), so an idle tenant
+    cannot bank credit and burst past its weight later. The round-robin
+    start rotates one tenant per call, so no tenant owns the "first
+    emitted" slot structurally. Pure counter math, no clock,
+    stdlib-only."""
+
+    def __init__(self, table: TenantTable):
+        self.table = table
+        # tenant -> carried deficit (insertion order = round-robin
+        # order; new tenants join at the tail, the order rotates one
+        # step per order() call)
+        self._deficit: "OrderedDict[str, float]" = OrderedDict()
+
+    def order(self, buckets: Sequence[Tuple[str, int, T]]) -> List[T]:
+        """DRR dispatch order for one snapshot of ready buckets:
+        ``(tenant, cost, item)`` triples in, items out. Every item is
+        returned (detached buckets must all dispatch — fairness is
+        WHO GOES FIRST, the latency lever); the order interleaves
+        tenants by weighted deficit instead of arrival."""
+        if not buckets:
+            return []
+        queues: "OrderedDict[str, List[Tuple[int, T]]]" = OrderedDict()
+        for tenant, cost, item in buckets:
+            queues.setdefault(str(tenant), []).append(
+                (max(int(cost), 1), item))
+        for tenant in queues:
+            self._deficit.setdefault(tenant, 0.0)
+        if len(queues) == 1:
+            # single-tenant snapshot: FIFO is DRR
+            (q,) = queues.values()
+            return [item for _, item in q]
+        quantum = float(max(c for c, _ in
+                            (p for q in queues.values() for p in q)))
+        out: List[T] = []
+        # visit in the persistent round-robin order (the deficit
+        # dict's insertion order), carrying deficits between calls
+        while queues:
+            for tenant in list(self._deficit):
+                q = queues.get(tenant)
+                if not q:
+                    continue
+                self._deficit[tenant] += quantum * \
+                    self.table.weight(tenant)
+                while q and q[0][0] <= self._deficit[tenant]:
+                    cost, item = q.pop(0)
+                    self._deficit[tenant] -= cost
+                    out.append(item)
+                if not q:
+                    # bounded banked credit: a drained tenant carries
+                    # at most one quantum of deficit into the next
+                    # snapshot (without the cap, a high-weight tenant
+                    # draining tiny buckets would bank credit without
+                    # bound call over call)
+                    self._deficit[tenant] = min(self._deficit[tenant],
+                                                quantum)
+                    del queues[tenant]
+        # prune tenants that are absent from this snapshot and carry
+        # no deficit: tenant strings are client input, and the RR
+        # state must not grow with tenant-string churn (the caller
+        # drops the matching gauges — the round-15 cardinality
+        # discipline)
+        seen = {str(t) for t, _, _ in buckets}
+        for t in [t for t, d in self._deficit.items()
+                  if d == 0.0 and t not in seen]:
+            del self._deficit[t]
+        # rotate the round-robin start so the same tenant is not
+        # structurally first in every snapshot
+        if len(self._deficit) > 1:
+            first, val = next(iter(self._deficit.items()))
+            del self._deficit[first]
+            self._deficit[first] = val
+        return out
+
+    def deficits(self) -> Dict[str, float]:
+        """Point-in-time carried deficits (the ``fair_share_deficit``
+        gauge source)."""
+        return dict(self._deficit)

@@ -418,7 +418,11 @@ def test_tenants_route_disabled_payload():
     try:
         body = urllib.request.urlopen(srv.url("/tenants"),
                                       timeout=10).read().decode()
-        assert json.loads(body) == {"enabled": False, "tenants": {}}
+        # round 18: the disabled payload also carries the (disabled)
+        # quota view — both halves off is the full disabled contract
+        assert json.loads(body) == {
+            "enabled": False, "tenants": {},
+            "quotas": {"enabled": False, "tenants": {}}}
     finally:
         sess.close_obs()
 

@@ -77,7 +77,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
         "cost_log": [], "hbm": {}, "slo": {},
         "tenants": _tenants_section(),
-        "numerics": _numerics_section()}
+        "numerics": _numerics_section(),
+        "quotas": _quotas_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -149,6 +150,21 @@ def _numerics_section(state="healthy"):
     }
 
 
+def _quotas_section():
+    """A minimal round-18 serve-artifact quotas section that passes
+    gate_mod._check_quotas_section."""
+    return {
+        "enabled": True,
+        "policies": {"policies": {"bench-a": {"weight": 2.0}},
+                     "default": None},
+        "tenants": {"bench-a": {"resident_bytes": 1024,
+                                "residents": 1,
+                                "max_resident_bytes": None,
+                                "weight": 2.0}},
+        "counters": {"quota_rejections_total": 0.0},
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -182,7 +198,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "serve": {"solves_per_sec": 120.0},
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
         "cost_log": [], "hbm": {}, "slo": {},
-        "numerics": _numerics_section()}
+        "numerics": _numerics_section(),
+        "quotas": _quotas_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]
@@ -467,6 +484,61 @@ def test_serve_failover_missing_arm_rejected(tmp_path):
     with pytest.raises(gate_mod.SchemaError):
         gate_mod.normalize_all(
             str(tmp_path / "BENCH_FAILOVER_r02.json"))
+
+
+def _fair_tenant_row(p99=0.02, rejected=10):
+    return {"submitted": 40, "completed": 30,
+            "quota_rejected": rejected, "reqs_per_sec": 25.0,
+            "p50_latency_s": p99 / 2, "p99_latency_s": p99}
+
+
+def test_normalize_serve_fair_arm_tenant_records(tmp_path):
+    """Round 18: the tenant-isolation A/B artifact normalizes to one
+    record per (arm, tenant) — arm.tenant in the op series slot, so a
+    fair-arm victim series never gates against the fifo-arm one."""
+    art = {"bench": "serve_fair", "platform": "cpu", "n": 32,
+           "nb": 16, "service_ms": 10.0,
+           "arms": {
+               "fair": {"tenants": {
+                   "victim": _fair_tenant_row(0.02, 0),
+                   "aggressor": _fair_tenant_row(0.1, 80)}},
+               "fifo": {"tenants": {
+                   "victim": _fair_tenant_row(0.3, 0),
+                   "aggressor": _fair_tenant_row(0.1, 0)}}},
+           "ok": True}
+    _write(tmp_path, "BENCH_FAIR_r01.json", art)
+    recs = gate_mod.normalize_all(str(tmp_path / "BENCH_FAIR_r01.json"))
+    assert sorted(r["op"] for r in recs) == [
+        "fair.aggressor", "fair.victim", "fifo.aggressor",
+        "fifo.victim"]
+    assert all(r["kind"] == "serve_fair" for r in recs)
+    fv = next(r for r in recs if r["op"] == "fair.victim")
+    assert fv["metrics"]["p99_latency_s"] == 0.02
+    assert fv["metrics"]["reqs_per_sec"] == 25.0
+    # single-object normalize refuses the multi-row artifact
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize(str(tmp_path / "BENCH_FAIR_r01.json"))
+    # missing arm / missing tenant column are rejected
+    _write(tmp_path, "BENCH_FAIR_r02.json",
+           dict(art, arms={"fair": art["arms"]["fair"]}))
+    with pytest.raises(gate_mod.SchemaError):
+        gate_mod.normalize_all(str(tmp_path / "BENCH_FAIR_r02.json"))
+    bad = {"fair": {"tenants": {"victim": {"submitted": 1}}},
+           "fifo": art["arms"]["fifo"]}
+    _write(tmp_path, "BENCH_FAIR_r03.json", dict(art, arms=bad))
+    with pytest.raises(gate_mod.SchemaError, match="p99|completed"):
+        gate_mod.normalize_all(str(tmp_path / "BENCH_FAIR_r03.json"))
+
+
+def test_fair_metrics_classify_lower_is_better():
+    """The per-tenant latency series must enter the baseline
+    lower-is-better (a starved victim read as an improvement would
+    blind the watchdog); throughput stays higher-is-better."""
+    assert gate_mod._direction("p99_latency_s") == "lower"
+    assert gate_mod._direction("p50_latency_s") == "lower"
+    assert gate_mod._direction("quota_rejected") == "lower"
+    assert gate_mod._direction("reqs_per_sec") == "higher"
+    assert gate_mod._direction("completed") == "higher"
 
 
 def test_failover_metrics_classify_lower_is_better():

@@ -175,10 +175,17 @@ class TestWarmRestart:
         assert np.asarray(x1).tobytes() == np.asarray(x2).tobytes()
         assert sess2.metrics.get("factors_total") == 0
 
+    @pytest.mark.slow
     def test_refined_bf16_restore_policy_and_charge(self, tmp_path):
         """Satellite pin: a refined-bf16 resident restores with its
         policy active AND its half-HBM budget charge intact — and the
-        refined solve is bit-identical with zero refactors."""
+        refined solve is bit-identical with zero refactors. Slow
+        (round-18 tier-1 budget): the refined dense start/step
+        programs are their own compiles; tier-1 siblings —
+        test_dense_restore_bit_identical_no_refactor pins the
+        restore-without-refactor bit-identity class, and
+        TestCarryover::test_heat_and_tenant_carry_over pins the
+        metadata carryover."""
         rng = np.random.default_rng(2)
         n, nb = 32, 16
         spd = _spd(rng, n)
@@ -209,10 +216,17 @@ class TestWarmRestart:
         assert sess2.metrics.get("factors_total") == 0
         assert sess2.metrics.get("refine_converged_total") >= 1
 
+    @pytest.mark.slow
     def test_mesh_restore_resharded_on_current_grid(self, tmp_path):
         """Mesh residents restore RE-SHARDED onto the restoring
         session's grid with zero refactors; correctness (not
-        bit-identity) is the cross-placement claim (round-11 rule)."""
+        bit-identity) is the cross-placement claim (round-11 rule).
+        Slow (round-18 tier-1 budget): two DIFFERENT-grid sharded AOT
+        solve compiles dominate; tier-1 sibling —
+        test_dense_restore_bit_identical_no_refactor pins the
+        restore-without-refactor class single-device (the re-shard
+        itself is the round-11 mesh rule, pinned in
+        tests/test_mesh_session.py)."""
         import jax
         if len(jax.devices()) < 4:
             pytest.skip("needs >= 4 devices")

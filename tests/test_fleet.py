@@ -229,7 +229,14 @@ class TestFailover:
         # rung 3 (the floor): one counted refactor-on-miss
         assert fleet.member(target).metrics.get("factors_total") == 1
 
+    @pytest.mark.slow
     def test_orphaned_requests_reroute_zero_lost(self, tmp_path):
+        """Slow (round-18 tier-1 budget): the replicate+kill+re-route
+        sequence pays several restore/refactor program touches; tier-1
+        siblings — test_stale_replica_refreshed_not_served and
+        test_shed_policy_protects_recovery_surge keep the kill()
+        failover path pinned, and the chaos recovery drill exit-gates
+        zero-lost-futures end to end in examples/run_tests.py."""
         rng = np.random.default_rng(6)
         fleet = _fleet(tmp_path)
         m = _diag_dom(rng)

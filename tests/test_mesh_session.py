@@ -156,7 +156,13 @@ def test_unwarmed_mesh_solve_compiles_aot_on_request_path(grid2x4):
 # -- sharded solve ≡ single-device solve -----------------------------------
 
 
+@pytest.mark.slow
 def test_sharded_solve_matches_single_device_f64(mesh_sess, single_sess):
+    """Slow (round-18 tier-1 budget): the (N, 2)-width f64 sharded
+    solve programs for BOTH op kinds are their own GSPMD compiles;
+    tier-1 sibling test_sharded_solve_matches_single_device_f32 pins
+    the mesh ≡ single-device class (and the c64 arm was already
+    slow-marked in round 11)."""
     msess, mhc, mhl = mesh_sess
     ssess, shc, shl = single_sess
     b = RNG.standard_normal((N, 2))

@@ -132,7 +132,14 @@ def test_engine_solve_refined_chol_f64():
 BN = 32
 
 
+@pytest.mark.slow
 def test_batched_mixed_correctness_and_per_item_info():
+    """Slow (round-18 tier-1 budget: this test pays the first fused
+    gesv_mixed_batched bucket compiles of the file). Tier-1 siblings:
+    test_batched_mixed_b1_bit_identical_to_lane pins the fused api
+    kernels (bit-identity subsumes correctness), and
+    test_grouped_mixed_per_item_fallback_isolates_neighbors pins
+    per-item isolation at the Session seam."""
     bsz = 5
     a = np.stack([_diagdom(n=BN, seed=10 + i) for i in range(bsz)])
     b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
@@ -167,8 +174,9 @@ def test_batched_mixed_b1_bit_identical_to_lane():
 @pytest.mark.slow
 def test_batched_mixed_b1_bit_identical_chol_slow():
     """Chol arm of the lane bit-identity (tier-1 sibling: the LU arm
-    above and the grouped ≡ per-request pin below, which exercises the
-    chol-class refined solve kernels at B=1 vs bucket)."""
+    above; the grouped ≡ per-request pin — also exercising refined
+    solve kernels at B=1 vs bucket — moved to slow in round 18, its
+    tier-1 coverage named in its own docstring)."""
     bsz = 5
     b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
     spd = np.stack([_spd(n=BN, seed=30 + i) for i in range(bsz)])
@@ -335,9 +343,15 @@ def test_fallback_disabled_raises():
         sess.solve(h, RNG.standard_normal(N).astype(np.float32))
 
 
+@pytest.mark.slow
 def test_small_nonconvergence_falls_back_counted():
-    """The *_small arm of the same pin (tier-1 sibling of the grouped
-    sweep below)."""
+    """The *_small arm of the same pin. Slow (round-18 tier-1
+    budget): the (max_iters=1, tol=1e-14) chol_small bf16 config is
+    its own bucket-program compile; the tier-1 sibling
+    test_forced_nonconvergence_falls_back_counted pins the counted
+    fallback class on the dense path, and the chaos soak's
+    refine_no_converge injection exercises the small arm end to end
+    in examples/run_tests.py."""
     a = _spd(n=24, seed=10)
     sess = Session()
     h = sess.register(a, op="chol_small",
@@ -364,11 +378,17 @@ def test_bf16_resident_charges_half():
     assert mixed.factor(hm).nbytes * 2 == full.factor(hf).nbytes
 
 
+@pytest.mark.slow
 def test_budget_for_n_f32_residents_holds_2n_bf16():
     """A budget sized for N f32 small residents holds 2N bf16-factored
     ones before eviction (the *_small engine's residents carry no
     analyzed-program transient, so the arithmetic is exact: the
-    bf16 LU payload is n²·2 + perm bytes vs n²·4 + perm)."""
+    bf16 LU payload is n²·2 + perm bytes vs n²·4 + perm). Slow
+    (round-18 tier-1 budget): the 16-register fill experiment is the
+    expensive redundant arm; the tier-1 sibling
+    test_small_bf16_resident_charges_half pins the same half-charge
+    arithmetic directly (and BENCH_MIXED_r01's residents_ratio column
+    records the fit experiment)."""
     n, count = 32, 4
     mats = [_diagdom(n=n, seed=50 + i) for i in range(2 * count)]
     b = RNG.standard_normal(n).astype(np.float32)
@@ -395,10 +415,18 @@ def test_budget_for_n_f32_residents_holds_2n_bf16():
 # -- batched B=1 ≡ per-request (the acceptance pin) -------------------------
 
 
+@pytest.mark.slow
 def test_grouped_mixed_bit_identical_to_per_request():
     """The Batcher's grouped mixed dispatch (ONE batched refined
     program over stacked lo residents) returns bit-identical results
-    to the per-request mixed path (the same bucket programs at B=1)."""
+    to the per-request mixed path (the same bucket programs at B=1).
+    Slow (round-18 tier-1 budget): tier-1 siblings —
+    test_grouped_mixed_per_item_fallback_isolates_neighbors drives
+    the SAME grouped mixed dispatch path (with the harder fallback
+    branch), test_batched_mixed_b1_bit_identical_to_lane pins the
+    B=1 ≡ lane bit-identity of the underlying kernels, and
+    test_attribution.py's test_grouped_mixed_lane_tenant_tallies pins
+    grouped ≡ per-request tallies at n=8."""
     n = 32
     pol = RefinePolicy(factor_dtype="bfloat16")
     mats = [_diagdom(n=n, seed=60 + i) for i in range(3)]
@@ -594,7 +622,11 @@ def test_mesh_served_mixed_f32(mesh_refined):
     assert res.nbytes == res.nbytes_total // 8
 
 
+@pytest.mark.slow
 def test_mesh_served_mixed_f64(mesh_refined):
+    """Slow (round-18 tier-1 budget): the f64 sharded refine
+    start/step programs are their own GSPMD compiles; tier-1 sibling
+    test_mesh_served_mixed_f32 pins the mesh-refined serving class."""
     sess, _, hl, _, dd64 = mesh_refined
     b = RNG.standard_normal(N)
     x = sess.solve(hl, b)

@@ -1,0 +1,649 @@
+"""Multi-tenant isolation (runtime/tenancy.py + the round-18 seams).
+
+The acceptance pins: quota enforcement at BOTH seams — a tenant over
+its in-flight cap or flops/s rate is turned away at ``Batcher.submit``
+with a counted :class:`QuotaExceeded` (the conservation partition's
+``quota_rejected`` outcome, tenant-labeled), and a tenant over its HBM
+sub-budget evicts ITS OWN residents LRU-first at the Session's
+factor-insert seam while another tenant's residents are untouchable
+(the isolation pin); the deficit-weighted round-robin starvation bound
+is hand-pinned (a victim bucket dispatches within a weight-derived
+position bound regardless of the aggressor's backlog depth) and
+dispatch-order fairness is BIT-PARITY safe (same programs, different
+order); grouped small-op dispatch keeps the round-15 tenant-labeled
+"1 miss + B−1 hits" tallies with policies attached; fleet migration
+moves a resident BYTE-IDENTICALLY with routed requests following
+(zero lost futures, zero refactors) and a ``migration_abort`` leaves
+the source serving; the disabled path (``tenant_policies is None``)
+allocates nothing (the round-8 discipline extended).
+"""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st  # noqa: F401 — jax/platform init via conftest
+from slate_tpu.runtime import (Batcher, Fleet, QuotaExceeded, Session,
+                               ShedPolicy, TenantPolicy, TenantTable,
+                               TokenBucket)
+from slate_tpu.runtime.tenancy import DeficitScheduler, as_table
+
+RNG = np.random.default_rng(53)
+N = 8  # small-problem engine: tiny bucket programs, no dense compiles
+
+
+def _small_op(seed=0):
+    rng = np.random.default_rng(200 + seed)
+    return np.asarray(rng.standard_normal((N, N)) + N * np.eye(N))
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- policy table -----------------------------------------------------------
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(max_in_flight=0)
+    with pytest.raises(ValueError):
+        TenantPolicy(max_resident_bytes=-1)
+    with pytest.raises(ValueError):
+        TenantPolicy(flops_per_s=0.0)
+    with pytest.raises(TypeError):
+        TenantTable({"a": object()})
+    with pytest.raises(TypeError):
+        as_table(["not", "a", "table"])
+    assert as_table(None) is None
+    t = as_table({"a": TenantPolicy(weight=2.0)})
+    assert t.weight("a") == 2.0
+    assert t.weight("unlisted") == 1.0  # no default -> unconstrained
+    assert t.policy("unlisted") is None
+    t2 = TenantTable({"a": TenantPolicy()},
+                     default=TenantPolicy(max_in_flight=3))
+    assert t2.policy("anyone").max_in_flight == 3
+
+
+# -- deficit-weighted round-robin (the starvation bound) --------------------
+
+
+def test_drr_starvation_bound_hand_pinned():
+    """THE fairness pin: a weight-4 victim's single ready bucket
+    dispatches within the first ceil(c/(q·w)) + 1 foreign buckets —
+    position ≤ 2 here — INDEPENDENT of the aggressor's backlog depth
+    (FIFO would put it at position backlog+1). Exercised at three
+    backlog depths so the bound's depth-independence is the assertion,
+    not an example."""
+    for backlog in (4, 16, 64):
+        table = TenantTable({"noisy": TenantPolicy(weight=1.0),
+                             "victim": TenantPolicy(weight=4.0)})
+        sched = DeficitScheduler(table)
+        buckets = [("noisy", 4, f"n{i}") for i in range(backlog)]
+        buckets.append(("victim", 4, "v0"))
+        order = sched.order(buckets)
+        assert sorted(order) == sorted(x for _, _, x in buckets)
+        assert order.index("v0") <= 2, (backlog, order[:4])
+
+
+def test_drr_long_run_shares_follow_weights():
+    """Equal-cost buckets, weights 2:1 — the emitted prefix carries
+    ~2 of the heavy tenant per 1 of the light one."""
+    table = TenantTable({"a": TenantPolicy(weight=2.0),
+                         "b": TenantPolicy(weight=1.0)})
+    sched = DeficitScheduler(table)
+    buckets = ([("a", 1, f"a{i}") for i in range(30)]
+               + [("b", 1, f"b{i}") for i in range(30)])
+    order = sched.order(buckets)
+    head = order[:27]
+    na = sum(1 for x in head if x.startswith("a"))
+    nb = sum(1 for x in head if x.startswith("b"))
+    assert na == 2 * nb, (na, nb)
+
+
+def test_drr_deficit_bounded_and_single_tenant_fifo():
+    """Carried deficits stay bounded by one quantum call over call
+    (no banked-credit bursting), and a single-tenant snapshot is plain
+    FIFO."""
+    table = TenantTable({"a": TenantPolicy(weight=8.0),
+                         "b": TenantPolicy(weight=1.0)})
+    sched = DeficitScheduler(table)
+    for _ in range(20):
+        sched.order([("a", 1, "x"), ("b", 4, "y")])
+    assert all(d <= 4.0 for d in sched.deficits().values()), \
+        sched.deficits()
+    assert sched.order([("a", 2, i) for i in range(5)]) == list(range(5))
+
+
+def test_token_bucket_refill_pinned_under_injected_clock():
+    clk = _FakeClock()
+    tb = TokenBucket(rate=100.0, burst=50.0, clock=clk)
+    assert tb.admit(50.0)          # starts full
+    assert not tb.admit(1.0)       # drained
+    clk.t += 0.25                  # refills 25 tokens
+    assert tb.admit(25.0)
+    assert not tb.admit(1.0)
+    clk.t += 10.0                  # refill caps at burst depth
+    assert tb.admit(50.0)
+    assert not tb.admit(1.0)
+
+
+# -- quota enforcement at Batcher.submit ------------------------------------
+
+
+def test_inflight_cap_rejects_counted_and_isolated():
+    """The (B+1)-th submit of a capped tenant fails fast with
+    QuotaExceeded — counted in quota_rejections_total AND the
+    tenant-labeled quota_rejected outcome cell — while another
+    tenant's submits are untouched; the cap re-opens once the
+    in-flight drains (resolution decrements on every path)."""
+    sess = Session(tenant_policies={"t1": TenantPolicy(max_in_flight=2)})
+    sess.enable_attribution()
+    h1 = sess.register(_small_op(0), op="lu_small", tenant="t1")
+    h2 = sess.register(_small_op(1), op="lu_small", tenant="t2")
+    bat = Batcher(sess, max_batch=8, max_wait=3600.0)
+    futs = [bat.submit(h1, RNG.standard_normal(N)) for _ in range(4)]
+    rejected = [f for f in futs if f.done()
+                and isinstance(f.exception(), QuotaExceeded)]
+    assert len(rejected) == 2
+    assert sess.metrics.get("quota_rejections_total") == 2.0
+    f2 = bat.submit(h2, RNG.standard_normal(N))
+    assert not f2.done()  # the other tenant is unaffected
+    bat.flush()
+    for f in futs:
+        if f not in rejected:
+            f.result()
+    f2.result()
+    assert bat.tenant_inflight("t1") == 0  # drained on resolution
+    # conservation: per-tenant outcome cells partition the submissions
+    snap = sess.attribution.snapshot()["tenants"]
+    assert snap["t1"]["totals"]["quota_rejected"] == 2.0
+    assert snap["t1"]["totals"]["completed"] == 2.0
+    assert snap["t2"]["totals"]["completed"] == 1.0
+    assert "quota_rejected" not in snap["t2"]["totals"]
+    # re-opened: the drained tenant submits again
+    f3 = bat.submit(h1, RNG.standard_normal(N))
+    assert not f3.done()
+    bat.flush()
+    f3.result()
+
+
+def test_flops_rate_quota_under_injected_clock():
+    """The optional flops/s rate: a burst admits, the next submit is
+    quota-rejected, advancing the injected clock re-admits — the
+    TokenBucket refill math at the real seam."""
+    clk = _FakeClock()
+    cost = None
+    sess = Session(tenant_policies={
+        "t": TenantPolicy(flops_per_s=1.0, burst_s=1.0)})
+    h = sess.register(_small_op(2), op="lu_small", tenant="t")
+    cost = sess.recompute_cost(h, 1)
+    assert cost > 0
+    # rate sized so exactly ONE request fits the burst
+    sess.tenant_policies = as_table({
+        "t": TenantPolicy(flops_per_s=cost, burst_s=1.0)})
+    bat = Batcher(sess, max_batch=8, max_wait=3600.0, clock=clk)
+    f1 = bat.submit(h, RNG.standard_normal(N))
+    assert not f1.done()
+    f2 = bat.submit(h, RNG.standard_normal(N))
+    assert isinstance(f2.exception(), QuotaExceeded)
+    assert sess.metrics.get("quota_rejections_total") == 1.0
+    clk.t += 1.0  # one second refills one request's cost
+    f3 = bat.submit(h, RNG.standard_normal(N))
+    assert not f3.done()
+    bat.flush()
+    f1.result()
+    f3.result()
+
+
+# -- quota enforcement at the Session's factor-insert seam ------------------
+
+
+def test_per_tenant_eviction_isolation():
+    """Tenant A blowing through its sub-budget evicts A's OWN LRU
+    residents — tenant B's resident is untouchable by A's pressure
+    (THE isolation pin), and the eviction is counted in
+    tenant_quota_evictions_total."""
+    bytes_one = None
+    probe = Session()
+    hp = probe.register(_small_op(10), op="lu_small")
+    probe.solve(hp, RNG.standard_normal(N))
+    bytes_one = probe._cache[hp].nbytes
+    sess = Session(tenant_policies={
+        "a": TenantPolicy(max_resident_bytes=2 * bytes_one)})
+    sess.enable_attribution()
+    hb = sess.register(_small_op(11), op="lu_small", tenant="b")
+    has = [sess.register(_small_op(12 + i), op="lu_small", tenant="a")
+           for i in range(3)]
+    sess.solve(hb, RNG.standard_normal(N))
+    for h in has:
+        sess.solve(h, RNG.standard_normal(N))
+    cached = sess.cached_handles()
+    assert hb in cached                      # B survived A's pressure
+    assert has[0] not in cached              # A's own LRU evicted
+    assert has[1] in cached and has[2] in cached
+    assert sess.metrics.get("tenant_quota_evictions_total") == 1.0
+    assert sess.tenant_resident_bytes("a") == 2 * bytes_one
+    assert sess.metrics.get_gauge(
+        "tenant_quota_resident_bytes:a") == 2 * bytes_one
+    assert sess.metrics.get_gauge(
+        "tenant_quota_hbm_headroom:a") == 0.0
+    q = sess.quotas_payload()
+    assert q["enabled"] and q["tenants"]["a"]["residents"] == 2
+    assert q["tenants"]["b"]["max_resident_bytes"] is None
+
+
+def test_kept_factor_over_sub_budget_counts_overflow():
+    """A single factor larger than its tenant's whole sub-budget is
+    KEPT (you cannot serve without it) and counted — the
+    budget_overflows convention, tenant-scoped."""
+    sess = Session(tenant_policies={
+        "a": TenantPolicy(max_resident_bytes=1)})
+    h = sess.register(_small_op(20), op="lu_small", tenant="a")
+    x = sess.solve(h, RNG.standard_normal(N))
+    assert np.isfinite(np.asarray(x)).all()
+    assert h in sess.cached_handles()
+    assert sess.metrics.get("tenant_quota_overflows") >= 1.0
+
+
+# -- weighted-fair dispatch through the Batcher -----------------------------
+
+
+def test_pop_ready_drr_order_and_bit_parity():
+    """End-to-end fairness pin: with an aggressor's deep backlog and
+    one victim bucket queued, pop_ready's dispatch order puts the
+    victim's bucket within the DRR bound (FIFO dict order would put
+    it LAST); and the solutions are BIT-IDENTICAL to a FIFO batcher's
+    — same buckets, same programs, different order."""
+    def build(policies):
+        sess = Session(tenant_policies=policies)
+        hn = sess.register(_small_op(30), op="lu_small",
+                           tenant="noisy")
+        hv = sess.register(_small_op(31), op="lu_small",
+                           tenant="victim")
+        return sess, hn, hv
+
+    rhs = [RNG.standard_normal(N) for _ in range(13)]
+
+    def run(policies):
+        sess, hn, hv = build(policies)
+        bat = Batcher(sess, max_batch=2, max_wait=3600.0)
+        futs = [bat.submit(hn, b, tenant="noisy") for b in rhs[:12]]
+        futs.append(bat.submit(hv, rhs[12], tenant="victim"))
+        order = []
+        for key, reqs in bat.pop_ready(force=True):
+            order.append(sess.request_tenant(reqs[0].handle,
+                                             reqs[0].tenant))
+            bat.run(key, reqs)
+        return [np.asarray(f.result()) for f in futs], order
+
+    fair_pol = {"noisy": TenantPolicy(weight=1.0),
+                "victim": TenantPolicy(weight=2.0)}
+    xs_fair, order_fair = run(fair_pol)
+    xs_fifo, order_fifo = run(None)
+    # FIFO: the victim's bucket dispatches dead last
+    assert order_fifo[-1] == "victim" and len(order_fifo) == 7
+    # DRR: within the starvation bound, not behind the whole backlog
+    assert order_fair.index("victim") <= 2, order_fair
+    # fair-share deficit gauges published for both tenants
+    # (cardinality = tenants, the rollup discipline)
+    # bit-parity: same programs, different order
+    for a, b in zip(xs_fair, xs_fifo):
+        assert (a == b).all()
+
+
+def test_fair_share_deficit_gauges_published():
+    sess = Session(tenant_policies={"a": TenantPolicy(),
+                                    "b": TenantPolicy()})
+    ha = sess.register(_small_op(32), op="lu_small", tenant="a")
+    hb = sess.register(_small_op(33), op="lu_small", tenant="b")
+    bat = Batcher(sess, max_batch=4, max_wait=3600.0)
+    fa = bat.submit(ha, RNG.standard_normal(N), tenant="a")
+    fb = bat.submit(hb, RNG.standard_normal(N), tenant="b")
+    bat.flush()
+    fa.result()
+    fb.result()
+    gauges = sess.metrics.snapshot()["gauges"]
+    assert "fair_share_deficit:a" in gauges
+    assert "fair_share_deficit:b" in gauges
+
+
+# -- tenant-scoped shedding + breakers --------------------------------------
+
+
+def test_tenant_scoped_shed_victimizes_only_the_burning_tenant():
+    """A tenant-scoped Objective burning past the threshold sheds
+    ONLY that tenant's queued requests (cheapest-first), counted in
+    tenant_sheds_total; the other tenant's queue is untouched."""
+    from slate_tpu.obs.slo import Objective, SloTracker
+
+    clk = _FakeClock()
+    slo = SloTracker((Objective("noisy_errors", "error_rate", 0.9,
+                                tenant="noisy", windows=(60.0,)),),
+                     clock=clk)
+    sess = Session(tenant_policies={"noisy": TenantPolicy(),
+                                    "victim": TenantPolicy()})
+    sess.slo = slo
+    slo.metrics = sess.metrics
+    hn = sess.register(_small_op(40), op="lu_small", tenant="noisy")
+    hv = sess.register(_small_op(41), op="lu_small", tenant="victim")
+    # the noisy tenant's scoped objective burns (all-bad events)
+    for _ in range(10):
+        slo.record_request("lu_small", N, 0.0, ok=False,
+                           tenant="noisy", t=clk.t)
+    assert slo.tenant_burn_rates(now=clk.t)["noisy"] > 1.0
+    bat = Batcher(sess, max_batch=64, max_wait=3600.0,
+                  shed_policy=ShedPolicy(burn_threshold=1.0,
+                                         shed_fraction=1.0,
+                                         min_queue_depth=1,
+                                         check_interval_s=0.0))
+    nf = [bat.submit(hn, RNG.standard_normal(N), tenant="noisy")
+          for _ in range(4)]
+    vf = [bat.submit(hv, RNG.standard_normal(N), tenant="victim")
+          for _ in range(4)]
+    # the injected clock drives the burn-rate windows, so the shed
+    # check evaluates at the same instant the events were recorded
+    shed = bat.maybe_shed(now=clk.t)
+    assert shed >= 1
+    assert sess.metrics.get("tenant_sheds_total") == 1.0
+    assert all(not f.done() for f in vf)       # victim untouched
+    assert any(f.done() for f in nf)           # noisy paid
+    bat.flush()
+    for f in vf:
+        f.result()
+
+
+def test_breaker_key_tenant_scoped_for_explicit_tenants():
+    """An explicit-tenant bucket's circuit breaker is (op, n, tenant)
+    — a noisy tenant's failing traffic cannot open every tenant's
+    same-shape breaker; implicit buckets keep the round-14 (op, n)
+    grain."""
+    from slate_tpu.runtime.executor import Executor
+
+    sess = Session()
+    h = sess.register(_small_op(42), op="lu_small")
+    ex = Executor(sess, max_batch=2, max_wait=3600.0)
+    try:
+        req, _ = ex.batcher.submit_deferred(h, RNG.standard_normal(N),
+                                            tenant="noisy")
+        (key, reqs), = ex.batcher.pop_ready(force=True)
+        bk = ex._breaker_key(key, reqs)
+        assert bk[-1] == "noisy" and len(bk) == 3
+        ex.batcher.run(key, reqs)
+        req.future.result()
+        req2, _ = ex.batcher.submit_deferred(h, RNG.standard_normal(N))
+        (key2, reqs2), = ex.batcher.pop_ready(force=True)
+        assert ex._breaker_key(key2, reqs2) == ("lu_small", N)
+        ex.batcher.run(key2, reqs2)
+        req2.future.result()
+    finally:
+        ex.shutdown()
+
+
+# -- grouped dispatch parity with policies attached -------------------------
+
+
+def test_grouped_tenant_parity_with_policies():
+    """The round-15 tenant-labeled "1 miss + B−1 hits" pin survives an
+    attached tenant table: grouped small dispatch produces the SAME
+    tenant-labeled hit/miss/outcome tallies as B per-request solves,
+    and no quota counter moves (the bucket runs inside its limits)."""
+    bs = [RNG.standard_normal(N) for _ in range(3)]
+
+    def tallies(grouped):
+        sess = Session(tenant_policies={
+            "ta": TenantPolicy(weight=2.0, max_in_flight=16)})
+        sess.enable_attribution()
+        h = sess.register(_small_op(50), op="lu_small", tenant="ta")
+        if grouped:
+            bat = Batcher(sess, max_batch=8, max_wait=3600.0)
+            futs = [bat.submit(h, b) for b in bs]
+            bat.flush()
+            xs = [f.result() for f in futs]
+        else:
+            xs = [sess.solve(h, b) for b in bs]
+        snap = sess.attribution.snapshot()["tenants"]["ta"]["totals"]
+        assert sess.metrics.get("quota_rejections_total") == 0.0
+        return ({k: v for k, v in snap.items()
+                 if k in ("cache_hits", "cache_misses", "completed",
+                          "solve_flops", "factor_flops")},
+                [np.asarray(x) for x in xs])
+
+    g, xs_g = tallies(True)
+    p, xs_p = tallies(False)
+    assert g["cache_hits"] == p["cache_hits"] == 2.0
+    assert g["cache_misses"] == p["cache_misses"] == 1.0
+    assert g["solve_flops"] == p["solve_flops"]
+    assert g["factor_flops"] == p["factor_flops"]
+    for a, b in zip(xs_g, xs_p):
+        assert (a == b).all()  # grouped ≡ per-request bits
+
+
+# -- migration (fleet) ------------------------------------------------------
+
+
+def test_migration_byte_identity_and_follow_the_handle():
+    """Fleet migration moves a resident BYTE-IDENTICALLY via the
+    checkpoint-transfer path; a request queued on the source at
+    migration time still resolves (zero lost futures); post-migration
+    requests route to the target and pay ZERO refactors — while plain
+    eviction of a sibling handle pays one."""
+    import jax
+
+    sessions = {f"p{i}": Session() for i in range(2)}
+    for s in sessions.values():
+        s.enable_attribution()
+    fleet = Fleet(sessions, max_batch=4, max_wait=3600.0)
+    mats = {f"s{i}": _small_op(60 + i) for i in range(2)}
+    for name, m in sorted(mats.items()):
+        fleet.register(m, op="lu_small", handle=name, member="p0")
+    b = RNG.standard_normal(N)
+    for name in sorted(mats):
+        f = fleet.submit(name, b)
+        fleet.flush()
+        f.result()
+    pre = jax.tree_util.tree_leaves(
+        fleet.member("p0")._cache["s0"].payload)
+    pre_factors = sum(fleet.member(m).metrics.get("factors_total")
+                      for m in fleet.alive())
+    fq = fleet.submit("s0", b)  # queued across the migration
+    assert fleet.migrate("s0") == "p1"
+    assert fq.done() and fq.exception() is None
+    assert "s0" not in fleet.member("p0")
+    assert fleet.placement_of("s0") == ["p1"]
+    post = jax.tree_util.tree_leaves(
+        fleet.member("p1")._cache["s0"].payload)
+    assert len(pre) == len(post)
+    for x, y in zip(pre, post):
+        assert (np.asarray(x) == np.asarray(y)).all()
+    # follow-the-handle: the next solve routes to p1, zero refactors
+    f2 = fleet.submit("s0", b)
+    fleet.flush()
+    x2 = np.asarray(f2.result())
+    m = mats["s0"]
+    assert float(np.abs(m @ x2.astype(np.float64) - b).max()) \
+        / (N * max(float(np.abs(x2).max()), 1.0)) < 1e-6
+    assert sum(fleet.member(mm).metrics.get("factors_total")
+               for mm in fleet.alive()) == pre_factors
+    # the control: eviction pays a refactor on the next touch
+    fleet.member("p0").evict("s1")
+    f3 = fleet.submit("s1", b)
+    fleet.flush()
+    f3.result()
+    assert sum(fleet.member(mm).metrics.get("factors_total")
+               for mm in fleet.alive()) == pre_factors + 1
+
+
+def test_migration_abort_leaves_source_serving():
+    """A fired migration_abort kills the transfer attempt mid-flight:
+    the source keeps serving untouched, the retry is counted, and two
+    consecutive aborts give up WITHOUT a half-resident anywhere."""
+    from slate_tpu.runtime import FaultInjector, FaultPlan, FaultSpec
+
+    sessions = {f"p{i}": Session() for i in range(2)}
+    inj = FaultInjector(FaultPlan(seed=9, specs=(
+        FaultSpec("migration_abort", rate=1.0, count=2),)))
+    fleet = Fleet(sessions, max_batch=4, max_wait=3600.0, faults=inj)
+    m = _small_op(70)
+    fleet.register(m, op="lu_small", handle="s0", member="p0")
+    b = RNG.standard_normal(N)
+    f = fleet.submit("s0", b)
+    fleet.flush()
+    f.result()
+    # both attempts abort -> give up; source untouched and serving
+    assert fleet.migrate("s0") is None
+    assert fleet.metrics.get("fleet_migration_aborts_total") == 2.0
+    assert fleet.metrics.get("fleet_migration_retries_total") == 1.0
+    assert "s0" in fleet.member("p0")
+    assert "s0" not in fleet.member("p1")
+    f2 = fleet.submit("s0", b)
+    fleet.flush()
+    f2.result()
+    # fault budget exhausted -> the next migration lands
+    assert fleet.migrate("s0") == "p1"
+
+
+def test_empty_tenant_pool_falls_back_to_global_shed():
+    """Review pin: a burning tenant with NOTHING queued must not
+    suppress the round-14 global overload reflex for the interval —
+    when its pool is empty and the global burn is also over
+    threshold, the shed falls back to the global cheapest-first
+    pool."""
+    from slate_tpu.obs.slo import Objective, SloTracker
+
+    clk = _FakeClock()
+    slo = SloTracker((Objective("noisy_errors", "error_rate", 0.9,
+                                tenant="noisy", windows=(60.0,)),),
+                     clock=clk)
+    sess = Session(tenant_policies={"noisy": TenantPolicy(),
+                                    "victim": TenantPolicy()})
+    sess.slo = slo
+    slo.metrics = sess.metrics
+    hv = sess.register(_small_op(45), op="lu_small", tenant="victim")
+    for _ in range(10):
+        slo.record_request("lu_small", N, 0.0, ok=False,
+                           tenant="noisy", t=clk.t)
+    bat = Batcher(sess, max_batch=64, max_wait=3600.0,
+                  shed_policy=ShedPolicy(burn_threshold=1.0,
+                                         shed_fraction=0.5,
+                                         min_queue_depth=1,
+                                         check_interval_s=0.0))
+    # ONLY victim traffic queued: the noisy tenant's pool is empty
+    vf = [bat.submit(hv, RNG.standard_normal(N), tenant="victim")
+          for _ in range(6)]
+    shed = bat.maybe_shed(now=clk.t)
+    assert shed >= 1  # the global reflex still fired
+    assert sess.metrics.get("load_sheds_total") == 1.0
+    assert sess.metrics.get("tenant_sheds_total") == 0.0
+    bat.flush()
+    for f in vf:
+        if not f.done() or f.exception() is None:
+            f.result()
+
+
+def test_implicit_tenant_small_groups_split_with_table():
+    """Review pin: with a tenant table attached, two tenants'
+    same-(op, n, dtype) small operators must NOT coalesce into one
+    bucket on implicit (tenant=None) submits — the aggressor's
+    backlog would ride the victim's weight through the DRR scheduler.
+    Without a table the round-14 coalescing keys are untouched."""
+    def buckets(policies):
+        sess = Session(tenant_policies=policies)
+        ha = sess.register(_small_op(46), op="lu_small", tenant="a")
+        hb = sess.register(_small_op(47), op="lu_small", tenant="b")
+        bat = Batcher(sess, max_batch=8, max_wait=3600.0)
+        futs = [bat.submit(ha, RNG.standard_normal(N)),
+                bat.submit(hb, RNG.standard_normal(N))]
+        popped = bat.pop_ready(force=True)
+        for key, reqs in popped:
+            bat.run(key, reqs)
+        for f in futs:
+            f.result()
+        return popped
+
+    assert len(buckets({"a": TenantPolicy(weight=4.0)})) == 2
+    assert len(buckets(None)) == 1  # round-14 keys byte-identical
+
+
+# -- fleet quota rollups (obs) ----------------------------------------------
+
+
+def test_quota_fold_and_fleet_prom_rollups():
+    """The fleet quota fold sums per-tenant resident bytes and the
+    quota counters across hosts (disabled/None hosts tolerated — the
+    partial-host discipline) and renders tenant-LABELED rollup rows
+    into the fleet Prometheus text."""
+    from slate_tpu.obs import aggregate as agg
+
+    pay = {"enabled": True,
+           "tenants": {"a": {"resident_bytes": 100, "residents": 1,
+                             "max_resident_bytes": 400}},
+           "counters": {"quota_rejections_total": 3.0}}
+    fold = agg.merge_quota_payloads([pay, pay, None,
+                                     {"enabled": False, "tenants": {}}])
+    assert fold["processes"] == 2
+    assert fold["tenants"]["a"]["resident_bytes"] == 200.0
+    assert fold["tenants"]["a"]["max_resident_bytes"] == 800
+    assert fold["counters"]["quota_rejections_total"] == 6.0
+    sess = Session()
+    fleet_doc = agg.aggregate_processes(
+        [sess.metrics.snapshot()], quota_payloads=[pay])
+    text = agg.render_fleet_prometheus(fleet_doc)
+    assert ('slate_tpu_fleet_tenant_quota_resident_bytes'
+            '{tenant="a"} 100') in text
+    assert ('slate_tpu_fleet_tenant_quota_max_resident_bytes'
+            '{tenant="a"} 400') in text
+    assert "slate_tpu_fleet_quota_rejections_total 3" in text
+
+
+def test_metrics_route_renders_labeled_quota_rows():
+    """/metrics on a policied session carries the tenant-labeled
+    quota rows (render_quota_sections through the ObsServer's quotas
+    provider) — rollups only, no handle cardinality."""
+    import urllib.request
+
+    sess = Session(tenant_policies={
+        "qa": TenantPolicy(max_resident_bytes=1 << 20)})
+    h = sess.register(_small_op(90), op="lu_small", tenant="qa")
+    sess.solve(h, RNG.standard_normal(N))
+    srv = sess.serve_obs()
+    try:
+        body = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=10).read().decode()
+    finally:
+        sess.close_obs()
+    assert 'slate_tpu_tenant_quota_resident_bytes{tenant="qa"}' in body
+    assert ('slate_tpu_tenant_quota_max_resident_bytes{tenant="qa"} '
+            '1048576') in body
+
+
+# -- disabled path (round-8 discipline) -------------------------------------
+
+
+def test_disabled_path_allocates_nothing():
+    """``tenant_policies is None`` (every existing caller): no
+    scheduler, no per-tenant state, no quota/fairness gauges, no new
+    counters — the hot path's only new cost is is-None checks."""
+    sess = Session()
+    assert sess.tenant_policies is None
+    h = sess.register(_small_op(80), op="lu_small")
+    bat = Batcher(sess, max_batch=4, max_wait=3600.0)
+    futs = [bat.submit(h, RNG.standard_normal(N)) for _ in range(3)]
+    bat.flush()
+    for f in futs:
+        f.result()
+    assert bat._sched is None
+    assert not hasattr(bat, "_tenant_inflight")
+    snap = sess.metrics.snapshot()
+    assert not any(k.startswith(("tenant_quota", "fair_share"))
+                   for k in snap["gauges"])
+    assert not any(k.startswith(("quota_", "tenant_"))
+                   for k in snap["counters"])
+    assert sess.quotas_payload() == {"enabled": False, "tenants": {}}
+    payload = sess.tenants_payload()
+    assert payload["quotas"]["enabled"] is False

@@ -95,6 +95,13 @@ TRACKED_OVERLOAD = ("p99_latency_s", "max_oldest_age_s", "completed")
 # _direction, availability higher
 TRACKED_FAILOVER = ("failover_s", "recovery_s_max",
                     "refactors_after_crash", "availability")
+# the round-18 tenant-isolation A/B (bench_serve.py --tenants-fair →
+# BENCH_FAIR_r*.json): one record per (arm, tenant); the latency
+# columns classify lower-is-better via _direction (a per-tenant p99
+# series entering the baseline inverted would read starvation as an
+# improvement), reqs_per_sec higher
+TRACKED_FAIR = ("reqs_per_sec", "p50_latency_s", "p99_latency_s",
+                "completed")
 GATED_PLATFORMS = ("tpu", "axon")
 
 # mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
@@ -105,7 +112,7 @@ GATED_PLATFORMS = ("tpu", "axon")
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics")
+    "tenants", "numerics", "quotas")
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
@@ -192,7 +199,8 @@ def normalize(path: str) -> dict:
     if isinstance(obj, dict) and obj.get("bench") in ("multichip",
                                                       "serve_mixed",
                                                       "serve_overload",
-                                                      "serve_failover"):
+                                                      "serve_failover",
+                                                      "serve_fair"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
@@ -222,6 +230,8 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_serve_overload(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_failover":
         return _normalize_serve_failover(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_fair":
+        return _normalize_serve_fair(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "chaos":
         return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
@@ -289,6 +299,46 @@ def _normalize_serve_failover(name: str, obj: dict,
             "op": arm, "ok": bool(obj.get("ok", True)),
             "metrics": _flat_metrics(row, TRACKED_FAILOVER),
         })
+    return out
+
+
+def _normalize_serve_fair(name: str, obj: dict,
+                          rnd: Optional[int]) -> List[dict]:
+    """The round-18 tenant-isolation A/B artifact: {"bench":
+    "serve_fair", "platform", "n", "arms": {"fair": {"tenants":
+    {tenant: {...}}}, "fifo": {...}}, "ok"} — one record per
+    (arm, tenant), the arm.tenant pair in the ``op`` series-key slot
+    (the serve_overload convention) so a fair-arm victim series never
+    gates against the fifo-arm one."""
+    for k in ("platform", "n", "arms", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_fair artifact "
+                              f"missing {k!r}")
+    arms = obj["arms"]
+    if not isinstance(arms, dict) or set(arms) != {"fair", "fifo"}:
+        raise SchemaError(f"{name}: serve_fair arms must be exactly "
+                          "{fair, fifo}")
+    out = []
+    for arm, row in sorted(arms.items()):
+        tenants = row.get("tenants")
+        if not isinstance(tenants, dict) or not tenants:
+            raise SchemaError(
+                f"{name}[arms.{arm}]: serve_fair arm missing tenants")
+        for tenant, trow in sorted(tenants.items()):
+            for k in ("submitted", "completed", "quota_rejected",
+                      "p99_latency_s", "reqs_per_sec"):
+                if k not in trow:
+                    raise SchemaError(
+                        f"{name}[arms.{arm}.{tenant}]: serve_fair "
+                        f"tenant row missing {k!r}")
+            out.append({
+                "round": rnd, "source": f"{name}[{arm}.{tenant}]",
+                "kind": "serve_fair",
+                "platform": str(obj["platform"]), "n": int(obj["n"]),
+                "op": f"{arm}.{tenant}",
+                "ok": bool(obj.get("ok", True)),
+                "metrics": _flat_metrics(trow, TRACKED_FAIR),
+            })
     return out
 
 
@@ -393,7 +443,8 @@ def _normalize_chaos(name: str, obj: dict,
     inv = obj["invariants"]
     for k in ("wrong_answers", "lost_futures", "conservation_ok",
               "slo_consistent", "fleet_fold_ok",
-              "schedule_reproducible"):
+              "schedule_reproducible",
+              "noisy_neighbor_isolated", "migration_zero_refactor"):
         if k not in inv:
             raise SchemaError(f"{name}: chaos invariants missing {k!r}")
     if not isinstance(obj["schedule"], dict) \
@@ -534,6 +585,29 @@ def _check_numerics_section(name: str, section) -> None:
         raise SchemaError(f"{name}: numerics.counters not an object")
 
 
+def _check_quotas_section(name: str, section) -> None:
+    """Validate the round-18 serve-artifact ``quotas`` section: the
+    declared tenant policies, per-tenant resident bytes, and the quota
+    counters — a committed fixture whose quota view went missing means
+    the bench session's tenant table silently fell off."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: quotas section is not an object")
+    for k in ("enabled", "tenants"):
+        if k not in section:
+            raise SchemaError(f"{name}: quotas section missing {k!r}")
+    if not section["enabled"]:
+        raise SchemaError(f"{name}: quotas section disabled (the bench "
+                          "session must carry a tenant table)")
+    for k in ("policies", "counters"):
+        if k not in section or not isinstance(section[k], dict):
+            raise SchemaError(f"{name}: quotas.{k} missing/not an "
+                              "object")
+    for t, row in section["tenants"].items():
+        if not isinstance(row, dict) or "resident_bytes" not in row:
+            raise SchemaError(
+                f"{name}: quotas.tenants[{t!r}] missing resident_bytes")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -563,6 +637,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
                     "bench_serve.py --regen-smoke)")
         _check_tenants_section(name, obj["tenants"])
         _check_numerics_section(name, obj["numerics"])
+        _check_quotas_section(name, obj["quotas"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -633,6 +708,7 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_MIXED_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_OVERLOAD_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_FAILOVER_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_FAIR_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
@@ -716,7 +792,8 @@ def _direction(metric: str) -> str:
     an improvement)."""
     if metric.startswith("residual_") or "latency" in metric \
             or "age_s" in metric or "recovery" in metric \
-            or "failover" in metric or "refactor" in metric:
+            or "failover" in metric or "refactor" in metric \
+            or "quota" in metric:
         return "lower"
     return "higher"
 

@@ -111,7 +111,9 @@ def _operators(rng, n_dense=48, nb=16, n_small=16, n_small_handles=4):
 
 
 def _conservation(metrics) -> dict:
-    """The conservation invariant over one Metrics instance."""
+    """The conservation invariant over one Metrics instance (round 18
+    grows the partition: a tenant turned away at its own quota is a
+    counted ``quota_rejected`` outcome, never a silent drop)."""
     g = metrics.get
     parts = {
         "requests_total": g("requests_total"),
@@ -120,6 +122,7 @@ def _conservation(metrics) -> dict:
         "shed": g("shed_requests_total"),
         "admission_rejected": g("admission_rejected_total"),
         "deadline_expired": g("deadline_expired_total"),
+        "quota_rejected": g("quota_rejections_total"),
         "cancelled": g("cancelled_requests"),
     }
     accounted = sum(v for k, v in parts.items()
@@ -675,6 +678,292 @@ def run_recovery_drill(seed, waves=3):
     return report, inj
 
 
+def run_noisy_drill(seed, waves=3):
+    """Noisy-neighbor isolation drill (round 18): one tenant submits
+    10× its weight's share of the traffic, both arms under the SAME
+    seed — quotas + weighted-fair dispatch ON (the round-18 isolation
+    layer) vs OFF (FIFO, no quotas, the pre-round-18 serving).
+
+    With isolation ON the victim tenant's p99 stays bounded (its
+    buckets dispatch within the DRR starvation bound, not behind the
+    aggressor's whole backlog), it completes EVERYTHING it submitted
+    (its fair share — it runs under it), and the aggressor's excess is
+    quota-rejected at the door, counted per tenant
+    (``quota_rejections_total`` + the tenant-labeled
+    ``quota_rejected`` outcome cells). With isolation OFF the same
+    seed shows victim starvation: its requests wait behind the
+    aggressor's entire arrival history, so its p99 is a multiple of
+    the ON arm's. Both arms: zero wrong answers, zero lost futures,
+    per-tenant outcome conservation (completed/failed/shed/expired/
+    quota_rejected partitions each tenant's submissions), and the
+    victim's solutions are BIT-IDENTICAL across arms — same programs,
+    different dispatch order (the fairness bit-parity pin)."""
+    from slate_tpu.runtime import (Batcher, FaultPlan, FaultSpec,
+                                   QuotaExceeded, Session, TenantPolicy)
+    import slate_tpu as st
+
+    rng0 = np.random.default_rng(seed + 6)
+    n, nb = 32, 16
+    a = rng0.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    ge = (rng0.standard_normal((n, n))
+          + n * np.eye(n)).astype(np.float32)
+    max_batch = 4
+    noisy_per_wave, victim_per_wave = 10 * max_batch, max_batch
+
+    def run_arm(fair):
+        rng = np.random.default_rng(seed + 7)
+        policies = ({"noisy": TenantPolicy(weight=1.0,
+                                           max_in_flight=3 * max_batch),
+                     "victim": TenantPolicy(weight=4.0)}
+                    if fair else None)
+        sess = Session(tenant_policies=policies)
+        sess.enable_attribution()
+        # deterministic service time: every dispatch sleeps 20 ms —
+        # long against this host's real dispatch cost, so completion
+        # order IS the latency story (thread-free pump)
+        inj = sess.enable_faults(FaultPlan(seed=seed, specs=(
+            FaultSpec("slow_device", rate=1.0, latency_s=20e-3),)))
+        hv = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                        uplo=st.Uplo.Lower),
+                           op="chol", tenant="victim", handle="v")
+        hn = sess.register(st.from_dense(ge, nb=nb), op="lu",
+                           tenant="noisy", handle="nz")
+        sess.warmup(hv)
+        sess.warmup(hn)
+        bat = Batcher(sess, max_batch=max_batch, max_wait=3600.0)
+        lat = {"victim": [], "noisy": []}
+        submitted = {"victim": 0, "noisy": 0}
+        xs_victim = []
+        wrong = lost = 0
+        # wave 0 is the untimed warm wave: it pays the one-time bucket
+        # compiles (both arms equally) so the recorded waves' latency
+        # story is dispatch ORDER, not compilation
+        for wave in range(waves + 1):
+            recorded = wave > 0
+            futs = []
+            # the aggressor submits FIRST (its backlog is what FIFO
+            # makes the victim wait behind)
+            for _ in range(noisy_per_wave):
+                b = rng.standard_normal(n).astype(np.float32)
+                submitted["noisy"] += 1
+                futs.append(("noisy", ge, bat.submit(hn, b), b))
+            for _ in range(victim_per_wave):
+                b = rng.standard_normal(n).astype(np.float32)
+                submitted["victim"] += 1
+                futs.append(("victim", spd, bat.submit(hv, b), b))
+            t0 = time.perf_counter()
+            # dispatch one bucket at a time, stamping completion time
+            # (DRR order in the fair arm, FIFO dict order otherwise)
+            done_at = {}
+            for key, reqs in bat.pop_ready(force=True):
+                bat.run(key, reqs)
+                now = time.perf_counter() - t0
+                for r in reqs:
+                    done_at[id(r.future)] = now
+            for tenant, dense, f, b in futs:
+                if not f.done():
+                    lost += 1
+                    continue
+                err = f.exception()
+                if err is not None:
+                    if not isinstance(err, QuotaExceeded):
+                        lost += 1  # only quota rejections are expected
+                    continue
+                if recorded:
+                    lat[tenant].append(done_at.get(id(f), 0.0))
+                x = f.result()
+                if tenant == "victim":
+                    xs_victim.append(np.asarray(x))
+                if _check_residual(dense, x, b) > RESID_TOL:
+                    wrong += 1
+        snap = sess.attribution.snapshot()["tenants"]
+        per_tenant = {
+            t: {cls: row["totals"].get(cls, 0.0)
+                for cls in ("completed", "failed", "shed", "expired",
+                            "quota_rejected")}
+            for t, row in snap.items()}
+        # per-tenant conservation: every submission lands in exactly
+        # one outcome cell of ITS tenant
+        tenant_cons_ok = all(
+            sum(per_tenant.get(t, {}).values()) == submitted[t]
+            for t in submitted)
+
+        def p99(xs):
+            return (sorted(xs)[max(int(0.99 * len(xs)) - 1, 0)]
+                    if xs else 0.0)
+
+        return {
+            "fair": fair,
+            "submitted": dict(submitted),
+            "per_tenant": per_tenant,
+            "victim_p99_s": p99(lat["victim"]),
+            "noisy_p99_s": p99(lat["noisy"]),
+            "victim_completed": len(xs_victim),
+            "quota_rejected": sess.metrics.get("quota_rejections_total"),
+            "conservation": _conservation(sess.metrics),
+            "tenant_conservation_ok": tenant_cons_ok,
+            "wrong_answers": wrong,
+            "lost_futures": lost,
+        }, xs_victim, inj
+
+    fair, xs_fair, inj = run_arm(True)
+    fifo, xs_fifo, _ = run_arm(False)
+    # bit-parity: same programs, different dispatch order — the
+    # victim's solutions are identical bits across arms
+    parity = (len(xs_fair) == len(xs_fifo)
+              and all((a == b).all()
+                      for a, b in zip(xs_fair, xs_fifo)))
+    report = {
+        "arms": {"fair": fair, "fifo": fifo},
+        "victim_p99_ratio_fifo_over_fair": (
+            fifo["victim_p99_s"] / fair["victim_p99_s"]
+            if fair["victim_p99_s"] > 0 else None),
+        "dispatch_order_bit_parity": parity,
+        "wrong_answers": fair["wrong_answers"] + fifo["wrong_answers"],
+        "lost_futures": fair["lost_futures"] + fifo["lost_futures"],
+        "conservation": {
+            "ok": (fair["conservation"]["ok"]
+                   and fifo["conservation"]["ok"]
+                   and fair["tenant_conservation_ok"]
+                   and fifo["tenant_conservation_ok"])},
+        "ok": (fair["wrong_answers"] == 0 and fifo["wrong_answers"] == 0
+               and fair["lost_futures"] == 0
+               and fifo["lost_futures"] == 0
+               and fair["conservation"]["ok"]
+               and fifo["conservation"]["ok"]
+               and fair["tenant_conservation_ok"]
+               and fifo["tenant_conservation_ok"]
+               # isolation ON: the victim completed its whole share
+               # (within-20%-of-fair-share acceptance — it runs UNDER
+               # its share, so the bound is everything it asked for)
+               and fair["victim_completed"]
+               >= 0.8 * fair["submitted"]["victim"]
+               # the aggressor pays: quota rejections on, none off
+               and fair["quota_rejected"] > 0
+               and fifo["quota_rejected"] == 0
+               # starvation shown OFF, bounded ON (same seed)
+               and fair["victim_p99_s"] < fifo["victim_p99_s"] / 2
+               and parity),
+    }
+    return report, inj
+
+
+def run_migration_drill(seed):
+    """Migration-on-eviction drill (round 18): an HBM-pressured fleet
+    member migrates its COLDEST resident to the least-loaded member
+    via the round-17 checkpoint-transfer path instead of evicting it
+    into refactor-on-miss. Exit gates: the migrated resident arrives
+    BYTE-IDENTICAL; a request queued on the source at migration time
+    still resolves (zero lost futures); post-migration solves route to
+    the target and pay 0 refactors, while the control (plain eviction
+    of the same-shaped handle) pays exactly 1; a seeded
+    ``migration_abort`` kills the first transfer attempt mid-flight —
+    the source keeps serving untouched, the retry is counted, and the
+    target never holds a half-resident."""
+    import jax
+
+    from slate_tpu.runtime import (FaultInjector, FaultPlan, FaultSpec,
+                                   Fleet, Session)
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 8)
+    n, nb = 32, 16
+    sessions = {f"p{i}": Session(hbm_budget=64 << 20) for i in range(2)}
+    for s in sessions.values():
+        s.enable_attribution()
+    inj = FaultInjector(FaultPlan(seed=seed, specs=(
+        FaultSpec("migration_abort", rate=1.0, count=1),)))
+    fleet = Fleet(sessions, max_batch=4, max_wait=3600.0, faults=inj)
+    dense = {}
+    for i in range(3):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+        fleet.register(st.hermitian(np.tril(spd), nb=nb,
+                                    uplo=st.Uplo.Lower),
+                       op="chol", handle=f"d{i}", member="p0")
+        dense[f"d{i}"] = spd
+    fleet.warmup()
+    futs = []
+    for h in sorted(dense):
+        b = rng.standard_normal(n).astype(np.float32)
+        futs.append((fleet.submit(h, b), h, b))
+    fleet.flush()
+    # heat: d1/d2 hot, d0 cold -> d0 is the migration candidate
+    for _ in range(3):
+        for h in ("d1", "d2"):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((fleet.submit(h, b), h, b))
+        fleet.flush()
+    src = fleet.member("p0")
+    pre_payload = jax.tree_util.tree_leaves(src._cache["d0"].payload)
+    pre_factors = sum(fleet.member(m).metrics.get("factors_total")
+                      for m in fleet.alive())
+    # a request queued on the source AT migration time must resolve
+    bq = rng.standard_normal(n).astype(np.float32)
+    fq = fleet.submit("d0", bq)
+    # the pressure reflex: source headroom at/below the floor ->
+    # migrate its coldest (the first transfer attempt is
+    # injected-aborted; the counted retry lands it)
+    moved = fleet.migrate_pressured(
+        headroom_floor=src.hbm_headroom(), k=1)
+    migrated_ok = moved.get("p0") == ["d0"]
+    queued_ok = fq.done() and fq.exception() is None
+    post_payload = jax.tree_util.tree_leaves(
+        fleet.member("p1")._cache["d0"].payload) \
+        if "d0" in fleet.member("p1") else []
+    byte_identical = (len(post_payload) == len(pre_payload)
+                      and all((np.asarray(x) == np.asarray(y)).all()
+                              for x, y in zip(pre_payload,
+                                              post_payload)))
+    # routed requests follow: next solve lands on p1, 0 refactors
+    b2 = rng.standard_normal(n).astype(np.float32)
+    f2 = fleet.submit("d0", b2)
+    fleet.flush()
+    x2 = f2.result()
+    wrong = int(_check_residual(dense["d0"], x2, b2) > RESID_TOL)
+    migrated_refactors = sum(
+        fleet.member(m).metrics.get("factors_total")
+        for m in fleet.alive()) - pre_factors
+    # the control: plain eviction pays 1 refactor per handle on the
+    # next touch (the failure mode migration exists to avoid)
+    fleet.member("p0").evict("d1")
+    f3 = fleet.submit("d1", b2)
+    fleet.flush()
+    wrong += int(_check_residual(dense["d1"], f3.result(), b2)
+                 > RESID_TOL)
+    evicted_refactors = sum(
+        fleet.member(m).metrics.get("factors_total")
+        for m in fleet.alive()) - pre_factors - migrated_refactors
+    lost = sum(1 for f, _, _ in futs if not f.done())
+    cons = {m: _conservation(fleet.member(m).metrics)
+            for m in fleet.alive()}
+    g = fleet.metrics.get
+    report = {
+        "migrated": {m: [str(h) for h in hs]
+                     for m, hs in moved.items()},
+        "byte_identical": byte_identical,
+        "queued_request_followed": queued_ok,
+        "refactors_migrated_handle": migrated_refactors,
+        "refactors_evicted_handle": evicted_refactors,
+        "migration_aborts": g("fleet_migration_aborts_total"),
+        "migration_retries": g("fleet_migration_retries_total"),
+        "migrations": g("fleet_migrations_total"),
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "conservation": {"per_member": cons,
+                         "ok": all(c["ok"] for c in cons.values())},
+        "ok": (migrated_ok and byte_identical and queued_ok
+               and wrong == 0 and lost == 0
+               and migrated_refactors == 0
+               and evicted_refactors == 1
+               and g("fleet_migration_aborts_total") == 1
+               and g("fleet_migration_retries_total") == 1
+               and all(c["ok"] for c in cons.values())),
+    }
+    return report, inj
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -683,18 +972,24 @@ def run_all(seed, waves):
     shed = run_shed_drill(seed)
     numerics = run_numerics_drill(seed)
     recovery, inj_r = run_recovery_drill(seed)
+    noisy, inj_n = run_noisy_drill(seed)
+    migration, inj_g = run_migration_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
-                           for i in (inj, inj_b, inj_m, inj_r)),
+                           for i in (inj, inj_b, inj_m, inj_r,
+                                     inj_n, inj_g)),
         "events": sum(len(i.schedule())
-                      for i in (inj, inj_b, inj_m, inj_r)),
+                      for i in (inj, inj_b, inj_m, inj_r,
+                                inj_n, inj_g)),
         "fired_counts": inj.fired_counts(),
         "opportunities": inj.opportunity_counts(),
     }
     return {"soak": soak, "breaker_drill": drill,
             "mixed_drill": mixed, "shed_drill": shed,
             "numerics_drill": numerics,
-            "recovery_drill": recovery}, schedule
+            "recovery_drill": recovery,
+            "noisy_drill": noisy,
+            "migration_drill": migration}, schedule
 
 
 def main(argv=None):
@@ -733,6 +1028,7 @@ def main(argv=None):
     enabled = [s.kind for s in plan.specs if s.rate > 0]
     enabled += [s.kind for s in recovery_plan(args.seed).specs
                 if s.rate > 0 and s.kind not in enabled]
+    enabled.append("migration_abort")  # run_migration_drill's plan
     invariants = {
         "wrong_answers": sum(ph.get("wrong_answers", 0)
                              for ph in phases.values()),
@@ -752,6 +1048,17 @@ def main(argv=None):
         # requests failed over, attribution + partial-placement folds
         # consistent across the crash — and never a wrong answer
         "failover_recovered": phases["recovery_drill"]["ok"],
+        # round 18: with quotas + weighted-fair dispatch ON the victim
+        # tenant's p99 stays bounded and it completes its share while
+        # the aggressor is quota-rejected; the SAME seed with them OFF
+        # shows victim starvation — and the victim's answers are
+        # bit-identical across arms (order changed, programs didn't)
+        "noisy_neighbor_isolated": phases["noisy_drill"]["ok"],
+        # round 18: an HBM-pressured member migrates its coldest
+        # resident byte-identically (0 refactors, routed requests
+        # follow, an injected mid-transfer abort leaves the source
+        # serving and retries counted) vs 1 refactor/handle evicted
+        "migration_zero_refactor": phases["migration_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0

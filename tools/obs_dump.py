@@ -96,7 +96,14 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     spd = spd @ spd.T + n * np.eye(n)
     A = st.hermitian(np.tril(spd), nb=nb, uplo=st.Uplo.Lower)
 
-    sess = Session(tracer=tracer)
+    # round 18: a declared tenant table through the smoke — the quota
+    # gauges, the /tenants "quotas" section, and the fair-share
+    # deficit gauges below are exit-gated
+    from slate_tpu.runtime import TenantPolicy
+    sess = Session(tracer=tracer, tenant_policies={
+        "tenant-a": TenantPolicy(weight=2.0),
+        "tenant-b": TenantPolicy(weight=1.0,
+                                 max_resident_bytes=64 << 20)})
     # round 12: SLO tracking on — default objectives PLUS the round-16
     # residual objective, so the sampled probes below feed a
     # residual-kind burn rate the /slo payload must evaluate
@@ -440,6 +447,36 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
             fails.append("fleet placement fold lost rows")
         if "tenant-a" not in pl_fleet["per_tenant"]:
             fails.append("fleet placement rollup missing tenant-a")
+
+        # -- tenant quotas + weighted-fair dispatch (round 18) ----------
+        # the session carries a declared TenantTable: the /tenants
+        # payload must expose the quota view, the per-tenant quota
+        # gauges must be in the Prometheus text, and a two-tenant
+        # ready snapshot pumped through a Batcher must engage the
+        # deficit scheduler (fair_share_deficit gauges published)
+        tpay = sess.tenants_payload()
+        q = tpay.get("quotas", {})
+        if not q.get("enabled"):
+            fails.append("/tenants payload missing an enabled quotas "
+                         "section")
+        if "tenant-a" not in q.get("tenants", {}):
+            fails.append("quotas section missing tenant-a resident row")
+        from slate_tpu.runtime import Batcher
+        qbat = Batcher(sess, max_batch=4, max_wait=3600.0)
+        qfuts = ([qbat.submit(h, rng.standard_normal(n))
+                  for _ in range(4)]
+                 + [qbat.submit(hb, rng.standard_normal(16),
+                                tenant="tenant-b")
+                    for _ in range(4)])
+        qbat.flush()
+        for f in qfuts:
+            f.result(timeout=120)
+        qprom = obs.render_prometheus(sess.metrics, ledger=False,
+                                      bytes_ledger=False)
+        for needle in ("slate_tpu_tenant_quota_resident_bytes_tenant_a",
+                       "slate_tpu_fair_share_deficit_tenant_"):
+            if needle not in qprom:
+                fails.append(f"prometheus text missing {needle}")
 
         # -- numerical-health telemetry (round 16) ----------------------
         # the served SPD workload above ran with a probe-every-solve
