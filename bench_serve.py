@@ -53,6 +53,7 @@ the structural columns are the portable claim.
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -72,7 +73,7 @@ apply_env_platforms()
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas")
+    "tenants", "numerics", "quotas", "spectral")
 
 
 def _tenants_section(sess):
@@ -129,6 +130,71 @@ def _numerics_section(sess):
         "counters": counters,
         "sample_fraction": payload.get("config", {}).get(
             "sample_fraction"),
+        "ok": ok,
+    }
+
+
+def _apply_dot_census(sess):
+    """dot-op counts of every warmed spectral apply program, by
+    function name — the round-19 two-gemm pin (each served matrix
+    function lowers to exactly two gemms + a diagonal scale)."""
+    dots = {}
+    for key, exe in sess._compiled.items():
+        if isinstance(key, tuple) and key \
+                and key[0] == "spectral.apply":
+            dots[key[1]] = len(re.findall(r"dot\(", exe.as_text()))
+    return dots
+
+
+def _spectral_section(sess, dtype):
+    """The serve artifact's round-19 ``spectral`` section: a resident
+    eigendecomposition registered in the SAME bench session, warmed,
+    and served through every catalog function — recording the
+    structural columns of the spectral serving claim (zero new
+    compiles across theta-varying serves, the two-gemm dot census of
+    each warmed apply program, the staged factor programs in the
+    cost log) plus a solve-with-shift accuracy spot check. Sized
+    small (n=96) so the section is schema/structure evidence, not a
+    second headline — the throughput A/B lives in --spectral
+    (BENCH_SPECTRAL_r*.json)."""
+    import slate_tpu as st
+    from slate_tpu import spectral as sp
+
+    ns, nbs = 96, 32
+    rng = np.random.default_rng(19)
+    a = rng.standard_normal((ns, ns)).astype(dtype)
+    a = ((a + a.T) / 2).astype(dtype)
+    A = st.from_dense(a, nb=nbs, kind=st.MatrixKind.Hermitian)
+    h = sess.register(A, op="eig", tenant="bench-a")
+    sess.warmup(h, nrhs=1)
+    n_compiles = len(sess.compile_log)
+    fns = sorted(sp.function_catalog("eig"))
+    b = rng.standard_normal(ns).astype(dtype)
+    shift = 0.7
+    x = None
+    for fn in fns:
+        for theta in (0.0, shift):
+            y = sess.apply(h, b, fn=fn, theta=theta, tenant="bench-a")
+            if fn == "solve" and theta == shift:
+                x = y
+    new_compiles = len(sess.compile_log) - n_compiles
+    dots = _apply_dot_census(sess)
+    lam = sess.eigvals(h)
+    xd = np.linalg.solve(a.astype(np.float64) - shift * np.eye(ns), b)
+    rel = float(np.abs(x - xd).max() / max(np.abs(xd).max(), 1.0))
+    stages = [r["what"] for r in sess.cost_log
+              if r["what"].startswith("spectral.")]
+    ok = (new_compiles == 0
+          and bool(dots) and all(v == 2 for v in dots.values())
+          and rel < (1e-3 if np.dtype(dtype).itemsize <= 4 else 1e-8)
+          and lam.shape == (ns,))
+    return {
+        "enabled": True, "op": "eig", "n": ns, "nb": nbs,
+        "functions": fns,
+        "new_compiles_after_warmup": new_compiles,
+        "apply_dot_ops": dots,
+        "stage_programs": stages,
+        "solve_rel_err": rel,
         "ok": ok,
     }
 
@@ -207,6 +273,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
 
     snap = sess.metrics.snapshot()
     lat = snap["histograms"].get("request_latency", {})
+    # round 19: the resident-spectral structural exercise runs AFTER
+    # the timed serve window (the snapshot above keeps the headline
+    # percentiles spectral-free); the tenants/numerics sections below
+    # are built after it, so its handle and probes fold into both
+    spectral_section = _spectral_section(sess, dtype)
     artifact = {
         "bench": "serve",
         "backend": jax.devices()[0].platform,
@@ -256,6 +327,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # tenant table went missing would silently stop exercising the
         # round-18 seams)
         "quotas": sess.quotas_payload(),
+        # round 19: the resident-spectral structural view — zero new
+        # compiles across theta-varying serves, the two-gemm dot
+        # census of every warmed apply program, the staged factor
+        # programs, and a solve-with-shift accuracy check (exit-gated)
+        "spectral": spectral_section,
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -1184,6 +1260,143 @@ def bench_failover(n=48, nb=16, n_handles=6, seed=1,
     return artifact
 
 
+def bench_spectral(n=96, nb=32, requests=32, cold_sample=6,
+                   out_path="BENCH_SPECTRAL_r01.json"):
+    """The round-19 resident-spectral A/B: serve ``requests``
+    theta-varying matrix-function applies from a RESIDENT
+    eigendecomposition (two analyzed gemms + a diagonal scale per
+    request, zero compiles after warmup) vs re-running the full
+    two-stage decomposition per request (api.heev_mesh / svd_mesh —
+    what a caller without a resident spectral pays) and applying
+    eagerly.
+
+    One row per op (eig, svd). The cold arm is measured on a bounded
+    sample (``cold_sample`` — a 9n³ decomposition per request makes a
+    full sweep pointless) and extrapolated to a rate. CPU wall times
+    are honest smoke (PERF.md policy); the structural columns — zero
+    new compiles across the serve sweep, the two-gemm dot census of
+    every warmed apply program, the staged factor programs' census
+    rows — are the portable claim."""
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu import spectral as sp
+    from slate_tpu.runtime import Session
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(19)
+    rows = []
+    for op in ("eig", "svd"):
+        if op == "eig":
+            m = n
+            a = rng.standard_normal((n, n)).astype(np.float32)
+            a = ((a + a.T) / 2).astype(np.float32)
+            A = st.from_dense(a, nb=nb, kind=st.MatrixKind.Hermitian)
+            dense = a
+        else:
+            m = n + nb
+            dense = rng.standard_normal((m, n)).astype(np.float32)
+            A = st.from_dense(dense, nb=nb)
+        # solve rhs rows: n for eig, m for svd (pinv direction)
+        rhs = [rng.standard_normal(n if op == "eig" else m)
+               .astype(np.float32) for _ in range(requests)]
+
+        sess = Session(hbm_budget=1 << 30)
+        h = sess.register(A, op=op)
+        sess.warmup(h, nrhs=1)
+        nc0 = len(sess.compile_log)
+        shift = 0.3
+        t0 = time.perf_counter()
+        for i, b in enumerate(rhs):
+            x = sess.apply(h, b, fn="solve",
+                           theta=shift * ((i % 4) + 1))
+        warm_wall = time.perf_counter() - t0
+        new_compiles = len(sess.compile_log) - nc0
+        dots = _apply_dot_census(sess)
+
+        # accuracy spot check on the last served theta
+        theta = shift * (((requests - 1) % 4) + 1)
+        a64 = dense.astype(np.float64)
+        if op == "eig":
+            xd = np.linalg.solve(a64 - theta * np.eye(n), rhs[-1])
+        else:
+            # Tikhonov-regularized pinv: sigma/(sigma^2+theta^2)
+            u, s, vt = np.linalg.svd(a64, full_matrices=False)
+            w = s / (s * s + theta * theta)
+            xd = vt.T @ (w * (u.T @ rhs[-1]))
+        rel = float(np.abs(np.asarray(x, np.float64) - xd).max()
+                    / max(np.abs(xd).max(), 1.0))
+
+        # cold arm: the full two-stage decomposition per request (the
+        # mesh api verbs), eager apply — bounded sample, extrapolated
+        ncold = min(requests, cold_sample)
+        decomp = (st.api.heev_mesh if op == "eig"
+                  else st.api.svd_mesh)
+        decomp(A)  # warm the staged compile caches off the clock
+        t0 = time.perf_counter()
+        for i in range(ncold):
+            th = shift * ((i % 4) + 1)
+            if op == "eig":
+                w, Z = decomp(A)
+                V = Z.to_numpy()
+                xc = V @ ((V.T @ rhs[i]) / (np.asarray(w) - th))
+            else:
+                s_, U, V = decomp(A)
+                s_ = np.asarray(s_)
+                wv = s_ / (s_ * s_ + th * th)
+                xc = V.to_numpy() @ (wv * (U.to_numpy().T @ rhs[i]))
+        cold_wall = time.perf_counter() - t0
+        census = [{k: r.get(k) for k in
+                   ("what", "model_flops", "bytes_accessed",
+                    "collective_bytes")}
+                  for r in sess.cost_log
+                  if r["what"].startswith("spectral.")]
+        row = {
+            "op": op, "m": m, "n": n, "nb": nb,
+            "functions": sorted(sp.function_catalog(op)),
+            "resident": {"wall_s": warm_wall,
+                         "applies_per_sec": requests / warm_wall},
+            "cold": {"wall_s": cold_wall, "sampled": ncold,
+                     "applies_per_sec": ncold / cold_wall},
+            "speedup": (requests / warm_wall) / (ncold / cold_wall),
+            "new_compiles_after_warmup": new_compiles,
+            "apply_dot_ops": dots,
+            "census": census,
+            "max_rel_err": rel,
+        }
+        row["one_program"] = (new_compiles == 0 and bool(dots)
+                              and all(v == 2 for v in dots.values()))
+        rows.append(row)
+        print(f"# spectral[{op}]: resident "
+              f"{row['resident']['applies_per_sec']:.1f} applies/s vs "
+              f"cold {row['cold']['applies_per_sec']:.1f} "
+              f"decomp+apply/s -> {row['speedup']:.1f}x "
+              f"(compiles after warmup: {new_compiles})",
+              file=sys.stderr)
+
+    ok = all(r["one_program"] and r["max_rel_err"] < 1e-3
+             and r["speedup"] > 1.0 for r in rows)
+    artifact = {
+        "bench": "serve_spectral",
+        "platform": platform,
+        "n": n, "nb": nb, "requests": requests,
+        "rows": rows,
+        "caveat": ("CPU smoke (TPU tunnel down since round 5): "
+                   "applies/s is host-dispatch-bound; the structural "
+                   "claim is the zero-new-compiles and two-gemm "
+                   "apply-census columns, which are dispatch-rate-"
+                   "independent." if platform == "cpu" else None),
+        "ok": ok,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"out": out_path, "ok": ok,
+                      "speedups": {r["op"]: round(r["speedup"], 2)
+                                   for r in rows}}))
+    return artifact
+
+
 def _probe_device_count(timeout=90):
     """Default-backend device count, probed in a subprocess with a
     hard timeout — with the TPU tunnel down, jax.devices() hangs
@@ -1282,6 +1495,16 @@ def main(argv=None):
                         "with zero refactors while the cold arm pays "
                         "one per handle (CPU smoke, honestly labeled)")
     p.add_argument("--failover-out", default="BENCH_FAILOVER_r01.json")
+    p.add_argument("--spectral", action="store_true",
+                   help="run the round-19 resident-spectral A/B: "
+                        "theta-varying matrix-function applies from a "
+                        "resident eigendecomposition vs the full "
+                        "two-stage decomposition per request; exit 0 "
+                        "iff every row is structurally one-program "
+                        "(zero compiles after warmup, two-gemm apply "
+                        "census) and the resident arm wins (CPU "
+                        "smoke, honestly labeled)")
+    p.add_argument("--spectral-out", default="BENCH_SPECTRAL_r01.json")
     p.add_argument("--regen-smoke", action="store_true",
                    help="GUARDED regeneration of the committed "
                         "BENCH_SERVE_smoke.json fixture (+ .metrics."
@@ -1318,6 +1541,14 @@ def main(argv=None):
                                  out_path=args.failover_out)
         else:
             art = bench_failover(out_path=args.failover_out)
+        return 0 if art["ok"] else 1
+    if args.spectral:
+        if args.smoke:
+            art = bench_spectral(n=64, nb=16, requests=16,
+                                 cold_sample=4,
+                                 out_path=args.spectral_out)
+        else:
+            art = bench_spectral(out_path=args.spectral_out)
         return 0 if art["ok"] else 1
     if args.overload:
         art = bench_overload(out_path=args.overload_out)
@@ -1385,8 +1616,11 @@ def main(argv=None):
     # per-tenant ledger stopped summing to the globals is broken
     # round 16: the numerics section exit-gates too — a healthy
     # operand misclassified (or dead probe seams) is a broken monitor
+    # round 19: the spectral section exit-gates too — a resident
+    # eigendecomposition that recompiles per theta (or whose apply
+    # stopped being two gemms) is a broken serving claim
     ok = (art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
-          and art["numerics"]["ok"])
+          and art["numerics"]["ok"] and art["spectral"]["ok"])
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

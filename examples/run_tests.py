@@ -173,6 +173,19 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --failover FAILED")
+    # spectral serving A/B smoke (round 19): resident eigendecomposition
+    # applies vs cold factor-per-request — exits nonzero unless every
+    # row serves from ONE warmed two-gemm program with zero new
+    # compiles after warmup (the structural claim; speeds are CPU smoke)
+    print("=== bench_serve.py --spectral --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--spectral", "--smoke",
+         "--spectral-out", "/tmp/BENCH_SPECTRAL_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --spectral FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure —

@@ -45,7 +45,9 @@ from .api import (multiply, rank_k_update, rank_2k_update,
                   gesv_mixed, posv_mixed, gesv_mixed_gmres,
                   posv_mixed_gmres, gesv_mixed_batched,
                   posv_mixed_batched)
+from .api import heev_mesh, svd_mesh
 from . import refine
 from .refine import PolicyTable, RefinePolicy
 from . import runtime
+from . import spectral
 from . import obs

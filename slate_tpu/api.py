@@ -522,3 +522,32 @@ def posv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
                      factor_dtype=str(jnp.dtype(factor_dtype))):
         return gmres_mod.posv_mixed_gmres(A, B, opts,
                                           factor_dtype=factor_dtype)
+
+
+def heev_mesh(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+              stage=None):
+    """Mesh-sharded two-stage Hermitian eigendecomposition → (Λ
+    ascending, V TiledMatrix on A's grid).
+
+    The round-19 spectral pipeline (spectral/mesh.py): sharded he2hb,
+    rank-0 band gather + bulge chase, host/device stedc D&C, sharded
+    back-transforms. ``stage`` hooks each device stage (the serving
+    Session passes its _aot_compile seam so every stage is a
+    cost-analyzed program); eager callers leave it None."""
+    from . import spectral
+    n = A.shape[0]
+    with _obs.driver("heev_mesh", _flops.heev_2stage(n), n=n,
+                     dtype=str(A.dtype)):
+        return spectral.heev_staged(A, opts, stage=stage)
+
+
+def svd_mesh(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+             stage=None):
+    """Mesh-sharded two-stage thin SVD of tall A (m ≥ n) → (Σ
+    descending, U, V). Same staged pipeline as :func:`heev_mesh` with
+    ge2tb + the Golub-Kahan perfect-shuffle chase."""
+    from . import spectral
+    m, n = A.shape
+    with _obs.driver("svd_mesh", _flops.svd(m, n, vectors=True), m=m,
+                     n=n, dtype=str(A.dtype)):
+        return spectral.svd_staged(A, opts, stage=stage)

@@ -135,20 +135,53 @@ def band_factor(n: int, band: int) -> float:
     return 2.0 * n * band * band if band else 2.0 * n
 
 
+# -- spectral two-stage per-stage models (round 19) -------------------------
+
+# heev_2stage's 9n³ total splits across the staged programs roughly as
+# he2hb (4/3)n³ + chase O(n²·nb) + the two back-transform sweeps ~2n³
+# each + stedc merges; the per-stage table below names the dominant
+# term of EACH analyzed program so the Session's cost_log rows carry a
+# defensible model numerator (the round-6 bench convention: model the
+# work the program body executes, not the end-to-end headline).
+SPECTRAL_STAGE_MODELS: Dict[str, Callable[[int, int, int], float]] = {
+    # (m, n, nb) -> flops; square ops ignore m
+    "spectral.he2hb": lambda m, n, nb: 4.0 * n ** 3 / 3.0,
+    "spectral.hb2td": lambda m, n, nb: 6.0 * n * n * nb,
+    "spectral.unmtr": lambda m, n, nb: 4.0 * n ** 3,
+    "spectral.heev_dense": lambda m, n, nb: heev(n, vectors=True),
+    "spectral.ge2tb": lambda m, n, nb: 8.0 * m * n * n / 3.0,
+    "spectral.tb2bd": lambda m, n, nb: 24.0 * n * n * nb,
+    "spectral.unmbr": lambda m, n, nb: 2.0 * m * n * n + 2.0 * n ** 3,
+    "spectral.svd_dense": lambda m, n, nb: svd(m, n, vectors=True),
+}
+
+
+def spectral_stage_flops(stage: str, m: int, n: int, nb: int) -> float:
+    """Model flops of one staged spectral program (0 for unknown
+    stages — the census still carries measured bytes)."""
+    model = SPECTRAL_STAGE_MODELS.get(stage)
+    return model(m, n, nb) if model else 0.0
+
+
 # -- solve / factor dispatch (the serving Session's accounting) -------------
 
 
 def factor_flops(op: str, m: int, n: int, band: int = 0) -> float:
     """Model flops of one factorization, keyed by the Session op kind
-    ({lu, chol, qr, band_lu, band_chol, lu_small, chol_small} — the
-    *_small ops are one ITEM of the batched engine: same per-item
-    model, credited B× by the batched dispatch)."""
+    ({lu, chol, qr, band_lu, band_chol, lu_small, chol_small, eig,
+    svd} — the *_small ops are one ITEM of the batched engine: same
+    per-item model, credited B× by the batched dispatch; eig/svd are
+    the round-19 two-stage spectral registrations)."""
     if op in ("lu", "lu_small"):
         return getrf(n)
     if op in ("chol", "chol_small"):
         return potrf(n)
     if op == "qr":
         return geqrf(m, n)
+    if op == "eig":
+        return heev_2stage(n)
+    if op == "svd":
+        return svd(m, n, vectors=True)
     return band_factor(n, band)
 
 
@@ -158,6 +191,10 @@ def solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
         return 2.0 * n * n * k
     if op == "qr":
         return (4.0 * m * n - 2.0 * n * n) * k
+    if op in ("eig", "svd"):
+        # served spectral apply = two gemms against the resident bases
+        # (+ a diagonal scale, O(nk), below model resolution)
+        return 4.0 * m * n * k
     return 4.0 * n * band * k if band else 4.0 * n * k
 
 

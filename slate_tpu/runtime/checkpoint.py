@@ -59,6 +59,9 @@ from ..linalg.band_packed import PackedBand
 from ..linalg.qr import QRFactors
 from ..obs.tracing import log as _obs_log
 from ..refine.policy import RefinePolicy
+# direct module import (not the spectral package __init__, which pulls
+# the staged pipeline drivers) — checkpoint only needs the pytree types
+from ..spectral.types import EigFactors, SVDFactors
 
 CHECKPOINT_SCHEMA = "slate_tpu.checkpoint.v1"
 # every key a checkpoint record carries. Mirrored (deliberately, the
@@ -172,6 +175,15 @@ def _encode_node(node, w: _BlobWriter) -> dict:
         return {"type": "qr_factors", "m": int(node.m), "n": int(node.n),
                 "nb": int(node.nb), "vr": w.add(node.vr),
                 "t": w.add(node.t)}
+    if isinstance(node, EigFactors):
+        # round-19 spectral residents: the eigenvector TiledMatrix
+        # nests as its own node (placement metadata and all), the
+        # spectrum is a plain blob
+        return {"type": "eig_factors", "v": _encode_node(node.v, w),
+                "lam": w.add(node.lam)}
+    if isinstance(node, SVDFactors):
+        return {"type": "svd_factors", "u": _encode_node(node.u, w),
+                "s": w.add(node.s), "v": _encode_node(node.v, w)}
     if isinstance(node, (tuple, list)):
         return {"type": "tuple",
                 "items": [_encode_node(x, w) for x in node]}
@@ -207,6 +219,13 @@ def _decode_node(desc: dict, r: _BlobReader, device: bool = True):
         return QRFactors(jnp.asarray(r.read(desc["vr"])),
                          jnp.asarray(r.read(desc["t"])),
                          int(desc["m"]), int(desc["n"]), int(desc["nb"]))
+    if t == "eig_factors":
+        return EigFactors(_decode_node(desc["v"], r, device),
+                          jnp.asarray(r.read(desc["lam"])))
+    if t == "svd_factors":
+        return SVDFactors(_decode_node(desc["u"], r, device),
+                          jnp.asarray(r.read(desc["s"])),
+                          _decode_node(desc["v"], r, device))
     raise CheckpointCorrupt(f"checkpoint: unknown node type {t!r}")
 
 
@@ -217,6 +236,15 @@ def _reshard_node(node, grid: ProcessGrid):
     across placements)."""
     if isinstance(node, TiledMatrix):
         return node.shard(grid)
+    if isinstance(node, EigFactors):
+        import jax
+        return EigFactors(_reshard_node(node.v, grid),
+                          jax.device_put(node.lam, grid.replicated()))
+    if isinstance(node, SVDFactors):
+        import jax
+        return SVDFactors(_reshard_node(node.u, grid),
+                          jax.device_put(node.s, grid.replicated()),
+                          _reshard_node(node.v, grid))
     if isinstance(node, tuple):
         return tuple(_reshard_node(x, grid) for x in node)
     return node
@@ -280,6 +308,23 @@ def _validate_node(desc, where: str) -> List[str]:
         errs = []
         for j, d in enumerate(items):
             errs.extend(_validate_node(d, f"{where}[{j}]"))
+        return errs
+    if t in ("eig_factors", "svd_factors"):
+        # round-19 spectral nodes: basis matrices nest as full node
+        # descriptors, the spectrum is a direct blob
+        nested = ("v",) if t == "eig_factors" else ("u", "v")
+        spec = "lam" if t == "eig_factors" else "s"
+        errs = []
+        for field in nested:
+            errs.extend(_validate_node(desc.get(field),
+                                       f"{where}.{field}"))
+        b = desc.get(spec)
+        if not isinstance(b, dict):
+            errs.append(f"{where}.{spec}: missing blob descriptor")
+        else:
+            for k in CHECKPOINT_BLOB_KEYS:
+                if k not in b:
+                    errs.append(f"{where}.{spec}: blob missing {k!r}")
         return errs
     blob_fields = {"array": ("a",), "tiled": ("data",),
                    "packed_band": ("ab",), "qr_factors": ("vr", "t")}

@@ -104,8 +104,12 @@ def _solve_flops(op: str, m: int, n: int, k: int, band: int = 0) -> float:
 # batched and per-request paths are bit-identical by construction
 # (batch-independent arithmetic, pinned by tests/test_batched.py).
 OPS = ("lu", "chol", "qr", "band_lu", "band_chol",
-       "lu_small", "chol_small")
+       "lu_small", "chol_small", "eig", "svd")
 SMALL_OPS = ("lu_small", "chol_small")
+# resident spectral operators (round 19, slate_tpu/spectral/): the
+# factor is the staged two-stage decomposition, the "solve" is the
+# served matrix-function apply (two analyzed gemms + a diagonal scale)
+SPECTRAL_OPS = ("eig", "svd")
 # operators the round-16 condest probe covers (the gecondest/pocondest
 # driver families; QR serves least-squares — trcondest on R is a
 # different estimate — and band factors stay on the eager verbs)
@@ -863,10 +867,10 @@ class Session:
                 grid = A.grid if (A.grid is not None
                                   and A.grid.size > 1) else None
         if grid is not None:
-            if op not in ("lu", "chol", "qr"):
+            if op not in ("lu", "chol", "qr", "eig", "svd"):
                 raise SlateError(
                     f"Session.register: mesh serving covers the dense "
-                    f"operator kinds (lu/chol/qr), not {op!r}")
+                    f"operator kinds (lu/chol/qr/eig/svd), not {op!r}")
             if not isinstance(A, TiledMatrix):
                 raise SlateError(
                     "Session.register: mesh serving requires a "
@@ -914,6 +918,27 @@ class Session:
                 "Session.register: wide (m < n) operators are not "
                 "servable via resident QR; use least_squares_solve "
                 "per call")
+        if op in SPECTRAL_OPS:
+            # round 19: resident spectral operators (spectral/) — the
+            # staged two-stage decomposition needs a dense TiledMatrix
+            # (eig additionally a Hermitian/Symmetric one); wide SVD
+            # operands register the transpose (api.svd handles wide
+            # per call)
+            if not isinstance(A, TiledMatrix):
+                raise SlateError(
+                    f"Session.register: op {op!r} requires a "
+                    f"TiledMatrix operand, got {type(A).__name__}")
+            if op == "eig":
+                if A.kind not in (MatrixKind.Hermitian,
+                                  MatrixKind.Symmetric) or m != n:
+                    raise SlateError(
+                        "Session.register: op 'eig' requires a square "
+                        "Hermitian/Symmetric TiledMatrix operand")
+            elif m < n:
+                raise SlateError(
+                    "Session.register: wide (m < n) operators are not "
+                    "servable via resident SVD; register the "
+                    "transpose (api.svd handles wide per call)")
         policy = None
         if refine is not None and refine is not False:
             if op not in ("lu", "chol", "lu_small", "chol_small"):
@@ -1205,6 +1230,15 @@ class Session:
     def _factor(self, entry: _Operator, handle: Hashable = None
                 ) -> _Resident:
         op, A, opts = entry.op, entry.A, entry.opts
+        if op in SPECTRAL_OPS:
+            payload = self._factor_spectral(entry, handle)
+            payload = jax.block_until_ready(payload)
+            # the two-stage pipeline finishes through stedc's D&C,
+            # which is direct (no convergence failure mode to report):
+            # a spectral resident is always info=0
+            return _Resident(payload, 0,
+                             _tree_nbytes(payload, per_chip=True),
+                             _tree_nbytes(payload))
         if op in SMALL_OPS:
             # the per-request arm of the many-small-problems engine:
             # ONE item through the SAME hand-batched kernels the
@@ -1282,6 +1316,47 @@ class Session:
         return _Resident(payload, int(info),
                          _tree_nbytes(payload, per_chip=True),
                          _tree_nbytes(payload))
+
+    def _factor_spectral(self, entry: _Operator, handle: Hashable):
+        """Caller holds the lock. The round-19 spectral factorization:
+        run the staged two-stage pipeline (spectral/mesh.py) with every
+        DEVICE stage routed through the ``_aot_compile`` seam — each
+        stage is a cost-analyzed program whose bytes/collective census
+        credit per execution (the mesh-factor discipline of round 11,
+        applied per stage because the host stedc round-trip splits the
+        pipeline). Returns the resident pytree payload
+        (EigFactors/SVDFactors) with the spectrum replicated over the
+        operator's grid."""
+        from .. import spectral as _spectral
+
+        def stage(name, jfn, args):
+            leaves, treedef = jax.tree_util.tree_flatten(args)
+            shapes = tuple((tuple(l.shape), str(l.dtype))
+                           for l in leaves)
+            key = ("spectral", name, entry.op, entry.opts, treedef,
+                   shapes)
+            exe = self._compiled.get(key)
+            if exe is None:
+                exe = self._aot_compile(name, entry, handle, jfn, args,
+                                        key=key)
+                self._compiled_put(key, exe)
+                self.metrics.inc("factor_aot_compiles")
+            else:
+                self._compiled.move_to_end(key)
+            self._credit_program(key, "serve.factor",
+                                 tenant=entry.tenant, handle=handle)
+            return exe(*args)
+
+        if entry.op == "eig":
+            lam, V = _spectral.heev_staged(entry.A, entry.opts,
+                                           stage=stage)
+            if entry.grid is not None:
+                lam = jax.device_put(lam, entry.grid.replicated())
+            return _spectral.EigFactors(V, lam)
+        s, U, V = _spectral.svd_staged(entry.A, entry.opts, stage=stage)
+        if entry.grid is not None:
+            s = jax.device_put(s, entry.grid.replicated())
+        return _spectral.SVDFactors(U, s, V)
 
     def _credit_program(self, key: Hashable, op: str,
                         waste_fraction: float = 0.0,
@@ -1615,7 +1690,9 @@ class Session:
 
     def solve_matrix(self, handle: Hashable, B: TiledMatrix,
                      served_cols: Optional[int] = None,
-                     tenant: Optional[str] = None) -> TiledMatrix:
+                     tenant: Optional[str] = None,
+                     spectral_fn: str = "solve",
+                     theta: float = 0.0) -> TiledMatrix:
         """Solve with the resident factor; B is a TiledMatrix (dense
         ops) or a padded dense array (band ops). Returns the TiledMatrix
         (or array) solution. Raises on factorization failure (info>0).
@@ -1663,7 +1740,8 @@ class Session:
             # SUCCESSFUL request stream (grouped-parity pin).
             nm = self.numerics
             probe = (nm is not None and entry.refine is None
-                     and entry.op in PROBE_OPS and nm.sampler.decide())
+                     and entry.op in PROBE_OPS + SPECTRAL_OPS
+                     and nm.sampler.decide())
             k = int(B.shape[1])
             served = k if served_cols is None else int(served_cols)
             tr = self.tracer
@@ -1680,7 +1758,18 @@ class Session:
                 t0 = time.perf_counter()
                 pstats = None
                 with tr.span("serve.dispatch"):
-                    if probe:
+                    if entry.op in SPECTRAL_OPS:
+                        X = self._dispatch_spectral(
+                            entry, res, B, handle, spectral_fn, theta,
+                            served_cols=served_cols, tenant=rt)
+                        if probe:
+                            # the spectral residual probe is a SEPARATE
+                            # one-gemm program (‖A·v_i − λ_i·v_i‖ on
+                            # sampled columns — it reads the resident,
+                            # not the request), run alongside the apply
+                            pstats = self._spectral_probe(entry, res,
+                                                          B, handle)
+                    elif probe:
                         X, pstats = self._dispatch_probed(
                             entry, res, B, handle,
                             served_cols=served_cols, tenant=rt)
@@ -2565,6 +2654,146 @@ class Session:
                              tenant=tenant, handle=handle)
         return exe(*args)
 
+    # -- resident spectral serving (round 19, slate_tpu/spectral/) ---------
+
+    @staticmethod
+    def _spectral_theta(entry: _Operator, theta) -> np.ndarray:
+        """The traced scalar parameter of a served matrix function, at
+        a FIXED dtype (the operand's real dtype) so every theta value
+        reuses one AOT program — a new shift/ridge/rank never
+        recompiles (the zero-new-compiles pin)."""
+        rdt = np.zeros((), np.dtype(entry.A.dtype)).real.dtype
+        return np.asarray(theta, dtype=rdt)
+
+    def _spectral_apply_exe(self, entry: _Operator, handle: Hashable,
+                            fname: str, args: Tuple):
+        """AOT-compiled served apply for these shapes → (exe, key).
+        ALWAYS through the ``_aot_compile`` seam (the refined-entry
+        discipline): every served spectral apply executes an analyzed
+        program — exactly two gemms + a diagonal scale (HLO-pinned by
+        test) — so bytes/census credit per execution."""
+        from .. import spectral as _spectral
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = ("spectral.apply", fname, entry.op, entry.opts, treedef,
+               shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            fn = self._jit_cached(
+                ("spectral.apply", entry.op, fname, entry.opts),
+                lambda: _spectral.make_apply_fn(entry.op, fname,
+                                                entry.opts))
+            exe = self._aot_compile("apply", entry, handle, fn, args,
+                                    key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        return exe, key
+
+    def _dispatch_spectral(self, entry: _Operator, res: _Resident, B,
+                           handle: Hashable = None,
+                           fname: str = "solve", theta: float = 0.0,
+                           served_cols: Optional[int] = None,
+                           tenant: Optional[str] = None):
+        """One served spectral apply: X = L·diag(f(spectrum, θ))·Rᴴ·B
+        against the resident decomposition."""
+        args = (res.payload, B, self._spectral_theta(entry, theta))
+        exe, key = self._spectral_apply_exe(entry, handle, fname, args)
+        k = int(B.shape[1]) if getattr(B, "shape", None) else 0
+        wf = (0.0 if served_cols is None or not k
+              else (k - served_cols) / k)
+        self._credit_program(key, "serve.solve", waste_fraction=wf,
+                             tenant=tenant, handle=handle)
+        return exe(*args)
+
+    def _spectral_probe(self, entry: _Operator, res: _Resident, B,
+                        handle: Hashable):
+        """Caller holds the lock. The sampled spectral residual probe:
+        one analyzed single-gemm program computing
+        ‖A·v_i − λ_i·v_i‖_max (svd: ‖A·v_i − σ_i·u_i‖_max) over a
+        static sample of extreme columns → the stacked max-norm triple
+        the shared ρ post-processing consumes."""
+        from .. import spectral as _spectral
+        args = (res.payload, entry.A)
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = ("spectral.probe", entry.op, entry.opts, treedef, shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            fn = self._jit_cached(
+                ("spectral.probe", entry.op, entry.opts),
+                lambda: _spectral.make_probe_fn(entry.op, entry.opts))
+            exe = self._aot_compile("probe", entry, handle, fn, args,
+                                    key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        self._credit_program(key, "numerics.probe", tenant=entry.tenant,
+                             handle=handle)
+        return exe(*args)
+
+    def apply(self, handle: Hashable, b, fn: str = "solve",
+              theta: float = 0.0, served_cols: Optional[int] = None,
+              tenant: Optional[str] = None) -> np.ndarray:
+        """Served matrix function of a resident spectral operator:
+        x = f(A)·b — solve-with-shift ((A−θI)⁻¹b), psd_project,
+        whiten, truncate (see spectral/types.py for the per-op
+        catalogs). Array-in/array-out like :meth:`solve`; ``theta`` is
+        the function's scalar parameter, traced so any value reuses
+        the warmed program. svd note: forward functions (truncate)
+        take n-row right-hand sides; inverse-direction functions
+        (solve/whiten) take m-row ones."""
+        from .. import spectral as _spectral
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op not in SPECTRAL_OPS:
+                raise SlateError(
+                    f"Session.apply: operator {handle!r} is "
+                    f"{entry.op!r}, not a spectral (eig/svd) resident")
+            catalog = _spectral.function_catalog(entry.op)
+            if fn not in catalog:
+                raise SlateError(
+                    f"Session.apply: unknown function {fn!r} for op "
+                    f"{entry.op!r}; served functions: "
+                    f"{sorted(catalog)}")
+            b = np.asarray(b)
+            vector = b.ndim == 1
+            b2 = b[:, None] if vector else b
+            B = self._wrap_rhs(entry, b2)
+            kw = {}
+            if served_cols is not None:
+                kw["served_cols"] = served_cols
+            if tenant is not None:
+                kw["tenant"] = tenant
+            X = self.solve_matrix(handle, B, spectral_fn=fn,
+                                  theta=theta, **kw)
+            x = X.to_numpy()
+            return x[:, 0] if vector else x
+
+    def eigvals(self, handle: Hashable) -> np.ndarray:
+        """The resident spectrum: Λ ascending for ``eig`` operators,
+        Σ descending for ``svd`` (factoring on miss — a spectrum read
+        is a serve and warms the resident like any other)."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op not in SPECTRAL_OPS:
+                raise SlateError(
+                    f"Session.eigvals: operator {handle!r} is "
+                    f"{entry.op!r}, not a spectral (eig/svd) resident")
+            res = self.factor(handle)
+            if res.info != 0:
+                raise SlateError(
+                    f"Session: operator {handle!r} factorization "
+                    f"failed (info={res.info})")
+            p = res.payload
+            return np.asarray(p.lam if entry.op == "eig" else p.s)
+
     # -- mixed-precision refined dispatch (round 13, slate_tpu/refine/) ----
 
     def _refine_exe(self, entry: _Operator, handle: Hashable, what: str,
@@ -2764,6 +2993,47 @@ class Session:
                             _batched.potrs_batched(res.payload[0][None],
                                                    b0[None])
                 return
+            if entry.op in SPECTRAL_OPS:
+                # round 19: factoring runs every pipeline stage through
+                # the _aot_compile seam (the stage hook in
+                # _factor_spectral), so the factor call below IS the
+                # stage warmup; then AOT-compile the served apply for
+                # EVERY catalog function at this rhs width (θ is a
+                # traced scalar — warmed once, any value serves), plus
+                # the sampled residual-probe program when the numerics
+                # monitor is on. After this, a served apply is zero
+                # new compiles (the acceptance pin).
+                from .. import spectral as _spectral
+                res = self.factor(handle)
+                catalog = _spectral.function_catalog(entry.op)
+                wd = np.dtype(entry.A.dtype)
+                for fname, (_wf, forward) in catalog.items():
+                    rows = (entry.n if entry.op == "eig"
+                            else (entry.n if forward else entry.m))
+                    B = self._wrap_rhs(entry,
+                                       np.zeros((rows, nrhs), wd))
+                    self._spectral_apply_exe(
+                        entry, handle, fname,
+                        (res.payload, B,
+                         self._spectral_theta(entry, 0.0)))
+                if self.numerics is not None:
+                    args = (res.payload, entry.A)
+                    leaves, treedef = jax.tree_util.tree_flatten(args)
+                    shapes = tuple((tuple(l.shape), str(l.dtype))
+                                   for l in leaves)
+                    pkey = ("spectral.probe", entry.op, entry.opts,
+                            treedef, shapes)
+                    if pkey not in self._compiled:
+                        fn = self._jit_cached(
+                            ("spectral.probe", entry.op, entry.opts),
+                            lambda: _spectral.make_probe_fn(
+                                entry.op, entry.opts))
+                        self._compiled_put(
+                            pkey, self._aot_compile(
+                                "probe", entry, handle, fn, args,
+                                key=pkey))
+                        self.metrics.inc("aot_compiles")
+                return
             if entry.op in ("lu", "chol", "qr"):
                 fkey = self._factor_key(entry)
                 if fkey not in self._compiled:
@@ -2857,10 +3127,21 @@ class Session:
         pc = _costs.program_costs(exe)
         if key is not None:
             self._program_costs[key] = pc
-        kk = (shapes[-1][1] if shapes and len(shapes[-1]) > 1 else 1)
+        # rhs width of the program (last array arg; the spectral apply
+        # carries a trailing scalar θ, so its rhs is one slot earlier)
+        wshape = (shapes[-2] if what == "apply" and len(shapes) >= 2
+                  else shapes[-1] if shapes else ())
+        kk = wshape[1] if len(wshape) > 1 else 1
         if what == "factor":
             model_fl = _factor_flops(entry.op, entry.m, entry.n,
                                      entry.band)
+        elif what.startswith("spectral."):
+            # one staged spectral program: the stage's own dominant
+            # term (obs/flops.py SPECTRAL_STAGE_MODELS), snapped to
+            # the counter grid like every other model numerator
+            model_fl = _fl_grid(_flops_mod.spectral_stage_flops(
+                what, entry.m, entry.n,
+                getattr(entry.A, "nb", entry.band) or 1))
         elif what == "refine_step":
             # one refinement step: the working-precision residual gemm
             # plus one low-precision factor apply

@@ -1,0 +1,29 @@
+"""slate_tpu.spectral — mesh-sharded two-stage heev/svd, served as
+resident eigendecompositions (round 19).
+
+Three layers:
+
+- :mod:`.mesh` — the staged two-stage reduction pipelines
+  (``heev_staged`` / ``svd_staged``): sharded he2hb/ge2tb, rank-0 band
+  gather + bulge chase, host/device stedc, sharded back-transforms —
+  each device stage routed through a ``stage`` hook so the Session
+  AOT-compiles and cost-analyzes every program.
+- :mod:`.types` — the ``EigFactors`` / ``SVDFactors`` resident pytrees
+  and the served matrix-function catalog (solve-with-shift, psd
+  projection, whitening, low-rank truncate, …).
+- :mod:`.apply` — factories for the served two-gemm + diagonal-scale
+  apply programs and the sampled eigen-residual health probe.
+"""
+
+from .types import (EigFactors, SVDFactors, EIG_FUNCTIONS,
+                    SVD_FUNCTIONS, function_catalog)
+from .mesh import (heev_staged, svd_staged, eig_level_offsets,
+                   svd_level_offsets)
+from .apply import make_apply_fn, make_probe_fn
+
+__all__ = [
+    "EigFactors", "SVDFactors", "EIG_FUNCTIONS", "SVD_FUNCTIONS",
+    "function_catalog", "heev_staged", "svd_staged",
+    "eig_level_offsets", "svd_level_offsets", "make_apply_fn",
+    "make_probe_fn",
+]

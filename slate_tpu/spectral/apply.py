@@ -1,0 +1,131 @@
+"""Served spectral applies: ``f(A)·b`` as two gemms + a diagonal scale.
+
+A resident eigendecomposition turns every matrix function of the
+operator into the same program shape::
+
+    X = L · diag(w) · Rᴴ · B      w = f(spectrum, θ)
+
+(eig: L = R = V; svd: forward functions use L, R = U, V, inverse
+functions the pinv orientation V…Uᴴ). The factories below build the
+(payload, B, θ) -> X functions the Session AOT-compiles once per
+(function, shape) signature — θ is a traced scalar so a new shift /
+ridge / rank reuses the warmed program (the zero-new-compiles pin in
+tests/test_spectral.py counts the gemm programs in the compiled HLO).
+
+``make_probe_fn`` is the numerics-health analog of the round-16 fused
+solve+residual program: one extra gemm computing ``A·v_i − λ_i·v_i``
+on a static sample of extreme columns, returning the same stacked
+max-norm triple the factor-op probes feed to ``_record_rho``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import api
+from ..core.exceptions import SlateError
+from ..core.tiled_matrix import TiledMatrix, from_dense, zeros
+from ..core.types import Options, DEFAULT_OPTIONS
+from .types import EigFactors, SVDFactors, function_catalog
+
+
+def _scale_rows(Y: TiledMatrix, w, n: int) -> TiledMatrix:
+    """diag(w)·Y on the tiled storage: w (length n, real) padded to the
+    storage rows and broadcast down the columns. Residents use the
+    default non-cyclic packing, so storage row i < n IS logical row i;
+    padded rows are already zero."""
+    wpad = jnp.zeros((Y.data.shape[0],), w.dtype).at[:n].set(w)
+    return Y.with_data(Y.data * wpad[:, None].astype(Y.data.dtype))
+
+
+def make_apply_fn(op: str, fname: str, opts: Options = DEFAULT_OPTIONS):
+    """(payload, B, theta) -> X for one served matrix function."""
+    catalog = function_catalog(op)
+    if fname not in catalog:
+        raise SlateError(
+            f"unknown spectral function {fname!r} for op {op!r}; "
+            f"served functions: {sorted(catalog)}")
+    wf, forward = catalog[fname]
+
+    if op == "eig":
+        def apply_fn(payload, B, theta):
+            V, lam = payload.v, payload.lam
+            n = V.shape[0]
+            nrhs = B.shape[1]
+            w = wf(lam, jnp.asarray(theta, lam.dtype))
+            Y = api.multiply(1.0, V.H, B, 0.0,
+                             zeros(n, nrhs, V.nb, B.dtype, grid=V.grid),
+                             opts)
+            Y = _scale_rows(Y, w, n)
+            return api.multiply(1.0, V, Y, 0.0,
+                                zeros(n, nrhs, V.nb, B.dtype,
+                                      grid=V.grid), opts)
+    else:
+        def apply_fn(payload, B, theta):
+            U, s, V = payload.u, payload.s, payload.v
+            k = s.shape[0]
+            nrhs = B.shape[1]
+            L, R = (U, V) if forward else (V, U)
+            w = wf(s, jnp.asarray(theta, s.dtype))
+            Y = api.multiply(1.0, R.H, B, 0.0,
+                             zeros(k, nrhs, R.nb, B.dtype, grid=R.grid),
+                             opts)
+            Y = _scale_rows(Y, w, k)
+            return api.multiply(1.0, L, Y, 0.0,
+                                zeros(L.shape[0], nrhs, L.nb, B.dtype,
+                                      grid=L.grid), opts)
+
+    apply_fn.__name__ = f"serve_{op}_apply_{fname}"
+    return apply_fn
+
+
+def make_probe_fn(op: str, opts: Options = DEFAULT_OPTIONS,
+                  ncols: int = 4):
+    """(payload, A) -> stats: the sampled spectral residual probe.
+
+    eig: r = max_i ‖A·v_i − λ_i·v_i‖_max over the ncols largest-|λ|
+    columns (ascending Λ — the top of the spectrum dominates served
+    solves). svd: ‖A·v_i − σ_i·u_i‖_max over the leading σ. Returns
+    the (resid_max, x_max, b_max) triple the factor-op probes emit so
+    the monitor's ρ normalization is shared."""
+
+    if op == "eig":
+        def probe_fn(payload, A):
+            V, lam = payload.v, payload.lam
+            n = V.shape[0]
+            c = min(ncols, n)
+            Vs = V.dense_canonical()[:n, n - c:n]
+            lams = lam[n - c:]
+            Vc = from_dense(Vs, V.nb, grid=V.grid, logical_shape=(n, c))
+            AV = api.multiply(1.0, A, Vc, 0.0,
+                              zeros(n, c, V.nb, Vs.dtype, grid=V.grid),
+                              opts)
+            R = (AV.dense_canonical()[:n, :c]
+                 - Vs * lams[None, :].astype(Vs.dtype))
+            return jnp.stack([
+                jnp.max(jnp.abs(R)),
+                jnp.max(jnp.abs(Vs)),
+                jnp.max(jnp.abs(lams)).astype(R.real.dtype),
+            ])
+    else:
+        def probe_fn(payload, A):
+            U, s, V = payload.u, payload.s, payload.v
+            m, n = U.shape[0], V.shape[0]
+            c = min(ncols, s.shape[0])
+            Vs = V.dense_canonical()[:n, :c]
+            Us = U.dense_canonical()[:m, :c]
+            sc = s[:c]
+            Vc = from_dense(Vs, V.nb, grid=V.grid, logical_shape=(n, c))
+            AV = api.multiply(1.0, A, Vc, 0.0,
+                              zeros(m, c, V.nb, Vs.dtype, grid=V.grid),
+                              opts)
+            R = (AV.dense_canonical()[:m, :c]
+                 - Us * sc[None, :].astype(Us.dtype))
+            return jnp.stack([
+                jnp.max(jnp.abs(R)),
+                jnp.max(jnp.abs(Us)),
+                jnp.max(jnp.abs(sc)).astype(R.real.dtype),
+            ])
+
+    probe_fn.__name__ = f"serve_{op}_spectral_probe"
+    return probe_fn

@@ -1,0 +1,320 @@
+"""Mesh-sharded two-stage heev/svd as a STAGED program pipeline.
+
+The reference's two-stage split (src/he2hb.cc full→band, src/hb2st.cc
+bulge chase, src/stedc*.cc D&C, src/unmtr_* back-transforms; mirrored
+for SVD by src/ge2tb.cc/src/tb2bd.cc) composed over the ProcessGrid:
+
+- **Stage 1 (sharded)**: he2hb / ge2tb run over the operand's 2D-block
+  placement — the rounds-6/7 trailing-update recipes (slab-wise
+  dynamic_update_slice writes, lookahead split at the next panel,
+  GSPMD-sharded panel QR through the round-7 wide bases) are reused
+  verbatim because the stage IS the existing level driver, traced over
+  sharded inputs.
+- **Stage 2 (rank-0 strategy)**: the O(n·nb)-data band is GATHERED
+  (replicated over the mesh — the reference chases the band on rank 0,
+  src/hb2st.cc:19; the chase's sequential window chain does not shard)
+  and bulge-chased to tridiagonal/bidiagonal in one program.
+- **Stage 3 (host + device merges)**: stedc divide & conquer with its
+  device-resident merge gemms — sharded over the grid when one is
+  present (linalg/stedc._DeviceCtx).
+- **Stage 4 (sharded)**: the back-transforms are stacked gemms — the
+  hb2td sweep segments plus the he2hb/ge2tb level reflectors — applied
+  in one program whose outputs land 2D-block sharded.
+
+Every device stage is exposed through a ``stage(name, jitted_fn,
+args)`` hook: the serving Session routes it through ``_aot_compile``
+so each stage is a cost-analyzed AOT program feeding the round-9
+collective census; eager callers (api.heev_mesh / api.svd_mesh) get a
+module-level jit cache instead. Reflector OFFSETS are recomputed from
+the static (n, nb) level plan on the host side so stage boundaries
+exchange only arrays (offsets must stay static for the slice-based
+back-transforms).
+
+Scaling note: the staged path skips api.heev's extreme-range sigma
+scaling (serving operands are working-dtype conditioned by contract;
+the eager verbs keep the scaled path). Rank-deficiency note: the svd
+±0 subspace completion (linalg/svd._svd_band_gk) is host-interactive
+and is skipped here — serving SVD residents assume numerical rank k.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.exceptions import SlateError
+from ..core.grid import num_tiles
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import MatrixKind, Options, DEFAULT_OPTIONS
+from ..ops import blocked
+from ..linalg.eig import (he2hb, hb2td, unmtr_he2hb, unmtr_hb2td,
+                          _hb2td_jit)
+from ..linalg.svd import ge2tb, _apply_u, _apply_v
+from ..linalg.stedc import stedc as _stedc
+
+Array = jax.Array
+
+
+def _run(stage, name: str, jfn, args: Tuple):
+    """Run one device stage: through the caller's AOT hook when given
+    (the Session's _aot_compile seam), else the jitted fn directly."""
+    if stage is None:
+        return jfn(*args)
+    return stage(name, jfn, args)
+
+
+def _real_dtype(dtype):
+    return jnp.zeros((), dtype).real.dtype
+
+
+# ---------------------------------------------------------------------------
+# static level-plan offsets (host metadata, stage-boundary contract)
+# ---------------------------------------------------------------------------
+
+
+def eig_level_offsets(n: int, nb: int) -> Tuple[int, ...]:
+    """he2hb level offsets for a (n, nb) operand — the static half of
+    the ``reflectors`` entries (he2hb pads to npad then plans over
+    nt - 1 panel columns)."""
+    nt = num_tiles(n, nb)
+    offs, off = [], 0
+    for kp in blocked.level_plan(nt - 1):
+        offs.append(off)
+        off += kp * nb
+    return tuple(offs)
+
+
+def svd_level_offsets(n: int, nb: int) -> Tuple[int, ...]:
+    """ge2tb level offsets (plans over kt = npad/nb panel columns)."""
+    kt = num_tiles(n, nb)
+    offs, off = [], 0
+    for kp in blocked.level_plan(kt):
+        offs.append(off)
+        off += kp * nb
+    return tuple(offs)
+
+
+def _with_offsets(offs: Tuple[int, ...], pairs):
+    return [(off, Vs, Ts) for off, (Vs, Ts) in zip(offs, pairs)]
+
+
+def _strip_offsets(refl) -> Tuple[Tuple[Array, Array], ...]:
+    return tuple((Vs, Ts) for _off, Vs, Ts in refl)
+
+
+# ---------------------------------------------------------------------------
+# stage program makers (one jit per static signature, module-cached)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _he2hb_fn(opts: Options):
+    def reduce_stage(A):
+        band, refl = he2hb(A, opts)
+        return band, _strip_offsets(refl)
+    reduce_stage.__name__ = "spectral_he2hb"
+    return jax.jit(reduce_stage)
+
+
+@functools.lru_cache(maxsize=8)
+def _hb2td_fn():
+    def chase_stage(band):
+        return hb2td(band)
+    chase_stage.__name__ = "spectral_hb2td"
+    return jax.jit(chase_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _eig_back_fn(offs: Tuple[int, ...], n: int):
+    def back_stage(refl_pairs, Vh, Th, z, phase):
+        npad = Vh.shape[0] + 2
+        zt = jnp.zeros((npad, n), z.dtype).at[:n, :].set(z)
+        z1 = unmtr_hb2td(Vh, Th, zt, phase)
+        return unmtr_he2hb(_with_offsets(offs, refl_pairs), z1)
+    back_stage.__name__ = "spectral_unmtr"
+    return jax.jit(back_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _eig_dense_fn(opts: Options, n: int):
+    """Small-operand fallback (npad < 3·nb): he2hb + one-device dense
+    diagonalization of the band, as ONE analyzed program (the
+    _heev_band_dense recipe with the pad-decoupling diagonal shift)."""
+    def dense_stage(A):
+        nb = A.nb
+        band, refl = he2hb(A, opts)
+        bfull = band.full_dense_canonical()
+        npad = bfull.shape[0]
+        if npad != n:
+            big = (2 * nb + 1) * jnp.max(jnp.abs(bfull)) + 1.0
+            idx = jnp.arange(npad)
+            dpad = jnp.where(idx >= n,
+                             big.astype(jnp.real(bfull).dtype),
+                             jnp.real(jnp.diagonal(bfull)))
+            bfull = bfull.at[idx, idx].set(dpad.astype(bfull.dtype))
+        w, zb = jnp.linalg.eigh(bfull)
+        z = unmtr_he2hb(refl, zb[:, :n], trans=False)
+        return w[:n], z
+    dense_stage.__name__ = "spectral_heev_dense"
+    return jax.jit(dense_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _ge2tb_fn(opts: Options):
+    def reduce_stage(A):
+        band, u_refl, v_refl = ge2tb(A, opts)
+        return band, _strip_offsets(u_refl), _strip_offsets(v_refl)
+    reduce_stage.__name__ = "spectral_ge2tb"
+    return jax.jit(reduce_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _gk_chase_fn(nbw: int, npad: int):
+    """Golub-Kahan embed the ge2tb BAND in the perfect-shuffled
+    Hermitian [[0, Bᴴ],[B, 0]] (bandwidth 2·nb) and chase it — the
+    tb2bd analog through the heev stage-2 machinery
+    (linalg/svd._svd_band_gk)."""
+    def chase_stage(band):
+        bsq = band[:npad, :npad]
+        s2 = 2 * npad
+        C = jnp.zeros((s2, s2), bsq.dtype)
+        C = C.at[1::2, 0::2].set(bsq)
+        C = C.at[0::2, 1::2].set(jnp.conj(bsq).T)
+        return _hb2td_jit(C, b=2 * nbw)
+    chase_stage.__name__ = "spectral_tb2bd"
+    return jax.jit(chase_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _svd_back_fn(offs: Tuple[int, ...], nbw: int, mpad: int, npad: int):
+    def back_stage(u_pairs, v_pairs, Vh, Th, zsel, phase):
+        s2 = 2 * npad
+        k = zsel.shape[1]
+        spad = Vh.shape[0] + 2
+        zt = jnp.zeros((spad, k), zsel.dtype).at[:s2].set(zsel)
+        zb = unmtr_hb2td(Vh, Th, zt, phase)[:s2]
+        rdt = _real_dtype(zsel.dtype)
+        root2 = jnp.asarray(np.sqrt(2.0), rdt)
+        v = zb[0::2, :] * root2
+        u = zb[1::2, :] * root2
+        un = jnp.linalg.norm(u, axis=0)
+        vn = jnp.linalg.norm(v, axis=0)
+        u = u / jnp.where(un == 0, 1.0, un)
+        v = v / jnp.where(vn == 0, 1.0, vn)
+        u_pad = jnp.zeros((mpad, k), zsel.dtype).at[:npad].set(u)
+        Uf = _apply_u(_with_offsets(offs, u_pairs), u_pad, nbw,
+                      trans=False)
+        Vf = _apply_v(_with_offsets(offs, v_pairs), v, nbw, trans=False)
+        return Uf, Vf
+    back_stage.__name__ = "spectral_unmbr"
+    return jax.jit(back_stage)
+
+
+@functools.lru_cache(maxsize=64)
+def _svd_dense_fn(opts: Options, k: int, mpad: int, npad: int):
+    """Small-operand fallback: ge2tb + one-device dense band SVD in
+    one program (the api.svd small-band recipe)."""
+    def dense_stage(A):
+        nbw = A.nb
+        band, u_refl, v_refl = ge2tb(A, opts)
+        bsq = band[:npad, :npad]
+        ub, s, vbt = jnp.linalg.svd(bsq, full_matrices=False)
+        s_log = s[:k]
+        ub = ub[:, :k]
+        vbt = vbt[:k, :]
+        u_pad = jnp.zeros((mpad, k), ub.dtype).at[:npad].set(ub)
+        u = _apply_u(u_refl, u_pad, nbw, trans=False)
+        v = _apply_v(v_refl, jnp.conj(vbt).T, nbw, trans=False)
+        return s_log, u, v
+    dense_stage.__name__ = "spectral_svd_dense"
+    return jax.jit(dense_stage)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _gather(x, grid):
+    """Rank-0 strategy: replicate an array over the mesh before the
+    sequential chase (single-device: no-op)."""
+    if grid is None:
+        return x
+    return jax.device_put(x, grid.replicated())
+
+
+def heev_staged(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+                stage=None) -> Tuple[Array, TiledMatrix]:
+    """Mesh two-stage Hermitian eigendecomposition: returns
+    (Λ ascending, V TiledMatrix sharded over A's grid)."""
+    if A.kind not in (MatrixKind.Hermitian, MatrixKind.Symmetric):
+        raise SlateError("heev_staged: A must be Hermitian/Symmetric")
+    n = A.shape[0]
+    nb = A.nb
+    rdt = _real_dtype(A.dtype)
+    npad = num_tiles(n, nb) * nb
+    if npad < 3 * nb:
+        w, z = _run(stage, "spectral.heev_dense",
+                    _eig_dense_fn(opts, n), (A,))
+        Z = from_dense(z[:n], nb, grid=A.grid, logical_shape=(n, n))
+        return jnp.asarray(w, rdt), Z
+    band, refl_pairs = _run(stage, "spectral.he2hb", _he2hb_fn(opts),
+                            (A,))
+    band = band.with_data(_gather(band.data, A.grid))
+    d, e, Vh, Th, phase = _run(stage, "spectral.hb2td", _hb2td_fn(),
+                               (band,))
+    dn = np.asarray(d, np.float64)[:n]
+    en = np.asarray(e, np.float64)[: n - 1]
+    w, z = _stedc(dn, en, grid=A.grid)
+    z = jnp.asarray(np.asarray(z) if not isinstance(z, jax.Array) else z
+                    ).astype(A.dtype)
+    offs = eig_level_offsets(n, nb)
+    Zfull = _run(stage, "spectral.unmtr", _eig_back_fn(offs, n),
+                 (refl_pairs, Vh, Th, z, phase))
+    Z = from_dense(Zfull[:n], nb, grid=A.grid, logical_shape=(n, n))
+    return jnp.asarray(w, rdt), Z
+
+
+def svd_staged(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS,
+               stage=None) -> Tuple[Array, TiledMatrix, TiledMatrix]:
+    """Mesh two-stage thin SVD of tall A (m ≥ n): returns
+    (Σ descending, U (m, k), V (n, k)), k = min(m, n)."""
+    m, n = A.shape
+    if m < n:
+        raise SlateError(
+            "svd_staged: wide operands are not servable; register the "
+            "transpose (the api.svd verb handles wide per call)")
+    nb = A.nb
+    k = min(m, n)
+    rdt = _real_dtype(A.dtype)
+    mpad = num_tiles(m, nb) * nb
+    npad = num_tiles(n, nb) * nb
+    if npad < 3 * nb:
+        s, u, v = _run(stage, "spectral.svd_dense",
+                       _svd_dense_fn(opts, k, mpad, npad), (A,))
+        U = from_dense(u, nb, grid=A.grid, logical_shape=(m, k))
+        V = from_dense(v, nb, grid=A.grid, logical_shape=(n, k))
+        return jnp.asarray(s, rdt), U, V
+    band, u_pairs, v_pairs = _run(stage, "spectral.ge2tb",
+                                  _ge2tb_fn(opts), (A,))
+    band = _gather(band, A.grid)
+    d, e, Vh, Th, phase = _run(stage, "spectral.tb2bd",
+                               _gk_chase_fn(nb, npad), (band,))
+    s2 = 2 * npad
+    dn = np.asarray(d, np.float64)[:s2]
+    en = np.asarray(e, np.float64)[: s2 - 1]
+    w, z = _stedc(dn, en, grid=A.grid)
+    order = np.argsort(np.asarray(w))[::-1][:k].copy()
+    sig = np.maximum(np.asarray(w)[order], 0.0)
+    zsel = jnp.asarray(z)[:, jnp.asarray(order)].astype(A.dtype)
+    offs = svd_level_offsets(n, nb)
+    Uf, Vf = _run(stage, "spectral.unmbr",
+                  _svd_back_fn(offs, nb, mpad, npad),
+                  (u_pairs, v_pairs, Vh, Th, zsel, phase))
+    U = from_dense(Uf, nb, grid=A.grid, logical_shape=(m, k))
+    V = from_dense(Vf, nb, grid=A.grid, logical_shape=(n, k))
+    return jnp.asarray(sig.copy(), rdt), U, V

@@ -1,0 +1,166 @@
+"""Resident spectral payload types and the served matrix-function
+catalog.
+
+The serving Session stores an eigendecomposition ``(V, Λ)`` (op kind
+``eig``) or an SVD ``(U, Σ, Vᴴ)`` (op kind ``svd``) as ONE pytree
+resident — the analog of the LU/Cholesky factor payloads, so every
+op-agnostic seam (HBM accounting, eviction, checkpoint/restore,
+replication, migration) sees a spectral resident as just another
+factor tree. Both types are registered jax pytrees whose leaves are
+the sharded arrays; the metadata (tile sizes, kinds, grids) rides the
+TiledMatrix treedefs exactly like the dense factor payloads.
+
+The function catalog maps a served matrix function ``f`` to its
+diagonal weights — the served apply is always ``L·diag(w)·Rᴴ·b``:
+two gemms against the resident bases plus one diagonal scale, which
+is the whole point of keeping the decomposition resident (PAPER.md's
+two-stage cost is paid once at registration; every request after is
+gemm-rate work).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class EigFactors:
+    """Resident Hermitian eigendecomposition A = V·diag(Λ)·Vᴴ.
+
+    ``v``: TiledMatrix of eigenvectors (columns, sharded over the
+    operator's grid for mesh residents); ``lam``: real eigenvalues
+    ASCENDING (the heev/stedc convention), replicated."""
+
+    __slots__ = ("v", "lam")
+
+    def __init__(self, v, lam):
+        self.v = v
+        self.lam = lam
+
+    def tree_flatten(self):
+        return (self.v, self.lam), None
+
+    @classmethod
+    def tree_unflatten(cls, meta, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"EigFactors(n={self.v.shape[0]})"
+
+
+@jax.tree_util.register_pytree_node_class
+class SVDFactors:
+    """Resident thin SVD A = U·diag(Σ)·Vᴴ.
+
+    ``u``: (m, k) left vectors, ``s``: singular values DESCENDING
+    (the svd/bdsqr convention), ``v``: (n, k) right vectors,
+    k = min(m, n)."""
+
+    __slots__ = ("u", "s", "v")
+
+    def __init__(self, u, s, v):
+        self.u = u
+        self.s = s
+        self.v = v
+
+    def tree_flatten(self):
+        return (self.u, self.s, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, meta, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"SVDFactors(m={self.u.shape[0]}, n={self.v.shape[0]})"
+
+
+# ---------------------------------------------------------------------------
+# served matrix functions: f -> diagonal weights
+# ---------------------------------------------------------------------------
+#
+# Every entry is (weights(spectrum, theta), forward) where ``theta`` is
+# the function's scalar parameter TRACED into the apply program (a new
+# shift/regularizer/rank never recompiles) and ``forward`` picks the
+# gemm bases: True  -> X = L·diag(w)·Rᴴ·b in the operator's forward
+# direction (eig: V…Vᴴ; svd: U…Vᴴ), False -> the adjoint/inverse
+# direction (svd: V…Uᴴ — the pseudoinverse orientation).
+
+
+def _rank_of(theta, n):
+    """theta -> clamped integer rank for the truncate functions."""
+    return jnp.clip(jnp.round(theta).astype(jnp.int32), 0, n)
+
+
+def _eig_solve(lam, theta):
+    # solve-with-shift: (A - θ·I)⁻¹ b
+    return 1.0 / (lam - theta)
+
+
+def _eig_psd_project(lam, theta):
+    # nearest-PSD projection: clamp the negative modes to zero
+    return jnp.maximum(lam, jnp.zeros((), lam.dtype))
+
+
+def _eig_whiten(lam, theta):
+    # Λ^{-1/2} on the positive spectrum (θ: ridge added before the
+    # inverse square root — θ=0 is plain whitening)
+    lt = lam + theta
+    pos = lt > 0
+    safe = jnp.where(pos, lt, jnp.ones((), lam.dtype))
+    return jnp.where(pos, safe ** -0.5, jnp.zeros((), lam.dtype))
+
+
+def _eig_truncate(lam, theta):
+    # keep the round(θ) largest-|λ| modes (ascending λ: ties keep the
+    # whole tied group — deterministic, documented)
+    n = lam.shape[0]
+    r = _rank_of(theta, n)
+    srt = jnp.sort(jnp.abs(lam))  # ascending
+    guard = jnp.concatenate([srt, srt[-1:] + 1])
+    thr = jax.lax.dynamic_slice(guard, (n - r,), (1,))[0]
+    return jnp.where(jnp.abs(lam) >= thr, lam, jnp.zeros((), lam.dtype))
+
+
+def _svd_solve(s, theta):
+    # Tikhonov-regularized pseudoinverse: σ/(σ² + θ²); θ=0 -> 1/σ on
+    # the nonzero spectrum
+    nz = s > 0
+    safe = jnp.where(nz, s, jnp.ones((), s.dtype))
+    return jnp.where(nz, safe / (safe * safe + theta * theta),
+                     jnp.zeros((), s.dtype))
+
+
+def _svd_truncate(s, theta):
+    # rank-r truncated operator A_r·b (σ descending: first r survive)
+    r = _rank_of(theta, s.shape[0])
+    keep = jnp.arange(s.shape[0]) < r
+    return jnp.where(keep, s, jnp.zeros((), s.dtype))
+
+
+def _svd_whiten(s, theta):
+    # Σ^{-1} on the nonzero spectrum (+θ ridge) — the V·Σ⁻¹·Uᴴ
+    # whitening transform of a data matrix
+    nz = s > 0
+    safe = jnp.where(nz, s + theta, jnp.ones((), s.dtype))
+    return jnp.where(nz, 1.0 / safe, jnp.zeros((), s.dtype))
+
+
+# eig applies are V·diag(w)·Vᴴ always (forward is vacuous but kept so
+# both catalogs share one shape)
+EIG_FUNCTIONS = {
+    "solve": (_eig_solve, True),
+    "psd_project": (_eig_psd_project, True),
+    "whiten": (_eig_whiten, True),
+    "truncate": (_eig_truncate, True),
+}
+
+SVD_FUNCTIONS = {
+    "solve": (_svd_solve, False),      # V·w·Uᴴ (pinv direction)
+    "truncate": (_svd_truncate, True),  # U·w·Vᴴ (forward direction)
+    "whiten": (_svd_whiten, False),
+}
+
+
+def function_catalog(op: str) -> dict:
+    return EIG_FUNCTIONS if op == "eig" else SVD_FUNCTIONS

@@ -78,7 +78,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "cost_log": [], "hbm": {}, "slo": {},
         "tenants": _tenants_section(),
         "numerics": _numerics_section(),
-        "quotas": _quotas_section()}
+        "quotas": _quotas_section(),
+        "spectral": _spectral_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -165,6 +166,24 @@ def _quotas_section():
     }
 
 
+def _spectral_section():
+    """A minimal round-19 serve-artifact spectral section that passes
+    gate_mod._check_spectral_section."""
+    return {
+        "enabled": True,
+        "op": "eig",
+        "n": 96,
+        "functions": ["solve", "psd_project", "whiten", "truncate"],
+        "new_compiles_after_warmup": 0,
+        "apply_dot_ops": {"solve": 2, "psd_project": 2,
+                          "whiten": 2, "truncate": 2},
+        "stage_programs": ["spectral.he2hb", "spectral.hb2td",
+                           "spectral.unmtr"],
+        "solve_rel_err": 3.1e-6,
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -199,7 +218,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "per_request": {"solves_per_sec": 9.0}, "speedup": 13.3,
         "cost_log": [], "hbm": {}, "slo": {},
         "numerics": _numerics_section(),
-        "quotas": _quotas_section()}
+        "quotas": _quotas_section(),
+        "spectral": _spectral_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

@@ -102,6 +102,13 @@ TRACKED_FAILOVER = ("failover_s", "recovery_s_max",
 # improvement), reqs_per_sec higher
 TRACKED_FAIR = ("reqs_per_sec", "p50_latency_s", "p99_latency_s",
                 "completed")
+# the round-19 resident-spectral A/B (bench_serve.py --spectral →
+# BENCH_SPECTRAL_r*.json): one record per op row (eig / svd);
+# theta-varying applies from a resident eigendecomposition vs the
+# full two-stage decomposition per request. The zero-new-compiles and
+# two-gemm apply-census columns are structural evidence, not series.
+TRACKED_SPECTRAL = ("resident.applies_per_sec",
+                    "cold.applies_per_sec", "speedup")
 GATED_PLATFORMS = ("tpu", "axon")
 
 # mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
@@ -112,7 +119,7 @@ GATED_PLATFORMS = ("tpu", "axon")
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas")
+    "tenants", "numerics", "quotas", "spectral")
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
@@ -200,7 +207,8 @@ def normalize(path: str) -> dict:
                                                       "serve_mixed",
                                                       "serve_overload",
                                                       "serve_failover",
-                                                      "serve_fair"):
+                                                      "serve_fair",
+                                                      "serve_spectral"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
@@ -232,6 +240,8 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_serve_failover(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_fair":
         return _normalize_serve_fair(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_spectral":
+        return _normalize_serve_spectral(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "chaos":
         return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
@@ -411,6 +421,23 @@ def _validate_ckpt_node(desc, where: str) -> List[str]:
         for j, d in enumerate(items):
             errs.extend(_validate_ckpt_node(d, f"{where}[{j}]"))
         return errs
+    if t in ("eig_factors", "svd_factors"):
+        # round-19 spectral nodes: basis matrices nest as full node
+        # descriptors, the spectrum is a direct blob
+        nested = ("v",) if t == "eig_factors" else ("u", "v")
+        spec = "lam" if t == "eig_factors" else "s"
+        errs = []
+        for field in nested:
+            errs.extend(_validate_ckpt_node(desc.get(field),
+                                            f"{where}.{field}"))
+        b = desc.get(spec)
+        if not isinstance(b, dict):
+            errs.append(f"{where}.{spec}: missing blob descriptor")
+        else:
+            for k in CHECKPOINT_BLOB_KEYS:
+                if k not in b:
+                    errs.append(f"{where}.{spec}: blob missing {k!r}")
+        return errs
     blob_fields = {"array": ("a",), "tiled": ("data",),
                    "packed_band": ("ab",), "qr_factors": ("vr", "t")}
     if t not in blob_fields:
@@ -425,6 +452,49 @@ def _validate_ckpt_node(desc, where: str) -> List[str]:
             if k not in b:
                 errs.append(f"{where}.{field}: blob missing {k!r}")
     return errs
+
+
+def _normalize_serve_spectral(name: str, obj: dict,
+                              rnd: Optional[int]) -> List[dict]:
+    """The round-19 resident-spectral A/B artifact: {"bench":
+    "serve_spectral", "platform", "n", "rows": [{op, resident, cold,
+    speedup, one_program, ...}], "ok"} — one record per op row (the
+    op in its natural series-key slot). A row that stopped being
+    structurally one-program (compiles after warmup, or an apply that
+    is no longer two gemms) fails schema validation outright — that
+    is a broken serving claim, not a slow one."""
+    for k in ("platform", "n", "nb", "requests", "rows", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_spectral artifact "
+                              f"missing {k!r}")
+    rows = obj["rows"]
+    if not isinstance(rows, list) or not rows:
+        raise SchemaError(f"{name}: serve_spectral rows missing/empty")
+    out = []
+    for i, row in enumerate(rows):
+        for k in ("op", "n", "resident", "cold", "speedup",
+                  "new_compiles_after_warmup", "apply_dot_ops",
+                  "census", "max_rel_err", "one_program"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[rows.{i}]: serve_spectral row missing "
+                    f"{k!r}")
+        if row["op"] not in ("eig", "svd"):
+            raise SchemaError(f"{name}[rows.{i}]: serve_spectral op "
+                              f"{row['op']!r} not eig/svd")
+        if not row["one_program"]:
+            raise SchemaError(
+                f"{name}[rows.{i}]: spectral serving is no longer "
+                "one-program (compiles after warmup, or an apply "
+                "that is not two gemms)")
+        out.append({
+            "round": rnd, "source": f"{name}[{row['op']}]",
+            "kind": "serve_spectral",
+            "platform": str(obj["platform"]), "n": int(row["n"]),
+            "op": str(row["op"]), "ok": bool(obj.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_SPECTRAL),
+        })
+    return out
 
 
 def _normalize_chaos(name: str, obj: dict,
@@ -608,6 +678,41 @@ def _check_quotas_section(name: str, section) -> None:
                 f"{name}: quotas.tenants[{t!r}] missing resident_bytes")
 
 
+def _check_spectral_section(name: str, section) -> None:
+    """Validate the round-19 serve-artifact ``spectral`` section: the
+    resident-eigendecomposition structural columns — zero new compiles
+    across theta-varying serves, the two-gemm dot census of every
+    warmed apply program, and the exit-gated verdict. A committed
+    fixture whose spectral serving recompiles per theta (or whose
+    apply stopped being two gemms) is a broken serving claim."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: spectral section is not an object")
+    for k in ("enabled", "op", "n", "functions",
+              "new_compiles_after_warmup", "apply_dot_ops",
+              "stage_programs", "solve_rel_err", "ok"):
+        if k not in section:
+            raise SchemaError(f"{name}: spectral section missing {k!r}")
+    if section["new_compiles_after_warmup"] != 0:
+        raise SchemaError(
+            f"{name}: spectral section recorded "
+            f"{section['new_compiles_after_warmup']} compiles after "
+            "warmup (theta must be traced, never a recompile)")
+    dots = section["apply_dot_ops"]
+    if not isinstance(dots, dict) or not dots:
+        raise SchemaError(f"{name}: spectral.apply_dot_ops "
+                          "missing/empty")
+    for fn, d in dots.items():
+        if d != 2:
+            raise SchemaError(
+                f"{name}: spectral apply {fn!r} lowered to {d} dot "
+                "ops (the served apply is exactly two gemms + a "
+                "diagonal scale)")
+    if not isinstance(section["stage_programs"], list) \
+            or not section["stage_programs"]:
+        raise SchemaError(f"{name}: spectral.stage_programs "
+                          "missing/empty")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -638,6 +743,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         _check_tenants_section(name, obj["tenants"])
         _check_numerics_section(name, obj["numerics"])
         _check_quotas_section(name, obj["quotas"])
+        _check_spectral_section(name, obj["spectral"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -709,6 +815,7 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_OVERLOAD_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_FAILOVER_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_FAIR_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_SPECTRAL_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition

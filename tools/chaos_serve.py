@@ -964,6 +964,124 @@ def run_migration_drill(seed):
     return report, inj
 
 
+def run_spectral_drill(seed):
+    """Resident-spectral fleet drill (round 19): eigendecompositions
+    are full fleet citizens. Two exit-gated halves:
+
+    1. **Replica failover**: a 2-member fleet serves a resident eig
+       operator on p0, heat-replicates it to p1 (the round-17
+       checkpoint-transfer path moving the ``eig_factors`` node), then
+       p0 dies with a request in flight. The replica must serve with
+       ZERO refactors (the 9n³ two-stage decomposition is exactly what
+       failover exists to not re-pay), the queued future must resolve,
+       and the post-crash answers stay residual-correct.
+
+    2. **Suspect reflex on a poisoned spectrum**: a single session
+       with the numerics monitor at probe rate 1.0 serves an eig
+       operator whose resident Λ is shifted by ‖A‖ after factoring —
+       a genuinely wrong eigendecomposition the one-gemm residual
+       probe (‖A·v_i − λ_i·v_i‖) must catch. The handle must demote to
+       SUSPECT (counted transition), and the state must land in the
+       placement snapshot's health column."""
+    import jax
+
+    from slate_tpu.runtime import Fleet, Session
+    from slate_tpu.spectral import EigFactors
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 9)
+    n, nb = 32, 16
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    a = ((a + a.T) / 2 + n * np.eye(n)).astype(np.float32)
+
+    # -- half 1: replicated eigendecomposition survives member death --
+    import shutil
+    import tempfile
+    root = tempfile.mkdtemp(prefix="slate_spectral_drill_")
+    sessions = {f"p{i}": Session(hbm_budget=64 << 20)
+                for i in range(2)}
+    for s in sessions.values():
+        s.enable_attribution()  # handle heat rides the ledger
+    fleet = Fleet(sessions, max_batch=4, max_wait=3600.0,
+                  checkpoint_root=root)
+    A = st.from_dense(a, nb=nb, kind=st.MatrixKind.Hermitian)
+    fleet.register(A, op="eig", handle="s0", member="p0")
+    # ballast on the survivor so the fleet keeps a non-spectral lane
+    spd = (a @ a.T / n + n * np.eye(n)).astype(np.float32)
+    fleet.register(st.hermitian(np.tril(spd), nb=nb,
+                                uplo=st.Uplo.Lower),
+                   op="chol", handle="c0", member="p1")
+    fleet.warmup()
+    futs = []
+    for _ in range(4):  # heat: the eig resident is the hot handle
+        b = rng.standard_normal(n).astype(np.float32)
+        futs.append((fleet.submit("s0", b), "s0", b))
+        fleet.flush()
+    replicated = fleet.replicate_hot(1)
+    pre_factors = sum(fleet.member(m).metrics.get("factors_total")
+                      for m in fleet.alive() if m != "p0")
+    bq = rng.standard_normal(n).astype(np.float32)
+    fq = fleet.submit("s0", bq)  # in flight at the moment of death
+    fleet.kill("p0")
+    b2 = rng.standard_normal(n).astype(np.float32)
+    f2 = fleet.submit("s0", b2)
+    fleet.flush()  # drains the re-routed orphan too
+    queued_ok = fq.done() and fq.exception() is None
+    wrong = 0
+    if queued_ok:
+        wrong += int(_check_residual(a, fq.result(), bq) > RESID_TOL)
+    wrong += int(_check_residual(a, f2.result(), b2) > RESID_TOL)
+    refactors = sum(fleet.member(m).metrics.get("factors_total")
+                    for m in fleet.alive()) - pre_factors
+    lost = sum(1 for f, _, _ in futs if not f.done())
+    replica_served = fleet.metrics.get("fleet_failover_replica_served")
+    cons = {m: _conservation(fleet.member(m).metrics)
+            for m in fleet.alive()}
+    shutil.rmtree(root, ignore_errors=True)
+
+    # -- half 2: poisoned spectrum -> suspect demotion ----------------
+    sess = Session()
+    sess.enable_numerics(sample_fraction=1.0, sample_seed=seed)
+    h = sess.register(st.from_dense(a, nb=nb,
+                                    kind=st.MatrixKind.Hermitian),
+                      op="eig")
+    sess.warmup(h, nrhs=1)
+    res = sess._cache[h]
+    anorm = float(np.abs(a).sum(axis=1).max())
+    # shift Λ by ‖A‖: V is still orthonormal but A·v − λ·v is now
+    # O(‖A‖) — a wrong decomposition only the residual probe can see
+    res.payload = EigFactors(
+        res.payload.v, res.payload.lam + 10.0 * anorm)
+    sess.apply(h, rng.standard_normal(n).astype(np.float32))
+    health = sess.numerics.health(h)
+    rows = sess.placement_snapshot(host="drill")["rows"]
+    placement_health = rows[0]["health"] if rows else None
+    transitions = sess.metrics.get("health_transitions_total")
+    cons_b = _conservation(sess.metrics)
+
+    return {
+        "replicated": [str(x) for x in replicated],
+        "queued_request_served": queued_ok,
+        "refactors_after_crash": refactors,
+        "replica_served": replica_served,
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "suspect_health": health,
+        "suspect_placement_health": placement_health,
+        "health_transitions": transitions,
+        "conservation": {"per_member": cons, "single": cons_b,
+                         "ok": (all(c["ok"] for c in cons.values())
+                                and cons_b["ok"])},
+        "ok": (queued_ok and wrong == 0 and lost == 0
+               and refactors == 0 and replica_served >= 1
+               and health == "suspect"
+               and placement_health == "suspect"
+               and transitions >= 1
+               and all(c["ok"] for c in cons.values())
+               and cons_b["ok"]),
+    }
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -974,6 +1092,7 @@ def run_all(seed, waves):
     recovery, inj_r = run_recovery_drill(seed)
     noisy, inj_n = run_noisy_drill(seed)
     migration, inj_g = run_migration_drill(seed)
+    spectral = run_spectral_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
                            for i in (inj, inj_b, inj_m, inj_r,
@@ -989,7 +1108,8 @@ def run_all(seed, waves):
             "numerics_drill": numerics,
             "recovery_drill": recovery,
             "noisy_drill": noisy,
-            "migration_drill": migration}, schedule
+            "migration_drill": migration,
+            "spectral_drill": spectral}, schedule
 
 
 def main(argv=None):
@@ -1059,6 +1179,12 @@ def main(argv=None):
         # follow, an injected mid-transfer abort leaves the source
         # serving and retries counted) vs 1 refactor/handle evicted
         "migration_zero_refactor": phases["migration_drill"]["ok"],
+        # round 19: a replicated resident eigendecomposition survives
+        # its member's death mid-soak — the replica serves with zero
+        # refactors and zero lost futures — and a poisoned spectrum
+        # (Λ shifted by 10‖A‖ after factoring) is caught by the
+        # one-gemm residual probe and demoted to suspect
+        "spectral_resident_survives": phases["spectral_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0
