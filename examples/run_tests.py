@@ -186,6 +186,20 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --spectral FAILED")
+    # incremental-maintenance A/B smoke (round 20): rank-k update /
+    # QR row append+delete vs evict+refactor — exits nonzero unless
+    # every row serves its mutations with zero refactors and zero new
+    # compiles after warmup, and delta checkpoints ship fewer bytes
+    # than full ones (the structural claims; speeds are CPU smoke)
+    print("=== bench_serve.py --updates --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--updates", "--smoke",
+         "--updates-out", "/tmp/BENCH_UPDATE_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --updates FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure —

@@ -1,0 +1,291 @@
+"""Incremental factor maintenance (round 20): rank-k Cholesky
+up/downdates and QR row append — serve operand mutations at O(n²k)
+against the RESIDENT factor instead of paying the O(n³) refactor.
+
+The classical recipes, in their TPU-shaped form:
+
+* **Cholesky rank-k update/downdate** — Gill–Golub–Murray–Saunders,
+  *Methods for Modifying Matrix Factorizations* (Math. Comp. 28, 1974)
+  method C1/C2, in the multiple-rank sweep formulation of Davis & Hager
+  (*Row Modifications of a Sparse Cholesky Factorization*, SIMAX 2005):
+  for A' = A ± W·Wᴴ, sweep the columns of L once; at column j each of
+  the k vectors contributes one plane rotation (update: a Givens
+  rotation mixing L[:,j] with w; downdate: its hyperbolic twin) chosen
+  to annihilate w[j]. The downdate's rotation exists only while
+  L[j,j]² − |w[j]|² > 0 — a failed positivity check means A − WWᴴ is
+  not positive definite, reported as ``info = j+1`` (LAPACK
+  convention) and NEVER a silently wrong factor: the serving layer
+  degrades to a counted refactor of the committed operand.
+* **QR row append** — GGMS method Q4: appending p rows U to a factored
+  m×n A costs the structured QR of [R; U]. Column j's Householder
+  reflector is v = [e_j; w_j] (one in the R row, a length-p tail) —
+  R's triangularity is preserved, no base-factor row is touched, and
+  the resident (V, T) pair keeps answering for the original m rows.
+  The served least-squares solve applies the base Qᴴ (resident unmqr)
+  then the p-tail reflectors in a forward scan, then one trsm against
+  the appended R.
+
+Kernel shape discipline (the round-10 bucket rationale): zero update
+vectors are exactly inert for the rotation sweep (r = L[j,j], c = 1,
+s = 0) and zero appended rows are exactly inert for the structured QR
+(xn2 = 0 ⇒ τ = 0) — both pinned by test — so ranks/row-counts are
+padded to pow2 buckets and a stream of k = 1..16 updates compiles
+O(log k) programs, not k.
+
+Everything here is plain traced jnp/lax code (scans with dynamic row/
+column slices — O(n) rotation steps of O(n·k) work each): the Session
+compiles it through the same ``_aot_compile`` census seam as every
+other serving program, and ``*_batched`` variants route through
+linalg/batched's per-bucket program cache for Kalman-filter/RLS
+fleets of small residents.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.precision import accurate_matmuls
+from ..core.tiled_matrix import TiledMatrix, from_dense
+from ..core.types import MatrixKind, Options, Side, Uplo, DEFAULT_OPTIONS
+from ..ops import blocked
+from . import blas3
+from .qr import QRFactors, unmqr
+
+Array = jax.Array
+
+
+def bucket_k(k: int) -> int:
+    """Pow2 compilation bucket for an update rank / appended-row count
+    (the round-10 quantum: zero padding lanes are exactly inert)."""
+    return blocked.bucket_pow2(max(int(k), 1), 1)
+
+
+# -- Cholesky rank-k up/downdate (GGMS C1/C2, Davis–Hager sweep) ------------
+
+
+def chol_update_dense(l: Array, w: Array, sign: int,
+                      n: int = None) -> Tuple[Array, Array]:
+    """One rotation sweep over a dense lower factor: A' = A + sign·WWᴴ.
+
+    ``l``: (npad, npad) lower-triangular factor (zero above the
+    diagonal and beyond the logical n — the from_dense invariant).
+    ``w``: (npad, kb) update vectors, zero-padded in both rows beyond n
+    and columns beyond the live rank (padding is exactly inert).
+    ``sign``: static +1 (update) or −1 (downdate). ``n``: static
+    logical dimension (defaults to the full array size).
+
+    Returns ``(l', info)`` — info 0, or the 1-based column where a
+    downdate first failed the positivity check (the result array is
+    then garbage past that column and MUST be discarded; values stay
+    finite — the rotation denominator is clamped — so no NaN ever
+    leaks into a downstream program)."""
+    if n is None:
+        n = l.shape[-1]
+    npad = l.shape[-1]
+    kb = w.shape[-1]
+    rdt = jnp.finfo(l.dtype).dtype  # real counterpart of the dtype
+    tiny = jnp.asarray(jnp.finfo(rdt).tiny, rdt)
+    rows = jnp.arange(npad)
+
+    def body(carry, j):
+        l, w, info = carry
+        lcol = lax.dynamic_slice_in_dim(l, j, 1, axis=1)[:, 0]
+        for i in range(kb):  # static rank bucket: unrolled, kb ≤ 16
+            x = w[:, i]
+            ljj = jnp.real(lcol[j])
+            xj = x[j]
+            ax2 = jnp.real(xj * jnp.conj(xj))
+            if sign > 0:
+                r2 = ljj * ljj + ax2
+            else:
+                r2 = ljj * ljj - ax2
+                fail = r2 <= jnp.zeros((), rdt)
+                info = jnp.where((info == 0) & fail,
+                                 (j + 1).astype(jnp.int32), info)
+            r = jnp.sqrt(jnp.maximum(r2, tiny))
+            c = (ljj / r).astype(l.dtype)
+            s = (xj / r).astype(l.dtype)
+            if sign > 0:
+                newcol = c * lcol + jnp.conj(s) * x
+            else:
+                newcol = c * lcol - jnp.conj(s) * x
+            newx = c * x - s * lcol
+            if sign < 0:
+                # freeze the sweep past the first positivity failure:
+                # the result is discarded (counted refactor), but it
+                # must stay FINITE — otherwise the c = ljj/√tiny blowup
+                # cascades to inf/NaN in later columns and a NaN array
+                # reaches block_until_ready/debug dumps
+                ok = info == 0
+                newcol = jnp.where(ok, newcol, lcol)
+                newx = jnp.where(ok, newx, x)
+            lcol = jnp.where(rows >= j, newcol, lcol)
+            xnew = jnp.where(rows > j, newx,
+                             jnp.zeros((), l.dtype))
+            xnew = jnp.where(rows < j, x, xnew)
+            w = w.at[:, i].set(xnew)
+        l = lax.dynamic_update_slice_in_dim(l, lcol[:, None], j, axis=1)
+        return (l, w, info), None
+
+    info0 = jnp.zeros((), jnp.int32)
+    (l, _, info), _ = lax.scan(body, (l, w, info0),
+                               jnp.arange(n, dtype=jnp.int32))
+    return l, info
+
+
+@accurate_matmuls
+def chol_update_factor(L: TiledMatrix, w: Array, sign: int,
+                       opts: Options = DEFAULT_OPTIONS
+                       ) -> Tuple[TiledMatrix, Array]:
+    """Rank-k up/downdate of a resident potrf factor. ``w`` is the
+    (npad, kb) padded vector block (see :func:`chol_update_dense`).
+    Returns ``(L', info)`` with L' structurally IDENTICAL to the potrf
+    output (same kind/uplo/nb/logical shape — so a warmed solve
+    program's treedef still matches and serving pays zero new
+    compiles, the acceptance pin)."""
+    del opts  # rotation sweep has no tunables; kept for verb symmetry
+    n = L.shape[1]
+    ld, info = chol_update_dense(L.dense_canonical(), w, sign, n=n)
+    out = from_dense(jnp.tril(ld), L.nb, kind=MatrixKind.Triangular,
+                     uplo=Uplo.Lower, logical_shape=(n, n))
+    return out, info
+
+
+def _k_chol_update(sign: int):
+    """Batched-kernel body factory for linalg/batched's _run_bucket
+    (fn(*args, nb) calling convention): one program per (B-bucket, n,
+    k-bucket, dtype), a vmap of the SAME sweep the dense path runs —
+    so the batched lane is bit-identical to B=1 by construction
+    (batch-independent arithmetic, like every round-10 kernel)."""
+    def kern(l, w, nb):
+        del nb
+        return jax.vmap(
+            lambda li, wi: chol_update_dense(li, wi, sign))(l, w)
+    kern.__name__ = f"k_chol_update_{'up' if sign > 0 else 'down'}"
+    return kern
+
+
+def chol_update_batched(l: Array, w: Array, sign: int,
+                        live_batch=None) -> Tuple[Array, Array]:
+    """[B, n, n] stack of small resident factors, each up/downdated by
+    its own [n, kb] vector block — the Kalman-filter/RLS lane, routed
+    through the per-bucket program cache (one compile per (B-bucket,
+    n, k-bucket, dtype), per-item info isolation like every batched
+    driver)."""
+    from . import batched as _batched
+    name = f"chol_update_batched_{'up' if sign > 0 else 'down'}"
+    return _batched._run_bucket(name, _k_chol_update(sign), 0, l, w,
+                                live_batch=live_batch)
+
+
+# -- QR row append (GGMS Q4: structured QR of [R; U]) -----------------------
+
+
+@accurate_matmuls
+def qr_append_build(vr: Array, u: Array, n: int
+                    ) -> Tuple[Array, Array, Array]:
+    """Structured QR of [R; U] for R = triu(vr) (the resident factor's
+    packed V\\R storage) and U an (P, npad) block of appended rows
+    (zero rows beyond the live count are exactly inert — the pow2
+    P-bucket invariant, pinned by test).
+
+    Returns ``(w, tau, r)``: per-column reflector tails w (P, npad),
+    scalars tau (npad,), and the appended upper factor r (npad, npad).
+    Columns beyond the logical n stay zero/identity."""
+    npad = vr.shape[1]
+    r0 = jnp.triu(vr)[:npad, :npad]
+    dt = r0.dtype
+    one = jnp.ones((), dt)
+    cols = jnp.arange(npad)
+    w0 = jnp.zeros_like(u)
+    tau0 = jnp.zeros((npad,), dt)
+
+    def body(carry, j):
+        r, umat, wacc, tacc = carry
+        alpha = lax.dynamic_slice_in_dim(
+            lax.dynamic_slice_in_dim(r, j, 1, axis=0), j, 1,
+            axis=1)[0, 0]
+        x = lax.dynamic_slice_in_dim(umat, j, 1, axis=1)[:, 0]
+        xn2 = jnp.sum(jnp.real(x * jnp.conj(x)))
+        an = jnp.abs(alpha)
+        phase = jnp.where(an > 0, alpha / jnp.where(an > 0, an, 1.0),
+                          one)
+        beta = -phase * jnp.sqrt(an * an + xn2).astype(dt)
+        inert = xn2 == 0  # zero appended column: identity reflector
+        tj = jnp.where(inert, jnp.zeros((), dt),
+                       (beta - alpha) / jnp.where(inert, one, beta))
+        wj = jnp.where(inert, jnp.zeros((), dt),
+                       x / jnp.where(inert, one, alpha - beta))
+        rrow = lax.dynamic_slice_in_dim(r, j, 1, axis=0)[0]
+        # vᴴ·y per column: earlier columns are already eliminated
+        # (R[j, c<j] = 0 and U[:, c<j] = 0), so vy self-masks
+        vy = rrow + jnp.conj(wj) @ umat
+        rrow = rrow - tj * vy
+        rrow = jnp.where(cols == j, jnp.where(inert, alpha, beta),
+                         rrow)
+        r = lax.dynamic_update_slice_in_dim(r, rrow[None, :], j,
+                                            axis=0)
+        umat = umat - tj * jnp.outer(wj, vy)
+        umat = jnp.where((cols == j)[None, :],
+                         jnp.zeros((), dt), umat)
+        wacc = jnp.where((cols == j)[None, :], wj[:, None], wacc)
+        tacc = jnp.where(cols == j, tj, tacc)
+        return (r, umat, wacc, tacc), None
+
+    (r, _, w, tau), _ = lax.scan(body, (r0, u, w0, tau0),
+                                 jnp.arange(n, dtype=jnp.int32))
+    return w, tau, r
+
+
+def qr_append_factor(qr: QRFactors, u: Array
+                     ) -> Tuple[Array, Array, Array]:
+    """Append factors against a resident geqrf result (see
+    :func:`qr_append_build`); ``u`` is (P, npad) zero-padded."""
+    return qr_append_build(qr.vr, u, qr.n)
+
+
+@accurate_matmuls
+def appended_gels(payload: Tuple, B: TiledMatrix,
+                  opts: Options = DEFAULT_OPTIONS) -> TiledMatrix:
+    """Least-squares solve against an appended QR resident: payload is
+    the 5-tuple ``(qr, u, w, tau, r)`` the Session keeps after row
+    appends (qr: the UNTOUCHED base factors; u: the raw appended rows,
+    carried for checkpoint fidelity; w/tau/r: the append factors).
+    X = R'⁻¹ · (Q'ᴴ·B)[:n] with Q'ᴴ applied as the base Qᴴ on the top
+    m rows (resident unmqr — the amortized part) followed by the
+    appended reflectors' forward sweep over [c_top; d]."""
+    qr, _u, w, tau, r = payload
+    nb, n, m = qr.nb, qr.n, qr.m
+    q = B.shape[1]
+    bd = B.dense_canonical()
+    btop = from_dense(bd[:m], nb, logical_shape=(m, q))
+    c = unmqr(Side.Left, qr, btop, trans=True, opts=opts)
+    npad = r.shape[0]
+    ct = c.dense_canonical()[:npad]
+    p_log = B.shape[0] - m
+    P = w.shape[0]
+    d = bd[m:m + p_log]
+    if d.shape[0] < P:  # pad appended rhs rows to the reflector bucket
+        d = jnp.pad(d, ((0, P - d.shape[0]), (0, 0)))
+
+    def body(carry, j):
+        ct, d = carry
+        wj = lax.dynamic_slice_in_dim(w, j, 1, axis=1)[:, 0]
+        tj = tau[j]
+        crow = lax.dynamic_slice_in_dim(ct, j, 1, axis=0)[0]
+        vy = crow + jnp.conj(wj) @ d
+        ct = lax.dynamic_update_slice_in_dim(
+            ct, (crow - tj * vy)[None, :], j, axis=0)
+        d = d - tj * jnp.outer(wj, vy)
+        return (ct, d), None
+
+    (ct, _), _ = lax.scan(body, (ct, d),
+                          jnp.arange(n, dtype=jnp.int32))
+    rtm = from_dense(jnp.triu(r), nb, kind=MatrixKind.Triangular,
+                     uplo=Uplo.Upper, logical_shape=(n, n))
+    ct_tm = from_dense(ct, nb, logical_shape=(n, q))
+    return blas3.trsm(Side.Left, 1.0, rtm, ct_tm, opts)

@@ -83,6 +83,9 @@ CLASSES: Dict[str, str] = {
     "factor_flops": "factor_flops_total",
     "solve_flops": "solve_flops_total",
     "refine_flops": "refine_flops_total",
+    # round 20: incremental factor maintenance (rank-k up/downdates,
+    # QR row append) — executed-bucket model flops per served update
+    "update_flops": "update_flops_total",
     "bytes": "bytes_accessed_total",
     "ici_bytes": "collective_bytes_total",
     "device_seconds": "device_seconds_total",

@@ -135,6 +135,37 @@ def band_factor(n: int, band: int) -> float:
     return 2.0 * n * band * band if band else 2.0 * n
 
 
+# -- incremental factor maintenance (round 20) ------------------------------
+
+
+def update_chol(n: int, k: int) -> float:
+    """Rank-k Cholesky up/downdate of a resident n×n L (GGMS '74 /
+    Davis–Hager rotation sweep): each of the k vectors touches every
+    column once — one rotation build + one length-(n-j) axpy pair per
+    (column, vector), ~4·Σ(n-j) ≈ 2n² per vector."""
+    return 2.0 * n * n * k
+
+
+def update_qr(m: int, n: int, k: int) -> float:
+    """Append k rows to a resident m×n QR: the structured factorization
+    of [R; U] — per column j a length-k reflector applied to the n-j
+    trailing columns of (R row j, U), ~6·Σ k·(n-j) ≈ 3n²k (build +
+    two-sided apply; m enters only through the base factor, kept for
+    signature symmetry)."""
+    return 3.0 * n * n * k
+
+
+def update_flops(op: str, m: int, n: int, k: int) -> float:
+    """Model flops of one rank-k/row-k incremental update against a
+    resident factor, keyed by the Session op kind (chol/chol_small
+    share the dense model — the batched dispatch credits B×)."""
+    if op in ("chol", "chol_small"):
+        return update_chol(n, k)
+    if op == "qr":
+        return update_qr(m, n, k)
+    raise ValueError(f"update_flops: unsupported op {op!r}")
+
+
 # -- spectral two-stage per-stage models (round 19) -------------------------
 
 # heev_2stage's 9n³ total splits across the staged programs roughly as
@@ -247,6 +278,12 @@ TESTER_MODELS: Dict[str, Callable[[int, int], float]] = {
     "svd": svd,
     "svd_vec": lambda m, n: heev_2stage(n),
     "hesv": lambda m, n: hetrf(n),
+    # round 20: incremental-maintenance rows use a FIXED k=4 (same
+    # discipline as the batched rows' fixed B=4 — an (m, n) sweep row
+    # must name the work its body executes); the serving ledger charges
+    # the EXACT rank via update_flops(op, m, n, k)
+    "potrf_update": lambda m, n: update_chol(n, 4),
+    "geqrf_rowadd": lambda m, n: update_qr(m, n, 4),
 }
 
 

@@ -148,6 +148,37 @@ def aasen_growth(LT, a) -> float:
                / max(np.linalg.norm(an, 1), 1e-300))
 
 
+# -- incremental-update budget (round 20 — ONE source of truth) -------------
+
+# Default accumulated-update weight a resident factor absorbs before
+# the Session schedules a counted refactor. Weight is Σ k·max(1, ‖W‖₁²/
+# ‖A‖₁) over the updates applied since the last fresh factor — the
+# count×growth form of the GGMS error accumulation (each rank-1 sweep
+# adds O(u·‖W‖²/‖A‖) relative backward error, so small updates charge
+# exactly their rank and large ones charge proportionally more).
+DEFAULT_UPDATE_BUDGET = 64.0
+
+
+def update_weight(k: int, wnorm1_sq: float, anorm1: float) -> float:
+    """Accumulation charge of one rank-k update: k·max(1, ‖W‖₁²/‖A‖₁).
+    Small deltas charge exactly k (the threshold-pin property tests
+    rely on); deltas comparable to the operand itself charge more —
+    they degrade conditioning faster than their rank suggests."""
+    rel = wnorm1_sq / anorm1 if anorm1 > 0.0 else 0.0
+    return float(k) * max(1.0, rel)
+
+
+def update_refactor_due(count: int, weight: float, budget: float) -> bool:
+    """Has the accumulated update weight exceeded the budget? The ONE
+    predicate both the Session's update verb and the monitor's
+    bookkeeping consult (ROADMAP item 2: update-count × growth bound
+    decides, in obs/numerics — not scattered per caller). ``count`` is
+    carried for observability/symmetry; weight ≥ count by construction
+    so the budget bounds both."""
+    del count
+    return float(weight) > float(budget)
+
+
 # -- Hager/Higham 1-norm estimation (the ?gecon / norm1est lineage) ---------
 
 
@@ -286,6 +317,7 @@ class NumericsConfig:
     growth_degraded: float = 1e4
     growth_suspect: float = 1e8
     refine_drift_degraded: float = 4.0
+    update_budget: float = DEFAULT_UPDATE_BUDGET
 
 
 class _HandleStats:
@@ -293,6 +325,7 @@ class _HandleStats:
                  "condest", "growth", "nonfinite",
                  "resid_ewma", "resid_last", "resid_max", "resid_count",
                  "refine_ewma", "refine_floor", "refine_count", "state",
+                 "updates", "update_weight",
                  "gauge")
 
     def __init__(self):
@@ -311,6 +344,8 @@ class _HandleStats:
         self.refine_ewma = None
         self.refine_floor = None
         self.refine_count = 0
+        self.updates = 0
+        self.update_weight = 0.0
         self.state = "healthy"
 
 
@@ -378,7 +413,39 @@ class NumericsMonitor:
                 # per-handle count must agree with the session's
                 # numerics_nonfinite_total event counter
                 s.nonfinite += 1
+            # a fresh factor zeroes the update-error accumulation — the
+            # counted refactor is exactly what resets the GGMS budget
+            s.updates = 0
+            s.update_weight = 0.0
             return self._reclassify(handle, s)
+
+    def record_update(self, handle: Hashable, k: int, weight: float
+                      ) -> Tuple[str, str]:
+        """One applied rank-k incremental update (round 20): accrue
+        its accumulation charge (:func:`update_weight`) toward the
+        handle's budget. Whether the accrued total now demands a
+        refactor is read via :meth:`update_due` — the Session's update
+        verb consults it AFTER recording, so the update that crosses
+        the budget is still served and the refactor runs off the
+        answer path."""
+        with self._lock:
+            s = self._stats(handle)
+            s.updates += 1
+            s.update_weight += float(weight)
+            if not math.isfinite(s.update_weight):
+                s.nonfinite += 1
+            return self._reclassify(handle, s)
+
+    def update_due(self, handle: Hashable) -> bool:
+        """Has ``handle`` accumulated enough update weight to owe a
+        refactor? (:func:`update_refactor_due` against the config's
+        budget — the one predicate.)"""
+        with self._lock:
+            s = self._handles.get(repr(handle))
+            if s is None:
+                return False
+            return update_refactor_due(s.updates, s.update_weight,
+                                       self.config.update_budget)
 
     def record_condest(self, handle: Hashable, cond: float
                        ) -> Tuple[str, str]:
@@ -542,6 +609,8 @@ class NumericsMonitor:
                     "resid_count": s.resid_count,
                     "refine_ewma": s.refine_ewma,
                     "refine_count": s.refine_count,
+                    "updates": s.updates,
+                    "update_weight": s.update_weight,
                     "state": s.state,
                 }
                 for h, s in self._handles.items()
@@ -564,6 +633,7 @@ class NumericsMonitor:
                       "condest", "growth", "nonfinite", "resid_ewma",
                       "resid_last", "resid_max", "resid_count",
                       "refine_ewma", "refine_floor", "refine_count",
+                      "updates", "update_weight",
                       "state")
 
     def export_state(self, handle: Hashable) -> Optional[dict]:

@@ -64,6 +64,13 @@ from ..refine.policy import RefinePolicy
 from ..spectral.types import EigFactors, SVDFactors
 
 CHECKPOINT_SCHEMA = "slate_tpu.checkpoint.v1"
+# round 20: delta checkpoints — same record structure, but any blob
+# whose sha256 already exists in a BASE checkpoint is referenced
+# (``"base": true`` on its descriptor) instead of rewritten, so
+# replicating an incrementally-updated resident ships only the blobs
+# the update actually changed (an appended-QR update leaves the base
+# factor blobs byte-identical; a chol update rewrites only L)
+DELTA_SCHEMA = "slate_tpu.checkpoint.delta.v1"
 # every key a checkpoint record carries. Mirrored (deliberately, the
 # bench_gate/placement duplication pattern: tools/bench_gate.py stays
 # importable without package context) as
@@ -119,20 +126,61 @@ class _BlobWriter:
         }
 
 
+class _DeltaBlobWriter(_BlobWriter):
+    """Blob-level dedup against a BASE checkpoint (round 20): a leaf
+    whose raw bytes hash to a sha256 the base already holds is
+    referenced (``"base": true``, the base's blob id) instead of
+    rewritten — the per-blob checksums the v1 format already carries
+    ARE the diff index, so the delta needs no new hashing scheme."""
+
+    def __init__(self, blob_dir: str, base_index: dict):
+        super().__init__(blob_dir)
+        self.base_index = base_index  # sha256 -> base blob descriptor
+        self.reused = 0
+        self.written_bytes = 0
+        self.total_bytes = 0
+
+    def add(self, arr) -> dict:
+        a = np.ascontiguousarray(np.asarray(arr))
+        raw = a.tobytes()
+        self.total_bytes += len(raw)
+        sha = hashlib.sha256(raw).hexdigest()
+        base = self.base_index.get(sha)
+        if base is not None and int(base["nbytes"]) == len(raw):
+            self.reused += 1
+            return {"blob": base["blob"],
+                    "shape": [int(d) for d in a.shape],
+                    "dtype": str(a.dtype.name), "nbytes": len(raw),
+                    "sha256": sha, "base": True}
+        bid = f"b{self.count:05d}.bin"
+        self.count += 1
+        self.written_bytes += len(raw)
+        with open(os.path.join(self.blob_dir, bid), "wb") as f:
+            f.write(raw)
+        return {"blob": bid, "shape": [int(d) for d in a.shape],
+                "dtype": str(a.dtype.name), "nbytes": len(raw),
+                "sha256": sha}
+
+
 class _BlobReader:
     """Reads blob files back, verifying length + sha256 per blob.
+    ``base_dir``: where ``"base": true`` descriptors resolve (delta
+    checkpoints — round 20); None for a full checkpoint.
 
     ``corrupt_next``: the deterministic ``restore_corrupt`` fault hook —
     the NEXT read's bytes are flipped before verification, so the
     checksum must catch the injected corruption exactly like a real
     torn write would be caught."""
 
-    def __init__(self, blob_dir: str):
+    def __init__(self, blob_dir: str, base_dir: Optional[str] = None):
         self.blob_dir = blob_dir
+        self.base_dir = base_dir
         self.corrupt_next = False
 
     def read(self, desc: dict) -> np.ndarray:
-        path = os.path.join(self.blob_dir, str(desc["blob"]))
+        d = (self.base_dir if desc.get("base") and self.base_dir
+             else self.blob_dir)
+        path = os.path.join(d, str(desc["blob"]))
         try:
             with open(path, "rb") as f:
                 raw = f.read()
@@ -253,16 +301,26 @@ def _reshard_node(node, grid: ProcessGrid):
 # -- manifest validation ------------------------------------------------------
 
 
-def validate_manifest(doc) -> List[str]:
+def validate_manifest(doc, schema: str = CHECKPOINT_SCHEMA
+                      ) -> List[str]:
     """Schema errors for a checkpoint manifest (empty list = valid).
+    ``schema`` selects the expected flavor: the full v1 format
+    (default) or the round-20 delta format (same records, plus the
+    ``base_blobs`` generation pointer its reused blob ids resolve in).
     The producer self-checks its own output (the placement-snapshot
     discipline); ``tools/bench_gate.py`` mirrors this jax-free so CI
     can validate a manifest without the runtime (mirror-pinned)."""
     errs: List[str] = []
     if not isinstance(doc, dict):
         return ["checkpoint manifest is not an object"]
-    if doc.get("schema") != CHECKPOINT_SCHEMA:
-        errs.append(f"schema != {CHECKPOINT_SCHEMA!r}")
+    if schema not in (CHECKPOINT_SCHEMA, DELTA_SCHEMA):
+        return [f"unknown checkpoint schema {schema!r}"]
+    if doc.get("schema") != schema:
+        errs.append(f"schema != {schema!r}")
+    if schema == DELTA_SCHEMA and (
+            not isinstance(doc.get("base_blobs"), str)
+            or not doc.get("base_blobs")):
+        errs.append("base_blobs missing/not a string")
     if not isinstance(doc.get("host"), str) or not doc.get("host"):
         errs.append("host missing/not a string")
     ga = doc.get("generated_at")
@@ -345,26 +403,14 @@ def _validate_node(desc, where: str) -> List[str]:
 # -- save / restore -----------------------------------------------------------
 
 
-def save_session(session, path: str,
-                 only: Optional[List[Hashable]] = None,
-                 host: Optional[str] = None) -> dict:
-    """Write ``session``'s resident state to checkpoint directory
-    ``path`` (created; an existing checkpoint there is overwritten).
-    One record per RESIDENT factor — registered-but-uncached operators
-    carry no expensive state and are deliberately not checkpointed
-    (the fleet retains their registration specs; refactor-on-miss is
-    their recovery path). ``only`` filters to a handle subset (the
-    fleet's replication transfer). Returns the manifest."""
-    if host is None:
-        import socket as _socket
-        host = f"{_socket.gethostname()}:{os.getpid()}"
-    # crash-safety: blobs go into a FRESH generation directory, and the
-    # manifest (replaced atomically, last) is what points at it — a
-    # death mid-save leaves the previous manifest still naming the
-    # previous generation's intact blobs, so the crash a checkpoint
-    # exists to survive can never corrupt the only durable copy.
-    # Superseded generations are pruned only after the new manifest
-    # lands.
+def _new_generation(path: str) -> Tuple[List[str], str, str]:
+    """Crash-safety primitive shared by full and delta saves: blobs go
+    into a FRESH generation directory, and the manifest (replaced
+    atomically, last) is what points at it — a death mid-save leaves
+    the previous manifest still naming the previous generation's
+    intact blobs, so the crash a checkpoint exists to survive can
+    never corrupt the only durable copy. Returns (prior generation
+    dirs, new blobs dir name, new blobs dir path)."""
     os.makedirs(path, exist_ok=True)
     prior = [d for d in os.listdir(path)
              if d == BLOBS_DIR or d.startswith(BLOBS_DIR + "-")]
@@ -377,23 +423,90 @@ def save_session(session, path: str,
     blobs_name = f"{BLOBS_DIR}-{gen:05d}"
     blob_dir = os.path.join(path, blobs_name)
     os.makedirs(blob_dir, exist_ok=True)
-    writer = _BlobWriter(blob_dir)
+    return prior, blobs_name, blob_dir
+
+
+def _snapshot_residents(session, only: Optional[List[Hashable]]):
+    """Snapshot the resident references under the lock, then gather/
+    hash/write OUTSIDE it — a checkpoint of hundreds of MB must not
+    stop-the-world the serving threads for its disk I/O. Entries and
+    payload trees are immutable once cached; a concurrent evict just
+    means the checkpoint keeps a resident the cache no longer does
+    (a snapshot, not a transaction)."""
     keep = None if only is None else set(only)
+    with session._lock:
+        return [(h, session._ops[h], res)
+                for h, res in session._cache.items()
+                if (keep is None or h in keep)
+                and session._ops.get(h) is not None]
+
+
+def _publish_manifest(session, path: str, manifest: dict,
+                      prior: List[str], blobs_name: str, skipped: int):
+    """Self-check, atomic manifest replace, prune superseded
+    generations — the shared tail of full and delta saves."""
+    errs = validate_manifest(manifest,
+                             schema=str(manifest.get("schema")))
+    if errs:
+        raise SlateError(f"checkpoint: manifest self-check failed "
+                         f"({errs[:3]})")
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    for d in prior:  # superseded generations, pruned post-publish
+        if d != blobs_name:
+            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    session.metrics.inc("checkpoints_written_total")
+    session.metrics.inc("checkpoint_records_total",
+                        len(manifest["records"]))
+    if skipped:
+        session.metrics.inc("checkpoint_skipped_handles", skipped)
+
+
+def _default_host(host: Optional[str]) -> str:
+    if host is None:
+        import socket as _socket
+        host = f"{_socket.gethostname()}:{os.getpid()}"
+    return host
+
+
+def save_session(session, path: str,
+                 only: Optional[List[Hashable]] = None,
+                 host: Optional[str] = None) -> dict:
+    """Write ``session``'s resident state to checkpoint directory
+    ``path`` (created; an existing checkpoint there is overwritten).
+    One record per RESIDENT factor — registered-but-uncached operators
+    carry no expensive state and are deliberately not checkpointed
+    (the fleet retains their registration specs; refactor-on-miss is
+    their recovery path). ``only`` filters to a handle subset (the
+    fleet's replication transfer). Returns the manifest."""
+    host = _default_host(host)
+    prior, blobs_name, blob_dir = _new_generation(path)
+    writer = _BlobWriter(blob_dir)
+    items = _snapshot_residents(session, only)
+    records, skipped = _gather_records(session, writer, items)
+    manifest = {
+        "schema": CHECKPOINT_SCHEMA,
+        "host": host,
+        "generated_at": time.time(),
+        "blobs": blobs_name,
+        "records": records,
+    }
+    _publish_manifest(session, path, manifest, prior, blobs_name,
+                      skipped)
+    return manifest
+
+
+def _gather_records(session, writer: _BlobWriter, items
+                    ) -> Tuple[list, int]:
+    """One manifest record per snapshotted resident (shared by the
+    full and delta writers — the writer decides what hits disk)."""
+    attr = session.attribution
+    nm = session.numerics
     records = []
     skipped = 0
-    # snapshot the resident references under the lock, then gather/
-    # hash/write OUTSIDE it — a checkpoint of hundreds of MB must not
-    # stop-the-world the serving threads for its disk I/O. Entries and
-    # payload trees are immutable once cached; a concurrent evict just
-    # means the checkpoint keeps a resident the cache no longer does
-    # (a snapshot, not a transaction).
-    with session._lock:
-        attr = session.attribution
-        nm = session.numerics
-        items = [(h, session._ops[h], res)
-                 for h, res in session._cache.items()
-                 if (keep is None or h in keep)
-                 and session._ops.get(h) is not None]
     for h, entry, res in items:
         if not isinstance(h, (str, int)) or isinstance(h, bool):
             # restorable handles must round-trip through JSON; an
@@ -439,38 +552,112 @@ def save_session(session, path: str,
             "operator": oper,
             "payload": payload,
         })
+    return records, skipped
+
+
+# -- delta checkpoints (round 20: replicate updates, not factors) ------------
+
+
+def _iter_blob_descs(desc):
+    """Every blob descriptor reachable from a node descriptor (the
+    index the delta writer dedups against)."""
+    if not isinstance(desc, dict):
+        return
+    t = desc.get("type")
+    if t == "tuple":
+        for d in desc.get("items", []):
+            yield from _iter_blob_descs(d)
+    elif t == "eig_factors":
+        yield from _iter_blob_descs(desc.get("v"))
+        yield desc.get("lam")
+    elif t == "svd_factors":
+        yield from _iter_blob_descs(desc.get("u"))
+        yield desc.get("s")
+        yield from _iter_blob_descs(desc.get("v"))
+    elif t == "array":
+        yield desc.get("a")
+    elif t == "tiled":
+        yield desc.get("data")
+    elif t == "packed_band":
+        yield desc.get("ab")
+    elif t == "qr_factors":
+        yield desc.get("vr")
+        yield desc.get("t")
+
+
+def _base_blob_index(base_manifest: dict) -> dict:
+    """sha256 -> blob descriptor over every blob a base checkpoint
+    holds. Only non-delta descriptors index (a blob the base itself
+    borrowed lives elsewhere and cannot be referenced)."""
+    index = {}
+    for rec in base_manifest.get("records", []):
+        for key in ("operator", "payload"):
+            for b in _iter_blob_descs(rec.get(key)):
+                if isinstance(b, dict) and not b.get("base") \
+                        and "sha256" in b:
+                    index[str(b["sha256"])] = b
+    return index
+
+
+def save_session_delta(session, path: str, base_manifest: dict,
+                       only: Optional[List[Hashable]] = None,
+                       host: Optional[str] = None
+                       ) -> Tuple[dict, dict]:
+    """Delta checkpoint of ``session`` against ``base_manifest`` (a
+    previously written FULL checkpoint's manifest): same record
+    structure, but blobs whose sha256 the base already holds are
+    referenced instead of rewritten — so replicating an incrementally
+    updated resident ships only what the update changed (for an
+    appended-QR resident that is the append block, never the base
+    factor). Returns ``(manifest, stats)`` with stats =
+    ``{"sync_bytes", "full_bytes", "reused_blobs", "written_blobs"}``
+    (sync_bytes counts the manifest too — it IS part of the wire
+    transfer). The restore side needs BOTH directories:
+    :func:`restore_session_delta`."""
+    if str(base_manifest.get("schema")) != CHECKPOINT_SCHEMA:
+        raise SlateError("checkpoint: delta base must be a full "
+                         f"{CHECKPOINT_SCHEMA!r} checkpoint")
+    host = _default_host(host)
+    prior, blobs_name, blob_dir = _new_generation(path)
+    writer = _DeltaBlobWriter(blob_dir, _base_blob_index(base_manifest))
+    items = _snapshot_residents(session, only)
+    records, skipped = _gather_records(session, writer, items)
     manifest = {
-        "schema": CHECKPOINT_SCHEMA,
+        "schema": DELTA_SCHEMA,
         "host": host,
         "generated_at": time.time(),
         "blobs": blobs_name,
+        # the base GENERATION the reused blob ids resolve in — the
+        # retainer must keep that base directory unchanged (the
+        # fleet keeps one per replica edge)
+        "base_blobs": str(base_manifest.get("blobs", BLOBS_DIR)),
+        "base_host": str(base_manifest.get("host", "")),
         "records": records,
     }
-    errs = validate_manifest(manifest)
-    if errs:
-        raise SlateError(f"checkpoint: manifest self-check failed "
-                         f"({errs[:3]})")
-    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=2, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
-    for d in prior:  # superseded generations, pruned post-publish
-        if d != blobs_name:
-            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
-    session.metrics.inc("checkpoints_written_total")
-    session.metrics.inc("checkpoint_records_total", len(records))
-    if skipped:
-        session.metrics.inc("checkpoint_skipped_handles", skipped)
-    return manifest
+    _publish_manifest(session, path, manifest, prior, blobs_name,
+                      skipped)
+    manifest_bytes = os.path.getsize(os.path.join(path, MANIFEST_NAME))
+    stats = {
+        "sync_bytes": int(writer.written_bytes) + int(manifest_bytes),
+        "full_bytes": int(writer.total_bytes) + int(manifest_bytes),
+        "reused_blobs": int(writer.reused),
+        "written_blobs": int(writer.count),
+    }
+    session.metrics.inc("delta_checkpoints_written_total")
+    session.metrics.inc("delta_sync_bytes", stats["sync_bytes"])
+    session.metrics.inc("delta_full_bytes", stats["full_bytes"])
+    return manifest, stats
 
 
 def _is_bf16(dtype) -> bool:
     return str(dtype) == "bfloat16"
 
 
-def load_manifest(path: str) -> dict:
-    """Read + schema-validate a checkpoint directory's manifest."""
+def load_manifest(path: str,
+                  schema: str = CHECKPOINT_SCHEMA) -> dict:
+    """Read + schema-validate a checkpoint directory's manifest
+    (``schema``: the expected flavor — full by default, DELTA_SCHEMA
+    for a delta directory)."""
     mpath = os.path.join(path, MANIFEST_NAME)
     try:
         with open(mpath) as f:
@@ -478,7 +665,7 @@ def load_manifest(path: str) -> dict:
     except (OSError, json.JSONDecodeError) as e:
         raise SlateError(f"checkpoint: manifest unreadable at "
                          f"{mpath!r} ({e})")
-    errs = validate_manifest(manifest)
+    errs = validate_manifest(manifest, schema=schema)
     if errs:
         raise SlateError(f"checkpoint: invalid manifest at {mpath!r} "
                          f"({errs[:3]})")
@@ -511,10 +698,38 @@ def restore_session(session, path: str,
     ``manifest``: an already-loaded (validated) manifest for ``path``
     — the fleet's failover loads it ONCE and threads it through its
     per-handle restores instead of re-parsing per handle."""
-    from .session import SMALL_OPS, _Resident, _tree_nbytes
     if manifest is None:
         manifest = load_manifest(path)
     blob_dir = os.path.join(path, str(manifest.get("blobs", BLOBS_DIR)))
+    return _restore_records(session, manifest, blob_dir, None, only)
+
+
+def restore_session_delta(session, path: str, base_path: str,
+                          only: Optional[List[Hashable]] = None,
+                          manifest: Optional[dict] = None) -> dict:
+    """Restore a DELTA checkpoint (round 20): records read exactly
+    like :func:`restore_session`, but blob descriptors marked
+    ``"base": true`` resolve in ``base_path``'s recorded blob
+    generation — the receiver already holds those bytes from the full
+    checkpoint it retained, so the wire transfer was the delta
+    directory alone. Every blob (reused or shipped) still verifies
+    length + sha256; the degradation rules are unchanged."""
+    if manifest is None:
+        manifest = load_manifest(path, schema=DELTA_SCHEMA)
+    blob_dir = os.path.join(path, str(manifest.get("blobs", BLOBS_DIR)))
+    base_dir = os.path.join(base_path,
+                            str(manifest.get("base_blobs", BLOBS_DIR)))
+    session.metrics.inc("delta_restores_total")
+    return _restore_records(session, manifest, blob_dir, base_dir,
+                            only)
+
+
+def _restore_records(session, manifest: dict, blob_dir: str,
+                     base_dir: Optional[str],
+                     only: Optional[List[Hashable]]) -> dict:
+    """The shared restore loop (full and delta checkpoints differ only
+    in where a blob descriptor's bytes live)."""
+    from .session import SMALL_OPS, _Resident, _tree_nbytes
     keep = None if only is None else set(only)
     summary = {"registered": [], "restored": [], "corrupt": [],
                "conflicts": [], "skipped": []}
@@ -536,7 +751,7 @@ def restore_session(session, path: str,
             fired = session._fault("restore")
             corrupt_injected = any(s.kind == "restore_corrupt"
                                    for s in fired)
-        reader = _BlobReader(blob_dir)
+        reader = _BlobReader(blob_dir, base_dir)
         small = rec["op"] in SMALL_OPS  # host-side operators
         try:
             A = _decode_node(rec["operator"], reader, device=not small)
@@ -598,6 +813,9 @@ def restore_session(session, path: str,
                             _tree_nbytes(payload, per_chip=True),
                             _tree_nbytes(payload))
             session._cache[h] = res
+            # an appended-QR resident's row count grew past its
+            # registered operand's; the record carries the truth
+            entry.m = int(rec["m"])
             session.metrics.inc("restored_residents_total")
             attr = session.attribution
             if attr is not None:

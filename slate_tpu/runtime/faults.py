@@ -77,6 +77,12 @@ KINDS = (
                            # the source keeps serving untouched and the
                            # coordinator retries, counted — never a
                            # half-resident on the target
+    # -- round 20: incremental factor maintenance --
+    "update_abort",        # a rank-k update dies mid-apply -> the
+                           # resident stays bit-untouched and the verb
+                           # degrades to a counted refactor of the
+                           # already-committed operand — never a
+                           # half-updated factor
 )
 
 # seam name -> fault kinds evaluated there. The Session/chaos runner
@@ -100,6 +106,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # per migration transfer attempt (HBM-pressure migration — a fired
     # migration_abort kills that attempt mid-flight)
     "fleet.migrate": ("migration_abort",),
+    # round 20: Session.update consults "update" once per update verb,
+    # BEFORE the resident is touched (abort-before-commit semantics)
+    "update": ("update_abort",),
 }
 
 # The declared degradation ladder (tentpole): when a serving path keeps

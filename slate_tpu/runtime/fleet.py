@@ -161,6 +161,12 @@ class Fleet:
         self._by_repr: Dict[str, Hashable] = {}
         self._inflight: Dict[str, List[_FleetRequest]] = defaultdict(list)
         self._seq = 0
+        # (handle, replica member) -> (retained base checkpoint dir,
+        # its manifest): what round-20 delta syncs diff against. The
+        # retained dir is refreshed after every successful sync so the
+        # next delta ships only the NEWEST update's changed blobs.
+        self._replica_base: Dict[tuple, tuple] = {}
+        self._xfer_root: Optional[str] = None
         self.metrics.set_gauge("fleet_alive_members",
                                len(self._members))
 
@@ -262,12 +268,32 @@ class Fleet:
 
     # -- replication (heat-driven) ------------------------------------------
 
+    def _replica_dir(self, handle: Hashable, target: str) -> str:
+        """The RETAINED per-(handle, replica) base checkpoint
+        directory (round 20): created under ``checkpoint_root`` when
+        the coordinator has one, else under a coordinator-owned temp
+        root (:meth:`close` removes it). The handle component is its
+        ring hash — filesystem-safe for any str/int handle."""
+        if self.checkpoint_root is not None:
+            base = os.path.join(self.checkpoint_root, "_replica_bases")
+        else:
+            with self._lock:
+                if self._xfer_root is None:
+                    self._xfer_root = tempfile.mkdtemp(
+                        prefix="slate_fleet_bases_")
+                base = self._xfer_root
+        return os.path.join(base, target,
+                            f"h{_hval(repr(handle)):016x}")
+
     def replicate(self, handle: Hashable) -> Optional[str]:
         """Replicate one handle onto its next ring member via a
         checkpoint transfer (byte-identical resident, heat/health
         included); falls back to register+warm when the primary holds
-        no resident yet. Returns the replica member name (None when
-        every alive member already serves the handle)."""
+        no resident yet. The transferred checkpoint is RETAINED as the
+        replica edge's delta base (round 20): a later :meth:`update`
+        ships only the blobs the update changed. Returns the replica
+        member name (None when every alive member already serves the
+        handle)."""
         with self._lock:
             places = self._placement.get(handle)
             spec = self._specs.get(handle)
@@ -279,13 +305,13 @@ class Fleet:
             if target is None:
                 return None
         if handle in primary.session.cached_handles():
-            xfer = tempfile.mkdtemp(prefix="slate_xfer_")
-            try:
-                primary.session.checkpoint(xfer, only=[handle],
-                                           host=primary.name)
-                target.session.restore(xfer, only=[handle])
-            finally:
-                shutil.rmtree(xfer, ignore_errors=True)
+            bdir = self._replica_dir(handle, target.name)
+            manifest = primary.session.checkpoint(bdir, only=[handle],
+                                                  host=primary.name)
+            target.session.restore(bdir, only=[handle])
+            with self._lock:
+                self._replica_base[(handle, target.name)] = (bdir,
+                                                             manifest)
         else:
             target.session.register(spec.A, op=spec.op, handle=handle,
                                     **spec.kwargs)
@@ -314,6 +340,127 @@ class Fleet:
             if len(made) >= top_k:
                 break
         return made
+
+    # -- incremental-update replication (round 20) --------------------------
+
+    def update(self, handle: Hashable, delta=None, **kwargs) -> dict:
+        """Apply an incremental factor update (Session.update: chol
+        rank-k up/downdate, qr row append/delete) on the handle's
+        PRIMARY, then propagate the mutated resident to every replica
+        as a DELTA checkpoint — blob-level sha256 diff against the
+        retained base each replica edge keeps, so the sync ships only
+        what the update changed (for an appended-QR resident that is
+        the append block, never the base factor;
+        ``fleet_delta_sync_bytes`` vs ``fleet_full_sync_bytes`` is the
+        wire saving, bench-artifact pinned). A replica edge with no
+        usable base (never full-transferred, or injected-stale via the
+        seeded ``replica_stale`` fault at site ``fleet.replica``)
+        falls back to a counted full re-transfer that BECOMES the new
+        retained base. Returns the primary's update result dict."""
+        with self._lock:
+            places = list(self._placement.get(handle, ()))
+        if not places:
+            raise SlateError(
+                f"Fleet.update: unknown handle {handle!r}")
+        primary = self._members[places[0]]
+        if not primary.alive:
+            raise SlateError(
+                f"Fleet.update: primary of {handle!r} is dead; run "
+                "failover (kill) before mutating")
+        out = primary.session.update(handle, delta, **kwargs)
+        if out.get("deferred"):
+            return out  # no resident mutated -> nothing to propagate
+        for name in places[1:]:
+            mem = self._members[name]
+            if mem.alive and handle in mem.session:
+                self._sync_replica(handle, primary, mem)
+        return out
+
+    def _sync_replica(self, handle: Hashable, primary: _Member,
+                      target: _Member):
+        """One replica edge's post-update sync: delta checkpoint
+        against the retained base when one exists (the target's queued
+        requests drain against its still-resident factor first — zero
+        lost futures — then the stale resident is swapped for the
+        restored one), full re-transfer otherwise. Either way the
+        retained base is refreshed to the post-update state so the
+        NEXT update's delta is minimal."""
+        from .checkpoint import (_iter_blob_descs as _iter_manifest_blobs,
+                                 restore_session_delta,
+                                 save_session_delta)
+        key = (handle, target.name)
+        with self._lock:
+            base = self._replica_base.get(key)
+        if base is not None and self.faults is not None and any(
+                s.kind == "replica_stale"
+                for s in self.faults.fire("fleet.replica")):
+            # injected-stale retained base: never diff against bits
+            # the replica might not actually hold — counted, and the
+            # full re-transfer below re-establishes a trusted base
+            self.metrics.inc("fleet_delta_base_stale_total")
+            base = None
+        synced = False
+        if base is not None:
+            bdir, base_manifest = base
+            ddir = tempfile.mkdtemp(prefix="slate_delta_")
+            try:
+                _, stats = save_session_delta(
+                    primary.session, ddir, base_manifest,
+                    only=[handle], host=primary.name)
+                # restore skips registered handles (live-operator-wins
+                # conflict rule), so the replica's stale copy must
+                # leave first — AFTER its queued work drains against
+                # the still-resident factor (zero lost futures)
+                self._drain_member(target)
+                target.session.unregister(handle)
+                summary = restore_session_delta(target.session, ddir,
+                                                bdir, only=[handle])
+                if handle in summary["restored"]:
+                    synced = True
+                    self.metrics.inc("fleet_delta_replications_total")
+                    self.metrics.inc("fleet_delta_sync_bytes",
+                                     stats["sync_bytes"])
+                    self.metrics.inc("fleet_full_sync_bytes",
+                                     stats["full_bytes"])
+            finally:
+                shutil.rmtree(ddir, ignore_errors=True)
+        if not synced:
+            # the recovery floor: full checkpoint transfer, which is
+            # ALSO the new retained base for this edge
+            bdir = self._replica_dir(handle, target.name)
+            manifest = primary.session.checkpoint(
+                bdir, only=[handle], host=primary.name)
+            self._drain_member(target)
+            target.session.unregister(handle)
+            target.session.restore(bdir, only=[handle])
+            self.metrics.inc("fleet_full_replications_total")
+            self.metrics.inc(
+                "fleet_full_sync_bytes",
+                sum(int(b.get("nbytes", 0))
+                    for rec in manifest.get("records", [])
+                    for k_ in ("operator", "payload")
+                    for b in _iter_manifest_blobs(rec.get(k_))))
+            with self._lock:
+                self._replica_base[key] = (bdir, manifest)
+            return
+        # refresh the retained base in place: the next delta diffs
+        # against the state BOTH sides now hold (blob content is what
+        # resolves — the manifest records the new generation)
+        bdir, _ = base
+        manifest = primary.session.checkpoint(bdir, only=[handle],
+                                              host=primary.name)
+        with self._lock:
+            self._replica_base[key] = (bdir, manifest)
+
+    def close(self):
+        """Remove the coordinator-owned retained-base temp root (a
+        ``checkpoint_root`` fleet keeps its bases — they are part of
+        the durable checkpoint tree)."""
+        with self._lock:
+            root, self._xfer_root = self._xfer_root, None
+            self._replica_base.clear()
+        if root is not None:
+            shutil.rmtree(root, ignore_errors=True)
 
     # -- migration-on-eviction (round 18: HBM-pressure rebalancing) ---------
 
@@ -650,6 +797,12 @@ class Fleet:
             for h in affected:
                 self._placement[h] = [p for p in self._placement[h]
                                       if p != name]
+            # retained delta bases whose replica died are garbage
+            # (content-addressing makes a stale base SAFE, but a dead
+            # edge's base is never diffed again — drop the references)
+            for key in [k for k in self._replica_base
+                        if k[1] == name]:
+                del self._replica_base[key]
         _obs_log.warning(
             "fleet: member %r declared dead (%d orphaned requests, "
             "%d affected handles); running failover", name,

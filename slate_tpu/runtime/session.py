@@ -118,6 +118,11 @@ CONDEST_OPS = ("lu", "chol", "lu_small", "chol_small")
 # signal only where x solves A·x = b (a least-squares minimizer's
 # residual is data, not error)
 PROBE_OPS = ("lu", "chol")
+# operators the round-20 incremental-maintenance verb covers: rank-k
+# Cholesky up/downdates (dense + small-engine residents) and QR row
+# append/delete (linalg/update.py). Everything else answers a mutation
+# with the refactor it always did.
+UPDATE_OPS = ("chol", "chol_small", "qr")
 
 
 def _work_dtype_name(entry) -> str:
@@ -202,6 +207,13 @@ class _Operator:
     # None = the DEFAULT_TENANT — every existing caller lands there,
     # so single-tenant deployments get the ledger without changes
     tenant: Optional[str] = None
+    # incremental-maintenance accrual (round 20): applied-update count
+    # and growth-weighted error mass since the last fresh factor — the
+    # monitor-less fallback for the refactor-due predicate (a numerics
+    # monitor, when attached, keeps the authoritative copy per handle).
+    # Reset by every fresh factor insert.
+    updates: int = 0
+    update_weight: float = 0.0
 
 
 @dataclasses.dataclass
@@ -1159,6 +1171,11 @@ class Session:
                 # same grid-snapped value as the counters above
                 attr.record("factor_flops", entry.tenant, handle, fl)
             self._cache[handle] = res
+            # a fresh factor zeroes the incremental-update error
+            # accrual (round 20; the numerics monitor resets its own
+            # copy in record_factor — this is the monitor-less one)
+            entry.updates = 0
+            entry.update_weight = 0.0
             if attr is not None:
                 # open the residency interval: byte-seconds accrue
                 # from this insert until eviction/unregister. A
@@ -2936,6 +2953,549 @@ class Session:
         return self._dispatch(entry, res2, B, handle,
                               served_cols=served_cols, tenant=tenant)
 
+    # -- incremental factor maintenance (round 20, linalg/update.py) -------
+
+    def update(self, handle: Hashable, delta=None, *,
+               downdate: bool = False, delete=None,
+               tenant: Optional[str] = None) -> dict:
+        """Serve an operand mutation against the RESIDENT factor at
+        O(n²k) instead of paying the O(n³) refactor (round 20,
+        linalg/update.py — GGMS C1/C2/Q4, Davis–Hager sweep):
+
+        * ``chol``/``chol_small``: ``delta`` is the (n, k) vector block
+          W of A' = A + W·Wᴴ (``downdate=True`` for A − W·Wᴴ; the
+          positivity guard degrades a failed downdate to a counted
+          refactor of the committed operand — never a wrong factor);
+        * ``qr``: ``delta`` is (p, n) rows to APPEND, or ``delete=``
+          row indices to remove (incremental for previously appended
+          rows; deleting a base row degrades to a counted refactor).
+
+        The mutated operand is committed either way — on every
+        degraded path the refactor answers from A', so the caller's
+        view of the operator is always the post-mutation one. Ranks
+        and appended-row counts are padded to pow2 buckets (zero
+        lanes are exactly inert), so a stream of k = 1..16 updates
+        compiles O(log k) programs through the same ``_aot_compile``
+        census seam as every serving program.
+
+        Returns a result dict: ``applied`` (the incremental path
+        served it), ``refactored`` (a counted refactor ran — abort
+        fault, failed downdate, base-row delete, or the numerics
+        update budget coming due), ``deferred`` (no resident to
+        maintain: the mutation committed, the next factor() is a
+        plain miss), plus ``info``/``k``/``k_bucket``."""
+        with self._lock:
+            entry = self._ops.get(handle)
+            if entry is None:
+                raise SlateError(f"Session: unknown handle {handle!r}")
+            if entry.op not in UPDATE_OPS:
+                raise SlateError(
+                    f"Session.update: operator kind {entry.op!r} has "
+                    f"no incremental form (supported: {UPDATE_OPS}); "
+                    "re-register the mutated operand instead")
+            if entry.grid is not None:
+                raise SlateError(
+                    "Session.update: mesh residents refactor, they do "
+                    "not update (the rotation sweep is sequential in "
+                    "columns — no profitable sharding)")
+            if entry.op == "qr":
+                return self._update_qr(entry, handle, delta, delete,
+                                       tenant)
+            if delete is not None:
+                raise SlateError("Session.update: delete= applies to "
+                                 "qr operators only")
+            return self._update_chol(entry, handle, delta, downdate,
+                                     tenant)
+
+    def _request_tenant_or_none(self, handle: Hashable,
+                                tenant: Optional[str]) -> Optional[str]:
+        """Caller holds the lock: resolved tenant when attribution
+        needs one (the request_tenant rule), else the raw override."""
+        if self.attribution is not None:
+            return self.request_tenant(handle, tenant)
+        return tenant
+
+    def _update_chol(self, entry: _Operator, handle: Hashable, delta,
+                     downdate: bool, tenant: Optional[str]) -> dict:
+        """Caller holds the lock. Rank-k A' = A ± W·Wᴴ against the
+        resident potrf factor: the dense path runs the AOT-compiled
+        rotation sweep; the small-engine path runs the B=1 slice of
+        the SAME batched sweep the grouped verb uses (bit-identical
+        by construction, the round-10 rule)."""
+        import jax.numpy as jnp
+        from ..linalg import update as _upd
+        if delta is None:
+            raise SlateError("Session.update: chol update needs delta "
+                             "(the (n, k) update-vector block W)")
+        small = entry.op == "chol_small"
+        wd = np.dtype(entry.A.dtype)
+        w = np.asarray(delta)
+        if w.ndim == 1:
+            w = w[:, None]
+        if w.ndim != 2 or w.shape[0] != entry.n:
+            raise SlateError(
+                f"Session.update: delta must be ({entry.n}, k) update "
+                f"vectors, got shape {tuple(w.shape)}")
+        w = np.ascontiguousarray(w, dtype=wd)
+        k = int(w.shape[1])
+        sign = -1 if downdate else 1
+        # stage the mutated operand host-side FIRST: whatever happens
+        # on the device path (abort fault, failed positivity guard),
+        # A' is the committed truth every degraded path answers from
+        if small:
+            a_cur = np.asarray(entry.A)
+            A2 = np.ascontiguousarray(
+                a_cur + sign * (w @ w.conj().T), dtype=wd)
+            anorm1 = float(np.linalg.norm(a_cur, 1))
+        else:
+            a_cur = np.asarray(
+                entry.A.full_dense())[: entry.n, : entry.n]
+            anorm1 = float(np.linalg.norm(a_cur, 1))
+            A2 = from_dense(a_cur + sign * (w @ w.conj().T),
+                            entry.A.nb, kind=entry.A.kind,
+                            uplo=entry.A.uplo)
+        self.metrics.inc("updates_total")
+        rt = self._request_tenant_or_none(handle, tenant)
+        # the fault seam fires BEFORE any resident byte is touched: an
+        # injected update_abort models a mid-update failure — the
+        # resident is bit-untouched and the committed operand
+        # refactors (counted), the chaos exit gate
+        if self.faults is not None and self._fault("update"):
+            self.metrics.inc("update_aborts_total")
+            self._update_commit(entry, A2)
+            return self._update_refactor(entry, handle, "abort")
+        res = self._cache.get(handle)
+        if res is None:
+            # nothing resident to maintain: commit the mutation; the
+            # next factor() is a plain miss, not a counted refactor
+            self._update_commit(entry, A2)
+            self.metrics.inc("updates_deferred_total")
+            return {"applied": False, "refactored": False,
+                    "deferred": True, "info": 0, "op": entry.op,
+                    "k": k}
+        L = res.payload[0]
+        kb = _upd.bucket_k(k)
+        ldt = np.dtype(L.dtype)  # factor dtype (lo under refine)
+        npad = int(L.shape[-1]) if small else int(L.mt * L.nb)
+        wpad = np.zeros((npad, kb), dtype=ldt)
+        wpad[: entry.n, :k] = w.astype(ldt)
+        if small:
+            l2, infos = _upd.chol_update_batched(
+                L[None], jnp.asarray(wpad)[None], sign)
+            l2 = jax.block_until_ready(l2)
+            payload2 = (l2[0],)
+            info = int(np.asarray(infos)[0])
+        else:
+            wdev = jnp.asarray(wpad)
+            exe, key = self._update_exe(
+                entry, handle,
+                "chol_down" if downdate else "chol_up", (L, wdev))
+            out, info = exe(L, wdev)
+            out = jax.block_until_ready(out)
+            payload2 = (out,)
+            info = int(info)
+            self._credit_program(key, "serve.update", tenant=rt,
+                                 handle=handle)
+        if downdate and info > 0:
+            # the positivity guard fired: A − W·Wᴴ is not (numerically)
+            # positive definite along the sweep. The incremental result
+            # is discarded; the refactor of the committed operand is
+            # the authority — it either succeeds (the guard was
+            # rounding-conservative) or reports the indefiniteness
+            # itself: detected, never served
+            self.metrics.inc("update_downdate_failures_total")
+            self._update_commit(entry, A2)
+            return self._update_refactor(entry, handle,
+                                         "downdate_indefinite")
+        self._update_commit(entry, A2)
+        return self._update_finish(
+            entry, handle, payload2, rt, kb, k,
+            float(np.linalg.norm(w, 1)) ** 2, anorm1)
+
+    def _update_qr(self, entry: _Operator, handle: Hashable, rows,
+                   delete, tenant: Optional[str]) -> dict:
+        """Caller holds the lock. QR row maintenance (GGMS Q4): append
+        (``rows`` = the (p, n) new rows) or delete (``delete`` = row
+        indices). The resident base factors are never touched —
+        appends rebuild the (w, tau, r) append block from the full
+        appended stack against the resident R (O(n²·P), not O(mn²));
+        deleting a BASE row has no incremental form and degrades to a
+        counted refactor of the pruned operand."""
+        import jax.numpy as jnp
+        from ..linalg import update as _upd
+        if (rows is None) == (delete is None):
+            raise SlateError(
+                "Session.update(qr): exactly one of delta (rows to "
+                "append) or delete= (row indices) per call")
+        wd = np.dtype(entry.A.dtype)
+        a_cur = np.asarray(entry.A.to_dense())  # logical (m, n)
+        res = self._cache.get(handle)
+        base_m = res.payload[0].m if res is not None else None
+        idx = None
+        if rows is not None:
+            u = np.asarray(rows)
+            if u.ndim == 1:
+                u = u[None, :]
+            if u.ndim != 2 or u.shape[1] != entry.n:
+                raise SlateError(
+                    f"Session.update(qr): delta must be (p, {entry.n})"
+                    f" rows to append, got shape {tuple(u.shape)}")
+            u = np.ascontiguousarray(u, dtype=wd)
+            k_live = int(u.shape[0])
+            a_new = np.vstack([a_cur, u])
+            m_new = entry.m + k_live
+            wn1_sq = float(np.linalg.norm(u, 1)) ** 2
+            base_delete = False
+        else:
+            idx = np.unique(np.atleast_1d(
+                np.asarray(delete, dtype=np.int64)))
+            if idx.size == 0:
+                raise SlateError("Session.update(qr): delete= is empty")
+            if int(idx[0]) < 0 or int(idx[-1]) >= entry.m:
+                raise SlateError(
+                    f"Session.update(qr): delete= indices out of range "
+                    f"for {entry.m} rows")
+            k_live = int(idx.size)
+            a_new = np.delete(a_cur, idx, axis=0)
+            m_new = entry.m - k_live
+            if m_new < entry.n:
+                raise SlateError(
+                    "Session.update(qr): delete would leave an "
+                    f"underdetermined operator ({m_new} rows < "
+                    f"{entry.n} cols)")
+            wn1_sq = float(np.linalg.norm(a_cur[idx], 1)) ** 2
+            base_delete = res is None or bool((idx < base_m).any())
+        A2 = from_dense(a_new, entry.A.nb)
+        anorm1 = float(np.linalg.norm(a_cur, 1))
+        self.metrics.inc("updates_total")
+        rt = self._request_tenant_or_none(handle, tenant)
+        if self.faults is not None and self._fault("update"):
+            self.metrics.inc("update_aborts_total")
+            self._update_commit(entry, A2, m=m_new)
+            return self._update_refactor(entry, handle, "abort")
+        if res is None:
+            self._update_commit(entry, A2, m=m_new)
+            self.metrics.inc("updates_deferred_total")
+            return {"applied": False, "refactored": False,
+                    "deferred": True, "info": 0, "op": "qr",
+                    "k": k_live}
+        if base_delete:
+            # no incremental form for base-row removal: the pruned
+            # operand commits and a counted refactor answers
+            self._update_commit(entry, A2, m=m_new)
+            return self._update_refactor(entry, handle, "base_delete")
+        base = res.payload[0]
+        # rows already appended on top of the base factors, recovered
+        # from the resident payload itself (cols beyond n and rows
+        # beyond the live count are zero padding) — survives
+        # checkpoint/restore with no side table
+        prev = (np.asarray(res.payload[1])[: entry.m - base.m,
+                                           : entry.n]
+                if len(res.payload) > 1
+                else np.zeros((0, entry.n), dtype=wd))
+        if rows is not None:
+            u_all = np.vstack([prev.astype(wd, copy=False), u])
+        else:
+            u_all = np.delete(prev, idx - base.m, axis=0)
+        p_all = int(u_all.shape[0])
+        self._update_commit(entry, A2, m=m_new)
+        if p_all == 0:
+            # every appended row deleted: the resident base factors
+            # alone are exactly the factorization of the pruned
+            # operand — zero device work
+            return self._update_finish(entry, handle, (base,), rt, 0,
+                                       k_live, wn1_sq, anorm1)
+        P = _upd.bucket_k(p_all)
+        npad = int(base.vr.shape[1])
+        ldt = np.dtype(base.vr.dtype)
+        upad = np.zeros((P, npad), dtype=ldt)
+        upad[:p_all, : entry.n] = u_all.astype(ldt, copy=False)
+        udev = jnp.asarray(upad)
+        exe, key = self._update_exe(entry, handle, "qr_append",
+                                    (base, udev))
+        w_, tau_, r_ = jax.block_until_ready(exe(base, udev))
+        self._credit_program(key, "serve.update", tenant=rt,
+                             handle=handle)
+        return self._update_finish(entry, handle,
+                                   (base, udev, w_, tau_, r_), rt, P,
+                                   k_live, wn1_sq, anorm1)
+
+    def update_small_batched(self, handles, deltas,
+                             downdate: bool = False,
+                             tenant: Optional[str] = None) -> list:
+        """Grouped incremental maintenance for the many-small-problems
+        engine (Kalman-filter/RLS fleets): one bucketed program
+        up/downdates B chol_small residents at once, through the same
+        per-(B-bucket, n, k-bucket, dtype) program cache as the
+        batched solve engine, with per-item info isolation (a failed
+        downdate degrades THAT item to a counted refactor; the rest
+        commit). Cold handles are factored on miss first (a plain
+        miss, then updated). Ranks may differ per item — zero pad
+        columns are exactly inert, so the group shares one program at
+        the max rank's bucket. Returns one result dict per handle."""
+        import jax.numpy as jnp
+        from ..linalg import update as _upd
+        handles = list(handles)
+        deltas = list(deltas)
+        if len(handles) != len(deltas):
+            raise SlateError("Session.update_small_batched: handles "
+                             "and deltas length mismatch")
+        if not handles:
+            return []
+        sign = -1 if downdate else 1
+        with self._lock:
+            entries = []
+            for h in handles:
+                e = self._ops.get(h)
+                if e is None:
+                    raise SlateError(f"Session: unknown handle {h!r}")
+                if e.op != "chol_small":
+                    raise SlateError(
+                        "Session.update_small_batched: chol_small "
+                        f"operators only (got {e.op!r} for {h!r})")
+                entries.append(e)
+            keys = {self.small_group_key(h) for h in handles}
+            if len(keys) != 1:
+                raise SlateError(
+                    "Session.update_small_batched: one (op, n, dtype"
+                    "[, refine]) group per call, got "
+                    f"{sorted(map(str, keys))}")
+            n = entries[0].n
+            wd = np.dtype(entries[0].A.dtype)
+            ws = []
+            for e, d in zip(entries, deltas):
+                w = np.asarray(d)
+                if w.ndim == 1:
+                    w = w[:, None]
+                if w.ndim != 2 or w.shape[0] != n:
+                    raise SlateError(
+                        f"Session.update_small_batched: each delta "
+                        f"must be ({n}, k) vectors, got "
+                        f"{tuple(w.shape)}")
+                ws.append(np.ascontiguousarray(w, dtype=wd))
+            kb = _upd.bucket_k(max(w.shape[1] for w in ws))
+            residents = [self.factor(h) for h in handles]
+            for h, r in zip(handles, residents):
+                if r.info != 0:
+                    raise SlateError(
+                        f"Session: operator {h!r} factorization "
+                        f"failed (info={r.info})")
+            a_curs = [np.asarray(e.A) for e in entries]
+            a2s = [np.ascontiguousarray(
+                a + sign * (w @ w.conj().T), dtype=wd)
+                for a, w in zip(a_curs, ws)]
+            an1s = [float(np.linalg.norm(a, 1)) for a in a_curs]
+            B = len(handles)
+            self.metrics.inc("updates_total", B)
+            if self.faults is not None and self._fault("update"):
+                self.metrics.inc("update_aborts_total", B)
+                outs = []
+                for h, e, a2 in zip(handles, entries, a2s):
+                    self._update_commit(e, a2)
+                    outs.append(self._update_refactor(e, h, "abort"))
+                return outs
+            ldt = np.dtype(residents[0].payload[0].dtype)
+            npad = int(residents[0].payload[0].shape[-1])
+            wpad = np.zeros((B, npad, kb), dtype=ldt)
+            for i, w in enumerate(ws):
+                wpad[i, :n, : w.shape[1]] = w.astype(ldt)
+            ls = jnp.stack([r.payload[0] for r in residents])
+            l2, infos = _upd.chol_update_batched(
+                ls, jnp.asarray(wpad), sign, live_batch=B)
+            l2 = jax.block_until_ready(l2)
+            infos = np.asarray(infos)[:B]
+            outs = []
+            for i, (h, e) in enumerate(zip(handles, entries)):
+                self._update_commit(e, a2s[i])
+                if downdate and int(infos[i]) > 0:
+                    self.metrics.inc("update_downdate_failures_total")
+                    outs.append(self._update_refactor(
+                        e, h, "downdate_indefinite"))
+                    continue
+                outs.append(self._update_finish(
+                    e, h, (l2[i],),
+                    self._request_tenant_or_none(h, tenant), kb,
+                    int(ws[i].shape[1]),
+                    float(np.linalg.norm(ws[i], 1)) ** 2, an1s[i]))
+            return outs
+
+    def _warm_update(self, entry: _Operator, handle: Hashable, res,
+                     update_k: int, nrhs: int):
+        """Caller holds the lock (warmup's round-20 arm). Compile-only
+        — no program executes, nothing is maintained: chol gets both
+        sweep signs at the rank bucket; qr gets the append program at
+        ``bucket_k(update_k)`` PLUS the appended-payload solve for
+        exactly ``update_k`` appended rows at this nrhs."""
+        import jax.numpy as jnp
+        from ..linalg import update as _upd
+        kb = _upd.bucket_k(update_k)
+        if entry.op == "chol":
+            L0 = res.payload[0]
+            w0 = jnp.zeros((int(L0.mt * L0.nb), kb), dtype=L0.dtype)
+            self._update_exe(entry, handle, "chol_up", (L0, w0))
+            self._update_exe(entry, handle, "chol_down", (L0, w0))
+            return
+        base = res.payload[0]
+        npad = int(base.vr.shape[1])
+        dt = base.vr.dtype
+        u0 = jnp.zeros((kb, npad), dtype=dt)
+        self._update_exe(entry, handle, "qr_append", (base, u0))
+        pay5 = (base, u0, jnp.zeros((kb, npad), dtype=dt),
+                jnp.zeros((npad,), dtype=dt),
+                jnp.zeros((npad, npad), dtype=dt))
+        B = self._wrap_rhs(entry, np.zeros(
+            (entry.m + int(update_k), nrhs), np.dtype(entry.A.dtype)))
+        skey = self._aot_key(entry, pay5, B)
+        if skey not in self._compiled:
+            fn = self._solve_fn(entry)
+            self._compiled_put(
+                skey, self._aot_compile("solve", entry, handle, fn,
+                                        (pay5, B), key=skey))
+            self.metrics.inc("aot_compiles")
+
+    def _update_exe(self, entry: _Operator, handle: Hashable,
+                    kind: str, args: Tuple):
+        """AOT executable for one maintenance program — the _probe_exe
+        discipline: cached per (kind, op, opts, treedef, shapes) so a
+        k-bucketed update stream pays O(log k) compiles (counted in
+        ``aot_compiles``/``update_aot_compiles``), every program
+        analyzed so executions credit the bytes ledger and the budget
+        sees the transient. Returns ``(exe, key)``."""
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        shapes = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        key = ("update", kind, entry.op, entry.opts, treedef, shapes)
+        exe = self._compiled.get(key)
+        if exe is None:
+            from ..linalg import update as _upd
+            opts = entry.opts
+            if kind == "qr_append":
+                def make():
+                    return lambda qr, u: _upd.qr_append_factor(qr, u)
+            else:
+                sign = 1 if kind == "chol_up" else -1
+
+                def make():
+                    return lambda L, w: _upd.chol_update_factor(
+                        L, w, sign, opts)
+            fn = self._jit_cached(("update", kind, entry.op,
+                                   entry.opts), make)
+            exe = self._aot_compile("update", entry, handle, fn, args,
+                                    key=key)
+            self._compiled_put(key, exe)
+            self.metrics.inc("aot_compiles")
+            self.metrics.inc("update_aot_compiles")
+        else:
+            self._compiled.move_to_end(key)
+        return exe, key
+
+    def _update_commit(self, entry: _Operator, A2,
+                       m: Optional[int] = None):
+        """Caller holds the lock: the mutated operand becomes the
+        operator's truth. Cached norms are stale — dropped, refreshed
+        lazily by the next refined solve / condest probe."""
+        entry.A = A2
+        if m is not None:
+            entry.m = m
+        entry.anorm = None
+        entry.anorm1 = None
+
+    def _update_evict(self, handle: Hashable):
+        """Caller holds the lock: drop the resident (counted eviction,
+        residency interval closed) ahead of a degrade-to-refactor."""
+        res = self._cache.pop(handle, None)
+        if res is None:
+            return
+        self.metrics.inc("evictions")
+        self.metrics.inc("evicted_bytes", res.nbytes)
+        if self.attribution is not None:
+            self._attr_evicted(handle)
+        self._update_hbm_gauges()
+
+    def _update_refactor(self, entry: _Operator, handle: Hashable,
+                         reason: str, applied: bool = False) -> dict:
+        """Caller holds the lock, mutated operand committed. The
+        counted degrade path every update failure funnels through:
+        evict the (stale or discarded) resident and refactor A' —
+        which either serves correctly or reports its own info, never
+        a wrong answer from a half-maintained factor."""
+        self.metrics.inc("update_refactors_total")
+        self._update_evict(handle)
+        res = self.factor(handle)
+        return {"applied": applied, "refactored": True,
+                "reason": reason, "info": int(res.info),
+                "op": entry.op}
+
+    def _update_finish(self, entry: _Operator, handle: Hashable,
+                       payload2: Tuple, rt: Optional[str], kb: int,
+                       k: int, wnorm1_sq: float,
+                       anorm1: float) -> dict:
+        """Caller holds the lock, operand committed. Install the
+        maintained resident, credit the executed-bucket update flops
+        (counters + process ledger + attribution cell, all
+        grid-snapped — the conservation discipline), then run the
+        numerics accrual: if the accumulated update error mass crosses
+        the budget, the just-served resident refactors NOW (counted),
+        off the next request's path."""
+        res2 = _Resident(payload2, 0,
+                         _tree_nbytes(payload2, per_chip=True),
+                         _tree_nbytes(payload2))
+        self._cache[handle] = res2
+        self._cache.move_to_end(handle)
+        fl = 0.0
+        if kb:
+            fl = _fl_grid(_flops_mod.update_flops(
+                entry.op, entry.m, entry.n, kb))
+            self.metrics.inc("flops_total", fl)
+            self.metrics.inc("update_flops_total", fl)
+            _LEDGER.record("serve.update", fl)
+        attr = self.attribution
+        if attr is not None:
+            if fl:
+                attr.record("update_flops", rt, handle, fl)
+            inc = attr.touch_residency(entry.tenant, handle,
+                                       res2.nbytes)
+            if inc:
+                self.metrics.inc("residency_byte_seconds_total", inc)
+        self._update_hbm_gauges()
+        self._evict_to_budget(keep=handle)
+        if self.tenant_policies is not None:
+            self._evict_tenant_to_budget(entry.tenant, keep=handle)
+        refactored = self._update_health(entry, handle, k, wnorm1_sq,
+                                         anorm1)
+        out = {"applied": True, "refactored": bool(refactored),
+               "info": 0, "op": entry.op, "k": k, "k_bucket": kb}
+        if refactored:
+            out["reason"] = "update_budget"
+        return out
+
+    def _update_health(self, entry: _Operator, handle: Hashable,
+                       k: int, wnorm1_sq: float,
+                       anorm1: float) -> bool:
+        """Caller holds the lock, maintained resident installed.
+        Accrue the update's growth-weighted error mass and consult the
+        refactor-due predicate (obs/numerics.py — ONE source of truth:
+        the monitor keeps the authoritative per-handle copy when
+        attached, the operator entry carries the monitor-less
+        fallback). Returns True when the budget came due and a counted
+        refactor replaced the accumulated-error resident."""
+        weight = _num.update_weight(k, wnorm1_sq, anorm1)
+        nm = self.numerics
+        if nm is not None:
+            old, new = nm.record_update(handle, k, weight)
+            self._health_reflex(entry, handle, old, new)
+            due = nm.update_due(handle)
+        else:
+            entry.updates += 1
+            entry.update_weight += weight
+            due = _num.update_refactor_due(entry.updates,
+                                           entry.update_weight,
+                                           _num.DEFAULT_UPDATE_BUDGET)
+        if not due:
+            return False
+        self.metrics.inc("update_budget_refactors_total")
+        self._update_refactor(entry, handle, "budget", applied=True)
+        return True
+
     @staticmethod
     def _aot_key(entry: _Operator, payload, B) -> Hashable:
         leaves, treedef = jax.tree_util.tree_flatten((payload, B))
@@ -2944,7 +3504,8 @@ class Session:
 
     # -- AOT warmup --------------------------------------------------------
 
-    def warmup(self, handle: Hashable, nrhs: int = 1):
+    def warmup(self, handle: Hashable, nrhs: int = 1,
+               update_k: Optional[int] = None):
         """Ahead-of-time path: AOT-compile the whole-factor program
         (dense operators; the lookahead-pipeline driver — round 7),
         factor ``handle`` through it now (off the request path), and
@@ -2953,7 +3514,17 @@ class Session:
         request-time refactors AND solves skip tracing and
         compilation. Dense right-hand sides are tile-padded, so one
         warmup at nrhs=1 covers every bucket width up to the
-        operator's nb."""
+        operator's nb.
+
+        ``update_k`` (round 20): additionally precompile the
+        incremental-maintenance programs at ``bucket_k(update_k)`` —
+        both chol sweep signs (zero update vectors are exactly inert,
+        so one warm covers every live rank in the bucket), or the QR
+        append program plus the appended-payload solve for EXACTLY
+        ``update_k`` appended rows (the appended solve's rhs height is
+        m + p, so each append count is its own program). After this, a
+        served update at the bucket is zero new compiles (the
+        acceptance pin)."""
         with self._lock:
             entry = self._ops.get(handle)
             if entry is None:
@@ -2992,6 +3563,21 @@ class Session:
                         else:
                             _batched.potrs_batched(res.payload[0][None],
                                                    b0[None])
+                if (update_k is not None and res.info == 0
+                        and entry.op == "chol_small"):
+                    # populate the batched sweep's bucket programs at
+                    # this rank bucket (zero W is exactly inert, so
+                    # running it maintains nothing); suppressed — fake
+                    # traffic credits no bytes
+                    import jax.numpy as jnp
+                    from ..linalg import update as _upd
+                    kb = _upd.bucket_k(update_k)
+                    L0 = res.payload[0]
+                    w0 = jnp.zeros((1, int(L0.shape[-1]), kb),
+                                   dtype=L0.dtype)
+                    with _batched.suppress_accounting():
+                        _upd.chol_update_batched(L0[None], w0, 1)
+                        _upd.chol_update_batched(L0[None], w0, -1)
                 return
             if entry.op in SPECTRAL_OPS:
                 # round 19: factoring runs every pipeline stage through
@@ -3044,6 +3630,10 @@ class Session:
                             key=fkey))
                     self.metrics.inc("factor_aot_compiles")
             res = self.factor(handle)
+            if (update_k is not None and res.info == 0
+                    and entry.op in ("chol", "qr")
+                    and entry.grid is None):
+                self._warm_update(entry, handle, res, update_k, nrhs)
             B = self._wrap_rhs(
                 entry, np.zeros((entry.m, nrhs)))
             if entry.refine is not None:
@@ -3148,6 +3738,15 @@ class Session:
             model_fl = (_flops_mod.gemm(entry.n, kk, entry.n)
                         + _solve_flops(entry.op, entry.m, entry.n, kk,
                                        entry.band))
+        elif what == "update":
+            # round 20: one incremental-maintenance program. The rank
+            # operand is the LAST arg — (npad, kb) vectors for chol
+            # (rank = cols), (P, npad) appended rows for qr (rank =
+            # rows) — and the model charges the executed bucket
+            model_fl = _fl_grid(_flops_mod.update_flops(
+                entry.op, entry.m, entry.n,
+                (wshape[0] if entry.op == "qr" else kk)
+                if wshape else 1))
         else:
             model_fl = _solve_flops(entry.op, entry.m, entry.n, kk,
                                     entry.band)
@@ -3419,6 +4018,13 @@ def _make_solve_fn(op: str, opts: Options):
             return api.chol_solve_using_factor(payload[0], B, opts)
     else:
         def solve(payload, B):
+            if len(payload) > 1:
+                # round 20: an appended-rows QR resident carries the
+                # 5-tuple (base, u, w, tau, r) — python-level arity
+                # branch: jit keys on the treedef, so each payload
+                # shape traces its own program, never a mixed one
+                from ..linalg import update as _upd
+                return _upd.appended_gels(payload, B, opts)
             return api.least_squares_solve_using_factor(payload[0], B, opts)
     solve.__name__ = f"serve_{op}_solve"
     return solve

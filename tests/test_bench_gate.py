@@ -79,7 +79,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "tenants": _tenants_section(),
         "numerics": _numerics_section(),
         "quotas": _quotas_section(),
-        "spectral": _spectral_section()}
+        "spectral": _spectral_section(),
+        "updates": _updates_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -184,6 +185,25 @@ def _spectral_section():
     }
 
 
+def _updates_section():
+    """A minimal round-20 serve-artifact updates section that passes
+    gate_mod._check_updates_section."""
+    return {
+        "enabled": True,
+        "op": "chol",
+        "n": 96,
+        "nb": 32,
+        "k": 2,
+        "updates_applied": 2,
+        "new_compiles_after_warmup": 0,
+        "update_refactors": 0,
+        "refactors_during_updates": 0.0,
+        "update_flops": 73728.0,
+        "solve_rel_err": 4.1e-9,
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -219,7 +239,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "cost_log": [], "hbm": {}, "slo": {},
         "numerics": _numerics_section(),
         "quotas": _quotas_section(),
-        "spectral": _spectral_section()}
+        "spectral": _spectral_section(),
+        "updates": _updates_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

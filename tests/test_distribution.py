@@ -84,7 +84,15 @@ def test_factorizations_accept_cyclic_input(grid2x4):
 
 # -- sharded outputs + 1x1-grid agreement ----------------------------------
 
-@pytest.mark.parametrize("routine", ["potrf", "getrf", "geqrf"])
+# getrf/geqrf arms ride the slow lane (round-20 tier-1 budget: each is
+# its own n=256 mesh factor compile); the potrf arm keeps the
+# outputs-stay-sharded contract tier-1, and grid_matches_single_device
+# pins mesh correctness for all three routines
+@pytest.mark.parametrize("routine", [
+    "potrf",
+    pytest.param("getrf", marks=pytest.mark.slow),
+    pytest.param("geqrf", marks=pytest.mark.slow),
+])
 def test_factorization_outputs_stay_sharded(grid2x4, routine):
     n, nb = 256, 32
     if routine == "potrf":
@@ -365,11 +373,16 @@ def test_method_gemm_summa_routing(grid2x4):
                                rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_hlo_he2hb_has_collectives_and_heev_2stage_runs(grid2x4):
     """VERDICT r4 weak #7: the two-stage heev's stage-1 (he2hb) exists
     for its mesh sharding — assert its compiled HLO actually carries
     collectives on the 2x4 grid, and run the full two-stage eigensolver
-    on the mesh end to end."""
+    on the mesh end to end. Slow (round-20 tier-1 budget: the full
+    n=256 2x4 two-stage pipeline compile). Tier-1 sibling:
+    test_spectral.py::test_mesh_census_collective_bytes pins nonzero
+    collective bytes for the staged he2hb on a 2x2 grid through the
+    Session census."""
     from slate_tpu.core.types import MethodEig, Options
 
     n, nb = 256, 32

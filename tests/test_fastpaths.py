@@ -122,10 +122,15 @@ def test_gesv_getrs_through_fused_factors():
                                rtol=1e-9, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_getrf_pivot_fusion_bit_identical_mesh(grid2x4):
     """Bit-level equivalence must survive the 8-device mesh (the
     deferred-left-swap suffix gathers become collective traffic there),
-    and the mesh result must match the 1×1 grid."""
+    and the mesh result must match the 1×1 grid. Slow (round-20 tier-1
+    budget: two n=256 8-device factor compiles). Tier-1 siblings: the
+    single-device pivot-fusion bit-identity params above, and
+    test_distribution.py::test_grid_matches_single_device[getrf] for
+    mesh-getrf agreement."""
     # nb=32 keeps this test on the round-6 shape; the (256, nb=64)
     # corruption recorded here as an open item was ROOT-CAUSED AND
     # FIXED in round 7 (two pre-0.6 partitioner mis-lowerings:

@@ -386,13 +386,18 @@ def test_compose_tail_sharded(grid2x4):
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_mesh_getrf_nb64_perm_regression(grid2x4):
     """The full previously-failing shape (n=256, nb=64): the perm must
     be a valid permutation, match the 1×1 grid, and factor correctly —
     under BOTH lookahead arms (the restructure does not change the
     lowering class: the corruption lived in perm composition and the
     sharded-panel gathers, fixed by lift_tail_perm +
-    replicate_on_grid)."""
+    replicate_on_grid). Slow (round-20 tier-1 budget: two n=256 mesh
+    factor compiles). Tier-1 siblings: test_compose_tail_sharded pins
+    the root-cause perm-composition contract on the same grid, and
+    test_distribution.py's grid_matches_single_device[getrf] pins
+    mesh-getrf correctness."""
     n, nb = 256, 64
     a = _randn(n, n, np.float64)
     Ag = st.from_dense(a, nb=nb, grid=grid2x4)

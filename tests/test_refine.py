@@ -135,11 +135,13 @@ BN = 32
 @pytest.mark.slow
 def test_batched_mixed_correctness_and_per_item_info():
     """Slow (round-18 tier-1 budget: this test pays the first fused
-    gesv_mixed_batched bucket compiles of the file). Tier-1 siblings:
-    test_batched_mixed_b1_bit_identical_to_lane pins the fused api
-    kernels (bit-identity subsumes correctness), and
-    test_grouped_mixed_per_item_fallback_isolates_neighbors pins
-    per-item isolation at the Session seam."""
+    gesv_mixed_batched bucket compiles of the file). The b1-lane
+    bit-identity and per-item-isolation pins moved to the slow lane
+    too in round 20 (each fused mixed config is its own ~30 s compile
+    on this host); the tier-1 pins for the class are named in their
+    docstrings (test_batched.py bit-identity family,
+    test_attribution.py grouped-mixed tallies, the counted-fallback
+    pins in this file and test_faults.py)."""
     bsz = 5
     a = np.stack([_diagdom(n=BN, seed=10 + i) for i in range(bsz)])
     b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
@@ -155,14 +157,18 @@ def test_batched_mixed_correctness_and_per_item_info():
         assert _scaled_err(a[i], np.asarray(x)[i], b[i]) < 30
 
 
+@pytest.mark.slow
 def test_batched_mixed_b1_bit_identical_to_lane():
     """The linalg/batched contract extended to the mixed kernels: a
     B=1 run is bit-identical to its lane of a bucket (the
     optimization-barrier'd cast-up pins the low-precision rounding —
-    without it XLA:CPU fuses the upcast batch-shape-dependently). LU
-    arm tier-1; the chol arm and more bucket sizes ride the slow
-    sweeps (each fused mixed-kernel CONFIG is its own ~30 s XLA:CPU
-    compile)."""
+    without it XLA:CPU fuses the upcast batch-shape-dependently).
+    Slow (round-20 tier-1 budget: the two fused mixed-kernel configs
+    it compares are ~30 s of XLA:CPU compile each). Tier-1 siblings:
+    test_batched.py's bucket_padding/bit-identity pins hold the
+    b1-lane contract for the batched kernel family, and
+    test_attribution.py::test_grouped_mixed_lane_tenant_tallies
+    executes the grouped mixed bucket kernels at the Session seam."""
     bsz = 5
     a = np.stack([_diagdom(n=BN, seed=20 + i) for i in range(bsz)])
     b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
@@ -190,8 +196,10 @@ def test_batched_mixed_fallback_splices_working_precision_slow():
     """A non-convergent item (impossible tolerance) is re-solved at
     working precision by the api fallback and keeps its negative
     iters marker. Slow: the (max_iters=1, tol=1e-14) config is its own
-    bucket-program compile; the tier-1 sibling for per-item fallback
-    isolation is test_grouped_mixed_per_item_fallback_isolates_neighbors."""
+    bucket-program compile; tier-1 pins for the fallback class are
+    test_lo_factor_failure_falls_back_per_request and test_faults.py's
+    injected-non-convergence counted fallback (the grouped per-item
+    isolation pin rides the slow lane since round 20)."""
     bsz = 3
     a = np.stack([_diagdom(n=BN, seed=40 + i) for i in range(bsz)])
     b = RNG.standard_normal((bsz, BN, 2)).astype(np.float32)
@@ -461,10 +469,18 @@ def test_grouped_mixed_does_not_coalesce_with_plain():
     assert km != kp and km[:3] == kp
 
 
+@pytest.mark.slow
 def test_grouped_mixed_per_item_fallback_isolates_neighbors():
     """One non-convergent item in a grouped mixed bucket takes the
     working-precision fallback alone; its neighbors' solutions are the
-    refined ones, bit-identical to a clean grouped run."""
+    refined ones, bit-identical to a clean grouped run. Slow (round-20
+    tier-1 budget: the impossible-tolerance policy is its own grouped
+    bucket-program compile). Tier-1 siblings:
+    test_lo_factor_failure_falls_back_per_request pins the counted
+    working-precision fallback, and test_faults.py::
+    test_injected_refine_non_convergence_takes_counted_fallback pins
+    non-convergence degrading to a counted fallback at the Session
+    seam."""
     n = 32
     pol = RefinePolicy(factor_dtype="bfloat16", max_iters=2, tol=1e-14)
     ok_pol = RefinePolicy(factor_dtype="bfloat16")

@@ -1,0 +1,434 @@
+"""Incremental factor maintenance (round 20): rank-k Cholesky
+up/downdates, QR row append/delete, delta-checkpoint replication.
+
+The contract under test is the tentpole's: a mutated operator serves
+from an UPDATED resident with zero full refactors on the happy path
+(counter-pinned), every degraded path is a counted refactor that never
+serves a wrong answer, and replica propagation ships only the blobs an
+update changed.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.core.tiled_matrix import from_dense, hermitian
+from slate_tpu.core.types import Uplo
+from slate_tpu.linalg import update as upd
+from slate_tpu.obs import numerics as num
+from slate_tpu.runtime import checkpoint as ck
+from slate_tpu.runtime.faults import FaultInjector, FaultPlan, FaultSpec
+from slate_tpu.runtime.fleet import Fleet
+from slate_tpu.runtime.session import Session
+
+RNG = np.random.default_rng(20)
+
+
+def _spd(n, complex_=False):
+    a = RNG.standard_normal((n, n))
+    if complex_:
+        a = a + 1j * RNG.standard_normal((n, n))
+    a = a @ a.conj().T + n * np.eye(n)
+    return a
+
+
+def _counters(s):
+    return s.metrics.snapshot()["counters"]
+
+
+class TestCholUpdateKernel:
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_update_matches_refactor(self, k):
+        n = 24
+        a = _spd(n)
+        w = RNG.standard_normal((n, k))
+        l = np.linalg.cholesky(a)
+        l2, info = jax.jit(upd.chol_update_dense,
+                           static_argnums=(2,))(l, w, +1)
+        assert int(info) == 0
+        np.testing.assert_allclose(np.tril(np.asarray(l2)),
+                                   np.linalg.cholesky(a + w @ w.T),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_complex_update(self):
+        n = 16
+        a = _spd(n, complex_=True)
+        w = RNG.standard_normal((n, 2)) + 1j * RNG.standard_normal((n, 2))
+        l = np.linalg.cholesky(a)
+        l2, info = upd.chol_update_dense(l, w, +1)
+        assert int(info) == 0
+        ref = np.linalg.cholesky(a + w @ w.conj().T)
+        # column phases are a sweep choice; compare L·Lᴴ
+        got = np.tril(np.asarray(l2))
+        np.testing.assert_allclose(got @ got.conj().T,
+                                   ref @ ref.conj().T,
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_downdate_roundtrip_and_indefinite_guard(self):
+        n = 20
+        a = _spd(n)
+        w = RNG.standard_normal((n, 2))
+        l = np.linalg.cholesky(a + w @ w.T)
+        l2, info = upd.chol_update_dense(l, w, -1)
+        assert int(info) == 0
+        np.testing.assert_allclose(np.tril(np.asarray(l2)),
+                                   np.linalg.cholesky(a),
+                                   rtol=1e-8, atol=1e-10)
+        # downdating past positivity must FLAG, and stay finite (the
+        # guard is what turns this into a counted refactor upstream)
+        _, info = upd.chol_update_dense(np.linalg.cholesky(a),
+                                        10.0 * w, -1)
+        assert int(info) > 0
+        assert np.isfinite(np.asarray(_)).all()
+
+    def test_batched_matches_single(self):
+        n, k, B = 16, 2, 3
+        ls = np.stack([np.linalg.cholesky(_spd(n)) for _ in range(B)])
+        ws = RNG.standard_normal((B, n, k))
+        lb, infos = upd.chol_update_batched(jnp.asarray(ls),
+                                            jnp.asarray(ws), +1)
+        assert np.asarray(infos).max() == 0
+        for i in range(B):
+            l1, _ = upd.chol_update_dense(ls[i], ws[i], +1)
+            np.testing.assert_allclose(np.tril(np.asarray(lb[i])),
+                                       np.tril(np.asarray(l1)),
+                                       rtol=1e-12, atol=1e-13)
+
+
+class TestSessionCholUpdate:
+    def test_serves_without_refactor_counter_pinned(self):
+        n, nb = 32, 16
+        a = _spd(n)
+        s = Session()
+        s.register(hermitian(a, nb, Uplo.Lower), op="chol", handle="c")
+        s.warmup("c", nrhs=2, update_k=2)
+        acc = a.copy()
+        b = RNG.standard_normal((n, 2))
+        for k in (1, 2):
+            w = RNG.standard_normal((n, k))
+            out = s.update("c", w)
+            assert out["applied"] and not out["refactored"], out
+            acc = acc + w @ w.T
+            np.testing.assert_allclose(s.solve("c", b),
+                                       np.linalg.solve(acc, b),
+                                       rtol=1e-9, atol=1e-11)
+        c = _counters(s)
+        # THE happy-path pin: one initial factorization, zero since
+        assert c.get("factors_total") == 1, c
+        assert c.get("update_refactors_total", 0) == 0, c
+        assert c.get("updates_total") == 2, c
+        assert c.get("update_flops_total", 0) > 0, c
+
+    def test_k_bucket_compile_once(self):
+        n, nb = 32, 16
+        s = Session()
+        s.register(hermitian(_spd(n), nb, Uplo.Lower), op="chol",
+                   handle="c")
+        s.factor("c")
+        s.update("c", RNG.standard_normal((n, 3)))
+        after_first = _counters(s).get("update_aot_compiles", 0)
+        assert after_first == 1
+        # k=4 lands in the SAME pow2 bucket as k=3 -> zero new programs
+        s.update("c", RNG.standard_normal((n, 4)))
+        assert _counters(s).get("update_aot_compiles", 0) == after_first
+
+    def test_pad_parity_odd_n(self):
+        n, nb = 20, 16  # npad=32: the update must ignore pad lanes
+        a = _spd(n)
+        s = Session()
+        s.register(hermitian(a, nb, Uplo.Lower), op="chol", handle="c")
+        # no resident yet: the update DEFERS (commits the operator,
+        # the next factor() absorbs it — no wasted sweep program)
+        w0 = RNG.standard_normal((n, 1))
+        out = s.update("c", w0)
+        assert out["deferred"] and not out["applied"], out
+        a0 = a + w0 @ w0.T
+        s.factor("c")
+        w = RNG.standard_normal((n, 2))
+        out = s.update("c", w)
+        assert out["applied"], out
+        b = RNG.standard_normal(n)
+        np.testing.assert_allclose(s.solve("c", b),
+                                   np.linalg.solve(a0 + w @ w.T, b),
+                                   rtol=1e-9, atol=1e-11)
+
+    def test_indefinite_downdate_is_counted_never_served(self):
+        n, nb = 24, 16
+        a = _spd(n)
+        s = Session()
+        s.register(hermitian(a, nb, Uplo.Lower), op="chol", handle="c")
+        s.factor("c")
+        out = s.update("c", 10.0 * RNG.standard_normal((n, 2)),
+                       downdate=True)
+        assert out["refactored"] and out["reason"] == "downdate_indefinite"
+        c = _counters(s)
+        assert c.get("update_downdate_failures_total") == 1, c
+        assert c.get("update_refactors_total") == 1, c
+        # A' is indefinite: the authoritative refactor reports it and
+        # the solve REFUSES — detected, never a wrong answer
+        with pytest.raises(SlateError):
+            s.solve("c", RNG.standard_normal(n))
+
+    def test_small_batched_verb_matches_refactor(self):
+        n = 16
+        s = Session()
+        mats, hs, ws = [], [], []
+        for i in range(3):
+            a = _spd(n)
+            h = f"h{i}"
+            s.register(np.ascontiguousarray(a), op="chol_small",
+                       handle=h)
+            mats.append(a)
+            hs.append(h)
+            ws.append(RNG.standard_normal((n, i + 1)))
+        outs = s.update_small_batched(hs, ws)
+        b = RNG.standard_normal(n)
+        for i, h in enumerate(hs):
+            assert outs[i]["applied"], outs[i]
+            np.testing.assert_allclose(
+                s.solve(h, b),
+                np.linalg.solve(mats[i] + ws[i] @ ws[i].T, b),
+                rtol=1e-9, atol=1e-11)
+        assert _counters(s).get("updates_total") == 3
+
+    def test_update_budget_triggers_counted_refactor(self):
+        n, nb = 24, 16
+        s = Session()
+        s.enable_numerics(num.NumericsConfig(update_budget=3.0,
+                                             condest_on_factor=False))
+        s.register(hermitian(_spd(n), nb, Uplo.Lower), op="chol",
+                   handle="c")
+        s.factor("c")
+        reasons = []
+        for _ in range(4):
+            out = s.update("c", 1e-3 * RNG.standard_normal((n, 1)))
+            reasons.append(out.get("reason"))
+        # each rank-1 update weighs >= 1: the 4th crosses budget=3
+        assert reasons[:3] == [None, None, None] and \
+            reasons[3] == "update_budget", reasons
+        assert _counters(s).get("update_budget_refactors_total") == 1
+
+    def test_injected_update_abort_degrades_to_counted_refactor(self):
+        n, nb = 24, 16
+        a = _spd(n)
+        s = Session(faults=FaultInjector(FaultPlan(9, (FaultSpec(
+            "update_abort", rate=1.0, count=1),))))
+        s.register(hermitian(a, nb, Uplo.Lower), op="chol", handle="c")
+        s.factor("c")
+        w = RNG.standard_normal((n, 2))
+        out = s.update("c", w)
+        assert out["refactored"] and out["reason"] == "abort", out
+        c = _counters(s)
+        assert c.get("update_aborts_total") == 1, c
+        # the refactor is the authority: the answer is still right
+        b = RNG.standard_normal(n)
+        np.testing.assert_allclose(s.solve("c", b),
+                                   np.linalg.solve(a + w @ w.T, b),
+                                   rtol=1e-9, atol=1e-11)
+
+
+class TestSessionQrUpdate:
+    def test_append_matches_lstsq_zero_compiles_after_warmup(self):
+        m, n, nb = 48, 24, 16
+        aq = RNG.standard_normal((m, n))
+        s = Session()
+        s.register(from_dense(aq, nb), op="qr", handle="q")
+        s.warmup("q", nrhs=2, update_k=2)
+        before = _counters(s).get("aot_compiles", 0)
+        u = RNG.standard_normal((2, n))
+        out = s.update("q", u)
+        assert out["applied"] and not out["refactored"], out
+        b = RNG.standard_normal((m + 2, 2))
+        xref, *_ = np.linalg.lstsq(np.vstack([aq, u]), b, rcond=None)
+        np.testing.assert_allclose(s.solve("q", b), xref,
+                                   rtol=1e-8, atol=1e-10)
+        c = _counters(s)
+        assert c.get("aot_compiles", 0) == before, \
+            "append or its solve compiled post-warmup"
+        assert c.get("factors_total") == 1, c
+
+    def test_delete_appended_and_back_to_base(self):
+        m, n, nb = 32, 16, 16
+        aq = RNG.standard_normal((m, n))
+        s = Session()
+        s.register(from_dense(aq, nb), op="qr", handle="q")
+        s.factor("q")
+        u = RNG.standard_normal((2, n))
+        s.update("q", u)
+        out = s.update("q", delete=[m])  # drop the first appended row
+        assert out["applied"], out
+        b = RNG.standard_normal((m + 1, 1))
+        xref, *_ = np.linalg.lstsq(np.vstack([aq, u[1:]]), b,
+                                   rcond=None)
+        np.testing.assert_allclose(s.solve("q", b), xref,
+                                   rtol=1e-8, atol=1e-10)
+        out = s.update("q", delete=[m])  # back to the base factors
+        assert out["applied"] and out["k_bucket"] == 0, out
+        b = RNG.standard_normal((m, 1))
+        xref, *_ = np.linalg.lstsq(aq, b, rcond=None)
+        np.testing.assert_allclose(s.solve("q", b), xref,
+                                   rtol=1e-8, atol=1e-10)
+        assert _counters(s).get("factors_total") == 1
+
+    def test_base_row_delete_degrades_to_counted_refactor(self):
+        m, n, nb = 32, 16, 16
+        aq = RNG.standard_normal((m, n))
+        s = Session()
+        s.register(from_dense(aq, nb), op="qr", handle="q")
+        s.factor("q")
+        out = s.update("q", delete=[0])
+        assert out["refactored"] and out["reason"] == "base_delete", out
+        assert _counters(s).get("update_refactors_total") == 1
+        b = RNG.standard_normal((m - 1, 1))
+        xref, *_ = np.linalg.lstsq(aq[1:], b, rcond=None)
+        np.testing.assert_allclose(s.solve("q", b), xref,
+                                   rtol=1e-8, atol=1e-10)
+
+
+class TestDeltaCheckpoint:
+    def _session_with_updates(self):
+        n, nb, m = 24, 16, 32
+        s = Session()
+        a = _spd(n)
+        s.register(hermitian(a, nb, Uplo.Lower), op="chol", handle="c")
+        aq = RNG.standard_normal((m, n))
+        s.register(from_dense(aq, nb), op="qr", handle="q")
+        s.factor("c")
+        s.factor("q")
+        return s, a, aq, n, m
+
+    def test_delta_ships_only_changed_blobs(self, tmp_path):
+        s, a, aq, n, m = self._session_with_updates()
+        base = str(tmp_path / "base")
+        delta = str(tmp_path / "delta")
+        base_manifest = ck.save_session(s, base, host="p")
+        w = RNG.standard_normal((n, 1))
+        u = RNG.standard_normal((1, n))
+        s.update("c", w)
+        s.update("q", u)
+        manifest, stats = ck.save_session_delta(s, delta,
+                                                base_manifest, host="p")
+        assert stats["reused_blobs"] > 0, stats
+        assert stats["sync_bytes"] < stats["full_bytes"], stats
+        # the qr append NEVER rewrites the base factors
+        qr_rec = [r for r in manifest["records"]
+                  if r["handle"] == "q"][0]
+        assert any(b.get("base")
+                   for b in ck._iter_blob_descs(qr_rec["payload"])), \
+            "append rewrote the base factor blobs"
+        # restore side: fresh session, bit-identical resident, solve
+        # parity with the UPDATED operators, zero refactors
+        s2 = Session()
+        summary = ck.restore_session_delta(s2, delta, base)
+        assert set(summary["restored"]) == {"c", "q"}, summary
+        b = RNG.standard_normal((n, 1))
+        np.testing.assert_allclose(
+            s2.solve("c", b), np.linalg.solve(a + w @ w.T, b),
+            rtol=1e-9, atol=1e-11)
+        bq = RNG.standard_normal((m + 1, 1))
+        xref, *_ = np.linalg.lstsq(np.vstack([aq, u]), bq, rcond=None)
+        np.testing.assert_allclose(s2.solve("q", bq), xref,
+                                   rtol=1e-8, atol=1e-10)
+        assert _counters(s2).get("factors_total", 0) == 0
+        for x1, x2 in zip(
+                jax.tree_util.tree_leaves(s._cache["q"].payload),
+                jax.tree_util.tree_leaves(s2._cache["q"].payload)):
+            np.testing.assert_array_equal(np.asarray(x1),
+                                          np.asarray(x2))
+        assert _counters(s2).get("delta_restores_total") == 1
+
+    def test_delta_schema_validation(self, tmp_path):
+        s, *_ = self._session_with_updates()
+        base = str(tmp_path / "base")
+        delta = str(tmp_path / "delta")
+        base_manifest = ck.save_session(s, base)
+        manifest, _ = ck.save_session_delta(s, delta, base_manifest)
+        assert manifest["schema"] == ck.DELTA_SCHEMA
+        assert not ck.validate_manifest(manifest,
+                                        schema=ck.DELTA_SCHEMA)
+        # a delta manifest is NOT a valid full checkpoint, and a delta
+        # cannot chain off another delta
+        assert ck.validate_manifest(manifest)
+        with pytest.raises(SlateError):
+            ck.save_session_delta(s, str(tmp_path / "d2"), manifest)
+
+
+class TestFleetUpdateReplication:
+    def test_update_delta_syncs_replicas_and_survives_failover(self):
+        n, nb, m = 24, 16, 32
+        f = Fleet({"a": Session(), "b": Session()})
+        try:
+            aq = RNG.standard_normal((m, n))
+            h = f.register(from_dense(aq, nb), op="qr", handle="q")
+            f.member(f.placement_of(h)[0]).factor(h)
+            assert f.replicate(h) is not None
+            u = RNG.standard_normal((2, n))
+            out = f.update(h, u)
+            assert out["applied"], out
+            c = f.snapshot()["counters"]
+            assert c.get("fleet_delta_replications_total") == 1, c
+            assert c.get("fleet_delta_sync_bytes") \
+                < c.get("fleet_full_sync_bytes"), c
+            bq = RNG.standard_normal((m + 2, 1))
+            xref, *_ = np.linalg.lstsq(np.vstack([aq, u]), bq,
+                                       rcond=None)
+            replica = f.placement_of(h)[1]
+            before = f.member(replica).metrics.snapshot()[
+                "counters"].get("factors_total", 0)
+            f.kill(f.placement_of(h)[0])
+            fut = f.submit(h, bq)
+            f.flush()
+            np.testing.assert_allclose(fut.result(timeout=60), xref,
+                                       rtol=1e-8, atol=1e-10)
+            after = f.member(replica).metrics.snapshot()[
+                "counters"].get("factors_total", 0)
+            assert after == before, \
+                "failover refactored a delta-synced replica"
+        finally:
+            f.close()
+
+    def test_stale_base_falls_back_to_counted_full_transfer(self):
+        n, nb = 24, 16
+        f = Fleet({"a": Session(), "b": Session()},
+                  faults=FaultInjector(FaultPlan(5, (FaultSpec(
+                      "replica_stale", rate=1.0, count=1),))))
+        try:
+            a = _spd(n)
+            h = f.register(hermitian(a, nb, Uplo.Lower), op="chol",
+                           handle="c")
+            f.member(f.placement_of(h)[0]).factor(h)
+            f.replicate(h)
+            w = RNG.standard_normal((n, 1))
+            assert f.update(h, w)["applied"]
+            c = f.snapshot()["counters"]
+            assert c.get("fleet_delta_base_stale_total") == 1, c
+            assert c.get("fleet_full_replications_total") == 1, c
+            b = RNG.standard_normal(n)
+            xref = np.linalg.solve(a + w @ w.T, b)
+            for name_ in ("a", "b"):
+                if h in f.member(name_):
+                    np.testing.assert_allclose(
+                        f.member(name_).solve(h, b), xref,
+                        rtol=1e-9, atol=1e-11)
+            # the full transfer re-established a trusted base: the
+            # NEXT update rides the delta path again
+            assert f.update(h, RNG.standard_normal((n, 1)))["applied"]
+            c = f.snapshot()["counters"]
+            assert c.get("fleet_delta_replications_total") == 1, c
+        finally:
+            f.close()
+
+
+class TestUpdateFlopsModels:
+    def test_models_positive_and_monotone(self):
+        assert num.update_weight(1, 1.0, 10.0) >= 1.0
+        from slate_tpu.obs import flops as fl
+        assert fl.update_flops("chol", 32, 32, 2) \
+            == fl.update_chol(32, 2)
+        assert fl.update_flops("qr", 48, 24, 2) \
+            == fl.update_qr(48, 24, 2)
+        assert fl.update_chol(32, 4) > fl.update_chol(32, 1)
+        assert fl.update_qr(48, 24, 4) > fl.update_qr(48, 24, 1)

@@ -51,10 +51,12 @@ def test_committed_baseline_loads_and_validates():
     assert len(doc["series"]) > 20
     assert validate_baseline(doc) == []
     # direction annotation: residual, latency, queue-age (round 14
-    # overload columns), and recovery/failover/refactor series (round
-    # 17 failover columns) are lower-is-better, everything else higher
+    # overload columns), recovery/failover/refactor series (round 17
+    # failover columns), and sync.* transfer-byte series (round 20
+    # delta replication) are lower-is-better, everything else higher
     for row in doc["series"]:
         want = ("lower" if (row["metric"].startswith("residual_")
+                            or row["metric"].startswith("sync.")
                             or "latency" in row["metric"]
                             or "age_s" in row["metric"]
                             or "recovery" in row["metric"]

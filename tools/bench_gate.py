@@ -109,6 +109,18 @@ TRACKED_FAIR = ("reqs_per_sec", "p50_latency_s", "p99_latency_s",
 # two-gemm apply-census columns are structural evidence, not series.
 TRACKED_SPECTRAL = ("resident.applies_per_sec",
                     "cold.applies_per_sec", "speedup")
+# the round-20 incremental-maintenance A/B (bench_serve.py --updates →
+# BENCH_UPDATE_r*.json): one record per (op, n, k) row — rank-k
+# updates / QR row appends served from the resident factor vs a full
+# evict+refactor per mutation, k riding the batch series slot. The
+# sync.* columns (delta-vs-full replica transfer bytes) classify
+# lower-is-better via _direction; the refactor arm's rate is kept in
+# the row for reading but NOT tracked as a series (its name would
+# collide with the lower-is-better "refactor" classification the
+# failover counts rely on). Zero-refactor/zero-compile columns are
+# structural evidence, not series.
+TRACKED_UPDATE = ("update.updates_per_sec", "speedup",
+                  "sync.delta_bytes", "sync.ratio")
 GATED_PLATFORMS = ("tpu", "axon")
 
 # mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
@@ -119,7 +131,7 @@ GATED_PLATFORMS = ("tpu", "axon")
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas", "spectral")
+    "tenants", "numerics", "quotas", "spectral", "updates")
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
@@ -208,7 +220,8 @@ def normalize(path: str) -> dict:
                                                       "serve_overload",
                                                       "serve_failover",
                                                       "serve_fair",
-                                                      "serve_spectral"):
+                                                      "serve_spectral",
+                                                      "serve_update"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
@@ -242,6 +255,8 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_serve_fair(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_spectral":
         return _normalize_serve_spectral(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_update":
+        return _normalize_serve_update(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "chaos":
         return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
@@ -497,6 +512,67 @@ def _normalize_serve_spectral(name: str, obj: dict,
     return out
 
 
+def _normalize_serve_update(name: str, obj: dict,
+                            rnd: Optional[int]) -> List[dict]:
+    """The round-20 incremental-maintenance A/B artifact: {"bench":
+    "serve_update", "platform", "nb", "rows": [{op, n, k, update,
+    refactor, speedup, model_flops, sync, ...}], "sync_totals", "ok"}
+    — one record per (op, n, k) row, k riding the batch series slot
+    (same discipline as serve_batched's B). A row that paid a full
+    refactor or a recompile for a served mutation fails schema
+    validation outright — that is a broken incremental-maintenance
+    claim, not a slow one; so does a delta sync that costs MORE than
+    the full re-transfer it exists to undercut."""
+    for k in ("platform", "nb", "rows", "sync_totals", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_update artifact "
+                              f"missing {k!r}")
+    rows = obj["rows"]
+    if not isinstance(rows, list) or not rows:
+        raise SchemaError(f"{name}: serve_update rows missing/empty")
+    out = []
+    for i, row in enumerate(rows):
+        for k in ("op", "m", "n", "k", "update", "refactor", "speedup",
+                  "model_flops", "sync", "new_compiles_after_warmup",
+                  "update_refactors"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[rows.{i}]: serve_update row missing {k!r}")
+        if row["op"] not in ("chol", "qr"):
+            raise SchemaError(f"{name}[rows.{i}]: serve_update op "
+                              f"{row['op']!r} not chol/qr")
+        if row["update_refactors"] != 0:
+            raise SchemaError(
+                f"{name}[rows.{i}]: {row['update_refactors']} full "
+                "refactors on the served-update path (the happy path "
+                "is O(n²k) incremental, never a refactor)")
+        if row["new_compiles_after_warmup"] != 0:
+            raise SchemaError(
+                f"{name}[rows.{i}]: serve_update recorded "
+                f"{row['new_compiles_after_warmup']} compiles after "
+                "warmup (every rank bucket must be pre-compiled)")
+        sync = row["sync"]
+        if not isinstance(sync, dict) or "delta_bytes" not in sync \
+                or "full_bytes" not in sync:
+            raise SchemaError(f"{name}[rows.{i}]: serve_update sync "
+                              "split missing delta/full bytes")
+        if sync["delta_bytes"] > sync["full_bytes"]:
+            raise SchemaError(
+                f"{name}[rows.{i}]: delta sync "
+                f"({sync['delta_bytes']}B) costs more than the full "
+                f"re-transfer ({sync['full_bytes']}B)")
+        out.append({
+            "round": rnd,
+            "source": f"{name}[{row['op']}/n{row['n']}/k{row['k']}]",
+            "kind": "serve_update",
+            "platform": str(obj["platform"]), "n": int(row["n"]),
+            "batch": int(row["k"]), "op": str(row["op"]),
+            "ok": bool(row.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_UPDATE),
+        })
+    return out
+
+
 def _normalize_chaos(name: str, obj: dict,
                      rnd: Optional[int]) -> List[dict]:
     """The round-14 chaos-soak artifact (tools/chaos_serve.py →
@@ -713,6 +789,36 @@ def _check_spectral_section(name: str, section) -> None:
                           "missing/empty")
 
 
+def _check_updates_section(name: str, section) -> None:
+    """Validate the round-20 serve-artifact ``updates`` section: the
+    incremental-maintenance structural columns — every mutation served
+    on the O(n²k) path (zero full refactors, zero new compiles after
+    warmup), nonzero update flops credited, and the exit-gated
+    verdict. A committed fixture whose resident pays a refactor per
+    served mutation is a broken incremental-maintenance claim."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: updates section is not an object")
+    for k in ("enabled", "op", "n", "k", "updates_applied",
+              "new_compiles_after_warmup", "update_refactors",
+              "refactors_during_updates", "update_flops",
+              "solve_rel_err", "ok"):
+        if k not in section:
+            raise SchemaError(f"{name}: updates section missing {k!r}")
+    if section["update_refactors"] != 0 \
+            or section["refactors_during_updates"] != 0:
+        raise SchemaError(
+            f"{name}: updates section recorded a full refactor on the "
+            "served-update path (the happy path is incremental)")
+    if section["new_compiles_after_warmup"] != 0:
+        raise SchemaError(
+            f"{name}: updates section recorded "
+            f"{section['new_compiles_after_warmup']} compiles after "
+            "warmup (the rank bucket must be pre-compiled)")
+    if not section["update_flops"] > 0:
+        raise SchemaError(f"{name}: updates section credited no "
+                          "update flops to the ledger")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -744,6 +850,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         _check_numerics_section(name, obj["numerics"])
         _check_quotas_section(name, obj["quotas"])
         _check_spectral_section(name, obj["spectral"])
+        _check_updates_section(name, obj["updates"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -816,6 +923,7 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_FAILOVER_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_FAIR_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_SPECTRAL_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_UPDATE_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
@@ -896,8 +1004,11 @@ def _direction(metric: str) -> str:
     failover columns) — classified here so a future artifact exporting
     a latency series cannot silently enter the baseline with an
     inverted direction (the watchdog would then read a 10× p99 rise as
-    an improvement)."""
-    if metric.startswith("residual_") or "latency" in metric \
+    an improvement). The round-20 ``sync.*`` columns (delta-vs-full
+    replica transfer bytes and their ratio) are transfer COSTS —
+    lower-is-better by the same rule."""
+    if metric.startswith("residual_") or metric.startswith("sync.") \
+            or "latency" in metric \
             or "age_s" in metric or "recovery" in metric \
             or "failover" in metric or "refactor" in metric \
             or "quota" in metric:

@@ -1082,6 +1082,167 @@ def run_spectral_drill(seed):
     }
 
 
+def run_update_drill(seed):
+    """Incremental-maintenance reflex drill (round 20): every degrade
+    path of the update verb, deterministically.
+
+    (a) a seeded ``update_abort`` kills the rank-k sweep MID-UPDATE on
+        an SPD resident — the mutation must degrade to a COUNTED
+        refactor of the committed post-mutation operand, the next
+        solve must be residual-correct (the refactor is the authority,
+        never a half-swept factor), and the NEXT update (fault budget
+        spent) must run clean on the incremental path;
+    (b) an indefinite downdate (A − W·Wᴴ loses positive definiteness)
+        must be counted in ``update_downdate_failures_total``, and the
+        subsequent solve must RAISE — the authoritative refactor
+        reports the indefiniteness: detected, never served;
+    (c) a fleet update under a seeded ``replica_stale`` must degrade
+        its replica sync to a counted FULL re-transfer that
+        re-establishes the delta base (the next update delta-syncs
+        again), with zero lost futures and every member answering
+        residual-correct on the POST-update operand."""
+    from slate_tpu.core.exceptions import SlateError
+    from slate_tpu.runtime import (FaultInjector, FaultPlan, FaultSpec,
+                                   Fleet, Session)
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed + 10)
+    n, nb = 32, 16
+    wrong = 0
+
+    # -- (a) injected mid-update abort -> counted refactor, right answer
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    sess = Session(faults=FaultInjector(FaultPlan(seed=seed, specs=(
+        FaultSpec("update_abort", rate=1.0, count=1),))))
+    h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", handle="u0")
+    sess.factor(h)
+    w = (0.1 * rng.standard_normal((n, 2))).astype(np.float32)
+    out_abort = sess.update(h, w)
+    mutated = spd.astype(np.float64) + (w.astype(np.float64)
+                                        @ w.astype(np.float64).T)
+    b = rng.standard_normal(n).astype(np.float32)
+    wrong += int(_check_residual(mutated, sess.solve(h, b), b)
+                 > RESID_TOL)
+    ga = sess.metrics.get
+    abort_ok = (bool(out_abort["refactored"])
+                and out_abort.get("reason") == "abort"
+                and ga("update_aborts_total") == 1
+                and ga("update_refactors_total") == 1)
+    # the fault budget is spent: the next mutation serves incrementally
+    w2 = (0.1 * rng.standard_normal((n, 1))).astype(np.float32)
+    out_clean = sess.update(h, w2)
+    w264 = w2.astype(np.float64)
+    mutated = mutated + w264 @ w264.T
+    wrong += int(_check_residual(mutated, sess.solve(h, b), b)
+                 > RESID_TOL)
+    clean_ok = (bool(out_clean["applied"])
+                and ga("update_refactors_total") == 1)
+    cons_a = _conservation(sess.metrics)
+
+    # -- (b) indefinite downdate: counted, detected, never served ------
+    a2 = rng.standard_normal((n, n)).astype(np.float32)
+    spd2 = (a2 @ a2.T + n * np.eye(n)).astype(np.float32)
+    sess_b = Session()
+    hb = sess_b.register(st.hermitian(np.tril(spd2), nb=nb,
+                                      uplo=st.Uplo.Lower),
+                         op="chol", handle="u1")
+    sess_b.factor(hb)
+    out_dd = sess_b.update(
+        hb, (10.0 * rng.standard_normal((n, 2))).astype(np.float32),
+        downdate=True)
+    gb = sess_b.metrics.get
+    downdate_counted = (bool(out_dd["refactored"])
+                        and out_dd.get("reason") == "downdate_indefinite"
+                        and gb("update_downdate_failures_total") == 1)
+    refused = False
+    try:
+        sess_b.solve(hb, b)
+    except SlateError:
+        refused = True
+    cons_b = _conservation(sess_b.metrics)
+
+    # -- (c) stale replica base -> counted full re-transfer ------------
+    inj = FaultInjector(FaultPlan(seed=seed, specs=(
+        FaultSpec("replica_stale", rate=1.0, count=1),)))
+    fleet = Fleet({"p0": Session(), "p1": Session()},
+                  max_batch=4, max_wait=3600.0, faults=inj)
+    a3 = rng.standard_normal((n, n)).astype(np.float32)
+    spd3 = (a3 @ a3.T + n * np.eye(n)).astype(np.float32)
+    fleet.register(st.hermitian(np.tril(spd3), nb=nb,
+                                uplo=st.Uplo.Lower),
+                   op="chol", handle="u2", member="p0")
+    fleet.member("p0").factor("u2")
+    fleet.replicate("u2")
+    futs = []
+    for _ in range(4):
+        bq = rng.standard_normal(n).astype(np.float32)
+        futs.append((fleet.submit("u2", bq), bq))
+    fleet.flush()
+    w3 = (0.1 * rng.standard_normal((n, 1))).astype(np.float32)
+    fleet.update("u2", w3)  # the stale fault forces the full path
+    gf = fleet.metrics.get
+    stale_counted = (gf("fleet_delta_base_stale_total") == 1
+                     and gf("fleet_full_replications_total") == 1)
+    w364 = w3.astype(np.float64)
+    mutated3 = spd3.astype(np.float64) + w364 @ w364.T
+    for name in ("p0", "p1"):
+        member = fleet.member(name)
+        if "u2" in member:
+            wrong += int(_check_residual(mutated3,
+                                         member.solve("u2", b), b)
+                         > RESID_TOL)
+    # base re-established by the full transfer: delta path again
+    w4 = (0.1 * rng.standard_normal((n, 1))).astype(np.float32)
+    fleet.update("u2", w4)
+    delta_resumed = gf("fleet_delta_replications_total") >= 1
+    w464 = w4.astype(np.float64)
+    mutated3 = mutated3 + w464 @ w464.T
+    for _ in range(4):
+        bq = rng.standard_normal(n).astype(np.float32)
+        futs.append((fleet.submit("u2", bq), bq))
+    fleet.flush()
+    lost = sum(1 for f, _ in futs if not f.done())
+    for f, bq in futs[4:]:
+        if f.done() and f.exception() is None:
+            wrong += int(_check_residual(mutated3, f.result(), bq)
+                         > RESID_TOL)
+    cons_c = {m: _conservation(fleet.member(m).metrics)
+              for m in fleet.alive()}
+    fleet.close()
+
+    return {
+        "abort": {"result": {k: out_abort.get(k) for k in
+                             ("applied", "refactored", "reason")},
+                  "counted": abort_ok,
+                  "next_update_incremental": clean_ok},
+        "downdate": {"result": {k: out_dd.get(k) for k in
+                                ("applied", "refactored", "reason")},
+                     "counted": downdate_counted,
+                     "solve_refused": refused},
+        "stale_replica": {"counted_full_retransfer": stale_counted,
+                          "delta_path_resumed": delta_resumed,
+                          "delta_sync_bytes":
+                          gf("fleet_delta_sync_bytes"),
+                          "full_sync_bytes":
+                          gf("fleet_full_sync_bytes")},
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "conservation": {
+            "session": cons_a, "downdate_session": cons_b,
+            "per_member": cons_c,
+            "ok": (cons_a["ok"] and cons_b["ok"]
+                   and all(c["ok"] for c in cons_c.values()))},
+        "ok": (abort_ok and clean_ok and downdate_counted and refused
+               and stale_counted and delta_resumed
+               and wrong == 0 and lost == 0
+               and cons_a["ok"] and cons_b["ok"]
+               and all(c["ok"] for c in cons_c.values())),
+    }
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -1093,6 +1254,7 @@ def run_all(seed, waves):
     noisy, inj_n = run_noisy_drill(seed)
     migration, inj_g = run_migration_drill(seed)
     spectral = run_spectral_drill(seed)
+    update = run_update_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
                            for i in (inj, inj_b, inj_m, inj_r,
@@ -1109,7 +1271,8 @@ def run_all(seed, waves):
             "recovery_drill": recovery,
             "noisy_drill": noisy,
             "migration_drill": migration,
-            "spectral_drill": spectral}, schedule
+            "spectral_drill": spectral,
+            "update_drill": update}, schedule
 
 
 def main(argv=None):
@@ -1149,6 +1312,7 @@ def main(argv=None):
     enabled += [s.kind for s in recovery_plan(args.seed).specs
                 if s.rate > 0 and s.kind not in enabled]
     enabled.append("migration_abort")  # run_migration_drill's plan
+    enabled.append("update_abort")  # run_update_drill's plan
     invariants = {
         "wrong_answers": sum(ph.get("wrong_answers", 0)
                              for ph in phases.values()),
@@ -1185,6 +1349,14 @@ def main(argv=None):
         # (Λ shifted by 10‖A‖ after factoring) is caught by the
         # one-gemm residual probe and demoted to suspect
         "spectral_resident_survives": phases["spectral_drill"]["ok"],
+        # round 20: every degrade path of the update verb is a COUNTED
+        # refactor with a correct answer — an injected mid-update abort
+        # refactors the committed post-mutation operand (and the next
+        # update is incremental again), an indefinite downdate is
+        # detected and never served, and a stale replica base degrades
+        # the delta sync to a counted full re-transfer that puts the
+        # fleet back on the delta path
+        "update_degrades_counted": phases["update_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0
