@@ -125,17 +125,18 @@ def bench_gemm(n=8192, nb=512, dtype=jnp.float32, precision=None):
     return model_flops.gemm(n, n, n) / 1e9 / t, t
 
 
-def bench_potrf(n=8192, nb=1024, dtype=jnp.float32):
+def bench_potrf(n=8192, nb=1024, dtype=jnp.float32, opts=None):
     import slate_tpu as st
-    from slate_tpu.core.types import Uplo
+    from slate_tpu.core.types import Options, Uplo
     from slate_tpu.matgen import random_spd
 
     a = random_spd(n, dtype=dtype, seed=3)
     A = st.hermitian(jnp.tril(a), nb=nb, uplo=Uplo.Lower)
+    opts = opts or Options()
 
     def step(a_data, cs):
         (A,) = cs
-        L, _ = st.potrf(A.with_data(a_data))
+        L, _ = st.potrf(A.with_data(a_data), opts)
         # tiny L-dependent perturbation keeps the chain live without
         # changing the factored matrix materially
         return a_data + 1e-30 * L.data
@@ -172,16 +173,18 @@ def bench_getrf_calu(n=8192, nb=1024, dtype=jnp.float32):
                        opts=Options(method_lu=MethodLU.CALU))
 
 
-def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32):
+def bench_geqrf(n=8192, nb=1024, dtype=jnp.float32, opts=None):
     import slate_tpu as st
+    from slate_tpu.core.types import Options
     from slate_tpu.matgen import generate_matrix
 
     a = generate_matrix("randn", n, n, dtype, seed=5)
     A = st.from_dense(a, nb=nb)
+    opts = opts or Options()
 
     def step(a_data, cs):
         (A,) = cs
-        qr = st.geqrf(A.with_data(a_data))
+        qr = st.geqrf(A.with_data(a_data), opts)
         return a_data + 1e-30 * qr.vr
 
     t = _per_iter_seconds(step, A.data, (A,), k1=2, k2=6)
@@ -746,6 +749,15 @@ def main():
     ap.add_argument("--out", default=None,
                     help="also write the full JSON object to this file "
                          "(BENCH_*.json artifact, schema per PERF.md)")
+    ap.add_argument("--tuning", default=None, nargs="?",
+                    const="TUNING_r01.json", metavar="PATH",
+                    help="measure through a tuning table (round 21): "
+                         "activates PATH (bare flag: the committed "
+                         "TUNING_r01.json) process-globally — the "
+                         "batched small engine resolves nb/quantum "
+                         "through it — and applies each dense op's "
+                         "resolved inner_blocking/lookahead to its "
+                         "bench; provenance recorded in the artifact")
     args = ap.parse_args()
 
     cpu_fallback = bool(os.environ.get("_SLATE_TPU_BENCH_CPU"))
@@ -780,6 +792,8 @@ def main():
                 flags += ["--eig-n", str(args.eig_n)]
             if args.out:
                 flags += ["--out", args.out]
+            if args.tuning:
+                flags += ["--tuning", args.tuning]
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "1024"]
                 + flags, env=env)
@@ -793,6 +807,26 @@ def main():
     # 16 GiB of one v5e chip (n=32768 factorization-only numbers are in
     # PERF.md — a 32768² fp32 gemm needs ~70 GiB of operands)
     n = args.n
+    # round 21: measure through a tuning table — activate it (the
+    # batched small engine resolves through the process-global seam)
+    # and resolve each dense op's Options up front; provenance lands
+    # in the artifact so a tuned number can never masquerade as a
+    # default-config one
+    tuned_opts, tuned_prov = {}, {}
+    if args.tuning:
+        from slate_tpu import tuning as tn
+        from slate_tpu.core.types import Options
+        table = tn.TuningTable.from_path(args.tuning)
+        tn.activate_table(table)
+        backend = jax.default_backend()
+        for opn in ("chol", "lu", "qr"):
+            cfg = table.resolve(opn, n, "float32", backend)
+            if cfg is not None:
+                tuned_opts[opn] = cfg.apply(Options())
+                tuned_prov[opn] = cfg.label()
+        print(f"# tuning table {args.tuning}: resolved "
+              f"{tuned_prov or 'nothing for this platform/size'}",
+              file=sys.stderr)
     gemm_gflops, gemm_t = bench_gemm(n=n)
     print(f"# gemm   n={n} fp32: {gemm_gflops:9.1f} GFLOP/s  ({gemm_t*1e3:.1f} ms/iter)",
           file=sys.stderr)
@@ -808,11 +842,15 @@ def main():
     except Exception as e:
         gemm_hi = None
         print(f"# gemm(high) skipped: {e}", file=sys.stderr)
+    op_of = {"potrf": "chol", "getrf": "lu", "geqrf": "qr"}
     for name, fn in (("potrf", bench_potrf), ("getrf", bench_getrf),
                      ("getrf_calu", bench_getrf_calu),
                      ("geqrf", bench_geqrf)):
         try:
-            gflops, t = fn(n=n)
+            kw = {}
+            if tuned_opts.get(op_of.get(name)) is not None:
+                kw["opts"] = tuned_opts[op_of[name]]
+            gflops, t = fn(n=n, **kw)
             routine_secs[name] = t
             extra[f"{name}_gflops"] = round(gflops, 1)
             extra[f"{name}_pct_of_gemm"] = round(100 * gflops / gemm_gflops, 1)
@@ -902,6 +940,8 @@ def main():
         "vs_baseline": round(gemm_gflops / BASELINE_GFLOPS_PER_CHIP, 2),
         **extra,
     }
+    if args.tuning:
+        out["tuning"] = {"table": args.tuning, "resolved": tuned_prov}
     # the trajectory gate (tools/bench_gate.py) groups series by
     # platform; record it on EVERY artifact (it used to be written only
     # on the cpu-fallback path, which left TPU rounds ungateable)

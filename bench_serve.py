@@ -73,7 +73,7 @@ apply_env_platforms()
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas", "spectral", "updates")
+    "tenants", "numerics", "quotas", "spectral", "updates", "tuning")
 
 
 def _tenants_section(sess):
@@ -261,6 +261,67 @@ def _updates_section(sess, dtype):
     }
 
 
+def _tuning_section(sess, dtype):
+    """The serve artifact's round-21 ``tuning`` section: structural
+    evidence the committed tuning table wires end to end — the table
+    loads and validates, a fresh operator registered through it
+    resolves its config with provenance recorded on the entry, and a
+    warmed tuned solve adds NO compiles on the serve path (exit-gated
+    ok). Runs after the timed window (the headline serve numbers stay
+    table-free — the A/B that measures the table is ``--tuned``); the
+    table activation is restored before returning so the rest of the
+    artifact build sees the untuned process state."""
+    import slate_tpu as st
+    from slate_tpu import tuning as tn
+
+    path = tn.table_path()
+    if not os.path.exists(path):
+        return {"enabled": False, "table": None, "resolved": None,
+                "new_compiles_after_warmup": None, "ok": True}
+    import jax
+    table = tn.TuningTable.from_path(path)
+    backend = jax.default_backend()
+    platform_row = any(e.get("platform") in ("*", backend)
+                       for e in table.entries)
+    prev_tbl = tn.activate_table(table)
+    prev_sess = sess.tuning
+    sess.tuning = table
+    try:
+        ns, nbs = 32, 8
+        rng = np.random.default_rng(21)
+        a = rng.standard_normal((ns, ns)).astype(dtype)
+        spd = a @ a.T + ns * np.eye(ns, dtype=dtype)
+        A = st.hermitian(np.tril(spd), nb=nbs, uplo=st.Uplo.Lower)
+        h = sess.register(A, op="chol", tenant="bench-a")
+        resolved = sess._ops[h].tuned
+        sess.warmup(h)
+        nc0 = len(sess.compile_log)
+        b = rng.standard_normal(ns).astype(dtype)
+        x = sess.solve(h, b, tenant="bench-a")
+        new_compiles = len(sess.compile_log) - nc0
+        xd = np.linalg.solve(spd.astype(np.float64),
+                             b.astype(np.float64))
+        rel = float(np.abs(np.asarray(x, np.float64).ravel() - xd).max()
+                    / max(np.abs(xd).max(), 1.0))
+        ok = (new_compiles == 0 and rel < 1e-3
+              and (resolved is not None or not platform_row))
+        return {
+            "enabled": True,
+            "table": {"file": os.path.basename(path),
+                      "schema": tn.TUNING_SCHEMA,
+                      "entries": len(table.entries),
+                      "platform_match": platform_row},
+            "resolved": resolved,
+            "op": "chol", "n": ns,
+            "new_compiles_after_warmup": new_compiles,
+            "solve_rel_err": rel,
+            "ok": ok,
+        }
+    finally:
+        sess.tuning = prev_sess
+        tn.activate_table(prev_tbl)
+
+
 def _build_operator(n, nb, dtype):
     import slate_tpu as st
 
@@ -344,6 +405,10 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # runs after the timed window, before the tenants/numerics
     # sections are built (its handle, updates and probes fold in)
     updates_section = _updates_section(sess, dtype)
+    # round 21: the tuning-table structural exercise — committed table
+    # loads, register-time resolution records provenance, warmed tuned
+    # solve adds zero compiles; the timed window above stays table-free
+    tuning_section = _tuning_section(sess, dtype)
     artifact = {
         "bench": "serve",
         "backend": jax.devices()[0].platform,
@@ -403,6 +468,12 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # zero full refactors and zero new compiles after warmup,
         # plus the post-mutation solve accuracy check (exit-gated)
         "updates": updates_section,
+        # round 21: the tuning-table structural view — the committed
+        # TUNING_r01.json loads, a registered operator resolves its
+        # config with provenance, and the warmed tuned solve path
+        # compiles nothing new (exit-gated; the measured tuned-vs-
+        # default A/B is the separate --tuned artifact)
+        "tuning": tuning_section,
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -1675,6 +1746,113 @@ def bench_updates(sizes=(64, 128, 256, 512), ks=(1, 4, 16), nb=32,
     return artifact
 
 
+def bench_tuned(sizes=(64, 128), nb=32, requests=32, dtype=np.float32,
+                ops=("chol", "lu"), table=None,
+                out_path="BENCH_TUNED_r01.json"):
+    """Tuned-vs-default serving A/B (round 21): the same resident-
+    factor serve through a default Session and through one constructed
+    with the committed tuning table. One row per (op, n): both arms'
+    solves/sec, both arms' compile counts (warmup compiles recorded,
+    new-compiles-after-warmup exit-gated ZERO — the table must never
+    put compilation back on the serve path), and the tuned arm's
+    resolved config provenance. The throughput pair on CPU is smoke —
+    dispatch-noise-dominated like every serve number this repo
+    measures on a host CPU (the platform stamp keeps it informational
+    in the gate); the structural columns are the portable claim."""
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu import tuning as tn
+
+    platform = jax.devices()[0].platform
+    from slate_tpu.runtime import Session
+
+    table = tn.TuningTable.from_path() if table is None else table
+    rng = np.random.default_rng(29)
+    rows = []
+
+    def _arm(sess, A, op, n, dense):
+        h = sess.register(A, op=op)
+        resolved = sess._ops[h].tuned
+        sess.warmup(h)
+        warm_compiles = len(sess.compile_log)
+        nc0 = warm_compiles
+        bs = [rng.standard_normal(n).astype(dtype)
+              for _ in range(requests)]
+        xs = []
+        t0 = time.perf_counter()
+        for b in bs:
+            xs.append(sess.solve(h, b))
+        wall = time.perf_counter() - t0
+        new_compiles = len(sess.compile_log) - nc0
+        xd = np.linalg.solve(dense.astype(np.float64),
+                             bs[-1].astype(np.float64))
+        rel = float(np.abs(np.asarray(xs[-1], np.float64).ravel()
+                           - xd).max() / max(np.abs(xd).max(), 1.0))
+        return {
+            "solves_per_sec": requests / wall,
+            "warmup_compiles": warm_compiles,
+            "new_compiles_after_warmup": new_compiles,
+            "config": resolved,
+            "rel_err": rel,
+        }
+
+    for op in ops:
+        for n in sizes:
+            a = rng.standard_normal((n, n)).astype(dtype)
+            if op == "chol":
+                dense = a @ a.T + n * np.eye(n, dtype=dtype)
+                A = st.hermitian(np.tril(dense), nb=nb,
+                                 uplo=st.Uplo.Lower)
+            else:
+                dense = a + n * np.eye(n, dtype=dtype)
+                A = st.from_dense(dense, nb=nb)
+            # default arm FIRST: Session(tuning=...) activates the
+            # process-global table, so the untuned measurement must
+            # complete before the tuned session exists
+            tn.activate_table(None)
+            default = _arm(Session(), A, op, n, dense)
+            tuned_sess = Session(tuning=table)
+            try:
+                tuned = _arm(tuned_sess, A, op, n, dense)
+            finally:
+                tn.activate_table(None)
+            tol = 1e-3 if np.dtype(dtype).itemsize <= 4 else 1e-8
+            rows.append({
+                "op": op, "n": n, "dtype": np.dtype(dtype).name,
+                "default": default, "tuned": tuned,
+                "speedup": (tuned["solves_per_sec"]
+                            / default["solves_per_sec"]),
+                "ok": (default["new_compiles_after_warmup"] == 0
+                       and tuned["new_compiles_after_warmup"] == 0
+                       and default["rel_err"] < tol
+                       and tuned["rel_err"] < tol),
+            })
+            print(f"# tuned A/B {op} n={n}: default "
+                  f"{default['solves_per_sec']:.1f}/s vs tuned "
+                  f"{tuned['solves_per_sec']:.1f}/s "
+                  f"({rows[-1]['speedup']:.2f}x, "
+                  f"config={tuned['config']})", file=sys.stderr)
+    artifact = {
+        "bench": "serve_tuned",
+        "platform": platform,
+        "dtype": np.dtype(dtype).name,
+        "requests": requests,
+        "table": {"file": tn.TUNING_FILENAME,
+                  "schema": tn.TUNING_SCHEMA,
+                  "entries": len(table.entries)},
+        "rows": rows,
+        "ok": all(r["ok"] for r in rows),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"bench": "serve_tuned", "platform": platform,
+                      "rows": len(rows), "ok": artifact["ok"]},
+                     sort_keys=True))
+    return artifact
+
+
 def _probe_device_count(timeout=90):
     """Default-backend device count, probed in a subprocess with a
     hard timeout — with the TPU tunnel down, jax.devices() hangs
@@ -1794,6 +1972,14 @@ def main(argv=None):
                         "delta sync undercuts full re-transfer (CPU "
                         "smoke, honestly labeled)")
     p.add_argument("--updates-out", default="BENCH_UPDATE_r01.json")
+    p.add_argument("--tuned", action="store_true",
+                   help="tuned-vs-default serving A/B (round 21): the "
+                        "same resident-factor serve through a default "
+                        "Session vs one built with the committed "
+                        "TUNING_r01.json; writes one serve_tuned row "
+                        "per (op, n) with both arms' solves/sec, "
+                        "compile counts, and config provenance")
+    p.add_argument("--tuned-out", default="BENCH_TUNED_r01.json")
     p.add_argument("--regen-smoke", action="store_true",
                    help="GUARDED regeneration of the committed "
                         "BENCH_SERVE_smoke.json fixture (+ .metrics."
@@ -1846,6 +2032,13 @@ def main(argv=None):
                                 out_path=args.updates_out)
         else:
             art = bench_updates(out_path=args.updates_out)
+        return 0 if art["ok"] else 1
+    if args.tuned:
+        if args.smoke:
+            art = bench_tuned(sizes=(48, 64), nb=16, requests=16,
+                              out_path=args.tuned_out)
+        else:
+            art = bench_tuned(out_path=args.tuned_out)
         return 0 if art["ok"] else 1
     if args.overload:
         art = bench_overload(out_path=args.overload_out)
@@ -1919,9 +2112,12 @@ def main(argv=None):
     # round 20: the updates section exit-gates too — a resident that
     # pays a full refactor (or a recompile) per served mutation is a
     # broken incremental-maintenance claim
+    # round 21: the tuning section exit-gates too — a committed table
+    # that stops loading, resolving, or serving compile-free is a
+    # broken tuning claim
     ok = (art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
           and art["numerics"]["ok"] and art["spectral"]["ok"]
-          and art["updates"]["ok"])
+          and art["updates"]["ok"] and art["tuning"]["ok"])
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

@@ -200,6 +200,20 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --updates FAILED")
+    # tuned-vs-default serving A/B smoke (round 21): both arms resolve
+    # through the committed TUNING_r01.json (tuned arm carries config
+    # provenance) — exits nonzero unless the tuned arm adds zero new
+    # compiles after warmup and both arms answer within tolerance (the
+    # structural claims; speedups are CPU smoke, gated only on TPU)
+    print("=== bench_serve.py --tuned --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--tuned", "--smoke",
+         "--tuned-out", "/tmp/BENCH_TUNED_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --tuned FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure —

@@ -214,10 +214,14 @@ class Options:
     # between the slab and the remainder, so the serial panel chain of
     # step k+1 carries no data edge to step k's remainder gemms and the
     # scheduler may interleave them (lookahead-1 — PLASMA/DPLASMA
-    # lineage puts most of the win there; depths > 1 are accepted but
-    # currently schedule as depth 1). 0 = the strictly sequential
+    # lineage puts most of the win there). 0 = the strictly sequential
     # round-6 schedule (bit-identical results; the reference arm for
-    # tests and A/B timing).
+    # tests and A/B timing). Depths > 1 CLAMP to 1 with a one-time
+    # warning at the driver consumption seam (normalize_lookahead,
+    # below): the pipeline implements depth 1, and round 21's
+    # autotuner must not search a dimension that is a no-op — the
+    # clamp (and its bit-identity to depth 1) is pinned in
+    # tests/test_tuning.py.
     lookahead: int = 1
     block_size: int = 256  # nb — tile size
     inner_blocking: int = 32  # ib — panel inner blocking
@@ -288,3 +292,38 @@ class Options:
 
 
 DEFAULT_OPTIONS = Options()
+
+# one-time-warning latch for normalize_lookahead (process-wide: the
+# point is not to spam a serving log once per solve)
+_LOOKAHEAD_WARNED = False
+
+
+def normalize_lookahead(depth: int) -> int:
+    """The effective pipeline depth for a requested ``lookahead``.
+
+    The round-7 pipeline implements depths 0 and 1; deeper requests
+    used to be silently scheduled as depth 1 (the old ``Options``
+    comment admitted it). Round 21 makes that contract explicit —
+    the autotuner must not search a dimension that is a no-op:
+    negative depths are rejected, depths > 1 CLAMP to 1 with a
+    one-time warning, and the clamped schedule is bit-identical to an
+    explicit depth-1 run (pinned in tests/test_tuning.py). Called at
+    the driver consumption seams (cholesky/lu/qr), so every entry
+    point — Options, tuning tables, direct kwargs — shares one rule.
+    """
+    global _LOOKAHEAD_WARNED
+    depth = int(depth)
+    if depth < 0:
+        raise ValueError(f"Options.lookahead must be >= 0, got {depth}")
+    if depth > 1:
+        if not _LOOKAHEAD_WARNED:
+            _LOOKAHEAD_WARNED = True
+            import warnings
+            warnings.warn(
+                f"Options.lookahead={depth} clamps to 1: the "
+                "factorization pipeline implements lookahead-1 "
+                "(PLASMA/DPLASMA lineage puts most of the win there); "
+                "deeper depths schedule identically. This warning is "
+                "emitted once per process.", stacklevel=2)
+        return 1
+    return depth

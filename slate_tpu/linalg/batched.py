@@ -92,9 +92,51 @@ def default_nb(n: int) -> int:
     return n if n <= DEFAULT_NB else DEFAULT_NB
 
 
-def batch_bucket(b: int) -> int:
-    """Smallest power of two ≥ b — the batch-dim compilation bucket."""
-    return blocked.bucket_pow2(max(int(b), 1), 1)
+def batch_bucket(b: int, quantum: int = 1) -> int:
+    """Smallest ``quantum``·2^i ≥ b — the batch-dim compilation
+    bucket. The default quantum 1 is the plain pow2 grid every round
+    since 10; a tuning table (round 21) may coarsen/offset it per
+    (op, n, dtype, platform) through :func:`resolved_quantum`."""
+    return blocked.bucket_pow2(max(int(b), 1), max(int(quantum), 1))
+
+
+# -- tuning-table consultation (round 21, slate_tpu/tuning/) ----------------
+# The bucket program cache is process-global, so its tuning seam is
+# too: tuning.activate_table() installs the table these resolvers
+# consult when a caller leaves nb unset. One `table is None` check
+# when disabled — with no active table, resolved_nb IS default_nb and
+# resolved_quantum IS 1, so every program key, pad shape, and served
+# bit matches the untuned tree (pinned in tests/test_tuning.py).
+
+
+def _tuned_cfg(op: str, n: int, dtype):
+    from ..tuning.table import active_table
+    t = active_table()
+    if t is None:
+        return None
+    return t.resolve(op, int(n), str(np.dtype(dtype)),
+                     jax.default_backend())
+
+
+def resolved_nb(op: str, n: int, dtype, nb: Optional[int] = None) -> int:
+    """The panel width for one small-engine call: the caller's
+    explicit nb wins, then the active table's first-match entry
+    (clamped to n — a panel wider than the problem is the whole
+    problem), then :func:`default_nb`."""
+    if nb is not None:
+        return nb
+    cfg = _tuned_cfg(op, n, dtype)
+    if cfg is not None and cfg.nb:
+        return min(int(cfg.nb), int(n))
+    return default_nb(n)
+
+
+def resolved_quantum(op: str, n: int, dtype) -> int:
+    """The batch-dim bucket quantum: the active table's
+    ``batch_quantum`` when one matches, else 1 (plain pow2)."""
+    cfg = _tuned_cfg(op, n, dtype)
+    return (1 if cfg is None or not cfg.batch_quantum
+            else max(1, int(cfg.batch_quantum)))
 
 
 # -- per-bucket compiled program cache --------------------------------------
@@ -374,9 +416,10 @@ def getrf_batched(A, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("getrf_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
-    ap = _pad_eye(a, batch_bucket(bsz))
-    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.getrf(n))
+    nb = resolved_nb("lu_small", n, a.dtype, nb)
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, a.dtype))
+    ap = _pad_eye(a, bb)
+    _credit_padding_flops(bb - bsz, _flops.getrf(n))
     lu, perm, info = _run_bucket("getrf_batched", _k_getrf, nb, ap,
                                  live_batch=bsz)
     return lu[:bsz], perm[:bsz], info[:bsz]
@@ -389,9 +432,10 @@ def potrf_batched(A, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("potrf_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
-    ap = _pad_eye(a, batch_bucket(bsz))
-    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.potrf(n))
+    nb = resolved_nb("chol_small", n, a.dtype, nb)
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, a.dtype))
+    ap = _pad_eye(a, bb)
+    _credit_padding_flops(bb - bsz, _flops.potrf(n))
     l, info = _run_bucket("potrf_batched", _k_potrf, nb, ap,
                           live_batch=bsz)
     return l[:bsz], info[:bsz]
@@ -404,9 +448,10 @@ def geqrf_batched(A, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m < n:
         raise SlateError("geqrf_batched: items must have m >= n")
-    nb = default_nb(n) if nb is None else nb
-    ap = _pad_eye(a, batch_bucket(bsz))
-    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.geqrf(m, n))
+    nb = resolved_nb("qr_small", n, a.dtype, nb)
+    bb = batch_bucket(bsz, resolved_quantum("qr_small", n, a.dtype))
+    ap = _pad_eye(a, bb)
+    _credit_padding_flops(bb - bsz, _flops.geqrf(m, n))
     vr, taus, ts = _run_bucket("geqrf_batched", _k_geqrf, nb, ap,
                                live_batch=bsz)
     return vr[:bsz], taus[:bsz], ts[:bsz]
@@ -420,7 +465,7 @@ def getrs_batched(LU, perm, B):
     lu = _as_stack(LU, "getrs_batched")
     bsz, n, _ = lu.shape
     b, vector, k = _rhs_stack(B, bsz, n, lu.dtype, "getrs_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, lu.dtype))
     _credit_padding_flops(bb - bsz,
                           _flops.solve_flops("lu", n, n, int(b.shape[2])))
     x = _run_bucket("getrs_batched", _k_getrs, 0, _pad_eye(lu, bb),
@@ -435,7 +480,7 @@ def potrs_batched(L, B):
     l = _as_stack(L, "potrs_batched")
     bsz, n, _ = l.shape
     b, vector, k = _rhs_stack(B, bsz, n, l.dtype, "potrs_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, l.dtype))
     _credit_padding_flops(bb - bsz,
                           _flops.solve_flops("chol", n, n,
                                              int(b.shape[2])))
@@ -455,7 +500,7 @@ def gels_batched_using_factor(VR, taus, Ts, B, nb: Optional[int] = None):
     nb = int(ts.shape[-1]) if nb is None else nb
     b, vector, k = _rhs_stack(B, bsz, m, vr.dtype,
                               "gels_batched_using_factor")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("qr_small", n, vr.dtype))
     _credit_padding_flops(bb - bsz,
                           _flops.solve_flops("qr", m, n,
                                              int(b.shape[2])))
@@ -477,9 +522,9 @@ def gesv_batched(A, B, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("gesv_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("lu_small", n, a.dtype, nb)
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "gesv_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz,
         _flops.getrf(n) + _flops.solve_flops("lu", n, n,
@@ -497,9 +542,9 @@ def posv_batched(A, B, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("posv_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("chol_small", n, a.dtype, nb)
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "posv_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz,
         _flops.potrf(n) + _flops.solve_flops("chol", n, n,
@@ -610,11 +655,12 @@ def getrf_mixed_batched(A, factor_dtype="bfloat16",
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("getrf_mixed_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("lu_small", n, a.dtype, nb)
     lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
                             "getrf_mixed_batched")
-    ap = _pad_eye(a, batch_bucket(bsz))
-    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.getrf(n))
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, a.dtype))
+    ap = _pad_eye(a, bb)
+    _credit_padding_flops(bb - bsz, _flops.getrf(n))
     lu, perm, info = _run_bucket(
         f"getrf_mixed_batched[{lo}]",
         functools.partial(_k_getrf_mixed, lo=_jax_dtype(lo)), nb, ap,
@@ -629,11 +675,12 @@ def potrf_mixed_batched(A, factor_dtype="bfloat16",
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("potrf_mixed_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("chol_small", n, a.dtype, nb)
     lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
                             "potrf_mixed_batched")
-    ap = _pad_eye(a, batch_bucket(bsz))
-    _credit_padding_flops(batch_bucket(bsz) - bsz, _flops.potrf(n))
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, a.dtype))
+    ap = _pad_eye(a, bb)
+    _credit_padding_flops(bb - bsz, _flops.potrf(n))
     l, info = _run_bucket(
         f"potrf_mixed_batched[{lo}]",
         functools.partial(_k_potrf_mixed, lo=_jax_dtype(lo)), nb, ap,
@@ -652,7 +699,7 @@ def getrs_refined_batched(A, LU_lo, perm, B, max_iters: int = 30,
     lu = _as_stack(LU_lo, "getrs_refined_batched")
     bsz, n, _ = a.shape
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "getrs_refined_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz, _flops.solve_flops("lu", n, n, int(b.shape[2])))
     name = (f"getrs_refined_batched[{_dtype_name(lu.dtype)},"
@@ -675,7 +722,7 @@ def potrs_refined_batched(A, L_lo, B, max_iters: int = 30,
     l = _as_stack(L_lo, "potrs_refined_batched")
     bsz, n, _ = a.shape
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "potrs_refined_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz, _flops.solve_flops("chol", n, n, int(b.shape[2])))
     name = (f"potrs_refined_batched[{_dtype_name(l.dtype)},"
@@ -700,11 +747,11 @@ def gesv_mixed_batched(A, B, nb: Optional[int] = None,
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("gesv_mixed_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("lu_small", n, a.dtype, nb)
     lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
                             "gesv_mixed_batched")
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "gesv_mixed_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("lu_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz,
         _flops.getrf(n) + _flops.solve_flops("lu", n, n,
@@ -731,11 +778,11 @@ def posv_mixed_batched(A, B, nb: Optional[int] = None,
     bsz, m, n = a.shape
     if m != n:
         raise SlateError("posv_mixed_batched: items must be square")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("chol_small", n, a.dtype, nb)
     lo = _guard_mixed_dtype(a.dtype, _dtype_name(factor_dtype),
                             "posv_mixed_batched")
     b, vector, k = _rhs_stack(B, bsz, n, a.dtype, "posv_mixed_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("chol_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz,
         _flops.potrf(n) + _flops.solve_flops("chol", n, n,
@@ -759,9 +806,9 @@ def gels_batched(A, B, nb: Optional[int] = None):
     bsz, m, n = a.shape
     if m < n:
         raise SlateError("gels_batched: items must have m >= n")
-    nb = default_nb(n) if nb is None else nb
+    nb = resolved_nb("qr_small", n, a.dtype, nb)
     b, vector, k = _rhs_stack(B, bsz, m, a.dtype, "gels_batched")
-    bb = batch_bucket(bsz)
+    bb = batch_bucket(bsz, resolved_quantum("qr_small", n, a.dtype))
     _credit_padding_flops(
         bb - bsz,
         _flops.geqrf(m, n) + _flops.solve_flops("qr", m, n,

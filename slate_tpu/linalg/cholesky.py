@@ -48,7 +48,7 @@ import jax.numpy as jnp
 from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, Norm, Options, Side, Uplo,
-                          DEFAULT_OPTIONS)
+                          DEFAULT_OPTIONS, normalize_lookahead)
 from ..core.precision import accurate_matmuls
 from ..ops import blocked, tile_ops
 from . import blas3
@@ -305,7 +305,8 @@ def potrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
     with blocked.distribute_on(A.grid):
         lower, info = _potrf_blocked(a, nb, nt, prec=opts.update_precision,
                                      iter_large=opts.factor_iter_large,
-                                     lookahead=opts.lookahead)
+                                     lookahead=normalize_lookahead(
+                                         opts.lookahead))
     if A.uplo is Uplo.Upper:
         out = from_dense(jnp.conj(lower).T, nb, grid=A.grid,
                          kind=MatrixKind.Triangular, uplo=Uplo.Upper,

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from ..core.exceptions import SlateError
 from ..core.tiled_matrix import TiledMatrix, from_dense, unit_pad_diag
 from ..core.types import (Diag, MatrixKind, MethodLU, Norm, Options, Side,
-                          Uplo, DEFAULT_OPTIONS)
+                          Uplo, DEFAULT_OPTIONS, normalize_lookahead)
 from ..core.precision import accurate_matmuls
 from ..ops import blocked
 from . import blas3
@@ -417,7 +417,7 @@ def getrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS
             threshold=opts.pivot_threshold,
             fused=opts.lu_pivot_fusion,
             iter_large=opts.factor_iter_large,
-            lookahead=opts.lookahead,
+            lookahead=normalize_lookahead(opts.lookahead),
             tournament_batched=opts.lu_tournament_batched)
     out = from_dense(lu, A.nb, grid=A.grid, logical_shape=(m, n))
     return out, perm, info

@@ -39,7 +39,7 @@ from ..core.exceptions import SlateError
 from ..core.tiled_matrix import (TiledMatrix, from_dense, triangular,
                                  unit_pad_diag)
 from ..core.types import (Diag, MatrixKind, MethodGels, Norm, Options, Side,
-                          Uplo, DEFAULT_OPTIONS)
+                          Uplo, DEFAULT_OPTIONS, normalize_lookahead)
 from ..core.precision import accurate_matmuls
 from ..ops import blocked
 from . import blas3
@@ -143,7 +143,7 @@ def geqrf(A: TiledMatrix, opts: Options = DEFAULT_OPTIONS) -> QRFactors:
     m, n = A.shape
     nb = A.nb
     prec = opts.update_precision
-    lookahead = opts.lookahead
+    lookahead = normalize_lookahead(opts.lookahead)
     a = A.dense_canonical()
     a = _pad_identity_diag(a, m, n)
     mpad, npad = a.shape

@@ -267,6 +267,28 @@ def program_costs(compiled) -> ProgramCosts:
     return pc
 
 
+def score_measured(model_flops: Optional[float], seconds: float,
+                   bytes_accessed: Optional[float] = None,
+                   machine=None) -> dict:
+    """Join ONE measured slope-timed row with the program's
+    compile-time cost analysis (the round-21 autotune scorer): always
+    the measured GFLOP/s against the model-flop numerator; the
+    arithmetic intensity when the backend reported bytes-accessed; and
+    the roofline fraction/bound whenever a MachineModel is configured
+    (``machine=`` or the SLATE_TPU_PEAK_GFLOPS/HBM_GBPS env — the
+    round-9 roofline substrate, reused verbatim). CPU-smoke rows
+    typically score gflops-only (XLA:CPU reports no byte analysis and
+    no machine model is set) — honest degradation, the bench_gate
+    platform policy."""
+    from .roofline import MachineModel, roofline_row
+    if machine is None:
+        machine = MachineModel.from_env()
+    row = roofline_row("tuning.candidate", model_flops, bytes_accessed,
+                       seconds=seconds, machine=machine)
+    return {k: row[k] for k in ("gflops", "gbps", "intensity", "bound",
+                                "attainable_gflops", "roof_fraction")}
+
+
 # -- process-wide bytes ledger ----------------------------------------------
 
 

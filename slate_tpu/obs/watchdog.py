@@ -144,6 +144,18 @@ class Watchdog:
         # breach-transition discipline
         self._flagged: set = set()
         self.anomalies: List[dict] = []
+        # round 21: anomaly listeners — called once per NEWLY-flagged
+        # gated series (the same transition discipline as the counter)
+        # with the anomaly row dict. The online tuner's trigger seam:
+        # ShadowTuner.attach() registers here. Listener exceptions are
+        # swallowed (a broken consumer must never kill the check loop)
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(row)`` to be called on each ok -> anomalous
+        transition of a gated series (stdlib-only contract: ``row`` is
+        the plain anomaly dict ``check()`` reports)."""
+        self._listeners.append(fn)
 
     @property
     def series(self) -> Dict[_SeriesKey, dict]:
@@ -291,6 +303,11 @@ class Watchdog:
                 attrs = {("series_kind" if k == "kind" else k): v
                          for k, v in row.items() if v is not None}
                 tr.event("watchdog.anomaly", kind="anomaly", **attrs)
+            for fn in self._listeners:
+                try:
+                    fn(row)
+                except Exception:
+                    log.exception("watchdog listener failed")
 
 
 def _serve_roof_fraction(snap: dict) -> Optional[float]:

@@ -784,7 +784,13 @@ class Batcher:
                     # the Batcher's padded widths can never drift
                     # from the bucketing the rest of the repo primes
                     from ..ops.blocked import bucket_pow2
-                    w = bucket_pow2(cols, 1)
+                    # round 21: the width quantum comes through the
+                    # tuning table when one is active for this handle's
+                    # (op, n, dtype) — tuned_width_quantum is a single
+                    # `tuning is None` check returning 1 when disabled,
+                    # so the untuned pad grid is bit-identical to HEAD
+                    w = bucket_pow2(
+                        cols, self.session.tuned_width_quantum(handle))
                     if w > cols:
                         stacked = np.concatenate(
                             [stacked, np.zeros((stacked.shape[0],

@@ -109,6 +109,12 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # round 20: Session.update consults "update" once per update verb,
     # BEFORE the resident is touched (abort-before-commit semantics)
     "update": ("update_abort",),
+    # round 21: the shadow tuner consults "tuner.compile" once per
+    # shadow AOT compile — the stall sleeps there (off the request
+    # path, so live solves never feel it) and a transient dispatch
+    # failure rejects THAT shadow attempt (breaker-counted), never a
+    # live future
+    "tuner.compile": ("compile_stall", "dispatch_error"),
 }
 
 # The declared degradation ladder (tentpole): when a serving path keeps

@@ -214,6 +214,10 @@ class _Operator:
     # Reset by every fresh factor insert.
     updates: int = 0
     update_weight: float = 0.0
+    # tuned-config provenance (round 21): the tuning-table entry (or
+    # shadow-tuner promotion) whose knobs this operator's opts carry —
+    # the `tuned_config` span attr / cost_log column. None = defaults.
+    tuned: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -262,8 +266,25 @@ class Session:
                  refine_policies: Optional[PolicyTable] = None,
                  faults=None, attribution=None, numerics=None,
                  checkpoint_dir: Optional[str] = None,
-                 tenant_policies=None):
+                 tenant_policies=None, tuning=None):
         self.hbm_budget = hbm_budget
+        # autotuning table (round 21, slate_tpu/tuning/): a
+        # TuningTable / loaded doc / path, or True for the committed
+        # repo-root TUNING_r01.json. None = disabled — every
+        # consultation seam is ONE `tuning is None` check and with no
+        # table every solve is bit-identical to an untuned session
+        # (pinned). register() resolves each operator's
+        # nb/inner_blocking/lookahead through the table by first-match
+        # (op, n-bucket, dtype, platform); the resolved provenance
+        # rides span attrs and the cost_log as `tuned_config`. A
+        # session-held table is also ACTIVATED process-globally for
+        # the linalg/batched bucket cache (its programs are
+        # process-global, so its tuning seam is too — last activation
+        # wins; tuning.activate_table(None) restores defaults).
+        from .. import tuning as _tuning_mod
+        self.tuning = _tuning_mod.as_table(tuning)
+        if self.tuning is not None:
+            _tuning_mod.activate_table(self.tuning)
         # tenant isolation (round 18, runtime/tenancy.py): a
         # TenantTable (or {tenant: TenantPolicy} dict) declaring
         # per-tenant HBM sub-budgets (enforced here at the
@@ -992,6 +1013,22 @@ class Session:
                         "single-device dense operators; use "
                         "strategy='ir' for mesh or small-problem "
                         "operators")
+        eopts = opts or self.opts
+        tuned = None
+        if self.tuning is not None:
+            # round 21: first-match (op, n-bucket, dtype, platform)
+            # resolution — matched knobs land in THIS operator's opts
+            # (nb -> block_size, inner_blocking, lookahead) before any
+            # program is built, so warmup compiles the tuned program
+            # and the serve path after warmup is zero new compiles;
+            # unmatched operators keep their defaults (the documented
+            # fallback). One `tuning is None` check when disabled.
+            dt = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+            cfg = self.tuning.resolve(op, n, str(np.dtype(dt)),
+                                      jax.default_backend())
+            if cfg is not None:
+                eopts = cfg.apply(eopts)
+                tuned = cfg.label()
         with self._lock:
             if handle is None:
                 self._seq += 1
@@ -1002,10 +1039,38 @@ class Session:
                 raise SlateError(f"Session.register: handle {handle!r} "
                                  "already registered (unregister first)")
             self._ops[handle] = _Operator(
-                A, op, opts or self.opts, m, n, band, grid=grid,
+                A, op, eopts, m, n, band, grid=grid,
                 refine=policy,
-                tenant=None if tenant is None else str(tenant))
+                tenant=None if tenant is None else str(tenant),
+                tuned=tuned)
         return handle
+
+    def _resolve_tuned(self, entry: _Operator):
+        """The table's TunedConfig for one registered operator (None
+        without a table or match) — the shadow tuner's first ladder
+        rung and the register-time resolution, one vocabulary."""
+        if self.tuning is None:
+            return None
+        A = entry.A
+        dt = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+        return self.tuning.resolve(entry.op, entry.n, str(np.dtype(dt)),
+                                   jax.default_backend())
+
+    def tuned_width_quantum(self, handle: Hashable) -> int:
+        """The Batcher's rhs-width pad quantum for ``handle`` (round
+        21): the table's ``width_quantum`` when one matches, else 1 —
+        plain pow2 padding, bit-identical to the untuned tree."""
+        if self.tuning is None:
+            return 1
+        with self._lock:
+            entry = self._ops.get(handle)
+        if entry is None:
+            return 1
+        A = entry.A
+        dt = A.ab.dtype if isinstance(A, PackedBand) else A.dtype
+        return self.tuning.width_quantum(entry.op, entry.n,
+                                         str(np.dtype(dt)),
+                                         jax.default_backend())
 
     @staticmethod
     def _infer_op(A) -> str:
@@ -1703,6 +1768,11 @@ class Session:
         if entry.refine is not None:
             attrs["factor_dtype"] = entry.refine.factor_dtype
             attrs["refine_strategy"] = entry.refine.strategy
+        if entry.tuned is not None:
+            # round 21: which tuning-table row (or shadow promotion)
+            # configured this operator — attribution joins it per
+            # tenant, making tables workload-aware
+            attrs["tuned_config"] = entry.tuned
         return attrs
 
     def solve_matrix(self, handle: Hashable, B: TiledMatrix,
@@ -3752,7 +3822,8 @@ class Session:
                                     entry.band)
         self.cost_log.append({
             "op": entry.op, "what": what, "shape": shapes,
-            "model_flops": model_fl, **pc.to_dict(),
+            "model_flops": model_fl, "tuned_config": entry.tuned,
+            **pc.to_dict(),
         })
         self._cost_index[(entry.op, what)] = float(model_fl or 0.0)
         self._update_hbm_gauges()

@@ -1,0 +1,33 @@
+"""Cost-model-driven autotuning (round 21).
+
+Three halves of one loop:
+
+- ``search``: offline empirical config search (``tools/autotune.py``
+  drives it) — sweep (nb, inner_blocking, lookahead, wide-panel cell,
+  batch/width bucket quantum) per (op, pow2-n-bucket, dtype, platform),
+  AOT-compile each candidate once, slope-time it, score by joining the
+  measured rows against the round-9 cost/roofline substrate, and emit
+  the committed ``TUNING_r01.json``.
+- ``table``: consultation — first-match (op, n-bucket, dtype,
+  platform) resolution over the committed table, with documented
+  fallback to today's defaults; ``Session(tuning=...)`` and the
+  ``linalg/batched.py`` bucket cache resolve nb/lookahead/quanta
+  through it (one ``table is None`` check when disabled).
+- ``shadow``: online refinement — the round-12 watchdog flags a
+  regressed series, the :class:`ShadowTuner` shadow-compiles the
+  neighboring config off the request path, A/Bs measured device time,
+  and promotes only on a ≥10 % win (demotion on re-flag).
+"""
+
+from .table import (TUNING_FILENAME, TUNING_SCHEMA, TunedConfig,
+                    TuningTable, activate_table, active_table, as_table,
+                    table_path, validate_table)
+from .search import config_space, measure_config, run_search, slope_seconds
+from .shadow import ShadowTuner
+
+__all__ = [
+    "TUNING_FILENAME", "TUNING_SCHEMA", "TunedConfig", "TuningTable",
+    "activate_table", "active_table", "as_table", "table_path",
+    "validate_table", "config_space", "measure_config", "run_search",
+    "slope_seconds", "ShadowTuner",
+]

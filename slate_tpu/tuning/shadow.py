@@ -1,0 +1,422 @@
+"""Online shadow refinement: watchdog flag → shadow compile → A/B →
+promotion.
+
+The offline table (``tuning/table.py``) is only as good as the host it
+was searched on. This module closes the loop online: when the round-12
+:class:`~slate_tpu.obs.watchdog.Watchdog` flags a per-series
+regression, the :class:`ShadowTuner` schedules a *shadow* AOT compile
+of the neighboring config in the search space — OFF the request path
+(work happens only inside :meth:`poll`, which the deployment drives
+from idle capacity; a non-empty ``Batcher.backpressure()`` queue
+defers it), breaker-guarded (consecutive shadow failures open the
+breaker and stop further attempts), and faults-injectable (the
+``tuner.compile`` seam evaluates ``compile_stall`` and
+``dispatch_error`` — a fired error rejects THAT shadow attempt,
+counted, and can never fail a live future). The armed candidate is
+then A/B'd against the live config on N measured device-time probes of
+the factor program (the config-sensitive program; both arms execute
+the SAME registered operand and the results must agree before timing
+counts), and promoted only on a ≥ ``min_win`` (10 %) median win:
+
+    tuner_shadow_compiles_total   shadow programs built
+    tuner_promotions_total        candidates that won and took over
+    tuner_rejections_total        candidates that lost / failed / misagreed
+    tuner_demotions_total         promotions reverted on watchdog re-flag
+    tuner_breaker_open_total      breaker trips
+
+Promotion installs the candidate's executable under the session's own
+AOT cache key BEFORE swapping the entry's ``Options`` and evicting the
+resident, so the recovery refactor is zero new compiles; the promotion
+itself is a trace event (``tuner.promotion``). A watchdog re-flag of a
+promoted handle demotes it back to the previous config (the previous
+program is still cached — again zero new compiles).
+
+Dense operators only (chol/lu/qr): the small-problem engine's configs
+live in the process-global bucket cache and re-tune offline through
+the table; its quanta are not per-handle state a shadow can swap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Hashable, List, Optional
+
+from ..obs.tracing import log
+from .table import TunedConfig
+
+SHADOW_OPS = ("chol", "lu", "qr")
+DEFAULT_PROBES = 3
+DEFAULT_MIN_WIN = 0.10
+DEFAULT_BREAKER_LIMIT = 3
+
+
+@dataclasses.dataclass
+class _ShadowState:
+    """Per-handle tuner state (guarded by the tuner's own lock)."""
+
+    stage: str                      # flagged | armed | promoted
+    candidate_opts: object = None   # Options under evaluation
+    candidate_label: str = ""
+    exe: object = None              # the shadow-compiled executable
+    exe_key: object = None          # session AOT-cache key it lands under
+    prev_opts: object = None        # for demotion
+    prev_label: Optional[str] = None
+    tried: int = 0                  # ladder cursor
+
+
+class ShadowTuner:
+    """Wires a Session (+ optional Batcher for the idle gate) to the
+    watchdog's anomaly stream. ``attach(watchdog)`` subscribes;
+    :meth:`flag` is the direct entry for tests/drills. All real work
+    happens in :meth:`poll` — call it from idle capacity."""
+
+    def __init__(self, session, batcher=None,
+                 probes: int = DEFAULT_PROBES,
+                 min_win: float = DEFAULT_MIN_WIN,
+                 breaker_limit: int = DEFAULT_BREAKER_LIMIT):
+        self.session = session
+        self.batcher = batcher
+        self.probes = int(probes)
+        self.min_win = float(min_win)
+        self.breaker_limit = int(breaker_limit)
+        self._lock = threading.Lock()
+        self._states: Dict[Hashable, _ShadowState] = {}
+        self._failures = 0          # consecutive shadow failures
+        self.breaker_open = False
+        self.events: List[dict] = []
+
+    # -- the watchdog hookup -------------------------------------------------
+
+    def attach(self, watchdog) -> "ShadowTuner":
+        watchdog.add_listener(self.on_anomaly)
+        return self
+
+    def on_anomaly(self, row: dict):
+        """One watchdog anomaly row (the bench_gate series vocabulary).
+        Every registered dense handle the row's op/n match (None
+        matches all — watch_session feeds op-less series) is flagged;
+        a PROMOTED matching handle is demoted instead — the candidate
+        did not hold up under live traffic."""
+        n = row.get("n")
+        op = row.get("op")
+        with self.session._lock:
+            matches = [(h, e) for h, e in self.session._ops.items()
+                       if e.op in SHADOW_OPS
+                       and (n is None or n == e.n)
+                       and (op is None or op == e.op)]
+        for h, _e in matches:
+            st = self._states.get(h)
+            if st is not None and st.stage == "promoted":
+                self.demote(h)
+            else:
+                self.flag(h)
+
+    def flag(self, handle: Hashable):
+        """Mark a handle for shadow evaluation (idempotent while a
+        cycle is in flight)."""
+        with self._lock:
+            if self.breaker_open or handle in self._states:
+                return
+            entry = self.session._ops.get(handle)
+            if entry is None or entry.op not in SHADOW_OPS:
+                return
+            self._states[handle] = _ShadowState(stage="flagged")
+            self._gauge()
+
+    def demote(self, handle: Hashable):
+        """Revert a promoted handle to its pre-promotion config. The
+        previous factor program is still in the session's AOT cache,
+        so the next refactor (on-miss) is zero new compiles."""
+        sess = self.session
+        with self._lock:
+            st = self._states.get(handle)
+            if st is None or st.stage != "promoted":
+                return
+            del self._states[handle]
+            self._gauge()
+        with sess._lock:
+            entry = sess._ops.get(handle)
+            if entry is None:
+                return
+            entry.opts = st.prev_opts
+            entry.tuned = st.prev_label
+            sess._cache.pop(handle, None)
+        sess.metrics.inc("tuner_demotions_total")
+        self._event("tuner.demotion", handle=repr(handle),
+                    config=st.candidate_label)
+        log.warning("tuner demotion: %r back from %s (watchdog re-flag)",
+                    handle, st.candidate_label)
+
+    # -- the off-path pump ---------------------------------------------------
+
+    def poll(self) -> dict:
+        """One unit of off-request-path work: defer when the batcher
+        queue is non-empty (idle-capacity gate) or the breaker is
+        open; otherwise advance every pending handle one stage
+        (flagged → shadow compile → A/B → promote/reject). Returns a
+        status dict for the caller's loop."""
+        if self.breaker_open:
+            return {"breaker_open": True, "pending": self.pending()}
+        if self.batcher is not None \
+                and self.batcher.backpressure()["queue_depth"] > 0:
+            return {"deferred": True, "pending": self.pending()}
+        with self._lock:
+            work = list(self._states.items())
+        done = {"promoted": 0, "rejected": 0, "compiled": 0}
+        for handle, st in work:
+            if st.stage == "flagged":
+                if self._arm(handle, st):
+                    done["compiled"] += 1
+            elif st.stage == "armed":
+                if self._ab(handle, st):
+                    done["promoted"] += 1
+                else:
+                    done["rejected"] += 1
+        done["pending"] = self.pending()
+        return done
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values()
+                       if s.stage in ("flagged", "armed"))
+
+    # -- stages --------------------------------------------------------------
+
+    def _neighbor_opts(self, entry, tried: int):
+        """The candidate ladder for one dense entry, deterministic:
+        the table's own resolution first (when the session carries one
+        and it differs), then the lookahead toggle, then the
+        inner-blocking step — the neighboring cells of the offline
+        search space that change the factor program for a FIXED
+        operand (nb is the operand's tiling, set at registration)."""
+        opts = entry.opts
+        ladder = []
+        tu = self.session.tuning
+        if tu is not None:
+            cfg = self.session._resolve_tuned(entry)
+            if cfg is not None:
+                cand = cfg.apply(opts)
+                if cand != opts:
+                    ladder.append((cand, cfg.label()))
+        la = getattr(opts, "lookahead", 1)
+        ladder.append((dataclasses.replace(opts, lookahead=1 - min(la, 1)),
+                       f"neighbor[lookahead={1 - min(la, 1)}]"))
+        ib = getattr(opts, "inner_blocking", 32)
+        nib = 16 if ib >= 32 else 32
+        ladder.append((dataclasses.replace(opts, inner_blocking=nib),
+                       f"neighbor[inner_blocking={nib}]"))
+        uniq = []
+        for cand, label in ladder:
+            if cand != opts and all(cand != c for c, _l in uniq):
+                uniq.append((cand, label))
+        return uniq[tried] if tried < len(uniq) else (None, None)
+
+    def _arm(self, handle: Hashable, st: _ShadowState) -> bool:
+        """Shadow-compile the next candidate. Never raises: a failed
+        compile (injected or real) counts a rejection, bumps the
+        breaker, and leaves every live code path untouched."""
+        import jax
+
+        from ..runtime.session import _make_factor_fn
+        sess = self.session
+        with sess._lock:
+            entry = sess._ops.get(handle)
+            if entry is None:
+                with self._lock:
+                    self._states.pop(handle, None)
+                return False
+            cand, label = self._neighbor_opts(entry, st.tried)
+            if cand is None:
+                with self._lock:
+                    self._states.pop(handle, None)
+                    self._gauge()
+                return False
+            A = entry.A
+            op = entry.op
+        try:
+            if sess.faults is not None:
+                sess._fault("tuner.compile")
+            fn = jax.jit(_make_factor_fn(op, cand))
+            t0 = time.perf_counter()
+            exe = fn.lower(A).compile()
+            dt = time.perf_counter() - t0
+        except Exception as e:
+            sess.metrics.inc("tuner_rejections_total")
+            self._breaker_bump()
+            self._event("tuner.shadow_failed", handle=repr(handle),
+                        config=label, error=type(e).__name__)
+            log.warning("tuner: shadow compile of %s for %r failed: %s",
+                        label, handle, e)
+            with self._lock:
+                cur = self._states.get(handle)
+                if cur is st:
+                    st.tried += 1  # next flag retries the next rung
+                    st.stage = "flagged"
+            return False
+        self._failures = 0
+        sess.metrics.inc("tuner_shadow_compiles_total")
+        with self._lock:
+            cur = self._states.get(handle)
+            if cur is not st:
+                return False
+            st.candidate_opts = cand
+            st.candidate_label = label
+            st.exe = exe
+            st.stage = "armed"
+        self._event("tuner.shadow_compile", handle=repr(handle),
+                    config=label, compile_s=round(dt, 4))
+        return True
+
+    def _measure(self, exe, A) -> float:
+        """Median measured device seconds of ``probes`` executions."""
+        import jax
+        times = []
+        for _ in range(self.probes):
+            t0 = time.perf_counter()
+            jax.block_until_ready(exe(A))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]
+
+    def _ab(self, handle: Hashable, st: _ShadowState) -> bool:
+        """A/B the armed candidate against the live config on measured
+        device time; promote only on a ≥ min_win median win AND
+        agreeing results (never a wrong answer). Returns True on
+        promotion."""
+        import numpy as np
+
+        sess = self.session
+        with sess._lock:
+            entry = sess._ops.get(handle)
+            if entry is None:
+                with self._lock:
+                    self._states.pop(handle, None)
+                return False
+            A = entry.A
+            fkey = sess._factor_key(entry)
+            live_exe = sess._compiled.get(fkey)
+            ffn = sess._factor_fn(entry) if live_exe is None else None
+        try:
+            if live_exe is None:
+                # unwarmed handle: build the live arm through the
+                # observed seam (counted like any warmup compile)
+                with sess._lock:
+                    live_exe = sess._aot_compile(
+                        "factor", entry, handle, ffn, (A,), key=fkey)
+                    sess._compiled_put(fkey, live_exe)
+                    sess.metrics.inc("factor_aot_compiles")
+            live_out = live_exe(A)
+            cand_out = st.exe(A)
+            ok = self._agree(live_out, cand_out, np)
+            live_s = self._measure(live_exe, A)
+            cand_s = self._measure(st.exe, A)
+        except Exception as e:
+            sess.metrics.inc("tuner_rejections_total")
+            self._breaker_bump()
+            with self._lock:
+                self._states.pop(handle, None)
+                self._gauge()
+            log.warning("tuner: A/B of %r failed: %s", handle, e)
+            return False
+        self._failures = 0
+        win = (live_s - cand_s) / live_s if live_s > 0 else 0.0
+        if not ok or win < self.min_win:
+            sess.metrics.inc("tuner_rejections_total")
+            self._event("tuner.rejection", handle=repr(handle),
+                        config=st.candidate_label,
+                        win_pct=round(100 * win, 1), agree=ok)
+            with self._lock:
+                self._states.pop(handle, None)
+                self._gauge()
+            return False
+        self._promote(handle, st, win)
+        return True
+
+    @staticmethod
+    def _agree(live_out, cand_out, np) -> bool:
+        """Both arms must produce the same factorization before a
+        timing win counts (info equal, payloads allclose — the
+        schedule knobs are bit-identity-pinned, the loose tolerance
+        only forgives fp reassociation of future knobs)."""
+        import jax
+        try:
+            (lp, li), (cp, ci) = live_out, cand_out
+            if int(np.asarray(li)) != int(np.asarray(ci)):
+                return False
+            for a, b in zip(jax.tree_util.tree_leaves(lp),
+                            jax.tree_util.tree_leaves(cp)):
+                if not np.allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5,
+                                   equal_nan=True):
+                    return False
+        except Exception:
+            return False
+        return True
+
+    def _promote(self, handle: Hashable, st: _ShadowState, win: float):
+        """Swap the entry onto the candidate config. Order matters:
+        the shadow executable is installed under the NEW factor key
+        first, then the Options swap, then the resident eviction — so
+        the recovery refactor (here, off-path) hits a warm program:
+        zero new compiles on the serve path (acceptance pin)."""
+        sess = self.session
+        with sess._lock:
+            entry = sess._ops.get(handle)
+            if entry is None:
+                return
+            prev_opts, prev_label = entry.opts, entry.tuned
+            entry.opts = st.candidate_opts
+            entry.tuned = f"tuner:{st.candidate_label}"
+            sess._compiled_put(sess._factor_key(entry), st.exe)
+            sess._cache.pop(handle, None)
+        sess.metrics.inc("tuner_promotions_total")
+        with self._lock:
+            st.stage = "promoted"
+            st.prev_opts = prev_opts
+            st.prev_label = prev_label
+            st.exe = None
+            self._gauge()
+        self._event("tuner.promotion", handle=repr(handle),
+                    config=st.candidate_label,
+                    win_pct=round(100 * win, 1))
+        log.warning("tuner promotion: %r -> %s (%.1f%% device-time win)",
+                    handle, st.candidate_label, 100 * win)
+        # recover off-path: refactor through the promoted program now,
+        # so the next live solve is a cache hit
+        try:
+            sess.factor(handle)
+        except Exception as e:
+            log.warning("tuner: post-promotion refactor of %r failed: %s",
+                        handle, e)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _breaker_bump(self):
+        with self._lock:
+            self._failures += 1
+            if (self._failures >= self.breaker_limit
+                    and not self.breaker_open):
+                self.breaker_open = True
+                self.session.metrics.inc("tuner_breaker_open_total")
+                log.warning("tuner breaker OPEN after %d consecutive "
+                            "shadow failures", self._failures)
+
+    def reset_breaker(self):
+        with self._lock:
+            self.breaker_open = False
+            self._failures = 0
+
+    def _gauge(self):
+        """Caller holds the tuner lock."""
+        self.session.metrics.set_gauge(
+            "tuner_pending", sum(1 for s in self._states.values()
+                                 if s.stage in ("flagged", "armed")))
+
+    def _event(self, name: str, **attrs):
+        self.events.append({"event": name, **attrs})
+        del self.events[:-256]
+        tr = self.session.tracer
+        if tr is not None and tr.enabled:
+            tr.event(name, kind="tuner", **attrs)

@@ -1,0 +1,267 @@
+"""Committed tuning tables: first-match config resolution.
+
+Every fast path in the tree is gated by constants hand-picked on a
+2-core CPU host — ``Options.block_size``, ``inner_blocking``,
+``lookahead``, the small-engine panel width (``linalg/batched.py``
+``DEFAULT_NB``), and the pow2 batch/width bucket quanta. SLATE itself
+treats these as tunable ``Option``/``Method`` knobs resolved per
+target (``Option::Lookahead``, the ``MethodGemm/Trsm/LU::Auto``
+selection machinery mirrored in ``core/types.py``). This module is
+the consultation half of the round-21 autotuner: it loads the
+committed ``TUNING_r01.json`` artifact (``tools/autotune.py`` emits
+it; ``tools/bench_gate.py --check-schema`` validates it with the other
+artifacts) and resolves one :class:`TunedConfig` per
+(op, n, dtype, platform) query by FIRST MATCH over the table's entry
+list.
+
+Resolution contract (documented fallback):
+
+- An entry matches a query when its ``op``/``dtype``/``platform``
+  equal the query's (or are the wildcard ``"*"``) and the query's
+  ``n`` is ≤ the entry's ``n_max`` (``null`` = unbounded).
+  ``tools/autotune.py`` emits ``n_max`` as pow2 n-bucket upper bounds,
+  so resolution is per pow2-n-bucket; arbitrary bounds also work.
+- The FIRST matching entry (file order) wins — specific rows go
+  before catch-alls, exactly the refine ``PolicyTable`` convention.
+- No match — or no table at all — falls back to today's defaults:
+  the caller keeps whatever ``Options``/``default_nb``/pow2-quantum
+  it already had. Every consultation seam is one ``table is None``
+  check when disabled, and with no table active the served bits are
+  identical to an untuned tree (pinned in tests/test_tuning.py).
+
+A :class:`TunedConfig` never forces a knob it doesn't set: ``None``
+fields mean "keep the caller's value", so a table may tune only the
+lookahead of one op family and leave everything else on defaults.
+
+Stdlib-only and jax-free (the obs import rule): ``tools/bench_gate.py``
+mirrors :func:`validate_table` for its jax-free gate, and the pair is
+drift-pinned per the round-12 convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+TUNING_SCHEMA = "slate_tpu.tuning_table.v1"
+TUNING_FILENAME = "TUNING_r01.json"
+
+# knobs one table entry may set; everything absent/None keeps the
+# caller's default (the "tune one knob" contract above)
+_CONFIG_FIELDS = ("nb", "inner_blocking", "lookahead", "wide_panel",
+                  "batch_quantum", "width_quantum")
+_WILD = "*"
+
+
+def table_path() -> str:
+    """The committed artifact at the repo root."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, os.pardir, TUNING_FILENAME)
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedConfig:
+    """One resolved config: the knob values a matched table entry
+    sets (``None`` = keep the caller's default) plus provenance —
+    ``source`` names the artifact and entry that produced it, so span
+    attrs and the cost_log can say WHICH table row served a solve."""
+
+    nb: Optional[int] = None
+    inner_blocking: Optional[int] = None
+    lookahead: Optional[int] = None
+    wide_panel: Optional[int] = None
+    batch_quantum: Optional[int] = None
+    width_quantum: Optional[int] = None
+    source: str = ""
+
+    def apply(self, opts):
+        """A new ``Options`` with this config's non-None Options-backed
+        knobs applied (nb → ``block_size``, ``inner_blocking``,
+        ``lookahead``); the bucket quanta ride their own seams."""
+        kw = {}
+        if self.nb is not None:
+            kw["block_size"] = int(self.nb)
+        if self.inner_blocking is not None:
+            kw["inner_blocking"] = int(self.inner_blocking)
+        if self.lookahead is not None:
+            kw["lookahead"] = int(self.lookahead)
+        return dataclasses.replace(opts, **kw) if kw else opts
+
+    def label(self) -> str:
+        """Compact provenance string for span attrs / cost_log rows."""
+        knobs = ",".join(
+            f"{f}={getattr(self, f)}" for f in _CONFIG_FIELDS
+            if getattr(self, f) is not None)
+        return f"{self.source or 'tuned'}[{knobs}]"
+
+
+def validate_table(doc) -> List[str]:
+    """Schema errors of a loaded tuning-table document (empty =
+    valid). ``tools/bench_gate.py`` carries a jax-free mirror of this
+    validator (``_validate_tuning``) — keep the two in step; the pair
+    is drift-pinned in tests/test_tuning.py."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["tuning: top level is not an object"]
+    if doc.get("schema") != TUNING_SCHEMA:
+        errs.append(f"tuning: schema {doc.get('schema')!r} != "
+                    f"{TUNING_SCHEMA!r}")
+    entries = doc.get("entries")
+    if not isinstance(entries, list) or not entries:
+        return errs + ["tuning: entries missing or empty"]
+    for i, row in enumerate(entries):
+        if not isinstance(row, dict):
+            errs.append(f"tuning entries[{i}]: not an object")
+            continue
+        for k in ("op", "dtype", "platform", "config"):
+            if k not in row:
+                errs.append(f"tuning entries[{i}]: missing {k!r}")
+                break
+        else:
+            nm = row.get("n_max")
+            if nm is not None and (not isinstance(nm, int)
+                                   or isinstance(nm, bool) or nm < 1):
+                errs.append(f"tuning entries[{i}]: bad n_max {nm!r}")
+            cfg = row["config"]
+            if not isinstance(cfg, dict) or not cfg:
+                errs.append(f"tuning entries[{i}]: config missing or "
+                            "empty")
+                continue
+            for k, v in cfg.items():
+                if k not in _CONFIG_FIELDS:
+                    errs.append(f"tuning entries[{i}]: unknown config "
+                                f"knob {k!r}")
+                elif v is not None and (not isinstance(v, int)
+                                        or isinstance(v, bool) or v < 0):
+                    errs.append(f"tuning entries[{i}]: non-integer "
+                                f"config {k}={v!r}")
+    return errs
+
+
+class TuningTable:
+    """A loaded, validated table with first-match resolution.
+
+    Resolution results are memoized per (op, n, dtype, platform) —
+    ``linalg/batched.py`` consults the table on every bucket-cache
+    call, so repeat lookups must be one dict hit, not a table scan."""
+
+    def __init__(self, doc: dict, source: Optional[str] = None):
+        errs = validate_table(doc)
+        if errs:
+            raise ValueError("; ".join(errs))
+        self.doc = doc
+        self.source = source or doc.get("generated_by", "tuning-table")
+        self.entries: List[dict] = list(doc["entries"])
+        self._memo: Dict[Tuple, Optional[TunedConfig]] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_path(cls, path: Optional[str] = None) -> "TuningTable":
+        """Load + validate a table file (default: the committed
+        repo-root ``TUNING_r01.json``). Raises ValueError on schema
+        violations — a session consulting a malformed table would
+        silently serve untuned, the worse failure mode (the watchdog
+        baseline discipline)."""
+        path = table_path() if path is None else path
+        with open(path) as f:
+            doc = json.load(f)
+        try:
+            return cls(doc, source=os.path.basename(path))
+        except ValueError as e:
+            raise ValueError(f"{os.path.basename(path)}: {e}")
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def resolve(self, op: str, n: int, dtype, platform: str
+                ) -> Optional[TunedConfig]:
+        """First entry matching (op, n, dtype, platform), as a
+        :class:`TunedConfig`; None = no match (caller keeps its
+        defaults — the documented fallback)."""
+        dtype = str(dtype)
+        key = (op, int(n), dtype, platform)
+        with self._lock:
+            if key in self._memo:
+                return self._memo[key]
+        cfg = None
+        for i, row in enumerate(self.entries):
+            if row["op"] not in (op, _WILD):
+                continue
+            if row["dtype"] not in (dtype, _WILD):
+                continue
+            if row["platform"] not in (platform, _WILD):
+                continue
+            n_max = row.get("n_max")
+            if n_max is not None and n > n_max:
+                continue
+            cfg = TunedConfig(
+                source=f"{self.source}#{i}",
+                **{k: row["config"].get(k) for k in _CONFIG_FIELDS})
+            break
+        with self._lock:
+            self._memo[key] = cfg
+        return cfg
+
+    def batch_quantum(self, op: str, n: int, dtype, platform: str) -> int:
+        """The batch-dim bucket quantum for (op, n, dtype, platform);
+        1 (plain pow2 bucketing) when unmatched or unset."""
+        cfg = self.resolve(op, n, dtype, platform)
+        return (1 if cfg is None or cfg.batch_quantum is None
+                else max(1, int(cfg.batch_quantum)))
+
+    def width_quantum(self, op: str, n: int, dtype, platform: str) -> int:
+        """The rhs-width pad quantum (Batcher ``pad_widths``); 1 when
+        unmatched or unset."""
+        cfg = self.resolve(op, n, dtype, platform)
+        return (1 if cfg is None or cfg.width_quantum is None
+                else max(1, int(cfg.width_quantum)))
+
+
+def as_table(tuning) -> Optional["TuningTable"]:
+    """Coerce a Session/bench ``tuning=`` argument: an existing
+    TuningTable, a loaded doc, a path, or True (the committed
+    repo-root artifact). None/False stay None — tuning disabled."""
+    if tuning is None or tuning is False:
+        return None
+    if isinstance(tuning, TuningTable):
+        return tuning
+    if tuning is True:
+        return TuningTable.from_path()
+    if isinstance(tuning, str):
+        return TuningTable.from_path(tuning)
+    if isinstance(tuning, dict):
+        return TuningTable(tuning)
+    raise TypeError(f"tuning: expected TuningTable/doc/path/True, "
+                    f"got {type(tuning).__name__}")
+
+
+# -- the process-global seam -------------------------------------------------
+#
+# linalg/batched.py's bucket cache is process-global (one compiled
+# program per (op, n, nb, dtype, B-bucket) regardless of which Session
+# dispatched), so its tuning seam is too: activate_table() installs
+# the table its drivers consult when a caller passes nb=None. A
+# Session constructed with tuning= activates its table here (last
+# activation wins; activate_table(None) restores the untuned
+# defaults). Each consultation is one `table is None` check when
+# disabled — zero behavior change without a table, pinned.
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[TuningTable] = None
+
+
+def activate_table(table: Optional[TuningTable]) -> Optional[TuningTable]:
+    """Install (or clear, with None) the process-global table;
+    returns the previously active one so callers can restore it."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = table
+    return prev
+
+
+def active_table() -> Optional[TuningTable]:
+    return _ACTIVE

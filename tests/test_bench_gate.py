@@ -80,7 +80,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "numerics": _numerics_section(),
         "quotas": _quotas_section(),
         "spectral": _spectral_section(),
-        "updates": _updates_section()}
+        "updates": _updates_section(),
+        "tuning": _tuning_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -204,6 +205,24 @@ def _updates_section():
     }
 
 
+def _tuning_section():
+    """A minimal round-21 serve-artifact tuning section that passes
+    gate_mod._check_tuning_section."""
+    return {
+        "enabled": True,
+        "op": "chol",
+        "n": 32,
+        "resolved": "TUNING_r01.json#0[nb=32,inner_blocking=16,"
+                    "lookahead=0,wide_panel=32]",
+        "table": {"schema": gate_mod.TUNING_SCHEMA,
+                  "file": "TUNING_r01.json", "entries": 5,
+                  "platform_match": True},
+        "new_compiles_after_warmup": 0,
+        "solve_rel_err": 9.1e-9,
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -240,7 +259,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "numerics": _numerics_section(),
         "quotas": _quotas_section(),
         "spectral": _spectral_section(),
-        "updates": _updates_section()}
+        "updates": _updates_section(),
+        "tuning": _tuning_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

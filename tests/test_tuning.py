@@ -1,0 +1,573 @@
+"""The round-21 autotuner: committed tuning tables, consultation
+seams, the online shadow tuner, and the offline search contract.
+
+Covers the PR's pinned claims:
+
+- first-match (op, n-bucket, dtype, platform) resolution with the
+  documented fallback (no match / no table → caller keeps defaults);
+- ZERO behavior change with no table: the register seam is one
+  ``tuning is None`` check (``entry.opts is sess.opts`` — not even an
+  allocation) and deactivating a table restores bit-identical solves;
+- tuned registration stamps provenance into span attrs and the
+  cost_log, and the serve path after warmup is zero new compiles;
+- ``Options.lookahead`` depths > 1 clamp to 1 with a one-time warning
+  and a bit-identical schedule; negative depths are rejected;
+- shadow refinement promotes ONLY on a ≥10% measured win, demotes on
+  watchdog re-flag, and an injected fault at the ``tuner.compile``
+  site can never fail a live solve;
+- the offline search is deterministic under a fixed seed (injected
+  pure measure → byte-identical documents, ties to the earlier
+  candidate);
+- the jax-free validator mirror in tools/bench_gate.py is
+  drift-pinned against slate_tpu/tuning/table.py (round-12
+  convention: same schema id, same knob vocabulary, same verdict on
+  the same malformed documents).
+
+Tuner A/B probes run real programs at n ≤ 48 (tier-1 budget); the
+offline search itself never runs here — the committed TUNING_r01.json
+is the fixture.
+"""
+
+import copy
+import dataclasses
+import importlib.util
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import tuning as tn
+from slate_tpu.core import types as types_mod
+from slate_tpu.core.types import Options, normalize_lookahead
+from slate_tpu.linalg import batched
+from slate_tpu.runtime import FaultPlan, FaultSpec, Session
+from slate_tpu.tuning import (ShadowTuner, TunedConfig, TuningTable,
+                              activate_table, active_table, as_table,
+                              table_path, validate_table)
+from slate_tpu.tuning.search import config_space, run_search
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "_bench_gate", os.path.join(_ROOT, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table():
+    """Every test starts and ends untuned: the active table is a
+    process-global seam (batched._PROGRAMS is process-global), so a
+    leaked activation would silently re-tune sibling test files."""
+    prev = activate_table(None)
+    yield
+    activate_table(prev)
+
+
+def _doc(entries):
+    return {"schema": "slate_tpu.tuning_table.v1", "entries": entries}
+
+
+def _entry(op="lu_small", n_max=64, dtype="*", platform="*", **config):
+    return {"op": op, "n_max": n_max, "dtype": dtype,
+            "platform": platform, "config": config}
+
+
+def _spd(n, rng, dtype=np.float32):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    return (a @ a.T + n * np.eye(n)).astype(dtype)
+
+
+# -- table: first match, wildcards, fallback ---------------------------------
+
+
+def test_first_match_wins_in_file_order():
+    t = TuningTable(_doc([
+        _entry(op="chol", n_max=32, platform="cpu", nb=8),
+        _entry(op="chol", n_max=None, platform="*", nb=64),
+    ]))
+    assert t.resolve("chol", 32, "float32", "cpu").nb == 8
+    # past the first row's n_max: falls through to the catch-all
+    assert t.resolve("chol", 48, "float32", "cpu").nb == 64
+    # unbounded n_max matches any n
+    assert t.resolve("chol", 10_000, "float32", "tpu").nb == 64
+
+
+def test_wildcards_and_no_match_fallback():
+    t = TuningTable(_doc([
+        _entry(op="lu", n_max=64, dtype="float32", platform="tpu", nb=16),
+    ]))
+    assert t.resolve("lu", 64, "float32", "tpu").nb == 16
+    assert t.resolve("lu", 64, "float64", "tpu") is None   # dtype miss
+    assert t.resolve("lu", 64, "float32", "cpu") is None   # platform miss
+    assert t.resolve("qr", 64, "float32", "tpu") is None   # op miss
+    assert t.resolve("lu", 65, "float32", "tpu") is None   # n > n_max
+
+
+def test_resolution_is_memoized():
+    t = TuningTable(_doc([_entry(op="chol", nb=8)]))
+    a = t.resolve("chol", 32, "float32", "cpu")
+    t.entries.clear()  # a second scan would now miss
+    assert t.resolve("chol", 32, "float32", "cpu") is a
+
+
+def test_quantum_accessors_default_to_one():
+    t = TuningTable(_doc([
+        _entry(op="lu_small", batch_quantum=3, width_quantum=3),
+        _entry(op="chol_small", nb=8),
+    ]))
+    assert t.batch_quantum("lu_small", 16, "float32", "cpu") == 3
+    assert t.width_quantum("lu_small", 16, "float32", "cpu") == 3
+    # matched entry that doesn't set the quantum: plain pow2
+    assert t.batch_quantum("chol_small", 16, "float32", "cpu") == 1
+    # no match at all: plain pow2
+    assert t.batch_quantum("qr_small", 16, "float32", "cpu") == 1
+
+
+def test_tuned_config_apply_and_label():
+    cfg = TunedConfig(nb=16, lookahead=0, source="T#0")
+    opts = cfg.apply(Options())
+    assert opts.block_size == 16 and opts.lookahead == 0
+    # unset knobs keep the caller's values
+    assert opts.inner_blocking == Options().inner_blocking
+    assert cfg.label() == "T#0[nb=16,lookahead=0]"
+    # the all-None config is the identity (same object, no allocation)
+    base = Options()
+    assert TunedConfig().apply(base) is base
+
+
+def test_validate_table_negatives():
+    good = _doc([_entry(op="chol", nb=16)])
+    assert validate_table(good) == []
+    assert validate_table([]) != []
+    assert validate_table({"schema": "nope", "entries": [_entry()]})
+    assert validate_table(_doc([])) != []
+    bad_nmax = _doc([_entry(op="chol", n_max=0, nb=16)])
+    assert any("n_max" in e for e in validate_table(bad_nmax))
+    unknown = _doc([_entry(op="chol", warp_speed=9)])
+    assert any("unknown config" in e for e in validate_table(unknown))
+    non_int = _doc([_entry(op="chol", nb="big")])
+    assert any("non-integer" in e for e in validate_table(non_int))
+    missing = _doc([{"op": "chol", "config": {"nb": 16}}])
+    assert validate_table(missing) != []
+
+
+def test_as_table_coercions():
+    assert as_table(None) is None and as_table(False) is None
+    t = as_table(_doc([_entry(op="chol", nb=8)]))
+    assert isinstance(t, TuningTable) and len(t) == 1
+    assert as_table(t) is t
+    with pytest.raises(TypeError):
+        as_table(42)
+    with pytest.raises(ValueError):
+        as_table({"schema": "nope"})
+
+
+def test_committed_table_loads_and_resolves():
+    """The committed repo-root artifact is the fixture: it validates,
+    and resolution over it honors its own platform stamp (a CPU-smoke
+    table must never steer another platform's configs)."""
+    t = TuningTable.from_path()
+    assert validate_table(t.doc) == []
+    plat = t.doc["platform"]
+    cfg = t.resolve("chol", 64, "float32", plat)
+    assert cfg is not None and cfg.nb is not None
+    assert cfg.source.startswith(os.path.basename(table_path()))
+    assert t.resolve("chol", 64, "float32", "definitely-not-" + plat) \
+        is None
+
+
+# -- the disabled path: zero overhead, bit-identical --------------------------
+
+
+def test_no_table_zero_overhead_register():
+    """With tuning disabled the register seam must not even allocate:
+    the entry's Options IS the session's (one `tuning is None`
+    check — the round-8 disabled-path discipline)."""
+    sess = Session()
+    assert sess.tuning is None
+    h = sess.register(_spd(16, np.random.default_rng(0)), op="lu_small")
+    e = sess._ops[h]
+    assert e.opts is sess.opts
+    assert e.tuned is None
+
+
+def test_no_table_batched_helpers_are_defaults():
+    assert active_table() is None
+    assert batched.resolved_nb("lu_small", 48, np.float32) \
+        == batched.default_nb(48)
+    assert batched.resolved_nb("lu_small", 48, np.float32, nb=8) == 8
+    assert batched.resolved_quantum("lu_small", 48, np.float32) == 1
+    assert batched.batch_bucket(5) == 8
+    assert batched.batch_bucket(5, 3) == 6
+
+
+def test_deactivating_table_restores_bit_identical_solves():
+    """The pinned fallback: activate a table (different nb, different
+    bucket quantum → different compiled programs), deactivate, and
+    the untuned solve is BIT-identical to the never-tuned one."""
+    rng = np.random.default_rng(7)
+    n, bsz = 16, 5
+    a = np.stack([_spd(n, rng) for _ in range(bsz)])
+    b = rng.standard_normal((bsz, n)).astype(np.float32)
+    x0 = np.asarray(batched.posv_batched(a, b)[0])
+    activate_table(TuningTable(_doc([
+        _entry(op="chol_small", nb=4, batch_quantum=3)])))
+    x1 = np.asarray(batched.posv_batched(a, b)[0])
+    activate_table(None)
+    x2 = np.asarray(batched.posv_batched(a, b)[0])
+    assert x0.tobytes() == x2.tobytes()
+    # and the tuned arm was still a correct solve
+    for i in range(bsz):
+        assert np.allclose(a[i] @ x1[i], b[i], atol=1e-3)
+
+
+def test_batched_resolves_through_active_table():
+    t = TuningTable(_doc([_entry(op="lu_small", n_max=32, nb=4,
+                                 batch_quantum=3)]))
+    activate_table(t)
+    assert batched.resolved_nb("lu_small", 16, np.float32) == 4
+    # explicit nb always wins over the table
+    assert batched.resolved_nb("lu_small", 16, np.float32, nb=8) == 8
+    assert batched.resolved_quantum("lu_small", 16, np.float32) == 3
+    # past the entry's n_max: defaults again
+    assert batched.resolved_nb("lu_small", 64, np.float32) \
+        == batched.default_nb(64)
+    rng = np.random.default_rng(3)
+    a = np.stack([rng.standard_normal((16, 16)).astype(np.float32)
+                  + 16 * np.eye(16, dtype=np.float32) for _ in range(5)])
+    b = rng.standard_normal((5, 16)).astype(np.float32)
+    x = np.asarray(batched.gesv_batched(a, b)[0])
+    for i in range(5):
+        assert np.allclose(a[i] @ x[i], b[i], atol=1e-3)
+
+
+# -- session consultation: provenance + zero compiles after warmup -----------
+
+
+def test_session_register_resolves_and_stamps_provenance():
+    rng = np.random.default_rng(11)
+    n = 32
+    doc = _doc([_entry(op="chol", n_max=64, nb=16, inner_blocking=16,
+                       lookahead=0)])
+    sess = Session(tuning=doc)
+    try:
+        spd = _spd(n, rng)
+        h = sess.register(st.hermitian(np.tril(spd), nb=16,
+                                       uplo=st.Uplo.Lower), op="chol")
+        e = sess._ops[h]
+        assert e.opts.block_size == 16
+        assert e.opts.inner_blocking == 16
+        assert e.opts.lookahead == 0
+        assert "nb=16" in e.tuned and "lookahead=0" in e.tuned
+        sess.warmup(h)
+        # tuned provenance rides the cost_log rows...
+        assert sess.cost_log
+        assert all(r["tuned_config"] == e.tuned for r in sess.cost_log)
+        # ...and the span attrs
+        assert sess._span_attrs(e, h)["tuned_config"] == e.tuned
+        # warmup compiled the TUNED program: the serve path after
+        # warmup is zero new compiles (the acceptance pin)
+        before = len(sess.compile_log)
+        b = rng.standard_normal(n).astype(np.float32)
+        x = sess.solve(h, b)
+        assert len(sess.compile_log) == before
+        assert np.allclose(spd @ np.asarray(x), b, atol=1e-3)
+        # an op the table doesn't speak for keeps its defaults
+        ge = (rng.standard_normal((n, n))
+              + n * np.eye(n)).astype(np.float32)
+        h2 = sess.register(st.from_dense(ge, nb=16), op="lu")
+        assert sess._ops[h2].tuned is None
+    finally:
+        activate_table(None)
+
+
+def test_tuned_width_quantum_seam():
+    rng = np.random.default_rng(13)
+    sess = Session()
+    h = sess.register(_spd(16, rng), op="lu_small")
+    assert sess.tuned_width_quantum(h) == 1  # disabled: plain pow2
+    doc = _doc([_entry(op="lu_small", n_max=32, width_quantum=3)])
+    sess2 = Session(tuning=doc)
+    try:
+        h2 = sess2.register(_spd(16, rng), op="lu_small")
+        assert sess2.tuned_width_quantum(h2) == 3
+    finally:
+        activate_table(None)
+
+
+# -- satellite: the lookahead depth contract ---------------------------------
+
+
+def test_lookahead_negative_rejected():
+    with pytest.raises(ValueError):
+        normalize_lookahead(-1)
+
+
+def test_lookahead_deep_clamps_with_one_warning():
+    types_mod._LOOKAHEAD_WARNED = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert normalize_lookahead(2) == 1
+        assert normalize_lookahead(7) == 1
+    assert len([x for x in w if "clamps to 1" in str(x.message)]) == 1
+    assert normalize_lookahead(0) == 0
+    assert normalize_lookahead(1) == 1
+
+
+def test_lookahead_clamped_schedule_bit_identical():
+    """depth-3 used to silently schedule as depth-1; now it clamps —
+    and the clamp must be a true no-op vs an explicit depth-1 run."""
+    rng = np.random.default_rng(17)
+    n = 32
+    spd = _spd(n, rng)
+    A = st.hermitian(np.tril(spd), nb=16, uplo=st.Uplo.Lower)
+    types_mod._LOOKAHEAD_WARNED = True  # quiet; warning pinned above
+    l1, i1 = st.potrf(A, opts=Options(block_size=16, lookahead=1))
+    l3, i3 = st.potrf(A, opts=Options(block_size=16, lookahead=3))
+    assert int(np.asarray(i1)) == int(np.asarray(i3)) == 0
+    assert np.asarray(l1.data).tobytes() \
+        == np.asarray(l3.data).tobytes()
+
+
+# -- the online shadow tuner -------------------------------------------------
+
+
+class _FixedTimes(ShadowTuner):
+    """Real A/B executions (the agreement check runs both arms), with
+    deterministically injected timings: live arm 1.0, candidate arm
+    ``cand_scale`` — the promotion rule under test, not CPU jitter."""
+
+    cand_scale = 0.5
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._mcalls = 0
+
+    def _measure(self, exe, A):
+        super()._measure(exe, A)
+        self._mcalls += 1
+        return 1.0 if self._mcalls % 2 == 1 else float(self.cand_scale)
+
+
+def _chol_session(rng, n=32, faults=None):
+    sess = Session()
+    if faults is not None:
+        sess.enable_faults(faults)
+    spd = _spd(n, rng)
+    h = sess.register(st.hermitian(np.tril(spd), nb=16,
+                                   uplo=st.Uplo.Lower), op="chol")
+    sess.warmup(h)
+    return sess, h, spd
+
+
+def test_shadow_promotes_only_on_win_then_demotes_on_reflag():
+    rng = np.random.default_rng(19)
+    sess, h, spd = _chol_session(rng)
+    tuner = _FixedTimes(sess, probes=1)
+    tuner.flag(h)
+    assert tuner.poll()["compiled"] == 1
+    assert tuner.poll()["promoted"] == 1
+    g = sess.metrics.get
+    assert g("tuner_shadow_compiles_total") == 1
+    assert g("tuner_promotions_total") == 1
+    assert sess._ops[h].tuned.startswith("tuner:")
+    # promotion installed the shadow program under the session's own
+    # key: the recovery refactor and the next solve compile NOTHING
+    before = len(sess.compile_log)
+    b = rng.standard_normal(32).astype(np.float32)
+    x = sess.solve(h, b)
+    assert len(sess.compile_log) == before
+    assert np.allclose(spd @ np.asarray(x), b, atol=1e-3)
+    # watchdog re-flag of a promoted handle: counted demotion back to
+    # the previous config, zero new compiles (program still resident)
+    tuner.on_anomaly({"n": 32, "op": "chol"})
+    assert g("tuner_demotions_total") == 1
+    assert sess._ops[h].tuned is None
+    sess.factor(h)
+    assert len(sess.compile_log) == before
+    x2 = sess.solve(h, b)
+    assert np.allclose(spd @ np.asarray(x2), b, atol=1e-3)
+
+
+def test_shadow_sub_bar_win_rejected():
+    rng = np.random.default_rng(23)
+    sess, h, _spd_ = _chol_session(rng)
+    tuner = _FixedTimes(sess, probes=1)
+    tuner.cand_scale = 0.95  # a 5% win: below the 10% bar
+    tuner.flag(h)
+    tuner.poll()
+    assert tuner.poll()["rejected"] == 1
+    g = sess.metrics.get
+    assert g("tuner_promotions_total") == 0
+    assert g("tuner_rejections_total") == 1
+    assert sess._ops[h].tuned is None  # config untouched
+
+
+def test_shadow_fault_never_fails_live_and_breaker_opens():
+    """Injected compile_stall + dispatch_error at the tuner.compile
+    site: the shadow attempt is a counted rejection, the live solve
+    between attempts still answers, and consecutive failures open the
+    breaker (counted, poll short-circuits)."""
+    rng = np.random.default_rng(29)
+    sess, h, spd = _chol_session(rng, faults=FaultPlan(seed=5, specs=(
+        FaultSpec("compile_stall", rate=1.0, latency_s=1e-3, count=1),
+        FaultSpec("dispatch_error", rate=1.0, count=2),
+    )))
+    tuner = ShadowTuner(sess, breaker_limit=2)
+    tuner.flag(h)
+    tuner.poll()  # rung 0: injected failure
+    g = sess.metrics.get
+    assert g("tuner_rejections_total") == 1
+    assert not tuner.breaker_open
+    tuner.poll()  # rung 1: second injected failure -> breaker
+    assert g("tuner_rejections_total") == 2
+    assert tuner.breaker_open
+    assert g("tuner_breaker_open_total") == 1
+    # both fault budgets were consumed AT the tuner.compile site: the
+    # live solve never saw one, and it still answers correctly
+    b = rng.standard_normal(32).astype(np.float32)
+    x = sess.solve(h, b)
+    assert np.allclose(spd @ np.asarray(x), b, atol=1e-3)
+    assert g("failed_requests_total") == 0
+    assert tuner.poll() == {"breaker_open": True, "pending": 1}
+    tuner.reset_breaker()
+    assert not tuner.breaker_open
+
+
+def test_shadow_ignores_small_engine_ops():
+    rng = np.random.default_rng(31)
+    sess = Session()
+    h = sess.register(_spd(16, rng), op="lu_small")
+    tuner = ShadowTuner(sess)
+    tuner.flag(h)
+    assert tuner.pending() == 0
+
+
+def test_watchdog_listener_fires_on_transition_only():
+    from slate_tpu.obs.watchdog import Watchdog
+    base = {"schema": "slate_tpu.baseline_series.v1", "series": [{
+        "kind": "serve", "metric": "serve.solves_per_sec",
+        "platform": "tpu", "n": 32, "batch": None, "op": "chol",
+        "dtype": None, "best": 100.0, "direction": "higher"}]}
+    wd = Watchdog(baseline=base)
+    rows = []
+    wd.add_listener(rows.append)
+    wd.observe("serve.solves_per_sec", 10.0, platform="tpu", n=32,
+               op="chol", kind="serve")
+    wd.check()
+    wd.check()  # persistent anomaly: no second listener call
+    assert len(rows) == 1
+    assert rows[0]["op"] == "chol" and rows[0]["n"] == 32
+
+
+def test_watchdog_listener_exception_swallowed():
+    from slate_tpu.obs.watchdog import Watchdog
+    base = {"schema": "slate_tpu.baseline_series.v1", "series": [{
+        "kind": "serve", "metric": "serve.solves_per_sec",
+        "platform": "tpu", "n": 32, "batch": None, "op": "chol",
+        "dtype": None, "best": 100.0, "direction": "higher"}]}
+    wd = Watchdog(baseline=base)
+    wd.add_listener(lambda row: 1 / 0)
+    got = []
+    wd.add_listener(got.append)
+    wd.observe("serve.solves_per_sec", 10.0, platform="tpu", n=32,
+               op="chol", kind="serve")
+    rep = wd.check()  # must not raise; later listeners still run
+    assert not rep["ok"] and len(got) == 1
+
+
+# -- the offline search contract ---------------------------------------------
+
+
+def _pure_measure(op, n, dtype, config, seed):
+    """Deterministic stand-in for measure_config: a pure function of
+    the candidate (faster with bigger nb; seed shifts everything)."""
+    s = 1e-3 / (config["nb"] + seed + 1)
+    return {"seconds_per_iter": s, "model_flops": 1e6,
+            "bytes_accessed": 1e5, "compiles": 1, "live_items": 1}
+
+
+def test_search_deterministic_under_fixed_seed():
+    kw = dict(ops=("chol", "lu_small"), n_buckets=(64,),
+              dtypes=("float32",), platform="cpu", seed=3,
+              measure=_pure_measure)
+    d1, d2 = run_search(**kw), run_search(**kw)
+    assert json.dumps(d1, sort_keys=True) == json.dumps(d2, sort_keys=True)
+    assert validate_table(d1) == []
+    assert d1["seed"] == 3 and d1["platform"] == "cpu"
+    # argmax: the pure measure makes the biggest nb fastest (the
+    # grid caps nb at the n-bucket: 64 for chol, 32 for the small op)
+    by_op = {e["op"]: e for e in d1["entries"]}
+    assert by_op["chol"]["config"]["nb"] == 64
+    assert by_op["lu_small"]["config"]["nb"] == 32
+    assert d1["search"]["total_compiles"] == sum(
+        e["score"]["compiles"] for e in d1["entries"])
+
+
+def test_search_ties_break_to_earlier_candidate():
+    def flat(op, n, dtype, config, seed):
+        return {"seconds_per_iter": 1e-3, "model_flops": 1e6,
+                "bytes_accessed": None, "compiles": 1, "live_items": 1}
+    doc = run_search(ops=("chol",), platform="cpu", measure=flat)
+    first = config_space("chol", 64)[0]
+    got = doc["entries"][0]["config"]
+    assert got == {k: v for k, v in first.items() if v is not None}
+
+
+def test_config_space_respects_n_and_quick():
+    assert all(c["nb"] <= 32 for c in config_space("chol", 32))
+    full = config_space("lu", 256)
+    quick = config_space("lu", 256, quick=True)
+    assert len(quick) < len(full)
+    assert all(c["batch_quantum"] == c["width_quantum"]
+               for c in config_space("lu_small", 64))
+    with pytest.raises(ValueError):
+        config_space("eig", 64)
+
+
+# -- the jax-free mirror (round-12 drift pin) --------------------------------
+
+
+def test_tuning_mirror_drift_pinned():
+    """bench_gate's standalone validator must stay in lockstep with
+    the package's: same schema id, same knob vocabulary, and the same
+    verdict on the same malformed documents (the baseline-validator
+    precedent). The SERVE_ARTIFACT_SECTIONS twin pin (now including
+    'tuning') lives in test_faults.py."""
+    from slate_tpu.tuning import table as table_mod
+    gate = _bench_gate()
+    assert gate.TUNING_SCHEMA == table_mod.TUNING_SCHEMA
+    assert tuple(gate.TUNING_CONFIG_KEYS) == tuple(table_mod._CONFIG_FIELDS)
+    committed = json.load(open(table_path()))
+    malformed = [
+        {"schema": "nope", "entries": committed["entries"]},
+        _doc([]),
+        _doc([_entry(op="chol", warp_speed=9)]),
+        _doc([_entry(op="chol", nb="big")]),
+        _doc([_entry(op="chol", n_max=0, nb=8)]),
+        _doc([{"op": "chol", "config": {"nb": 8}}]),
+        _doc([{"op": "chol", "dtype": "*", "platform": "*",
+               "config": {}}]),
+    ]
+    for doc in [committed] + malformed:
+        ours = validate_table(copy.deepcopy(doc))
+        theirs = []
+        try:
+            gate._validate_tuning_doc("t", copy.deepcopy(doc))
+        except gate.SchemaError as e:
+            theirs = [str(e)]
+        assert bool(ours) == bool(theirs), (doc, ours, theirs)
+
+
+def test_committed_table_discovered_by_gate():
+    gate = _bench_gate()
+    names = [os.path.basename(p) for p in gate.discover(_ROOT)]
+    assert "TUNING_r01.json" in names
+    assert "BENCH_TUNED_r01.json" in names

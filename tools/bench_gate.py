@@ -121,6 +121,21 @@ TRACKED_SPECTRAL = ("resident.applies_per_sec",
 # structural evidence, not series.
 TRACKED_UPDATE = ("update.updates_per_sec", "speedup",
                   "sync.delta_bytes", "sync.ratio")
+# the round-21 tuned-serving A/B (bench_serve.py --tuned →
+# BENCH_TUNED_r*.json): default-Session vs tuning-table-Session serve
+# of the same resident factor. The compile-count and config-provenance
+# columns are structural evidence (validated, never series); the
+# solves/sec pair and speedup gate on TPU platforms like every serve
+# series (CPU rows are dispatch-noise smoke — informational).
+TRACKED_TUNED = ("tuned.solves_per_sec", "default.solves_per_sec",
+                 "speedup")
+# the round-21 offline-search table itself (tools/autotune.py →
+# TUNING_r*.json): each entry's measured score enters the trajectory
+# as an informational series keyed by (op, n_max, dtype, platform) —
+# the committed table is also the runtime's config source, so
+# --check-schema holding it to the schema is what keeps the serving
+# seam and this gate reading the same document
+TRACKED_TUNING = ("tuned.gflops",)
 GATED_PLATFORMS = ("tpu", "axon")
 
 # mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
@@ -131,7 +146,7 @@ GATED_PLATFORMS = ("tpu", "axon")
 SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas", "spectral", "updates")
+    "tenants", "numerics", "quotas", "spectral", "updates", "tuning")
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
@@ -156,6 +171,15 @@ CHECKPOINT_RECORD_KEYS = (
     "tenant", "refine", "mesh", "info", "heat", "last_access",
     "health", "operator", "payload")
 CHECKPOINT_BLOB_KEYS = ("blob", "shape", "dtype", "nbytes", "sha256")
+# mirror of slate_tpu/tuning/table.py (round 21; the same jax-free
+# duplication discipline as the checkpoint/placement mirrors — tests
+# pin the schema ids and the config-knob vocabulary equal and feed
+# both validators the same malformed docs): the committed tuning
+# table the serving runtime resolves configs from, held to its schema
+# by CI without importing the runtime
+TUNING_SCHEMA = "slate_tpu.tuning_table.v1"
+TUNING_CONFIG_KEYS = ("nb", "inner_blocking", "lookahead",
+                      "wide_panel", "batch_quantum", "width_quantum")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -221,8 +245,12 @@ def normalize(path: str) -> dict:
                                                       "serve_failover",
                                                       "serve_fair",
                                                       "serve_spectral",
-                                                      "serve_update"):
+                                                      "serve_update",
+                                                      "serve_tuned"):
         raise SchemaError(f"{name}: multi-row {obj['bench']} artifact "
+                          "— use normalize_all")
+    if isinstance(obj, dict) and obj.get("schema") == TUNING_SCHEMA:
+        raise SchemaError(f"{name}: multi-entry tuning table "
                           "— use normalize_all")
     m = _ROUND_RE.search(name)
     rnd = int(m.group(1)) if m else None
@@ -257,6 +285,10 @@ def normalize_all(path: str) -> List[dict]:
         return _normalize_serve_spectral(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "serve_update":
         return _normalize_serve_update(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("bench") == "serve_tuned":
+        return _normalize_serve_tuned(name, obj, rnd)
+    if isinstance(obj, dict) and obj.get("schema") == TUNING_SCHEMA:
+        return _normalize_tuning(name, obj, rnd)
     if isinstance(obj, dict) and obj.get("bench") == "chaos":
         return _normalize_chaos(name, obj, rnd)
     return [_normalize_obj(name, obj, rnd)]
@@ -605,6 +637,117 @@ def _normalize_chaos(name: str, obj: dict,
     }]
 
 
+def _validate_tuning_doc(name: str, obj) -> None:
+    """Mirror of slate_tpu/tuning/table.py validate_table (tests pin
+    the two validators against the same malformed docs): the committed
+    TUNING_r*.json held to its schema without importing the runtime —
+    a hand-edited table would otherwise be discovered by a serving
+    session resolving garbage, not by CI."""
+    if not isinstance(obj, dict):
+        raise SchemaError(f"{name}: tuning table is not an object")
+    if obj.get("schema") != TUNING_SCHEMA:
+        raise SchemaError(f"{name}: schema {obj.get('schema')!r} != "
+                          f"{TUNING_SCHEMA!r}")
+    entries = obj.get("entries")
+    if not isinstance(entries, list) or not entries:
+        raise SchemaError(f"{name}: entries missing or empty")
+    for i, row in enumerate(entries):
+        if not isinstance(row, dict):
+            raise SchemaError(f"{name}[entries.{i}]: not an object")
+        for k in ("op", "dtype", "platform", "config"):
+            if k not in row:
+                raise SchemaError(f"{name}[entries.{i}]: missing {k!r}")
+        n_max = row.get("n_max")
+        if n_max is not None and (not isinstance(n_max, int)
+                                  or isinstance(n_max, bool)
+                                  or n_max <= 0):
+            raise SchemaError(f"{name}[entries.{i}]: n_max must be a "
+                              "positive int or null")
+        cfg = row["config"]
+        if not isinstance(cfg, dict) or not cfg:
+            raise SchemaError(f"{name}[entries.{i}]: config missing "
+                              "or empty")
+        for k, v in cfg.items():
+            if k not in TUNING_CONFIG_KEYS:
+                raise SchemaError(f"{name}[entries.{i}]: unknown "
+                                  f"config knob {k!r}")
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise SchemaError(f"{name}[entries.{i}]: config "
+                                  f"{k}={v!r} must be a non-negative "
+                                  "int or null")
+
+
+def _normalize_tuning(name: str, obj: dict,
+                      rnd: Optional[int]) -> List[dict]:
+    """The round-21 committed tuning table (tools/autotune.py →
+    TUNING_r*.json): schema-validated (the serving runtime resolves
+    configs out of this exact file), each entry's measured search
+    score entering the trajectory as an informational series keyed by
+    the entry's (op, n_max, dtype, platform)."""
+    _validate_tuning_doc(name, obj)
+    out = []
+    for i, row in enumerate(obj["entries"]):
+        score = row.get("score") or {}
+        metrics = {}
+        if isinstance(score.get("gflops"), (int, float)) \
+                and not isinstance(score.get("gflops"), bool):
+            metrics["tuned.gflops"] = float(score["gflops"])
+        out.append({
+            "round": rnd, "source": f"{name}[{i}]", "kind": "tuning",
+            "platform": str(row["platform"]),
+            "n": row.get("n_max"),
+            "op": str(row["op"]),
+            "dtype": (None if row["dtype"] in ("*", None)
+                      else str(row["dtype"])),
+            "ok": True, "metrics": metrics,
+        })
+    return out
+
+
+def _normalize_serve_tuned(name: str, obj: dict,
+                           rnd: Optional[int]) -> List[dict]:
+    """The round-21 tuned-serving A/B artifact: {"bench":
+    "serve_tuned", "platform", "table", "rows": [...]} — one
+    ``serve_tuned`` record per row, series keyed by the row's
+    (op, n, dtype). The compile-count columns are validated
+    structural evidence (a tuned arm that compiles on the serve path
+    fails schema here, not just the bench's own exit gate)."""
+    for k in ("platform", "table", "rows", "ok"):
+        if k not in obj:
+            raise SchemaError(f"{name}: serve_tuned artifact missing "
+                              f"{k!r}")
+    if not isinstance(obj["rows"], list) or not obj["rows"]:
+        raise SchemaError(f"{name}: serve_tuned artifact with empty "
+                          "rows")
+    out = []
+    for i, row in enumerate(obj["rows"]):
+        for k in ("op", "n", "default", "tuned", "speedup", "ok"):
+            if k not in row:
+                raise SchemaError(
+                    f"{name}[rows.{i}]: serve_tuned row missing {k!r}")
+        for arm in ("default", "tuned"):
+            arm_row = row[arm]
+            if not isinstance(arm_row, dict):
+                raise SchemaError(f"{name}[rows.{i}]: {arm} arm not "
+                                  "an object")
+            for k in ("solves_per_sec", "new_compiles_after_warmup",
+                      "config"):
+                if k not in arm_row:
+                    raise SchemaError(
+                        f"{name}[rows.{i}]: {arm} arm missing {k!r}")
+        out.append({
+            "round": rnd, "source": f"{name}[{i}]",
+            "kind": "serve_tuned",
+            "platform": str(obj["platform"]), "n": int(row["n"]),
+            "op": str(row["op"]),
+            "dtype": str(row.get("dtype", "")) or None,
+            "ok": bool(row.get("ok", True)),
+            "metrics": _flat_metrics(row, TRACKED_TUNED),
+        })
+    return out
+
+
 def _normalize_serve_mixed(name: str, obj: dict,
                            rnd: Optional[int]) -> List[dict]:
     """The round-13 mixed-precision serving artifact: {"bench":
@@ -819,6 +962,35 @@ def _check_updates_section(name: str, section) -> None:
                           "update flops to the ledger")
 
 
+def _check_tuning_section(name: str, section) -> None:
+    """Validate the round-21 serve-artifact ``tuning`` section: the
+    committed-table structural columns — the table loaded, a fresh
+    registration resolved its config with provenance, and the warmed
+    tuned solve added zero compiles on the serve path. A disabled
+    section (no committed table) is valid — the tuning subsystem is
+    optional by design — but a PRESENT table that recompiles on the
+    serve path is a broken tuning claim."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: tuning section is not an object")
+    for k in ("enabled", "table", "resolved",
+              "new_compiles_after_warmup", "ok"):
+        if k not in section:
+            raise SchemaError(f"{name}: tuning section missing {k!r}")
+    if not section["enabled"]:
+        return
+    table = section["table"]
+    if not isinstance(table, dict) \
+            or table.get("schema") != TUNING_SCHEMA:
+        raise SchemaError(f"{name}: tuning.table schema != "
+                          f"{TUNING_SCHEMA!r}")
+    if section["new_compiles_after_warmup"] != 0:
+        raise SchemaError(
+            f"{name}: tuning section recorded "
+            f"{section['new_compiles_after_warmup']} compiles after "
+            "warmup (the table must never put compilation back on "
+            "the serve path)")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -851,6 +1023,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         _check_quotas_section(name, obj["quotas"])
         _check_spectral_section(name, obj["spectral"])
         _check_updates_section(name, obj["updates"])
+        _check_tuning_section(name, obj["tuning"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -924,6 +1097,8 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_FAIR_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_SPECTRAL_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_UPDATE_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_TUNED_r*.json"))
+             + glob.glob(os.path.join(root, "TUNING_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition

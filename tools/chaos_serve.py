@@ -1243,6 +1243,225 @@ def run_update_drill(seed):
     }
 
 
+def run_tuner_drill(seed):
+    """Online shadow-tuner drill (round 21): the watchdog-triggered
+    promotion loop end-to-end, deterministically, with the shadow seam
+    under fault injection the whole way.
+
+    (a) an injected regression — a synthetic baseline whose committed
+        best the live serve.solves_per_sec can never reach (the drill
+        gates its OWN platform via ``gated_platforms``, honestly:
+        nothing pretends to be a TPU) — makes ``Watchdog.check()``
+        flag the series, and the listener seam hands the anomaly row
+        to the attached :class:`ShadowTuner`;
+    (b) the FIRST shadow attempt runs into injected ``compile_stall``
+        + ``dispatch_error`` at the ``tuner.compile`` site: a counted
+        rejection, the breaker stays closed, and the live futures
+        served through the Executor meanwhile are all answered
+        residual-correct (a shadow fault can never fail a live
+        future);
+    (c) with the Executor queue non-empty, ``poll()`` defers — the
+        idle-capacity gate is observable, not aspirational;
+    (d) the retry shadow-compiles the next ladder rung clean, the A/B
+        runs both arms for real (the agreement check is live), the
+        *timing* is injected deterministically — a 2x candidate win
+        promotes (counted, traced), a 5% win on a second handle is
+        rejected (< the 10% bar) — and the post-promotion refactor is
+        zero new compiles;
+    (e) a watchdog re-flag of the promoted handle demotes it (counted)
+        back to the pre-promotion config with zero new compiles (the
+        previous program is still resident);
+    (f) a separate session drives consecutive shadow failures into the
+        breaker (counted open, poll short-circuits) while its own live
+        solves keep answering."""
+    import jax
+
+    from slate_tpu.obs.watchdog import BASELINE_SCHEMA, Watchdog
+    from slate_tpu.runtime import Executor, FaultPlan, FaultSpec, Session
+    from slate_tpu.tuning import ShadowTuner
+    import slate_tpu as st
+
+    class _DrillTuner(ShadowTuner):
+        """A/B arms execute for real (the agreement check upstream runs
+        both programs on the device); only the *timing* is injected —
+        live arm 1.0, candidate ``cand_scale`` — so the ≥10% promotion
+        rule is exercised on both sides of the bar without trusting
+        CPU-smoke jitter."""
+
+        cand_scale = 0.5
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._mcalls = 0
+
+        def _measure(self, exe, A):
+            super()._measure(exe, A)  # real executions, discarded timing
+            self._mcalls += 1
+            return 1.0 if self._mcalls % 2 == 1 else float(self.cand_scale)
+
+    rng = np.random.default_rng(seed + 11)
+    platform = jax.default_backend()
+    n, nb = 48, 16
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    ge = (rng.standard_normal((n, n))
+          + n * np.eye(n)).astype(np.float32)
+
+    sess = Session()
+    h = sess.register(st.hermitian(np.tril(spd), nb=nb,
+                                   uplo=st.Uplo.Lower),
+                      op="chol", handle="t0")
+    h_lu = sess.register(st.from_dense(ge, nb=nb), op="lu", handle="t1")
+    sess.warmup(h)
+    sess.warmup(h_lu)
+
+    # (a) the injected regression: a baseline best no live window meets
+    baseline = {"schema": BASELINE_SCHEMA, "series": [{
+        "kind": "serve", "metric": "serve.solves_per_sec",
+        "platform": platform, "n": n, "batch": None, "op": "chol",
+        "dtype": None, "best": 1e12, "direction": "higher"}]}
+    wd = Watchdog(baseline=baseline, metrics=sess.metrics,
+                  gated_platforms=(platform,))
+    wrong = lost = completed = 0
+    events = []
+    with Executor(sess, max_batch=4, max_wait=3600.0) as ex:
+        tuner = _DrillTuner(sess, batcher=ex.batcher, probes=1).attach(wd)
+        futs = []
+        for _ in range(4):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((ex.submit(h, b), spd, b))
+        ex.flush()
+        wd.watch_session(sess, platform=platform, n=n, op="chol")
+        wd.check()
+        flagged = tuner.pending() == 1  # watchdog row -> listener -> flag
+        events.append(("flagged", flagged))
+
+        # (c) queued live work defers the tuner: the idle gate. The
+        # probe request sits in a partial bucket (max_wait is the
+        # wave lock) exactly while poll() looks, then the bucket is
+        # completed and flushed — full-bucket discipline preserved
+        b_gate = rng.standard_normal(n).astype(np.float32)
+        futs.append((ex.submit(h, b_gate), spd, b_gate))
+        deferred = tuner.poll().get("deferred", False)
+        for _ in range(3):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((ex.submit(h, b), spd, b))
+        ex.flush()
+
+        # (b) first shadow attempt eats the injected faults, live path
+        # untouched (both budgets are consumed AT the tuner.compile
+        # site before any live opportunity sees them)
+        sess.enable_faults(FaultPlan(seed=seed, specs=(
+            FaultSpec("compile_stall", rate=1.0, latency_s=5e-3, count=1),
+            FaultSpec("dispatch_error", rate=1.0, count=1),
+        )))
+        r1 = tuner.poll()
+        g = sess.metrics.get
+        shadow_rejected = (r1.get("compiled", 0) == 0
+                          and g("tuner_rejections_total") == 1
+                          and not tuner.breaker_open)
+        for _ in range(4):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((ex.submit(h, b), spd, b))
+        ex.flush()
+
+        # (d) retry: clean shadow compile of the next rung, then the
+        # deterministic-win A/B -> promotion; recovery refactor warm
+        r2 = tuner.poll()
+        compiles_before = len(sess.compile_log)
+        r3 = tuner.poll()
+        promoted = (r2.get("compiled", 0) == 1 and r3.get("promoted", 0) == 1
+                    and g("tuner_shadow_compiles_total") == 1
+                    and g("tuner_promotions_total") == 1
+                    and len(sess.compile_log) == compiles_before)
+        tuned_label = sess._ops[h].tuned
+        for _ in range(4):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((ex.submit(h, b), spd, b))
+        ex.flush()
+
+        # the losing arm: 5% candidate win on the lu handle -> rejected
+        tuner.cand_scale = 0.95
+        tuner._mcalls = 0
+        tuner.flag(h_lu)
+        tuner.poll()  # arm
+        r_lose = tuner.poll()  # A/B
+        loss_rejected = (r_lose.get("rejected", 0) == 1
+                         and g("tuner_promotions_total") == 1
+                         and g("tuner_rejections_total") == 2)
+
+        # (e) re-flag of the promoted handle -> counted demotion,
+        # zero new compiles (previous program still resident)
+        compiles_before = len(sess.compile_log)
+        tuner.on_anomaly({"n": n, "op": "chol"})
+        sess.factor(h)
+        demoted = (g("tuner_demotions_total") == 1
+                   and sess._ops[h].tuned is None
+                   and len(sess.compile_log) == compiles_before)
+        for _ in range(4):
+            b = rng.standard_normal(n).astype(np.float32)
+            futs.append((ex.submit(h, b), spd, b))
+        ex.flush()
+        for f, m, b in futs:
+            if not f.done():
+                lost += 1
+            elif f.exception() is None:
+                completed += 1
+                if _check_residual(m, f.result(), b) > RESID_TOL:
+                    wrong += 1
+    cons = _conservation(sess.metrics)
+
+    # (f) the breaker: consecutive shadow failures open it; the live
+    # path keeps answering (the fault budget is exactly the two
+    # shadow attempts)
+    sess_b = Session()
+    inj = sess_b.enable_faults(FaultPlan(seed=seed, specs=(
+        FaultSpec("dispatch_error", rate=1.0, count=2),)))
+    a2 = rng.standard_normal((n, n)).astype(np.float32)
+    spd2 = (a2 @ a2.T + n * np.eye(n)).astype(np.float32)
+    hb = sess_b.register(st.hermitian(np.tril(spd2), nb=nb,
+                                      uplo=st.Uplo.Lower),
+                         op="chol", handle="t2")
+    sess_b.warmup(hb)
+    tuner_b = ShadowTuner(sess_b, breaker_limit=2)
+    tuner_b.flag(hb)
+    tuner_b.poll()
+    tuner_b.poll()
+    short = tuner_b.poll()
+    gb = sess_b.metrics.get
+    breaker_opened = (tuner_b.breaker_open
+                      and gb("tuner_breaker_open_total") == 1
+                      and short.get("breaker_open", False))
+    bb = rng.standard_normal(n).astype(np.float32)
+    wrong += int(_check_residual(spd2, sess_b.solve(hb, bb), bb)
+                 > RESID_TOL)
+    cons_b = _conservation(sess_b.metrics)
+
+    return {
+        "watchdog_flagged": flagged,
+        "idle_gate_deferred": deferred,
+        "shadow_fault_rejected": shadow_rejected,
+        "promoted_on_win": promoted,
+        "promoted_config": tuned_label,
+        "loss_rejected": loss_rejected,
+        "demoted_on_reflag": demoted,
+        "breaker_opened": breaker_opened,
+        "counters": {k: g(k) for k in (
+            "tuner_shadow_compiles_total", "tuner_promotions_total",
+            "tuner_rejections_total", "tuner_demotions_total")},
+        "tuner_events": [e["event"] for e in tuner.events],
+        "completed": completed,
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "conservation": {"session": cons, "breaker_session": cons_b,
+                         "ok": cons["ok"] and cons_b["ok"]},
+        "ok": (flagged and deferred and shadow_rejected and promoted
+               and loss_rejected and demoted and breaker_opened
+               and wrong == 0 and lost == 0 and completed > 0
+               and cons["ok"] and cons_b["ok"]),
+    }, inj
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -1255,13 +1474,14 @@ def run_all(seed, waves):
     migration, inj_g = run_migration_drill(seed)
     spectral = run_spectral_drill(seed)
     update = run_update_drill(seed)
+    tuner, inj_t = run_tuner_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
                            for i in (inj, inj_b, inj_m, inj_r,
-                                     inj_n, inj_g)),
+                                     inj_n, inj_g, inj_t)),
         "events": sum(len(i.schedule())
                       for i in (inj, inj_b, inj_m, inj_r,
-                                inj_n, inj_g)),
+                                inj_n, inj_g, inj_t)),
         "fired_counts": inj.fired_counts(),
         "opportunities": inj.opportunity_counts(),
     }
@@ -1272,7 +1492,8 @@ def run_all(seed, waves):
             "noisy_drill": noisy,
             "migration_drill": migration,
             "spectral_drill": spectral,
-            "update_drill": update}, schedule
+            "update_drill": update,
+            "tuner_drill": tuner}, schedule
 
 
 def main(argv=None):
@@ -1357,6 +1578,14 @@ def main(argv=None):
         # the delta sync to a counted full re-transfer that puts the
         # fleet back on the delta path
         "update_degrades_counted": phases["update_drill"]["ok"],
+        # round 21: the online tuner's whole promotion loop is
+        # fault-isolated from serving — an injected regression flags
+        # through the watchdog listener seam, injected faults at the
+        # tuner.compile site reject a shadow attempt without failing a
+        # single live future, the deterministic-win A/B promotes
+        # (counted, zero-compile recovery) and the 5% win is refused,
+        # re-flag demotes, consecutive failures open the breaker
+        "tuner_shadow_isolated": phases["tuner_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0
