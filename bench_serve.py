@@ -63,17 +63,32 @@ from slate_tpu.compat.platform import apply_env_platforms
 
 apply_env_platforms()
 
-# Every top-level section the serve artifact currently carries — the
-# committed BENCH_SERVE_smoke.json fixture must have ALL of them
-# (rounds 12 and 13 both tripped on stale fixtures when the schema
-# grew a section). bench() asserts this at write time; tools/
-# bench_gate.py --check-schema asserts it on the committed files
-# (mirrored there to stay jax-free; tests pin the two tuples equal);
-# --regen-smoke is the guarded regeneration path.
-SERVE_ARTIFACT_SECTIONS = (
-    "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
-    "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas", "spectral", "updates", "tuning")
+# Every top-level section the serve artifact currently carries — ONE
+# source of truth shared with tools/bench_gate.py since round 22
+# (tools/serve_sections.py; the drift pin is now an import-identity
+# test). bench() asserts it at write time; --check-schema asserts it
+# on the committed files; --regen-smoke is the guarded regeneration
+# path.
+
+
+def _load_serve_sections():
+    """Load tools/serve_sections.py under ONE fixed module name (both
+    consumers share the cached module, so the tuples are the SAME
+    object — the import-identity pin)."""
+    import importlib.util
+    name = "slate_tpu_serve_sections"
+    mod = sys.modules.get(name)
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_sections.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+SERVE_ARTIFACT_SECTIONS = _load_serve_sections().SERVE_ARTIFACT_SECTIONS
 
 
 def _tenants_section(sess):
@@ -332,6 +347,54 @@ def _build_operator(n, nb, dtype):
     return A, spd
 
 
+def _incidents_section(sess, handle):
+    """The serve artifact's round-22 ``incidents`` section: the
+    decision-journal/counter parity table over every kind that fired
+    during this exact workload, plus one deliberately-triggered probe
+    incident validated against ``slate_tpu.incident.v1`` (exit-gated —
+    a bench whose black box stopped recording, or whose journal
+    drifted from its counters, is a broken recorder, not a slow
+    bench). The committed fixture's ``sample`` doc is what bench_gate
+    --check-schema's jax-free mirror validator chews on."""
+    from slate_tpu.obs import validate_incident
+    from slate_tpu.obs.events import KIND_COUNTERS
+
+    rec = sess.recorder
+    if rec is None:
+        return {"enabled": False, "ok": False}
+    sample = rec.incident("bench_probe", key="bench", handle=handle,
+                          context={"bench": "serve"})
+    errs = [] if sample is None else validate_incident(sample)
+    counters = sess.metrics.snapshot()["counters"]
+    counts = rec.journal.counts()
+    parity = {}
+    for kind, counter in sorted(KIND_COUNTERS.items()):
+        j = counts.get(kind, 0.0)
+        c = counters.get(counter, 0.0)
+        if j or c:
+            parity[kind] = {"journal": j, "counter": c, "ok": j == c}
+    if not parity:
+        # a perfectly quiet run still records the (vacuously-equal)
+        # eviction row so the gate's parity table is never empty
+        parity["eviction"] = {
+            "journal": counts.get("eviction", 0.0),
+            "counter": counters.get("evictions", 0.0),
+            "ok": counts.get("eviction", 0.0)
+            == counters.get("evictions", 0.0)}
+    ok = (sample is not None and not errs
+          and all(r["ok"] for r in parity.values()))
+    return {
+        "enabled": True,
+        "ok": ok,
+        "captured": counters.get("incidents_captured_total", 0.0),
+        "journal_recorded": rec.journal.payload()["recorded"],
+        "journal_digest": rec.journal.digest(),
+        "parity": parity,
+        "validator_errors": errs,
+        "sample": sample,
+    }
+
+
 def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
           dtype=np.float32, out_path="BENCH_SERVE.json"):
     import jax
@@ -378,6 +441,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # probed-solve path; the artifact's "numerics" section records the
     # per-handle health view of this exact workload, exit-gated below
     sess.enable_numerics(sample_fraction=0.25, sample_seed=16)
+    # round 22: the flight recorder + decision journal through the
+    # bench — enabled BEFORE any decision seam can fire, so the
+    # artifact's "incidents" section can check journal/counter parity
+    # as absolute equality (both start at zero together)
+    sess.enable_recorder()
     h = sess.register(A, op="chol", tenant="bench-a")
     with Executor(sess, max_batch=max_batch, max_wait=max_wait) as ex:
         ex.warmup([h])  # factor + AOT compile off the request path
@@ -409,6 +477,9 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # loads, register-time resolution records provenance, warmed tuned
     # solve adds zero compiles; the timed window above stays table-free
     tuning_section = _tuning_section(sess, dtype)
+    # round 22: built LAST so every decision the exercises above made
+    # (evictions, update refactors, ...) is inside the parity check
+    incidents_section = _incidents_section(sess, h)
     artifact = {
         "bench": "serve",
         "backend": jax.devices()[0].platform,
@@ -474,6 +545,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # compiles nothing new (exit-gated; the measured tuned-vs-
         # default A/B is the separate --tuned artifact)
         "tuning": tuning_section,
+        # round 22: the black-box view — journal/counter parity per
+        # decision kind and one probe incident held to
+        # slate_tpu.incident.v1 (exit-gated below and by bench_gate
+        # --check-schema on the committed fixture)
+        "incidents": incidents_section,
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -2115,9 +2191,13 @@ def main(argv=None):
     # round 21: the tuning section exit-gates too — a committed table
     # that stops loading, resolving, or serving compile-free is a
     # broken tuning claim
+    # round 22: the incidents section exit-gates too — a journal that
+    # drifted from its counters (or a probe incident that fails its
+    # own schema) is a broken black box
     ok = (art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
           and art["numerics"]["ok"] and art["spectral"]["ok"]
-          and art["updates"]["ok"] and art["tuning"]["ok"])
+          and art["updates"]["ok"] and art["tuning"]["ok"]
+          and art["incidents"]["ok"])
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

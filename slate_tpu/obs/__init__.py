@@ -36,6 +36,14 @@ tools ingest:
   dyadic grids, EWMA handle heat, and the placement-snapshot schema
   the fleet fold turns into ROADMAP item 1's placement input
   (round 15).
+* :mod:`.events` / :mod:`.recorder` — the decision journal, flight
+  recorder, and incident capture (round 22): every runtime reflex
+  emits one structured :class:`~.events.DecisionEvent` (parity with
+  its metric counter pinned per kind), recent spans + gauge samples
+  ride bounded always-on rings, and anomaly/breach/breaker/fault
+  transitions materialize rate-limited, deduped, crash-safe
+  ``slate_tpu.incident.v1`` snapshots (the ``/journal`` +
+  ``/incidents`` routes; fleet folds in :mod:`.aggregate`).
 * :mod:`.numerics`   — numerical-health telemetry (round 16): the
   growth-bound machinery (one source of truth with the tester), the
   Hager/Higham condest loop the Session drives with resident-factor
@@ -48,26 +56,33 @@ See DESIGN.md "Observability (round 8)" for the reference mapping
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import (aggregate, attribution, costs, flops, numerics, roofline,
-               slo, watchdog)
+from . import (aggregate, attribution, costs, events, flops, numerics,
+               recorder, roofline, slo, watchdog)
 from .attribution import AttributionLedger
+from .events import DecisionEvent, journal_digest, validate_incident
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
 from .merge import combine_process_traces, lookahead_overlap, merge_traces
 from .numerics import NumericsConfig, NumericsMonitor
+from .recorder import (DecisionJournal, FlightRecorder, IncidentCapture,
+                       Recorder)
 from .slo import Objective, SloTracker
 from .tracing import NOOP_SPAN, Span, Tracer, default_tracer
 from .watchdog import Watchdog
 
 __all__ = [
-    "AttributionLedger", "NOOP_SPAN", "NumericsConfig",
-    "NumericsMonitor", "Objective", "ObsServer",
+    "AttributionLedger", "DecisionEvent", "DecisionJournal",
+    "FlightRecorder", "IncidentCapture", "NOOP_SPAN", "NumericsConfig",
+    "NumericsMonitor", "Objective", "ObsServer", "Recorder",
     "SloTracker", "Span", "Tracer",
     "Watchdog", "aggregate", "attribution", "chrome_trace",
     "combine_process_traces",
-    "costs", "default_tracer", "flops", "lookahead_overlap",
-    "merge_traces", "numerics", "render_prometheus", "roofline", "slo",
-    "validate_chrome_trace", "watchdog", "write_chrome_trace",
+    "costs", "default_tracer", "events", "flops", "journal_digest",
+    "lookahead_overlap",
+    "merge_traces", "numerics", "recorder", "render_prometheus",
+    "roofline", "slo",
+    "validate_chrome_trace", "validate_incident", "watchdog",
+    "write_chrome_trace",
 ]
 
 

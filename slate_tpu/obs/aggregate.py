@@ -43,6 +43,7 @@ __all__ = [
     "combine_process_traces", "merge_attribution_snapshots",
     "merge_bytes_snapshots",
     "merge_flop_snapshots", "merge_histograms",
+    "merge_incident_payloads", "merge_journal_payloads",
     "merge_metrics_snapshots", "merge_placement_snapshots",
     "merge_quota_payloads",
     "aggregate_processes", "placement_from_checkpoint",
@@ -388,6 +389,79 @@ def merge_quota_payloads(snaps: Sequence[dict]) -> dict:
         "processes": len(snaps),
         "tenants": tenants,
         "counters": counters,
+    }
+
+
+def merge_journal_payloads(payloads: Sequence[dict],
+                           hosts: Optional[Sequence[str]] = None
+                           ) -> dict:
+    """N ``DecisionJournal.payload()`` docs -> one fleet decision
+    timeline (round 22): every ring event host-labeled and merged
+    into ONE ts-ordered stream, per-kind / per-(kind, outcome) counts
+    summed exactly (the conservation invariant: fleet count(kind) ==
+    sum of per-process counts — merging two copies of one journal
+    doubles every count bit-exactly, same as the metrics fold)."""
+    labels = _hosts(len(payloads), hosts)
+    events: List[dict] = []
+    counts: Dict[str, float] = {}
+    outcome_counts: Dict[str, float] = {}
+    recorded = dropped = 0
+    for label, p in zip(labels, payloads):
+        if not p:
+            continue
+        for ev in p.get("events", ()):
+            row = dict(ev)
+            row["host"] = label
+            events.append(row)
+        for k, v in p.get("counts", {}).items():
+            counts[k] = counts.get(k, 0.0) + v
+        for k, v in p.get("outcome_counts", {}).items():
+            outcome_counts[k] = outcome_counts.get(k, 0.0) + v
+        recorded += int(p.get("recorded", 0))
+        dropped += int(p.get("dropped", 0))
+    # ts-ordered; (host, seq) breaks clock ties deterministically
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("host", ""),
+                               e.get("seq", 0)))
+    return {
+        "schema": "slate_tpu.journal.fleet.v1",
+        "processes": len(payloads),
+        "hosts": labels,
+        "recorded": recorded,
+        "dropped": dropped,
+        "counts": counts,
+        "outcome_counts": outcome_counts,
+        "events": events,
+    }
+
+
+def merge_incident_payloads(payloads: Sequence[dict],
+                            hosts: Optional[Sequence[str]] = None
+                            ) -> dict:
+    """N ``IncidentCapture.payload()`` docs -> one fleet incident
+    timeline: every incident labeled with its process host (the
+    document's own ``host`` field is preserved — the label records
+    which FOLD slot it came from), ts-ordered, capture totals
+    summed."""
+    labels = _hosts(len(payloads), hosts)
+    incidents: List[dict] = []
+    captured = 0
+    for label, p in zip(labels, payloads):
+        if not p:
+            continue
+        for doc in p.get("incidents", ()):
+            row = dict(doc)
+            row["fold_host"] = label
+            incidents.append(row)
+        captured += int(p.get("captured", 0))
+    incidents.sort(key=lambda d: (d.get("ts", 0.0),
+                                  d.get("fold_host", ""),
+                                  d.get("id", "")))
+    return {
+        "schema": "slate_tpu.incidents.fleet.v1",
+        "processes": len(payloads),
+        "hosts": labels,
+        "captured": captured,
+        "incidents": incidents,
     }
 
 

@@ -1,0 +1,196 @@
+"""Decision-event vocabulary: the journal's schema and parity map.
+
+Every autonomous reflex in the serving runtime announces itself twice:
+a metric counter bump (the fleet-dashboard aggregate, unchanged) and —
+when a :class:`~slate_tpu.obs.recorder.Recorder` is enabled — ONE
+structured :class:`DecisionEvent` into the bounded decision journal.
+The counter says *how many times*; the event says *what the system
+knew when it decided* (queue depth, burn rate, headroom, condest,
+measured win — the inputs an autoscaler policy or a post-incident
+reader replays). SLATE's own per-rank trace payloads play the same
+role for the reference factorizations: counters alone cannot order a
+cascade (shed → breaker trip → failover) across subsystems, the
+journal can (DESIGN.md round 22).
+
+:data:`KIND_COUNTERS` is the single source of truth binding each
+decision kind to the metric counter its seam has always incremented —
+the parity invariant ``journal count(kind) == counter delta`` is
+pinned per kind by test and exit-gated by the chaos recorder drill.
+:data:`OUTCOME_COUNTERS` covers the seams that count one decision
+under TWO counters (a tenant-LRU eviction bumps both ``evictions``
+and ``tenant_quota_evictions_total``): the journal still records ONE
+event, outcome-tagged, and the secondary counter's parity is checked
+against the (kind, outcome) slice.
+
+Stdlib-only (the obs import rule): the journal schema must be
+readable by jax-free tooling (tools/bench_gate.py mirrors the
+incident validator; tests pin the mirrors equal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, Iterable, Optional, Tuple
+
+JOURNAL_SCHEMA = "slate_tpu.journal.v1"
+INCIDENT_SCHEMA = "slate_tpu.incident.v1"
+
+# decision kind -> the metric counter the same seam increments; the
+# parity map (module docstring). A kind's journal counts sum event
+# ``count`` (a shed wave drops N requests in ONE decision; a
+# clear_cache evicts N residents in ONE sweep).
+KIND_COUNTERS: Dict[str, str] = {
+    # serving-door reflexes (runtime/batching.py)
+    "shed": "shed_requests_total",
+    "admission_reject": "admission_rejected_total",
+    "quota_reject": "quota_rejections_total",
+    "deadline_expired": "deadline_expired_total",
+    # circuit breaker transitions (runtime/executor.py)
+    "breaker_open": "breaker_trips_total",
+    "breaker_probe": "breaker_probes_total",
+    "breaker_close": "breaker_closes_total",
+    # precision / health reflexes (runtime/session.py)
+    "refine_fallback": "refine_fallbacks_total",
+    "refine_demotion": "refine_demotions_total",
+    "health_demotion": "health_demotions_total",
+    "eviction": "evictions",
+    "update_refactor": "update_refactors_total",
+    # fleet coordinator reflexes (runtime/fleet.py)
+    "failover": "fleet_failover_handles_total",
+    "migration": "fleet_migrations_total",
+    "migration_abort": "fleet_migration_aborts_total",
+    "delta_sync": "fleet_delta_replications_total",
+    "full_sync": "fleet_full_replications_total",
+    # online shadow tuner (tuning/shadow.py)
+    "tuner_promote": "tuner_promotions_total",
+    "tuner_reject": "tuner_rejections_total",
+    "tuner_demote": "tuner_demotions_total",
+}
+
+# (kind, outcome) -> the SECOND counter the same single decision
+# bumps; parity for these checks the outcome-tagged journal slice.
+OUTCOME_COUNTERS: Dict[Tuple[str, str], str] = {
+    ("eviction", "tenant_quota"): "tenant_quota_evictions_total",
+    ("update_refactor", "budget"): "update_budget_refactors_total",
+    ("failover", "replica"): "fleet_failover_replica_served",
+    ("failover", "restored"): "fleet_failover_restored",
+    ("failover", "refactor"): "fleet_failover_refactor",
+    ("failover", "cold"): "fleet_failover_cold",
+}
+
+DECISION_KINDS: Tuple[str, ...] = tuple(sorted(KIND_COUNTERS))
+
+# the fields the same-seed chaos digest hashes: deterministic under a
+# fixed fault schedule (timestamps and measured inputs are not)
+DIGEST_FIELDS: Tuple[str, ...] = ("kind", "op", "handle", "tenant",
+                                  "outcome", "count")
+
+
+@dataclasses.dataclass(slots=True)
+class DecisionEvent:
+    """One reflex decision: what fired, over what scope, driven by
+    which inputs, with which outcome. ``count`` carries multi-victim
+    decisions (one shed wave, one eviction sweep); ``trace_id``/
+    ``span_id`` join the event to the flight recorder's span ring."""
+
+    seq: int
+    ts: float
+    kind: str
+    op: Optional[str] = None
+    handle: Optional[str] = None
+    tenant: Optional[str] = None
+    inputs: Optional[dict] = None
+    outcome: Optional[str] = None
+    count: float = 1.0
+    trace_id: Optional[int] = None
+    span_id: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq, "ts": self.ts, "kind": self.kind,
+            "op": self.op, "handle": self.handle, "tenant": self.tenant,
+            "inputs": self.inputs, "outcome": self.outcome,
+            "count": self.count, "trace_id": self.trace_id,
+            "span_id": self.span_id,
+        }
+
+
+def journal_digest(events: Iterable) -> str:
+    """Stable digest over the journal's deterministic fields
+    (:data:`DIGEST_FIELDS`) in recording order — the reproducibility
+    token the chaos recorder drill compares across same-seed runs
+    (the journal twin of ``FaultInjector.schedule_digest``). Accepts
+    :class:`DecisionEvent` objects or their dicts."""
+    rows = []
+    for e in events:
+        d = e.to_dict() if isinstance(e, DecisionEvent) else e
+        rows.append([d.get(f) for f in DIGEST_FIELDS])
+    payload = json.dumps(rows, separators=(",", ":"))
+    return "sha256:" + hashlib.sha256(payload.encode()).hexdigest()
+
+
+# -- the incident schema ------------------------------------------------------
+
+# every top-level key an incident document carries (the capture
+# sections are nullable — a session without numerics enabled writes
+# null, never omits the key); tools/bench_gate.py mirrors this tuple
+# (tests pin the mirrors equal and feed both validators the same
+# malformed docs — the checkpoint/placement discipline).
+INCIDENT_KEYS: Tuple[str, ...] = (
+    "schema", "id", "ts", "host", "reason", "key", "context",
+    "journal", "flight", "metrics", "numerics", "quotas", "placement",
+    "cost_log", "tuning")
+
+
+def validate_incident(doc) -> list:
+    """Validate one ``slate_tpu.incident.v1`` document; returns a list
+    of error strings (empty = valid). This is the runtime-side
+    validator; ``tools/bench_gate.py --check-schema`` applies a
+    jax-free mirror to committed artifacts (drift-pinned by test)."""
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"incident: not a dict ({type(doc).__name__})"]
+    if doc.get("schema") != INCIDENT_SCHEMA:
+        errs.append(f"incident: schema {doc.get('schema')!r} != "
+                    f"{INCIDENT_SCHEMA!r}")
+    for k in INCIDENT_KEYS:
+        if k not in doc:
+            errs.append(f"incident: missing key {k!r}")
+    if errs:
+        return errs
+    if not isinstance(doc["id"], str) or not doc["id"]:
+        errs.append("incident: id must be a nonempty string")
+    if not isinstance(doc["ts"], (int, float)):
+        errs.append("incident: ts must be a number")
+    if not isinstance(doc["reason"], str) or not doc["reason"]:
+        errs.append("incident: reason must be a nonempty string")
+    j = doc["journal"]
+    if not isinstance(j, dict) or "events" not in j or "counts" not in j:
+        errs.append("incident: journal must carry events + counts")
+    else:
+        if not isinstance(j["events"], list):
+            errs.append("incident: journal.events must be a list")
+        else:
+            for i, ev in enumerate(j["events"]):
+                if (not isinstance(ev, dict) or not ev.get("kind")
+                        or not isinstance(ev.get("ts"), (int, float))
+                        or not isinstance(ev.get("count"),
+                                          (int, float))):
+                    errs.append(f"incident: journal.events[{i}] "
+                                "malformed (kind/ts/count)")
+                    break
+        if not isinstance(j["counts"], dict):
+            errs.append("incident: journal.counts must be a dict")
+    fl = doc["flight"]
+    if (not isinstance(fl, dict)
+            or not isinstance(fl.get("spans"), list)
+            or not isinstance(fl.get("samples"), list)):
+        errs.append("incident: flight must carry spans + samples lists")
+    m = doc["metrics"]
+    if (not isinstance(m, dict)
+            or not isinstance(m.get("counters"), dict)
+            or not isinstance(m.get("gauges"), dict)):
+        errs.append("incident: metrics must carry counters + gauges")
+    return errs

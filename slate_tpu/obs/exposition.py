@@ -287,6 +287,25 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = {"enabled": False, "tenants": {}}
             body = json.dumps(payload, sort_keys=True) + "\n"
             self._reply(200, body, "application/json")
+        elif path == "/journal":
+            # round 22: the decision journal (getter-bound so a
+            # recorder enabled AFTER the server started is served —
+            # the /slo provider discipline)
+            rec = (obs.recorder() if callable(obs.recorder)
+                   else obs.recorder)
+            payload = ({"enabled": False, "events": [], "counts": {}}
+                       if rec is None else rec.journal.payload())
+            body = json.dumps(payload, sort_keys=True,
+                              default=repr) + "\n"
+            self._reply(200, body, "application/json")
+        elif path == "/incidents":
+            rec = (obs.recorder() if callable(obs.recorder)
+                   else obs.recorder)
+            payload = ({"enabled": False, "incidents": []}
+                       if rec is None else rec.incidents.payload())
+            body = json.dumps(payload, sort_keys=True,
+                              default=repr) + "\n"
+            self._reply(200, body, "application/json")
         else:
             self._reply(404, "not found\n", "text/plain")
 
@@ -311,7 +330,8 @@ class ObsServer:
 
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
                  port: int = 0, ledger=None, slo=None, tenants=None,
-                 attribution=None, numerics=None, quotas=None):
+                 attribution=None, numerics=None, quotas=None,
+                 recorder=None):
         self.metrics = metrics
         self.tracer = tracer
         # the /slo provider: an SloTracker, or a zero-arg callable
@@ -329,6 +349,9 @@ class ObsServer:
         # round 18: the quotas-payload provider for the /metrics
         # tenant-labeled quota rows (or getter — same discipline)
         self.quotas = quotas
+        # round 22: the Recorder behind /journal + /incidents (or
+        # getter — same late-enable discipline)
+        self.recorder = recorder
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

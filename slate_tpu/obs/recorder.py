@@ -1,0 +1,429 @@
+"""Flight recorder + decision journal: black-box capture for serving.
+
+Three always-on, bounded, stdlib-only rings behind ONE opt-in object
+(:class:`Recorder`), extending the round-8 hot-path discipline: every
+runtime seam guards with a single ``recorder is None`` check — the
+disabled path allocates nothing and calls nothing in this module
+(pinned by test).
+
+* :class:`DecisionJournal` — every reflex decision (shed, breaker
+  transition, eviction, failover rung, tuner promotion, ...) recorded
+  as ONE :class:`~slate_tpu.obs.events.DecisionEvent` with the inputs
+  that drove it. Per-kind counts are maintained monotonically OUTSIDE
+  the ring, so the parity invariant (journal count == metric counter
+  delta, :data:`~slate_tpu.obs.events.KIND_COUNTERS`) survives ring
+  eviction.
+* :class:`FlightRecorder` — recent finished spans (fed by the Tracer's
+  ``recorder`` hook on span finish) plus throttled backpressure/gauge +
+  stage-histogram samples: the last seconds of *how the system felt*,
+  cheap enough to leave on.
+* :class:`IncidentCapture` — anomaly/breach/breaker/fault triggers
+  materialize a rate-limited, deduped ``slate_tpu.incident.v1``
+  snapshot: the recent journal slice, the flight rings, a metrics
+  snapshot, and whatever providers the session wired (numerics health,
+  quota state, placement rows, cost_log + tuning provenance for the
+  implicated handles) — written crash-safe (tmp + ``os.replace``, the
+  round-17 atomic-publish discipline) under a configurable dir and
+  kept in a memory ring for the ``/incidents`` route.
+
+The fleet story lives in :mod:`.aggregate`
+(``merge_journal_payloads`` / ``merge_incident_payloads``): N
+processes' journals fold into one host-labeled timeline with exact
+count conservation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .events import (DecisionEvent, INCIDENT_SCHEMA, JOURNAL_SCHEMA,
+                     journal_digest, validate_incident)
+
+__all__ = ["DecisionJournal", "FlightRecorder", "IncidentCapture",
+           "Recorder", "validate_incident"]
+
+_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+class DecisionJournal:
+    """Thread-safe bounded ring of :class:`DecisionEvent` rows plus
+    monotone per-kind / per-(kind, outcome) count tables (class
+    docstring above for why the counts live outside the ring)."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = int(capacity)
+        self._ring: "deque[DecisionEvent]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._counts: Dict[str, float] = {}
+        self._outcome_counts: Dict[str, float] = {}
+
+    def record(self, kind: str, *, op=None, handle=None, tenant=None,
+               inputs: Optional[dict] = None, outcome=None,
+               count: float = 1.0, trace_id=None, span_id=None,
+               ts: Optional[float] = None) -> DecisionEvent:
+        c = float(count)
+        with self._lock:
+            self._seq += 1
+            ev = DecisionEvent(
+                seq=self._seq,
+                ts=time.time() if ts is None else ts,
+                kind=kind,
+                op=None if op is None else str(op),
+                handle=None if handle is None else str(handle),
+                tenant=None if tenant is None else str(tenant),
+                inputs=inputs, outcome=outcome, count=c,
+                trace_id=trace_id, span_id=span_id)
+            self._ring.append(ev)
+            self._counts[kind] = self._counts.get(kind, 0.0) + c
+            if outcome is not None:
+                k = f"{kind}:{outcome}"
+                self._outcome_counts[k] = \
+                    self._outcome_counts.get(k, 0.0) + c
+        return ev
+
+    # -- reads ---------------------------------------------------------------
+
+    def events(self, limit: Optional[int] = None, kind=None,
+               handle=None) -> List[DecisionEvent]:
+        """Snapshot (oldest first), optionally filtered/tail-limited."""
+        with self._lock:
+            rows = list(self._ring)
+        if kind is not None:
+            rows = [e for e in rows if e.kind == kind]
+        if handle is not None:
+            h = str(handle)
+            rows = [e for e in rows if e.handle == h]
+        if limit is not None:
+            rows = rows[-int(limit):]
+        return rows
+
+    def count(self, kind: str) -> float:
+        with self._lock:
+            return self._counts.get(kind, 0.0)
+
+    def counts(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counts)
+
+    def outcome_count(self, kind: str, outcome: str) -> float:
+        with self._lock:
+            return self._outcome_counts.get(f"{kind}:{outcome}", 0.0)
+
+    def outcome_counts(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._outcome_counts)
+
+    def digest(self) -> str:
+        """Deterministic-field digest of the ring (events.py)."""
+        return journal_digest(self.events())
+
+    def payload(self) -> dict:
+        """The ``/journal`` route document."""
+        with self._lock:
+            rows = [e.to_dict() for e in self._ring]
+            recorded = self._seq
+            counts = dict(self._counts)
+            outcome_counts = dict(self._outcome_counts)
+        return {
+            "schema": JOURNAL_SCHEMA,
+            "capacity": self.capacity,
+            "recorded": recorded,
+            "dropped": recorded - len(rows),
+            "counts": counts,
+            "outcome_counts": outcome_counts,
+            "events": rows,
+        }
+
+
+class FlightRecorder:
+    """Bounded rings of recent finished spans and throttled gauge/
+    stage-histogram samples (module docstring)."""
+
+    def __init__(self, span_capacity: int = 256,
+                 sample_capacity: int = 64,
+                 sample_interval_s: float = 0.25,
+                 clock: Callable[[], float] = time.time):
+        self._spans: "deque[dict]" = deque(maxlen=int(span_capacity))
+        self._samples: "deque[dict]" = deque(maxlen=int(sample_capacity))
+        self.sample_interval_s = float(sample_interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_sample = 0.0
+
+    def record_span(self, span) -> None:
+        """Tracer ``finish_span`` hook: one finished span into the
+        ring (duck-typed on the Span fields; never raises into the
+        tracer)."""
+        try:
+            end = span.end
+            row = {
+                "ts": self._clock(), "name": span.name,
+                "kind": span.kind, "trace_id": span.trace_id,
+                "span_id": span.span_id, "status": span.status,
+                "dur_s": (end - span.start) if end is not None else None,
+            }
+        except Exception:
+            return
+        with self._lock:
+            self._spans.append(row)
+
+    def sample(self, metrics, now: Optional[float] = None) -> dict:
+        """One backpressure sample: every gauge plus the lifecycle
+        ``stage_*`` histogram snapshots."""
+        now = self._clock() if now is None else now
+        snap = metrics.snapshot()
+        row = {
+            "ts": now,
+            "gauges": snap.get("gauges", {}),
+            "stages": {k: v for k, v in snap.get("histograms",
+                                                 {}).items()
+                       if k.startswith("stage_")},
+        }
+        with self._lock:
+            self._samples.append(row)
+            self._last_sample = now
+        return row
+
+    def maybe_sample(self, metrics) -> Optional[dict]:
+        """Throttled :meth:`sample` (at most one per interval) — the
+        journal calls this on every decision, so the sample ring
+        tracks exactly the windows where the system was deciding
+        things, without hot-loop cost."""
+        now = self._clock()
+        with self._lock:
+            if now - self._last_sample < self.sample_interval_s:
+                return None
+        return self.sample(metrics, now)
+
+    def payload(self) -> dict:
+        with self._lock:
+            return {"spans": list(self._spans),
+                    "samples": list(self._samples)}
+
+
+class IncidentCapture:
+    """Rate-limited, deduped materialization of incident snapshots
+    (module docstring). ``providers`` maps section name -> zero-arg
+    callable; every provider failure is captured as an error string,
+    never raised into the triggering seam."""
+
+    def __init__(self, journal: DecisionJournal, flight: FlightRecorder,
+                 dir: Optional[str] = None, rate_limit_s: float = 5.0,
+                 dedup_window_s: float = 60.0, capacity: int = 32,
+                 journal_slice: int = 64, host: Optional[str] = None,
+                 metrics=None, clock: Callable[[], float] = time.time):
+        self.journal = journal
+        self.flight = flight
+        self.dir = dir
+        self.rate_limit_s = float(rate_limit_s)
+        self.dedup_window_s = float(dedup_window_s)
+        self.journal_slice = int(journal_slice)
+        self.host = host or f"pid{os.getpid()}"
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=int(capacity))
+        self._seq = 0
+        self._last_capture = None          # ts of last capture (any)
+        self._last_by_key: Dict[str, float] = {}
+        self.providers: Dict[str, Callable[[], object]] = {}
+
+    # -- the trigger ---------------------------------------------------------
+
+    def trigger(self, reason: str, key=None,
+                context: Optional[dict] = None,
+                handle=None) -> Optional[dict]:
+        """One anomalous transition. Returns the captured incident
+        document, or None when deduped / rate-limited (counted either
+        way on the attached metrics)."""
+        now = self._clock()
+        dedup_key = f"{reason}:{key}"
+        with self._lock:
+            seen = self._last_by_key.get(dedup_key)
+            if seen is not None and now - seen < self.dedup_window_s:
+                if self.metrics is not None:
+                    self.metrics.inc("incidents_deduped_total")
+                return None
+            if (self._last_capture is not None
+                    and now - self._last_capture < self.rate_limit_s):
+                if self.metrics is not None:
+                    self.metrics.inc("incidents_rate_limited_total")
+                return None
+            self._seq += 1
+            seq = self._seq
+            self._last_capture = now
+            self._last_by_key[dedup_key] = now
+        doc = self._capture(seq, now, reason, key, context, handle)
+        with self._lock:
+            self._ring.append(doc)
+        if self.metrics is not None:
+            self.metrics.inc("incidents_captured_total")
+        if self.dir is not None:
+            self._publish(doc)
+        return doc
+
+    # -- capture -------------------------------------------------------------
+
+    def _section(self, name: str):
+        fn = self.providers.get(name)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception as e:  # never fail the triggering seam
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _capture(self, seq, now, reason, key, context, handle) -> dict:
+        events = self.journal.events(limit=self.journal_slice)
+        if handle is not None:
+            # the implicated handle's slice rides along even when the
+            # tail window is dominated by other traffic
+            h = str(handle)
+            tail_seqs = {e.seq for e in events}
+            events = ([e for e in self.journal.events(handle=h)
+                       if e.seq not in tail_seqs] + events)
+            events.sort(key=lambda e: e.seq)
+        metrics_snap = self._section("metrics") or {"counters": {},
+                                                    "gauges": {}}
+        return {
+            "schema": INCIDENT_SCHEMA,
+            "id": f"inc-{seq:04d}-{_SAFE.sub('_', str(reason))}",
+            "ts": now,
+            "host": self.host,
+            "reason": str(reason),
+            "key": None if key is None else str(key),
+            "context": dict(context) if context else {},
+            "journal": {
+                "events": [e.to_dict() for e in events],
+                "counts": self.journal.counts(),
+                "outcome_counts": self.journal.outcome_counts(),
+            },
+            "flight": self.flight.payload(),
+            "metrics": {
+                "counters": metrics_snap.get("counters", {}),
+                "gauges": metrics_snap.get("gauges", {}),
+            },
+            "numerics": self._section("numerics"),
+            "quotas": self._section("quotas"),
+            "placement": self._section("placement"),
+            "cost_log": self._section("cost_log"),
+            "tuning": self._section("tuning"),
+        }
+
+    def _publish(self, doc: dict) -> None:
+        """Crash-safe single-file publish: write sibling tmp, fsync,
+        ``os.replace`` (a reader never sees a torn incident)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            path = os.path.join(self.dir, f"{doc['id']}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            if self.metrics is not None:
+                self.metrics.inc("incident_write_errors_total")
+
+    # -- reads ---------------------------------------------------------------
+
+    def incidents(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def payload(self) -> dict:
+        """The ``/incidents`` route document."""
+        with self._lock:
+            rows = list(self._ring)
+            captured = self._seq
+        return {
+            "schema": "slate_tpu.incidents.v1",
+            "host": self.host,
+            "captured": captured,
+            "dir": self.dir,
+            "incidents": rows,
+        }
+
+
+class Recorder:
+    """The facade the runtime seams hold: one journal, one flight
+    recorder, one incident capture. ``session.recorder`` (and
+    ``fleet.recorder``) default to None; every seam guards with one
+    is-None check (module docstring)."""
+
+    def __init__(self, journal_capacity: int = 1024,
+                 flight_spans: int = 256, flight_samples: int = 64,
+                 incident_dir: Optional[str] = None,
+                 rate_limit_s: float = 5.0,
+                 dedup_window_s: float = 60.0,
+                 incident_capacity: int = 32,
+                 journal_slice: int = 64,
+                 host: Optional[str] = None,
+                 metrics=None, tracer=None,
+                 clock: Callable[[], float] = time.time):
+        self.metrics = metrics
+        self.tracer = tracer
+        self.journal = DecisionJournal(capacity=journal_capacity)
+        self.flight = FlightRecorder(span_capacity=flight_spans,
+                                     sample_capacity=flight_samples,
+                                     clock=clock)
+        self.incidents = IncidentCapture(
+            self.journal, self.flight, dir=incident_dir,
+            rate_limit_s=rate_limit_s, dedup_window_s=dedup_window_s,
+            capacity=incident_capacity, journal_slice=journal_slice,
+            host=host, metrics=metrics, clock=clock)
+        self.providers = self.incidents.providers  # one wiring surface
+
+    # -- seam entry points ---------------------------------------------------
+
+    def decision(self, kind: str, *, op=None, handle=None, tenant=None,
+                 inputs: Optional[dict] = None, outcome=None,
+                 count: float = 1.0) -> DecisionEvent:
+        """Record one reflex decision (joined to the current span when
+        a tracer rides along) and opportunistically refresh the
+        backpressure sample ring."""
+        trace_id = span_id = None
+        t = self.tracer
+        if t is not None and t.enabled:
+            cur = t.current()
+            if cur is not None:
+                trace_id, span_id = cur.trace_id, cur.span_id
+        ev = self.journal.record(kind, op=op, handle=handle,
+                                 tenant=tenant, inputs=inputs,
+                                 outcome=outcome, count=count,
+                                 trace_id=trace_id, span_id=span_id)
+        if self.metrics is not None:
+            self.flight.maybe_sample(self.metrics)
+        return ev
+
+    def incident(self, reason: str, key=None,
+                 context: Optional[dict] = None,
+                 handle=None) -> Optional[dict]:
+        if self.metrics is not None:
+            self.flight.maybe_sample(self.metrics)
+        return self.incidents.trigger(reason, key=key, context=context,
+                                      handle=handle)
+
+    # -- hooks ---------------------------------------------------------------
+
+    def watchdog_listener(self, row: dict) -> None:
+        """``Watchdog.add_listener`` target: every anomaly row is an
+        incident trigger (the watchdog already emits only on ok ->
+        anomalous transitions, so scrape loops cannot restorm this)."""
+        self.incident("watchdog_anomaly",
+                      key=row.get("series") or row.get("metric"),
+                      context=row)
+
+    def span_finished(self, span) -> None:
+        """Tracer hook (``tracer.recorder``): finished spans feed the
+        flight ring."""
+        self.flight.record_span(span)

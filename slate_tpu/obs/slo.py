@@ -159,6 +159,10 @@ class SloTracker:
             default_objectives() if objectives is None else objectives)
         self.metrics = metrics
         self.tracer = tracer
+        # incident hook (obs/recorder.py): a breach TRANSITION (ok ->
+        # breached, already deduped under the lock below) triggers
+        # black-box capture; None = one is-None check
+        self.recorder = None
         self._clock = clock
         self._max = max_events
         self._lock = threading.Lock()
@@ -369,3 +373,10 @@ class SloTracker:
                     "slo.breach", kind="anomaly", objective=obj.name,
                     slo_kind=obj.kind, target=obj.target,
                     worst_burn_rate=worst))
+            rec = self.recorder
+            if rec is not None:
+                rec.incident(
+                    "slo_breach", key=obj.name,
+                    context={"objective": obj.name, "kind": obj.kind,
+                             "target": obj.target,
+                             "worst_burn_rate": worst})

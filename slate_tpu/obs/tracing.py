@@ -144,6 +144,9 @@ class Tracer:
                  max_spans: int = 65536, max_slow: int = 256):
         self.enabled = False
         self.slow_threshold = slow_threshold
+        # flight-recorder hook (obs/recorder.py): finished spans feed
+        # its bounded ring; None = one is-None check, nothing else
+        self.recorder = None
         self._lock = threading.Lock()
         self._spans: List[Span] = []
         self._max_spans = max_spans
@@ -235,6 +238,9 @@ class Tracer:
                 self._spans.append(span)
             else:
                 self._dropped += 1
+        rec = self.recorder
+        if rec is not None:
+            rec.span_finished(span)
         if span.kind == "request" and self.slow_threshold is not None:
             total = float(span.attrs.get("total_s", dur))
             if total >= self.slow_threshold:

@@ -149,7 +149,11 @@ class Watchdog:
         # with the anomaly row dict. The online tuner's trigger seam:
         # ShadowTuner.attach() registers here. Listener exceptions are
         # swallowed (a broken consumer must never kill the check loop)
+        # — but COUNTED (watchdog_listener_errors_total) and logged
+        # once per listener (round 22: a silently-dead incident
+        # capture hook defeats the whole black box)
         self._listeners: List = []
+        self._listener_warned: set = set()
 
     def add_listener(self, fn) -> None:
         """Register ``fn(row)`` to be called on each ok -> anomalous
@@ -307,7 +311,18 @@ class Watchdog:
                 try:
                     fn(row)
                 except Exception:
-                    log.exception("watchdog listener failed")
+                    if self.metrics is not None:
+                        self.metrics.inc("watchdog_listener_errors_total")
+                    # log-once-per-listener: a listener that fails on
+                    # every anomaly must not drown the log the check
+                    # loop is trying to protect
+                    if id(fn) not in self._listener_warned:
+                        self._listener_warned.add(id(fn))
+                        log.exception(
+                            "watchdog listener %r failed (counted in "
+                            "watchdog_listener_errors_total; further "
+                            "failures of this listener log at this "
+                            "site only once)", fn)
 
 
 def _serve_roof_fraction(snap: dict) -> Optional[float]:

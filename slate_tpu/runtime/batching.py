@@ -377,14 +377,24 @@ class Batcher:
         (``quota_rejections_total`` + the tenant-labeled
         ``quota_rejected`` outcome); everything else is the round-14
         admission bound."""
+        rec = self.session.recorder
         if isinstance(rejection, QuotaExceeded):
             self.session.metrics.inc("quota_rejections_total")
             attr = self.session.attribution
             if attr is not None:
                 attr.record_outcome(self._rtenant(req), req.handle,
                                     "quota_rejected")
+            if rec is not None:
+                rec.decision("quota_reject", handle=req.handle,
+                             tenant=self._rtenant(req),
+                             outcome="rejected",
+                             inputs={"error": str(rejection)})
         else:
             self.session.metrics.inc("admission_rejected_total")
+            if rec is not None:
+                rec.decision("admission_reject", handle=req.handle,
+                             tenant=req.tenant, outcome="rejected",
+                             inputs={"error": str(rejection)})
         req.future.set_exception(rejection)
 
     def pending(self) -> int:
@@ -568,6 +578,12 @@ class Batcher:
             if attr is not None:
                 attr.record_outcome(self._rtenant(r), r.handle,
                                     "expired")
+            rec = self.session.recorder
+            if rec is not None:
+                rec.decision("deadline_expired", handle=r.handle,
+                             tenant=r.tenant, outcome="failed_fast",
+                             inputs={"queue_s": now - r.t_submit,
+                                     "deadline_s": r.deadline})
             if tr.enabled:
                 sp = r.span or tr.start_span(
                     "serve.request", kind="request",
@@ -707,6 +723,15 @@ class Batcher:
                 tr.finish_span(sp, shed=True)
                 r.span = None
         m.inc("shed_requests_total", shed)
+        rec = self.session.recorder
+        if rec is not None and shed:
+            # ONE wave = ONE decision; count carries the victim total
+            # (journal parity vs shed_requests_total sums count)
+            rec.decision("shed", tenant=shed_tenant, outcome=trigger,
+                         count=shed,
+                         inputs={"trigger": trigger,
+                                 "queued": len(queued),
+                                 "victims": shed})
         return shed
 
     # -- dispatch ----------------------------------------------------------

@@ -321,6 +321,11 @@ class Executor:
         probing = br is not None and br.state == "half_open"
         if probing:
             m.inc("breaker_probes_total")
+            rec = self.session.recorder
+            if rec is not None:
+                rec.decision("breaker_probe", handle=bk,
+                             outcome="half_open",
+                             inputs={"failures": br.failures})
 
         def _fail_spans(e, attempt):
             for r in reqs:
@@ -338,6 +343,11 @@ class Executor:
                 if br is not None and br.record_ok():
                     m.inc("breaker_closes_total")
                     self._publish_breakers()
+                    rec = self.session.recorder
+                    if rec is not None:
+                        rec.decision("breaker_close", handle=bk,
+                                     outcome="closed",
+                                     inputs={"attempt": attempt})
                 return
             except SlateError as e:
                 err = e
@@ -363,6 +373,19 @@ class Executor:
                     "circuit breaker OPEN for %s after %d consecutive "
                     "dispatch failures; degrading per the ladder %s",
                     bk, br.failures, DEGRADATION_LADDER)
+                rec = self.session.recorder
+                if rec is not None:
+                    rec.decision(
+                        "breaker_open", handle=bk, outcome="open",
+                        inputs={"failures": br.failures,
+                                "error": f"{type(err).__name__}: "
+                                         f"{err}",
+                                "cooldown_s": self.breaker_cooldown})
+                    # a breaker trip is an incident trigger (tentpole):
+                    # capture the journal/flight context around it
+                    rec.incident("breaker_open", key=str(bk),
+                                 handle=bk,
+                                 context={"failures": br.failures})
             if br.state == "open":
                 # the tripping bucket itself takes the degraded lane —
                 # its requests deserve the reflex, not the corpse of

@@ -268,6 +268,9 @@ class FaultInjector:
         self._by_site: Dict[str, Tuple[FaultSpec, ...]] = {
             site: tuple(s for s in plan.specs if s.kind in kinds)
             for site, kinds in SITES.items()}
+        # incident hook (obs/recorder.py): a firing is an anomaly
+        # worth black-box capture; None = one is-None check
+        self.recorder = None
         self._lock = threading.Lock()
         self._seq: Dict[str, int] = defaultdict(int)
         self._fired: Dict[str, int] = defaultdict(int)
@@ -301,6 +304,12 @@ class FaultInjector:
                     self._fired[spec.kind] += 1
                     self.log.append((site, spec.kind, seq))
                     fired.append(spec)
+        rec = self.recorder
+        if rec is not None and fired:
+            # outside the lock: incident providers walk session state
+            rec.incident("fault", key=site,
+                         context={"site": site,
+                                  "kinds": [s.kind for s in fired]})
         return tuple(fired)
 
     def hook(self, site: str):

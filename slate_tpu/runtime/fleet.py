@@ -134,11 +134,15 @@ class Fleet:
                  shed_policy: Optional[ShedPolicy] = None,
                  checkpoint_root: Optional[str] = None,
                  vnodes: int = 16, faults=None,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None, recorder=None):
         if not sessions:
             raise SlateError("Fleet: at least one member session")
         self.metrics = metrics or Metrics()
         self.faults = faults
+        # decision journal (obs/recorder.py): coordinator reflexes
+        # (failover rungs, migration, sync choice) are decisions too;
+        # None = one is-None check per seam (round-8 discipline)
+        self.recorder = recorder
         self.checkpoint_root = checkpoint_root
         self._members: Dict[str, _Member] = {}
         for name, sess in sessions.items():
@@ -422,6 +426,14 @@ class Fleet:
                                      stats["sync_bytes"])
                     self.metrics.inc("fleet_full_sync_bytes",
                                      stats["full_bytes"])
+                    rec = self.recorder
+                    if rec is not None:
+                        rec.decision(
+                            "delta_sync", handle=handle,
+                            outcome=target.name,
+                            inputs={"primary": primary.name,
+                                    "sync_bytes": stats["sync_bytes"],
+                                    "full_bytes": stats["full_bytes"]})
             finally:
                 shutil.rmtree(ddir, ignore_errors=True)
         if not synced:
@@ -440,6 +452,14 @@ class Fleet:
                     for rec in manifest.get("records", [])
                     for k_ in ("operator", "payload")
                     for b in _iter_manifest_blobs(rec.get(k_))))
+            jrec = self.recorder
+            if jrec is not None:
+                # the delta-vs-full CHOICE: full because no trusted
+                # base existed or the delta restore fell through
+                jrec.decision("full_sync", handle=handle,
+                              outcome=target.name,
+                              inputs={"primary": primary.name,
+                                      "had_base": base is not None})
             with self._lock:
                 self._replica_base[key] = (bdir, manifest)
             return
@@ -549,6 +569,14 @@ class Fleet:
                 # the source is untouched and KEEPS SERVING; counted,
                 # and the second pass is the counted retry
                 self.metrics.inc("fleet_migration_aborts_total")
+                rec = self.recorder
+                if rec is not None:
+                    rec.decision(
+                        "migration_abort", handle=handle,
+                        outcome="retry" if attempt == 0 else "gave_up",
+                        inputs={"source": source.name,
+                                "target": tmem.name,
+                                "attempt": attempt})
                 if attempt == 0:
                     self.metrics.inc("fleet_migration_retries_total")
                     continue
@@ -589,6 +617,13 @@ class Fleet:
         self.metrics.inc("fleet_migrations_total")
         if moved:
             self.metrics.inc("fleet_migrations_warm")
+        rec = self.recorder
+        if rec is not None:
+            rec.decision("migration", handle=handle,
+                         outcome="warm" if moved else "cold",
+                         inputs={"source": source.name,
+                                 "target": tmem.name,
+                                 "resident": resident})
         _obs_log.warning(
             "fleet: migrated %r from %r to %r (%s)", handle,
             source.name, tmem.name,
@@ -843,6 +878,7 @@ class Fleet:
                     "(%s); falling through to cold re-register",
                     dead.name, e)
                 ckpt = None
+        rec = self.recorder
         for h in affected:
             if h not in was_primary:
                 # only a replica died — the primary never stopped
@@ -864,17 +900,34 @@ class Fleet:
                                  self.faults.fire("fleet.replica")))
                 if not stale:
                     self.metrics.inc("fleet_failover_replica_served")
+                    # ONE failover decision per counted handle; the
+                    # rung taken rides the outcome (OUTCOME_COUNTERS
+                    # carries the per-rung counter parity)
+                    if rec is not None:
+                        rec.decision("failover", handle=h,
+                                     outcome="replica",
+                                     inputs={"dead": dead.name,
+                                             "replicas": places})
                     continue
                 self.metrics.inc("fleet_replica_stale_refreshes")
                 _obs_log.warning(
                     "fleet: replica of %r is stale; refreshing "
                     "(evict + refactor-on-miss)", h)
+                if rec is not None:
+                    rec.decision("failover", handle=h,
+                                 outcome="stale_refresh",
+                                 inputs={"dead": dead.name,
+                                         "replicas": places})
                 for pname in places:
                     self._members[pname].session.evict(h)
                 continue
             target = self._first_alive(self.ring_order(h))
             if target is None:
                 _obs_log.warning("fleet: no survivor for handle %r", h)
+                if rec is not None:
+                    rec.decision("failover", handle=h,
+                                 outcome="no_survivor",
+                                 inputs={"dead": dead.name})
                 continue
             registered = False
             if ckpt is not None:
@@ -888,13 +941,27 @@ class Fleet:
                     registered = True
                     if h in summary["restored"]:
                         self.metrics.inc("fleet_failover_restored")
+                        if rec is not None:
+                            rec.decision("failover", handle=h,
+                                         outcome="restored",
+                                         inputs={"dead": dead.name,
+                                                 "target": target.name})
                     else:
                         self.metrics.inc("fleet_failover_refactor")
+                        if rec is not None:
+                            rec.decision("failover", handle=h,
+                                         outcome="refactor",
+                                         inputs={"dead": dead.name,
+                                                 "target": target.name})
             if not registered:
                 # rung 3 (the floor): re-register the retained spec
                 # cold — counted refactor-on-miss on first touch
                 spec = self._specs.get(h)
                 if spec is None:
+                    if rec is not None:
+                        rec.decision("failover", handle=h,
+                                     outcome="no_spec",
+                                     inputs={"dead": dead.name})
                     continue
                 try:
                     target.session.register(spec.A, op=spec.op,
@@ -903,8 +970,17 @@ class Fleet:
                     _obs_log.warning(
                         "fleet: cold re-register of %r failed (%s)",
                         h, e)
+                    if rec is not None:
+                        rec.decision("failover", handle=h,
+                                     outcome="register_failed",
+                                     inputs={"dead": dead.name,
+                                             "error": str(e)})
                     continue
                 self.metrics.inc("fleet_failover_cold")
+                if rec is not None:
+                    rec.decision("failover", handle=h, outcome="cold",
+                                 inputs={"dead": dead.name,
+                                         "target": target.name})
             with self._lock:
                 self._placement[h] = [target.name]
 

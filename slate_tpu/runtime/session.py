@@ -326,6 +326,15 @@ class Session:
         # makes dispatch failures, slow devices, compile stalls, HBM
         # exhaustion, and refine non-convergence reproducible inputs.
         self.faults = faults
+        # flight recorder + decision journal (round 22,
+        # obs/recorder.py): None = disabled — every reflex seam guards
+        # with ONE `recorder is None` check and allocates nothing (the
+        # round-8 discipline, pinned by test). enable_recorder() is
+        # the opt-in; every counted reflex decision then also lands a
+        # structured DecisionEvent (events.KIND_COUNTERS parity,
+        # pinned), and anomaly/breach/breaker/fault transitions
+        # capture rate-limited incident snapshots.
+        self.recorder = None
         self.opts = opts
         # mixed-precision policy table (round 13): register(...,
         # refine=True) resolves its RefinePolicy here per
@@ -396,6 +405,9 @@ class Session:
             if self.slo is None:
                 self.slo = SloTracker(objectives, metrics=self.metrics,
                                       tracer=self.tracer, **kw)
+                if self.recorder is not None:
+                    # breach transitions are incident triggers (rd 22)
+                    self.slo.recorder = self.recorder
             return self.slo
 
     def enable_attribution(self, halflife_s: float = 300.0, **kw):
@@ -450,6 +462,21 @@ class Session:
             self.metrics.inc("residency_byte_seconds_total", inc)
         attr.touch_eviction(handle)
 
+    def _journal_evict(self, rec, handle, nbytes, reason,
+                       entry=None, **inputs):
+        """Caller verified ``rec`` (= self.recorder) is not None: ONE
+        reason-tagged eviction DecisionEvent — every seam that bumps
+        the ``evictions`` counter funnels here, so the journal/counter
+        parity per events.KIND_COUNTERS stays exact."""
+        if entry is None:
+            entry = self._ops.get(handle)
+        rec.decision("eviction",
+                     op=None if entry is None else entry.op,
+                     handle=handle,
+                     tenant=None if entry is None else entry.tenant,
+                     outcome=reason,
+                     inputs=dict(inputs, nbytes=nbytes))
+
     def enable_faults(self, plan=None, seed: int = 1):
         """Attach a :class:`~.faults.FaultInjector` built from ``plan``
         (default: :func:`~.faults.default_plan` under ``seed``) and
@@ -462,7 +489,53 @@ class Session:
         elif isinstance(plan, dict):
             plan = FaultPlan.from_dict(plan)
         self.faults = FaultInjector(plan)
+        if self.recorder is not None:
+            # injector firings are incident triggers (round 22)
+            self.faults.recorder = self.recorder
         return self.faults
+
+    def enable_recorder(self, incident_dir: Optional[str] = None,
+                        host: Optional[str] = None, **kw):
+        """Attach an :class:`~..obs.recorder.Recorder` (round 22): the
+        decision journal + flight recorder + incident capture, bound
+        to this session's metrics and tracer; idempotent — a second
+        call returns the running recorder. ``incident_dir`` enables
+        crash-safe on-disk incident snapshots (atomic publish);
+        ``kw`` forwards ring capacities and rate-limit/dedup windows.
+        The ``/journal`` and ``/incidents`` routes of
+        :meth:`serve_obs` serve its payloads."""
+        from ..obs.recorder import Recorder
+        with self._lock:
+            if self.recorder is None:
+                rec = Recorder(incident_dir=incident_dir, host=host,
+                               metrics=self.metrics,
+                               tracer=self.tracer, **kw)
+                rec.providers.update({
+                    "metrics": self.metrics.snapshot,
+                    "numerics": self.numerics_payload,
+                    "quotas": self.quotas_payload,
+                    "placement": self.placement_snapshot,
+                    # the newest rows carry the implicated programs'
+                    # compile provenance; the full log stays on /costs
+                    "cost_log": lambda: list(self.cost_log[-64:]),
+                    "tuning": self._tuning_provenance,
+                })
+                # finished spans feed the flight ring (tracing hook)
+                self.tracer.recorder = rec
+                if self.faults is not None:
+                    self.faults.recorder = rec
+                if self.slo is not None:
+                    self.slo.recorder = rec
+                self.recorder = rec
+            return self.recorder
+
+    def _tuning_provenance(self) -> dict:
+        """Incident-capture section: which handles serve under which
+        resolved/promoted config right now."""
+        with self._lock:
+            handles = {repr(h): e.tuned for h, e in self._ops.items()
+                       if getattr(e, "tuned", None) is not None}
+        return {"table": self.tuning is not None, "handles": handles}
 
     def _fault(self, site: str):
         """Apply one fault opportunity at ``site`` (caller verified
@@ -551,6 +624,16 @@ class Session:
                 if self.attribution is not None:
                     self._attr_evicted(handle)
             self.metrics.inc("refine_demotions_total")
+            rec = self.recorder
+            if rec is not None:
+                if dropped is not None:
+                    rec.decision("eviction", op=entry.op, handle=handle,
+                                 tenant=entry.tenant,
+                                 outcome="refine_demotion",
+                                 inputs={"nbytes": dropped.nbytes})
+                rec.decision("refine_demotion", op=entry.op,
+                             handle=handle, tenant=entry.tenant,
+                             outcome="working_precision")
             self._update_hbm_gauges()
         _obs_log.warning(
             "degradation ladder: operator %r demoted to working "
@@ -575,6 +658,16 @@ class Session:
             return
         if new == "suspect" and entry.refine is not None:
             self.metrics.inc("health_demotions_total")
+            rec = self.recorder
+            if rec is not None:
+                _st, condest, growth = \
+                    self.numerics.placement_info(handle)
+                rec.decision("health_demotion", op=entry.op,
+                             handle=handle, tenant=entry.tenant,
+                             inputs={"from": old, "to": new,
+                                     "condest": condest,
+                                     "growth": growth},
+                             outcome="suspect")
             _obs_log.warning(
                 "numerics reflex: suspect operator %r demoted off the "
                 "refine ladder", handle)
@@ -1091,13 +1184,17 @@ class Session:
     def unregister(self, handle: Hashable):
         """Drop an operator and its cached factor (no error if absent)."""
         with self._lock:
-            self._ops.pop(handle, None)
+            entry = self._ops.pop(handle, None)
             res = self._cache.pop(handle, None)
             if res is not None:
                 self.metrics.inc("evictions")
                 self.metrics.inc("evicted_bytes", res.nbytes)
                 if self.attribution is not None:
                     self._attr_evicted(handle)
+                rec = self.recorder
+                if rec is not None:
+                    self._journal_evict(rec, handle, res.nbytes,
+                                        "unregister", entry=entry)
             if self.attribution is not None:
                 # the handle can never be accessed again: drop its
                 # heat/residency clocks (and gauge) so handle churn
@@ -1139,6 +1236,10 @@ class Session:
                 self.metrics.inc("evicted_bytes", res.nbytes)
                 if self.attribution is not None:
                     self._attr_evicted(handle)
+                rec = self.recorder
+                if rec is not None:
+                    self._journal_evict(rec, handle, res.nbytes,
+                                        "explicit")
             self._update_hbm_gauges()
         return res is not None
 
@@ -1153,6 +1254,12 @@ class Session:
             self._update_hbm_gauges()
         self.metrics.inc("evictions", n)
         self.metrics.inc("evicted_bytes", nbytes)
+        rec = self.recorder
+        if rec is not None and n:
+            # one sweep, one decision: count carries the victim total
+            # so journal-count parity vs the ``evictions`` counter holds
+            rec.decision("eviction", outcome="clear_cache", count=n,
+                         inputs={"nbytes": nbytes})
 
     def factor(self, handle: Hashable) -> _Resident:
         """Resident factor for ``handle``: cache hit or refactor-on-miss
@@ -1212,6 +1319,16 @@ class Session:
                         "%r failed (info=%d); refactoring at working "
                         "precision", entry.refine.factor_dtype, handle,
                         res.info)
+                    rec = self.recorder
+                    if rec is not None:
+                        rec.decision(
+                            "refine_fallback", op=entry.op,
+                            handle=handle, tenant=entry.tenant,
+                            outcome="lo_factor_failed",
+                            inputs={
+                                "info": int(res.info),
+                                "factor_dtype":
+                                    str(entry.refine.factor_dtype)})
                     if not entry.refine.fallback:
                         raise SlateError(
                             f"Session: low-precision factor of "
@@ -1627,6 +1744,10 @@ class Session:
             self.metrics.inc("evicted_bytes", nbytes)
             if self.attribution is not None:
                 self._attr_evicted(h)
+            rec = self.recorder
+            if rec is not None:
+                self._journal_evict(rec, h, nbytes, "budget",
+                                    used=used, budget=budget)
         if used > budget:
             # the kept factor (+ program transient) alone exceeds the
             # budget; serving must continue, but this is OOM risk —
@@ -1704,6 +1825,13 @@ class Session:
                 self.metrics.inc("tenant_quota_evictions_total")
                 if self.attribution is not None:
                     self._attr_evicted(h)
+                # ONE decision, TWO counters (evictions + the tenant
+                # quota secondary): outcome "tenant_quota" carries the
+                # OUTCOME_COUNTERS parity for the second one
+                rec = self.recorder
+                if rec is not None:
+                    self._journal_evict(rec, h, nbytes, "tenant_quota",
+                                        used=used, sub_budget=sub)
             if used > sub:
                 self.metrics.inc("tenant_quota_overflows")
                 _obs_log.warning(
@@ -2179,6 +2307,13 @@ class Session:
                 "refine fallback: small operator %r did not converge "
                 "in %d iterations (factor_dtype=%s)", handle,
                 policy.max_iters, policy.factor_dtype)
+            rec = self.recorder
+            if rec is not None:
+                rec.decision("refine_fallback", op=entry.op,
+                             handle=handle, tenant=tenant,
+                             outcome="not_converged",
+                             inputs={"iters": iters,
+                                     "max_iters": policy.max_iters})
             if not policy.fallback:
                 raise SlateError(
                     f"Session: refined solve of {handle!r} did not "
@@ -2191,6 +2326,9 @@ class Session:
                 self.metrics.inc("evicted_bytes", dropped.nbytes)
                 if self.attribution is not None:
                     self._attr_evicted(handle)
+                if rec is not None:
+                    self._journal_evict(rec, handle, dropped.nbytes,
+                                        "refine_fallback", entry=entry)
             return None
         self.metrics.inc("refine_converged_total")
         ex = getattr(ph.span, "trace_id", None)
@@ -2510,6 +2648,14 @@ class Session:
                             "refine fallback: grouped small operator %r "
                             "did not converge in %d iterations", h,
                             pol.max_iters)
+                        rec = self.recorder
+                        if rec is not None:
+                            rec.decision(
+                                "refine_fallback", op=e.op, handle=h,
+                                tenant=e.tenant,
+                                outcome="not_converged",
+                                inputs={"max_iters": pol.max_iters,
+                                        "grouped": True})
                         if not pol.fallback:
                             raise SlateError(
                                 f"Session: refined solve of {h!r} did "
@@ -2524,6 +2670,10 @@ class Session:
                                                  dropped.nbytes)
                                 if self.attribution is not None:
                                     self._attr_evicted(h)
+                                if rec is not None:
+                                    self._journal_evict(
+                                        rec, h, dropped.nbytes,
+                                        "refine_fallback", entry=e)
                         res_i = self.factor(h)
                         infos_req[i] = res_i.info
                         if res_i.info != 0:
@@ -2999,6 +3149,13 @@ class Session:
             "(factor_dtype=%s, strategy=%s); refactoring at working "
             "precision", handle, policy.max_iters, policy.factor_dtype,
             policy.strategy)
+        rec = self.recorder
+        if rec is not None:
+            rec.decision("refine_fallback", op=entry.op, handle=handle,
+                         tenant=tenant, outcome="not_converged",
+                         inputs={"iters": iters,
+                                 "max_iters": policy.max_iters,
+                                 "strategy": policy.strategy})
         if tr.enabled:
             with tr.span("refine.fallback", handle=repr(handle),
                          iters=iters):
@@ -3015,6 +3172,9 @@ class Session:
             self.metrics.inc("evicted_bytes", dropped.nbytes)
             if self.attribution is not None:
                 self._attr_evicted(handle)
+            if rec is not None:
+                self._journal_evict(rec, handle, dropped.nbytes,
+                                    "refine_fallback", entry=entry)
         res2 = self.factor(handle)
         if res2.info != 0:
             raise SlateError(
@@ -3479,6 +3639,9 @@ class Session:
         self.metrics.inc("evicted_bytes", res.nbytes)
         if self.attribution is not None:
             self._attr_evicted(handle)
+        rec = self.recorder
+        if rec is not None:
+            self._journal_evict(rec, handle, res.nbytes, "update")
         self._update_hbm_gauges()
 
     def _update_refactor(self, entry: _Operator, handle: Hashable,
@@ -3489,6 +3652,14 @@ class Session:
         which either serves correctly or reports its own info, never
         a wrong answer from a half-maintained factor."""
         self.metrics.inc("update_refactors_total")
+        rec = self.recorder
+        if rec is not None:
+            # outcome carries the degrade reason; reason "budget" is
+            # the OUTCOME_COUNTERS slice that mirrors
+            # update_budget_refactors_total (one decision, two counters)
+            rec.decision("update_refactor", op=entry.op, handle=handle,
+                         tenant=entry.tenant, outcome=reason,
+                         inputs={"applied": applied})
         self._update_evict(handle)
         res = self.factor(handle)
         return {"applied": applied, "refactored": True,
@@ -4020,7 +4191,8 @@ class Session:
                     tenants=lambda: self.tenants_payload(),
                     attribution=lambda: self.attribution,
                     numerics=lambda: self.numerics_payload(),
-                    quotas=lambda: self.quotas_payload())
+                    quotas=lambda: self.quotas_payload(),
+                    recorder=lambda: self.recorder)
             return self._obs_server
 
     def close_obs(self):

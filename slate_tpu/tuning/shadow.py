@@ -144,6 +144,11 @@ class ShadowTuner:
             entry.tuned = st.prev_label
             sess._cache.pop(handle, None)
         sess.metrics.inc("tuner_demotions_total")
+        rec = sess.recorder
+        if rec is not None:
+            rec.decision("tuner_demote", handle=handle,
+                         outcome="watchdog_reflag",
+                         inputs={"config": st.candidate_label})
         self._event("tuner.demotion", handle=repr(handle),
                     config=st.candidate_label)
         log.warning("tuner demotion: %r back from %s (watchdog re-flag)",
@@ -245,6 +250,12 @@ class ShadowTuner:
         except Exception as e:
             sess.metrics.inc("tuner_rejections_total")
             self._breaker_bump()
+            rec = sess.recorder
+            if rec is not None:
+                rec.decision("tuner_reject", handle=handle,
+                             outcome="shadow_failed",
+                             inputs={"config": label,
+                                     "error": type(e).__name__})
             self._event("tuner.shadow_failed", handle=repr(handle),
                         config=label, error=type(e).__name__)
             log.warning("tuner: shadow compile of %s for %r failed: %s",
@@ -315,6 +326,12 @@ class ShadowTuner:
         except Exception as e:
             sess.metrics.inc("tuner_rejections_total")
             self._breaker_bump()
+            rec = sess.recorder
+            if rec is not None:
+                rec.decision("tuner_reject", handle=handle,
+                             outcome="ab_failed",
+                             inputs={"config": st.candidate_label,
+                                     "error": type(e).__name__})
             with self._lock:
                 self._states.pop(handle, None)
                 self._gauge()
@@ -324,6 +341,13 @@ class ShadowTuner:
         win = (live_s - cand_s) / live_s if live_s > 0 else 0.0
         if not ok or win < self.min_win:
             sess.metrics.inc("tuner_rejections_total")
+            rec = sess.recorder
+            if rec is not None:
+                rec.decision("tuner_reject", handle=handle,
+                             outcome="lost_ab" if ok else "disagreed",
+                             inputs={"config": st.candidate_label,
+                                     "win_pct": round(100 * win, 1),
+                                     "agree": ok})
             self._event("tuner.rejection", handle=repr(handle),
                         config=st.candidate_label,
                         win_pct=round(100 * win, 1), agree=ok)
@@ -372,6 +396,12 @@ class ShadowTuner:
             sess._compiled_put(sess._factor_key(entry), st.exe)
             sess._cache.pop(handle, None)
         sess.metrics.inc("tuner_promotions_total")
+        rec = sess.recorder
+        if rec is not None:
+            rec.decision("tuner_promote", handle=handle,
+                         outcome="promoted",
+                         inputs={"config": st.candidate_label,
+                                 "win_pct": round(100 * win, 1)})
         with self._lock:
             st.stage = "promoted"
             st.prev_opts = prev_opts

@@ -170,6 +170,9 @@ def test_getrf_getrs_batched_roundtrip():
     assert np.array_equal(np.asarray(perm[1]), np.asarray(perm1[0]))
 
 
+@pytest.mark.slow  # ~6 s (round-22 tier-1 budget); tier-1 sibling —
+# the float64 arm of test_posv_batched_bit_identical_to_singles runs
+# the same potrf_batched/potrs_batched pair lane-for-lane
 def test_potrf_potrs_batched_roundtrip():
     b, n = 4, 40
     a = _spd_stack(b, n, np.float64)
@@ -272,6 +275,21 @@ def test_batched_hlo_has_no_per_item_factorization_custom_call():
 # -- api verbs: B x model ledger crediting ---------------------------------
 
 
+def test_api_batched_gesv_credits_b_times_model():
+    """Tier-1 sibling of the 4-verb sweep below (round-22 budget):
+    one verb pins the B x model-formula crediting contract."""
+    b, n, k = 3, 16, 2
+    LEDGER.reset()
+    st.gesv_batched(_stack(b, n, n, np.float32), _stack(b, n, k,
+                                                        np.float32))
+    assert LEDGER.snapshot()["per_op"]["gesv_batched"] == b * (
+        fl_getrf(n) + solve_flops("lu", n, n, k))
+
+
+@pytest.mark.slow  # ~8 s: four verb compiles (round-22 tier-1
+# budget); tier-1 siblings — test_api_batched_gesv_credits_b_times_model
+# (the B x model contract) and test_api_batched_verbs_validate_shapes
+# (the API surface)
 def test_api_batched_verbs_credit_b_times_model():
     b, m, n, k = 3, 24, 16, 2
     LEDGER.reset()

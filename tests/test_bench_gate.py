@@ -81,7 +81,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "quotas": _quotas_section(),
         "spectral": _spectral_section(),
         "updates": _updates_section(),
-        "tuning": _tuning_section()}
+        "tuning": _tuning_section(),
+        "incidents": _incidents_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -223,6 +224,35 @@ def _tuning_section():
     }
 
 
+def _incidents_section():
+    """A minimal round-22 serve-artifact incidents section that passes
+    gate_mod._check_incidents_section (the sample is held to the
+    slate_tpu.incident.v1 mirror validator)."""
+    return {
+        "enabled": True,
+        "captured": 1,
+        "journal_recorded": 3,
+        "journal_digest": "sha256:deadbeef",
+        "parity": {"eviction": {"counter": 1.0, "journal": 1.0,
+                                "ok": True}},
+        "sample": {
+            "schema": gate_mod.INCIDENT_SCHEMA,
+            "id": "inc-0000-bench_probe", "ts": 1700000000.0,
+            "host": "bench", "reason": "bench_probe", "key": "smoke",
+            "context": {},
+            "journal": {"events": [{"kind": "eviction",
+                                    "ts": 1700000000.0, "count": 1}],
+                        "counts": {"eviction": 1},
+                        "outcome_counts": {}},
+            "flight": {"spans": [], "samples": []},
+            "metrics": {"counters": {"evictions": 1.0}, "gauges": {}},
+            "numerics": None, "quotas": None, "placement": None,
+            "cost_log": None, "tuning": None,
+        },
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -260,7 +290,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "quotas": _quotas_section(),
         "spectral": _spectral_section(),
         "updates": _updates_section(),
-        "tuning": _tuning_section()}
+        "tuning": _tuning_section(),
+        "incidents": _incidents_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

@@ -76,6 +76,9 @@ def test_posv_residual():
     assert res < 3.0
 
 
+@pytest.mark.slow  # ~7 s mesh posv compile (round-22 tier-1 budget);
+# tier-1 siblings — test_posv_residual (posv numerics) and
+# test_uneven_grid.py::test_posv_uneven_grid (posv on a mesh)
 def test_posv_on_grid(grid2x2):
     n, nrhs = 64, 8
     a = np.asarray(random_spd(n, dtype=jnp.float64, seed=9))
@@ -165,6 +168,10 @@ def test_potrf_rec_iter_base_dispatch(monkeypatch):
     assert _residual_factor(a, L) < 3.0
 
 
+@pytest.mark.slow  # ~6 s: two n=128 dispatch-variant compiles
+# (round-22 tier-1 budget); tier-1 siblings — test_potrf_not_spd_info
+# (the info contract) and test_potrf_rec_iter_base_dispatch (the
+# hybrid rec->iter dispatch wiring)
 def test_potrf_hybrid_info_offset(monkeypatch):
     """Non-SPD pivot inside the SECOND recursion half reports the
     correct absolute 1-based LAPACK info index through the hybrid

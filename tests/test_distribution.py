@@ -121,9 +121,11 @@ def test_factorization_outputs_stay_sharded(grid2x4, routine):
     "potrf",
     # the getrf arm (~9 s) rides the slow lane (round-10 headroom):
     # mesh getrf stays pinned by the nb=64 perm-regression test and
-    # the fastpaths mesh pivot-fusion bit-identity test
+    # the fastpaths mesh pivot-fusion bit-identity test; the geqrf arm
+    # (~12 s, its own n=256 mesh factor compile) follows in round 22 —
+    # mesh geqrf stays pinned by test_qr.py::test_geqrf_jit_and_grid
     pytest.param("getrf", marks=pytest.mark.slow),
-    "geqrf"])
+    pytest.param("geqrf", marks=pytest.mark.slow)])
 def test_grid_matches_single_device(grid2x4, routine):
     n, nb = 256, 32
     if routine == "potrf":
@@ -232,6 +234,34 @@ def test_hlo_rank_k_family_has_collectives(grid2x4):
                                rtol=1e-12, atol=1e-12)
 
 
+def test_dist_panel_maxloc_small(grid2x4):
+    """Tier-1 sibling of test_dist_panel_maxloc (round-22 budget): the
+    same shard_map maxloc-panel contract — LU correctness under the
+    pivot collective + collectives present in the compiled HLO — on a
+    half-width panel (w=32 halves the unrolled column loop that
+    dominates the compile)."""
+    import jax.numpy as jnp
+    from slate_tpu.parallel.panel import dist_panel_getrf
+
+    rng = np.random.default_rng(22)
+    m, w = 256, 32
+    a = jnp.asarray(rng.standard_normal((m, w)))
+    lu, perm, info = dist_panel_getrf(a, grid2x4)
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    assert int(info) == 0
+    L = np.tril(lu, -1) + np.concatenate(
+        [np.eye(w), np.zeros((m - w, w))])
+    U = np.triu(lu[:w])
+    assert np.abs(np.asarray(a)[perm] - L @ U).max() < 1e-12
+    assert _collective_count(lambda x: dist_panel_getrf(x, grid2x4),
+                             a) > 0, \
+        "maxloc panel compiled without collectives"
+
+
+@pytest.mark.slow  # ~11 s: the w=64 panel compile + the n=256 mesh
+# getrf driver-site agreement are each their own compiles (round-22
+# tier-1 budget); tier-1 sibling test_dist_panel_maxloc_small keeps
+# the maxloc-panel contract in tier-1
 def test_dist_panel_maxloc(grid2x4):
     """VERDICT r3 #7: the explicit shard_map panel (per-column maxloc
     pivot collective + masked-psum row swaps, parallel/panel.py) must

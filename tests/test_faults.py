@@ -716,9 +716,11 @@ def test_injected_hbm_exhaustion_forces_eviction_under_pressure():
 
 
 def test_serve_artifact_sections_pinned_across_tools():
-    """bench_serve.SERVE_ARTIFACT_SECTIONS and the jax-free mirror in
-    tools/bench_gate.py must agree — the --check-schema fixture
-    assertion is only as strong as this equality."""
+    """Round 22 unified the two hand-synced SERVE_ARTIFACT_SECTIONS
+    copies into tools/serve_sections.py, loaded by both consumers
+    under ONE fixed module name — so the old tuple-equality pin
+    strengthens to import IDENTITY: both tools hold the same object,
+    and drift is structurally impossible."""
     def load(path, name):
         spec = importlib.util.spec_from_file_location(name, str(path))
         mod = importlib.util.module_from_spec(spec)
@@ -726,8 +728,12 @@ def test_serve_artifact_sections_pinned_across_tools():
         return mod
     gate = load(_REPO / "tools" / "bench_gate.py", "bench_gate_pin")
     serve = load(_REPO / "bench_serve.py", "bench_serve_pin")
+    assert gate.SERVE_ARTIFACT_SECTIONS is serve.SERVE_ARTIFACT_SECTIONS
+    shared = load(_REPO / "tools" / "serve_sections.py",
+                  "serve_sections_pin")
     assert (tuple(gate.SERVE_ARTIFACT_SECTIONS)
-            == tuple(serve.SERVE_ARTIFACT_SECTIONS))
+            == tuple(shared.SERVE_ARTIFACT_SECTIONS))
+    assert "incidents" in gate.SERVE_ARTIFACT_SECTIONS
 
 
 def test_committed_chaos_artifact_validates_and_holds():

@@ -159,6 +159,9 @@ def test_gesv_mixed():
     assert res < 1e-13
 
 
+@pytest.mark.slow  # ~6 s n=192/nb=64 compile (round-22 tier-1
+# budget); tier-1 sibling test_getrf_pivot_threshold_recursive_base
+# keeps the CALU tournament path pinned on a tall single panel
 def test_getrf_pivot_threshold_tournament():
     """pivot_threshold < 1 (the Option::PivotThreshold analog) swaps the
     panel's argmax/swap chain for the vmap-batched CALU tournament."""

@@ -113,14 +113,34 @@ def test_chol_tile_kernel_interpret_cross_panel():
     _chol_tile_interpret_case(256, junk_upper=True)
 
 
+@pytest.mark.slow  # ~19 s interpret-mode dispatch (round-22 headroom);
+# tier-1 sibling: test_chol_tile_nan_poisons_nonspd_single_micro
 def test_chol_tile_nan_poisons_nonspd():
-    """Non-SPD input must NaN-poison (the _tile_chol info contract)."""
+    """Non-SPD input must NaN-poison (the _tile_chol info contract) —
+    b=128 breaks in a LATER micro step, so the poison must propagate
+    through the trailing updates."""
     b = 128
     x = RNG.standard_normal((b, b)).astype(np.float32)
     a = (x @ x.T + b * np.eye(b)).astype(np.float32)
     a[40, 40] = -a[40, 40] - abs(a).sum()
     lk = np.asarray(pallas_ops.chol_tile(jnp.asarray(a), interpret=True))
     assert np.isnan(lk[40:, 40:]).any()
+
+
+def test_chol_tile_nan_poisons_nonspd_single_micro():
+    """Tier-1 sibling of the b=128 case above: the same poison contract
+    at its source — the 32-micro factorization (_chol_cols_unrolled),
+    where rsqrt of the negative pivot first goes NaN. (chol_tile itself
+    requires b >= _CHOL_IB=128, which is interpret-mode-slow; the kernel
+    builds its panels out of exactly this micro step.)"""
+    m = 32
+    x = RNG.standard_normal((m, m)).astype(np.float32)
+    a = (x @ x.T + m * np.eye(m)).astype(np.float32)
+    a[10, 10] = -a[10, 10] - abs(a).sum()
+    lk = np.asarray(pallas_ops._chol_cols_unrolled(jnp.asarray(a), m))
+    assert np.isnan(lk[10:, 10:]).any()
+    # healthy columns before the bad pivot stay finite
+    assert np.isfinite(lk[:, :10]).all()
 
 
 def test_chol_eligibility_gates(monkeypatch):

@@ -1,0 +1,499 @@
+"""Flight recorder + decision journal + incident capture (round 22).
+
+The black-box contract: every counted runtime reflex journals exactly
+one structured DecisionEvent (journal-count == counter delta, absolute
+equality per kind), finished spans and gauge samples ride bounded
+always-on rings, and anomalous transitions (watchdog flag, SLO breach,
+breaker open, fault firing) materialize rate-limited, deduped,
+crash-safe ``slate_tpu.incident.v1`` snapshots — while the DISABLED
+path stays one is-None check with zero allocation.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import obs
+from slate_tpu.obs.events import (DECISION_KINDS, DIGEST_FIELDS,
+                                  INCIDENT_KEYS, INCIDENT_SCHEMA,
+                                  JOURNAL_SCHEMA, KIND_COUNTERS,
+                                  OUTCOME_COUNTERS, DecisionEvent,
+                                  journal_digest, validate_incident)
+from slate_tpu.obs.recorder import (DecisionJournal, FlightRecorder,
+                                    IncidentCapture, Recorder)
+from slate_tpu.obs.watchdog import Watchdog
+from slate_tpu.runtime import Batcher, Metrics, Session
+
+RNG = np.random.default_rng(22)
+N, NB = 32, 16
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "_bench_gate", os.path.join(_ROOT, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lu_session(**kw):
+    sess = Session(**kw)
+    a = RNG.standard_normal((N, N)) + N * np.eye(N)
+    h = sess.register(st.from_dense(a, nb=NB), op="lu")
+    return sess, h, a
+
+
+def _synthetic_baseline(best=100.0):
+    return {"schema": "slate_tpu.baseline_series.v1", "tolerance": 0.10,
+            "series": [{"kind": "serve", "metric": "serve.solves_per_sec",
+                        "platform": "tpu", "n": N, "batch": None,
+                        "op": None, "dtype": None,
+                        "direction": "higher", "best": best}]}
+
+
+def _assert_parity(sess, rec):
+    """Absolute equality per kind — including the zero == zero kinds:
+    a counter that moved without a journal entry (or vice versa) is a
+    seam that forgot the other half."""
+    for kind, counter in sorted(KIND_COUNTERS.items()):
+        assert rec.journal.count(kind) == sess.metrics.get(counter), (
+            f"{kind}: journal {rec.journal.count(kind)} != "
+            f"counter {counter}={sess.metrics.get(counter)}")
+
+
+# -- the tables are closed ---------------------------------------------------
+
+
+def test_every_decision_kind_maps_to_exactly_one_counter():
+    """DECISION_KINDS and KIND_COUNTERS are the same set: a new reflex
+    kind without a counter mapping (or a mapping for a kind nobody can
+    emit) fails here before it ships unparityable."""
+    assert set(DECISION_KINDS) == set(KIND_COUNTERS)
+    assert len(set(KIND_COUNTERS.values())) == len(KIND_COUNTERS), \
+        "two kinds sharing one counter cannot both hold parity"
+    for (kind, _outcome), counter in OUTCOME_COUNTERS.items():
+        assert kind in DECISION_KINDS
+        assert counter not in KIND_COUNTERS.values(), (
+            "an outcome counter that is also a kind counter would be "
+            "double-counted by the parity check")
+    assert "ts" not in DIGEST_FIELDS and "inputs" not in DIGEST_FIELDS
+
+
+def test_journal_ring_bounded_counts_monotone():
+    """The ring drops oldest events; the per-kind counts do NOT — the
+    parity invariant survives eviction from the ring."""
+    j = DecisionJournal(capacity=4)
+    for i in range(10):
+        j.record("eviction", handle=f"h{i}", outcome="explicit")
+    assert len(j.events()) == 4
+    assert j.count("eviction") == 10
+    p = j.payload()
+    assert p["schema"] == JOURNAL_SCHEMA
+    assert p["recorded"] == 10 and p["dropped"] == 6
+    assert [e["handle"] for e in p["events"]] == ["h6", "h7", "h8", "h9"]
+
+
+def test_multi_victim_decision_counts_as_n():
+    """One shed wave / clear_cache is ONE decision with count=N; the
+    journal count (what parity compares) advances by N."""
+    j = DecisionJournal()
+    j.record("shed", outcome="deadline", count=3)
+    assert j.count("shed") == 3
+    assert len(j.events()) == 1
+
+
+def test_digest_is_wallclock_free():
+    """Two journals recording the same decisions at different times
+    digest identically (DIGEST_FIELDS exclude ts/inputs/trace ids) —
+    the same-seed chaos reproducibility gate depends on this."""
+    rows = [("eviction", "h0", "budget"), ("breaker_open", "h1", "open"),
+            ("shed", None, "deadline")]
+    digests = []
+    for _ in range(2):
+        j = DecisionJournal()
+        for kind, handle, outcome in rows:
+            j.record(kind, handle=handle, outcome=outcome,
+                     inputs={"noise": RNG.standard_normal()})
+        digests.append(j.digest())
+    assert digests[0] == digests[1]
+    # and it is order-sensitive: a reordered cascade is a different story
+    j2 = DecisionJournal()
+    for kind, handle, outcome in reversed(rows):
+        j2.record(kind, handle=handle, outcome=outcome)
+    assert j2.digest() != digests[0]
+
+
+# -- journal/counter parity through the real seams ---------------------------
+
+
+def test_session_reflex_parity():
+    """Eviction reflexes through the real Session seams: explicit
+    evict, unregister-with-resident, clear_cache (one decision,
+    count=n) — every KIND_COUNTERS pair holds with absolute equality,
+    including the untouched zero kinds."""
+    sess, h, a = _lu_session()
+    rec = sess.enable_recorder()
+    assert sess.enable_recorder() is rec  # idempotent
+    h2 = sess.register(st.from_dense(
+        RNG.standard_normal((N, N)) + N * np.eye(N), nb=NB), op="lu")
+    b = RNG.standard_normal(N)
+    sess.solve(h, b)
+    sess.solve(h2, b)
+    assert sess.evict(h)
+    sess.factor(h)
+    sess.clear_cache()
+    sess.unregister(h2)  # resident already gone: no double count
+    _assert_parity(sess, rec)
+    ev = [e for e in rec.journal.events() if e.kind == "eviction"]
+    assert ev[0].outcome == "explicit" and ev[0].handle == str(h)
+    wave = [e for e in ev if e.outcome == "clear_cache"]
+    assert len(wave) == 1 and wave[0].count == 2
+    assert rec.journal.count("eviction") == sess.metrics.get("evictions")
+
+
+def test_deadline_expiry_parity_through_batcher():
+    """The serving seam: an already-expired request fails fast AND
+    journals one deadline_expired decision per victim."""
+    sess, h, a = _lu_session()
+    rec = sess.enable_recorder()
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    ok = batcher.submit(h, RNG.standard_normal(N))
+    dead = batcher.submit(h, RNG.standard_normal(N), timeout_s=-1.0)
+    batcher.flush()
+    assert ok.result(timeout=30) is not None
+    with pytest.raises(Exception):
+        dead.result(timeout=30)
+    assert sess.metrics.get("deadline_expired_total") == 1
+    _assert_parity(sess, rec)
+    (e,) = [e for e in rec.journal.events()
+            if e.kind == "deadline_expired"]
+    assert e.outcome == "failed_fast" and e.handle == str(h)
+
+
+# -- incident capture --------------------------------------------------------
+
+
+def _capture(dir=None, clock=None, **kw):
+    j = DecisionJournal()
+    kw.setdefault("metrics", Metrics())
+    cap = IncidentCapture(j, FlightRecorder(), dir=dir,
+                          **({"clock": clock} if clock else {}), **kw)
+    return j, cap
+
+
+def test_incident_dedup_then_rate_limit_then_window_expiry():
+    """Same (reason, key) inside the window -> deduped; a DIFFERENT
+    key inside the global rate limit -> rate-limited; past the windows
+    both capture again. All three outcomes are counted."""
+    t = {"now": 0.0}
+    j, cap = _capture(clock=lambda: t["now"],
+                      rate_limit_s=5.0, dedup_window_s=60.0)
+    m = cap.metrics
+    assert cap.trigger("fault", key="dispatch") is not None
+    t["now"] = 1.0
+    assert cap.trigger("fault", key="dispatch") is None  # dedup
+    assert cap.trigger("breaker_open", key="h0") is None  # rate limit
+    t["now"] = 10.0
+    assert cap.trigger("breaker_open", key="h0") is not None
+    t["now"] = 70.0  # dedup window expired for the first key
+    assert cap.trigger("fault", key="dispatch") is not None
+    assert m.get("incidents_captured_total") == 3
+    assert m.get("incidents_deduped_total") == 1
+    assert m.get("incidents_rate_limited_total") == 1
+    assert len(cap.incidents()) == 3
+
+
+def test_incident_carries_implicated_handle_slice():
+    """The tail window can be dominated by other traffic; the
+    implicated handle's decisions ride along anyway, merged in seq
+    order."""
+    j, cap = _capture(journal_slice=8)
+    j.record("eviction", handle="victim", outcome="budget")
+    for i in range(50):
+        j.record("shed", handle=f"noise{i}", outcome="deadline")
+    doc = cap.trigger("watchdog_anomaly", key="s", handle="victim")
+    handles = [e["handle"] for e in doc["journal"]["events"]]
+    assert "victim" in handles
+    seqs = [e["seq"] for e in doc["journal"]["events"]]
+    assert seqs == sorted(seqs)
+    assert validate_incident(doc) == []
+    assert doc["journal"]["counts"]["shed"] == 50
+
+
+def test_incident_publish_is_crash_safe(tmp_path):
+    """On-disk snapshots go through tmp + os.replace: after a capture
+    the directory holds exactly the finished document (no .tmp
+    residue), loadable and identical to the in-ring copy."""
+    d = str(tmp_path / "incidents")
+    j, cap = _capture(dir=d)
+    doc = cap.trigger("fault", key="dispatch")
+    files = os.listdir(d)
+    assert len(files) == 1 and files[0].endswith(".json")
+    assert not [f for f in files if ".tmp" in f]
+    with open(os.path.join(d, files[0])) as f:
+        assert json.load(f) == json.loads(json.dumps(doc, default=repr))
+
+
+def test_provider_failure_never_raises_into_the_seam():
+    """A broken section provider (dead numerics hook, crashed quota
+    payload) must not turn the incident path into a new failure mode:
+    the section degrades to an error string, the document still
+    validates."""
+    j, cap = _capture()
+    cap.providers["numerics"] = lambda: 1 / 0
+    doc = cap.trigger("fault", key="x")
+    assert "ZeroDivisionError" in doc["numerics"]["error"]
+    assert validate_incident(doc) == []
+
+
+def test_watchdog_anomaly_during_served_workload_captures_one_incident(
+        tmp_path):
+    """THE acceptance path: a served workload, an injected watchdog
+    anomaly -> exactly ONE schema-valid incident containing the
+    implicated handle's journal slice — and repeated check() scrapes
+    (the restorm case) mint nothing new."""
+    sess, h, a = _lu_session()
+    rec = sess.enable_recorder(incident_dir=str(tmp_path / "inc"))
+    batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+    futs = [batcher.submit(h, RNG.standard_normal(N)) for _ in range(4)]
+    batcher.flush()
+    for f in futs:
+        f.result(timeout=30)
+    sess.evict(h)  # the implicated handle's decision, pre-anomaly
+    wd = Watchdog(baseline=_synthetic_baseline(best=1e12),
+                  metrics=sess.metrics)
+    wd.add_listener(rec.watchdog_listener)
+    wd.observe("serve.solves_per_sec", 1.0, "tpu", n=N, kind="serve")
+    assert not wd.check()["ok"]
+    for _ in range(5):  # scrape loop: still anomalous, still ONE
+        wd.check()
+    assert sess.metrics.get("incidents_captured_total") == 1
+    incidents = rec.incidents.incidents()
+    assert len(incidents) == 1
+    doc = incidents[0]
+    assert validate_incident(doc) == []
+    assert doc["reason"] == "watchdog_anomaly"
+    assert doc["context"]["metric"] == "serve.solves_per_sec"
+    handles = {e["handle"] for e in doc["journal"]["events"]}
+    assert str(h) in handles
+    assert doc["metrics"]["counters"].get("evictions") == 1
+    on_disk = os.listdir(str(tmp_path / "inc"))
+    assert len(on_disk) == 1
+    _assert_parity(sess, rec)
+
+
+def test_slo_breach_transition_captures_incident():
+    """An SLO breach transition triggers capture at the source (the
+    tracker's _breached latch), so scrape-driven publish loops cannot
+    restorm; recovery re-arms."""
+    from slate_tpu.obs.slo import Objective
+    sess, h, a = _lu_session()
+    rec = sess.enable_recorder()
+    sess.enable_slo((Objective("errors", "error_rate", 0.99),))
+    for _ in range(4):
+        sess.slo.record_request("lu", N, 1e-3, ok=False)
+    for _ in range(3):  # scrape loop: ONE transition, one capture
+        sess.slo.evaluate()
+    assert sess.metrics.get("slo_breaches_total") == 1
+    assert sess.metrics.get("incidents_captured_total") == 1
+    (doc,) = rec.incidents.incidents()
+    assert doc["reason"] == "slo_breach" and doc["key"] == "errors"
+
+
+# -- the disabled path -------------------------------------------------------
+
+
+def test_disabled_recorder_allocates_nothing():
+    """Round-8 discipline, pinned with a real allocator trace: with
+    ``recorder=None`` a full served workload allocates ZERO bytes from
+    recorder.py/events.py (tracemalloc filtered by file), and the
+    session/tracer carry no journal, ring, or capture object at all.
+    The enabled control proves the instrument measures what we claim."""
+    filters = [tracemalloc.Filter(
+        True, os.path.join("*", "slate_tpu", "obs", "recorder.py")),
+        tracemalloc.Filter(
+        True, os.path.join("*", "slate_tpu", "obs", "events.py"))]
+
+    def _serve(sess, h):
+        batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+        futs = [batcher.submit(h, RNG.standard_normal(N))
+                for _ in range(4)]
+        batcher.flush()
+        for f in futs:
+            f.result(timeout=30)
+        sess.evict(h)
+        sess.clear_cache()
+
+    from slate_tpu.obs.tracing import Tracer
+    sess, h, a = _lu_session(tracer=Tracer())  # isolated from the
+    # default tracer, which other tests may have wired a recorder onto
+    assert sess.recorder is None and sess.tracer.recorder is None
+    sess.solve(h, RNG.standard_normal(N))  # warm the compile caches
+    gc.collect()
+    tracemalloc.start()
+    try:
+        _serve(sess, h)
+        disabled = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    assert sum(s.size for s in disabled.statistics("filename")) == 0
+
+    sess2, h2, _ = _lu_session(tracer=Tracer())
+    sess2.enable_recorder()
+    sess2.solve(h2, RNG.standard_normal(N))
+    gc.collect()
+    tracemalloc.start()
+    try:
+        _serve(sess2, h2)
+        enabled = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    assert sum(s.size for s in enabled.statistics("filename")) > 0
+
+
+# -- fleet folds -------------------------------------------------------------
+
+
+def test_journal_fold_conserves_counts_and_labels_hosts():
+    """merge_journal_payloads: per-kind counts (and recorded/dropped)
+    sum EXACTLY, every folded event carries its host, and the merged
+    stream is (ts, host, seq)-ordered."""
+    j1, j2 = DecisionJournal(), DecisionJournal()
+    for i in range(3):
+        j1.record("eviction", handle=f"a{i}", outcome="budget")
+    j2.record("shed", outcome="deadline", count=5)
+    j2.record("eviction", handle="b0", outcome="explicit")
+    p1, p2 = j1.payload(), j2.payload()
+    fleet = obs.aggregate.merge_journal_payloads([p1, p2],
+                                                 hosts=["h0", "h1"])
+    assert fleet["schema"] == "slate_tpu.journal.fleet.v1"
+    assert fleet["counts"] == {"eviction": 4, "shed": 5}
+    assert fleet["recorded"] == 5 and fleet["dropped"] == 0
+    assert fleet["processes"] == 2
+    hosts = {e["host"] for e in fleet["events"]}
+    assert hosts == {"h0", "h1"}
+    keys = [(e["ts"], e["host"], e["seq"]) for e in fleet["events"]]
+    assert keys == sorted(keys)
+
+
+def test_incident_fold_preserves_documents():
+    _, c1 = _capture()
+    _, c2 = _capture(rate_limit_s=0.0)
+    c1.trigger("fault", key="x")
+    c2.trigger("breaker_open", key="y")
+    c2.trigger("slo_breach", key="z")
+    fleet = obs.aggregate.merge_incident_payloads(
+        [c1.payload(), c2.payload()], hosts=["h0", "h1"])
+    assert fleet["schema"] == "slate_tpu.incidents.fleet.v1"
+    assert len(fleet["incidents"]) == 3
+    assert fleet["captured"] == 3
+    assert {d["fold_host"] for d in fleet["incidents"]} == {"h0", "h1"}
+
+
+# -- exposition routes -------------------------------------------------------
+
+
+def test_journal_and_incident_routes():
+    import urllib.request
+    sess, h, a = _lu_session()
+    rec = sess.enable_recorder()
+    sess.solve(h, RNG.standard_normal(N))
+    sess.evict(h)
+    rec.incident("probe", key="route-test", handle=h)
+    srv = sess.serve_obs()
+    try:
+        jp = json.loads(urllib.request.urlopen(
+            srv.url("/journal"), timeout=10).read().decode())
+        assert jp["schema"] == JOURNAL_SCHEMA
+        assert jp["counts"]["eviction"] == 1
+        ip = json.loads(urllib.request.urlopen(
+            srv.url("/incidents"), timeout=10).read().decode())
+        assert ip["schema"] == "slate_tpu.incidents.v1"
+        assert len(ip["incidents"]) == 1
+        assert validate_incident(ip["incidents"][0]) == []
+    finally:
+        sess.close_obs()
+
+
+def test_routes_degrade_when_recorder_disabled():
+    import urllib.request
+    sess, h, a = _lu_session()
+    srv = sess.serve_obs()
+    try:
+        for path in ("/journal", "/incidents"):
+            body = json.loads(urllib.request.urlopen(
+                srv.url(path), timeout=10).read().decode())
+            assert body["enabled"] is False
+    finally:
+        sess.close_obs()
+
+
+# -- drift pins vs the jax-free mirror ---------------------------------------
+
+
+def test_incident_validator_pinned_across_gate_and_runtime():
+    """bench_gate validates committed artifacts WITHOUT importing the
+    runtime; its incident mirror must reject exactly what the runtime
+    validator rejects (same malformed documents, same verdicts)."""
+    gate = _bench_gate()
+    assert gate.INCIDENT_SCHEMA == INCIDENT_SCHEMA
+    assert tuple(gate.INCIDENT_KEYS) == tuple(INCIDENT_KEYS)
+    _, cap = _capture()
+    good = cap.trigger("fault", key="x")
+    good = json.loads(json.dumps(good, default=repr))
+    bad_docs = [
+        "not a dict",
+        {},
+        {**good, "schema": "slate_tpu.incident.v0"},
+        {k: v for k, v in good.items() if k != "journal"},
+        {**good, "journal": {"events": "nope", "counts": {}}},
+        {**good, "ts": "yesterday"},
+        {**good, "reason": None},
+    ]
+    for doc in [good] + bad_docs:
+        runtime_errs = validate_incident(doc)
+        gate_errs = gate.validate_incident_doc(doc)
+        assert bool(runtime_errs) == bool(gate_errs), (
+            f"validators disagree on {doc!r}: runtime={runtime_errs} "
+            f"gate={gate_errs}")
+    assert validate_incident(good) == []
+
+
+def test_decision_event_str_coercion_keeps_payload_jsonable():
+    """Handles are arbitrary hashables (tuples, objects); the journal
+    str()-coerces at record time so every payload round-trips through
+    plain json.dumps."""
+    j = DecisionJournal()
+    j.record("eviction", handle=("h", 0), op=object(), tenant=7,
+             outcome="budget")
+    json.dumps(j.payload())  # must not raise
+    e = j.payload()["events"][0]
+    assert e["handle"] == str(("h", 0)) and e["tenant"] == "7"
+
+
+def test_concurrent_recording_keeps_parity():
+    """Decisions from N threads: the ring and counts stay consistent
+    (no lost updates) — the journal sits on serving hot paths."""
+    j = DecisionJournal(capacity=64)
+
+    def hammer(i):
+        for k in range(200):
+            j.record("shed", handle=f"t{i}", outcome="x")
+
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert j.count("shed") == 800
+    assert j.payload()["recorded"] == 800
+    assert len(j.events()) == 64

@@ -519,6 +519,11 @@ def test_lo_factor_failure_falls_back_per_request():
     assert str(sess.factor(h).payload[0].dtype) == "float32"
 
 
+@pytest.mark.slow  # ~12 s of grouped-bucket + per-request compiles
+# (round-22 tier-1 budget); tier-1 siblings —
+# test_lo_factor_failure_falls_back_per_request pins the counted
+# lo-factor fallback, and the grouped-bucket serving path stays pinned
+# by test_tenancy.py::test_grouped_tenant_parity_with_policies
 def test_grouped_lo_factor_failure_no_cache_poison():
     """Review fix: a failed LOW-precision batched factor in a grouped
     mixed bucket must NOT cache the bad resident or fail futures — the

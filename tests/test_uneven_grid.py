@@ -123,6 +123,10 @@ def test_nb_larger_than_n(grid2x2):
                                rtol=1e-9, atol=1e-10)
 
 
+@pytest.mark.slow  # ~6 s: three nb-variant mesh posv compiles
+# (round-22 tier-1 budget); tier-1 siblings — test_posv_uneven_grid
+# (uneven mesh posv) and test_nb_larger_than_n (the extreme-padding
+# case: one padded tile holds the whole matrix)
 def test_padding_isolated_from_results(grid2x2):
     """The same logical matrix under different padding amounts (nb
     choices → different pad sizes and grid roundings) must produce the

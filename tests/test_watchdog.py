@@ -343,3 +343,41 @@ def test_persistent_anomaly_counts_once_per_transition():
     assert not wd.check(now=201.0)["ok"]
     assert m.get("watchdog_anomalies_total") == 2.0
     tracer.off()
+
+
+def test_listener_exception_counted_and_logged_once(caplog):
+    """Round 22: a crashing listener (a dead incident hook) must not
+    break the check loop OR silently vanish — every failure is counted
+    in watchdog_listener_errors_total, the traceback logs ONCE per
+    listener, and healthy listeners keep receiving rows."""
+    import logging
+
+    m = Metrics()
+    wd = Watchdog(baseline=_synthetic(best=100.0), metrics=m)
+    seen = []
+
+    def bad(row):
+        raise RuntimeError("dead incident hook")
+
+    wd.add_listener(bad)
+    wd.add_listener(seen.append)
+    wd.observe("serve.solves_per_sec", 50.0, "tpu", n=512, kind="serve",
+               t=10.0)
+    with caplog.at_level(logging.ERROR, logger="slate_tpu.obs"):
+        assert not wd.check(now=11.0)["ok"]  # does not raise
+        assert m.get("watchdog_listener_errors_total") == 1.0
+        assert len(seen) == 1  # the healthy listener still ran
+        # recovery re-arms, a NEW transition fails the listener again:
+        # counted again, NOT logged again
+        wd.observe("serve.solves_per_sec", 99.0, "tpu", n=512,
+                   kind="serve", t=13.0)
+        assert wd.check(now=14.0)["ok"]
+        wd.observe("serve.solves_per_sec", 50.0, "tpu", n=512,
+                   kind="serve", t=200.0)
+        assert not wd.check(now=201.0)["ok"]
+    assert m.get("watchdog_listener_errors_total") == 2.0
+    assert len(seen) == 2
+    logged = [r for r in caplog.records
+              if "watchdog listener" in r.getMessage()]
+    assert len(logged) == 1
+    assert "dead incident hook" in logged[0].exc_text

@@ -138,15 +138,31 @@ TRACKED_TUNED = ("tuned.solves_per_sec", "default.solves_per_sec",
 TRACKED_TUNING = ("tuned.gflops",)
 GATED_PLATFORMS = ("tpu", "axon")
 
-# mirror of bench_serve.SERVE_ARTIFACT_SECTIONS (this tool stays
-# jax-import-free; tests pin the two tuples equal): every section the
-# serve artifact currently carries. --check-schema fails a committed
-# fixture missing any of them — the round-12/13 stale-fixture class
-# (schema grew a section, fixture silently didn't).
-SERVE_ARTIFACT_SECTIONS = (
-    "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
-    "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
-    "tenants", "numerics", "quotas", "spectral", "updates", "tuning")
+# SHARED with bench_serve.py since round 22 (tools/serve_sections.py,
+# stdlib-only — this tool stays jax-import-free; the old hand-synced
+# mirror pin is now an import-identity test): every section the serve
+# artifact currently carries. --check-schema fails a committed fixture
+# missing any of them — the round-12/13 stale-fixture class (schema
+# grew a section, fixture silently didn't).
+
+
+def _load_serve_sections():
+    """Same fixed-name module load as bench_serve._load_serve_sections
+    (one cached module object -> one shared tuple object)."""
+    import importlib.util
+    name = "slate_tpu_serve_sections"
+    mod = sys.modules.get(name)
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "serve_sections.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+SERVE_ARTIFACT_SECTIONS = _load_serve_sections().SERVE_ARTIFACT_SECTIONS
 # mirror of obs/attribution.py PLACEMENT_ROW_KEYS + PLACEMENT_SCHEMA
 # (same jax-free duplication discipline as the sections tuple above
 # and the baseline validators; tests pin the mirrors equal): the
@@ -180,6 +196,17 @@ CHECKPOINT_BLOB_KEYS = ("blob", "shape", "dtype", "nbytes", "sha256")
 TUNING_SCHEMA = "slate_tpu.tuning_table.v1"
 TUNING_CONFIG_KEYS = ("nb", "inner_blocking", "lookahead",
                       "wide_panel", "batch_quantum", "width_quantum")
+# mirror of slate_tpu/obs/events.py (round 22; same jax-free
+# duplication discipline — tests pin the schema id and key tuple
+# equal and feed both validators the same malformed docs): the
+# incident-snapshot document the flight recorder publishes, held to
+# its schema by --check-schema via the serve artifact's embedded
+# sample and any committed incident files
+INCIDENT_SCHEMA = "slate_tpu.incident.v1"
+INCIDENT_KEYS = (
+    "schema", "id", "ts", "host", "reason", "key", "context",
+    "journal", "flight", "metrics", "numerics", "quotas", "placement",
+    "cost_log", "tuning")
 DEFAULT_TOLERANCE = 0.10
 
 _N_RE = re.compile(r"_n(\d+)$")
@@ -622,7 +649,8 @@ def _normalize_chaos(name: str, obj: dict,
     for k in ("wrong_answers", "lost_futures", "conservation_ok",
               "slo_consistent", "fleet_fold_ok",
               "schedule_reproducible",
-              "noisy_neighbor_isolated", "migration_zero_refactor"):
+              "noisy_neighbor_isolated", "migration_zero_refactor",
+              "recorder_black_box"):
         if k not in inv:
             raise SchemaError(f"{name}: chaos invariants missing {k!r}")
     if not isinstance(obj["schedule"], dict) \
@@ -991,6 +1019,88 @@ def _check_tuning_section(name: str, section) -> None:
             "the serve path)")
 
 
+def validate_incident_doc(doc) -> List[str]:
+    """Jax-free mirror of ``slate_tpu.obs.events.validate_incident``
+    (tests feed both validators the same malformed docs and pin the
+    verdicts equal): returns error strings, empty = valid."""
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"incident: not a dict ({type(doc).__name__})"]
+    if doc.get("schema") != INCIDENT_SCHEMA:
+        errs.append(f"incident: schema {doc.get('schema')!r} != "
+                    f"{INCIDENT_SCHEMA!r}")
+    for k in INCIDENT_KEYS:
+        if k not in doc:
+            errs.append(f"incident: missing key {k!r}")
+    if errs:
+        return errs
+    if not isinstance(doc["id"], str) or not doc["id"]:
+        errs.append("incident: id must be a nonempty string")
+    if not isinstance(doc["ts"], (int, float)):
+        errs.append("incident: ts must be a number")
+    if not isinstance(doc["reason"], str) or not doc["reason"]:
+        errs.append("incident: reason must be a nonempty string")
+    j = doc["journal"]
+    if not isinstance(j, dict) or "events" not in j or "counts" not in j:
+        errs.append("incident: journal must carry events + counts")
+    else:
+        if not isinstance(j["events"], list):
+            errs.append("incident: journal.events must be a list")
+        else:
+            for i, ev in enumerate(j["events"]):
+                if (not isinstance(ev, dict) or not ev.get("kind")
+                        or not isinstance(ev.get("ts"), (int, float))
+                        or not isinstance(ev.get("count"),
+                                          (int, float))):
+                    errs.append(f"incident: journal.events[{i}] "
+                                "malformed (kind/ts/count)")
+                    break
+        if not isinstance(j["counts"], dict):
+            errs.append("incident: journal.counts must be a dict")
+    fl = doc["flight"]
+    if (not isinstance(fl, dict)
+            or not isinstance(fl.get("spans"), list)
+            or not isinstance(fl.get("samples"), list)):
+        errs.append("incident: flight must carry spans + samples lists")
+    m = doc["metrics"]
+    if (not isinstance(m, dict)
+            or not isinstance(m.get("counters"), dict)
+            or not isinstance(m.get("gauges"), dict)):
+        errs.append("incident: metrics must carry counters + gauges")
+    return errs
+
+
+def _check_incidents_section(name: str, section) -> None:
+    """Validate the round-22 serve-artifact ``incidents`` section: the
+    decision-journal/counter parity verdicts and one embedded sample
+    incident held to ``slate_tpu.incident.v1`` by the mirror validator
+    above — a committed fixture whose black box stopped recording (or
+    whose parity broke) is a broken recorder, not a slow bench."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: incidents section is not an object")
+    for k in ("enabled", "ok", "captured", "journal_recorded",
+              "parity", "sample"):
+        if k not in section:
+            raise SchemaError(f"{name}: incidents section missing {k!r}")
+    if not section["enabled"]:
+        raise SchemaError(f"{name}: incidents section disabled (the "
+                          "bench session must run its recorder)")
+    if not isinstance(section["parity"], dict) or not section["parity"]:
+        raise SchemaError(f"{name}: incidents.parity missing/empty")
+    bad = [k for k, row in section["parity"].items()
+           if not (isinstance(row, dict) and row.get("ok"))]
+    if bad:
+        raise SchemaError(
+            f"{name}: incidents.parity broken for {bad} (journal "
+            "count != metric counter delta)")
+    errs = validate_incident_doc(section["sample"])
+    if errs:
+        raise SchemaError(f"{name}: incidents.sample invalid: "
+                          + "; ".join(errs))
+    if not section["ok"]:
+        raise SchemaError(f"{name}: incidents section verdict not ok")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
@@ -1024,6 +1134,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         _check_spectral_section(name, obj["spectral"])
         _check_updates_section(name, obj["updates"])
         _check_tuning_section(name, obj["tuning"])
+        _check_incidents_section(name, obj["incidents"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),

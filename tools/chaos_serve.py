@@ -1462,6 +1462,155 @@ def run_tuner_drill(seed):
     }, inj
 
 
+def run_recorder_drill(seed):
+    """Flight-recorder / decision-journal drill (round 22): black-box
+    incident capture under injected faults, deterministically.
+
+    (a) a served pass with the recorder ON before the first register:
+        injected ``dispatch_error`` trips the breaker (journaled
+        ``breaker_open``), an explicit evict + ``clear_cache`` drive
+        the eviction reflex — and every (kind, counter) pair in
+        ``KIND_COUNTERS`` where either side moved holds with absolute
+        equality (the journal IS the counter, one decision at a time);
+    (b) incidents: 6 fault firings at one site + the breaker trip all
+        land inside the dedup/rate-limit windows (the drill injects a
+        deterministic 1ms-step clock, so this is seed-stable, not
+        wall-clock luck) -> exactly ONE incident is captured, the rest
+        are counted dedups/rate-limits; repeated ``/incidents``
+        scrapes mint nothing new; jumping the clock past the dedup
+        window lets the SAME (reason, key) capture again — the window
+        is a window, not a latch;
+    (c) every captured document validates as ``slate_tpu.incident.v1``
+        (runtime validator), carries the journal slice + counts, and
+        its crash-safe on-disk twin is byte-loadable and id-identical;
+    (d) the journal digest is a pure function of the seed: a second
+        same-seed pass reproduces it (``DIGEST_FIELDS`` exclude
+        wall-clock and inputs)."""
+    import tempfile
+
+    from slate_tpu.obs import validate_incident
+    from slate_tpu.obs.events import KIND_COUNTERS
+    from slate_tpu.runtime import Executor, FaultPlan, FaultSpec, Session
+
+    def one_pass(tag):
+        rng = np.random.default_rng(seed + 12)
+        t = {"now": 0.0}
+
+        def clock():
+            t["now"] += 1e-3
+            return t["now"]
+
+        sess = Session()
+        idir = tempfile.mkdtemp(prefix=f"slate_tpu_chaos_inc_{tag}_")
+        rec = sess.enable_recorder(incident_dir=idir, clock=clock)
+        sess.enable_faults(FaultPlan(seed=seed, specs=(
+            FaultSpec("dispatch_error", rate=1.0, count=6),)))
+        n = 16
+        mats = [(rng.standard_normal((n, n))
+                 + n * np.eye(n)).astype(np.float32) for _ in range(4)]
+        hs = [sess.register(m, op="lu_small") for m in mats]
+        wrong = lost = completed = 0
+        with Executor(sess, max_batch=4, max_wait=3600.0,
+                      retries=0, breaker_threshold=2,
+                      breaker_cooldown=3600.0) as ex:
+            futs = []
+            for wave in range(5):
+                for j in range(4):
+                    b = rng.standard_normal(n).astype(np.float32)
+                    futs.append((ex.submit(hs[j], b), mats[j], b))
+                ex.flush()
+            for f, m, b in futs:
+                if not f.done():
+                    lost += 1
+                elif f.exception() is None:
+                    completed += 1
+                    if _check_residual(m, f.result(), b) > RESID_TOL:
+                        wrong += 1
+        sess.evict(hs[0])
+        sess.clear_cache()
+        return sess, rec, idir, wrong, lost, completed
+
+    sess, rec, idir, wrong, lost, completed = one_pass("a")
+    g = sess.metrics.get
+
+    # (a) journal/counter parity: absolute equality per kind
+    parity = {}
+    for kind, counter in sorted(KIND_COUNTERS.items()):
+        j, c = rec.journal.count(kind), g(counter)
+        if j or c:
+            parity[kind] = {"journal": j, "counter": c, "ok": j == c}
+    parity_ok = bool(parity) and all(v["ok"] for v in parity.values())
+    kinds_fired = sorted(parity)
+
+    # (b) exactly one capture; scrapes are reads, not triggers
+    p1 = rec.incidents.payload()
+    p2 = rec.incidents.payload()
+    one_captured = (g("incidents_captured_total") == 1
+                    and len(p1["incidents"]) == 1
+                    and p1 == p2
+                    and g("incidents_captured_total") == 1)
+    deduped = g("incidents_deduped_total")
+    rate_limited = g("incidents_rate_limited_total")
+    # the dedup window expires: jump the injected clock past it and
+    # the same (reason, key) captures a SECOND document
+    rec.incidents._clock = (lambda t0=rec.incidents._clock:
+                            t0() + 3600.0)
+    redoc = rec.incident("fault", key="dispatch",
+                         context={"drill": "window_expiry"})
+    window_expires = (redoc is not None
+                      and g("incidents_captured_total") == 2)
+
+    # (c) schema + crash-safe disk twin
+    docs = rec.incidents.incidents()
+    schema_errs = [e for d in docs for e in validate_incident(d)]
+    # the first capture fires at the FIRST injected fault — before any
+    # decision exists, so its slice is honestly empty; the post-drill
+    # capture must carry the breaker + eviction decisions and counts
+    slice_ok = bool(docs and docs[-1]["journal"]["events"]
+                    and docs[-1]["journal"]["counts"])
+    disk = sorted(fn for fn in os.listdir(idir) if fn.endswith(".json"))
+    disk_ids = set()
+    for fn in disk:
+        with open(os.path.join(idir, fn)) as f:
+            disk_ids.add(json.load(f)["id"])
+    disk_ok = (len(disk) == len(docs)
+               and disk_ids == {d["id"] for d in docs})
+
+    # (d) same seed, same journal digest
+    digest = rec.journal.digest()
+    sess_b, rec_b, _idir_b, wrong_b, lost_b, _comp_b = one_pass("b")
+    digest_b = rec_b.journal.digest()
+    wrong += wrong_b
+    lost += lost_b
+    cons = _conservation(sess.metrics)
+    cons_b = _conservation(sess_b.metrics)
+
+    return {
+        "parity": parity,
+        "kinds_fired": kinds_fired,
+        "one_incident_despite_scrapes": one_captured,
+        "incidents_deduped": deduped,
+        "incidents_rate_limited": rate_limited,
+        "dedup_window_expires": window_expires,
+        "incident_schema_errors": schema_errs,
+        "journal_slice_rides_along": slice_ok,
+        "disk_twin_ok": disk_ok,
+        "journal_digest": digest,
+        "digest_reproducible": digest == digest_b,
+        "completed": completed,
+        "wrong_answers": wrong,
+        "lost_futures": lost,
+        "conservation": {"session": cons, "repeat_session": cons_b,
+                         "ok": cons["ok"] and cons_b["ok"]},
+        "ok": (parity_ok and one_captured and deduped >= 1
+               and window_expires and not schema_errs and slice_ok
+               and disk_ok and digest == digest_b
+               and "breaker_open" in parity and "eviction" in parity
+               and wrong == 0 and lost == 0 and completed > 0
+               and cons["ok"] and cons_b["ok"]),
+    }
+
+
 def run_all(seed, waves):
     """One full chaos pass; returns (phase reports, schedule record)."""
     soak, inj, _sess = run_soak(seed, waves)
@@ -1475,6 +1624,7 @@ def run_all(seed, waves):
     spectral = run_spectral_drill(seed)
     update = run_update_drill(seed)
     tuner, inj_t = run_tuner_drill(seed)
+    recorder = run_recorder_drill(seed)
     schedule = {
         "digest": "+".join(i.schedule_digest()
                            for i in (inj, inj_b, inj_m, inj_r,
@@ -1493,7 +1643,8 @@ def run_all(seed, waves):
             "migration_drill": migration,
             "spectral_drill": spectral,
             "update_drill": update,
-            "tuner_drill": tuner}, schedule
+            "tuner_drill": tuner,
+            "recorder_drill": recorder}, schedule
 
 
 def main(argv=None):
@@ -1586,6 +1737,15 @@ def main(argv=None):
         # (counted, zero-compile recovery) and the 5% win is refused,
         # re-flag demotes, consecutive failures open the breaker
         "tuner_shadow_isolated": phases["tuner_drill"]["ok"],
+        # round 22: the black box is trustworthy — every counted
+        # reflex that fired journaled exactly one decision (absolute
+        # parity per kind), an injected fault produced exactly ONE
+        # incident despite 6 firings + repeated scrapes (dedup and
+        # rate-limit counted; the window expires, not latches), the
+        # captured documents validate as slate_tpu.incident.v1 with
+        # the journal slice riding along, the crash-safe disk twins
+        # match, and the journal digest is a pure function of the seed
+        "recorder_black_box": phases["recorder_drill"]["ok"],
     }
     ok = (all(ph["ok"] for ph in phases.values())
           and invariants["wrong_answers"] == 0

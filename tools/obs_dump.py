@@ -120,6 +120,11 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     # session-lifetime global counters, so every credited event must
     # be attributed)
     sess.enable_attribution()
+    # round 22: flight recorder + decision journal + incident capture
+    # on from the FIRST request (journal/counter parity below is
+    # absolute equality, so the recorder must predate any reflex)
+    sess.enable_recorder(incident_dir=os.path.join(out_dir,
+                                                   "incidents"))
     h = sess.register(A, op="chol", tenant="tenant-a")
     srv = sess.serve_obs()  # opt-in HTTP endpoint, ephemeral port
     try:
@@ -634,12 +639,61 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
             fails.append("attribution fold did not count the partial "
                          "host")
 
+        # -- decision journal + incidents (round 22) --------------------
+        # an explicit eviction gives the journal a decision whose
+        # counter parity is absolute (recorder predates the register),
+        # and a probe incident drives the crash-safe capture path
+        sess.evict(h)
+        jp = sess.recorder.journal.payload()
+        if not jp["events"]:
+            fails.append("decision journal empty after an explicit "
+                         "evict")
+        if jp["counts"].get("eviction") != sess.metrics.get("evictions"):
+            fails.append("journal eviction count != evictions counter: "
+                         f"{jp['counts'].get('eviction')} != "
+                         f"{sess.metrics.get('evictions')}")
+        sess.recorder.incident("obs_dump_probe", key="smoke", handle=h)
+        ip = sess.recorder.incidents.payload()
+        if not ip["incidents"]:
+            fails.append("probe incident was not captured")
+        else:
+            ierrs = obs.validate_incident(ip["incidents"][-1])
+            if ierrs:
+                fails.append(f"captured incident schema: {ierrs[:3]}")
+        idir = os.path.join(out_dir, "incidents")
+        on_disk = ([f2 for f2 in os.listdir(idir) if f2.endswith(".json")]
+                   if os.path.isdir(idir) else [])
+        if not on_disk:
+            fails.append("incident capture published no on-disk "
+                         "snapshot")
+        # 2-process journal fold: counts conserved exactly, events
+        # host-labeled (the fleet view of "why did N processes shed")
+        jf = obs.aggregate.merge_journal_payloads([jp, jp],
+                                                  hosts=["p0", "p1"])
+        for k3, v3 in jp["counts"].items():
+            if jf["counts"].get(k3) != 2 * v3:
+                fails.append(f"journal fold not exact for {k3}: "
+                             f"{jf['counts'].get(k3)} != 2*{v3}")
+                break
+        if jf.get("recorded") != 2 * jp["recorded"]:
+            fails.append("journal fold lost recorded totals")
+        if jp["events"] and not all(e3.get("host") in ("p0", "p1")
+                                    for e3 in jf["events"]):
+            fails.append("journal fold events not host-labeled")
+        iflt = obs.aggregate.merge_incident_payloads([ip, ip],
+                                                     hosts=["p0", "p1"])
+        if len(iflt["incidents"]) != 2 * len(ip["incidents"]):
+            fails.append("incident fold dropped incidents")
+
         # -- HTTP endpoint --------------------------------------------
         for path, needle in (("/metrics", "slate_tpu_solves_total"),
                              ("/healthz", '"status": "ok"'),
                              ("/trace.json", "traceEvents"),
                              ("/slo", '"objectives"'),
-                             ("/numerics", '"handles"')):
+                             ("/numerics", '"handles"'),
+                             ("/journal", '"slate_tpu.journal.v1"'),
+                             ("/incidents",
+                              '"slate_tpu.incidents.v1"')):
             body = urllib.request.urlopen(srv.url(path),
                                           timeout=10).read().decode()
             if needle not in body:

@@ -1,0 +1,22 @@
+"""The serve-artifact section list — ONE source of truth.
+
+Every top-level section the bench_serve artifact carries. The
+committed BENCH_SERVE_smoke.json fixture must have ALL of them
+(rounds 12 and 13 both tripped on stale fixtures when the schema grew
+a section). bench_serve.bench() asserts this at write time;
+tools/bench_gate.py --check-schema asserts it on the committed files;
+--regen-smoke is the guarded regeneration path.
+
+Rounds 12-21 kept two hand-synced copies (bench_serve.py + the
+jax-free mirror in bench_gate.py) pinned equal by test; round 22
+unifies them here. Stdlib-only — bench_gate must stay importable
+without jax — and loaded by file path under ONE fixed module name
+(``_load()`` in both consumers), so the legacy drift pin degenerates
+to an import-identity check: both tools hold the SAME tuple object.
+"""
+
+SERVE_ARTIFACT_SECTIONS = (
+    "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
+    "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
+    "tenants", "numerics", "quotas", "spectral", "updates", "tuning",
+    "incidents")
