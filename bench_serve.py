@@ -52,6 +52,7 @@ the structural columns are the portable claim.
 
 import argparse
 import json
+import math
 import os
 import re
 import sys
@@ -395,6 +396,55 @@ def _incidents_section(sess, handle):
     }
 
 
+def _forecast_section(sess):
+    """The serve artifact's round-23 ``forecast`` section: the
+    telemetry-history view of this exact workload — the full
+    ``slate_tpu.timeseries.v1`` store payload (what /history serves),
+    the ``slate_tpu.forecast.v1`` document over it (what /forecast
+    serves), and the counter-conservation table: every counter series'
+    lifetime delta sum must equal the live metric counter EXACTLY
+    (the store records deltas; their sum reconstructs the cumulative
+    value bit-for-bit). Exit-gated — a serving bench whose sensing
+    substrate stopped sampling, stopped validating, or lost a count is
+    a broken forecaster, not a slow bench. The embedded payloads are
+    what bench_gate --check-schema's file-loaded validators chew on."""
+    from slate_tpu.obs import validate_forecast, validate_timeseries
+
+    store = sess.timeseries
+    if store is None:
+        return {"enabled": False, "ok": False}
+    # final forced pump: the conservation check below compares against
+    # a counter snapshot taken AFTER this (nothing runs in between —
+    # the executor is closed and every other section already built)
+    sess.pump_timeseries(force=True)
+    history = store.payload()
+    hist_errs = validate_timeseries(history)
+    forecast = sess.forecaster.payload(horizon_s=60.0, k=4,
+                                       max_series=48, points_limit=8)
+    fc_errs = validate_forecast(forecast)
+    counters = sess.metrics.snapshot()["counters"]
+    conservation = {}
+    for name, total in sorted(store.counter_totals().items()):
+        live = counters.get(name, 0.0)
+        conservation[name] = {"store": total, "counter": live,
+                              "ok": total == live}
+    ok = (not hist_errs and not fc_errs
+          and history["series_count"] > 0
+          and bool(conservation)
+          and all(r["ok"] for r in conservation.values()))
+    return {
+        "enabled": True,
+        "ok": ok,
+        "series_count": history["series_count"],
+        "dropped_series": history["dropped_series"],
+        "dropped_samples": history["dropped_samples"],
+        "conservation": conservation,
+        "history": history,
+        "forecast": forecast,
+        "validator_errors": hist_errs + fc_errs,
+    }
+
+
 def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
           dtype=np.float32, out_path="BENCH_SERVE.json"):
     import jax
@@ -446,6 +496,11 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # artifact's "incidents" section can check journal/counter parity
     # as absolute equality (both start at zero together)
     sess.enable_recorder()
+    # round 23: the telemetry time-series store through the bench —
+    # the sampler pumps (throttled) as results drain, so the
+    # artifact's "forecast" section records the history-and-forecast
+    # view of this exact workload, exit-gated below
+    sess.enable_timeseries(interval_s=0.25)
     h = sess.register(A, op="chol", tenant="bench-a")
     with Executor(sess, max_batch=max_batch, max_wait=max_wait) as ex:
         ex.warmup([h])  # factor + AOT compile off the request path
@@ -453,7 +508,10 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         futs = [ex.submit(h, b, tenant=("bench-b" if i % 4 == 3
                                         else None))
                 for i, b in enumerate(rhs)]
-        xs = [f.result(timeout=600) for f in futs]
+        xs = []
+        for f in futs:
+            xs.append(f.result(timeout=600))
+            sess.pump_timeseries()  # <=1 sampling pass per 0.25 s
         serve_wall = time.perf_counter() - t0
 
     # correctness spot check (serving a wrong answer fast is not a win)
@@ -480,6 +538,10 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
     # round 22: built LAST so every decision the exercises above made
     # (evictions, update refactors, ...) is inside the parity check
     incidents_section = _incidents_section(sess, h)
+    # round 23: built after incidents (its probe capture bumps
+    # counters) so the final forced pump sees every count this run
+    # will ever make — the conservation table then holds exactly
+    forecast_section = _forecast_section(sess)
     artifact = {
         "bench": "serve",
         "backend": jax.devices()[0].platform,
@@ -550,6 +612,12 @@ def bench(n=512, nb=128, requests=64, max_batch=16, max_wait=1e-3,
         # slate_tpu.incident.v1 (exit-gated below and by bench_gate
         # --check-schema on the committed fixture)
         "incidents": incidents_section,
+        # round 23: the sensing-substrate view — the bounded
+        # time-series store's full /history payload, the /forecast
+        # document over it, and exact counter conservation between
+        # the store's delta sums and the live metric counters
+        # (exit-gated below and by bench_gate --check-schema)
+        "forecast": forecast_section,
     }
     artifact["speedup"] = (artifact["serve"]["solves_per_sec"]
                            / artifact["per_request"]["solves_per_sec"])
@@ -1980,6 +2048,149 @@ def _reexec_multichip(argv, n_devices):
     return r.returncode
 
 
+def bench_forecast(n=192, nb=64, requests=32, max_batch=8,
+                   dtype=np.float32, cycles=6, period_s=300.0,
+                   step_s=10.0, micro_samples=20000,
+                   out_path="BENCH_FORECAST_r01.json"):
+    """The round-23 sensing-substrate A/B (BENCH_FORECAST artifact):
+
+    * ``serve``   — the same warmed resident-factor serve with the
+      time-series store pumping FORCED on every result vs no store at
+      all: the store's worst-case cost on the request path (the
+      in-bench integration throttles to 4 Hz; this arm is the upper
+      bound).
+    * ``store``   — the record-path micro: ns per ``record_gauge``
+      sample through ring + both downsample tiers, measured over
+      ``micro_samples`` appends on one series.
+    * ``holdout`` — predicted-vs-actual: a deterministic diurnal
+      trace (fixed rng, injected clock), first ``cycles-1`` cycles
+      shown to the forecaster, last cycle held out; MAE of the
+      forecast over the held-out cycle vs the naive last-value
+      baseline's MAE. The seasonal ladder must (a) find the true
+      period, (b) beat naive, and (c) claim NO period on an aperiodic
+      control trace — a forecaster that hallucinates seasonality
+      would pre-warm the wrong handles on schedule.
+
+    Exit: ok iff the holdout gates hold and both serve arms ran.
+    Wall-clock numbers are honestly labeled CPU smoke when run there.
+    """
+    import jax
+
+    import slate_tpu as st
+    from slate_tpu.obs.forecast import forecast_points
+    from slate_tpu.obs.timeseries import TimeseriesStore
+    from slate_tpu.runtime import Executor, Session
+
+    A, _spd = _build_operator(n, nb, dtype)
+    rng = np.random.default_rng(11)
+    rhs = [rng.standard_normal(n).astype(dtype)
+           for _ in range(requests)]
+
+    def _serve_arm(with_store):
+        sess = Session(hbm_budget=1 << 30)
+        if with_store:
+            sess.enable_timeseries(interval_s=0.0)
+        h = sess.register(A, op="chol")
+        with Executor(sess, max_batch=max_batch, max_wait=1e-3) as ex:
+            ex.warmup([h])
+            t0 = time.perf_counter()
+            futs = [ex.submit(h, b) for b in rhs]
+            pumped = 0
+            for f in futs:
+                f.result(timeout=600)
+                if with_store:
+                    pumped += sess.pump_timeseries(force=True)
+            wall = time.perf_counter() - t0
+        return requests / wall, pumped, sess
+
+    base_sps, _, _ = _serve_arm(False)
+    store_sps, pumped, sess = _serve_arm(True)
+    overhead_pct = 100.0 * (base_sps - store_sps) / base_sps
+
+    # -- record-path micro (injected clock: no wall reads in the loop)
+    mstore = TimeseriesStore(clock=lambda: 0.0)
+    t0 = time.perf_counter()
+    for i in range(micro_samples):
+        mstore.record_gauge("micro", float(i & 1023), t=0.5 * i)
+    record_ns = (time.perf_counter() - t0) / micro_samples * 1e9
+
+    # -- holdout: seasonal trace, last cycle held out ----------------------
+    hrng = np.random.default_rng(23)
+    steps_per_cycle = int(period_s / step_s)
+    total = steps_per_cycle * cycles
+    ts0 = 1_000.0
+    series = [(ts0 + step_s * i,
+               5.0 + 3.0 * math.sin(2 * math.pi * i / steps_per_cycle)
+               + float(hrng.normal(0.0, 0.15)))
+              for i in range(total)]
+    train = series[:-steps_per_cycle]
+    test = dict((round(t, 6), v) for t, v in series[-steps_per_cycle:])
+    fc = forecast_points(train, horizon_s=period_s)
+    pairs = [(p[1], test[round(p[0], 6)]) for p in fc["points"]
+             if round(p[0], 6) in test]
+    mae = (sum(abs(a - b) for a, b in pairs) / len(pairs)
+           if pairs else float("inf"))
+    naive = train[-1][1]
+    naive_mae = sum(abs(naive - v) for v in test.values()) / len(test)
+    improvement = naive_mae / mae if mae > 0 else float("inf")
+
+    # aperiodic control: drifting white noise must yield NO period
+    arng = np.random.default_rng(29)
+    ap = [(ts0 + step_s * i, 2.0 + 0.001 * i
+           + float(arng.normal(0.0, 0.5))) for i in range(total)]
+    ap_fc = forecast_points(ap[:-steps_per_cycle], horizon_s=period_s)
+
+    holdout_ok = (fc["period_s"] == period_s
+                  and fc["method"] in ("holt_winters",
+                                       "seasonal_naive")
+                  and improvement > 1.0
+                  and ap_fc["period_s"] is None)
+    artifact = {
+        "bench": "serve_forecast",
+        "platform": jax.devices()[0].platform,
+        "dtype": np.dtype(dtype).name,
+        "n": n, "nb": nb, "requests": requests,
+        "note": "store overhead is the FORCED per-result pump (upper "
+                "bound; the serve bench throttles to 4 Hz); wall "
+                "numbers are CPU smoke unless platform says tpu",
+        "serve": {
+            "with_store_solves_per_sec": store_sps,
+            "without_store_solves_per_sec": base_sps,
+            "overhead_pct": overhead_pct,
+            "samples_recorded": pumped,
+            "series_count": sess.timeseries.payload()["series_count"],
+        },
+        "store": {
+            "record_ns_per_sample": record_ns,
+            "micro_samples": micro_samples,
+        },
+        "holdout": {
+            "period_s_true": period_s,
+            "period_s_detected": fc["period_s"],
+            "method": fc["method"],
+            "points_train": len(train),
+            "points_test": len(test),
+            "matched_points": len(pairs),
+            "mae": mae,
+            "naive_mae": naive_mae,
+            "improvement": improvement,
+            "aperiodic_period_s": ap_fc["period_s"],
+            "aperiodic_method": ap_fc["method"],
+        },
+        "ok": bool(holdout_ok and base_sps > 0 and store_sps > 0
+                   and pumped > 0),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"bench": "serve_forecast", "ok": artifact["ok"],
+                      "overhead_pct": round(overhead_pct, 2),
+                      "record_ns_per_sample": round(record_ns, 1),
+                      "holdout_improvement": round(improvement, 2),
+                      "method": fc["method"]}, sort_keys=True))
+    return artifact
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
@@ -2056,6 +2267,17 @@ def main(argv=None):
                         "per (op, n) with both arms' solves/sec, "
                         "compile counts, and config provenance")
     p.add_argument("--tuned-out", default="BENCH_TUNED_r01.json")
+    p.add_argument("--forecast", action="store_true",
+                   help="run the round-23 sensing-substrate A/B: the "
+                        "same warmed serve with the time-series store "
+                        "pumping per-result vs without, the "
+                        "record-path micro, and the predicted-vs-"
+                        "actual holdout (seasonal trace, last cycle "
+                        "held out, MAE vs naive-last); exit 0 iff the "
+                        "forecaster finds the true period, beats "
+                        "naive, and claims no period on the aperiodic "
+                        "control (CPU smoke, honestly labeled)")
+    p.add_argument("--forecast-out", default="BENCH_FORECAST_r01.json")
     p.add_argument("--regen-smoke", action="store_true",
                    help="GUARDED regeneration of the committed "
                         "BENCH_SERVE_smoke.json fixture (+ .metrics."
@@ -2115,6 +2337,15 @@ def main(argv=None):
                               out_path=args.tuned_out)
         else:
             art = bench_tuned(out_path=args.tuned_out)
+        return 0 if art["ok"] else 1
+    if args.forecast:
+        if args.smoke:
+            art = bench_forecast(n=96, nb=32, requests=16,
+                                 max_batch=4, cycles=5,
+                                 micro_samples=5000,
+                                 out_path=args.forecast_out)
+        else:
+            art = bench_forecast(out_path=args.forecast_out)
         return 0 if art["ok"] else 1
     if args.overload:
         art = bench_overload(out_path=args.overload_out)
@@ -2194,10 +2425,13 @@ def main(argv=None):
     # round 22: the incidents section exit-gates too — a journal that
     # drifted from its counters (or a probe incident that fails its
     # own schema) is a broken black box
+    # round 23: the forecast section exit-gates too — a store whose
+    # counter deltas stopped summing to the live counters (or whose
+    # payloads fail their own schemas) is a broken sensing substrate
     ok = (art["speedup"] > 1.0 and art["tenants"]["conservation_ok"]
           and art["numerics"]["ok"] and art["spectral"]["ok"]
           and art["updates"]["ok"] and art["tuning"]["ok"]
-          and art["incidents"]["ok"])
+          and art["incidents"]["ok"] and art["forecast"]["ok"])
     print(f"serve {art['serve']['solves_per_sec']:.1f} solves/s vs "
           f"per-request {art['per_request']['solves_per_sec']:.1f} "
           f"solves/s -> speedup {art['speedup']:.2f}x "

@@ -214,12 +214,29 @@ def main(argv=None):
     if r.returncode != 0:
         fails += 1
         print("!!! bench_serve --tuned FAILED")
+    # telemetry-history + forecasting smoke (round 23): store-on vs
+    # store-off serve arms, the record-path micro, and a synthetic
+    # periodic holdout — exits nonzero unless the forecaster detects
+    # the true period, beats last-value persistence on the held-out
+    # cycle, stays silent on an aperiodic control, and every counter
+    # conserves exactly through the store's delta samples
+    print("=== bench_serve.py --forecast --smoke ===")
+    r = subprocess.run(
+        [sys.executable, str(here.parent / "bench_serve.py"),
+         "--forecast", "--smoke",
+         "--forecast-out", "/tmp/BENCH_FORECAST_smoke.json"],
+        cwd=here.parent, env=env_ex)
+    if r.returncode != 0:
+        fails += 1
+        print("!!! bench_serve --forecast FAILED")
     # observability smoke: traced served workload -> Chrome-trace JSON
     # (schema-validated), Prometheus text, SVG, and the /metrics HTTP
     # endpoint (tools/obs_dump.py exits nonzero on any export failure —
     # incl. the round-15 tenant/placement sections: attribution
     # conservation, placement-snapshot schema, the /tenants route,
-    # tenant_* prom rows, and the 2-process attribution/placement fold)
+    # tenant_* prom rows, the 2-process attribution/placement fold,
+    # and the round-23 /history + /forecast payloads with exact
+    # counter conservation through the store)
     print("=== tools/obs_dump.py --smoke ===")
     r = subprocess.run(
         [sys.executable, str(here.parent / "tools" / "obs_dump.py"),

@@ -44,6 +44,15 @@ tools ingest:
   transitions materialize rate-limited, deduped, crash-safe
   ``slate_tpu.incident.v1`` snapshots (the ``/journal`` +
   ``/incidents`` routes; fleet folds in :mod:`.aggregate`).
+* :mod:`.timeseries` / :mod:`.forecast` — the telemetry-history layer
+  (round 23): a bounded per-series store (raw rings + 10 s/60 s
+  min/max/sum/count downsample tiers, counter-to-rate, hard
+  cardinality cap) fed by a ``pump()``-style Session sampler, and
+  deterministic trend/seasonality forecasting over it
+  (autocorrelation periodicity, seasonal-naive/Holt-Winters with
+  confidence bands, ``predicted_hot`` / ``time_to_exhaustion`` — the
+  elastic-fleet sensing substrate; ``/history`` + ``/forecast``
+  routes; fleet fold in :mod:`.aggregate`).
 * :mod:`.numerics`   — numerical-health telemetry (round 16): the
   growth-bound machinery (one source of truth with the tester), the
   Hager/Higham condest loop the Session drives with resident-factor
@@ -56,12 +65,15 @@ See DESIGN.md "Observability (round 8)" for the reference mapping
 map / --timer-level -> Metrics histograms / Prometheus text).
 """
 
-from . import (aggregate, attribution, costs, events, flops, numerics,
-               recorder, roofline, slo, watchdog)
+from . import (aggregate, attribution, costs, events, flops, forecast,
+               numerics, recorder, roofline, slo, timeseries, watchdog)
 from .attribution import AttributionLedger
 from .events import DecisionEvent, journal_digest, validate_incident
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .exposition import ObsServer, render_prometheus
+from .forecast import Forecaster, forecast_points, validate_forecast
+from .timeseries import (SessionSampler, TimeseriesStore,
+                         validate_timeseries)
 from .merge import combine_process_traces, lookahead_overlap, merge_traces
 from .numerics import NumericsConfig, NumericsMonitor
 from .recorder import (DecisionJournal, FlightRecorder, IncidentCapture,
@@ -72,16 +84,19 @@ from .watchdog import Watchdog
 
 __all__ = [
     "AttributionLedger", "DecisionEvent", "DecisionJournal",
-    "FlightRecorder", "IncidentCapture", "NOOP_SPAN", "NumericsConfig",
+    "FlightRecorder", "Forecaster", "IncidentCapture", "NOOP_SPAN",
+    "NumericsConfig",
     "NumericsMonitor", "Objective", "ObsServer", "Recorder",
-    "SloTracker", "Span", "Tracer",
+    "SessionSampler", "SloTracker", "Span", "TimeseriesStore", "Tracer",
     "Watchdog", "aggregate", "attribution", "chrome_trace",
     "combine_process_traces",
-    "costs", "default_tracer", "events", "flops", "journal_digest",
+    "costs", "default_tracer", "events", "flops", "forecast",
+    "forecast_points", "journal_digest",
     "lookahead_overlap",
     "merge_traces", "numerics", "recorder", "render_prometheus",
-    "roofline", "slo",
-    "validate_chrome_trace", "validate_incident", "watchdog",
+    "roofline", "slo", "timeseries",
+    "validate_chrome_trace", "validate_forecast", "validate_incident",
+    "validate_timeseries", "watchdog",
     "write_chrome_trace",
 ]
 

@@ -45,7 +45,7 @@ __all__ = [
     "merge_flop_snapshots", "merge_histograms",
     "merge_incident_payloads", "merge_journal_payloads",
     "merge_metrics_snapshots", "merge_placement_snapshots",
-    "merge_quota_payloads",
+    "merge_quota_payloads", "merge_timeseries_payloads",
     "aggregate_processes", "placement_from_checkpoint",
     "render_fleet_prometheus", "write_fleet",
 ]
@@ -130,6 +130,11 @@ def merge_metrics_snapshots(snaps: Sequence[dict],
          if name in s.get("histograms", {})]) for name in hist_names}
     gauges_per_host = {label: dict(s.get("gauges", {}))
                        for label, s in zip(labels, snaps)}
+    # round 23: the per-host gauge rows keep their host label AND
+    # their set-time stamps — a fleet reader can tell a fresh value
+    # from one last true minutes before the scrape
+    gauge_ts_per_host = {label: dict(s.get("gauge_ts", {}))
+                         for label, s in zip(labels, snaps)}
     fleet_gauges = {}
     for g in _SUMMABLE_GAUGES:
         vals = [s["gauges"][g] for s in snaps if g in s.get("gauges", {})]
@@ -144,6 +149,7 @@ def merge_metrics_snapshots(snaps: Sequence[dict],
         "histograms": hists,
         "gauges": fleet_gauges,
         "gauges_per_host": gauges_per_host,
+        "gauge_ts_per_host": gauge_ts_per_host,
         "derived": _derive(counters, hists),
     }
 
@@ -431,6 +437,51 @@ def merge_journal_payloads(payloads: Sequence[dict],
         "counts": counts,
         "outcome_counts": outcome_counts,
         "events": events,
+    }
+
+
+def merge_timeseries_payloads(payloads: Sequence[dict],
+                              hosts: Optional[Sequence[str]] = None
+                              ) -> dict:
+    """N ``TimeseriesStore.payload()`` docs -> one fleet history view
+    (round 23): every member's series kept host-labeled under
+    ``"<host>:<name>"`` (a fleet has one queue-depth history per
+    member, not one mush), drop accounting summed, and every COUNTER
+    series' lifetime sum folded into ``counter_totals`` by plain float
+    addition — the round-12 conservation discipline: merging two
+    copies of one payload doubles every counter total bit-exactly,
+    and the fleet total equals the sum of the members' cumulative
+    counters. ``None`` entries (a host inside the crash window)
+    are tolerated and counted ``partial_processes``."""
+    raw = list(payloads)
+    labels = _hosts(len(raw), hosts)
+    series: Dict[str, dict] = {}
+    counter_totals: Dict[str, float] = {}
+    dropped_series = dropped_samples = 0
+    partial = 0
+    for label, p in zip(labels, raw):
+        if not p:
+            partial += 1
+            continue
+        for name, row in p.get("series", {}).items():
+            labeled = dict(row)
+            labeled["host"] = label
+            series[f"{label}:{name}"] = labeled
+            if row.get("kind") == "counter":
+                counter_totals[name] = (counter_totals.get(name, 0.0)
+                                        + float(row.get("total_sum",
+                                                        0.0)))
+        dropped_series += int(p.get("dropped_series", 0))
+        dropped_samples += int(p.get("dropped_samples", 0))
+    return {
+        "schema": "slate_tpu.timeseries.fleet.v1",
+        "processes": len(raw),
+        "partial_processes": partial,
+        "hosts": labels,
+        "dropped_series": dropped_series,
+        "dropped_samples": dropped_samples,
+        "series": series,
+        "counter_totals": counter_totals,
     }
 
 

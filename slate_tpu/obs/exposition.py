@@ -18,6 +18,13 @@ a stdlib-only (http.server) threaded listener with
   per-handle condest/growth/residual signals and the
   healthy/degraded/suspect states; {"enabled": false} when no
   monitor is bound)
+* ``GET /history``    — the time-series store payload (round 23:
+  per-series raw rings + downsample tiers; ``?series=a,b`` filters;
+  {"enabled": false} when no store is bound — /metrics stays
+  instantaneous, history is JSON-only)
+* ``GET /forecast``   — per-series trend/seasonality forecasts,
+  predicted-hot ranking, exhaustion runways (``?horizon_s=`` tunes
+  the horizon; {"enabled": false} when no forecaster is bound)
 
 No third-party dependency, daemon threads only, ephemeral port by
 default (``port=0``) so tests and co-located sessions never collide.
@@ -30,6 +37,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 from . import flops as flops_mod
 from .export import chrome_trace
@@ -306,6 +314,38 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(payload, sort_keys=True,
                               default=repr) + "\n"
             self._reply(200, body, "application/json")
+        elif path == "/history":
+            # round 23: the time-series store (getter-bound — same
+            # late-enable discipline); ``?series=a,b`` filters.
+            # Prometheus (/metrics) stays instantaneous — history is
+            # JSON-only by design
+            store = (obs.history() if callable(obs.history)
+                     else obs.history)
+            if store is None:
+                payload = {"enabled": False, "series": {}}
+            else:
+                qs = parse_qs(urlsplit(self.path).query)
+                names = qs.get("series")
+                if names:
+                    names = [n for arg in names
+                             for n in arg.split(",") if n]
+                payload = store.payload(series=names or None)
+            body = json.dumps(payload, sort_keys=True) + "\n"
+            self._reply(200, body, "application/json")
+        elif path == "/forecast":
+            fc = (obs.forecast() if callable(obs.forecast)
+                  else obs.forecast)
+            if fc is None:
+                payload = {"enabled": False, "series": {}}
+            else:
+                qs = parse_qs(urlsplit(self.path).query)
+                try:
+                    horizon = float(qs.get("horizon_s", ["300"])[0])
+                except ValueError:
+                    horizon = 300.0
+                payload = fc.payload(horizon_s=horizon)
+            body = json.dumps(payload, sort_keys=True) + "\n"
+            self._reply(200, body, "application/json")
         else:
             self._reply(404, "not found\n", "text/plain")
 
@@ -331,7 +371,7 @@ class ObsServer:
     def __init__(self, metrics, tracer=None, host: str = "127.0.0.1",
                  port: int = 0, ledger=None, slo=None, tenants=None,
                  attribution=None, numerics=None, quotas=None,
-                 recorder=None):
+                 recorder=None, history=None, forecast=None):
         self.metrics = metrics
         self.tracer = tracer
         # the /slo provider: an SloTracker, or a zero-arg callable
@@ -352,6 +392,10 @@ class ObsServer:
         # round 22: the Recorder behind /journal + /incidents (or
         # getter — same late-enable discipline)
         self.recorder = recorder
+        # round 23: the TimeseriesStore behind /history and the
+        # Forecaster behind /forecast (or getters — same discipline)
+        self.history = history
+        self.forecast = forecast
         self.ledger = ledger if ledger is not None else flops_mod.LEDGER
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

@@ -1,0 +1,406 @@
+"""Per-series trend + seasonality forecasting over the history store.
+
+The store (:mod:`.timeseries`) remembers; this module extrapolates —
+the sensing half of ROADMAP item 3's "fit per-handle periodicity so
+diurnal tenants get pre-replicated ahead of their peak". Everything is
+closed-form and deterministic: the same ring contents produce the same
+forecast bit-for-bit (no RNG, no wall-clock — the chaos drill pins a
+same-seed digest over two full runs).
+
+Method ladder (documented in DESIGN.md round 23 — seasonal-naive
+before Holt-Winters):
+
+* fewer than ``min_points`` samples — ``last``: flat carry-forward.
+* no detected period — ``trend``: least-squares line.
+* a period detected by autocorrelation but under three full cycles of
+  history — ``seasonal_naive``: repeat the last full cycle (with the
+  line's drift added). Needs one cycle, has no parameters to
+  mis-fit, and is the standard baseline any fancier model must beat.
+* three-plus cycles — ``holt_winters``: additive level/trend/seasonal
+  exponential smoothing (fixed, committed smoothing constants — no
+  online optimizer, no fit nondeterminism).
+
+Every forecast carries a confidence band (±z·σ of the method's own
+one-step-ahead residuals — honest about how well it fit the ring, not
+a distributional claim). Periodicity detection detrends first so a
+ramp is never mistaken for seasonality (pinned by the aperiodic-series
+test).
+
+Queries: :meth:`Forecaster.predicted_hot` ranks heat series by
+predicted peak over a horizon (the pre-replication input
+``Fleet.replicate_hot`` will consume); :meth:`time_to_exhaustion`
+projects a lower-is-worse gauge (HBM headroom, quota headroom) to its
+zero crossing. Stdlib-only and jax-free (the obs import rule); the
+functional core (:func:`detect_period`, :func:`forecast_points`)
+takes plain ``(ts, value)`` lists so ``tools/capacity_report.py``
+can run it over exported payload files with no runtime import.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FORECAST_SCHEMA", "Forecaster", "detect_period",
+           "forecast_points", "validate_forecast"]
+
+FORECAST_SCHEMA = "slate_tpu.forecast.v1"
+
+# Holt-Winters smoothing constants: committed, not fitted (fitting
+# them online would make the forecast depend on optimizer state —
+# the determinism contract outranks the last few percent of error)
+_HW_ALPHA = 0.35    # level
+_HW_BETA = 0.05     # trend
+_HW_GAMMA = 0.30    # seasonal
+
+_MIN_POINTS = 8
+_ACF_THRESHOLD = 0.5
+_Z = 1.96
+
+
+def _linear_fit(values: Sequence[float]) -> Tuple[float, float]:
+    """Least-squares (intercept, slope-per-sample) of values vs index."""
+    n = len(values)
+    if n < 2:
+        return (values[0] if values else 0.0), 0.0
+    sx = (n - 1) * n / 2.0
+    sxx = (n - 1) * n * (2 * n - 1) / 6.0
+    sy = sum(values)
+    sxy = sum(i * v for i, v in enumerate(values))
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        return sy / n, 0.0
+    slope = (n * sxy - sx * sy) / denom
+    intercept = (sy - slope * sx) / n
+    return intercept, slope
+
+
+def detect_period(values: Sequence[float], min_period: int = 2,
+                  acf_threshold: float = _ACF_THRESHOLD
+                  ) -> Optional[int]:
+    """Dominant period (in samples) by autocorrelation, or None.
+
+    The series is detrended (least-squares line removed) first — a
+    monotone ramp autocorrelates strongly at every lag and must not
+    read as seasonality. A lag qualifies when its ACF clears
+    ``acf_threshold`` AND is a local maximum; the best-scoring such
+    lag wins. Needs at least two full cycles in ``values`` (lags are
+    searched up to len//2)."""
+    n = len(values)
+    if n < 2 * min_period + 2:
+        return None
+    intercept, slope = _linear_fit(values)
+    x = [v - (intercept + slope * i) for i, v in enumerate(values)]
+    var = sum(v * v for v in x) / n
+    if var <= 0:
+        return None
+    max_lag = n // 2
+    # length-normalized ACF (mean of products over the overlap, not
+    # the biased sum-over-full-variance): the biased estimator decays
+    # with lag and would hand every smooth series a tiny-lag "period"
+    acf = [0.0] * (max_lag + 2)
+    acf[0] = 1.0
+    for lag in range(1, max_lag + 1):
+        acf[lag] = (sum(x[i] * x[i + lag] for i in range(n - lag))
+                    / (n - lag)) / var
+    acf[max_lag + 1] = -math.inf
+    best_lag = None
+    best_score = acf_threshold
+    for lag in range(min_period, max_lag + 1):
+        a = acf[lag]
+        # a TRUE interior local maximum (strictly above the lag-1
+        # neighbor): a smooth series' ACF declines from lag 0, so only
+        # a genuine cycle produces a rebound peak
+        if a > best_score and a > acf[lag - 1] and a >= acf[lag + 1]:
+            best_score = a
+            best_lag = lag
+    return best_lag
+
+
+def _resample(points: Sequence[Tuple[float, float]]
+              ) -> Tuple[List[float], float, float]:
+    """(ts, value) points -> (evenly-gridded values, t0, dt).
+
+    The grid step is the median inter-sample gap; gaps carry the
+    previous value forward (a missed pump must not shift every later
+    sample's phase). Deterministic for deterministic input."""
+    pts = sorted(points)
+    if len(pts) < 2:
+        vals = [v for _, v in pts]
+        return vals, (pts[0][0] if pts else 0.0), 1.0
+    gaps = sorted(pts[i + 1][0] - pts[i][0]
+                  for i in range(len(pts) - 1))
+    dt = gaps[len(gaps) // 2]
+    if dt <= 0:
+        dt = 1.0
+    t0 = pts[0][0]
+    span = pts[-1][0] - t0
+    steps = int(round(span / dt)) + 1
+    out: List[float] = []
+    j = 0
+    last = pts[0][1]
+    for i in range(steps):
+        t = t0 + i * dt
+        while j < len(pts) and pts[j][0] <= t + dt / 2:
+            last = pts[j][1]
+            j += 1
+        out.append(last)
+    return out, t0, dt
+
+
+def _holt_winters(values: Sequence[float], period: int
+                  ) -> Tuple[float, float, List[float], List[float]]:
+    """One deterministic additive-HW pass. Returns (level, trend,
+    seasonal[period], one_step_errors). Initialization: first-cycle
+    mean for level, cycle-over-cycle drift for trend, first-cycle
+    anomalies for the seasonal profile."""
+    m = period
+    c0 = values[:m]
+    c1 = values[m:2 * m]
+    level = sum(c0) / m
+    trend = ((sum(c1) / len(c1)) - level) / m if c1 else 0.0
+    season = [v - level for v in c0]
+    errors: List[float] = []
+    for i in range(m, len(values)):
+        s = season[i % m]
+        yhat = level + trend + s
+        y = values[i]
+        errors.append(y - yhat)
+        new_level = (_HW_ALPHA * (y - s)
+                     + (1 - _HW_ALPHA) * (level + trend))
+        trend = (_HW_BETA * (new_level - level)
+                 + (1 - _HW_BETA) * trend)
+        season[i % m] = (_HW_GAMMA * (y - new_level)
+                         + (1 - _HW_GAMMA) * s)
+        level = new_level
+    return level, trend, season, errors
+
+
+def forecast_points(points: Sequence[Tuple[float, float]],
+                    horizon_s: float,
+                    min_points: int = _MIN_POINTS,
+                    acf_threshold: float = _ACF_THRESHOLD,
+                    z: float = _Z, max_steps: int = 256) -> dict:
+    """Forecast one series ``horizon_s`` past its last sample.
+
+    Returns ``{method, period_s, dt, sigma, slope_per_s, last,
+    last_ts, points: [[t, yhat, lo, hi], ...]}`` (points capped at
+    ``max_steps``). Pure function of its inputs — the determinism
+    contract the chaos drill digests."""
+    pts = [(float(t), float(v)) for t, v in points]
+    if not pts:
+        return {"method": "empty", "period_s": None, "dt": None,
+                "sigma": None, "slope_per_s": 0.0, "last": None,
+                "last_ts": None, "points": []}
+    values, t0, dt = _resample(pts)
+    last_ts = t0 + (len(values) - 1) * dt
+    last = values[-1]
+    steps = max(1, min(max_steps, int(math.ceil(horizon_s / dt))))
+    n = len(values)
+    period = (detect_period(values, acf_threshold=acf_threshold)
+              if n >= min_points else None)
+    intercept, slope = _linear_fit(values)
+
+    if n < min_points:
+        method = "last"
+        spread = (max(values) - min(values)) if n > 1 else 0.0
+        sigma = spread / 2.0
+        preds = [last] * steps
+        slope = 0.0
+    elif period is None:
+        method = "trend"
+        resid = [v - (intercept + slope * i)
+                 for i, v in enumerate(values)]
+        sigma = math.sqrt(sum(r * r for r in resid)
+                          / max(1, len(resid)))
+        preds = [intercept + slope * (n - 1 + h)
+                 for h in range(1, steps + 1)]
+    elif n >= 3 * period:
+        method = "holt_winters"
+        level, trend, season, errors = _holt_winters(values, period)
+        sigma = math.sqrt(sum(e * e for e in errors)
+                          / max(1, len(errors)))
+        preds = [level + h * trend + season[(n + h - 1) % period]
+                 for h in range(1, steps + 1)]
+        slope = trend  # HW's own per-sample trend replaces the line's
+    else:
+        method = "seasonal_naive"
+        # repeat the last full cycle, drifted by the fitted line —
+        # one-cycle-back residuals give the band
+        errors = [values[i] - values[i - period]
+                  for i in range(period, n)]
+        sigma = math.sqrt(sum(e * e for e in errors)
+                          / max(1, len(errors)))
+        preds = []
+        for h in range(1, steps + 1):
+            src = n - period + ((h - 1) % period)
+            preds.append(values[src] + slope * period
+                         * ((h - 1) // period + 1))
+    band = z * sigma if sigma is not None else 0.0
+    out_pts = [[last_ts + h * dt, p, p - band, p + band]
+               for h, p in zip(range(1, steps + 1), preds)]
+    return {
+        "method": method,
+        "period_s": None if period is None else period * dt,
+        "dt": dt,
+        "sigma": sigma,
+        "slope_per_s": slope / dt if dt else 0.0,
+        "last": last,
+        "last_ts": last_ts,
+        "points": out_pts,
+    }
+
+
+def validate_forecast(doc: dict) -> List[str]:
+    """Schema errors of a ``/forecast`` payload (empty = valid) —
+    mirrored jax-free in tools/bench_gate.py (drift-pinned by test)."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["forecast: top level is not an object"]
+    if doc.get("schema") != FORECAST_SCHEMA:
+        errs.append(f"forecast: schema {doc.get('schema')!r} != "
+                    f"{FORECAST_SCHEMA!r}")
+    for k in ("horizon_s", "series", "predicted_hot", "exhaustion"):
+        if k not in doc:
+            errs.append(f"forecast: missing {k!r}")
+    series = doc.get("series")
+    if not isinstance(series, dict):
+        errs.append("forecast: series is not an object")
+        return errs
+    for name, row in series.items():
+        if not isinstance(row, dict):
+            errs.append(f"forecast series[{name}]: not an object")
+            continue
+        if row.get("method") not in ("empty", "last", "trend",
+                                     "seasonal_naive", "holt_winters"):
+            errs.append(f"forecast series[{name}]: method "
+                        f"{row.get('method')!r}")
+        for p in (row.get("points") or []):
+            if not (isinstance(p, list) and len(p) == 4):
+                errs.append(f"forecast series[{name}]: point is not "
+                            "[t,yhat,lo,hi]")
+                break
+    hot = doc.get("predicted_hot")
+    if not isinstance(hot, list):
+        errs.append("forecast: predicted_hot is not a list")
+    else:
+        for r in hot:
+            if not (isinstance(r, dict) and "series" in r
+                    and "predicted_peak" in r):
+                errs.append("forecast: predicted_hot row missing "
+                            "series/predicted_peak")
+                break
+    return errs
+
+
+# series-name prefixes that carry per-handle heat (the attribution
+# gauge vocabulary plus the sampler's decayed-heat series)
+_HEAT_PREFIXES = ("heat:", "handle_heat:")
+# lower-is-worse headroom gauges worth a runway projection
+_HEADROOM_SERIES = ("hbm_headroom",)
+_HEADROOM_PREFIXES = ("tenant_quota_hbm_headroom:",)
+
+
+class Forecaster:
+    """Forecast queries over one :class:`~.timeseries.TimeseriesStore`
+    (module docstring). Shares the store's injected clock."""
+
+    def __init__(self, store, min_points: int = _MIN_POINTS,
+                 acf_threshold: float = _ACF_THRESHOLD, z: float = _Z,
+                 clock: Optional[Callable[[], float]] = None):
+        self.store = store
+        self.min_points = int(min_points)
+        self.acf_threshold = float(acf_threshold)
+        self.z = float(z)
+        self._clock = store._clock if clock is None else clock
+
+    def forecast_series(self, name: str, horizon_s: float) -> dict:
+        return forecast_points(self.store.points(name), horizon_s,
+                               min_points=self.min_points,
+                               acf_threshold=self.acf_threshold,
+                               z=self.z)
+
+    # -- queries -------------------------------------------------------------
+
+    def predicted_hot(self, k: int = 5, horizon_s: float = 300.0
+                      ) -> List[dict]:
+        """Top-``k`` heat series ranked by predicted PEAK over the
+        horizon — the handles item 3's pre-replication will warm
+        before their peak arrives. Ties break by name (deterministic
+        under the digest contract)."""
+        rows = []
+        for name in self.store.names():
+            pfx = next((p for p in _HEAT_PREFIXES
+                        if name.startswith(p)), None)
+            if pfx is None:
+                continue
+            fc = self.forecast_series(name, horizon_s)
+            if not fc["points"]:
+                continue
+            peak_pt = max(fc["points"], key=lambda p: p[1])
+            rows.append({
+                "series": name,
+                "handle": name[len(pfx):],
+                "current": fc["last"],
+                "predicted_peak": peak_pt[1],
+                "peak_ts": peak_pt[0],
+                "method": fc["method"],
+                "period_s": fc["period_s"],
+            })
+        rows.sort(key=lambda r: (-r["predicted_peak"], r["series"]))
+        return rows[:int(k)]
+
+    def time_to_exhaustion(self, series: str,
+                           floor: float = 0.0) -> Optional[float]:
+        """Seconds until ``series`` is projected to cross ``floor``
+        (linear trend over the retained ring), or None when it is not
+        trending down / already unknown. ``0.0`` = already at/below
+        the floor — exhausted now."""
+        pts = self.store.points(series)
+        if len(pts) < 2:
+            return None
+        fc = forecast_points(pts, horizon_s=1.0,
+                             min_points=self.min_points,
+                             acf_threshold=self.acf_threshold,
+                             z=self.z)
+        last = fc["last"]
+        if last is None:
+            return None
+        if last <= floor:
+            return 0.0
+        slope = fc["slope_per_s"]
+        if slope >= 0:
+            return None
+        return (last - floor) / (-slope)
+
+    # -- the /forecast route -------------------------------------------------
+
+    def payload(self, horizon_s: float = 300.0, k: int = 8,
+                max_series: int = 128, points_limit: int = 32) -> dict:
+        """The ``/forecast`` route document: a per-series forecast
+        summary for every GAUGE series (bounded), the predicted-hot
+        ranking, and exhaustion runways for the headroom gauges."""
+        now = self._clock()
+        series: Dict[str, dict] = {}
+        for name in self.store.names()[:int(max_series)]:
+            if self.store.kind(name) != "gauge":
+                continue
+            fc = self.forecast_series(name, horizon_s)
+            fc["points"] = fc["points"][:int(points_limit)]
+            series[name] = fc
+        exhaustion: Dict[str, Optional[float]] = {}
+        for name in self.store.names():
+            if (name in _HEADROOM_SERIES
+                    or any(name.startswith(p)
+                           for p in _HEADROOM_PREFIXES)):
+                exhaustion[name] = self.time_to_exhaustion(name)
+        return {
+            "schema": FORECAST_SCHEMA,
+            "now": now,
+            "horizon_s": float(horizon_s),
+            "series": series,
+            "predicted_hot": self.predicted_hot(k=k,
+                                                horizon_s=horizon_s),
+            "exhaustion": exhaustion,
+        }

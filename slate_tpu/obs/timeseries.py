@@ -1,0 +1,410 @@
+"""Bounded in-process time-series store: the fleet sensing substrate.
+
+SLATE ships per-run counter payloads (one number per counter at exit);
+the serving runtime's gauges are instantaneous and its EWMAs reactive.
+ROADMAP item 3's control loop (pre-replicate diurnal tenants AHEAD of
+their peak) needs *history* — so this module turns the gauge/counter
+firehose into bounded, queryable series the forecaster
+(:mod:`.forecast`) can fit:
+
+* :class:`TimeseriesStore` — per-series fixed-capacity rings with
+  downsample tiers: every sample lands in the raw ring AND is folded
+  into 10 s and 60 s buckets carrying ``[start, min, max, sum, count]``
+  — so rates and percentile-ish envelopes survive compaction (a raw
+  ring remembers minutes; the 60 s tier remembers hours at the same
+  memory). Counter series are stored as **deltas** (counter-to-rate
+  derivation: the window rate is bucket-sum over seconds, and the
+  series' running sum equals the counter's cumulative value exactly —
+  the conservation invariant the fleet fold and the tier-compaction
+  tests pin). Hard series-cardinality cap with counted drops; the
+  clock is injectable (no wall-clock in tests, the round-15/22
+  convention).
+* :class:`SessionSampler` — a ``pump()``-style (thread-free,
+  chaos-deterministic like ``Fleet.pump``) sampler snapshotting one
+  Session's gauges (at their *stamped* timestamps — when the value was
+  last true, not when it was scraped), counter deltas, per-handle
+  attribution heat, per-tenant SLO burn rates, HBM headroom, and
+  queue depth/age into the store.
+
+Disabled-path contract (the round-8 discipline, pinned by test):
+``session.timeseries`` defaults to None, every seam guards with ONE
+``is None`` check, and the disabled path allocates nothing in this
+module. The fleet story lives in :mod:`.aggregate`
+(``merge_timeseries_payloads``): N stores fold host-labeled with exact
+conservation on summed counter series. Stdlib-only and jax-free (the
+obs import rule).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["TIMESERIES_SCHEMA", "TIER_WIDTHS", "SessionSampler",
+           "TimeseriesStore", "validate_timeseries"]
+
+TIMESERIES_SCHEMA = "slate_tpu.timeseries.v1"
+# downsample tier widths in seconds (raw -> 10 s -> 60 s)
+TIER_WIDTHS = (10.0, 60.0)
+
+
+class _Series:
+    """One series' rings: the raw (ts, value) deque plus one bucket
+    deque per tier. Buckets are plain lists ``[start, min, max, sum,
+    count]`` (JSON-able as-is for the /history payload)."""
+
+    __slots__ = ("name", "kind", "raw", "tiers", "last_value",
+                 "last_ts", "cumulative", "total_sum", "total_count")
+
+    def __init__(self, name: str, kind: str, raw_cap: int,
+                 tier_caps: Sequence[int]):
+        self.name = name
+        self.kind = kind                      # "gauge" | "counter"
+        self.raw: "deque[Tuple[float, float]]" = deque(maxlen=raw_cap)
+        self.tiers: Tuple[deque, ...] = tuple(
+            deque(maxlen=int(c)) for c in tier_caps)
+        self.last_value: Optional[float] = None
+        self.last_ts: Optional[float] = None
+        # counter series: the last cumulative observation (deltas are
+        # derived against it; a decrease is a process restart and the
+        # new cumulative IS the delta — the Prometheus rate() rule)
+        self.cumulative = 0.0
+        # running totals over the series' LIFETIME (not just the
+        # retained window): for counters total_sum tracks the
+        # cumulative counter exactly — the conservation anchor
+        self.total_sum = 0.0
+        self.total_count = 0
+
+    def add(self, t: float, v: float, widths: Sequence[float]):
+        self.raw.append((t, v))
+        self.last_value = v
+        self.last_ts = t
+        self.total_sum += v
+        self.total_count += 1
+        for width, dq in zip(widths, self.tiers):
+            start = math.floor(t / width) * width
+            if dq and dq[-1][0] >= start:
+                # in-bucket (or a late sample: folded into the newest
+                # bucket so no delta is ever lost — conservation over
+                # monotone-enough clocks)
+                b = dq[-1]
+                b[1] = min(b[1], v)
+                b[2] = max(b[2], v)
+                b[3] += v
+                b[4] += 1
+            else:
+                dq.append([start, v, v, v, 1])
+
+
+class TimeseriesStore:
+    """Bounded multi-series store (module docstring).
+
+    ``raw_capacity`` samples per series; ``tier_capacities`` buckets
+    per downsample tier (widths ``tier_widths``); at most
+    ``max_series`` distinct series — a sample for a NEW series beyond
+    the cap is dropped and counted (``dropped_samples`` /
+    ``dropped_series``), never stored: handle churn cannot grow the
+    store without bound (the round-15 cardinality discipline)."""
+
+    def __init__(self, raw_capacity: int = 240,
+                 tier_capacities: Sequence[int] = (360, 360),
+                 tier_widths: Sequence[float] = TIER_WIDTHS,
+                 max_series: int = 512,
+                 host: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        if len(tier_capacities) != len(tier_widths):
+            raise ValueError("one capacity per tier width")
+        self.raw_capacity = int(raw_capacity)
+        self.tier_capacities = tuple(int(c) for c in tier_capacities)
+        self.tier_widths = tuple(float(w) for w in tier_widths)
+        self.max_series = int(max_series)
+        self.host = host
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+        self.dropped_samples = 0
+        # distinct refused names (the set itself is capped so the drop
+        # accounting cannot become the unbounded thing it counts)
+        self._refused: set = set()
+        self._refused_overflow = 0
+
+    # -- writes --------------------------------------------------------------
+
+    def _get_series(self, name: str, kind: str) -> Optional[_Series]:
+        """Caller holds the lock."""
+        s = self._series.get(name)
+        if s is None:
+            if len(self._series) >= self.max_series:
+                self.dropped_samples += 1
+                if len(self._refused) < 4 * self.max_series:
+                    self._refused.add(name)
+                elif name not in self._refused:
+                    self._refused_overflow = 1
+                return None
+            s = self._series[name] = _Series(
+                name, kind, self.raw_capacity, self.tier_capacities)
+        return s
+
+    def record_gauge(self, name: str, value: float,
+                     t: Optional[float] = None):
+        """One gauge sample (point-in-time value at ``t``)."""
+        t = self._clock() if t is None else t
+        v = float(value)
+        with self._lock:
+            s = self._get_series(str(name), "gauge")
+            if s is not None:
+                s.add(t, v, self.tier_widths)
+
+    def record_counter(self, name: str, cumulative: float,
+                       t: Optional[float] = None):
+        """One cumulative-counter observation: the stored sample is
+        the DELTA since the previous observation (first observation:
+        the cumulative itself, so the series' running sum equals the
+        counter exactly); a decrease reads as a restart."""
+        t = self._clock() if t is None else t
+        c = float(cumulative)
+        with self._lock:
+            s = self._get_series(str(name), "counter")
+            if s is None:
+                return
+            delta = c - s.cumulative
+            if delta < 0:            # counter reset (process restart)
+                delta = c
+            s.cumulative = c
+            s.add(t, delta, self.tier_widths)
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def dropped_series(self) -> int:
+        with self._lock:
+            return len(self._refused) + self._refused_overflow
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def kind(self, name: str) -> Optional[str]:
+        with self._lock:
+            s = self._series.get(name)
+            return None if s is None else s.kind
+
+    def points(self, name: str, lo: Optional[float] = None,
+               hi: Optional[float] = None) -> List[Tuple[float, float]]:
+        """Raw-ring samples of one series in [lo, hi] (oldest first)."""
+        with self._lock:
+            s = self._series.get(name)
+            pts = [] if s is None else list(s.raw)
+        if lo is not None:
+            pts = [p for p in pts if p[0] >= lo]
+        if hi is not None:
+            pts = [p for p in pts if p[0] <= hi]
+        return pts
+
+    def buckets(self, name: str, tier: int = 0) -> List[list]:
+        """One tier's ``[start, min, max, sum, count]`` buckets."""
+        with self._lock:
+            s = self._series.get(name)
+            return [] if s is None else [list(b) for b in s.tiers[tier]]
+
+    def window_stats(self, name: str, lo: float,
+                     hi: float) -> Optional[dict]:
+        """min/max/sum/count/mean over [lo, hi], from the raw ring
+        where it still covers the window and the finest tier's buckets
+        for the part the raw ring has already forgotten — the
+        watchdog's history-backed window aggregate."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return None
+            raw = list(s.raw)
+            tier0 = [list(b) for b in s.tiers[0]] if s.tiers else []
+        vmin = math.inf
+        vmax = -math.inf
+        vsum = 0.0
+        count = 0
+        raw_lo = raw[0][0] if raw else math.inf
+        for t, v in raw:
+            if lo <= t <= hi:
+                vmin = min(vmin, v)
+                vmax = max(vmax, v)
+                vsum += v
+                count += 1
+        if raw_lo > lo and tier0:
+            # the raw ring no longer reaches back to ``lo``: cover the
+            # forgotten prefix with finest-tier buckets fully inside it
+            w = self.tier_widths[0]
+            for start, bmin, bmax, bsum, bcount in tier0:
+                if start >= lo and start + w <= min(hi, raw_lo):
+                    vmin = min(vmin, bmin)
+                    vmax = max(vmax, bmax)
+                    vsum += bsum
+                    count += bcount
+        if count == 0:
+            return None
+        return {"min": vmin, "max": vmax, "sum": vsum, "count": count,
+                "mean": vsum / count}
+
+    def rate(self, name: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Counter-to-rate: summed deltas over the window divided by
+        its length (per second). None for unknown/gauge series."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            s = self._series.get(name)
+            if s is None or s.kind != "counter":
+                return None
+        stats = self.window_stats(name, now - float(window_s), now)
+        if stats is None:
+            return 0.0
+        return stats["sum"] / float(window_s)
+
+    def counter_totals(self) -> Dict[str, float]:
+        """name -> lifetime summed deltas (== the cumulative counter)
+        for every counter series — the fleet fold's conservation
+        surface."""
+        with self._lock:
+            return {n: s.total_sum for n, s in self._series.items()
+                    if s.kind == "counter"}
+
+    def series_payload(self, name: str) -> Optional[dict]:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return None
+            return {
+                "kind": s.kind,
+                "last": s.last_value,
+                "last_ts": s.last_ts,
+                "total_sum": s.total_sum,
+                "total_count": s.total_count,
+                "raw": [[t, v] for t, v in s.raw],
+                "tiers": {str(int(w)): [list(b) for b in dq]
+                          for w, dq in zip(self.tier_widths, s.tiers)},
+            }
+
+    def payload(self, series: Optional[Sequence[str]] = None) -> dict:
+        """The ``/history`` route document (``?series=`` filters)."""
+        names = self.names() if series is None else [str(n)
+                                                     for n in series]
+        rows = {}
+        for n in names:
+            row = self.series_payload(n)
+            if row is not None:
+                rows[n] = row
+        with self._lock:
+            dropped_series = len(self._refused) + self._refused_overflow
+            dropped_samples = self.dropped_samples
+            count = len(self._series)
+        return {
+            "schema": TIMESERIES_SCHEMA,
+            "host": self.host,
+            "now": self._clock(),
+            "max_series": self.max_series,
+            "raw_capacity": self.raw_capacity,
+            "tier_widths": list(self.tier_widths),
+            "tier_capacities": list(self.tier_capacities),
+            "series_count": count,
+            "dropped_series": dropped_series,
+            "dropped_samples": dropped_samples,
+            "series": rows,
+        }
+
+
+def validate_timeseries(doc: dict) -> List[str]:
+    """Schema errors of a ``/history`` payload (empty = valid) —
+    mirrored jax-free in tools/bench_gate.py (drift-pinned by test)."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["timeseries: top level is not an object"]
+    if doc.get("schema") != TIMESERIES_SCHEMA:
+        errs.append(f"timeseries: schema {doc.get('schema')!r} != "
+                    f"{TIMESERIES_SCHEMA!r}")
+    for k in ("max_series", "series_count", "dropped_series",
+              "dropped_samples", "series"):
+        if k not in doc:
+            errs.append(f"timeseries: missing {k!r}")
+    series = doc.get("series")
+    if not isinstance(series, dict):
+        errs.append("timeseries: series is not an object")
+        return errs
+    for name, row in series.items():
+        if not isinstance(row, dict):
+            errs.append(f"timeseries series[{name}]: not an object")
+            continue
+        if row.get("kind") not in ("gauge", "counter"):
+            errs.append(f"timeseries series[{name}]: kind "
+                        f"{row.get('kind')!r}")
+        if not isinstance(row.get("raw"), list):
+            errs.append(f"timeseries series[{name}]: raw not a list")
+        tiers = row.get("tiers")
+        if not isinstance(tiers, dict):
+            errs.append(f"timeseries series[{name}]: tiers not an "
+                        "object")
+            continue
+        for w, buckets in tiers.items():
+            for b in buckets if isinstance(buckets, list) else ():
+                if not (isinstance(b, list) and len(b) == 5):
+                    errs.append(f"timeseries series[{name}] tier {w}: "
+                                "bucket is not [start,min,max,sum,"
+                                "count]")
+                    break
+    return errs
+
+
+class SessionSampler:
+    """``pump()``-style sampler over one Session (module docstring).
+
+    Thread-free: the owner (Fleet.pump, a chaos driver, a scrape loop)
+    calls :meth:`pump` on its own thread; with ``interval_s`` the call
+    is throttled (``force=True`` bypasses). Under an injected clock the
+    whole pipeline is deterministic — no sleeps anywhere."""
+
+    def __init__(self, session, store: TimeseriesStore,
+                 interval_s: float = 1.0):
+        self.session = session
+        self.store = store
+        self.interval_s = float(interval_s)
+        self._last_pump: Optional[float] = None
+
+    def pump(self, now: Optional[float] = None,
+             force: bool = False) -> int:
+        """One sampling pass; returns the number of samples recorded
+        (0 when throttled)."""
+        store = self.store
+        now = store._clock() if now is None else now
+        if (not force and self._last_pump is not None
+                and now - self._last_pump < self.interval_s):
+            return 0
+        self._last_pump = now
+        sess = self.session
+        snap = sess.metrics.snapshot()
+        recorded = 0
+        # gauges at their STAMPED timestamps — when the value was last
+        # true, not when this pump scraped it (the round-23 satellite);
+        # covers hbm_headroom / resident_bytes / queue_depth /
+        # oldest_request_age_s / handle_heat:* / tenant_quota_* as set
+        gauge_ts = snap.get("gauge_ts", {})
+        for name, v in snap.get("gauges", {}).items():
+            store.record_gauge(name, v, t=gauge_ts.get(name, now))
+            recorded += 1
+        for name, v in snap.get("counters", {}).items():
+            store.record_counter(name, v, t=now)
+            recorded += 1
+        attr = sess.attribution
+        if attr is not None:
+            # decayed-to-now heat for EVERY tracked handle (the gauge
+            # only updates on access; a cooling handle's decay curve
+            # is exactly what the forecaster needs to see)
+            for hrep, (heat, _wall) in attr.heat_rows(now).items():
+                store.record_gauge(f"heat:{hrep}", heat, t=now)
+                recorded += 1
+        slo = sess.slo
+        if slo is not None:
+            for tenant, rate in slo.tenant_burn_rates(now).items():
+                store.record_gauge(f"burn_rate:{tenant}", rate, t=now)
+                recorded += 1
+        return recorded

@@ -102,6 +102,13 @@ def _series_key(row: dict) -> _SeriesKey:
     return tuple(row.get(k) for k in _KEY_FIELDS)
 
 
+def _store_series_name(key: _SeriesKey) -> str:
+    """Store series name of one watchdog key (round 23 history mode):
+    the key fields joined in artifact order — stable and unique, so
+    the /history view of watchdog traffic reads like the baseline."""
+    return "wd:" + "|".join("" if f is None else str(f) for f in key)
+
+
 class Watchdog:
     """Compares live per-window observations against the baseline.
 
@@ -115,7 +122,8 @@ class Watchdog:
                  tolerance: Optional[float] = None,
                  window_s: float = DEFAULT_WINDOW_S,
                  gated_platforms: Tuple[str, ...] = GATED_PLATFORMS,
-                 max_events: int = 4096, clock=time.monotonic):
+                 max_events: int = 4096, clock=time.monotonic,
+                 store=None):
         if baseline is None or isinstance(baseline, str):
             baseline = load_baseline(baseline)
         else:
@@ -137,6 +145,14 @@ class Watchdog:
         # SloTracker
         self._lock = threading.Lock()
         self._live: Dict[_SeriesKey, Deque[Tuple[float, float]]] = {}
+        # round 23: history-backed mode — with a TimeseriesStore
+        # attached, observations land in the store (one resident
+        # history, no duplicated deque state) and check() reads a TRUE
+        # over-window aggregate (the exact window mean from bucket
+        # sum/count) instead of the round-12 charitable window-best.
+        # store=None keeps the deque path byte-identical (pinned).
+        self.store = store
+        self._store_keys: Dict[str, _SeriesKey] = {}
         # series currently in the anomalous state: transition
         # detection (ok -> anomalous emits; staying anomalous does
         # not), so a scrape-driven check() loop counts REGRESSIONS in
@@ -175,6 +191,12 @@ class Watchdog:
         kind/metric/platform/n/batch/op/dtype)."""
         key = (kind, metric, platform, n, batch, op, dtype)
         t = self._clock() if t is None else t
+        if self.store is not None:
+            name = _store_series_name(key)
+            with self._lock:
+                self._store_keys[name] = key
+            self.store.record_gauge(name, float(value), t=t)
+            return
         with self._lock:
             q = self._live.get(key)
             if q is None:
@@ -215,10 +237,14 @@ class Watchdog:
 
     def check(self, now: Optional[float] = None) -> dict:
         """Compare every live series with history against its committed
-        best. The live number is the window's BEST achieved value (max
-        for higher-is-better, min for lower) — charitable on purpose:
-        a warmup transient inside an otherwise healthy window is not a
-        regression. A gated-platform drop beyond tolerance is an
+        best. With no store attached the live number is the window's
+        BEST achieved value (max for higher-is-better, min for lower)
+        — charitable on purpose: a warmup transient inside an
+        otherwise healthy window is not a regression. With a
+        TimeseriesStore attached (round 23) the live number is the
+        TRUE window mean (exact, from bucket sum/count — anomaly rows
+        carry ``aggregate: "window_mean"``): charity was also how a
+        window that spent 55 s regressed and 5 s healthy passed. A gated-platform drop beyond tolerance is an
         anomaly; other platforms report informationally (the
         bench_gate policy). The report lists every CURRENT anomaly,
         but the counter/log/trace-event emission fires only on the
@@ -231,20 +257,50 @@ class Watchdog:
         anomalies: List[dict] = []
         informational: List[dict] = []
         matched = unmatched = 0
-        with self._lock:
-            live_map = {key: list(q) for key, q in self._live.items()}
-        for key, q in live_map.items():
-            base = self._baseline.get(key)
-            if base is None:
-                unmatched += 1
-                continue
-            vals = [v for (t, v) in q if lo <= t <= now]
-            if not vals:
-                continue
-            matched += 1
+        # (key, live value, aggregate tag) per matched series — the
+        # two modes differ ONLY in how the live value is computed:
+        # history mode (round 23) reads the TRUE window mean from the
+        # store's bucket sum/count (a warmup transient no longer hides
+        # a regressed window — the satellite window-fix); the deque
+        # path below is the round-12 charitable window-best, unchanged
+        # byte-for-byte when no store is attached (pinned)
+        live_rows: List[tuple] = []
+        if self.store is not None:
+            with self._lock:
+                names = dict(self._store_keys)
+            live_series = len(names)
+            for name in sorted(names):
+                key = names[name]
+                base = self._baseline.get(key)
+                if base is None:
+                    unmatched += 1
+                    continue
+                stats = self.store.window_stats(name, lo, now)
+                if stats is None:
+                    continue
+                matched += 1
+                live_rows.append((key, stats["mean"], "window_mean"))
+        else:
+            with self._lock:
+                live_map = {key: list(q)
+                            for key, q in self._live.items()}
+            live_series = len(live_map)
+            for key, q in live_map.items():
+                base = self._baseline.get(key)
+                if base is None:
+                    unmatched += 1
+                    continue
+                vals = [v for (t, v) in q if lo <= t <= now]
+                if not vals:
+                    continue
+                matched += 1
+                direction = base.get("direction", "higher")
+                live = max(vals) if direction == "higher" else min(vals)
+                live_rows.append((key, live, None))
+        for key, live, aggregate in live_rows:
+            base = self._baseline[key]
             direction = base.get("direction", "higher")
             best = float(base["best"])
-            live = max(vals) if direction == "higher" else min(vals)
             if best == 0:
                 continue
             if direction == "higher":
@@ -262,6 +318,8 @@ class Watchdog:
                 "gated": platform in self.gated_platforms,
                 "window_s": self.window_s,
             })
+            if aggregate is not None:
+                row["aggregate"] = aggregate
             (anomalies if row["gated"] else informational).append(row)
         # transition detection over the gated set: emit (counter, log,
         # trace event) only for series that were ok at the last check;
@@ -277,7 +335,7 @@ class Watchdog:
             "now": now, "window_s": self.window_s,
             "tolerance": self.tolerance,
             "baseline_series": len(self._baseline),
-            "live_series": len(live_map),
+            "live_series": live_series,
             "matched": matched, "unmatched": unmatched,
             "anomalies": anomalies, "informational": informational,
             "ok": not anomalies,

@@ -127,6 +127,7 @@ class Executor:
                                shed_policy=shed_policy)
         self._cv = threading.Condition()
         self._stop = False
+        self._kick = False  # work arrived since the worker last looked
         self._inflight = 0  # batches detached from the Batcher, unsolved
         self._thread = threading.Thread(target=self._run,
                                         name="slate-tpu-serve", daemon=True)
@@ -149,6 +150,7 @@ class Executor:
                 raise RuntimeError("Executor is shut down")
             req, rejection = self.batcher.submit_deferred(
                 handle, b, timeout_s=timeout_s, tenant=tenant)
+            self._kick = True
             self._cv.notify_all()
         if rejection is not None:
             # resolve OUTSIDE the lock: a done-callback that re-enters
@@ -172,6 +174,7 @@ class Executor:
         transition notifies on submit, so the deadline wait is only
         the backstop for the bucket/request deadlines themselves."""
         with self._cv:
+            self._kick = True
             self._cv.notify_all()
             while self.batcher.pending() or self._inflight:
                 deadline = self.batcher.next_deadline()
@@ -201,7 +204,13 @@ class Executor:
     def _run(self):
         while True:
             with self._cv:
-                if not self._stop:
+                # a notify that fires while this thread is OUTSIDE
+                # wait() (mid-dispatch) is consumed by nobody — the
+                # _kick flag carries it across the gap, else a bucket
+                # filled during a dispatch sleeps out its max_wait
+                # deadline (with a large max_wait that is a flush()
+                # deadlock, not a latency blip)
+                if not self._stop and not self._kick:
                     deadline = self.batcher.next_deadline()
                     if deadline is None:
                         self._cv.wait()
@@ -209,6 +218,7 @@ class Executor:
                         timeout = deadline - time.monotonic()
                         if timeout > 0:
                             self._cv.wait(timeout)
+                self._kick = False
                 stopping = self._stop
                 # detach + count in-flight under the SAME lock hold, so
                 # flush() never observes pending()==0 while a batch sits

@@ -772,6 +772,11 @@ class Fleet:
         for mem in self._members.values():
             if not mem.alive:
                 continue
+            # round 23: one history-sampling pass per pump (a member
+            # without enable_timeseries pays one is-None check) — the
+            # thread-free sampler rides the same caller-thread step
+            # the Batcher does, so chaos drives it deterministically
+            mem.session.pump_timeseries()
             mem.batcher.maybe_shed()
             for key, reqs in mem.batcher.pop_ready(force=force):
                 try:
@@ -1011,6 +1016,24 @@ class Fleet:
                 docs.append(placement_from_checkpoint(manifest,
                                                       host=mem.name))
         return merge_placement_snapshots(docs)
+
+    def timeseries_payload(self) -> dict:
+        """Fleet history fold (round 23): every member's time-series
+        store host-labeled into one
+        ``slate_tpu.timeseries.fleet.v1`` document with EXACT
+        conservation on the summed counter series (the round-12 fold
+        discipline). Members without a store — or dead — contribute
+        ``None`` and are counted ``partial_processes`` (the round-17
+        partial-host tolerance)."""
+        from ..obs.aggregate import merge_timeseries_payloads
+        names = list(self._members)
+        payloads = []
+        for name in names:
+            mem = self._members[name]
+            ts = mem.session.timeseries
+            payloads.append(ts.payload()
+                            if mem.alive and ts is not None else None)
+        return merge_timeseries_payloads(payloads, hosts=names)
 
     def snapshot(self) -> dict:
         """JSON view of the coordinator: members, placement, ring

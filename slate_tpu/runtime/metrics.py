@@ -153,11 +153,16 @@ class Metrics:
       deficit — bounded by one quantum)
     """
 
-    def __init__(self):
+    def __init__(self, clock=time.time):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = collections.defaultdict(float)
         self._hists: Dict[str, Histogram] = {}
         self._gauges: Dict[str, float] = {}
+        # round 23: every gauge write is stamped at set time (the
+        # injectable clock) — the history sampler records WHEN a value
+        # was last true, not when it happened to be scraped
+        self._gauge_ts: Dict[str, float] = {}
+        self._clock = clock
         self._t0 = time.perf_counter()
 
     def inc(self, name: str, value: float = 1.0):
@@ -168,19 +173,28 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
-    def set_gauge(self, name: str, value: float):
+    def set_gauge(self, name: str, value: float,
+                  t: Optional[float] = None):
         """Point-in-time gauge (resident_bytes, hbm_headroom, ...):
-        last write wins, rendered as a Prometheus gauge."""
+        last write wins, rendered as a Prometheus gauge; the sample is
+        timestamped (``t`` overrides the clock — tests and replayed
+        snapshots)."""
+        now = self._clock() if t is None else t
         with self._lock:
             self._gauges[name] = float(value)
+            self._gauge_ts[name] = now
 
-    def set_gauges(self, values: Dict[str, float]):
+    def set_gauges(self, values: Dict[str, float],
+                   t: Optional[float] = None):
         """Batch gauge write: one lock acquisition for N gauges — the
         Batcher's per-enqueue backpressure update uses this so the
-        request hot path pays one metrics-lock hold, not four."""
+        request hot path pays one metrics-lock hold, not four. All N
+        share one timestamp (they were true together)."""
+        now = self._clock() if t is None else t
         with self._lock:
             for name, value in values.items():
                 self._gauges[name] = float(value)
+                self._gauge_ts[name] = now
 
     def get_gauge(self, name: str, default: float = 0.0) -> float:
         with self._lock:
@@ -193,6 +207,7 @@ class Metrics:
         /metrics cardinality without bound."""
         with self._lock:
             self._gauges.pop(name, None)
+            self._gauge_ts.pop(name, None)
 
     def observe(self, name: str, value: float, exemplar=None):
         """``exemplar`` (a trace id) tags the observation so the worst
@@ -257,6 +272,7 @@ class Metrics:
             counters = dict(self._counters)
             hists = {k: h.snapshot() for k, h in self._hists.items()}
             gauges = dict(self._gauges)
+            gauge_ts = dict(self._gauge_ts)
             uptime = time.perf_counter() - self._t0
         # derived serving headline numbers (computed outside the lock
         # from the consistent copies above)
@@ -266,6 +282,7 @@ class Metrics:
             "counters": counters,
             "histograms": hists,
             "gauges": gauges,
+            "gauge_ts": gauge_ts,
             "derived": self._derive(
                 counters.get("cache_hits", 0.0),
                 counters.get("cache_misses", 0.0),

@@ -335,6 +335,17 @@ class Session:
         # pinned), and anomaly/breach/breaker/fault transitions
         # capture rate-limited incident snapshots.
         self.recorder = None
+        # telemetry history (round 23, obs/timeseries.py): None =
+        # disabled — the pump seam guards with ONE `timeseries is
+        # None` check and allocates nothing (the round-8 discipline,
+        # pinned by test). enable_timeseries() attaches the bounded
+        # time-series store, the pump()-style sampler (thread-free:
+        # Fleet.pump / a chaos driver / a scrape loop calls
+        # pump_timeseries on its own thread), and the forecaster
+        # behind the /history and /forecast routes.
+        self.timeseries = None
+        self.forecaster = None
+        self._ts_sampler = None
         self.opts = opts
         # mixed-precision policy table (round 13): register(...,
         # refine=True) resolves its RefinePolicy here per
@@ -528,6 +539,41 @@ class Session:
                     self.slo.recorder = rec
                 self.recorder = rec
             return self.recorder
+
+    def enable_timeseries(self, interval_s: float = 1.0,
+                          clock=time.time, host: Optional[str] = None,
+                          **kw):
+        """Attach the telemetry-history layer (round 23): a bounded
+        :class:`~..obs.timeseries.TimeseriesStore`, a ``pump()``-style
+        :class:`~..obs.timeseries.SessionSampler` throttled to
+        ``interval_s`` (drive it with :meth:`pump_timeseries`), and a
+        :class:`~..obs.forecast.Forecaster` over the store; idempotent
+        — a second call returns the running store. ``clock`` is
+        injectable (chaos drills and tests run on a scripted clock —
+        no sleeps). ``kw`` forwards ring capacities / tier widths /
+        ``max_series``. The ``/history`` and ``/forecast`` routes of
+        :meth:`serve_obs` serve the payloads."""
+        from ..obs.forecast import Forecaster
+        from ..obs.timeseries import SessionSampler, TimeseriesStore
+        with self._lock:
+            if self.timeseries is None:
+                store = TimeseriesStore(host=host, clock=clock, **kw)
+                self._ts_sampler = SessionSampler(
+                    self, store, interval_s=interval_s)
+                self.forecaster = Forecaster(store)
+                self.timeseries = store
+            return self.timeseries
+
+    def pump_timeseries(self, now: Optional[float] = None,
+                        force: bool = False) -> int:
+        """One history-sampling pass (round 23): snapshot gauges (at
+        their stamped timestamps), counter deltas, per-handle heat,
+        and per-tenant burn rates into the store. Thread-free and
+        throttled; returns samples recorded (0 when throttled or
+        disabled). Disabled (the default) costs ONE is-None check."""
+        if self.timeseries is None:
+            return 0
+        return self._ts_sampler.pump(now=now, force=force)
 
     def _tuning_provenance(self) -> dict:
         """Incident-capture section: which handles serve under which
@@ -4192,7 +4238,9 @@ class Session:
                     attribution=lambda: self.attribution,
                     numerics=lambda: self.numerics_payload(),
                     quotas=lambda: self.quotas_payload(),
-                    recorder=lambda: self.recorder)
+                    recorder=lambda: self.recorder,
+                    history=lambda: self.timeseries,
+                    forecast=lambda: self.forecaster)
             return self._obs_server
 
     def close_obs(self):

@@ -11,6 +11,7 @@ import importlib.util
 import json
 import os
 import pathlib
+import sys
 
 import pytest
 
@@ -82,7 +83,8 @@ def test_normalize_all_three_schemas(tmp_path):
         "spectral": _spectral_section(),
         "updates": _updates_section(),
         "tuning": _tuning_section(),
-        "incidents": _incidents_section()}
+        "incidents": _incidents_section(),
+        "forecast": _forecast_section()}
     assert set(gate_mod.SERVE_ARTIFACT_SECTIONS) <= set(serve_art)
     _write(tmp_path, "BENCH_SERVE_smoke.json", serve_art)
     rec = gate_mod.normalize(str(tmp_path / "BENCH_SERVE_smoke.json"))
@@ -253,6 +255,37 @@ def _incidents_section():
     }
 
 
+def _forecast_section():
+    """A minimal round-23 serve-artifact forecast section that passes
+    gate_mod._check_forecast_section.  The history/forecast payloads
+    are built by the REAL store + forecaster — the same stdlib-only
+    modules bench_gate file-loads for its validators (sys.modules
+    carries them under their fixed names once gate_mod is loaded), so
+    the fixture can never drift from the schema it is held to."""
+    tmod = sys.modules["slate_tpu_obs_timeseries"]
+    fmod = sys.modules["slate_tpu_obs_forecast"]
+    t = {"now": 0.0}
+    store = tmod.TimeseriesStore(clock=lambda: t["now"])
+    for i in range(12):
+        t["now"] = float(i)
+        store.record_gauge("queue_depth", float(i % 3))
+        store.record_counter("solves_total", float(i + 1))
+    hist = store.payload()
+    fc = fmod.Forecaster(store).payload(horizon_s=10.0)
+    cons = {name: {"store": total, "counter": total, "ok": True}
+            for name, total in store.counter_totals().items()}
+    return {
+        "enabled": True,
+        "series_count": len(hist["series"]),
+        "dropped_series": 0,
+        "dropped_samples": 0,
+        "conservation": cons,
+        "history": hist,
+        "forecast": fc,
+        "ok": True,
+    }
+
+
 def _tenants_section(conservation_ok=True, rows=None):
     """A minimal round-15 serve-artifact tenants section that passes
     gate_mod._check_tenants_section."""
@@ -291,7 +324,8 @@ def test_serve_tenants_section_schema(tmp_path):
         "spectral": _spectral_section(),
         "updates": _updates_section(),
         "tuning": _tuning_section(),
-        "incidents": _incidents_section()}
+        "incidents": _incidents_section(),
+        "forecast": _forecast_section()}
     # a placement row lacking "heat" fails
     bad_row = _tenants_section()
     del bad_row["placement"]["rows"][0]["heat"]

@@ -1,0 +1,255 @@
+"""Load/heat forecasting over the history store (round 23):
+periodicity detection (detrended ACF), the method ladder
+(last -> trend -> seasonal_naive -> holt_winters), confidence bands,
+holdout accuracy vs last-value persistence, the predicted-hot ranking,
+exhaustion runways, the /forecast payload, and bit-for-bit
+determinism — the contract the chaos drill digests.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from slate_tpu.obs.forecast import (FORECAST_SCHEMA, Forecaster,
+                                    detect_period, forecast_points,
+                                    validate_forecast)
+from slate_tpu.obs.timeseries import TimeseriesStore
+
+RNG = np.random.default_rng(123)
+
+
+def _diurnal(cycles=5, period=24, amp=3.0, base=5.0, noise=0.15,
+             dt=10.0, rng=None):
+    """(ts, value) samples of a noisy periodic load curve."""
+    rng = RNG if rng is None else rng
+    pts = []
+    for i in range(cycles * period):
+        t = i * dt
+        v = (base + amp * math.sin(2 * math.pi * i / period)
+             + float(rng.normal(0.0, noise)))
+        pts.append((t, v))
+    return pts
+
+
+# -- periodicity --------------------------------------------------------------
+
+
+def test_detect_period_finds_the_diurnal_cycle():
+    vals = [v for _, v in _diurnal(cycles=5, period=24)]
+    assert detect_period(vals) == 24
+
+
+def test_detect_period_silent_on_noise():
+    vals = [float(RNG.normal(0, 1)) for _ in range(120)]
+    assert detect_period(vals) is None
+
+
+def test_detect_period_not_fooled_by_a_ramp():
+    """A monotone ramp autocorrelates strongly at every lag — the
+    detrend step must keep it from reading as seasonality."""
+    vals = [0.5 * i for i in range(120)]
+    assert detect_period(vals) is None
+    drifting = [0.5 * i + float(RNG.normal(0, 0.2))
+                for i in range(120)]
+    assert detect_period(drifting) is None
+
+
+def test_detect_period_needs_two_cycles():
+    one_cycle = [math.sin(2 * math.pi * i / 40) for i in range(50)]
+    assert detect_period(one_cycle) is None
+
+
+# -- the method ladder ---------------------------------------------------------
+
+
+def test_ladder_last_under_min_points():
+    fc = forecast_points([(0.0, 2.0), (1.0, 4.0)], horizon_s=5.0)
+    assert fc["method"] == "last"
+    assert all(p[1] == 4.0 for p in fc["points"])
+    assert fc["slope_per_s"] == 0.0
+
+
+def test_ladder_trend_on_aperiodic_drift():
+    pts = [(float(i), 1.0 + 0.5 * i) for i in range(20)]
+    fc = forecast_points(pts, horizon_s=5.0)
+    assert fc["method"] == "trend"
+    assert fc["period_s"] is None
+    assert fc["slope_per_s"] == pytest.approx(0.5)
+    # the line extrapolates: five steps of dt=1 past the last sample
+    assert [round(p[1], 6) for p in fc["points"]] == [
+        pytest.approx(1.0 + 0.5 * (19 + h)) for h in range(1, 6)]
+    assert fc["sigma"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_ladder_seasonal_naive_under_three_cycles():
+    pts = _diurnal(cycles=2, period=16, noise=0.0)
+    fc = forecast_points(pts, horizon_s=160.0)
+    assert fc["method"] == "seasonal_naive"
+    assert fc["period_s"] == pytest.approx(16 * 10.0)
+
+
+def test_ladder_holt_winters_with_three_cycles():
+    pts = _diurnal(cycles=4, period=16, noise=0.0)
+    fc = forecast_points(pts, horizon_s=160.0)
+    assert fc["method"] == "holt_winters"
+    assert fc["period_s"] == pytest.approx(16 * 10.0)
+    # noise-free periodic signal: tight residuals, tight band
+    assert fc["sigma"] < 0.5
+
+
+def test_empty_series():
+    fc = forecast_points([], horizon_s=10.0)
+    assert fc["method"] == "empty" and fc["points"] == []
+    assert validate_forecast  # (the payload path covers empties)
+
+
+def test_confidence_band_brackets_the_prediction():
+    pts = _diurnal(cycles=4, period=16, noise=0.3)
+    fc = forecast_points(pts, horizon_s=160.0)
+    assert fc["sigma"] > 0
+    for t, yhat, lo, hi in fc["points"]:
+        assert lo <= yhat <= hi
+        assert hi - yhat == pytest.approx(1.96 * fc["sigma"])
+
+
+def test_horizon_bounds_the_forecast_grid():
+    """points never extend past horizon_s beyond the last sample (the
+    chaos drill's lead-time invariant leans on this)."""
+    pts = _diurnal(cycles=4, period=16, noise=0.0)
+    fc = forecast_points(pts, horizon_s=80.0)
+    last_ts = pts[-1][0]
+    assert fc["points"][0][0] > last_ts
+    assert fc["points"][-1][0] <= last_ts + 80.0 + fc["dt"]
+
+
+def test_resample_carries_gaps_forward():
+    """A missed pump must not shift every later sample's phase: the
+    gap is filled with the previous value at the median-dt grid."""
+    pts = [(float(i), float(i)) for i in range(10)]
+    del pts[5]  # one missed pump
+    fc = forecast_points(pts, horizon_s=3.0)
+    assert fc["dt"] == 1.0
+    assert fc["last_ts"] == 9.0
+
+
+# -- holdout accuracy ----------------------------------------------------------
+
+
+def test_seasonal_forecast_beats_persistence_on_holdout():
+    """The accuracy claim bench_serve --forecast gates: on a held-out
+    cycle of a periodic load curve, the seasonal forecast's MAE beats
+    last-value persistence."""
+    rng = np.random.default_rng(7)
+    period, dt, cycles = 24, 10.0, 5
+    pts = _diurnal(cycles=cycles, period=period, dt=dt, rng=rng)
+    train = pts[:-period]
+    test = pts[-period:]
+    fc = forecast_points(train, horizon_s=period * dt)
+    assert fc["method"] in ("holt_winters", "seasonal_naive")
+    pred = {round(p[0], 6): p[1] for p in fc["points"]}
+    matched = [(v, pred[round(t, 6)]) for t, v in test
+               if round(t, 6) in pred]
+    assert len(matched) == period
+    mae = sum(abs(v - p) for v, p in matched) / len(matched)
+    naive = train[-1][1]
+    naive_mae = sum(abs(v - naive) for v, _ in matched) / len(matched)
+    assert mae < naive_mae / 2  # at least 2x better than persistence
+
+
+# -- forecaster queries --------------------------------------------------------
+
+
+def _store_with(series):
+    t = {"now": 0.0}
+    store = TimeseriesStore(clock=lambda: t["now"])
+    for name, pts in series.items():
+        for ts, v in pts:
+            store.record_gauge(name, v, t=ts)
+            t["now"] = max(t["now"], ts)
+    return store, t
+
+
+def test_predicted_hot_ranks_by_predicted_peak():
+    hot = [(float(10 * i), 5.0 + 3.0 * math.sin(2 * math.pi * i / 16))
+           for i in range(64)]
+    cold = [(float(10 * i), 0.5) for i in range(64)]
+    store, _ = _store_with({"heat:'a'": hot, "heat:'b'": cold,
+                            "handle_heat:default:'a'": hot,
+                            "queue_depth": hot})  # not a heat series
+    f = Forecaster(store)
+    rows = f.predicted_hot(k=4, horizon_s=160.0)
+    assert [r["series"] for r in rows[:2]] == [
+        "handle_heat:default:'a'", "heat:'a'"]  # tie -> name order
+    assert rows[0]["handle"] == "default:'a'"
+    assert rows[1]["handle"] == "'a'"
+    assert all(r["series"] != "queue_depth" for r in rows)
+    assert rows[0]["predicted_peak"] > rows[-1]["predicted_peak"]
+    assert rows[0]["method"] == "holt_winters"
+    # peak_ts lands at the seasonal crest, within the horizon
+    assert 630.0 < rows[0]["peak_ts"] <= 630.0 + 160.0 + 10.0
+
+
+def test_time_to_exhaustion_projects_the_zero_crossing():
+    draining = [(float(i), 100.0 - 2.0 * i) for i in range(20)]
+    flat = [(float(i), 50.0) for i in range(20)]
+    rising = [(float(i), 50.0 + i) for i in range(20)]
+    gone = [(float(i), -1.0) for i in range(20)]
+    store, _ = _store_with({"hbm_headroom": draining, "flat": flat,
+                            "up": rising, "gone": gone})
+    f = Forecaster(store)
+    # last=62 at t=19, slope -2/s -> 31 s of runway
+    assert f.time_to_exhaustion("hbm_headroom") == pytest.approx(
+        31.0, rel=0.05)
+    assert f.time_to_exhaustion("flat") is None
+    assert f.time_to_exhaustion("up") is None
+    assert f.time_to_exhaustion("gone") == 0.0
+    assert f.time_to_exhaustion("missing") is None
+
+
+def test_payload_validates_and_is_bounded():
+    hot = [(float(10 * i), 5.0 + 3.0 * math.sin(2 * math.pi * i / 16))
+           for i in range(64)]
+    store, t = _store_with({"heat:'a'": hot,
+                            "hbm_headroom": [(float(i), 100.0 - i)
+                                             for i in range(20)]})
+    store.record_counter("solves_total", 9.0)  # counters not forecast
+    f = Forecaster(store)
+    doc = f.payload(horizon_s=60.0, k=2, max_series=8, points_limit=3)
+    assert doc["schema"] == FORECAST_SCHEMA
+    assert validate_forecast(doc) == []
+    assert "solves_total" not in doc["series"]
+    assert all(len(row["points"]) <= 3 for row in doc["series"].values())
+    assert doc["predicted_hot"][0]["series"] == "heat:'a'"
+    assert doc["exhaustion"]["hbm_headroom"] == pytest.approx(
+        81.0, rel=0.05)
+    json.dumps(doc)
+
+
+def test_validator_rejects_malformed_docs():
+    assert validate_forecast([]) != []
+    assert validate_forecast({"schema": "wrong"}) != []
+    store, _ = _store_with({"g": [(0.0, 1.0), (1.0, 2.0)]})
+    doc = Forecaster(store).payload(horizon_s=5.0)
+    assert validate_forecast(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["series"]["g"]["method"] = "oracle"
+    assert any("method" in e for e in validate_forecast(bad))
+    bad2 = json.loads(json.dumps(doc))
+    bad2["predicted_hot"] = [{"series": "g"}]  # missing predicted_peak
+    assert validate_forecast(bad2) != []
+
+
+# -- determinism ---------------------------------------------------------------
+
+
+def test_forecast_is_bit_deterministic():
+    """Same ring contents -> same forecast, bit for bit (no RNG, no
+    wall clock): the digest contract the chaos drill pins end to end."""
+    pts = _diurnal(cycles=4, period=16, noise=0.3,
+                   rng=np.random.default_rng(11))
+    a = forecast_points(pts, horizon_s=160.0)
+    b = forecast_points(list(pts), horizon_s=160.0)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                       sort_keys=True)

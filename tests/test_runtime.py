@@ -295,6 +295,25 @@ def test_executor_flush_waits_for_inflight():
         assert all(f.result(timeout=0).shape == (N,) for f in futs)
 
 
+def test_executor_no_lost_wakeup_with_large_max_wait():
+    """Round-23 regression pin: a submit/flush notify that lands while
+    the worker is mid-dispatch (after popping, before re-waiting) must
+    not be lost — the worker re-checks the `_kick` flag before
+    sleeping. Without it, this loop stalls out a full max_wait (here
+    3600 s) the first time the race hits; the chaos forecast drill hit
+    it within ~100 iterations. The production default max_wait=2e-3
+    masked the bug as a ≤2 ms blip."""
+    sess = Session()
+    h, spd = _chol_handle(sess)
+    sess.warmup(h)
+    with Executor(sess, max_batch=1, max_wait=3600.0) as ex:
+        for i in range(150):
+            b = RNG.standard_normal(N)
+            f = ex.submit(h, b)
+            ex.flush()
+            assert f.done(), f"submit {i} slept into max_wait"
+
+
 def test_executor_warmup_aot():
     sess = Session()
     h, spd = _chol_handle(sess)

@@ -1,0 +1,472 @@
+"""Telemetry time-series store (round 23): bounded rings, downsample
+tiers, counter-delta conservation, cardinality caps, the pump()-style
+session sampler, the /history route, the 2-process fleet fold, and the
+zero-allocation disabled path.
+
+The conservation invariant under test everywhere: a counter series
+stores DELTAS, and its lifetime sum equals the live cumulative counter
+exactly — bit-exact, not approximately — which is what makes the fleet
+fold's summed totals meaningful.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import tracemalloc
+import urllib.request
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import obs
+from slate_tpu.obs.aggregate import merge_timeseries_payloads
+from slate_tpu.obs.timeseries import (TIMESERIES_SCHEMA, TIER_WIDTHS,
+                                      SessionSampler, TimeseriesStore,
+                                      validate_timeseries)
+from slate_tpu.runtime import Batcher, Metrics, Session
+
+RNG = np.random.default_rng(23)
+N, NB = 32, 16
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "_bench_gate", os.path.join(_ROOT, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _capacity_report():
+    spec = importlib.util.spec_from_file_location(
+        "_capacity_report",
+        os.path.join(_ROOT, "tools", "capacity_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _clocked(start=0.0, **kw):
+    t = {"now": float(start)}
+    store = TimeseriesStore(clock=lambda: t["now"], **kw)
+    return store, t
+
+
+# -- the store ---------------------------------------------------------------
+
+
+def test_gauge_samples_land_in_raw_and_tiers():
+    store, t = _clocked()
+    for i in range(5):
+        t["now"] = float(i)
+        store.record_gauge("queue_depth", 2.0 * i)
+    assert store.names() == ["queue_depth"]
+    assert store.kind("queue_depth") == "gauge"
+    assert store.points("queue_depth") == [(float(i), 2.0 * i)
+                                           for i in range(5)]
+    # all 5 samples fall in one 10 s bucket: min/max/sum/count folded
+    b10 = store.buckets("queue_depth", tier=0)
+    assert b10 == [[0.0, 0.0, 8.0, 20.0, 5]]
+    assert store.buckets("queue_depth", tier=1) == [[0.0, 0.0, 8.0,
+                                                     20.0, 5]]
+
+
+def test_counter_stored_as_deltas_with_exact_conservation():
+    store, t = _clocked()
+    cumulative = 0.0
+    for i, inc in enumerate([3, 0, 7, 1, 12]):
+        t["now"] = float(i)
+        cumulative += inc
+        store.record_counter("solves_total", cumulative)
+    assert store.kind("solves_total") == "counter"
+    # stored samples are the per-observation deltas...
+    assert [v for _, v in store.points("solves_total")] == [3.0, 0.0,
+                                                            7.0, 1.0,
+                                                            12.0]
+    # ...and the lifetime sum IS the cumulative counter, exactly
+    assert store.counter_totals() == {"solves_total": cumulative}
+
+
+def test_counter_reset_reads_as_restart():
+    """A decrease is a process restart: the new cumulative IS the
+    delta (the Prometheus rate() rule) — conservation then tracks the
+    sum across both incarnations."""
+    store, t = _clocked()
+    store.record_counter("solves_total", 100.0)
+    t["now"] = 1.0
+    store.record_counter("solves_total", 40.0)   # restarted process
+    assert [v for _, v in store.points("solves_total")] == [100.0, 40.0]
+    assert store.counter_totals()["solves_total"] == 140.0
+
+
+def test_tiers_conserve_counter_deltas_after_raw_ring_wraps():
+    """The compaction claim: integer deltas pushed far past the raw
+    ring's capacity are still fully accounted in the tier buckets (and
+    in total_sum) — the raw ring forgets, the tiers do not."""
+    store, t = _clocked(raw_capacity=16, tier_capacities=(1000, 1000))
+    total = 0
+    for i in range(400):
+        t["now"] = float(i)          # 400 s of 1 Hz traffic
+        total += (i % 5)
+        store.record_counter("requests_total", float(total))
+    assert len(store.points("requests_total")) == 16  # wrapped
+    for tier in (0, 1):
+        bucket_sum = sum(b[3] for b in store.buckets("requests_total",
+                                                     tier=tier))
+        bucket_count = sum(b[4] for b in store.buckets("requests_total",
+                                                       tier=tier))
+        assert bucket_sum == float(total)
+        assert bucket_count == 400
+    assert store.counter_totals()["requests_total"] == float(total)
+
+
+def test_tier_bucket_rings_are_bounded():
+    store, t = _clocked(raw_capacity=8, tier_capacities=(4, 2))
+    for i in range(1000):
+        t["now"] = float(10 * i)     # one sample per 10 s bucket
+        store.record_gauge("g", 1.0)
+    assert len(store.buckets("g", tier=0)) == 4
+    assert len(store.buckets("g", tier=1)) == 2
+
+
+def test_series_cardinality_cap_counts_drops():
+    store, t = _clocked(max_series=4)
+    for i in range(4):
+        store.record_gauge(f"keep{i}", 1.0)
+    assert store.dropped_series == 0
+    # churned handle names beyond the cap: dropped and counted, never
+    # stored — repeats of one refused name count samples, not series
+    for _ in range(3):
+        store.record_gauge("churn0", 1.0)
+    store.record_counter("churn1", 5.0)
+    assert len(store.names()) == 4
+    assert store.dropped_series == 2
+    assert store.dropped_samples == 4
+    assert "churn0" not in store.names()
+    # existing series keep recording under the cap
+    store.record_gauge("keep0", 2.0)
+    assert len(store.points("keep0")) == 2
+
+
+def test_refused_name_set_is_itself_bounded():
+    """The drop accounting must not become the unbounded thing it
+    counts: distinct refused names are tracked up to 4x max_series,
+    then a single overflow marker stands in for the rest."""
+    store, t = _clocked(max_series=2)
+    store.record_gauge("a", 1.0)
+    store.record_gauge("b", 1.0)
+    for i in range(100):
+        store.record_gauge(f"churn{i}", 1.0)
+    assert store.dropped_samples == 100
+    assert store.dropped_series == 4 * 2 + 1  # capped set + overflow
+    assert len(store._refused) == 8
+
+
+def test_window_stats_spans_raw_and_tier_history():
+    """Once the raw ring has forgotten the window's prefix, the finest
+    tier's buckets cover it: the over-window aggregate stays TRUE (sum
+    and count exact) instead of silently shrinking to the ring."""
+    store, t = _clocked(raw_capacity=4, tier_capacities=(100, 100))
+    for i in range(20):
+        t["now"] = float(10 * i)
+        store.record_gauge("lat", float(i))
+    # raw ring holds only the last 4 samples (t >= 160)
+    assert store.points("lat")[0][0] == 160.0
+    stats = store.window_stats("lat", lo=0.0, hi=190.0)
+    assert stats["count"] == 20
+    assert stats["sum"] == sum(range(20))
+    assert stats["min"] == 0.0 and stats["max"] == 19.0
+    assert stats["mean"] == pytest.approx(sum(range(20)) / 20)
+
+
+def test_counter_rate_over_window():
+    store, t = _clocked()
+    cum = 0.0
+    for i in range(10):
+        t["now"] = float(i)
+        cum += 5.0
+        store.record_counter("solves_total", cum)
+    # the window (4.5, 9.5] holds the 5 deltas at t=5..9 -> 5 solves/s
+    assert store.rate("solves_total", window_s=5.0,
+                      now=9.5) == pytest.approx(5.0)
+    assert store.rate("nope", 5.0) is None
+    store.record_gauge("g", 1.0)
+    assert store.rate("g", 5.0) is None   # gauges have no rate
+
+
+def test_payload_validates_and_filters():
+    store, t = _clocked()
+    store.record_gauge("g", 1.0)
+    store.record_counter("c", 2.0)
+    doc = store.payload()
+    assert doc["schema"] == TIMESERIES_SCHEMA
+    assert validate_timeseries(doc) == []
+    assert set(doc["series"]) == {"g", "c"}
+    assert doc["series"]["c"]["kind"] == "counter"
+    assert doc["series"]["c"]["total_sum"] == 2.0
+    assert list(doc["tier_widths"]) == list(TIER_WIDTHS)
+    only_g = store.payload(series=["g", "missing"])
+    assert set(only_g["series"]) == {"g"}
+    json.dumps(doc)  # JSON-able as-is
+
+
+def test_validator_rejects_malformed_docs():
+    good = TimeseriesStore().payload()
+    assert validate_timeseries(good) == []
+    assert validate_timeseries([]) != []
+    assert validate_timeseries({"schema": "wrong"}) != []
+    bad_kind = TimeseriesStore()
+    bad_kind.record_gauge("g", 1.0)
+    doc = bad_kind.payload()
+    doc["series"]["g"]["kind"] = "sideways"
+    assert any("kind" in e for e in validate_timeseries(doc))
+    doc2 = bad_kind.payload()
+    doc2["series"]["g"]["tiers"]["10"] = [[0.0, 1.0, 1.0]]  # not len-5
+    assert validate_timeseries(doc2) != []
+
+
+# -- the session sampler -----------------------------------------------------
+
+
+def _lu_session(**kw):
+    sess = Session(**kw)
+    a = RNG.standard_normal((N, N)) + N * np.eye(N)
+    h = sess.register(st.from_dense(a, nb=NB), op="lu")
+    return sess, h, a
+
+
+def test_sampler_pump_throttles_and_forces():
+    t = {"now": 0.0}
+    clock = lambda: t["now"]  # noqa: E731
+    sess = Session(metrics=Metrics(clock=clock))
+    store = sess.enable_timeseries(interval_s=10.0, clock=clock)
+    assert sess.enable_timeseries() is store  # idempotent
+    sess.metrics.inc("solves_total", 3)
+    assert sess.pump_timeseries() > 0
+    t["now"] = 5.0
+    assert sess.pump_timeseries() == 0          # throttled
+    assert sess.pump_timeseries(force=True) > 0
+    t["now"] = 15.0
+    assert sess.pump_timeseries() > 0           # interval elapsed
+
+
+def test_gauges_sampled_at_their_stamped_timestamps():
+    """The round-23 satellite: a gauge sample carries the time the
+    value was LAST TRUE (its set-time stamp), not the scrape time — a
+    late pump must not shift history."""
+    t = {"now": 7.0}
+    clock = lambda: t["now"]  # noqa: E731
+    sess = Session(metrics=Metrics(clock=clock))
+    store = sess.enable_timeseries(interval_s=0.0, clock=clock)
+    sess.metrics.set_gauge("queue_depth", 4.0)      # stamped at t=7
+    t["now"] = 100.0
+    sess.pump_timeseries(force=True)
+    assert store.points("queue_depth") == [(7.0, 4.0)]
+    # counters carry the pump time (deltas are interval quantities)
+    sess.metrics.inc("solves_total", 2)
+    t["now"] = 101.0
+    sess.pump_timeseries(force=True)
+    assert store.points("solves_total")[-1][0] == 101.0
+
+
+def test_sampler_covers_heat_and_conserves_counters():
+    sess, h, a = _lu_session()
+    sess.enable_attribution()
+    store = sess.enable_timeseries(interval_s=0.0)
+    for _ in range(3):
+        sess.solve(h, RNG.standard_normal(N))
+        sess.pump_timeseries(force=True)
+    heat_series = [nm for nm in store.names() if nm.startswith("heat:")]
+    assert heat_series, store.names()
+    assert all(v >= 0 for _, v in store.points(heat_series[0]))
+    # EXACT conservation across every sampled counter
+    counters = sess.metrics.snapshot()["counters"]
+    totals = store.counter_totals()
+    assert totals
+    for nm, total in totals.items():
+        assert total == counters.get(nm, 0.0), nm
+
+
+def test_disabled_path_allocates_nothing():
+    """Round-8 discipline: with timeseries never enabled, a served
+    workload allocates ZERO bytes from obs/timeseries.py and
+    pump_timeseries() is a single is-None check returning 0. The
+    enabled control proves the instrument measures what we claim."""
+    filters = [tracemalloc.Filter(
+        True, os.path.join("*", "slate_tpu", "obs", "timeseries.py"))]
+
+    def _serve(sess, h):
+        batcher = Batcher(sess, max_batch=4, max_wait=10.0)
+        futs = [batcher.submit(h, RNG.standard_normal(N))
+                for _ in range(4)]
+        batcher.flush()
+        for f in futs:
+            f.result(timeout=30)
+        assert sess.pump_timeseries() == 0
+
+    sess, h, _ = _lu_session()
+    assert sess.timeseries is None
+    sess.solve(h, RNG.standard_normal(N))  # warm the compile caches
+    gc.collect()
+    tracemalloc.start()
+    try:
+        _serve(sess, h)
+        disabled = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    assert sum(s.size for s in disabled.statistics("filename")) == 0
+
+    sess2, h2, _ = _lu_session()
+    sess2.enable_timeseries(interval_s=0.0)
+    sess2.solve(h2, RNG.standard_normal(N))
+    gc.collect()
+    tracemalloc.start()
+    try:
+        batcher = Batcher(sess2, max_batch=4, max_wait=10.0)
+        futs = [batcher.submit(h2, RNG.standard_normal(N))
+                for _ in range(4)]
+        batcher.flush()
+        for f in futs:
+            f.result(timeout=30)
+        assert sess2.pump_timeseries(force=True) > 0
+        enabled = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    assert sum(s.size for s in enabled.statistics("filename")) > 0
+
+
+# -- the /history route ------------------------------------------------------
+
+
+def test_history_and_forecast_routes_serve_schema_valid_payloads():
+    sess, h, _ = _lu_session()
+    sess.enable_attribution()
+    sess.enable_timeseries(interval_s=0.0)
+    srv = sess.serve_obs()
+    try:
+        for _ in range(2):
+            sess.solve(h, RNG.standard_normal(N))
+            sess.pump_timeseries(force=True)
+        hist = json.loads(urllib.request.urlopen(
+            srv.url("/history"), timeout=10).read().decode())
+        assert validate_timeseries(hist) == []
+        assert hist["series"]
+        # ?series= filters
+        assert "solves_total" in hist["series"]
+        filt = json.loads(urllib.request.urlopen(
+            srv.url("/history?series=solves_total"),
+            timeout=10).read().decode())
+        assert set(filt["series"]) == {"solves_total"}
+        fc = json.loads(urllib.request.urlopen(
+            srv.url("/forecast"), timeout=10).read().decode())
+        assert fc["schema"] == "slate_tpu.forecast.v1"
+        assert obs.validate_forecast(fc) == []
+    finally:
+        sess.close_obs()
+
+
+# -- fleet fold --------------------------------------------------------------
+
+
+def _two_host_payloads():
+    docs = []
+    for host, base in (("p0", 10.0), ("p1", 20.0)):
+        store, t = _clocked(host=host)
+        cum = 0.0
+        for i in range(6):
+            t["now"] = float(i)
+            store.record_gauge("queue_depth", base + i)
+            cum += 3.0
+            store.record_counter("solves_total", cum)
+        docs.append(store.payload())
+    return docs
+
+
+def test_fleet_fold_is_host_labeled_with_exact_conservation():
+    docs = _two_host_payloads()
+    fleet = merge_timeseries_payloads(docs, hosts=["p0", "p1"])
+    assert fleet["schema"] == "slate_tpu.timeseries.fleet.v1"
+    # one queue-depth history per member, not one mush
+    assert "p0:queue_depth" in fleet["series"]
+    assert "p1:queue_depth" in fleet["series"]
+    # counter totals are the exact sum across members
+    assert fleet["counter_totals"]["solves_total"] == 36.0
+    # folding a payload with itself doubles every total bit-exactly
+    twice = merge_timeseries_payloads([docs[0], docs[0]],
+                                      hosts=["a", "b"])
+    assert twice["counter_totals"]["solves_total"] == 2 * 18.0
+
+
+def test_fleet_fold_tolerates_a_lost_member():
+    docs = _two_host_payloads()
+    fleet = merge_timeseries_payloads([docs[0], None], hosts=["p0",
+                                                              "dead"])
+    assert fleet["partial_processes"] == 1
+    assert fleet["counter_totals"]["solves_total"] == 18.0
+
+
+def test_capacity_report_fold_matches_runtime_fold():
+    """tools/capacity_report.py re-implements the fold jax-free for
+    exported payload files — this pin keeps the two from drifting:
+    same series keys, same counter totals, same drop accounting."""
+    cr = _capacity_report()
+    docs = _two_host_payloads()
+    ours = merge_timeseries_payloads(docs, hosts=["p0", "p1"])
+    theirs = cr.fold_payloads(docs, hosts=["p0", "p1"])
+    assert set(theirs["series"]) == set(ours["series"])
+    assert theirs["counter_totals"] == ours["counter_totals"]
+    assert (theirs["dropped_samples"], theirs["dropped_series"]) == \
+        (ours["dropped_samples"], ours["dropped_series"])
+
+
+# -- bench_gate mirrors ------------------------------------------------------
+
+
+def test_bench_gate_binds_the_real_validators():
+    """bench_gate stays jax-free by FILE-LOADING obs/timeseries.py and
+    obs/forecast.py under fixed module names — import identity, not a
+    duplicated rule set. The pin: its bound validators are the very
+    functions defined in this package's source files."""
+    bg = _bench_gate()
+    from slate_tpu.obs import forecast as fmod
+    from slate_tpu.obs import timeseries as tmod
+    assert (bg.validate_timeseries_doc.__code__.co_filename
+            == tmod.validate_timeseries.__code__.co_filename)
+    assert (bg.validate_forecast_doc.__code__.co_filename
+            == fmod.validate_forecast.__code__.co_filename)
+    # and they behave identically on the same malformed docs
+    for doc in ({"schema": "wrong"}, {}, {"schema": TIMESERIES_SCHEMA}):
+        assert bool(bg.validate_timeseries_doc(doc)) == \
+            bool(validate_timeseries(doc))
+
+
+def test_bench_gate_checks_serve_forecast_section():
+    """The serve artifact's forecast section is exit-gated: a
+    conservation row with store != counter must fail the schema
+    check."""
+    bg = _bench_gate()
+    store, t = _clocked()
+    store.record_counter("solves_total", 5.0)
+    store.record_gauge("queue_depth", 1.0)
+    from slate_tpu.obs.forecast import Forecaster
+    section = {
+        "enabled": True, "ok": True, "series_count": 2,
+        "dropped_series": 0, "dropped_samples": 0,
+        "conservation": {"solves_total": {"store": 5.0, "counter": 5.0,
+                                          "ok": True}},
+        "history": store.payload(),
+        "forecast": Forecaster(store).payload(horizon_s=10.0),
+    }
+    bg._check_forecast_section("t", dict(section))   # passes
+    broken = dict(section)
+    broken["conservation"] = {"solves_total": {
+        "store": 5.0, "counter": 6.0, "ok": False}}
+    broken["ok"] = False
+    with pytest.raises(bg.SchemaError):
+        bg._check_forecast_section("t", broken)
+    with pytest.raises(bg.SchemaError):
+        bg._check_forecast_section("t", {"enabled": False})

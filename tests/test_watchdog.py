@@ -52,8 +52,10 @@ def test_committed_baseline_loads_and_validates():
     assert validate_baseline(doc) == []
     # direction annotation: residual, latency, queue-age (round 14
     # overload columns), recovery/failover/refactor series (round 17
-    # failover columns), and sync.* transfer-byte series (round 20
-    # delta replication) are lower-is-better, everything else higher
+    # failover columns), sync.* transfer-byte series (round 20 delta
+    # replication), and the round-23 forecast columns (holdout MAE,
+    # store overhead pct, record-path ns/sample — error and cost) are
+    # lower-is-better, everything else higher
     for row in doc["series"]:
         want = ("lower" if (row["metric"].startswith("residual_")
                             or row["metric"].startswith("sync.")
@@ -61,7 +63,10 @@ def test_committed_baseline_loads_and_validates():
                             or "age_s" in row["metric"]
                             or "recovery" in row["metric"]
                             or "failover" in row["metric"]
-                            or "refactor" in row["metric"])
+                            or "refactor" in row["metric"]
+                            or "mae" in row["metric"]
+                            or "overhead" in row["metric"]
+                            or "ns_per_sample" in row["metric"])
                 else "higher")
         assert row["direction"] == want, row["metric"]
     # real tpu history exists (rounds 1–5 on-chip runs) — the series
@@ -258,6 +263,100 @@ def test_direction_classifier_covers_latency_series():
     assert bg._direction("residual_posv_hemm") == "lower"
     assert bg._direction("serve.solves_per_sec") == "higher"
     assert bg._direction("potrf_gflops") == "higher"
+    # round 23: forecast-error, store-overhead, and record-path-cost
+    # series are lower-is-better
+    assert bg._direction("holdout_mae") == "lower"
+    assert bg._direction("store_overhead_pct") == "lower"
+    assert bg._direction("record_ns_per_sample") == "lower"
+
+
+# -- history-backed mode (round 23) ------------------------------------------
+
+
+def test_history_mode_window_mean_catches_what_charity_hides():
+    """The satellite window-fix: a window that spent most of its time
+    regressed with one healthy spike PASSES the charitable deque path
+    (window best) but FAILS the history-backed path (true window
+    mean) — same observations, same baseline."""
+    from slate_tpu.obs.timeseries import TimeseriesStore
+
+    samples = [(float(t), 50.0) for t in range(10, 20)]  # regressed
+    samples.append((20.0, 99.0))                         # one spike
+
+    deque_wd = Watchdog(baseline=_synthetic(best=100.0))
+    for t, v in samples:
+        deque_wd.observe("serve.solves_per_sec", v, "tpu", n=512,
+                         kind="serve", t=t)
+    assert deque_wd.check(now=21.0)["ok"]  # charity: best-of-window
+
+    store = TimeseriesStore(clock=lambda: 0.0)
+    hist_wd = Watchdog(baseline=_synthetic(best=100.0), store=store)
+    for t, v in samples:
+        hist_wd.observe("serve.solves_per_sec", v, "tpu", n=512,
+                        kind="serve", t=t)
+    rep = hist_wd.check(now=21.0)
+    assert not rep["ok"] and len(rep["anomalies"]) == 1
+    row = rep["anomalies"][0]
+    assert row["aggregate"] == "window_mean"
+    # live is the exact mean (10*50 + 99) / 11
+    assert row["live"] == pytest.approx((10 * 50.0 + 99.0) / 11)
+
+
+def test_history_mode_observations_land_in_the_store():
+    """One resident history, no duplicated deque state: observations
+    go to the TimeseriesStore under the wd:-prefixed key vocabulary
+    and the deque map stays empty."""
+    from slate_tpu.obs.timeseries import TimeseriesStore
+
+    store = TimeseriesStore(clock=lambda: 0.0)
+    wd = Watchdog(baseline=_synthetic(best=100.0), store=store)
+    wd.observe("serve.solves_per_sec", 95.0, "tpu", n=512, kind="serve",
+               t=5.0)
+    assert not wd._live
+    names = store.names()
+    assert len(names) == 1 and names[0].startswith("wd:")
+    assert store.points(names[0]) == [(5.0, 95.0)]
+    # the /history view of watchdog traffic is queryable like any series
+    assert wd.check(now=6.0)["ok"]
+
+
+def test_history_mode_matches_deque_verdict_on_clean_series():
+    """Parity pin: on a steady series the two modes agree in verdict
+    and (to float exactness on a constant window) in the live value —
+    store=None stays the byte-identical round-12 path."""
+    from slate_tpu.obs.timeseries import TimeseriesStore
+
+    for live_v, want_ok in ((95.0, True), (50.0, False)):
+        deque_wd = Watchdog(baseline=_synthetic(best=100.0))
+        store_wd = Watchdog(baseline=_synthetic(best=100.0),
+                            store=TimeseriesStore(clock=lambda: 0.0))
+        for wd in (deque_wd, store_wd):
+            for t in range(10, 15):
+                wd.observe("serve.solves_per_sec", live_v, "tpu", n=512,
+                           kind="serve", t=float(t))
+            rep = wd.check(now=15.0)
+            assert rep["ok"] is want_ok
+            assert rep["matched"] == 1
+        assert deque_wd._live and store_wd.store is not None
+
+
+def test_history_mode_window_uses_tier_fallback():
+    """A raw ring too small for the window still yields the TRUE
+    window mean (the finest tier covers the forgotten prefix) — the
+    whole point of backing the watchdog with the store."""
+    from slate_tpu.obs.timeseries import TimeseriesStore
+
+    store = TimeseriesStore(raw_capacity=4, tier_capacities=(100, 100),
+                            clock=lambda: 0.0)
+    wd = Watchdog(baseline=_synthetic(best=100.0), store=store,
+                  window_s=300.0)
+    # 20 samples at 10 s spacing, all regressed; ring holds only 4
+    for i in range(20):
+        wd.observe("serve.solves_per_sec", 50.0, "tpu", n=512,
+                   kind="serve", t=float(10 * i))
+    rep = wd.check(now=200.0)
+    assert len(rep["anomalies"]) == 1
+    assert rep["anomalies"][0]["live"] == pytest.approx(50.0)
 
 
 def test_baseline_out_regenerates_over_invalid_committed_file(tmp_path):

@@ -136,6 +136,15 @@ TRACKED_TUNED = ("tuned.solves_per_sec", "default.solves_per_sec",
 # --check-schema holding it to the schema is what keeps the serving
 # seam and this gate reading the same document
 TRACKED_TUNING = ("tuned.gflops",)
+# the round-23 sensing-substrate A/B (bench_serve.py --forecast →
+# BENCH_FORECAST_r*.json): the holdout improvement (forecast MAE vs
+# naive-last MAE — higher is better) plus the store cost columns
+# (record-path ns/sample and the forced-pump serve overhead — both
+# classify lower-is-better via _direction). Period detection and the
+# aperiodic control are structural evidence, never series.
+TRACKED_FORECAST = ("holdout.improvement",
+                    "store.record_ns_per_sample",
+                    "serve.overhead_pct")
 GATED_PLATFORMS = ("tpu", "axon")
 
 # SHARED with bench_serve.py since round 22 (tools/serve_sections.py,
@@ -208,6 +217,41 @@ INCIDENT_KEYS = (
     "journal", "flight", "metrics", "numerics", "quotas", "placement",
     "cost_log", "tuning")
 DEFAULT_TOLERANCE = 0.10
+
+# round 23: the timeseries/forecast/capacity validators are NOT
+# duplicated — slate_tpu/obs/{timeseries,forecast}.py are stdlib-only
+# with no relative imports, so this tool loads the REAL modules by
+# file path (the serve_sections discipline: one fixed module name,
+# shared with tools/capacity_report.py; the drift pin degenerates to
+# an import-identity test on __code__.co_filename).
+TIMESERIES_SCHEMA = "slate_tpu.timeseries.v1"
+FORECAST_SCHEMA = "slate_tpu.forecast.v1"
+CAPACITY_SCHEMA = "slate_tpu.capacity_report.v1"
+
+
+def _load_by_path(fixed_name: str, *relpath: str):
+    import importlib.util
+    mod = sys.modules.get(fixed_name)
+    if mod is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        path = os.path.join(root, *relpath)
+        spec = importlib.util.spec_from_file_location(fixed_name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[fixed_name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+validate_timeseries_doc = _load_by_path(
+    "slate_tpu_obs_timeseries", "slate_tpu", "obs",
+    "timeseries.py").validate_timeseries
+validate_forecast_doc = _load_by_path(
+    "slate_tpu_obs_forecast", "slate_tpu", "obs",
+    "forecast.py").validate_forecast
+validate_capacity_doc = _load_by_path(
+    "slate_tpu_capacity_report", "tools",
+    "capacity_report.py").validate_capacity_report
 
 _N_RE = re.compile(r"_n(\d+)$")
 # any committed artifact family named <FAMILY>_r<round>.json (BENCH_,
@@ -650,7 +694,7 @@ def _normalize_chaos(name: str, obj: dict,
               "slo_consistent", "fleet_fold_ok",
               "schedule_reproducible",
               "noisy_neighbor_isolated", "migration_zero_refactor",
-              "recorder_black_box"):
+              "recorder_black_box", "forecast_leads_peak"):
         if k not in inv:
             raise SchemaError(f"{name}: chaos invariants missing {k!r}")
     if not isinstance(obj["schedule"], dict) \
@@ -1101,9 +1145,77 @@ def _check_incidents_section(name: str, section) -> None:
         raise SchemaError(f"{name}: incidents section verdict not ok")
 
 
+def _check_forecast_section(name: str, section) -> None:
+    """Validate the round-23 serve-artifact ``forecast`` section: the
+    embedded /history payload held to slate_tpu.timeseries.v1, the
+    embedded /forecast payload held to slate_tpu.forecast.v1 (both by
+    the REAL validators, file-loaded above), and the exact
+    counter-conservation table — a committed fixture whose store lost
+    a count (or whose payloads fail their own schemas) is a broken
+    sensing substrate, not a slow bench."""
+    if not isinstance(section, dict):
+        raise SchemaError(f"{name}: forecast section is not an object")
+    for k in ("enabled", "ok", "series_count", "dropped_series",
+              "dropped_samples", "conservation", "history",
+              "forecast"):
+        if k not in section:
+            raise SchemaError(f"{name}: forecast section missing {k!r}")
+    if not section["enabled"]:
+        raise SchemaError(f"{name}: forecast section disabled (the "
+                          "bench session must run its time-series "
+                          "store)")
+    errs = validate_timeseries_doc(section["history"])
+    errs += validate_forecast_doc(section["forecast"])
+    if errs:
+        raise SchemaError(f"{name}: forecast payloads invalid: "
+                          + "; ".join(errs))
+    cons = section["conservation"]
+    if not isinstance(cons, dict) or not cons:
+        raise SchemaError(f"{name}: forecast.conservation missing/"
+                          "empty")
+    bad = [k for k, row in cons.items()
+           if not (isinstance(row, dict) and row.get("ok"))]
+    if bad:
+        raise SchemaError(
+            f"{name}: forecast.conservation broken for {bad} (store "
+            "delta sum != live counter)")
+    if not section["ok"]:
+        raise SchemaError(f"{name}: forecast section verdict not ok")
+
+
 def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
     if not isinstance(obj, dict):
         raise SchemaError(f"{name}: top level is not an object")
+
+    if obj.get("bench") == "serve_forecast":
+        for k in ("platform", "n", "serve", "store", "holdout", "ok"):
+            if k not in obj:
+                raise SchemaError(
+                    f"{name}: serve_forecast row missing {k!r}")
+        hold = obj["holdout"]
+        if not isinstance(hold, dict) or "improvement" not in hold:
+            raise SchemaError(f"{name}: serve_forecast holdout "
+                              "missing improvement")
+        if hold.get("aperiodic_period_s") is not None:
+            raise SchemaError(f"{name}: serve_forecast claims a "
+                              "period on the aperiodic control")
+        return {
+            "round": fname_round, "source": name,
+            "kind": "serve_forecast",
+            "platform": str(obj["platform"]), "n": int(obj["n"]),
+            "ok": bool(obj["ok"]),
+            "metrics": _flat_metrics(obj, TRACKED_FORECAST),
+        }
+
+    if obj.get("schema") == CAPACITY_SCHEMA:
+        errs = validate_capacity_doc(obj)
+        if errs:
+            raise SchemaError(f"{name}: " + "; ".join(errs))
+        # planning artifact, never a perf series: schema-gated only
+        return {
+            "round": fname_round, "source": name, "kind": "capacity",
+            "platform": "cpu", "n": None, "ok": True, "metrics": {},
+        }
 
     if obj.get("bench") == "serve_batched":
         for k in ("platform", "op", "n", "batch", "batched",
@@ -1135,6 +1247,7 @@ def _normalize_obj(name: str, obj, fname_round: Optional[int]) -> dict:
         _check_updates_section(name, obj["updates"])
         _check_tuning_section(name, obj["tuning"])
         _check_incidents_section(name, obj["incidents"])
+        _check_forecast_section(name, obj["forecast"])
         return {
             "round": fname_round, "source": name, "kind": "serve",
             "platform": str(obj["backend"]), "n": int(obj["n"]),
@@ -1210,6 +1323,8 @@ def discover(root: str) -> List[str]:
              + glob.glob(os.path.join(root, "BENCH_UPDATE_r*.json"))
              + glob.glob(os.path.join(root, "BENCH_TUNED_r*.json"))
              + glob.glob(os.path.join(root, "TUNING_r*.json"))
+             + glob.glob(os.path.join(root, "BENCH_FORECAST_r*.json"))
+             + glob.glob(os.path.join(root, "CAPACITY_r*.json"))
              + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
              + glob.glob(os.path.join(root, "CHAOS_r*.json")))
     # bench_serve writes <stem>.metrics.json / <stem>.prom exposition
@@ -1292,12 +1407,15 @@ def _direction(metric: str) -> str:
     inverted direction (the watchdog would then read a 10× p99 rise as
     an improvement). The round-20 ``sync.*`` columns (delta-vs-full
     replica transfer bytes and their ratio) are transfer COSTS —
-    lower-is-better by the same rule."""
+    lower-is-better by the same rule, as are the round-23 forecast
+    columns (holdout MAE, store overhead pct, record-path ns/sample
+    — error and cost, not throughput)."""
     if metric.startswith("residual_") or metric.startswith("sync.") \
             or "latency" in metric \
             or "age_s" in metric or "recovery" in metric \
             or "failover" in metric or "refactor" in metric \
-            or "quota" in metric:
+            or "quota" in metric or "mae" in metric \
+            or "overhead" in metric or "ns_per_sample" in metric:
         return "lower"
     return "higher"
 

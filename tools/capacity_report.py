@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Fleet capacity report — timeseries payloads in, one planning
+artifact out.
+
+Consumes one or more ``slate_tpu.timeseries.v1`` payload files (the
+``/history`` route document, one per host — Session.timeseries
+.payload() dumped to JSON) and renders the round-23
+``slate_tpu.capacity_report.v1`` artifact:
+
+* ``handles``  — per-handle predicted heat peak over the horizon
+  (Holt-Winters / seasonal-naive / trend ladder, forecast.py's
+  method selection), ranked hottest-first: ROADMAP item 3's
+  pre-replication input.
+* ``headroom`` — runway projections for the lower-is-worse gauges
+  (hbm_headroom + per-tenant quota headroom): seconds until the
+  linear trend crosses zero, None when flat/rising.
+* ``store``    — fold health: series counts, cardinality-cap drops
+  (summed exactly across hosts), counter conservation totals.
+
+Jax-free by construction: ``slate_tpu/__init__`` imports the linalg
+stack, so this tool loads ``slate_tpu/obs/forecast.py`` (pure stdlib,
+no relative imports) by FILE PATH under one fixed module name — the
+same ``_load()`` discipline bench_gate uses for serve_sections. The
+small payload fold is local (aggregate.py has relative imports and
+cannot be file-loaded); tests pin it against
+``merge_timeseries_payloads`` on the same inputs.
+
+Exit status: 0 iff the rendered report passes
+``validate_capacity_report`` (and every input passed the timeseries
+schema check). ``--selftest`` runs the whole pipeline on a synthetic
+two-host diurnal trace under a fixed clock — deterministic, no
+inputs needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+CAPACITY_SCHEMA = "slate_tpu.capacity_report.v1"
+TIMESERIES_SCHEMA = "slate_tpu.timeseries.v1"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_forecast():
+    """File-path load of slate_tpu/obs/forecast.py under ONE fixed
+    module name (stdlib-only module, no relative imports — loadable
+    without dragging jax in through the package root)."""
+    name = "slate_tpu_obs_forecast"
+    mod = sys.modules.get(name)
+    if mod is None:
+        path = os.path.join(_REPO, "slate_tpu", "obs", "forecast.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+_fc = _load_forecast()
+
+# heat / headroom vocabularies come from the loaded module so the
+# report and the live Forecaster can never disagree on them
+HEAT_PREFIXES = _fc._HEAT_PREFIXES
+HEADROOM_SERIES = _fc._HEADROOM_SERIES
+HEADROOM_PREFIXES = _fc._HEADROOM_PREFIXES
+
+
+# -- payload fold (local mirror of aggregate.merge_timeseries_payloads;
+#    drift-pinned by tests/test_timeseries.py) ----------------------------
+
+
+def fold_payloads(payloads: Sequence[Optional[dict]],
+                  hosts: Optional[Sequence[str]] = None) -> dict:
+    """N per-host timeseries payloads -> one labeled fold. ``None``
+    entries are tolerated (partial fleet) and counted. Counter totals
+    are summed EXACTLY (pure float adds in file order)."""
+    n = len(payloads)
+    labels = ([str(h) for h in hosts] if hosts is not None
+              else [f"p{i}" for i in range(n)])
+    series: Dict[str, dict] = {}
+    counter_totals: Dict[str, float] = {}
+    dropped_series = 0
+    dropped_samples = 0
+    partial = 0
+    for label, doc in zip(labels, payloads):
+        if doc is None:
+            partial += 1
+            continue
+        dropped_series += int(doc.get("dropped_series", 0))
+        dropped_samples += int(doc.get("dropped_samples", 0))
+        for name, row in (doc.get("series") or {}).items():
+            out = dict(row)
+            out["host"] = label
+            series[f"{label}:{name}"] = out
+            if row.get("kind") == "counter":
+                counter_totals[name] = (counter_totals.get(name, 0.0)
+                                        + float(row.get("total_sum",
+                                                        0.0)))
+    return {
+        "processes": n,
+        "partial_processes": partial,
+        "hosts": labels,
+        "dropped_series": dropped_series,
+        "dropped_samples": dropped_samples,
+        "series": series,
+        "counter_totals": counter_totals,
+    }
+
+
+# -- report ---------------------------------------------------------------
+
+
+def _series_points(row: dict) -> List[List[float]]:
+    return [[float(t), float(v)] for t, v in (row.get("raw") or [])]
+
+
+def build_report(payloads: Sequence[Optional[dict]],
+                 hosts: Optional[Sequence[str]] = None,
+                 horizon_s: float = 600.0, k: int = 16,
+                 now: Optional[float] = None) -> dict:
+    """The capacity artifact. ``now`` defaults to the max sample
+    timestamp across the fold (NOT wall clock — the committed artifact
+    must be a pure function of its inputs)."""
+    fold = fold_payloads(payloads, hosts=hosts)
+    last_ts = [row.get("last_ts") for row in fold["series"].values()
+               if row.get("last_ts") is not None]
+    if now is None:
+        now = max(last_ts) if last_ts else 0.0
+
+    handles: List[dict] = []
+    headroom: List[dict] = []
+    for key in sorted(fold["series"]):
+        row = fold["series"][key]
+        host = row["host"]
+        name = key[len(host) + 1:]
+        pfx = next((p for p in HEAT_PREFIXES if name.startswith(p)),
+                   None)
+        if pfx is not None:
+            pts = _series_points(row)
+            fc = _fc.forecast_points(pts, horizon_s)
+            if not fc["points"]:
+                continue
+            peak = max(fc["points"], key=lambda p: p[1])
+            handles.append({
+                "host": host, "series": name,
+                "handle": name[len(pfx):],
+                "current": fc["last"],
+                "predicted_peak": peak[1], "peak_ts": peak[0],
+                "method": fc["method"], "period_s": fc["period_s"],
+            })
+            continue
+        if (name in HEADROOM_SERIES
+                or any(name.startswith(p)
+                       for p in HEADROOM_PREFIXES)):
+            pts = _series_points(row)
+            runway: Optional[float] = None
+            last = pts[-1][1] if pts else None
+            if last is not None and len(pts) >= 2:
+                fc = _fc.forecast_points(pts, horizon_s=1.0)
+                if last <= 0.0:
+                    runway = 0.0
+                elif fc["slope_per_s"] < 0:
+                    runway = last / (-fc["slope_per_s"])
+            headroom.append({
+                "host": host, "series": name, "current": last,
+                "runway_s": runway,
+            })
+
+    handles.sort(key=lambda r: (-r["predicted_peak"], r["series"],
+                                r["host"]))
+    return {
+        "schema": CAPACITY_SCHEMA,
+        "generated_at": now,
+        "horizon_s": float(horizon_s),
+        "hosts": fold["hosts"],
+        "processes": fold["processes"],
+        "partial_processes": fold["partial_processes"],
+        "handles": handles[:int(k)],
+        "headroom": headroom,
+        "store": {
+            "series_count": len(fold["series"]),
+            "dropped_series": fold["dropped_series"],
+            "dropped_samples": fold["dropped_samples"],
+            "counter_totals": fold["counter_totals"],
+        },
+    }
+
+
+def validate_capacity_report(doc: dict) -> List[str]:
+    """Schema errors (empty = valid) — mirrored jax-free in
+    tools/bench_gate.py (drift-pinned by test)."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["capacity: top level is not an object"]
+    if doc.get("schema") != CAPACITY_SCHEMA:
+        errs.append(f"capacity: schema {doc.get('schema')!r} != "
+                    f"{CAPACITY_SCHEMA!r}")
+    for key in ("generated_at", "horizon_s", "hosts", "handles",
+                "headroom", "store"):
+        if key not in doc:
+            errs.append(f"capacity: missing {key!r}")
+    for row in (doc.get("handles") or []
+                if isinstance(doc.get("handles"), list) else []):
+        for key in ("host", "series", "handle", "predicted_peak",
+                    "peak_ts", "method"):
+            if not (isinstance(row, dict) and key in row):
+                errs.append(f"capacity: handles row missing {key!r}")
+                break
+    if not isinstance(doc.get("handles"), list):
+        errs.append("capacity: handles is not a list")
+    for row in (doc.get("headroom") or []
+                if isinstance(doc.get("headroom"), list) else []):
+        for key in ("host", "series", "runway_s"):
+            if not (isinstance(row, dict) and key in row):
+                errs.append(f"capacity: headroom row missing {key!r}")
+                break
+    if not isinstance(doc.get("headroom"), list):
+        errs.append("capacity: headroom is not a list")
+    store = doc.get("store")
+    if not isinstance(store, dict):
+        errs.append("capacity: store is not an object")
+    else:
+        for key in ("series_count", "dropped_series",
+                    "dropped_samples", "counter_totals"):
+            if key not in store:
+                errs.append(f"capacity: store missing {key!r}")
+    return errs
+
+
+# -- selftest -------------------------------------------------------------
+
+
+def _selftest_payloads() -> List[dict]:
+    """Two synthetic host payloads: a diurnal heat wave (host a leads
+    host b by half a cycle), a draining hbm_headroom gauge, and one
+    counter split across hosts — fully deterministic."""
+    t0 = 1_000.0
+    period, amp, n = 300.0, 4.0, 120
+    hosts = []
+    for h, phase in (("a", 0.0), ("b", math.pi)):
+        raw_hot = []
+        raw_cold = []
+        raw_head = []
+        for i in range(n):
+            t = t0 + 10.0 * i
+            hot = 5.0 + amp * math.sin(
+                2 * math.pi * (10.0 * i) / period + phase)
+            raw_hot.append([t, hot])
+            raw_cold.append([t, 0.5])
+            raw_head.append([t, 4.0e9 - 2.0e6 * i])
+        series = {
+            "heat:h0": {"kind": "gauge", "last": raw_hot[-1][1],
+                        "last_ts": raw_hot[-1][0],
+                        "total_sum": sum(v for _, v in raw_hot),
+                        "total_count": n, "raw": raw_hot,
+                        "tiers": {"10": [], "60": []}},
+            "heat:h1": {"kind": "gauge", "last": 0.5,
+                        "last_ts": raw_cold[-1][0],
+                        "total_sum": 0.5 * n, "total_count": n,
+                        "raw": raw_cold,
+                        "tiers": {"10": [], "60": []}},
+            "hbm_headroom": {"kind": "gauge", "last": raw_head[-1][1],
+                             "last_ts": raw_head[-1][0],
+                             "total_sum": sum(v for _, v in raw_head),
+                             "total_count": n, "raw": raw_head,
+                             "tiers": {"10": [], "60": []}},
+            "requests_total": {"kind": "counter", "last": 7.0,
+                               "last_ts": t0 + 10.0 * (n - 1),
+                               "total_sum": 170.0, "total_count": n,
+                               "raw": [], "tiers": {"10": [],
+                                                    "60": []}},
+        }
+        hosts.append({
+            "schema": TIMESERIES_SCHEMA, "host": h,
+            "now": t0 + 10.0 * n, "max_series": 512,
+            "raw_capacity": 240, "tier_widths": [10.0, 60.0],
+            "tier_capacities": [360, 360], "series_count": len(series),
+            "dropped_series": 0, "dropped_samples": 0,
+            "series": series,
+        })
+    return hosts
+
+
+def _run_selftest() -> int:
+    report = build_report(_selftest_payloads(), hosts=["a", "b"],
+                          horizon_s=600.0, k=4)
+    errs = validate_capacity_report(report)
+    ok = not errs
+    # the hot handle must outrank the flat one on BOTH hosts, the
+    # seasonal ladder must have engaged (4 cycles retained), and the
+    # draining gauge must get a finite runway
+    tops = [r for r in report["handles"] if r["handle"] == "h0"]
+    if len(tops) != 2:
+        errs.append("selftest: expected heat:h0 from both hosts in "
+                    "the top-k")
+    for r in tops:
+        if r["method"] not in ("holt_winters", "seasonal_naive"):
+            errs.append(f"selftest: heat:h0@{r['host']} method "
+                        f"{r['method']!r}, want seasonal")
+        if not (r["predicted_peak"] > 7.0):
+            errs.append(f"selftest: heat:h0@{r['host']} peak "
+                        f"{r['predicted_peak']:.2f} <= 7.0")
+    runways = [r["runway_s"] for r in report["headroom"]
+               if r["series"] == "hbm_headroom"]
+    if len(runways) != 2 or any(
+            rw is None or not (0.0 < rw < 1.0e6) for rw in runways):
+        errs.append(f"selftest: hbm runways {runways!r} not finite")
+    want_total = 340.0  # 170 per host, summed exactly
+    got = report["store"]["counter_totals"].get("requests_total")
+    if got != want_total:
+        errs.append(f"selftest: counter fold {got!r} != {want_total}")
+    ok = ok and not errs
+    print(json.dumps({"selftest_ok": ok, "errors": errs,
+                      "handles": report["handles"],
+                      "headroom": report["headroom"]}, indent=2))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("payloads", nargs="*",
+                    help="timeseries payload JSON files (one per "
+                    "host; file stem = host label unless the payload "
+                    "carries one)")
+    ap.add_argument("--out", default=None,
+                    help="write the report here (default: stdout)")
+    ap.add_argument("--horizon-s", type=float, default=600.0)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic two-host drill, no inputs needed")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _run_selftest()
+    if not args.payloads:
+        ap.error("no payload files (or --selftest)")
+
+    docs: List[dict] = []
+    hosts: List[str] = []
+    bad = 0
+    for path in args.payloads:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") != TIMESERIES_SCHEMA:
+            print(f"capacity_report: {path}: schema "
+                  f"{doc.get('schema')!r} != {TIMESERIES_SCHEMA!r}",
+                  file=sys.stderr)
+            bad += 1
+            continue
+        docs.append(doc)
+        hosts.append(doc.get("host")
+                     or os.path.splitext(os.path.basename(path))[0])
+    if not docs:
+        print("capacity_report: no valid payloads", file=sys.stderr)
+        return 1
+
+    report = build_report(docs, hosts=hosts, horizon_s=args.horizon_s,
+                          k=args.k)
+    errs = validate_capacity_report(report)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"capacity_report: wrote {args.out} "
+              f"({len(report['handles'])} handles, "
+              f"{len(report['headroom'])} headroom rows)")
+    else:
+        print(text)
+    for e in errs:
+        print(f"capacity_report: {e}", file=sys.stderr)
+    return 1 if (errs or bad) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

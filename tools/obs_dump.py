@@ -19,13 +19,20 @@ text exposition, and the HTTP endpoint — then writes the artifacts:
   <out-dir>/fleet.json    2-process aggregation of the run's snapshot
   <out-dir>/fleet.prom    fleet-level Prometheus text (host labels)
   <out-dir>/fleet_trace.json  2-process combined Chrome trace
+  <out-dir>/history.json  /history telemetry time-series payload
+  <out-dir>/forecast.json /forecast load/heat forecast payload
 
 Exit status is nonzero if the Chrome JSON fails schema validation
 (obs.validate_chrome_trace: required keys, monotone ts, span nesting),
 if the span tree is disconnected, if the HTTP endpoint serves the
 wrong payloads, if the round-9 cost exports are missing/incomplete
 (empty cost_log, absent Prometheus bytes/HBM sections, or a mesh run
-that credited zero collective bytes), or if any round-12 section
+that credited zero collective bytes), if any round-23 section fails
+(the /history payload rejecting its own validator, a served run whose
+store recorded no series, forecast output that fails schema, counter
+conservation through the store broken, or a 2-process history fold
+whose counter totals are not exactly double), or if any round-12
+section
 fails: /slo payload without computed burn rates, lifecycle-stage
 histograms or backpressure gauges missing, the watchdog flagging the
 real committed history (or NOT flagging the injected regression),
@@ -125,6 +132,11 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
     # absolute equality, so the recorder must predate any reflex)
     sess.enable_recorder(incident_dir=os.path.join(out_dir,
                                                    "incidents"))
+    # round 23: telemetry history on before any traffic (interval 0 so
+    # every explicit pump records — the smoke is pump-driven, not
+    # wall-clock-throttled); the /history, /forecast, and 2-process
+    # fold sections below are exit-gated
+    store = sess.enable_timeseries(interval_s=0.0)
     h = sess.register(A, op="chol", tenant="tenant-a")
     srv = sess.serve_obs()  # opt-in HTTP endpoint, ephemeral port
     try:
@@ -132,7 +144,10 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         with Executor(sess, max_batch=4, max_wait=1e-3) as ex:
             ex.warmup([h])
             futs = [ex.submit(h, b) for b in bs]
-            xs = [f.result(timeout=120) for f in futs]
+            xs = []
+            for f in futs:
+                xs.append(f.result(timeout=120))
+                sess.pump_timeseries()  # per-result history samples
         resid = max(float(np.abs(spd @ x - b).max()) / n
                     for x, b in zip(xs, bs))
         if not resid < 1e-2:
@@ -685,6 +700,54 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
         if len(iflt["incidents"]) != 2 * len(ip["incidents"]):
             fails.append("incident fold dropped incidents")
 
+        # -- telemetry history + forecasting (round 23) -----------------
+        # the served run above pumped a sample per completed request:
+        # the /history payload must self-validate and carry series, the
+        # /forecast payload must self-validate, every counter series
+        # total must equal the live counter EXACTLY (delta-storage
+        # conservation), and a 2-process fold must double the counter
+        # totals bit-exactly with host-labeled series
+        sess.pump_timeseries(force=True)
+        hist = store.payload()
+        terrs = obs.validate_timeseries(hist)
+        if terrs:
+            fails.append(f"/history payload schema: {terrs[:3]}")
+        if not hist["series"]:
+            fails.append("history store recorded no series over a "
+                         "served run")
+        with open(os.path.join(out_dir, "history.json"), "w") as f:
+            json.dump(hist, f, indent=2, sort_keys=True)
+            f.write("\n")
+        fc = sess.forecaster.payload(horizon_s=60.0, k=8,
+                                     max_series=64, points_limit=8)
+        ferrs = obs.validate_forecast(fc)
+        if ferrs:
+            fails.append(f"/forecast payload schema: {ferrs[:3]}")
+        with open(os.path.join(out_dir, "forecast.json"), "w") as f:
+            json.dump(fc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        ctot = store.counter_totals()
+        if not ctot:
+            fails.append("history store tracked no counter series")
+        csnap = sess.metrics.snapshot()["counters"]
+        for nm, total in ctot.items():
+            if total != csnap.get(nm, 0.0):
+                fails.append("history counter conservation broken for "
+                             f"{nm}: store {total!r} != live "
+                             f"{csnap.get(nm)!r}")
+                break
+        ts_fleet = obs.aggregate.merge_timeseries_payloads(
+            [hist, hist], hosts=["p0", "p1"])
+        for nm, total in ts_fleet.get("counter_totals", {}).items():
+            if total != 2 * ctot.get(nm, 0.0):
+                fails.append("history fold counter totals not exact "
+                             f"for {nm}: {total!r} != 2*{ctot.get(nm)!r}")
+                break
+        if hist["series"] and not any(
+                k4.startswith(("p0:", "p1:"))
+                for k4 in ts_fleet["series"]):
+            fails.append("history fold series not host-labeled")
+
         # -- HTTP endpoint --------------------------------------------
         for path, needle in (("/metrics", "slate_tpu_solves_total"),
                              ("/healthz", '"status": "ok"'),
@@ -693,7 +756,11 @@ def run(out_dir, n=96, nb=32, requests=12, slow_threshold=None):
                              ("/numerics", '"handles"'),
                              ("/journal", '"slate_tpu.journal.v1"'),
                              ("/incidents",
-                              '"slate_tpu.incidents.v1"')):
+                              '"slate_tpu.incidents.v1"'),
+                             ("/history",
+                              '"slate_tpu.timeseries.v1"'),
+                             ("/forecast",
+                              '"slate_tpu.forecast.v1"')):
             body = urllib.request.urlopen(srv.url(path),
                                           timeout=10).read().decode()
             if needle not in body:

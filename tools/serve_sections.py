@@ -19,4 +19,4 @@ SERVE_ARTIFACT_SECTIONS = (
     "bench", "backend", "dtype", "n", "nb", "requests", "max_batch",
     "serve", "per_request", "speedup", "cost_log", "hbm", "slo",
     "tenants", "numerics", "quotas", "spectral", "updates", "tuning",
-    "incidents")
+    "incidents", "forecast")
